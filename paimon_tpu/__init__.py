@@ -1,0 +1,37 @@
+"""paimon-tpu: a TPU-native streaming lakehouse framework.
+
+A from-scratch reimplementation of the capabilities of Apache Paimon
+(reference: /root/reference, 2.0-SNAPSHOT) designed TPU-first:
+
+- Metadata plane (snapshots, manifests, schemas, catalogs) is pure Python on
+  the host, wire-compatible with the reference's on-disk layout
+  (docs/docs/concepts/spec in the reference).
+- Data plane is Arrow on the host and struct-of-arrays jax DeviceArrays in
+  HBM; Parquet/ORC decode via Arrow C++.
+- The compute core -- k-way sorted-run merge, merge engines (deduplicate,
+  partial-update, aggregation, first-row), compaction rewrites -- runs on
+  TPU as XLA-compiled segmented sort/reduce kernels instead of the
+  reference's record-at-a-time loser tree
+  (paimon-core mergetree/compact/SortMergeReaderWithLoserTree.java:34).
+- Scale-out is a jax.sharding.Mesh over buckets instead of engine shuffles.
+"""
+
+__version__ = "0.1.0"
+
+from paimon_tpu.types import (  # noqa: F401
+    DataType, DataField, RowType,
+    TinyIntType, SmallIntType, IntType, BigIntType,
+    FloatType, DoubleType, BooleanType, CharType, VarCharType,
+    BinaryType, VarBinaryType, DecimalType, DateType, TimeType,
+    TimestampType, LocalZonedTimestampType, ArrayType, MapType,
+    MultisetType, BlobType, VariantType,
+)
+from paimon_tpu.options import Options, ConfigOption, CoreOptions  # noqa: F401
+from paimon_tpu.schema.schema import Schema  # noqa: F401
+
+
+def create_catalog(options=None, **kwargs):
+    """Create a catalog from options (analog of CatalogFactory.createCatalog,
+    reference paimon-core catalog/CatalogFactory.java)."""
+    from paimon_tpu.catalog import create_catalog as _create
+    return _create(options, **kwargs)

@@ -1,0 +1,13 @@
+"""Data plane (L1).
+
+Rows are plain Python tuples at the edges; columnar batches are Arrow
+RecordBatches on the host and struct-of-arrays jax arrays in HBM. The only
+row-level binary codec kept from the reference wire format is BinaryRow
+(paimon-common/.../data/BinaryRow.java:60), because manifests embed
+partitions and min/max stats as BinaryRow bytes.
+"""
+
+from paimon_tpu.data.binary_row import (  # noqa: F401
+    BinaryRowCodec, BINARY_ROW_EMPTY,
+)
+from paimon_tpu.data.row import GenericRow, InternalRow  # noqa: F401

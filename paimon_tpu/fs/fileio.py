@@ -1,0 +1,295 @@
+"""FileIO implementations.
+
+reference: paimon-common/.../fs/FileIO.java (SPI), fs/local/LocalFileIO.java.
+Paths are plain strings; scheme prefix (``mem://``, ``file://`` or none)
+selects the implementation via `get_file_io`.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["FileIO", "FileStatus", "LocalFileIO", "MemoryFileIO",
+           "get_file_io", "register_file_io"]
+
+
+@dataclass
+class FileStatus:
+    path: str
+    size: int
+    is_dir: bool
+    mtime_ms: int = 0
+
+
+class FileIO:
+    """Abstract file IO. All paths are absolute strings."""
+
+    # -- reading -------------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        data = self.read_bytes(path)
+        return data[offset:offset + length]
+
+    def read_utf8(self, path: str) -> str:
+        return self.read_bytes(path).decode("utf-8")
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def get_file_size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        raise NotImplementedError
+
+    def list_files(self, path: str) -> List[str]:
+        return [s.path for s in self.list_status(path) if not s.is_dir]
+
+    # -- writing -------------------------------------------------------------
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = True):
+        raise NotImplementedError
+
+    def write_utf8(self, path: str, text: str, overwrite: bool = True):
+        self.write_bytes(path, text.encode("utf-8"), overwrite)
+
+    def try_to_write_atomic(self, path: str, data: bytes) -> bool:
+        """Atomically publish `data` at `path`; False if target exists.
+        This is the commit CAS primitive (reference FileIO.tryToWriteAtomic)."""
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        raise NotImplementedError
+
+    def delete_quietly(self, path: str):
+        try:
+            self.delete(path, False)
+        except Exception:
+            pass
+
+    def rename(self, src: str, dst: str) -> bool:
+        raise NotImplementedError
+
+    def copy(self, src: str, dst: str, overwrite: bool = True):
+        self.write_bytes(dst, self.read_bytes(src), overwrite)
+
+    # -- helpers -------------------------------------------------------------
+
+    def is_object_store(self) -> bool:
+        return False
+
+
+class LocalFileIO(FileIO):
+    """Local filesystem (reference fs/local/LocalFileIO.java)."""
+
+    @staticmethod
+    def _strip(path: str) -> str:
+        if path.startswith("file://"):
+            return path[len("file://"):]
+        return path
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(self._strip(path), "rb") as f:
+            return f.read()
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        with open(self._strip(path), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._strip(path))
+
+    def get_file_size(self, path: str) -> int:
+        return os.path.getsize(self._strip(path))
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        p = self._strip(path)
+        if not os.path.isdir(p):
+            return []
+        out = []
+        for name in os.listdir(p):
+            full = os.path.join(p, name)
+            st = os.stat(full)
+            out.append(FileStatus(full, st.st_size, os.path.isdir(full),
+                                  int(st.st_mtime * 1000)))
+        return out
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = True):
+        p = self._strip(path)
+        if not overwrite and os.path.exists(p):
+            raise FileExistsError(p)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+
+    def try_to_write_atomic(self, path: str, data: bytes) -> bool:
+        p = self._strip(path)
+        if os.path.exists(p):
+            return False
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + "." + uuid.uuid4().hex + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            # On POSIX link() fails if the target exists -> CAS semantics
+            # (rename() would silently overwrite).
+            try:
+                os.link(tmp, p)
+                return True
+            except FileExistsError:
+                return False
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    def mkdirs(self, path: str) -> bool:
+        os.makedirs(self._strip(path), exist_ok=True)
+        return True
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        p = self._strip(path)
+        if not os.path.exists(p):
+            return False
+        if os.path.isdir(p):
+            if recursive:
+                shutil.rmtree(p)
+            else:
+                os.rmdir(p)
+        else:
+            os.remove(p)
+        return True
+
+    def rename(self, src: str, dst: str) -> bool:
+        s, d = self._strip(src), self._strip(dst)
+        if os.path.exists(d):
+            return False
+        os.makedirs(os.path.dirname(d), exist_ok=True)
+        try:
+            os.rename(s, d)
+            return True
+        except OSError:
+            return False
+
+
+class MemoryFileIO(FileIO):
+    """In-memory FileIO for tests (role of reference test LocalFileIO usage +
+    TraceableFileIO). One shared namespace per instance."""
+
+    def __init__(self):
+        self._files: Dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def _strip(path: str) -> str:
+        if path.startswith("mem://"):
+            return path[len("mem://"):]
+        return path
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._lock:
+            p = self._strip(path)
+            if p not in self._files:
+                raise FileNotFoundError(path)
+            return self._files[p]
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            p = self._strip(path)
+            if p in self._files:
+                return True
+            prefix = p.rstrip("/") + "/"
+            return any(k.startswith(prefix) for k in self._files)
+
+    def get_file_size(self, path: str) -> int:
+        return len(self.read_bytes(path))
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        with self._lock:
+            prefix = self._strip(path).rstrip("/") + "/"
+            seen = {}
+            for k, v in self._files.items():
+                if not k.startswith(prefix):
+                    continue
+                rest = k[len(prefix):]
+                if "/" in rest:
+                    d = prefix + rest.split("/", 1)[0]
+                    seen[d] = FileStatus(d, 0, True)
+                else:
+                    seen[k] = FileStatus(k, len(v), False)
+            return list(seen.values())
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = True):
+        with self._lock:
+            p = self._strip(path)
+            if not overwrite and p in self._files:
+                raise FileExistsError(path)
+            self._files[p] = bytes(data)
+
+    def try_to_write_atomic(self, path: str, data: bytes) -> bool:
+        with self._lock:
+            p = self._strip(path)
+            if p in self._files:
+                return False
+            self._files[p] = bytes(data)
+            return True
+
+    def mkdirs(self, path: str) -> bool:
+        return True
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        with self._lock:
+            p = self._strip(path)
+            if p in self._files:
+                del self._files[p]
+                return True
+            if recursive:
+                prefix = p.rstrip("/") + "/"
+                keys = [k for k in self._files if k.startswith(prefix)]
+                for k in keys:
+                    del self._files[k]
+                return bool(keys)
+            return False
+
+    def rename(self, src: str, dst: str) -> bool:
+        with self._lock:
+            s, d = self._strip(src), self._strip(dst)
+            if d in self._files or s not in self._files:
+                return False
+            self._files[d] = self._files.pop(s)
+            return True
+
+    def is_object_store(self) -> bool:
+        return False
+
+
+_REGISTRY: Dict[str, Callable[[], FileIO]] = {}
+_local = LocalFileIO()
+
+
+def register_file_io(scheme: str, factory: Callable[[], FileIO]):
+    _REGISTRY[scheme] = factory
+
+
+def get_file_io(path: str) -> FileIO:
+    """Resolve a FileIO by path scheme (reference fs/FileIOLoader)."""
+    if "://" in path:
+        scheme = path.split("://", 1)[0]
+        if scheme == "file":
+            return _local
+        if scheme in _REGISTRY:
+            return _REGISTRY[scheme]()
+        raise ValueError(f"No FileIO registered for scheme {scheme!r}")
+    return _local

@@ -1,0 +1,441 @@
+"""Config system.
+
+Analog of the reference's typed option system
+(paimon-api/.../options/ConfigOption.java, Options.java) and the table-level
+``CoreOptions`` (paimon-api/.../CoreOptions.java, 5498 lines). Only options
+with behavior in this framework are declared; unknown keys round-trip through
+``Options`` untouched so schemas remain forward-compatible.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Iterable, Optional
+
+__all__ = ["ConfigOption", "Options", "CoreOptions", "MergeEngine",
+           "ChangelogProducer", "StartupMode", "SortEngine", "BucketMode",
+           "MemorySize", "parse_memory_size"]
+
+
+_SIZE_RE = re.compile(r"^\s*(\d+)\s*([kKmMgGtT]?)[bB]?\s*$")
+_UNITS = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_memory_size(v) -> int:
+    """'128 mb' / '1g' / 1024 -> bytes (reference options/MemorySize.java)."""
+    if isinstance(v, int):
+        return v
+    m = _SIZE_RE.match(str(v))
+    if not m:
+        raise ValueError(f"Cannot parse memory size: {v!r}")
+    return int(m.group(1)) * _UNITS[m.group(2).lower()]
+
+
+MemorySize = parse_memory_size
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("true", "1", "yes")
+
+
+def _parse_duration_ms(v) -> int:
+    """'1 s' / '5 min' / '100ms' -> milliseconds."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    m = re.match(r"^(\d+)\s*([a-z]*)$", s)
+    if not m:
+        raise ValueError(f"Cannot parse duration: {v!r}")
+    n, unit = int(m.group(1)), m.group(2)
+    mult = {"": 1, "ms": 1, "s": 1000, "sec": 1000, "min": 60000,
+            "m": 60000, "h": 3600000, "d": 86400000}[unit]
+    return n * mult
+
+
+class ConfigOption:
+    """A typed option with key, default, and description."""
+
+    def __init__(self, key: str, typ: Callable[[Any], Any], default: Any,
+                 description: str = ""):
+        self.key = key
+        self.typ = typ
+        self.default = default
+        self.description = description
+
+    def parse(self, raw: Any) -> Any:
+        if raw is None:
+            return self.default
+        return self.typ(raw)
+
+    def __repr__(self):
+        return f"ConfigOption({self.key!r}, default={self.default!r})"
+
+
+class Options:
+    """String->string map with typed access (reference options/Options.java)."""
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        self._map: Dict[str, str] = {}
+        if conf:
+            for k, v in conf.items():
+                self.set(k, v)
+
+    def set(self, key, value) -> "Options":
+        if isinstance(key, ConfigOption):
+            key = key.key
+        self._map[key] = str(value) if not isinstance(value, str) else value
+        return self
+
+    def get(self, option):
+        if isinstance(option, ConfigOption):
+            return option.parse(self._map.get(option.key))
+        return self._map.get(option)
+
+    def get_or(self, key: str, default):
+        return self._map.get(key, default)
+
+    def contains(self, key) -> bool:
+        if isinstance(key, ConfigOption):
+            key = key.key
+        return key in self._map
+
+    def remove(self, key: str):
+        self._map.pop(key, None)
+
+    def keys(self) -> Iterable[str]:
+        return self._map.keys()
+
+    def to_map(self) -> Dict[str, str]:
+        return dict(self._map)
+
+    def copy(self) -> "Options":
+        return Options(dict(self._map))
+
+    def __eq__(self, other):
+        return isinstance(other, Options) and self._map == other._map
+
+    def __repr__(self):
+        return f"Options({self._map})"
+
+
+# -- enums (reference CoreOptions.java:4590,4619,4759) -----------------------
+
+class MergeEngine:
+    DEDUPLICATE = "deduplicate"
+    PARTIAL_UPDATE = "partial-update"
+    AGGREGATE = "aggregation"
+    FIRST_ROW = "first-row"
+
+
+class ChangelogProducer:
+    NONE = "none"
+    INPUT = "input"
+    FULL_COMPACTION = "full-compaction"
+    LOOKUP = "lookup"
+
+
+class StartupMode:
+    DEFAULT = "default"
+    LATEST_FULL = "latest-full"
+    FULL = "full"
+    LATEST = "latest"
+    COMPACTED_FULL = "compacted-full"
+    FROM_TIMESTAMP = "from-timestamp"
+    FROM_FILE_CREATION_TIME = "from-file-creation-time"
+    FROM_SNAPSHOT = "from-snapshot"
+    FROM_SNAPSHOT_FULL = "from-snapshot-full"
+    INCREMENTAL = "incremental"
+
+
+class SortEngine:
+    LOSER_TREE = "loser-tree"     # reference default
+    MIN_HEAP = "min-heap"
+    TPU_SEGMENTED = "tpu-segmented"  # ours: device sort + segmented reduce
+
+
+class BucketMode:
+    """reference paimon-common/.../table/BucketMode.java:30"""
+    HASH_FIXED = "hash-fixed"
+    HASH_DYNAMIC = "hash-dynamic"
+    KEY_DYNAMIC = "key-dynamic"
+    BUCKET_UNAWARE = "bucket-unaware"
+    POSTPONE = "postpone"
+
+    POSTPONE_BUCKET = -2
+    UNAWARE_BUCKET = -1
+
+
+class CoreOptions:
+    """Typed view over table options (reference CoreOptions.java)."""
+
+    BUCKET = ConfigOption("bucket", int, -1, "Bucket count; -1 = unaware/dynamic")
+    BUCKET_KEY = ConfigOption("bucket-key", str, None, "Comma-separated bucket key")
+    PATH = ConfigOption("path", str, None, "Table path")
+    FILE_FORMAT = ConfigOption("file.format", str, "parquet", "Data file format")
+    FILE_COMPRESSION = ConfigOption("file.compression", str, "zstd",
+                                    "Data file compression")
+    MANIFEST_FORMAT = ConfigOption("manifest.format", str, "avro",
+                                   "Manifest file format")
+    MANIFEST_TARGET_FILE_SIZE = ConfigOption("manifest.target-file-size",
+                                             parse_memory_size, 8 << 20, "")
+    MANIFEST_MERGE_MIN_COUNT = ConfigOption("manifest.merge-min-count", int, 30,
+                                            "Min manifests to trigger full rewrite")
+    MERGE_ENGINE = ConfigOption("merge-engine", str, MergeEngine.DEDUPLICATE,
+                                "deduplicate | partial-update | aggregation | first-row")
+    IGNORE_DELETE = ConfigOption("ignore-delete", _parse_bool, False, "")
+    CHANGELOG_PRODUCER = ConfigOption("changelog-producer", str,
+                                      ChangelogProducer.NONE, "")
+    SEQUENCE_FIELD = ConfigOption("sequence.field", str, None,
+                                  "User-defined sequence column(s)")
+    ROWKIND_FIELD = ConfigOption("rowkind.field", str, None, "")
+    PARTITION_DEFAULT_NAME = ConfigOption("partition.default-name", str,
+                                          "__DEFAULT_PARTITION__", "")
+    TARGET_FILE_SIZE = ConfigOption("target-file-size", parse_memory_size,
+                                    128 << 20, "Target data file size")
+    WRITE_BUFFER_SIZE = ConfigOption("write-buffer-size", parse_memory_size,
+                                     256 << 20, "Sort buffer memory")
+    WRITE_ONLY = ConfigOption("write-only", _parse_bool, False,
+                              "Skip compaction on write")
+    NUM_SORTED_RUNS_COMPACTION_TRIGGER = ConfigOption(
+        "num-sorted-run.compaction-trigger", int, 5,
+        "Sorted runs triggering compaction (reference CoreOptions.java:876)")
+    NUM_SORTED_RUNS_STOP_TRIGGER = ConfigOption(
+        "num-sorted-run.stop-trigger", int, None, "Write-stall threshold")
+    NUM_LEVELS = ConfigOption("num-levels", int, None, "LSM levels")
+    COMPACTION_MAX_SIZE_AMPLIFICATION_PERCENT = ConfigOption(
+        "compaction.max-size-amplification-percent", int, 200, "")
+    COMPACTION_SIZE_RATIO = ConfigOption("compaction.size-ratio", int, 1, "")
+    COMPACTION_MIN_FILE_NUM = ConfigOption("compaction.min.file-num", int, 5, "")
+    COMPACTION_OPTIMIZATION_INTERVAL = ConfigOption(
+        "compaction.optimization-interval", _parse_duration_ms, None, "")
+    FULL_COMPACTION_DELTA_COMMITS = ConfigOption(
+        "full-compaction.delta-commits", int, None, "")
+    SNAPSHOT_NUM_RETAINED_MIN = ConfigOption("snapshot.num-retained.min",
+                                             int, 10, "")
+    SNAPSHOT_NUM_RETAINED_MAX = ConfigOption("snapshot.num-retained.max",
+                                             int, 2147483647, "")
+    SNAPSHOT_TIME_RETAINED = ConfigOption("snapshot.time-retained",
+                                          _parse_duration_ms, 3600000, "")
+    SNAPSHOT_EXPIRE_LIMIT = ConfigOption("snapshot.expire.limit", int, 50, "")
+    CHANGELOG_NUM_RETAINED_MIN = ConfigOption("changelog.num-retained.min",
+                                              int, None, "")
+    CHANGELOG_NUM_RETAINED_MAX = ConfigOption("changelog.num-retained.max",
+                                              int, None, "")
+    SCAN_MODE = ConfigOption("scan.mode", str, StartupMode.DEFAULT, "")
+    SCAN_SNAPSHOT_ID = ConfigOption("scan.snapshot-id", int, None, "")
+    SCAN_TAG_NAME = ConfigOption("scan.tag-name", str, None, "")
+    SCAN_TIMESTAMP_MILLIS = ConfigOption("scan.timestamp-millis", int, None, "")
+    SCAN_FALLBACK_BRANCH = ConfigOption("scan.fallback-branch", str, None, "")
+    INCREMENTAL_BETWEEN = ConfigOption("incremental-between", str, None, "")
+    CONSUMER_ID = ConfigOption("consumer-id", str, None, "")
+    CONSUMER_EXPIRATION_TIME = ConfigOption("consumer.expiration-time",
+                                            _parse_duration_ms, None, "")
+    DELETION_VECTORS_ENABLED = ConfigOption("deletion-vectors.enabled",
+                                            _parse_bool, False, "")
+    DYNAMIC_BUCKET_TARGET_ROW_NUM = ConfigOption(
+        "dynamic-bucket.target-row-num", int, 2_000_000, "")
+    DYNAMIC_BUCKET_INITIAL_BUCKETS = ConfigOption(
+        "dynamic-bucket.initial-buckets", int, None, "")
+    DYNAMIC_BUCKET_ASSIGNER_PARALLELISM = ConfigOption(
+        "dynamic-bucket.assigner-parallelism", int, None, "")
+    SORT_ENGINE = ConfigOption("sort-engine", str, SortEngine.TPU_SEGMENTED, "")
+    SORT_SPILL_THRESHOLD = ConfigOption("sort-spill-threshold", int, None, "")
+    WRITE_BATCH_ROWS = ConfigOption("tpu.write-batch-rows", int, 1 << 20,
+                                    "Device merge batch rows (ours)")
+    KEY_PREFIX_LANES = ConfigOption("tpu.key-prefix-lanes", int, 2,
+                                    "u64 lanes of normalized key prefix (ours)")
+    BRANCH = ConfigOption("branch", str, "main", "")
+    METASTORE_PARTITIONED_TABLE = ConfigOption("metastore.partitioned-table",
+                                               _parse_bool, False, "")
+    PRIMARY_KEY = ConfigOption("primary-key", str, None,
+                               "Comma-separated pk (schema-level)")
+    PARTITION = ConfigOption("partition", str, None, "")
+    TYPE = ConfigOption("type", str, "table", "")
+    AUTO_CREATE = ConfigOption("auto-create", _parse_bool, False, "")
+    COMMIT_USER_PREFIX = ConfigOption("commit.user-prefix", str, None, "")
+    COMMIT_FORCE_COMPACT = ConfigOption("commit.force-compact", _parse_bool,
+                                        False, "")
+    LOOKUP_CACHE_MAX_DISK_SIZE = ConfigOption("lookup.cache-max-disk-size",
+                                              parse_memory_size,
+                                              9223372036854775807, "")
+    RECORD_LEVEL_EXPIRE_TIME = ConfigOption("record-level.expire-time",
+                                            _parse_duration_ms, None, "")
+    RECORD_LEVEL_TIME_FIELD = ConfigOption("record-level.time-field", str,
+                                           None, "")
+    FIELDS_DEFAULT_AGG_FUNC = ConfigOption("fields.default-aggregate-function",
+                                           str, None, "")
+    PARTITION_EXPIRATION_TIME = ConfigOption("partition.expiration-time",
+                                             _parse_duration_ms, None, "")
+    PARTITION_EXPIRATION_CHECK_INTERVAL = ConfigOption(
+        "partition.expiration-check-interval", _parse_duration_ms,
+        3600000, "")
+    PARTITION_TIMESTAMP_FORMATTER = ConfigOption(
+        "partition.timestamp-formatter", str, None, "")
+    PARTITION_TIMESTAMP_PATTERN = ConfigOption(
+        "partition.timestamp-pattern", str, None, "")
+    TAG_AUTOMATIC_CREATION = ConfigOption("tag.automatic-creation", str,
+                                          "none", "")
+    FILE_INDEX_IN_MANIFEST_THRESHOLD = ConfigOption(
+        "file-index.in-manifest-threshold", parse_memory_size, 500, "")
+    ROW_TRACKING_ENABLED = ConfigOption("row-tracking.enabled", _parse_bool,
+                                        False, "")
+    DATA_EVOLUTION_ENABLED = ConfigOption("data-evolution.enabled",
+                                          _parse_bool, False, "")
+    FORCE_LOOKUP = ConfigOption("force-lookup", _parse_bool, False, "")
+    LOCAL_MERGE_BUFFER_SIZE = ConfigOption("local-merge-buffer-size",
+                                           parse_memory_size, None, "")
+    METADATA_STATS_MODE = ConfigOption("metadata.stats-mode", str, "truncate(16)", "")
+    MANIFEST_COMPRESSION = ConfigOption("manifest.compression", str, "zstd", "")
+
+    def __init__(self, options):
+        if isinstance(options, dict):
+            options = Options(options)
+        self.options: Options = options
+
+    # -- convenience accessors ----------------------------------------------
+
+    def get(self, option: ConfigOption):
+        return self.options.get(option)
+
+    @property
+    def bucket(self) -> int:
+        return self.options.get(CoreOptions.BUCKET)
+
+    @property
+    def bucket_key(self):
+        v = self.options.get(CoreOptions.BUCKET_KEY)
+        return [s.strip() for s in v.split(",")] if v else []
+
+    @property
+    def file_format(self) -> str:
+        return self.options.get(CoreOptions.FILE_FORMAT)
+
+    @property
+    def file_compression(self) -> str:
+        return self.options.get(CoreOptions.FILE_COMPRESSION)
+
+    @property
+    def merge_engine(self) -> str:
+        return self.options.get(CoreOptions.MERGE_ENGINE)
+
+    @property
+    def changelog_producer(self) -> str:
+        return self.options.get(CoreOptions.CHANGELOG_PRODUCER)
+
+    @property
+    def sequence_field(self):
+        v = self.options.get(CoreOptions.SEQUENCE_FIELD)
+        return [s.strip() for s in v.split(",")] if v else []
+
+    @property
+    def target_file_size(self) -> int:
+        return self.options.get(CoreOptions.TARGET_FILE_SIZE)
+
+    @property
+    def write_buffer_size(self) -> int:
+        return self.options.get(CoreOptions.WRITE_BUFFER_SIZE)
+
+    @property
+    def write_only(self) -> bool:
+        return self.options.get(CoreOptions.WRITE_ONLY)
+
+    @property
+    def num_sorted_runs_compaction_trigger(self) -> int:
+        return self.options.get(CoreOptions.NUM_SORTED_RUNS_COMPACTION_TRIGGER)
+
+    @property
+    def num_sorted_runs_stop_trigger(self) -> int:
+        v = self.options.get(CoreOptions.NUM_SORTED_RUNS_STOP_TRIGGER)
+        if v is None:
+            return self.num_sorted_runs_compaction_trigger + 3
+        return v
+
+    @property
+    def num_levels(self) -> int:
+        v = self.options.get(CoreOptions.NUM_LEVELS)
+        if v is None:
+            return self.num_sorted_runs_compaction_trigger + 1
+        return v
+
+    @property
+    def max_size_amplification_percent(self) -> int:
+        return self.options.get(
+            CoreOptions.COMPACTION_MAX_SIZE_AMPLIFICATION_PERCENT)
+
+    @property
+    def size_ratio(self) -> int:
+        return self.options.get(CoreOptions.COMPACTION_SIZE_RATIO)
+
+    @property
+    def compaction_min_file_num(self) -> int:
+        return self.options.get(CoreOptions.COMPACTION_MIN_FILE_NUM)
+
+    @property
+    def deletion_vectors_enabled(self) -> bool:
+        return self.options.get(CoreOptions.DELETION_VECTORS_ENABLED)
+
+    @property
+    def snapshot_num_retained_min(self) -> int:
+        return self.options.get(CoreOptions.SNAPSHOT_NUM_RETAINED_MIN)
+
+    @property
+    def snapshot_num_retained_max(self) -> int:
+        return self.options.get(CoreOptions.SNAPSHOT_NUM_RETAINED_MAX)
+
+    @property
+    def snapshot_time_retained_ms(self) -> int:
+        return self.options.get(CoreOptions.SNAPSHOT_TIME_RETAINED)
+
+    @property
+    def branch(self) -> str:
+        return self.options.get(CoreOptions.BRANCH)
+
+    @property
+    def scan_mode(self) -> str:
+        return self.options.get(CoreOptions.SCAN_MODE)
+
+    @property
+    def consumer_id(self):
+        return self.options.get(CoreOptions.CONSUMER_ID)
+
+    @property
+    def startup_mode(self) -> str:
+        mode = self.options.get(CoreOptions.SCAN_MODE)
+        if mode == StartupMode.DEFAULT:
+            if self.options.get(CoreOptions.SCAN_SNAPSHOT_ID) is not None:
+                return StartupMode.FROM_SNAPSHOT
+            if self.options.get(CoreOptions.SCAN_TIMESTAMP_MILLIS) is not None:
+                return StartupMode.FROM_TIMESTAMP
+            if self.options.get(CoreOptions.INCREMENTAL_BETWEEN) is not None:
+                return StartupMode.INCREMENTAL
+            return StartupMode.LATEST_FULL
+        return mode
+
+    @property
+    def key_prefix_lanes(self) -> int:
+        return self.options.get(CoreOptions.KEY_PREFIX_LANES)
+
+    @property
+    def write_batch_rows(self) -> int:
+        return self.options.get(CoreOptions.WRITE_BATCH_ROWS)
+
+    @property
+    def dynamic_bucket_target_row_num(self) -> int:
+        return self.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
+
+    @property
+    def full_compaction_delta_commits(self):
+        return self.options.get(CoreOptions.FULL_COMPACTION_DELTA_COMMITS)
+
+    @property
+    def record_level_expire_time_ms(self):
+        return self.options.get(CoreOptions.RECORD_LEVEL_EXPIRE_TIME)
+
+    @property
+    def record_level_time_field(self):
+        return self.options.get(CoreOptions.RECORD_LEVEL_TIME_FIELD)
+
+    def to_map(self) -> Dict[str, str]:
+        return self.options.to_map()

@@ -1,0 +1,17 @@
+"""Test config: force a deterministic 8-device CPU mesh so sharding tests
+run without TPU hardware (the driver separately dry-runs multi-chip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_warehouse(tmp_path):
+    return str(tmp_path / "warehouse")

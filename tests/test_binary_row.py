@@ -1,0 +1,77 @@
+from datetime import date, datetime, time
+from decimal import Decimal
+
+import pytest
+
+from paimon_tpu.data.binary_row import BINARY_ROW_EMPTY, BinaryRowCodec
+from paimon_tpu.types import (
+    BigIntType, BinaryType, BooleanType, DateType, DecimalType, DoubleType,
+    FloatType, IntType, SmallIntType, TimeType, TimestampType, TinyIntType,
+    VarBinaryType, VarCharType,
+)
+
+
+def roundtrip(types, values):
+    codec = BinaryRowCodec(types)
+    data = codec.to_bytes(values)
+    return codec.from_bytes(data)
+
+
+def test_fixed_width():
+    types = [BooleanType(), TinyIntType(), SmallIntType(), IntType(),
+             BigIntType(), FloatType(), DoubleType()]
+    vals = (True, -5, 300, -70000, 1 << 40, 1.5, -2.25)
+    assert roundtrip(types, vals) == vals
+
+
+def test_nulls():
+    types = [IntType(), VarCharType(100), DoubleType()]
+    assert roundtrip(types, (None, None, None)) == (None, None, None)
+    assert roundtrip(types, (1, None, 2.0)) == (1, None, 2.0)
+
+
+def test_strings_inline_and_var():
+    types = [VarCharType(100), VarCharType(100)]
+    # <=7 bytes inline; >7 in var part
+    vals = ("abc", "a-much-longer-string-than-seven-bytes")
+    assert roundtrip(types, vals) == vals
+
+
+def test_unicode():
+    types = [VarCharType(100)]
+    vals = ("héllo wörld ünïcode",)
+    assert roundtrip(types, vals) == vals
+
+
+def test_binary():
+    types = [BinaryType(4), VarBinaryType(100)]
+    vals = (b"\x00\x01", b"\xff" * 20)
+    assert roundtrip(types, vals) == vals
+
+
+def test_decimal_compact_and_wide():
+    types = [DecimalType(10, 2), DecimalType(28, 4)]
+    vals = (Decimal("123.45"), Decimal("-99999999999999999999.1234"))
+    assert roundtrip(types, vals) == vals
+
+
+def test_temporal():
+    types = [DateType(), TimeType(3), TimestampType(3), TimestampType(6)]
+    vals = (date(2024, 3, 1), time(12, 30, 45, 123000),
+            datetime(2024, 3, 1, 12, 0, 0, 123000),
+            datetime(2024, 3, 1, 12, 0, 0, 123456))
+    out = roundtrip(types, vals)
+    assert out == vals
+
+
+def test_empty_row():
+    assert BINARY_ROW_EMPTY == b"\x00\x00\x00\x00" + b"\x00" * 8
+    codec = BinaryRowCodec([])
+    assert codec.from_bytes(BINARY_ROW_EMPTY) == ()
+
+
+def test_multi_var_offsets():
+    # Several var-length fields interleaved with nulls and fixed fields
+    types = [VarCharType(100), IntType(), VarCharType(100), VarCharType(100)]
+    vals = ("first-long-string-here", 42, None, "second-long-string-there")
+    assert roundtrip(types, vals) == vals

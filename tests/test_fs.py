@@ -1,0 +1,87 @@
+import threading
+
+import pytest
+
+from paimon_tpu.fs import LocalFileIO, MemoryFileIO, get_file_io
+
+
+@pytest.fixture(params=["local", "mem"])
+def fio(request, tmp_path):
+    if request.param == "local":
+        return LocalFileIO(), str(tmp_path)
+    return MemoryFileIO(), "/t"
+
+
+def test_write_read(fio):
+    io, root = fio
+    io.write_bytes(f"{root}/a/b.txt", b"hello")
+    assert io.read_bytes(f"{root}/a/b.txt") == b"hello"
+    assert io.exists(f"{root}/a/b.txt")
+    assert io.get_file_size(f"{root}/a/b.txt") == 5
+    assert not io.exists(f"{root}/a/c.txt")
+
+
+def test_atomic_write_cas(fio):
+    io, root = fio
+    p = f"{root}/snapshot-1"
+    assert io.try_to_write_atomic(p, b"v1")
+    assert not io.try_to_write_atomic(p, b"v2")
+    assert io.read_bytes(p) == b"v1"
+
+
+def test_atomic_write_concurrent(fio):
+    io, root = fio
+    p = f"{root}/contended"
+    wins = []
+
+    def attempt(i):
+        if io.try_to_write_atomic(p, f"w{i}".encode()):
+            wins.append(i)
+
+    threads = [threading.Thread(target=attempt, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert io.read_bytes(p) == f"w{wins[0]}".encode()
+
+
+def test_list_and_delete(fio):
+    io, root = fio
+    io.write_bytes(f"{root}/d/x", b"1")
+    io.write_bytes(f"{root}/d/y", b"22")
+    io.write_bytes(f"{root}/d/sub/z", b"333")
+    names = sorted(s.path.split("/")[-1] for s in io.list_status(f"{root}/d"))
+    assert names == ["sub", "x", "y"]
+    assert io.delete(f"{root}/d/x")
+    assert not io.exists(f"{root}/d/x")
+
+
+def test_rename_no_overwrite(fio):
+    io, root = fio
+    io.write_bytes(f"{root}/src", b"s")
+    io.write_bytes(f"{root}/dst", b"d")
+    assert not io.rename(f"{root}/src", f"{root}/dst")
+    assert io.rename(f"{root}/src", f"{root}/dst2")
+    assert io.read_bytes(f"{root}/dst2") == b"s"
+
+
+def test_scheme_dispatch(tmp_path):
+    assert isinstance(get_file_io(str(tmp_path)), LocalFileIO)
+    assert isinstance(get_file_io(f"file://{tmp_path}"), LocalFileIO)
+    with pytest.raises(ValueError):
+        get_file_io("s3://bucket/x")
+
+
+def test_options():
+    from paimon_tpu.options import CoreOptions, Options, parse_memory_size
+    o = Options({"bucket": 4, "file.format": "orc",
+                 "target-file-size": "64 mb"})
+    co = CoreOptions(o)
+    assert co.bucket == 4
+    assert co.file_format == "orc"
+    assert co.target_file_size == 64 << 20
+    assert co.merge_engine == "deduplicate"
+    assert parse_memory_size("1g") == 1 << 30
+    assert co.num_levels == 6  # trigger(5) + 1

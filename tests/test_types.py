@@ -1,0 +1,57 @@
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.types import (
+    ArrayType, BigIntType, DataField, DecimalType, DoubleType, IntType,
+    MapType, RowType, TimestampType, VarCharType, LocalZonedTimestampType,
+    parse_data_type, row_type_to_arrow_schema, arrow_schema_to_row_type,
+)
+
+
+def test_atomic_roundtrip():
+    for t in [IntType(), BigIntType(False), DoubleType(),
+              VarCharType(10), DecimalType(10, 2), TimestampType(3),
+              TimestampType(6, False), LocalZonedTimestampType(6)]:
+        assert parse_data_type(t.to_json()) == t
+
+
+def test_atomic_strings():
+    assert str(IntType(False)) == "INT NOT NULL"
+    assert str(VarCharType(10)) == "VARCHAR(10)"
+    assert str(DecimalType(10, 2)) == "DECIMAL(10, 2)"
+    assert parse_data_type("STRING") == VarCharType(VarCharType.MAX_LENGTH)
+    assert parse_data_type("BYTES").root == "VARBINARY"
+    assert (str(LocalZonedTimestampType(3, False))
+            == "TIMESTAMP(3) WITH LOCAL TIME ZONE NOT NULL")
+
+
+def test_row_roundtrip():
+    row = RowType([
+        DataField(0, "id", IntType(False)),
+        DataField(1, "name", VarCharType(VarCharType.MAX_LENGTH)),
+        DataField(2, "tags", ArrayType(VarCharType(VarCharType.MAX_LENGTH))),
+        DataField(3, "attrs", MapType(VarCharType(5), BigIntType())),
+        DataField(4, "nested", RowType([DataField(5, "x", DoubleType())])),
+    ])
+    j = row.to_json()
+    assert parse_data_type(j) == row
+    assert row.highest_field_id() == 5
+
+
+def test_arrow_roundtrip():
+    row = RowType.of("id", IntType(False), "name",
+                     VarCharType(VarCharType.MAX_LENGTH),
+                     "score", DoubleType())
+    schema = row_type_to_arrow_schema(row)
+    assert schema.field("id").type == pa.int32()
+    assert not schema.field("id").nullable
+    back = arrow_schema_to_row_type(schema)
+    assert back.field_names == ["id", "name", "score"]
+
+
+def test_project():
+    row = RowType.of("a", IntType(), "b", BigIntType(), "c", DoubleType())
+    p = row.project(["c", "a"])
+    assert p.field_names == ["c", "a"]
+    with pytest.raises(KeyError):
+        row.project(["nope"])
