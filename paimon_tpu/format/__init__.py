@@ -1,0 +1,15 @@
+"""File formats (L2).
+
+Plugin boundary analogous to the reference's ``FileFormat``
+(paimon-common/.../format/FileFormat.java:43): ``get_format(identifier)``
+returns a reader/writer factory pair operating on Arrow tables.
+
+- parquet / orc: pyarrow (Arrow C++) with stats extraction and predicate
+  pushdown -- the decode feeds device-ready columnar buffers.
+- avro: own pure-Python codec (paimon_tpu/format/avro.py) because manifests
+  are avro object files and must stay wire-compatible.
+"""
+
+from paimon_tpu.format.format import (  # noqa: F401
+    FileFormatFactory, get_format, FormatReader, FormatWriter,
+)

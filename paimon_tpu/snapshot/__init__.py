@@ -1,0 +1,12 @@
+"""Snapshot/refs subsystem: snapshot JSON files, snapshot manager,
+tags, branches, consumers.
+
+reference: paimon-api/.../Snapshot.java:43, paimon-core/.../utils/
+(SnapshotManager, TagManager, BranchManager, ChangelogManager), consumer/.
+"""
+
+from paimon_tpu.snapshot.snapshot import Snapshot, CommitKind  # noqa: F401
+from paimon_tpu.snapshot.snapshot_manager import SnapshotManager  # noqa: F401
+from paimon_tpu.snapshot.tag_manager import TagManager  # noqa: F401
+from paimon_tpu.snapshot.branch_manager import BranchManager  # noqa: F401
+from paimon_tpu.snapshot.consumer_manager import ConsumerManager  # noqa: F401

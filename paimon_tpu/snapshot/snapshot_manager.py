@@ -1,0 +1,151 @@
+"""SnapshotManager: list/find/commit snapshot files with hint files.
+
+reference: paimon-core/.../utils/SnapshotManager.java (snapshot/snapshot-N,
+EARLIEST/LATEST hints that may be stale; full scan as fallback).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from paimon_tpu.fs import FileIO
+from paimon_tpu.snapshot.snapshot import Snapshot
+
+__all__ = ["SnapshotManager"]
+
+SNAPSHOT_PREFIX = "snapshot-"
+EARLIEST = "EARLIEST"
+LATEST = "LATEST"
+
+
+class SnapshotManager:
+    def __init__(self, file_io: FileIO, table_path: str,
+                 branch: str = "main"):
+        self.file_io = file_io
+        self.table_path = table_path.rstrip("/")
+        self.branch = branch or "main"
+
+    @property
+    def snapshot_dir(self) -> str:
+        if self.branch != "main":
+            return (f"{self.table_path}/branch/branch-{self.branch}"
+                    f"/snapshot")
+        return f"{self.table_path}/snapshot"
+
+    def snapshot_path(self, snapshot_id: int) -> str:
+        return f"{self.snapshot_dir}/{SNAPSHOT_PREFIX}{snapshot_id}"
+
+    # -- reads ---------------------------------------------------------------
+
+    def snapshot(self, snapshot_id: int) -> Snapshot:
+        return Snapshot.from_json(
+            self.file_io.read_utf8(self.snapshot_path(snapshot_id)))
+
+    def snapshot_exists(self, snapshot_id: int) -> bool:
+        return self.file_io.exists(self.snapshot_path(snapshot_id))
+
+    def _hint(self, name: str) -> Optional[int]:
+        path = f"{self.snapshot_dir}/{name}"
+        try:
+            if self.file_io.exists(path):
+                return int(self.file_io.read_utf8(path).strip())
+        except (ValueError, OSError):
+            pass
+        return None
+
+    def _all_ids(self) -> List[int]:
+        ids = []
+        for st in self.file_io.list_status(self.snapshot_dir):
+            name = st.path.rstrip("/").split("/")[-1]
+            if name.startswith(SNAPSHOT_PREFIX):
+                try:
+                    ids.append(int(name[len(SNAPSHOT_PREFIX):]))
+                except ValueError:
+                    pass
+        return sorted(ids)
+
+    def earliest_snapshot_id(self) -> Optional[int]:
+        hint = self._hint(EARLIEST)
+        if hint is not None and self.snapshot_exists(hint):
+            # hint may be stale upward (expired snapshots); walk forward
+            i = hint
+            while not self.snapshot_exists(i):
+                i += 1
+            return i
+        ids = self._all_ids()
+        return ids[0] if ids else None
+
+    def latest_snapshot_id(self) -> Optional[int]:
+        hint = self._hint(LATEST)
+        if hint is not None and self.snapshot_exists(hint):
+            # hint may be stale downward (newer commits); walk forward
+            i = hint
+            while self.snapshot_exists(i + 1):
+                i += 1
+            return i
+        ids = self._all_ids()
+        return ids[-1] if ids else None
+
+    def latest_snapshot(self) -> Optional[Snapshot]:
+        sid = self.latest_snapshot_id()
+        return self.snapshot(sid) if sid is not None else None
+
+    def snapshots(self) -> Iterator[Snapshot]:
+        earliest = self.earliest_snapshot_id()
+        latest = self.latest_snapshot_id()
+        if earliest is None or latest is None:
+            return
+        for i in range(earliest, latest + 1):
+            if self.snapshot_exists(i):
+                yield self.snapshot(i)
+
+    def snapshot_count(self) -> int:
+        return sum(1 for _ in self.snapshots())
+
+    def earlier_or_equal_time_mills(self,
+                                    time_millis: int) -> Optional[Snapshot]:
+        """Latest snapshot with timeMillis <= given (reference
+        SnapshotManager.earlierOrEqualTimeMills); binary search over ids."""
+        lo = self.earliest_snapshot_id()
+        hi = self.latest_snapshot_id()
+        if lo is None or hi is None:
+            return None
+        best = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            s = self.snapshot(mid)
+            if s.time_millis <= time_millis:
+                best = s
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return best
+
+    # -- writes --------------------------------------------------------------
+
+    def try_commit(self, snapshot: Snapshot) -> bool:
+        """Atomically publish snapshot-N; False if id taken (CAS)."""
+        ok = self.file_io.try_to_write_atomic(
+            self.snapshot_path(snapshot.id),
+            snapshot.to_json().encode("utf-8"))
+        if ok:
+            self.commit_latest_hint(snapshot.id)
+            if snapshot.id == 1 or self._hint(EARLIEST) is None:
+                self.commit_earliest_hint(snapshot.id)
+        return ok
+
+    def commit_latest_hint(self, snapshot_id: int):
+        self._write_hint(LATEST, snapshot_id)
+
+    def commit_earliest_hint(self, snapshot_id: int):
+        self._write_hint(EARLIEST, snapshot_id)
+
+    def _write_hint(self, name: str, snapshot_id: int):
+        try:
+            self.file_io.write_utf8(f"{self.snapshot_dir}/{name}",
+                                    str(snapshot_id), overwrite=True)
+        except OSError:
+            pass  # hints are best-effort
+
+    def delete_snapshot(self, snapshot_id: int):
+        self.file_io.delete_quietly(self.snapshot_path(snapshot_id))

@@ -1,0 +1,61 @@
+"""TagManager: named immutable refs to snapshots (``tag/tag-<name>``).
+
+reference: paimon-core/.../utils/TagManager.java; a tag file stores the
+snapshot JSON it pins, protecting its files from expiry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from paimon_tpu.fs import FileIO
+from paimon_tpu.snapshot.snapshot import Snapshot
+
+__all__ = ["TagManager"]
+
+TAG_PREFIX = "tag-"
+
+
+class TagManager:
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.table_path = table_path.rstrip("/")
+
+    @property
+    def tag_dir(self) -> str:
+        return f"{self.table_path}/tag"
+
+    def tag_path(self, name: str) -> str:
+        return f"{self.tag_dir}/{TAG_PREFIX}{name}"
+
+    def create_tag(self, snapshot: Snapshot, name: str,
+                   ignore_if_exists: bool = False):
+        if self.tag_exists(name):
+            if ignore_if_exists:
+                return
+            raise ValueError(f"Tag {name!r} already exists")
+        ok = self.file_io.try_to_write_atomic(
+            self.tag_path(name), snapshot.to_json().encode("utf-8"))
+        if not ok:
+            raise ValueError(f"Tag {name!r} already exists")
+
+    def delete_tag(self, name: str):
+        self.file_io.delete_quietly(self.tag_path(name))
+
+    def tag_exists(self, name: str) -> bool:
+        return self.file_io.exists(self.tag_path(name))
+
+    def get_tag(self, name: str) -> Snapshot:
+        return Snapshot.from_json(self.file_io.read_utf8(self.tag_path(name)))
+
+    def tags(self) -> Dict[str, Snapshot]:
+        out = {}
+        for st in self.file_io.list_status(self.tag_dir):
+            fname = st.path.rstrip("/").split("/")[-1]
+            if fname.startswith(TAG_PREFIX):
+                name = fname[len(TAG_PREFIX):]
+                out[name] = self.get_tag(name)
+        return dict(sorted(out.items(), key=lambda kv: kv[1].id))
+
+    def tagged_snapshots(self) -> List[Snapshot]:
+        return list(self.tags().values())

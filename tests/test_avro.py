@@ -1,0 +1,73 @@
+import io
+
+import pytest
+
+from paimon_tpu.format import avro
+
+
+RECORD_SCHEMA = {
+    "type": "record", "name": "R",
+    "fields": [
+        {"name": "i", "type": "int"},
+        {"name": "l", "type": "long"},
+        {"name": "s", "type": "string"},
+        {"name": "b", "type": "bytes"},
+        {"name": "d", "type": "double"},
+        {"name": "opt", "type": ["null", "long"], "default": None},
+        {"name": "arr", "type": {"type": "array", "items": "string"}},
+        {"name": "nested", "type": {
+            "type": "record", "name": "N",
+            "fields": [{"name": "x", "type": "boolean"}]}},
+    ],
+}
+
+
+def test_zigzag_longs():
+    for n in [0, 1, -1, 63, -64, 64, 1 << 40, -(1 << 40), (1 << 62),
+              -(1 << 62)]:
+        buf = io.BytesIO()
+        avro._write_long(buf, n)
+        buf.seek(0)
+        assert avro._read_long(buf) == n
+
+
+def test_record_roundtrip():
+    rec = {"i": -5, "l": 1 << 50, "s": "héllo", "b": b"\x00\xff",
+           "d": 2.5, "opt": None, "arr": ["a", "b"], "nested": {"x": True}}
+    buf = io.BytesIO()
+    avro.encode_value(RECORD_SCHEMA, rec, buf)
+    buf.seek(0)
+    assert avro.decode_value(RECORD_SCHEMA, buf) == rec
+
+
+def test_union_branches():
+    rec = dict(i=0, l=0, s="", b=b"", d=0.0, opt=7, arr=[],
+               nested={"x": False})
+    buf = io.BytesIO()
+    avro.encode_value(RECORD_SCHEMA, rec, buf)
+    buf.seek(0)
+    assert avro.decode_value(RECORD_SCHEMA, buf)["opt"] == 7
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate", "zstandard"])
+def test_container_roundtrip(codec):
+    records = [{"i": i, "l": i * 1000, "s": f"row-{i}", "b": bytes([i % 256]),
+                "d": i / 3.0, "opt": i if i % 2 else None,
+                "arr": [str(i)] * (i % 3), "nested": {"x": i % 2 == 0}}
+               for i in range(500)]
+    data = avro.write_container(RECORD_SCHEMA, records, codec=codec,
+                                block_records=100)
+    schema, out = avro.read_container(data)
+    assert schema["name"] == "R"
+    assert out == records
+
+
+def test_container_empty():
+    data = avro.write_container(RECORD_SCHEMA, [])
+    _, out = avro.read_container(data)
+    assert out == []
+
+
+def test_magic_check():
+    with pytest.raises(avro.AvroSchemaError):
+        avro.read_container(b"nope" + b"\x00" * 100)

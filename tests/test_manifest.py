@@ -1,0 +1,117 @@
+import pytest
+
+from paimon_tpu.data.binary_row import BINARY_ROW_EMPTY, BinaryRowCodec
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.manifest import (
+    DataFileMeta, FileKind, IndexFileMeta, IndexManifestEntry,
+    IndexManifestFile, ManifestEntry, ManifestFile, ManifestList,
+    SimpleStats, merge_manifest_entries,
+)
+from paimon_tpu.types import BigIntType, IntType, VarCharType
+
+
+def make_file(name, level=0, min_key=1, max_key=9):
+    key_codec = BinaryRowCodec([BigIntType()])
+    return DataFileMeta(
+        file_name=name, file_size=1024, row_count=100,
+        min_key=key_codec.to_bytes((min_key,)),
+        max_key=key_codec.to_bytes((max_key,)),
+        key_stats=SimpleStats.from_values([BigIntType()], (min_key,),
+                                          (max_key,), [0]),
+        value_stats=SimpleStats.EMPTY,
+        min_sequence_number=0, max_sequence_number=99,
+        schema_id=0, level=level)
+
+
+def entry(kind, name, bucket=0, level=0):
+    return ManifestEntry(kind, BINARY_ROW_EMPTY, bucket, 2,
+                         make_file(name, level))
+
+
+@pytest.fixture
+def mdir(tmp_path):
+    return str(tmp_path / "manifest")
+
+
+def test_manifest_roundtrip(mdir):
+    mf = ManifestFile(LocalFileIO(), mdir)
+    entries = [entry(FileKind.ADD, f"data-{i}.parquet") for i in range(10)]
+    meta = mf.write(entries, schema_id=3)
+    assert meta.num_added_files == 10
+    assert meta.num_deleted_files == 0
+    assert meta.schema_id == 3
+    out = mf.read(meta.file_name)
+    assert len(out) == 10
+    assert out[0].file.file_name == "data-0.parquet"
+    assert out[0].file.min_key == entries[0].file.min_key
+    assert out[0].file.key_stats == entries[0].file.key_stats
+
+
+def test_manifest_list_roundtrip(mdir):
+    fio = LocalFileIO()
+    mf = ManifestFile(fio, mdir)
+    ml = ManifestList(fio, mdir)
+    metas = [mf.write([entry(FileKind.ADD, f"f{i}.parquet")]) for i in
+             range(3)]
+    name, size = ml.write(metas)
+    assert size > 0
+    out = ml.read(name)
+    assert [m.file_name for m in out] == [m.file_name for m in metas]
+
+
+def test_merge_entries():
+    e1 = entry(FileKind.ADD, "a.parquet")
+    e2 = entry(FileKind.ADD, "b.parquet")
+    e3 = entry(FileKind.DELETE, "a.parquet")
+    live = merge_manifest_entries([e1, e2, e3])
+    live_adds = [e for e in live if e.kind == FileKind.ADD]
+    assert [e.file.file_name for e in live_adds] == ["b.parquet"]
+
+
+def test_merge_respects_level():
+    # same file name at different level = different identity (upgrade)
+    e_add0 = entry(FileKind.ADD, "a.parquet", level=0)
+    e_del0 = entry(FileKind.DELETE, "a.parquet", level=0)
+    e_add1 = entry(FileKind.ADD, "a.parquet", level=1)
+    live = merge_manifest_entries([e_add0, e_del0, e_add1])
+    adds = [e for e in live if e.kind == FileKind.ADD]
+    assert len(adds) == 1
+    assert adds[0].file.level == 1
+
+
+def test_partition_stats(mdir):
+    part_codec = BinaryRowCodec([VarCharType(10)])
+    mf = ManifestFile(LocalFileIO(), mdir,
+                      partition_types=[VarCharType(10)])
+    entries = []
+    for dt in ["2024-01-02", "2024-01-01", "2024-01-03"]:
+        e = entry(FileKind.ADD, f"{dt}.parquet")
+        e.partition = part_codec.to_bytes((dt,))
+        entries.append(e)
+    meta = mf.write(entries)
+    mins, maxs = meta.partition_stats.decode([VarCharType(10)])
+    assert mins == ("2024-01-01",)
+    assert maxs == ("2024-01-03",)
+
+
+def test_index_manifest(mdir):
+    imf = IndexManifestFile(LocalFileIO(), mdir)
+    e1 = IndexManifestEntry(
+        FileKind.ADD, BINARY_ROW_EMPTY, 0,
+        IndexFileMeta("HASH", "index-abc-0", 400, 100))
+    e2 = IndexManifestEntry(
+        FileKind.ADD, BINARY_ROW_EMPTY, 1,
+        IndexFileMeta("DELETION_VECTORS", "index-dv-0", 64, 10,
+                      dv_ranges={"data-1.parquet": (0, 32, 5)}))
+    name = imf.write([e1, e2])
+    out = imf.read(name)
+    assert len(out) == 2
+    assert out[1].index_file.dv_ranges == {"data-1.parquet": (0, 32, 5)}
+    # combine: delete the hash index
+    e3 = IndexManifestEntry(
+        FileKind.DELETE, BINARY_ROW_EMPTY, 0,
+        IndexFileMeta("HASH", "index-abc-0", 400, 100))
+    name2 = imf.combine(name, [e3])
+    out2 = imf.read(name2)
+    assert len(out2) == 1
+    assert out2[0].index_file.index_type == "DELETION_VECTORS"

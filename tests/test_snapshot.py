@@ -1,0 +1,116 @@
+import json
+import time
+
+import pytest
+
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.snapshot import (
+    BranchManager, CommitKind, ConsumerManager, Snapshot, SnapshotManager,
+    TagManager,
+)
+
+
+def make_snapshot(sid, time_millis=None, kind=CommitKind.APPEND):
+    return Snapshot(
+        id=sid, schema_id=0,
+        base_manifest_list=f"manifest-list-base-{sid}",
+        delta_manifest_list=f"manifest-list-delta-{sid}",
+        commit_user="test-user", commit_identifier=sid,
+        commit_kind=kind,
+        time_millis=time_millis or int(time.time() * 1000),
+        total_record_count=sid * 100, delta_record_count=100)
+
+
+@pytest.fixture
+def sm(tmp_path):
+    return SnapshotManager(LocalFileIO(), str(tmp_path / "t"))
+
+
+def test_json_wire_format():
+    s = make_snapshot(7)
+    d = json.loads(s.to_json())
+    assert d["version"] == 3
+    assert d["schemaId"] == 0
+    assert d["commitKind"] == "APPEND"
+    assert "changelogManifestList" not in d  # nulls omitted
+    back = Snapshot.from_json(s.to_json())
+    assert back == s
+
+
+def test_commit_and_read(sm):
+    assert sm.latest_snapshot_id() is None
+    assert sm.try_commit(make_snapshot(1))
+    assert sm.try_commit(make_snapshot(2))
+    assert not sm.try_commit(make_snapshot(2))  # CAS conflict
+    assert sm.latest_snapshot_id() == 2
+    assert sm.earliest_snapshot_id() == 1
+    assert [s.id for s in sm.snapshots()] == [1, 2]
+
+
+def test_stale_latest_hint(sm):
+    for i in range(1, 5):
+        assert sm.try_commit(make_snapshot(i))
+    # corrupt the hint downward; manager must walk forward
+    sm._write_hint("LATEST", 2)
+    assert sm.latest_snapshot_id() == 4
+
+
+def test_time_travel(sm):
+    for i in range(1, 6):
+        assert sm.try_commit(make_snapshot(i, time_millis=i * 1000))
+    assert sm.earlier_or_equal_time_mills(3500).id == 3
+    assert sm.earlier_or_equal_time_mills(500) is None
+    assert sm.earlier_or_equal_time_mills(99999).id == 5
+
+
+def test_tags(tmp_path, sm):
+    for i in range(1, 4):
+        sm.try_commit(make_snapshot(i))
+    tm = TagManager(LocalFileIO(), sm.table_path)
+    tm.create_tag(sm.snapshot(2), "v1.0")
+    assert tm.tag_exists("v1.0")
+    assert tm.get_tag("v1.0").id == 2
+    with pytest.raises(ValueError):
+        tm.create_tag(sm.snapshot(3), "v1.0")
+    tm.create_tag(sm.snapshot(3), "v1.1")
+    assert list(tm.tags().keys()) == ["v1.0", "v1.1"]
+    tm.delete_tag("v1.0")
+    assert not tm.tag_exists("v1.0")
+
+
+def test_consumers(tmp_path, sm):
+    cm = ConsumerManager(LocalFileIO(), sm.table_path)
+    assert cm.consumer("job1") is None
+    cm.record_consumer("job1", 5)
+    cm.record_consumer("job2", 3)
+    assert cm.consumer("job1") == 5
+    assert cm.min_next_snapshot() == 3
+    cm.delete_consumer("job2")
+    assert cm.min_next_snapshot() == 5
+
+
+def test_branches(tmp_path):
+    fio = LocalFileIO()
+    table_path = str(tmp_path / "t")
+    # need a schema to branch from
+    from paimon_tpu.schema import Schema, SchemaManager
+    from paimon_tpu.types import IntType
+    SchemaManager(fio, table_path).create_table(
+        Schema.builder().column("id", IntType(False)).build())
+    sm = SnapshotManager(fio, table_path)
+    for i in range(1, 3):
+        sm.try_commit(make_snapshot(i))
+
+    bm = BranchManager(fio, table_path)
+    bm.create_branch("dev", from_snapshot=sm.snapshot(2))
+    assert bm.branch_exists("dev")
+    assert bm.branches() == ["dev"]
+
+    branch_sm = SnapshotManager(fio, table_path, branch="dev")
+    assert branch_sm.latest_snapshot_id() == 2
+    branch_sm.try_commit(make_snapshot(3))
+
+    bm.fast_forward("dev")
+    assert sm.latest_snapshot_id() == 3
+    bm.drop_branch("dev")
+    assert not bm.branch_exists("dev")
