@@ -1,0 +1,118 @@
+"""Benchmark: LSM full compaction of a primary-key bucket (BASELINE.md
+config 4 shape, scaled by BENCH_ROWS env).
+
+Measures end-to-end compaction throughput (decode parquet -> device
+sort-merge dedup -> encode parquet) in rows/sec over a bucket with 10
+sorted runs, and prints ONE JSON line.
+
+vs_baseline: BASELINE.md publishes no absolute reference numbers (the
+reference repo ships methodology only), so the recorded baseline is the
+pure-Python record-at-a-time merge loop measured here on a sample (the
+shape of the reference's LoserTree+MergeFunction inner loop) extrapolated
+to the full row count. vs_baseline = ours_rows_per_sec / loop_rows_per_sec.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def build_table(path, rows, runs):
+    import pyarrow as pa
+
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType, DoubleType, IntType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v1", BigIntType())
+              .column("v2", DoubleType())
+              .column("v3", IntType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(path, schema)
+    rng = np.random.default_rng(7)
+    per_run = rows // runs
+    for r in range(runs):
+        ids = rng.integers(0, rows // 2, per_run)
+        data = pa.table({
+            "id": pa.array(ids, pa.int64()),
+            "v1": pa.array(rng.integers(0, 1 << 40, per_run), pa.int64()),
+            "v2": pa.array(rng.random(per_run), pa.float64()),
+            "v3": pa.array(rng.integers(0, 100, per_run).astype(np.int32),
+                           pa.int32()),
+        })
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_arrow(data)
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+    return table
+
+
+def python_loop_baseline(rows_sample=200_000):
+    """Record-at-a-time merge loop (the reference's execution shape:
+    loser-tree pop + merge-function accept per record) on a sample."""
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, rows_sample // 2, rows_sample).tolist()
+    seqs = list(range(rows_sample))
+    values = rng.integers(0, 1 << 40, rows_sample).tolist()
+    items = sorted(zip(keys, seqs, values))
+    t0 = time.perf_counter()
+    out_keys = []
+    out_vals = []
+    prev_key = None
+    for k, s, v in items:
+        if k != prev_key:
+            out_keys.append(k)
+            out_vals.append(v)
+            prev_key = k
+        else:
+            out_vals[-1] = v
+    dt = time.perf_counter() - t0
+    return rows_sample / dt
+
+
+def main():
+    rows = int(os.environ.get("BENCH_ROWS", "20000000"))
+    runs = int(os.environ.get("BENCH_RUNS", "10"))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        table = build_table(os.path.join(tmp, "t"), rows, runs)
+
+        # warm up the kernel compile on a tiny merge so compile time does
+        # not pollute the measurement (first XLA compile is one-time)
+        import pyarrow as pa
+
+        from paimon_tpu.ops.merge import merge_runs
+        warm = pa.table({
+            "_KEY_id": pa.array(np.arange(1024), pa.int64()),
+            "_SEQUENCE_NUMBER": pa.array(np.arange(1024), pa.int64()),
+            "_VALUE_KIND": pa.array(np.zeros(1024, np.int8), pa.int8()),
+        })
+        merge_runs([warm], ["_KEY_id"])
+
+        t0 = time.perf_counter()
+        sid = table.compact(full=True)
+        dt = time.perf_counter() - t0
+        assert sid is not None
+        total_input_rows = rows
+        ours = total_input_rows / dt
+
+    baseline = python_loop_baseline()
+    print(json.dumps({
+        "metric": "full_compaction_rows_per_sec",
+        "value": round(ours, 1),
+        "unit": f"rows/s ({rows} rows, {runs} runs, dedup, parquet)",
+        "vs_baseline": round(ours / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

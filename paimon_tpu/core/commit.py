@@ -1,0 +1,278 @@
+"""FileStoreCommit: two-phase snapshot commit with optimistic retry.
+
+reference: operation/FileStoreCommitImpl.java:139 (javadoc :122-132:
+conflict check -> CAS publish; tryCommit retry loop :756), conflict
+detection in operation/commit/ConflictDetection.java, atomicity provider
+catalog/SnapshotCommit.java:27 (rename CAS here).
+"""
+
+from __future__ import annotations
+
+import time as _time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paimon_tpu.core.write import CommitMessage
+from paimon_tpu.data.binary_row import BinaryRowCodec
+from paimon_tpu.fs import FileIO
+from paimon_tpu.manifest import (
+    DataFileMeta, FileKind, IndexManifestFile, ManifestEntry, ManifestFile,
+    ManifestFileMeta, ManifestList, merge_manifest_entries,
+)
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.snapshot import CommitKind, Snapshot, SnapshotManager
+from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
+from paimon_tpu.utils.path_factory import FileStorePathFactory
+
+__all__ = ["FileStoreCommit", "CommitConflictError"]
+
+
+class CommitConflictError(RuntimeError):
+    pass
+
+
+class FileStoreCommit:
+    def __init__(self, file_io: FileIO, table_path: str,
+                 table_schema: TableSchema, options: CoreOptions,
+                 commit_user: Optional[str] = None,
+                 branch: str = "main"):
+        self.file_io = file_io
+        self.table_path = table_path.rstrip("/")
+        self.schema = table_schema
+        self.options = options
+        self.commit_user = commit_user or str(uuid.uuid4())
+        self.snapshot_manager = SnapshotManager(file_io, table_path, branch)
+        self.path_factory = FileStorePathFactory(
+            table_path, table_schema.partition_keys)
+        rt = table_schema.logical_row_type()
+        self.partition_types = [rt.get_field(k).type
+                                for k in table_schema.partition_keys]
+        self._partition_codec = BinaryRowCodec(self.partition_types)
+        compression = options.get(CoreOptions.MANIFEST_COMPRESSION)
+        codec = {"zstd": "zstandard", "none": "null"}.get(compression,
+                                                          compression)
+        mdir = self.path_factory.manifest_dir
+        self.manifest_file = ManifestFile(file_io, mdir, codec,
+                                          self.partition_types)
+        self.manifest_list = ManifestList(file_io, mdir, codec)
+        self.index_manifest_file = IndexManifestFile(file_io, mdir, codec)
+        self.manifest_target_size = options.get(
+            CoreOptions.MANIFEST_TARGET_FILE_SIZE)
+        self.manifest_merge_min = options.get(
+            CoreOptions.MANIFEST_MERGE_MIN_COUNT)
+
+    # -- public API ----------------------------------------------------------
+
+    def commit(self, messages: Sequence[CommitMessage],
+               commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
+               kind: Optional[str] = None,
+               index_entries: Optional[list] = None,
+               properties: Optional[Dict[str, str]] = None) -> Optional[int]:
+        """Commit append + compact changes. Returns snapshot id (or None if
+        nothing to commit). Append and compact deltas are committed as
+        separate snapshots like the reference (APPEND then COMPACT)."""
+        append_entries: List[ManifestEntry] = []
+        compact_entries: List[ManifestEntry] = []
+        changelog_entries: List[ManifestEntry] = []
+        for msg in messages:
+            pbytes = self._partition_codec.to_bytes(msg.partition)
+            for f in msg.new_files:
+                append_entries.append(ManifestEntry(
+                    FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
+            for f in msg.changelog_files:
+                changelog_entries.append(ManifestEntry(
+                    FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
+            for f in msg.compact_before:
+                compact_entries.append(ManifestEntry(
+                    FileKind.DELETE, pbytes, msg.bucket, msg.total_buckets,
+                    f))
+            for f in msg.compact_after:
+                compact_entries.append(ManifestEntry(
+                    FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
+
+        last_id = None
+        if append_entries or changelog_entries or index_entries:
+            last_id = self._try_commit(
+                append_entries, changelog_entries, commit_identifier,
+                kind or CommitKind.APPEND, index_entries=index_entries,
+                properties=properties)
+            index_entries = None
+        if compact_entries:
+            last_id = self._try_commit(
+                compact_entries, [], commit_identifier, CommitKind.COMPACT,
+                check_deleted_files=True, index_entries=index_entries,
+                properties=properties)
+        return last_id
+
+    def overwrite(self, messages: Sequence[CommitMessage],
+                  partition_filter: Optional[dict] = None,
+                  commit_identifier: int = BATCH_COMMIT_IDENTIFIER
+                  ) -> Optional[int]:
+        """INSERT OVERWRITE: delete current files (optionally restricted to
+        a partition spec) and add new ones atomically
+        (reference FileStoreCommitImpl.overwrite)."""
+        entries: List[ManifestEntry] = []
+        latest = self.snapshot_manager.latest_snapshot()
+        if latest is not None:
+            for e in self._read_all_entries(latest):
+                if e.kind != FileKind.ADD:
+                    continue
+                if partition_filter and not self._partition_matches(
+                        e.partition, partition_filter):
+                    continue
+                entries.append(ManifestEntry(
+                    FileKind.DELETE, e.partition, e.bucket, e.total_buckets,
+                    e.file))
+        for msg in messages:
+            pbytes = self._partition_codec.to_bytes(msg.partition)
+            for f in msg.new_files:
+                entries.append(ManifestEntry(
+                    FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
+        return self._try_commit(entries, [], commit_identifier,
+                                CommitKind.OVERWRITE)
+
+    def filter_committed(self, commit_identifiers: Sequence[int]
+                         ) -> List[int]:
+        """Drop identifiers already committed by this user (exactly-once
+        replay dedup, reference FileStoreCommit.filterCommitted:52)."""
+        committed = set()
+        for snap in self.snapshot_manager.snapshots():
+            if snap.commit_user == self.commit_user:
+                committed.add(snap.commit_identifier)
+        return [c for c in commit_identifiers if c not in committed]
+
+    # -- internals -----------------------------------------------------------
+
+    def _read_all_entries(self, snapshot: Snapshot) -> List[ManifestEntry]:
+        metas = self.manifest_list.read_all(snapshot.base_manifest_list,
+                                            snapshot.delta_manifest_list)
+        entries: List[ManifestEntry] = []
+        for m in metas:
+            entries.extend(self.manifest_file.read(m.file_name))
+        return merge_manifest_entries(entries)
+
+    def _partition_matches(self, pbytes: bytes, spec: dict) -> bool:
+        values = self._partition_codec.from_bytes(pbytes)
+        for i, k in enumerate(self.schema.partition_keys):
+            if k in spec and str(values[i]) != str(spec[k]):
+                return False
+        return True
+
+    def _try_commit(self, entries: List[ManifestEntry],
+                    changelog_entries: List[ManifestEntry],
+                    commit_identifier: int, kind: str,
+                    check_deleted_files: bool = False,
+                    index_entries: Optional[list] = None,
+                    properties: Optional[Dict[str, str]] = None) -> int:
+        new_manifest: Optional[ManifestFileMeta] = None
+        changelog_manifest: Optional[ManifestFileMeta] = None
+        while True:
+            latest = self.snapshot_manager.latest_snapshot()
+            if check_deleted_files and latest is not None:
+                self._assert_files_exist(latest, entries)
+
+            if new_manifest is None and entries:
+                new_manifest = self.manifest_file.write(
+                    entries, schema_id=self.schema.id)
+            if changelog_manifest is None and changelog_entries:
+                changelog_manifest = self.manifest_file.write(
+                    changelog_entries, schema_id=self.schema.id)
+
+            if latest is None:
+                base_metas: List[ManifestFileMeta] = []
+                new_id = 1
+                prev_total = 0
+                prev_index = None
+            else:
+                base_metas = self.manifest_list.read_all(
+                    latest.base_manifest_list, latest.delta_manifest_list)
+                new_id = latest.id + 1
+                prev_total = latest.total_record_count
+                prev_index = latest.index_manifest
+
+            base_metas = self._maybe_merge_manifests(base_metas)
+            base_name, base_size = self.manifest_list.write(base_metas)
+            delta_metas = [new_manifest] if new_manifest else []
+            delta_name, delta_size = self.manifest_list.write(delta_metas)
+            changelog_name = None
+            changelog_size = None
+            if changelog_manifest is not None:
+                changelog_name, changelog_size = self.manifest_list.write(
+                    [changelog_manifest])
+
+            index_manifest = self.index_manifest_file.combine(
+                prev_index, index_entries or [])
+
+            delta_rows = sum(
+                (e.file.row_count if e.kind == FileKind.ADD
+                 else -e.file.row_count) for e in entries)
+            changelog_rows = sum(e.file.row_count
+                                 for e in changelog_entries)
+            snapshot = Snapshot(
+                id=new_id,
+                schema_id=self.schema.id,
+                base_manifest_list=base_name,
+                base_manifest_list_size=base_size,
+                delta_manifest_list=delta_name,
+                delta_manifest_list_size=delta_size,
+                changelog_manifest_list=changelog_name,
+                changelog_manifest_list_size=changelog_size,
+                index_manifest=index_manifest,
+                commit_user=self.commit_user,
+                commit_identifier=commit_identifier,
+                commit_kind=kind,
+                time_millis=int(_time.time() * 1000),
+                total_record_count=prev_total + delta_rows,
+                delta_record_count=delta_rows,
+                changelog_record_count=changelog_rows or None,
+                properties=properties,
+            )
+            if self.snapshot_manager.try_commit(snapshot):
+                return new_id
+            # lost the race: clean up lists we wrote for this attempt and
+            # retry against the new latest (manifest files are reusable)
+            self.manifest_list.delete(base_name)
+            self.manifest_list.delete(delta_name)
+            if changelog_name:
+                self.manifest_list.delete(changelog_name)
+
+    def _assert_files_exist(self, latest: Snapshot,
+                            entries: List[ManifestEntry]):
+        """Compaction conflict check: all files we delete must still be
+        live (reference ConflictDetection: files-to-delete still exist)."""
+        deletes = [e for e in entries if e.kind == FileKind.DELETE]
+        if not deletes:
+            return
+        live = {e.identifier() for e in self._read_all_entries(latest)
+                if e.kind == FileKind.ADD}
+        for d in deletes:
+            ident = (d.partition, d.bucket, d.file.level, d.file.file_name,
+                     tuple(d.file.extra_files), d.file.embedded_index,
+                     d.file.external_path)
+            if ident not in live:
+                raise CommitConflictError(
+                    f"File to delete no longer exists: "
+                    f"{d.file.file_name} (level {d.file.level}); "
+                    f"a concurrent compaction won. Retry the compaction "
+                    f"from the new snapshot.")
+
+    def _maybe_merge_manifests(self, metas: List[ManifestFileMeta]
+                               ) -> List[ManifestFileMeta]:
+        """Full-rewrite small manifests when there are too many
+        (reference manifest/ManifestFileMerger)."""
+        if len(metas) < self.manifest_merge_min:
+            return metas
+        small = [m for m in metas if m.file_size < self.manifest_target_size]
+        if len(small) < 2:
+            return metas
+        big = [m for m in metas if m.file_size >= self.manifest_target_size]
+        entries: List[ManifestEntry] = []
+        for m in small:
+            entries.extend(self.manifest_file.read(m.file_name))
+        merged = merge_manifest_entries(entries)
+        out = list(big)
+        if merged:
+            out.append(self.manifest_file.write(merged,
+                                                schema_id=self.schema.id))
+        return out

@@ -1,0 +1,201 @@
+"""Read path: merge-on-read over DataSplits.
+
+reference call stack (SURVEY §3.2): KeyValueTableRead ->
+MergeFileSplitRead.createMergeReader (operation/MergeFileSplitRead.java:
+269,287) -> MergeTreeReaders.readerForMergeTree -> per-section
+SortMergeReaderWithLoserTree -> MergeFunctionWrapper -> DropDeleteReader;
+fast path RawFileSplitRead.java:74.
+
+TPU deviation: a split's runs are decoded to Arrow (Arrow C++ parquet),
+then merged in one device kernel (ops/merge.py) instead of a record
+iterator stack. Sections (IntervalPartition) are unnecessary: the sort
+handles arbitrary overlap; non-overlapping byte ranges just sort cheaply.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.core.kv_file import KEY_PREFIX, read_kv_file
+from paimon_tpu.core.scan import DataSplit
+from paimon_tpu.fs import FileIO
+from paimon_tpu.manifest import DataFileMeta
+from paimon_tpu.options import CoreOptions, MergeEngine
+from paimon_tpu.ops.merge import KIND_COL, SEQ_COL, merge_runs
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+from paimon_tpu.predicate import Predicate
+from paimon_tpu.schema.schema_manager import SchemaManager
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.types import RowKind, data_type_to_arrow
+from paimon_tpu.utils.path_factory import FileStorePathFactory
+
+__all__ = ["MergeFileSplitRead", "assemble_runs"]
+
+
+def assemble_runs(files: Sequence[DataFileMeta]) -> List[List[DataFileMeta]]:
+    """Order a bucket's files into sorted runs, oldest first.
+
+    Levels >=1 are each one key-sorted non-overlapping run (older = higher
+    level). Each L0 file is its own run, ordered by max sequence number
+    (reference mergetree/Levels.java:39 + MergeTreeReaders.readerForMergeTree).
+    """
+    by_level: Dict[int, List[DataFileMeta]] = {}
+    for f in files:
+        by_level.setdefault(f.level, []).append(f)
+    runs: List[List[DataFileMeta]] = []
+    for level in sorted((l for l in by_level if l > 0), reverse=True):
+        level_files = sorted(by_level[level], key=lambda f: f.min_key)
+        runs.append(level_files)
+    for f in sorted(by_level.get(0, []),
+                    key=lambda f: (f.max_sequence_number,
+                                   f.min_sequence_number)):
+        runs.append([f])
+    return runs
+
+
+class MergeFileSplitRead:
+    """Reads DataSplits with merge (or raw when safe)."""
+
+    def __init__(self, file_io: FileIO, table_path: str,
+                 schema: TableSchema, options: CoreOptions,
+                 schema_manager: Optional[SchemaManager] = None):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.schema = schema
+        self.options = options
+        self.schema_manager = schema_manager
+        self.path_factory = FileStorePathFactory(
+            table_path, schema.partition_keys,
+            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.trimmed_pk = schema.trimmed_primary_keys()
+        self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]
+        rt = schema.logical_row_type()
+        self.key_encoder = NormalizedKeyEncoder(
+            [data_type_to_arrow(rt.get_field(k).type)
+             for k in self.trimmed_pk])
+        self._schema_cache: Dict[int, TableSchema] = {schema.id: schema}
+        self._projection: Optional[List[str]] = None
+        self._predicate: Optional[Predicate] = None
+
+    def with_projection(self, columns: Optional[List[str]]
+                        ) -> "MergeFileSplitRead":
+        self._projection = list(columns) if columns else None
+        return self
+
+    def with_filter(self, predicate: Optional[Predicate]
+                    ) -> "MergeFileSplitRead":
+        self._predicate = predicate
+        return self
+
+    # -- split read ----------------------------------------------------------
+
+    def read_split(self, split: DataSplit) -> pa.Table:
+        value_cols = self._value_columns()
+        read_cols = self.key_cols + [SEQ_COL, KIND_COL] + value_cols
+        if split.raw_convertible:
+            out = self._read_raw(split, read_cols, value_cols)
+        else:
+            out = self._read_merged(split, read_cols, value_cols)
+        if self._predicate is not None:
+            out = out.filter(self._predicate.to_arrow())
+        return out
+
+    def read_splits(self, splits: Sequence[DataSplit]) -> pa.Table:
+        tables = [self.read_split(s) for s in splits]
+        tables = [t for t in tables if t.num_rows > 0]
+        if not tables:
+            return pa.table({c: [] for c in self._value_columns()})
+        return pa.concat_tables(tables, promote_options="default")
+
+    def _value_columns(self) -> List[str]:
+        names = [f.name for f in self.schema.fields]
+        if self._projection:
+            # key/sequence columns are read regardless; output honors the
+            # projection
+            return [n for n in names if n in set(self._projection)
+                    or n in self.trimmed_pk]
+        return names
+
+    def _read_file(self, split: DataSplit, meta: DataFileMeta,
+                   read_cols: List[str]) -> pa.Table:
+        table = read_kv_file(
+            self.file_io, self.path_factory, split.partition, split.bucket,
+            meta, file_format=None, projection=None)
+        table = self._evolve(table, meta.schema_id)
+        if split.deletion_vectors and \
+                meta.file_name in split.deletion_vectors:
+            dv = split.deletion_vectors[meta.file_name]
+            mask = dv.keep_mask(table.num_rows)
+            table = table.filter(pa.array(mask))
+        return table.select(read_cols)
+
+    def _read_raw(self, split: DataSplit, read_cols: List[str],
+                  value_cols: List[str]) -> pa.Table:
+        tables = [self._read_file(split, f, read_cols)
+                  for f in sorted(split.data_files,
+                                  key=lambda f: f.min_key)]
+        merged = pa.concat_tables(tables, promote_options="none")
+        kinds = np.asarray(merged.column(KIND_COL).combine_chunks()
+                           .cast(pa.int8()))
+        keep = (kinds == RowKind.INSERT) | (kinds == RowKind.UPDATE_AFTER)
+        if not keep.all():
+            merged = merged.filter(pa.array(keep))
+        return merged.select(value_cols)
+
+    def _read_merged(self, split: DataSplit, read_cols: List[str],
+                     value_cols: List[str]) -> pa.Table:
+        runs_meta = assemble_runs(split.data_files)
+        runs = []
+        for run_files in runs_meta:
+            tables = [self._read_file(split, f, read_cols)
+                      for f in run_files]
+            runs.append(pa.concat_tables(tables, promote_options="none")
+                        if len(tables) > 1 else tables[0])
+        engine = self.options.merge_engine
+        if engine == MergeEngine.FIRST_ROW:
+            res = merge_runs(runs, self.key_cols, merge_engine="first-row",
+                             key_encoder=self.key_encoder)
+        elif engine in (MergeEngine.DEDUPLICATE,):
+            res = merge_runs(runs, self.key_cols,
+                             key_encoder=self.key_encoder)
+        else:
+            from paimon_tpu.ops.agg import merge_runs_agg
+            return merge_runs_agg(runs, self.key_cols, self.schema,
+                                  self.options,
+                                  key_encoder=self.key_encoder
+                                  ).select(value_cols)
+        return res.take(value_cols)
+
+    # -- schema evolution ----------------------------------------------------
+
+    def _evolve(self, table: pa.Table, file_schema_id: int) -> pa.Table:
+        """Map an old-schema file onto the read schema by field id
+        (reference schema/SchemaEvolutionUtil.java index+cast mapping)."""
+        if file_schema_id == self.schema.id:
+            return table
+        old = self._schema_cache.get(file_schema_id)
+        if old is None:
+            if self.schema_manager is None:
+                return table
+            old = self.schema_manager.schema(file_schema_id)
+            self._schema_cache[file_schema_id] = old
+        old_by_id = {f.id: f for f in old.fields}
+        cols = {}
+        n = table.num_rows
+        for name in table.column_names:
+            if name.startswith(KEY_PREFIX) or name in (SEQ_COL, KIND_COL):
+                cols[name] = table.column(name)
+        for f in self.schema.fields:
+            old_f = old_by_id.get(f.id)
+            arrow_t = data_type_to_arrow(f.type)
+            if old_f is None or old_f.name not in table.column_names:
+                cols[f.name] = pa.nulls(n, arrow_t)
+            else:
+                col = table.column(old_f.name)
+                if col.type != arrow_t:
+                    col = col.cast(arrow_t)
+                cols[f.name] = col
+        return pa.table(cols)

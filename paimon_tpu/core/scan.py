@@ -1,0 +1,293 @@
+"""Scan planning: snapshot -> manifests -> pruned ManifestEntries ->
+DataSplits.
+
+reference: operation/AbstractFileStoreScan.java (manifest pruning),
+table/source/SnapshotReaderImpl.java:87 (generateSplits:412),
+MergeTreeSplitGenerator.java:38, DataSplit.java:62.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from paimon_tpu.data.binary_row import BinaryRowCodec
+from paimon_tpu.fs import FileIO
+from paimon_tpu.manifest import (
+    DataFileMeta, FileKind, IndexManifestFile, ManifestEntry, ManifestFile,
+    ManifestList, merge_manifest_entries,
+)
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.predicate import Predicate
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.snapshot import Snapshot, SnapshotManager
+from paimon_tpu.utils.path_factory import FileStorePathFactory
+
+__all__ = ["DataSplit", "ScanPlan", "FileStoreScan"]
+
+
+@dataclass
+class DataSplit:
+    """reference table/source/DataSplit.java:62."""
+    snapshot_id: int
+    partition: Tuple
+    bucket: int
+    total_buckets: int
+    data_files: List[DataFileMeta]
+    raw_convertible: bool = False
+    deletion_vectors: Optional[Dict[str, Any]] = None   # file -> DV
+
+    @property
+    def row_count(self) -> int:
+        return sum(f.row_count for f in self.data_files)
+
+
+@dataclass
+class ScanPlan:
+    snapshot_id: Optional[int]
+    splits: List[DataSplit]
+
+    @property
+    def row_count(self) -> int:
+        return sum(s.row_count for s in self.splits)
+
+
+class FileStoreScan:
+    def __init__(self, file_io: FileIO, table_path: str,
+                 schema: TableSchema, options: CoreOptions,
+                 branch: str = "main"):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.schema = schema
+        self.options = options
+        self.snapshot_manager = SnapshotManager(file_io, table_path, branch)
+        self.path_factory = FileStorePathFactory(table_path,
+                                                 schema.partition_keys)
+        rt = schema.logical_row_type()
+        self.partition_types = [rt.get_field(k).type
+                                for k in schema.partition_keys]
+        self._partition_codec = BinaryRowCodec(self.partition_types)
+        compression = options.get(CoreOptions.MANIFEST_COMPRESSION)
+        codec = {"zstd": "zstandard", "none": "null"}.get(compression,
+                                                          compression)
+        mdir = self.path_factory.manifest_dir
+        self.manifest_file = ManifestFile(file_io, mdir, codec,
+                                          self.partition_types)
+        self.manifest_list = ManifestList(file_io, mdir, codec)
+        self.index_manifest_file = IndexManifestFile(file_io, mdir, codec)
+        self._partition_filter: Optional[dict] = None
+        self._bucket_filter: Optional[set] = None
+        self._key_filter: Optional[Predicate] = None
+        self._value_filter: Optional[Predicate] = None
+        self._level_filter: Optional[Callable[[int], bool]] = None
+
+    # -- fluent filters ------------------------------------------------------
+
+    def with_partition_filter(self, spec: dict) -> "FileStoreScan":
+        self._partition_filter = spec
+        return self
+
+    def with_buckets(self, buckets: Sequence[int]) -> "FileStoreScan":
+        self._bucket_filter = set(buckets)
+        return self
+
+    def with_key_filter(self, predicate: Predicate) -> "FileStoreScan":
+        self._key_filter = predicate
+        return self
+
+    def with_value_filter(self, predicate: Predicate) -> "FileStoreScan":
+        self._value_filter = predicate
+        return self
+
+    def with_level_filter(self, fn) -> "FileStoreScan":
+        self._level_filter = fn
+        return self
+
+    # -- planning ------------------------------------------------------------
+
+    def plan(self, snapshot: Optional[Snapshot] = None) -> ScanPlan:
+        if snapshot is None:
+            snapshot = self.snapshot_manager.latest_snapshot()
+        if snapshot is None:
+            return ScanPlan(None, [])
+        entries = self.read_entries(snapshot)
+        return ScanPlan(snapshot.id, self.generate_splits(
+            snapshot.id, entries))
+
+    def plan_delta(self, snapshot: Snapshot) -> ScanPlan:
+        """Only this snapshot's delta files (for incremental/streaming
+        reads, reference DeltaFollowUpScanner)."""
+        metas = self.manifest_list.read(snapshot.delta_manifest_list)
+        entries = self._read_manifests(metas)
+        adds = [e for e in entries if e.kind == FileKind.ADD]
+        return ScanPlan(snapshot.id,
+                        self.generate_splits(snapshot.id, adds,
+                                             for_delta=True))
+
+    def plan_changelog(self, snapshot: Snapshot) -> ScanPlan:
+        if not snapshot.changelog_manifest_list:
+            return ScanPlan(snapshot.id, [])
+        metas = self.manifest_list.read(snapshot.changelog_manifest_list)
+        entries = self._read_manifests(metas)
+        adds = [e for e in entries if e.kind == FileKind.ADD]
+        return ScanPlan(snapshot.id,
+                        self.generate_splits(snapshot.id, adds,
+                                             for_delta=True))
+
+    def read_entries(self, snapshot: Snapshot) -> List[ManifestEntry]:
+        metas = self.manifest_list.read_all(snapshot.base_manifest_list,
+                                            snapshot.delta_manifest_list)
+        metas = self._prune_manifests(metas)
+        entries = merge_manifest_entries(self._read_manifests(metas))
+        return [e for e in entries if e.kind == FileKind.ADD]
+
+    def _read_manifests(self, metas) -> List[ManifestEntry]:
+        entries: List[ManifestEntry] = []
+        for m in metas:
+            entries.extend(self.manifest_file.read(m.file_name))
+        return entries
+
+    def _prune_manifests(self, metas):
+        """Skip whole manifests via partition stats
+        (reference AbstractFileStoreScan manifest-level pruning)."""
+        if not self._partition_filter or not self.partition_types:
+            return metas
+        out = []
+        for m in metas:
+            stats = m.partition_stats
+            if not stats.null_counts and stats.min_values == b"":
+                out.append(m)
+                continue
+            try:
+                mins, maxs = stats.decode(self.partition_types)
+            except Exception:
+                out.append(m)
+                continue
+            keep = True
+            for i, k in enumerate(self.schema.partition_keys):
+                if k in self._partition_filter:
+                    v = self._partition_filter[k]
+                    if mins[i] is not None and maxs[i] is not None and \
+                            not (str(mins[i]) <= str(v) <= str(maxs[i])):
+                        keep = False
+                        break
+            if keep:
+                out.append(m)
+        return out
+
+    def _entry_visible(self, e: ManifestEntry) -> bool:
+        if self._bucket_filter is not None and \
+                e.bucket not in self._bucket_filter:
+            return False
+        if self._level_filter is not None and \
+                not self._level_filter(e.file.level):
+            return False
+        if self._partition_filter:
+            values = self._partition_codec.from_bytes(e.partition)
+            for i, k in enumerate(self.schema.partition_keys):
+                if k in self._partition_filter and \
+                        str(values[i]) != str(self._partition_filter[k]):
+                    return False
+        if self._key_filter is not None and self.schema.primary_keys:
+            key_types = [t.copy(False) for t in (
+                self.schema.logical_row_type().get_field(k).type
+                for k in self.schema.trimmed_primary_keys())]
+            try:
+                mins, maxs = e.file.key_stats.decode(key_types)
+            except Exception:
+                return True
+            names = self.schema.trimmed_primary_keys()
+            if not self._key_filter.test_stats(
+                    dict(zip(names, mins)), dict(zip(names, maxs)),
+                    dict(zip(names, e.file.key_stats.null_counts
+                             or [0] * len(names))),
+                    e.file.row_count):
+                return False
+        if self._value_filter is not None:
+            value_types = [f.type.as_nullable() for f in self.schema.fields]
+            names = [f.name for f in self.schema.fields]
+            try:
+                mins, maxs = e.file.value_stats.decode(value_types)
+            except Exception:
+                return True
+            if not self._value_filter.test_stats(
+                    dict(zip(names, mins)), dict(zip(names, maxs)),
+                    dict(zip(names, e.file.value_stats.null_counts
+                             or [0] * len(names))),
+                    e.file.row_count):
+                return False
+        return True
+
+    def generate_splits(self, snapshot_id: int,
+                        entries: List[ManifestEntry],
+                        for_delta: bool = False) -> List[DataSplit]:
+        groups: Dict[Tuple, List[ManifestEntry]] = {}
+        for e in entries:
+            if not self._entry_visible(e):
+                continue
+            groups.setdefault((e.partition, e.bucket), []).append(e)
+        splits = []
+        dv_index = self._load_deletion_vectors(snapshot_id) \
+            if self.options.deletion_vectors_enabled else {}
+        for (pbytes, bucket), group in sorted(
+                groups.items(), key=lambda kv: (kv[0][0], kv[0][1])):
+            partition = self._partition_codec.from_bytes(pbytes)
+            files = [g.file for g in group]
+            total_buckets = group[0].total_buckets
+            max_level = max(f.level for f in files)
+            raw = (not for_delta
+                   and all(f.level == max_level and max_level > 0
+                           for f in files)
+                   and all((f.delete_row_count or 0) == 0 for f in files)
+                   and (pbytes, bucket) not in dv_index)
+            splits.append(DataSplit(
+                snapshot_id=snapshot_id,
+                partition=partition,
+                bucket=bucket,
+                total_buckets=total_buckets,
+                data_files=files,
+                raw_convertible=raw or for_delta,
+                deletion_vectors=dv_index.get((pbytes, bucket)),
+            ))
+        return splits
+
+    def _load_deletion_vectors(self, snapshot_id: int):
+        try:
+            snapshot = self.snapshot_manager.snapshot(snapshot_id)
+        except OSError:
+            return {}
+        if not snapshot.index_manifest:
+            return {}
+        from paimon_tpu.index.deletion_vector import read_deletion_vectors
+        out: Dict[Tuple, Dict[str, Any]] = {}
+        for e in self.index_manifest_file.read(snapshot.index_manifest):
+            if e.index_file.index_type != "DELETION_VECTORS":
+                continue
+            dvs = read_deletion_vectors(
+                self.file_io,
+                self.path_factory.index_file_path(e.index_file.file_name),
+                e.index_file.dv_ranges or {})
+            out.setdefault((e.partition, e.bucket), {}).update(dvs)
+        return out
+
+    # -- helpers for writers -------------------------------------------------
+
+    def max_sequence_number(self, partition: Tuple, bucket: int) -> int:
+        snapshot = self.snapshot_manager.latest_snapshot()
+        if snapshot is None:
+            return -1
+        pbytes = self._partition_codec.to_bytes(partition)
+        best = -1
+        for e in self.read_entries(snapshot):
+            if e.partition == pbytes and e.bucket == bucket:
+                best = max(best, e.file.max_sequence_number)
+        return best
+
+    def bucket_files(self, partition: Tuple,
+                     bucket: int) -> List[DataFileMeta]:
+        snapshot = self.snapshot_manager.latest_snapshot()
+        if snapshot is None:
+            return []
+        pbytes = self._partition_codec.to_bytes(partition)
+        return [e.file for e in self.read_entries(snapshot)
+                if e.partition == pbytes and e.bucket == bucket]

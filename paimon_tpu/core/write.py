@@ -1,0 +1,269 @@
+"""Write path: buffered per-(partition,bucket) writers producing L0 files.
+
+reference call stack (SURVEY §3.1): TableWriteImpl.write ->
+AbstractFileStoreWrite.write (operation/AbstractFileStoreWrite.java:186)
+-> MergeTreeWriter.write/flushMemory (mergetree/MergeTreeWriter.java:164,
+203) -> sort + merge-dedup -> KeyValueFileWriterFactory rolling write.
+
+TPU deviation: instead of a binary sort buffer with normalized-key
+insertion (SortBufferWriteBuffer.java:59), rows accumulate as Arrow
+batches; at flush the whole buffer is sorted/deduped by the device kernel
+in one shot and written columnar.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.core.bucket import FixedBucketAssigner
+from paimon_tpu.core.kv_file import KEY_PREFIX, KeyValueFileWriter
+from paimon_tpu.fs import FileIO
+from paimon_tpu.manifest import DataFileMeta, SimpleStats
+from paimon_tpu.options import CoreOptions, MergeEngine
+from paimon_tpu.ops.merge import KIND_COL, SEQ_COL, merge_runs, sort_table
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.types import RowKind
+from paimon_tpu.utils.path_factory import FileStorePathFactory
+
+__all__ = ["CommitMessage", "KeyValueFileStoreWrite", "build_kv_table"]
+
+ROW_KIND_COL = "_ROW_KIND"
+
+
+@dataclass
+class CommitMessage:
+    """reference: table/sink/CommitMessageImpl.java."""
+    partition: Tuple
+    bucket: int
+    total_buckets: int
+    new_files: List[DataFileMeta] = dc_field(default_factory=list)
+    compact_before: List[DataFileMeta] = dc_field(default_factory=list)
+    compact_after: List[DataFileMeta] = dc_field(default_factory=list)
+    changelog_files: List[DataFileMeta] = dc_field(default_factory=list)
+    compact_changelog: List[DataFileMeta] = dc_field(default_factory=list)
+
+    def is_empty(self) -> bool:
+        return not (self.new_files or self.compact_before
+                    or self.compact_after or self.changelog_files
+                    or self.compact_changelog)
+
+
+def build_kv_table(raw: pa.Table, schema: TableSchema,
+                   seq: np.ndarray, kinds: np.ndarray) -> pa.Table:
+    """Flatten rows into the KV file layout:
+    _KEY_<pk...>, _SEQUENCE_NUMBER, _VALUE_KIND, <all value columns>."""
+    cols = []
+    names = []
+    for k in schema.trimmed_primary_keys():
+        cols.append(raw.column(k))
+        names.append(KEY_PREFIX + k)
+    cols.append(pa.array(seq, pa.int64()))
+    names.append(SEQ_COL)
+    cols.append(pa.array(kinds, pa.int8()))
+    names.append(KIND_COL)
+    for f in schema.fields:
+        cols.append(raw.column(f.name))
+        names.append(f.name)
+    return pa.table(dict(zip(names, cols)))
+
+
+class _BucketWriter:
+    def __init__(self, parent: "KeyValueFileStoreWrite", partition: Tuple,
+                 bucket: int):
+        self.parent = parent
+        self.partition = partition
+        self.bucket = bucket
+        self.buffers: List[pa.Table] = []
+        self.kind_buffers: List[np.ndarray] = []
+        self.buffered_bytes = 0
+        self.next_seq: Optional[int] = None   # lazily restored
+        self.new_files: List[DataFileMeta] = []
+        self.changelog_files: List[DataFileMeta] = []
+
+    def write(self, table: pa.Table, kinds: np.ndarray):
+        self.buffers.append(table)
+        self.kind_buffers.append(kinds)
+        self.buffered_bytes += table.nbytes
+        if self.buffered_bytes >= self.parent.options.write_buffer_size:
+            self.flush()
+
+    def _restore_seq(self) -> int:
+        if self.next_seq is None:
+            self.next_seq = self.parent.restore_max_seq(
+                self.partition, self.bucket) + 1
+        return self.next_seq
+
+    def flush(self):
+        if not self.buffers:
+            return
+        raw = pa.concat_tables(self.buffers, promote_options="none")
+        kinds = np.concatenate(self.kind_buffers)
+        self.buffers, self.kind_buffers = [], []
+        self.buffered_bytes = 0
+        n = raw.num_rows
+        start = self._restore_seq()
+        seq = np.arange(start, start + n, dtype=np.int64)
+        self.next_seq = start + n
+
+        schema = self.parent.schema
+        kv = build_kv_table(raw, schema, seq, kinds)
+        key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
+        engine = self.parent.options.merge_engine
+        if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
+            res = merge_runs([kv], key_cols, merge_engine=engine,
+                             drop_deletes=False,
+                             key_encoder=self.parent.key_encoder)
+            sorted_kv = res.take()
+        else:
+            order = sort_table(kv, key_cols,
+                               key_encoder=self.parent.key_encoder)
+            sorted_kv = kv.take(pa.array(order))
+
+        metas = self.parent.kv_writer.write(self.partition, self.bucket,
+                                            sorted_kv, level=0)
+        self.new_files.extend(metas)
+
+        if self.parent.changelog_input:
+            # changelog-producer=input: raw rows in arrival order
+            cl = build_kv_table(raw, schema, seq, kinds)
+            self.changelog_files.extend(
+                self.parent.write_changelog(self.partition, self.bucket, cl))
+
+    def prepare_commit(self) -> Optional[CommitMessage]:
+        self.flush()
+        msg = CommitMessage(self.partition, self.bucket,
+                            self.parent.total_buckets,
+                            new_files=list(self.new_files),
+                            changelog_files=list(self.changelog_files))
+        self.new_files = []
+        self.changelog_files = []
+        return None if msg.is_empty() else msg
+
+
+class KeyValueFileStoreWrite:
+    """Routes rows to per-(partition,bucket) writers.
+
+    reference: operation/KeyValueFileStoreWrite.java:70."""
+
+    def __init__(self, file_io: FileIO, table_path: str,
+                 table_schema: TableSchema, options: CoreOptions,
+                 restore_max_seq: Optional[Callable[[Tuple, int], int]]
+                 = None):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.schema = table_schema
+        self.options = options
+        self.partition_keys = table_schema.partition_keys
+        self.path_factory = FileStorePathFactory(
+            table_path, self.partition_keys,
+            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.kv_writer = KeyValueFileWriter(
+            file_io, self.path_factory, table_schema,
+            file_format=options.file_format,
+            compression=options.file_compression,
+            target_file_size=options.target_file_size)
+        rt = table_schema.logical_row_type()
+        self.total_buckets = options.bucket
+        bucket_keys = table_schema.bucket_keys()
+        self.bucket_assigner = FixedBucketAssigner(
+            bucket_keys, [rt.get_field(k).type for k in bucket_keys],
+            max(1, options.bucket))
+        from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+        from paimon_tpu.types import data_type_to_arrow
+        self.key_encoder = NormalizedKeyEncoder(
+            [data_type_to_arrow(rt.get_field(k).type)
+             for k in table_schema.trimmed_primary_keys()])
+        self._writers: Dict[Tuple, _BucketWriter] = {}
+        self._restore_max_seq = restore_max_seq
+        self.changelog_input = (
+            options.changelog_producer == "input")
+        self._changelog_counter = 0
+
+    # -- seam for restore (reference operation/WriteRestore.java) ------------
+
+    def restore_max_seq(self, partition: Tuple, bucket: int) -> int:
+        if self._restore_max_seq is None:
+            return -1
+        return self._restore_max_seq(partition, bucket)
+
+    def write_changelog(self, partition: Tuple, bucket: int,
+                        table: pa.Table) -> List[DataFileMeta]:
+        from paimon_tpu.format import get_format
+        fmt = get_format(self.options.file_format)
+        name = self.path_factory.new_changelog_file_name(fmt.extension)
+        path = self.path_factory.data_file_path(partition, bucket, name)
+        size = fmt.create_writer(self.options.file_compression).write(
+            self.file_io, path, table)
+        import pyarrow.compute as pc
+        return [DataFileMeta(
+            file_name=name, file_size=size, row_count=table.num_rows,
+            min_key=b"", max_key=b"",
+            key_stats=SimpleStats.EMPTY,
+            value_stats=SimpleStats.EMPTY,
+            min_sequence_number=pc.min(table.column(SEQ_COL)).as_py(),
+            max_sequence_number=pc.max(table.column(SEQ_COL)).as_py(),
+            schema_id=self.schema.id, level=0)]
+
+    # -- writes --------------------------------------------------------------
+
+    def write_arrow(self, table: pa.Table,
+                    row_kinds: Optional[np.ndarray] = None):
+        """Write a batch of rows (full table schema). Optional `row_kinds`
+        int8[N] (RowKind codes); a `_ROW_KIND` column is also honored."""
+        if ROW_KIND_COL in table.column_names:
+            row_kinds = np.asarray(table.column(ROW_KIND_COL)
+                                   .combine_chunks().cast(pa.int8()))
+            table = table.drop_columns([ROW_KIND_COL])
+        if row_kinds is None:
+            row_kinds = np.zeros(table.num_rows, dtype=np.int8)
+        row_kinds = np.asarray(row_kinds, dtype=np.int8)
+
+        buckets = self.bucket_assigner.assign(table)
+        group_codes = [buckets]
+        part_dicts = []
+        for pk in self.partition_keys:
+            enc = table.column(pk).combine_chunks().dictionary_encode()
+            part_dicts.append(enc.dictionary)
+            group_codes.append(np.asarray(enc.indices))
+        if len(group_codes) == 1:
+            labels = buckets
+            uniq, inverse = np.unique(labels, return_inverse=True)
+            groups = [((), int(b)) for b in uniq]
+        else:
+            stacked = np.stack(group_codes, axis=1)
+            uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+            groups = []
+            for row in uniq:
+                part = tuple(part_dicts[i][int(row[i + 1])].as_py()
+                             for i in range(len(self.partition_keys)))
+                groups.append((part, int(row[0])))
+
+        order = np.argsort(inverse, kind="stable")
+        bounds = np.searchsorted(inverse[order],
+                                 np.arange(len(groups) + 1))
+        for gi, (part, bucket) in enumerate(groups):
+            idx = order[bounds[gi]:bounds[gi + 1]]
+            sub = table.take(pa.array(idx))
+            kinds = row_kinds[idx]
+            self._writer(part, bucket).write(sub, kinds)
+
+    def _writer(self, partition: Tuple, bucket: int) -> _BucketWriter:
+        key = (partition, bucket)
+        if key not in self._writers:
+            self._writers[key] = _BucketWriter(self, partition, bucket)
+        return self._writers[key]
+
+    def prepare_commit(self) -> List[CommitMessage]:
+        out = []
+        for w in self._writers.values():
+            msg = w.prepare_commit()
+            if msg is not None:
+                out.append(msg)
+        return out
+
+    def close(self):
+        self._writers.clear()

@@ -1,0 +1,9 @@
+"""Table indexes: deletion vectors, dynamic-bucket hash index, file
+indexes (bloom/bitmap).
+
+reference: paimon-core/.../deletionvectors/, index/, fileindex/.
+"""
+
+from paimon_tpu.index.deletion_vector import (  # noqa: F401
+    DeletionVector, DeletionVectorsIndexFile, read_deletion_vectors,
+)

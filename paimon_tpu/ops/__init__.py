@@ -1,0 +1,28 @@
+"""Device compute kernels (the TPU execution core).
+
+This package replaces the reference's record-at-a-time merge machinery --
+LoserTree (mergetree/compact/LoserTree.java:45), SortMergeReader
+(SortMergeReaderWithLoserTree.java:34), MergeFunction implementations, and
+Janino-generated comparators (paimon-codegen) -- with XLA-compiled
+data-parallel kernels:
+
+- normkey: memcmp-order-preserving key normalization into uint32 lanes
+  (the BinaryRow "normalized key" idea, vectorized)
+- merge: k-way sorted-run merge as one stable device sort over
+  (key lanes, sequence) + segmented winner/reduce selection per merge
+  engine; returns take-indices applied to Arrow on the host
+
+Design notes: all kernels use static shapes (inputs padded to bucketized
+sizes), uint32 lanes (TPU-native; 64-bit values split hi/lo), and
+jnp-only control flow so XLA can fuse and tile freely.
+"""
+
+import jax as _jax
+
+# BIGINT columns aggregate in 64-bit (sum/max of int64 values); without
+# x64, jax silently truncates to int32. TPU emulates int64 on the VPU --
+# acceptable: the hot sort path uses uint32 lanes regardless.
+_jax.config.update("jax_enable_x64", True)
+
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder  # noqa: F401
+from paimon_tpu.ops.merge import merge_runs, MergeResult  # noqa: F401

@@ -1,0 +1,294 @@
+"""Segmented-reduce merge engines: aggregation and partial-update.
+
+reference: mergetree/compact/PartialUpdateMergeFunction.java,
+AggregateMergeFunction + 24 FieldAggregators (mergetree/compact/aggregate/).
+
+The record-at-a-time accumulate loop becomes: device sort by (key, seq)
+(shared kernel in ops/merge.py) -> per-key segment ids -> per-column
+segmented reduction. Numeric sum/max/min/count/product run on device via
+jax.ops.segment_*; order-based aggregates (last/first[-non-null] value,
+listagg, strings) reduce to a per-segment index selection computed on
+device and a host-side Arrow take, so variable-length data never crosses
+to HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from paimon_tpu.options import CoreOptions, MergeEngine
+from paimon_tpu.ops.merge import (
+    KIND_COL, SEQ_COL, device_sorted_winners,
+)
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.types import RowKind
+
+__all__ = ["merge_runs_agg", "field_aggregators"]
+
+_NUMERIC_DEVICE_AGGS = {"sum", "max", "min", "product", "count"}
+
+
+def field_aggregators(schema: TableSchema,
+                      options: CoreOptions) -> Dict[str, str]:
+    """Resolve per-field aggregate function from options
+    (`fields.<name>.aggregate-function`), reference
+    CoreOptions.fieldAggFunc."""
+    default = options.options.get_or("fields.default-aggregate-function",
+                                     None)
+    engine = options.merge_engine
+    out = {}
+    pk = set(schema.primary_keys)
+    for f in schema.fields:
+        if f.name in pk:
+            continue
+        func = options.options.get_or(
+            f"fields.{f.name}.aggregate-function", None)
+        if func is None:
+            if engine == MergeEngine.PARTIAL_UPDATE:
+                func = "last_non_null_value"
+            else:
+                func = default or "last_non_null_value"
+        out[f.name] = func
+    return out
+
+
+def sequence_groups(schema: TableSchema,
+                    options: CoreOptions) -> Dict[str, List[str]]:
+    """`fields.<a,b>.sequence-group = c,d` -> {seq_field_key: [cols]}
+    (reference PartialUpdateMergeFunction sequence groups)."""
+    groups = {}
+    for key in options.options.keys():
+        if key.startswith("fields.") and key.endswith(".sequence-group"):
+            seq_fields = key[len("fields."):-len(".sequence-group")]
+            cols = [c.strip()
+                    for c in options.options.get(key).split(",")]
+            groups[seq_fields] = cols
+    return groups
+
+
+def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray):
+    """Shared device sort -> (order over real rows, segment ids)."""
+    n = lanes.shape[0]
+    perm, winner, _ = device_sorted_winners(lanes, seq, "last")
+    real = perm < n
+    order = perm[real].astype(np.int64)
+    win_sorted = winner[real]
+    seg_end = win_sorted.copy()
+    if len(seg_end):
+        seg_end[-1] = True
+    seg_id = np.concatenate([[0], np.cumsum(seg_end[:-1])]) \
+        if len(seg_end) else np.zeros(0, np.int64)
+    return order, seg_id.astype(np.int64), win_sorted
+
+
+@jax.jit
+def _seg_sum(vals, seg_ids, num_seg):
+    return jax.ops.segment_sum(vals, seg_ids, num_segments=num_seg)
+
+
+@jax.jit
+def _seg_max(vals, seg_ids, num_seg):
+    return jax.ops.segment_max(vals, seg_ids, num_segments=num_seg)
+
+
+@jax.jit
+def _seg_min(vals, seg_ids, num_seg):
+    return jax.ops.segment_min(vals, seg_ids, num_segments=num_seg)
+
+
+@jax.jit
+def _seg_prod(vals, seg_ids, num_seg):
+    return jax.ops.segment_prod(vals, seg_ids, num_segments=num_seg)
+
+
+def _last_index_where(mask: np.ndarray, seg_id: np.ndarray,
+                      num_seg: int) -> np.ndarray:
+    """Per segment, the position (into sorted order) of the last True;
+    -1 if none. Vectorized with segment_max over masked positions."""
+    pos = np.arange(len(mask), dtype=np.int64)
+    masked = np.where(mask, pos, -1)
+    out = np.asarray(_seg_max(jnp.asarray(masked), jnp.asarray(seg_id),
+                              num_seg))
+    return out
+
+
+def _first_index_where(mask: np.ndarray, seg_id: np.ndarray,
+                       num_seg: int) -> np.ndarray:
+    n = len(mask)
+    pos = np.arange(n, dtype=np.int64)
+    masked = np.where(mask, pos, n + 1)
+    out = np.asarray(_seg_min(jnp.asarray(masked), jnp.asarray(seg_id),
+                              num_seg))
+    return np.where(out > n, -1, out)
+
+
+_JAX_NUMERIC = {
+    pa.int8(): np.int32, pa.int16(): np.int32, pa.int32(): np.int64,
+    pa.int64(): np.int64, pa.float32(): np.float32,
+    pa.float64(): np.float64, pa.bool_(): np.int32,
+}
+
+
+def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
+                   schema: TableSchema, options: CoreOptions,
+                   key_encoder: Optional[NormalizedKeyEncoder] = None
+                   ) -> pa.Table:
+    """Merge runs under aggregation / partial-update semantics.
+    Returns a KV-shaped table (keys + sys cols + aggregated values),
+    sorted by key."""
+    table = pa.concat_tables(runs, promote_options="none")
+    n = table.num_rows
+    if n == 0:
+        return table
+    if key_encoder is None:
+        key_encoder = NormalizedKeyEncoder(
+            [table.schema.field(k).type for k in key_cols])
+    lanes, truncated = key_encoder.encode_table(table, key_cols)
+    if truncated.any():
+        raise NotImplementedError(
+            "aggregation merge with >prefix string keys not supported yet; "
+            "raise tpu.key-prefix-lanes")
+    seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
+    order, seg_id, win_sorted = _segment_ids_from_sort(lanes, seq)
+    num_seg = int(seg_id[-1]) + 1 if len(seg_id) else 0
+    win_pos = np.flatnonzero(win_sorted)           # last row of each segment
+
+    sorted_tbl = table.take(pa.array(order))
+    kinds_sorted = np.asarray(sorted_tbl.column(KIND_COL).combine_chunks()
+                              .cast(pa.int8()))
+    retract = (kinds_sorted == RowKind.DELETE) | \
+              (kinds_sorted == RowKind.UPDATE_BEFORE)
+
+    aggs = field_aggregators(schema, options)
+    remove_on_delete = options.options.get_or(
+        "partial-update.remove-record-on-delete", "false") == "true"
+
+    out_cols: Dict[str, pa.Array] = {}
+    # keys + sequence + kind from the segment winner row
+    for name in list(key_cols) + [SEQ_COL, KIND_COL]:
+        out_cols[name] = sorted_tbl.column(name).take(pa.array(win_pos))
+
+    add_mask = ~retract
+    for f in schema.fields:
+        name = f.name
+        col_sorted = sorted_tbl.column(name)
+        if name not in aggs:   # key column: winner value
+            out_cols[name] = col_sorted.take(pa.array(win_pos))
+            continue
+        func = aggs[name]
+        valid = np.asarray(pc.is_valid(col_sorted.combine_chunks()))
+        if func in _NUMERIC_DEVICE_AGGS and \
+                col_sorted.type in _JAX_NUMERIC:
+            np_dtype = _JAX_NUMERIC[col_sorted.type]
+            vals = np.asarray(col_sorted.combine_chunks()
+                              .fill_null(0)).astype(np_dtype)
+            contrib_mask = valid & add_mask
+            if func == "count":
+                dev = _seg_sum(jnp.asarray(contrib_mask.astype(np.int64)),
+                               jnp.asarray(seg_id), num_seg)
+                result = np.asarray(dev)
+                out_cols[name] = pa.array(result, pa.int64())
+                continue
+            if func == "sum":
+                signed = np.where(retract, -vals, vals)
+                signed = np.where(valid, signed, 0)
+                dev = _seg_sum(jnp.asarray(signed), jnp.asarray(seg_id),
+                               num_seg)
+                result = np.asarray(dev)
+                any_valid = np.asarray(_seg_max(
+                    jnp.asarray(valid.astype(np.int32)),
+                    jnp.asarray(seg_id), num_seg)) > 0
+                out_cols[name] = pa.array(
+                    [result[i].item() if any_valid[i] else None
+                     for i in range(num_seg)], col_sorted.type)
+                continue
+            if func in ("max", "min", "product"):
+                ident = {"max": _np_min_ident(np_dtype),
+                         "min": _np_max_ident(np_dtype),
+                         "product": np_dtype(1)}[func]
+                masked = np.where(valid & add_mask, vals, ident)
+                dev = {"max": _seg_max, "min": _seg_min,
+                       "product": _seg_prod}[func](
+                    jnp.asarray(masked), jnp.asarray(seg_id), num_seg)
+                result = np.asarray(dev)
+                any_valid = np.asarray(_seg_max(
+                    jnp.asarray((valid & add_mask).astype(np.int32)),
+                    jnp.asarray(seg_id), num_seg)) > 0
+                out_cols[name] = pa.array(
+                    [result[i].item() if any_valid[i] else None
+                     for i in range(num_seg)], col_sorted.type)
+                continue
+        # order-based aggregates: pick an index per segment, host gather
+        if func == "last_non_null_value":
+            idx = _last_index_where(valid & add_mask, seg_id, num_seg)
+        elif func == "last_value":
+            idx = _last_index_where(add_mask, seg_id, num_seg)
+        elif func == "first_non_null_value":
+            idx = _first_index_where(valid & add_mask, seg_id, num_seg)
+        elif func == "first_value":
+            idx = _first_index_where(add_mask, seg_id, num_seg)
+        elif func == "listagg":
+            out_cols[name] = _listagg(col_sorted, valid & add_mask, seg_id,
+                                      num_seg, options, name)
+            continue
+        elif func in ("bool_and", "bool_or"):
+            vals = np.asarray(col_sorted.combine_chunks()
+                              .fill_null(func == "bool_and"))
+            masked = vals if func == "bool_or" else vals | ~(valid & add_mask)
+            if func == "bool_or":
+                masked = vals & (valid & add_mask)
+            dev = (_seg_max if func == "bool_or" else _seg_min)(
+                jnp.asarray(masked.astype(np.int32)), jnp.asarray(seg_id),
+                num_seg)
+            out_cols[name] = pa.array(np.asarray(dev).astype(bool),
+                                      pa.bool_())
+            continue
+        else:
+            raise ValueError(f"Unknown aggregate function {func!r} "
+                             f"for field {name}")
+        taken = col_sorted.take(pa.array(np.where(idx < 0, 0, idx)))
+        nulls = pa.array(idx < 0)
+        out_cols[name] = pc.if_else(nulls, pa.nulls(num_seg, taken.type),
+                                    taken.combine_chunks())
+
+    out = pa.table(out_cols)
+    # delete handling: drop segments whose winner is a retract
+    winner_kinds = np.asarray(out.column(KIND_COL).combine_chunks()
+                              .cast(pa.int8()))
+    if options.merge_engine == MergeEngine.PARTIAL_UPDATE \
+            and not remove_on_delete:
+        return out  # deletes ignored (retracts folded per column)
+    drop = (winner_kinds == RowKind.DELETE)
+    if drop.any():
+        out = out.filter(pa.array(~drop))
+    return out
+
+
+def _listagg(col_sorted, mask, seg_id, num_seg, options, name):
+    sep = options.options.get_or(f"fields.{name}.list-agg-delimiter", ",")
+    vals = col_sorted.to_pylist()
+    acc: List[Optional[str]] = [None] * num_seg
+    for i in np.flatnonzero(mask):
+        s = vals[i]
+        g = seg_id[i]
+        acc[g] = s if acc[g] is None else acc[g] + sep + s
+    return pa.array(acc, pa.string())
+
+
+def _np_min_ident(dt):
+    if np.issubdtype(dt, np.integer):
+        return np.iinfo(dt).min
+    return dt(-np.inf)
+
+
+def _np_max_ident(dt):
+    if np.issubdtype(dt, np.integer):
+        return np.iinfo(dt).max
+    return dt(np.inf)

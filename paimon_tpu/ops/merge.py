@@ -1,0 +1,278 @@
+"""K-way sorted-run merge on device.
+
+Replaces the reference's per-record loser tree
+(mergetree/compact/SortMergeReaderWithLoserTree.java:34, LoserTree.java:45)
+and merge functions with one data-parallel plan:
+
+1. concatenate the k runs oldest-first (keeps input order for stable ties),
+2. stable device sort by (validity, key lanes..., seq_hi, seq_lo)
+   -- jax.lax.sort lexicographic keys; O(N log N) on the VPU but with
+   ~10^3-way parallelism it beats a scalar tournament tree by orders of
+   magnitude,
+3. segmented winner selection: neighbor-equality mask over sorted lanes
+   gives per-key segments; deduplicate keeps the last row of each segment
+   (max sequence; stability resolves equal sequences by arrival order),
+   first-row keeps the first,
+4. return take-indices into the concatenated input; the host applies them
+   to the Arrow table (variable-length values never touch the device).
+
+Static shapes: inputs are padded to the next power of two; padding rows
+carry validity=1 which sorts after all real rows and never joins a segment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+from paimon_tpu.types import RowKind
+
+__all__ = ["merge_runs", "MergeResult", "device_sorted_winners",
+           "SEQ_COL", "KIND_COL"]
+
+SEQ_COL = "_SEQUENCE_NUMBER"
+KIND_COL = "_VALUE_KIND"
+
+
+@dataclass
+class MergeResult:
+    """Indices into the concatenated input table, in key order."""
+    table: pa.Table          # concatenated input (runs oldest-first)
+    indices: np.ndarray      # winners, sorted by key
+    # per-winner previous-version indices (for changelog), -1 if none
+    prev_indices: Optional[np.ndarray] = None
+
+    def take(self, columns: Optional[List[str]] = None) -> pa.Table:
+        t = self.table.select(columns) if columns else self.table
+        return t.take(pa.array(self.indices))
+
+
+def _pad_size(n: int) -> int:
+    if n <= 1024:
+        return 1024
+    return 1 << (n - 1).bit_length()
+
+
+@lru_cache(maxsize=64)
+def _merge_fn(num_lanes: int, keep: str):
+    """Build the jitted merge kernel for a lane count."""
+
+    @jax.jit
+    def fn(lanes, seq_hi, seq_lo, invalid):
+        n = invalid.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        operands = [invalid] + [lanes[i] for i in range(num_lanes)] \
+            + [seq_hi, seq_lo, iota]
+        sorted_ops = jax.lax.sort(operands, num_keys=num_lanes + 3,
+                                  is_stable=True)
+        s_invalid = sorted_ops[0]
+        s_lanes = sorted_ops[1:1 + num_lanes]
+        perm = sorted_ops[-1]
+
+        lanes_mat = jnp.stack(s_lanes)          # [L, N]
+        eq_next = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
+        eq_next = jnp.concatenate([eq_next, jnp.array([False])])
+        eq_prev = jnp.concatenate([jnp.array([False]), eq_next[:-1]])
+        valid = s_invalid == 0
+        # padding rows never match a real row because invalid is the
+        # leading sort key and differs
+        if keep == "last":
+            winner = (~eq_next) & valid
+        else:  # "first"
+            winner = (~eq_prev) & valid
+        # previous version of each winner: its predecessor within the same
+        # segment (highest-seq non-winner), for changelog derivation
+        prev_in_seg = jnp.where(eq_prev, jnp.roll(perm, 1), -1)
+        return perm, winner, prev_in_seg
+
+    return fn
+
+
+def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
+                          keep: str = "last"
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run the device kernel.
+
+    lanes: uint32[N, L]; seq: int64[N] (non-negative).
+    Returns (perm, winner_mask, prev_in_segment) as numpy arrays of the
+    padded size; caller slices by validity via winner mask.
+    """
+    n, num_lanes = lanes.shape
+    m = _pad_size(n)
+    lanes_p = np.full((m, num_lanes), 0, dtype=np.uint32)
+    lanes_p[:n] = lanes
+    useq = seq.astype(np.int64).view(np.uint64)
+    seq_hi = np.zeros(m, dtype=np.uint32)
+    seq_lo = np.zeros(m, dtype=np.uint32)
+    seq_hi[:n] = (useq >> np.uint64(32)).astype(np.uint32)
+    seq_lo[:n] = (useq & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    invalid = np.ones(m, dtype=np.uint32)
+    invalid[:n] = 0
+
+    fn = _merge_fn(num_lanes, keep)
+    lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
+    perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
+                            jnp.asarray(seq_lo), jnp.asarray(invalid))
+    return (np.asarray(perm), np.asarray(winner), np.asarray(prev))
+
+
+def sort_table(table: pa.Table, key_names: Sequence[str],
+               key_encoder: Optional[NormalizedKeyEncoder] = None
+               ) -> np.ndarray:
+    """Full sort permutation by (key, seq) -- used to lay out write-buffer
+    flushes when the merge engine defers merging to read time. Returns
+    indices into `table` in sorted order (stable: arrival order for ties)."""
+    n = table.num_rows
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    if key_encoder is None:
+        key_encoder = NormalizedKeyEncoder(
+            [table.schema.field(k).type for k in key_names])
+    lanes, truncated = key_encoder.encode_table(table, key_names)
+    seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
+    perm, _, _ = device_sorted_winners(lanes, seq, "last")
+    order = perm[perm < n].astype(np.int64)
+    if truncated.any():
+        # prefix ties may misorder full keys; host re-sort of affected rows
+        key_cols = [table.column(k) for k in key_names]
+
+        def full_key(i):
+            return tuple(c[int(i)].as_py() for c in key_cols)
+
+        order = np.array(
+            sorted(order.tolist(),
+                   key=lambda i: (full_key(i), int(seq[i]))),
+            dtype=np.int64)
+    return order
+
+
+def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
+               merge_engine: str = "deduplicate",
+               drop_deletes: bool = True,
+               key_encoder: Optional[NormalizedKeyEncoder] = None,
+               with_prev: bool = False) -> MergeResult:
+    """Merge k sorted runs (oldest first) into the latest row per key.
+
+    Equivalent reference path: MergeTreeReaders.readerForMergeTree
+    (mergetree/MergeTreeReaders.java:44) + DeduplicateMergeFunction /
+    FirstRowMergeFunction + DropDeleteReader.
+    """
+    if not runs:
+        raise ValueError("No runs to merge")
+    table = pa.concat_tables(runs, promote_options="none")
+    n = table.num_rows
+    if n == 0:
+        return MergeResult(table, np.zeros(0, dtype=np.int64))
+
+    if key_encoder is None:
+        key_encoder = NormalizedKeyEncoder(
+            [table.schema.field(k).type for k in key_names])
+    lanes, truncated = key_encoder.encode_table(table, key_names)
+    seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
+
+    keep = "first" if merge_engine == "first-row" else "last"
+    perm, winner, prev = device_sorted_winners(lanes, seq, keep)
+
+    win_pos = np.flatnonzero(winner)
+    indices = perm[win_pos].astype(np.int64)
+    prev_idx = prev[win_pos].astype(np.int64) if with_prev else None
+
+    if truncated.any():
+        indices, prev_idx = _refine_truncated(
+            table, key_names, perm, winner, truncated, seq, keep,
+            with_prev, prev)
+
+    if drop_deletes and KIND_COL in table.column_names:
+        kinds = np.asarray(table.column(KIND_COL).combine_chunks()
+                           .cast(pa.int8()))
+        keep_mask = (kinds[indices] == RowKind.INSERT) | \
+                    (kinds[indices] == RowKind.UPDATE_AFTER)
+        indices = indices[keep_mask]
+        if prev_idx is not None:
+            prev_idx = prev_idx[keep_mask]
+
+    return MergeResult(table, indices, prev_idx)
+
+
+def _refine_truncated(table: pa.Table, key_names, perm, winner, truncated,
+                      seq, keep: str, with_prev: bool, prev=None):
+    """Host fallback for prefix-truncated string keys: rows whose prefix
+    collided may belong to different real keys, so device segments can
+    over-group. Only the sorted spans that contain a truncated row are
+    re-grouped by full key on the host; all other winners keep the device
+    result. Rare path (keys longer than the prefix sharing a prefix)."""
+    n = len(seq)
+    winner = np.asarray(winner)
+    sorted_real_mask = perm < n
+    sorted_real = perm[sorted_real_mask]              # sorted positions
+    win_sorted = winner[sorted_real_mask]
+    s_trunc = truncated[sorted_real]
+
+    # segment spans in sorted order: a span ends at each winner/last-of-
+    # segment boundary for keep="last"; reconstruct spans via winner mask
+    # (device winners mark segment boundaries regardless of keep by
+    # construction when keep == "last"; for "first" they mark starts).
+    m = len(sorted_real)
+    if keep == "last":
+        seg_end = win_sorted.copy()
+        seg_end[-1] = True
+        seg_id = np.concatenate([[0], np.cumsum(seg_end[:-1])])
+    else:
+        seg_start = win_sorted.copy()
+        seg_start[0] = True
+        seg_id = np.cumsum(seg_start) - 1
+
+    # spans affected by truncation
+    affected_segs = set(np.unique(seg_id[s_trunc]).tolist())
+    if not affected_segs:
+        win_pos = np.flatnonzero(winner)
+        prev_idx = (np.asarray(prev)[win_pos].astype(np.int64)
+                    if with_prev and prev is not None else None)
+        return (perm[win_pos].astype(np.int64), prev_idx)
+
+    key_cols = [table.column(k) for k in key_names]
+
+    def full_key(i: int):
+        return tuple(c[int(i)].as_py() for c in key_cols)
+
+    idx_out: List[int] = []
+    prev_out: List[int] = []
+    i = 0
+    while i < m:
+        sid = seg_id[i]
+        j = i
+        while j < m and seg_id[j] == sid:
+            j += 1
+        span = sorted_real[i:j]
+        if sid not in affected_segs:
+            for p, w in zip(span, win_sorted[i:j]):
+                if w:
+                    idx_out.append(int(p))
+                    if with_prev:
+                        # predecessor within span
+                        pos = list(span).index(p)
+                        prev_out.append(int(span[pos - 1]) if pos > 0 else -1)
+        else:
+            # re-group by full key; span order is (prefix, seq) so within a
+            # real key rows remain seq-ordered
+            groups: dict = {}
+            for p in span:
+                groups.setdefault(full_key(p), []).append(int(p))
+            for k in sorted(groups):
+                g = groups[k]
+                if keep == "last":
+                    idx_out.append(g[-1])
+                    prev_out.append(g[-2] if len(g) > 1 else -1)
+                else:
+                    idx_out.append(g[0])
+                    prev_out.append(-1)
+        i = j
+    return (np.array(idx_out, dtype=np.int64),
+            np.array(prev_out, dtype=np.int64) if with_prev else None)

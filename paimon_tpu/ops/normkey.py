@@ -1,0 +1,168 @@
+"""Normalized keys: order-preserving fixed-width encoding of key columns.
+
+The reference compares keys via codegen'd comparators over BinaryRow's
+memcmp-comparable layout (paimon-common/.../codegen NormalizedKeyComputer,
+sort/BinaryIndexedSortable). On TPU we need keys as fixed-width vector
+lanes instead: each row's key becomes L uint32 lanes such that
+lexicographic lane comparison == key comparison.
+
+Encodings (all big-endian style, most-significant lane first):
+- signed ints: value XOR sign bit -> unsigned of same width
+- floats: IEEE total order trick (negative -> flip all bits, else flip
+  sign bit)
+- strings/bytes: first `prefix_bytes` bytes as big-endian lanes, zero
+  padded; a `truncated` flag marks rows whose key exceeded the prefix, so
+  callers can resolve rare prefix-equal ties on the host
+- date/time/timestamp: underlying ints
+
+Null ordering: nulls-last via a leading presence bit folded into the first
+lane of each column (primary keys are NOT NULL, but sort/cluster keys may
+be nullable).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+__all__ = ["NormalizedKeyEncoder"]
+
+
+def _ints_to_u64(arr: np.ndarray) -> np.ndarray:
+    """Signed int array -> order-preserving uint64."""
+    a = arr.astype(np.int64)
+    return (a.view(np.uint64) ^ np.uint64(1 << 63))
+
+
+def _floats_to_u64(arr: np.ndarray) -> np.ndarray:
+    a = arr.astype(np.float64)
+    bits = a.view(np.uint64)
+    neg = bits >> np.uint64(63) != 0
+    out = np.where(neg, ~bits, bits ^ np.uint64(1 << 63))
+    return out
+
+
+def _split_u64(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    return ((x >> np.uint64(32)).astype(np.uint32),
+            (x & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+class NormalizedKeyEncoder:
+    """Encodes the key columns of Arrow batches into uint32 lane matrices."""
+
+    def __init__(self, key_types: Sequence[pa.DataType],
+                 string_prefix_bytes: int = 16):
+        self.key_types = list(key_types)
+        self.string_prefix_bytes = ((string_prefix_bytes + 7) // 8) * 8
+        self.lanes_per_col: List[int] = []
+        self._kinds: List[str] = []
+        for t in self.key_types:
+            if pa.types.is_integer(t) or pa.types.is_date(t) \
+                    or pa.types.is_time(t) or pa.types.is_timestamp(t) \
+                    or pa.types.is_boolean(t):
+                self._kinds.append("int")
+                self.lanes_per_col.append(2)
+            elif pa.types.is_floating(t):
+                self._kinds.append("float")
+                self.lanes_per_col.append(2)
+            elif pa.types.is_decimal(t):
+                self._kinds.append("decimal")
+                self.lanes_per_col.append(2)
+            elif (pa.types.is_string(t) or pa.types.is_large_string(t)
+                  or pa.types.is_binary(t) or pa.types.is_large_binary(t)):
+                self._kinds.append("bytes")
+                self.lanes_per_col.append(self.string_prefix_bytes // 4)
+            else:
+                raise ValueError(f"Unsupported key type {t}")
+
+    @property
+    def num_lanes(self) -> int:
+        return sum(self.lanes_per_col)
+
+    def encode_columns(self, columns: Sequence[pa.ChunkedArray],
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (lanes uint32[N, num_lanes], truncated bool[N])."""
+        assert len(columns) == len(self.key_types)
+        n = len(columns[0]) if columns else 0
+        lanes = np.zeros((n, self.num_lanes), dtype=np.uint32)
+        truncated = np.zeros(n, dtype=bool)
+        lane_pos = 0
+        for col, kind, nl, t in zip(columns, self._kinds, self.lanes_per_col,
+                                    self.key_types):
+            arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
+                else col
+            null_mask = np.asarray(arr.is_null())
+            if kind == "int":
+                vals = np.asarray(
+                    arr.cast(pa.int64()).fill_null(0))
+                u = _ints_to_u64(vals)
+                hi, lo = _split_u64(u)
+                lanes[:, lane_pos] = hi
+                lanes[:, lane_pos + 1] = lo
+            elif kind == "float":
+                vals = np.asarray(arr.cast(pa.float64()).fill_null(0))
+                hi, lo = _split_u64(_floats_to_u64(vals))
+                lanes[:, lane_pos] = hi
+                lanes[:, lane_pos + 1] = lo
+            elif kind == "decimal":
+                # scale-preserving: compare by unscaled value (same scale
+                # within a column)
+                vals = np.array(
+                    [0 if v is None else int(v.scaleb(t.scale))
+                     for v in arr.to_pylist()], dtype=np.int64)
+                hi, lo = _split_u64(_ints_to_u64(vals))
+                lanes[:, lane_pos] = hi
+                lanes[:, lane_pos + 1] = lo
+            else:  # bytes
+                trunc_col = self._encode_bytes(arr, lanes, lane_pos, nl)
+                truncated |= trunc_col
+            if null_mask.any():
+                # nulls-last: set all lanes to max for null rows
+                lanes[null_mask, lane_pos:lane_pos + nl] = np.uint32(
+                    0xFFFFFFFF)
+            lane_pos += nl
+        return lanes, truncated
+
+    def _encode_bytes(self, arr: pa.Array, lanes: np.ndarray, lane_pos: int,
+                      nl: int) -> np.ndarray:
+        pb = self.string_prefix_bytes
+        if pa.types.is_string(arr.type) or pa.types.is_large_string(arr.type):
+            arr = arr.cast(pa.binary())
+        arr = arr.cast(pa.large_binary())
+        # vectorized: buffer + offsets
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        offsets = np.asarray(arr.buffers()[1]).view(np.int64)
+        data = np.frombuffer(arr.buffers()[2], dtype=np.uint8) \
+            if arr.buffers()[2] is not None else np.zeros(0, np.uint8)
+        n = len(arr)
+        starts = offsets[:-1]
+        ends = offsets[1:]
+        lengths = ends - starts
+        truncated = lengths > pb
+        # gather first pb bytes of each value, zero-padded
+        take = np.minimum(lengths, pb)
+        padded = np.zeros((n, pb), dtype=np.uint8)
+        # index matrix trick: for each row, positions starts[i]..starts[i]+take[i]
+        col_idx = np.arange(pb)[None, :]
+        src_idx = starts[:, None] + col_idx
+        valid = col_idx < take[:, None]
+        src_idx = np.where(valid, src_idx, 0)
+        if len(data):
+            padded = np.where(valid, data[src_idx], 0).astype(np.uint8)
+        # big-endian u32 lanes
+        as_u32 = padded.reshape(n, pb // 4, 4)
+        lanes_col = (as_u32[:, :, 0].astype(np.uint32) << 24) | \
+                    (as_u32[:, :, 1].astype(np.uint32) << 16) | \
+                    (as_u32[:, :, 2].astype(np.uint32) << 8) | \
+                    as_u32[:, :, 3].astype(np.uint32)
+        lanes[:, lane_pos:lane_pos + nl] = lanes_col
+        return truncated
+
+    def encode_table(self, table: pa.Table,
+                     key_names: Sequence[str]) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+        cols = [table.column(n) for n in key_names]
+        return self.encode_columns(cols)

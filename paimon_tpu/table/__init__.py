@@ -1,0 +1,10 @@
+"""Table API (user-facing).
+
+reference: paimon-core/.../table/ (FileStoreTable, ReadBuilder,
+BatchWriteBuilder/StreamWriteBuilder, TableWriteImpl, TableCommitImpl).
+"""
+
+from paimon_tpu.table.table import (  # noqa: F401
+    FileStoreTable, BatchWriteBuilder, ReadBuilder, TableWrite, TableCommit,
+    TableRead, TableScan,
+)

@@ -1,0 +1,368 @@
+"""FileStoreTable and its read/write builders.
+
+reference: table/FileStoreTable.java, table/source/ReadBuilderImpl.java:49
+(newScan:190, newRead:241), table/sink/BatchWriteBuilder.java,
+TableWriteImpl.java:54, TableCommitImpl.java:78.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.core.commit import FileStoreCommit
+from paimon_tpu.core.read import MergeFileSplitRead
+from paimon_tpu.core.scan import DataSplit, FileStoreScan, ScanPlan
+from paimon_tpu.core.write import CommitMessage, KeyValueFileStoreWrite
+from paimon_tpu.fs import FileIO, get_file_io
+from paimon_tpu.options import CoreOptions, Options
+from paimon_tpu.predicate import Predicate
+from paimon_tpu.schema.schema import Schema
+from paimon_tpu.schema.schema_manager import SchemaManager
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.snapshot import (
+    BranchManager, ConsumerManager, Snapshot, SnapshotManager, TagManager,
+)
+from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
+
+__all__ = ["FileStoreTable", "BatchWriteBuilder", "ReadBuilder",
+           "TableWrite", "TableCommit", "TableRead", "TableScan"]
+
+
+class FileStoreTable:
+    """A table backed by the file store at `path`."""
+
+    def __init__(self, file_io: FileIO, path: str,
+                 table_schema: TableSchema,
+                 dynamic_options: Optional[Dict[str, str]] = None,
+                 branch: str = "main"):
+        self.file_io = file_io
+        self.path = path.rstrip("/")
+        opts = dict(table_schema.options)
+        if dynamic_options:
+            opts.update({k: str(v) for k, v in dynamic_options.items()})
+        self.schema = table_schema.copy(opts) \
+            if dynamic_options else table_schema
+        self.options = CoreOptions(Options(opts))
+        self.branch = branch if branch != "main" else self.options.branch
+        self.snapshot_manager = SnapshotManager(file_io, self.path,
+                                                self.branch)
+        self.schema_manager = SchemaManager(file_io, self.path, self.branch)
+        self.tag_manager = TagManager(file_io, self.path)
+        self.branch_manager = BranchManager(file_io, self.path)
+        self.consumer_manager = ConsumerManager(file_io, self.path)
+
+    # -- creation / loading --------------------------------------------------
+
+    @staticmethod
+    def create(path: str, schema: Schema,
+               file_io: Optional[FileIO] = None) -> "FileStoreTable":
+        fio = file_io or get_file_io(path)
+        ts = SchemaManager(fio, path).create_table(schema)
+        return FileStoreTable(fio, path, ts)
+
+    @staticmethod
+    def load(path: str, file_io: Optional[FileIO] = None,
+             dynamic_options: Optional[Dict[str, str]] = None
+             ) -> "FileStoreTable":
+        fio = file_io or get_file_io(path)
+        branch = "main"
+        if dynamic_options and "branch" in dynamic_options:
+            branch = dynamic_options["branch"]
+        ts = SchemaManager(fio, path, branch).latest()
+        if ts is None:
+            raise FileNotFoundError(f"No table at {path}")
+        return FileStoreTable(fio, path, ts, dynamic_options, branch)
+
+    def copy(self, dynamic_options: Dict[str, str]) -> "FileStoreTable":
+        base = self.schema_manager.latest()
+        return FileStoreTable(self.file_io, self.path, base,
+                              dynamic_options, self.branch)
+
+    # -- metadata ------------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.path.rstrip("/").split("/")[-1]
+
+    @property
+    def primary_keys(self) -> List[str]:
+        return self.schema.primary_keys
+
+    @property
+    def partition_keys(self) -> List[str]:
+        return self.schema.partition_keys
+
+    def row_type(self):
+        return self.schema.logical_row_type()
+
+    def arrow_schema(self) -> pa.Schema:
+        return self.schema.to_arrow_schema()
+
+    def latest_snapshot(self) -> Optional[Snapshot]:
+        return self.snapshot_manager.latest_snapshot()
+
+    # -- builders ------------------------------------------------------------
+
+    def new_batch_write_builder(self) -> "BatchWriteBuilder":
+        return BatchWriteBuilder(self)
+
+    def new_read_builder(self) -> "ReadBuilder":
+        return ReadBuilder(self)
+
+    def new_scan(self) -> FileStoreScan:
+        return FileStoreScan(self.file_io, self.path, self.schema,
+                             self.options, self.branch)
+
+    # -- convenience ---------------------------------------------------------
+
+    def to_arrow(self, projection: Optional[List[str]] = None,
+                 predicate: Optional[Predicate] = None) -> pa.Table:
+        rb = self.new_read_builder()
+        if projection:
+            rb = rb.with_projection(projection)
+        if predicate is not None:
+            rb = rb.with_filter(predicate)
+        scan = rb.new_scan()
+        return rb.new_read().to_arrow(scan.plan().splits)
+
+    def compact(self, full: bool = False,
+                partition_filter: Optional[dict] = None) -> Optional[int]:
+        """Trigger compaction and commit the result
+        (reference flink CompactAction, but engine-free here)."""
+        from paimon_tpu.compact.compact_action import compact_table
+        return compact_table(self, full=full,
+                             partition_filter=partition_filter)
+
+    def create_tag(self, name: str, snapshot_id: Optional[int] = None):
+        snap = (self.snapshot_manager.snapshot(snapshot_id)
+                if snapshot_id is not None
+                else self.snapshot_manager.latest_snapshot())
+        if snap is None:
+            raise ValueError("Table has no snapshot to tag")
+        self.tag_manager.create_tag(snap, name)
+
+    def delete_tag(self, name: str):
+        self.tag_manager.delete_tag(name)
+
+    def create_branch(self, name: str, tag_name: Optional[str] = None):
+        snap = self.tag_manager.get_tag(tag_name) if tag_name else None
+        self.branch_manager.create_branch(name, from_snapshot=snap)
+
+    def delete_branch(self, name: str):
+        self.branch_manager.drop_branch(name)
+
+    def fast_forward(self, branch_name: str):
+        self.branch_manager.fast_forward(branch_name)
+
+    def rollback_to(self, snapshot_id: int):
+        """Delete snapshots newer than `snapshot_id`
+        (reference table/RollbackHelper.java)."""
+        latest = self.snapshot_manager.latest_snapshot_id()
+        if latest is None or snapshot_id > latest:
+            raise ValueError(f"Cannot rollback to {snapshot_id}")
+        if not self.snapshot_manager.snapshot_exists(snapshot_id):
+            raise ValueError(f"Snapshot {snapshot_id} does not exist")
+        for i in range(latest, snapshot_id, -1):
+            self.snapshot_manager.delete_snapshot(i)
+        self.snapshot_manager.commit_latest_hint(snapshot_id)
+
+
+class BatchWriteBuilder:
+    def __init__(self, table: FileStoreTable):
+        self.table = table
+        self.commit_user = str(uuid.uuid4())
+        self._overwrite: Optional[dict] = None
+        self._static_partition: Optional[dict] = None
+
+    def with_overwrite(self, static_partition: Optional[dict] = None
+                       ) -> "BatchWriteBuilder":
+        self._overwrite = static_partition or {}
+        return self
+
+    def new_write(self) -> "TableWrite":
+        return TableWrite(self.table, self.commit_user)
+
+    def new_commit(self) -> "TableCommit":
+        return TableCommit(self.table, self.commit_user, self._overwrite)
+
+
+class TableWrite:
+    def __init__(self, table: FileStoreTable, commit_user: str):
+        self.table = table
+        scan = table.new_scan()
+
+        def restore(partition: Tuple, bucket: int) -> int:
+            return scan.max_sequence_number(partition, bucket)
+
+        self._write = KeyValueFileStoreWrite(
+            table.file_io, table.path, table.schema, table.options,
+            restore_max_seq=restore)
+
+    def write_arrow(self, data: pa.Table,
+                    row_kinds: Optional[np.ndarray] = None):
+        self._write.write_arrow(data, row_kinds)
+
+    def write_pandas(self, df):
+        self.write_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+    def write_dicts(self, rows: Sequence[dict],
+                    row_kinds: Optional[Sequence[int]] = None):
+        schema = self.table.arrow_schema()
+        table = pa.Table.from_pylist(list(rows), schema=schema)
+        kinds = np.asarray(row_kinds, dtype=np.int8) \
+            if row_kinds is not None else None
+        self.write_arrow(table, kinds)
+
+    def prepare_commit(self) -> List[CommitMessage]:
+        return self._write.prepare_commit()
+
+    def close(self):
+        self._write.close()
+
+
+class TableCommit:
+    def __init__(self, table: FileStoreTable, commit_user: str,
+                 overwrite: Optional[dict] = None):
+        self.table = table
+        self._commit = FileStoreCommit(
+            table.file_io, table.path, table.schema, table.options,
+            commit_user=commit_user, branch=table.branch)
+        self._overwrite = overwrite
+
+    def commit(self, messages: Sequence[CommitMessage],
+               commit_identifier: int = BATCH_COMMIT_IDENTIFIER
+               ) -> Optional[int]:
+        if self._overwrite is not None:
+            return self._commit.overwrite(
+                messages, partition_filter=self._overwrite or None,
+                commit_identifier=commit_identifier)
+        return self._commit.commit(messages, commit_identifier)
+
+    def filter_committed(self, identifiers: Sequence[int]) -> List[int]:
+        return self._commit.filter_committed(identifiers)
+
+    def close(self):
+        pass
+
+
+class ReadBuilder:
+    """reference table/source/ReadBuilderImpl.java:49."""
+
+    def __init__(self, table: FileStoreTable):
+        self.table = table
+        self._projection: Optional[List[str]] = None
+        self._predicate: Optional[Predicate] = None
+        self._partition_filter: Optional[dict] = None
+        self._buckets: Optional[List[int]] = None
+        self._limit: Optional[int] = None
+
+    def with_projection(self, columns: List[str]) -> "ReadBuilder":
+        self._projection = list(columns)
+        return self
+
+    def with_filter(self, predicate: Predicate) -> "ReadBuilder":
+        self._predicate = predicate
+        return self
+
+    def with_partition_filter(self, spec: dict) -> "ReadBuilder":
+        self._partition_filter = spec
+        return self
+
+    def with_buckets(self, buckets: List[int]) -> "ReadBuilder":
+        self._buckets = buckets
+        return self
+
+    def with_limit(self, limit: int) -> "ReadBuilder":
+        self._limit = limit
+        return self
+
+    def new_scan(self) -> "TableScan":
+        return TableScan(self)
+
+    def new_stream_scan(self):
+        from paimon_tpu.table.stream_scan import DataTableStreamScan
+        return DataTableStreamScan(self)
+
+    def new_read(self) -> "TableRead":
+        return TableRead(self)
+
+    def read_type(self):
+        rt = self.table.row_type()
+        if self._projection:
+            return rt.project(self._projection)
+        return rt
+
+
+class TableScan:
+    def __init__(self, builder: ReadBuilder):
+        self.builder = builder
+        self._scan = builder.table.new_scan()
+        if builder._partition_filter:
+            self._scan.with_partition_filter(builder._partition_filter)
+        if builder._buckets:
+            self._scan.with_buckets(builder._buckets)
+        if builder._predicate is not None:
+            pk = set(builder.table.schema.trimmed_primary_keys())
+            fields = set(builder._predicate.fields())
+            if fields and fields <= pk:
+                self._scan.with_key_filter(builder._predicate)
+            else:
+                self._scan.with_value_filter(builder._predicate)
+
+    def plan(self, snapshot_id: Optional[int] = None,
+             tag_name: Optional[str] = None) -> ScanPlan:
+        table = self.builder.table
+        snapshot = None
+        opts = table.options
+        if tag_name is None:
+            tag_name = opts.get(CoreOptions.SCAN_TAG_NAME)
+        if snapshot_id is None:
+            snapshot_id = opts.get(CoreOptions.SCAN_SNAPSHOT_ID)
+        ts_millis = opts.get(CoreOptions.SCAN_TIMESTAMP_MILLIS)
+        if tag_name is not None:
+            snapshot = table.tag_manager.get_tag(tag_name)
+        elif snapshot_id is not None:
+            snapshot = table.snapshot_manager.snapshot(snapshot_id)
+        elif ts_millis is not None:
+            snapshot = table.snapshot_manager.earlier_or_equal_time_mills(
+                ts_millis)
+            if snapshot is None:
+                return ScanPlan(None, [])
+        return self._scan.plan(snapshot)
+
+
+class TableRead:
+    def __init__(self, builder: ReadBuilder):
+        self.builder = builder
+        table = builder.table
+        self._read = MergeFileSplitRead(
+            table.file_io, table.path, table.schema, table.options,
+            schema_manager=table.schema_manager)
+        if builder._projection:
+            self._read.with_projection(builder._projection)
+        if builder._predicate is not None:
+            self._read.with_filter(builder._predicate)
+
+    def read_split(self, split: DataSplit) -> pa.Table:
+        t = self._read.read_split(split)
+        return self._finalize(t)
+
+    def to_arrow(self, splits: Sequence[DataSplit]) -> pa.Table:
+        out = self._read.read_splits(splits)
+        return self._finalize(out)
+
+    def _finalize(self, t: pa.Table) -> pa.Table:
+        if self.builder._projection:
+            cols = [c for c in self.builder._projection
+                    if c in t.column_names]
+            t = t.select(cols)
+        if self.builder._limit is not None:
+            t = t.slice(0, self.builder._limit)
+        return t
+
+    def to_pandas(self, splits: Sequence[DataSplit]):
+        return self.to_arrow(splits).to_pandas()

@@ -1,0 +1,1 @@
+from paimon_tpu.utils.path_factory import FileStorePathFactory  # noqa: F401

@@ -1,0 +1,99 @@
+"""Path layout factory.
+
+reference: paimon-core/.../utils/FileStorePathFactory.java:55-240 and the
+on-disk layout in SURVEY.md §2.9 / docs spec:
+
+  <table>/<k1=v1/k2=v2/...>/bucket-<b>/data-<uuid>-<n>.<ext>
+  <table>/manifest/, snapshot/, schema/, index/, statistics/, changelog/
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, List, Optional, Sequence, Tuple
+
+__all__ = ["FileStorePathFactory"]
+
+DEFAULT_PARTITION_NAME = "__DEFAULT_PARTITION__"
+
+
+class FileStorePathFactory:
+    def __init__(self, table_path: str, partition_keys: Sequence[str],
+                 default_partition_name: str = DEFAULT_PARTITION_NAME,
+                 data_file_prefix: str = "data-",
+                 changelog_file_prefix: str = "changelog-"):
+        self.table_path = table_path.rstrip("/")
+        self.partition_keys = list(partition_keys)
+        self.default_partition_name = default_partition_name
+        self.data_file_prefix = data_file_prefix
+        self.changelog_file_prefix = changelog_file_prefix
+        self._write_uuid = str(uuid.uuid4())
+        self._counter = 0
+
+    # -- dirs ----------------------------------------------------------------
+
+    @property
+    def manifest_dir(self) -> str:
+        return f"{self.table_path}/manifest"
+
+    @property
+    def snapshot_dir(self) -> str:
+        return f"{self.table_path}/snapshot"
+
+    @property
+    def schema_dir(self) -> str:
+        return f"{self.table_path}/schema"
+
+    @property
+    def index_dir(self) -> str:
+        return f"{self.table_path}/index"
+
+    @property
+    def statistics_dir(self) -> str:
+        return f"{self.table_path}/statistics"
+
+    @property
+    def changelog_dir(self) -> str:
+        return f"{self.table_path}/changelog"
+
+    # -- partitions ----------------------------------------------------------
+
+    def partition_path(self, partition: Sequence[Any]) -> str:
+        """'k1=v1/k2=v2' spec string (reference PartitionPathUtils)."""
+        parts = []
+        for key, value in zip(self.partition_keys, partition):
+            if value is None or (isinstance(value, str)
+                                 and not value.strip()):
+                v = self.default_partition_name
+            else:
+                v = str(value)
+            parts.append(f"{key}={v}")
+        return "/".join(parts)
+
+    def bucket_dir(self, partition: Sequence[Any], bucket: int) -> str:
+        pp = self.partition_path(partition)
+        base = f"{self.table_path}/{pp}" if pp else self.table_path
+        return f"{base}/bucket-{bucket}"
+
+    def data_file_path(self, partition: Sequence[Any], bucket: int,
+                       file_name: str) -> str:
+        return f"{self.bucket_dir(partition, bucket)}/{file_name}"
+
+    # -- file names ----------------------------------------------------------
+
+    def new_data_file_name(self, extension: str = "parquet") -> str:
+        n = self._counter
+        self._counter += 1
+        return f"{self.data_file_prefix}{self._write_uuid}-{n}.{extension}"
+
+    def new_changelog_file_name(self, extension: str = "parquet") -> str:
+        n = self._counter
+        self._counter += 1
+        return (f"{self.changelog_file_prefix}{self._write_uuid}-{n}"
+                f".{extension}")
+
+    def new_index_file_name(self) -> str:
+        return f"index-{uuid.uuid4()}-0"
+
+    def index_file_path(self, name: str) -> str:
+        return f"{self.index_dir}/{name}"

@@ -1,13 +1,25 @@
 """Test config: force a deterministic 8-device CPU mesh so sharding tests
-run without TPU hardware (the driver separately dry-runs multi-chip)."""
+run without TPU hardware (the driver separately dry-runs multi-chip).
+
+The environment boots an `axon` PJRT plugin (one real TPU behind a
+single-client tunnel) and its register() forces jax_platforms="axon,cpu"
+AFTER the env var is read -- so overriding the env is not enough; the jax
+config must be set back to cpu before any backend initializes. Tests must
+never touch the TPU tunnel (it wedges under concurrent clients); bench.py
+is the only TPU user.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,150 @@
+"""Compaction tests: strategy picks + end-to-end rewrite."""
+
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.compact import (
+    CompactUnit, Levels, LevelSortedRun, SortedRun, UniversalCompaction,
+)
+from paimon_tpu.manifest import DataFileMeta, SimpleStats
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, RowKind, VarCharType
+
+
+def fake_file(name, size, level=0, seq=0):
+    return DataFileMeta(
+        file_name=name, file_size=size, row_count=size,
+        min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
+        value_stats=SimpleStats.EMPTY, min_sequence_number=seq,
+        max_sequence_number=seq, schema_id=0, level=level)
+
+
+def run_of(level, *sizes, seq=0):
+    return LevelSortedRun(level, SortedRun(
+        [fake_file(f"f{level}-{i}-{seq}", s, level, seq + i)
+         for i, s in enumerate(sizes)]))
+
+
+class TestUniversalPick:
+    def test_no_pick_below_trigger(self):
+        u = UniversalCompaction(200, 1, 5)
+        runs = [run_of(0, 10), run_of(0, 10)]
+        assert u.pick(6, runs) is None
+
+    def test_size_amp_full_compaction(self):
+        u = UniversalCompaction(max_size_amp=100, size_ratio=1,
+                                num_run_trigger=3)
+        # candidate (all but last) = 300, earliest = 100 -> 300*100 >
+        # 100*100 -> full compaction to max level
+        runs = [run_of(0, 100, seq=1), run_of(0, 200, seq=2),
+                run_of(5, 100)]
+        unit = u.pick(6, runs)
+        assert unit is not None
+        assert unit.output_level == 5
+        assert len(unit.files) == 3
+
+    def test_size_ratio_merges_similar_runs(self):
+        u = UniversalCompaction(max_size_amp=10**9, size_ratio=1,
+                                num_run_trigger=3)
+        runs = [run_of(0, 100, seq=3), run_of(0, 100, seq=2),
+                run_of(0, 100, seq=1), run_of(5, 100000)]
+        unit = u.pick(6, runs)
+        assert unit is not None
+        # the three similar L0 runs merge; big old run untouched
+        assert len(unit.files) == 3
+        assert unit.output_level == 4  # level of next run (5) - 1
+
+    def test_file_num_trigger(self):
+        u = UniversalCompaction(max_size_amp=10**9, size_ratio=0,
+                                num_run_trigger=3)
+        runs = [run_of(0, 1, seq=4), run_of(0, 100, seq=3),
+                run_of(0, 10000, seq=2), run_of(0, 1000000, seq=1)]
+        unit = u.pick(6, runs)
+        assert unit is not None  # count trigger kicks in
+
+
+def pk_table(tmp_path, **options):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", VarCharType.string_type())
+              .primary_key("id")
+              .options({"bucket": "1", **options})
+              .build())
+    return FileStoreTable.create(str(tmp_path / "t"), schema)
+
+
+def write_rows(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows, kinds)
+    return wb.new_commit().commit(w.prepare_commit())
+
+
+def test_full_compaction_e2e(tmp_path):
+    table = pk_table(tmp_path)
+    for i in range(4):
+        write_rows(table, [{"id": k, "v": f"v{i}-{k}"}
+                           for k in range(i * 5, i * 5 + 10)])
+    files_before = table.new_read_builder().new_scan().plan()
+    n_files_before = sum(len(s.data_files) for s in files_before.splits)
+    assert n_files_before == 4
+
+    sid = table.compact(full=True)
+    assert sid is not None
+    snap = table.latest_snapshot()
+    assert snap.commit_kind == "COMPACT"
+
+    plan = table.new_read_builder().new_scan().plan()
+    files = [f for s in plan.splits for f in s.data_files]
+    assert len(files) == 1
+    assert files[0].level == table.options.num_levels - 1
+    assert plan.splits[0].raw_convertible
+
+    out = table.to_arrow().sort_by("id")
+    assert out.num_rows == 25
+    # latest writer wins for overlapping keys
+    assert out.column("v").to_pylist()[5] == "v1-5"
+
+
+def test_compaction_drops_deletes_at_max_level(tmp_path):
+    table = pk_table(tmp_path)
+    write_rows(table, [{"id": 1, "v": "a"}, {"id": 2, "v": "b"}])
+    write_rows(table, [{"id": 1, "v": "x"}], kinds=[RowKind.DELETE])
+    table.compact(full=True)
+    plan = table.new_read_builder().new_scan().plan()
+    files = [f for s in plan.splits for f in s.data_files]
+    assert len(files) == 1
+    assert files[0].delete_row_count == 0
+    assert files[0].row_count == 1  # tombstone physically dropped
+    assert table.to_arrow().column("id").to_pylist() == [2]
+
+
+def test_compaction_noop_when_compacted(tmp_path):
+    table = pk_table(tmp_path)
+    write_rows(table, [{"id": 1, "v": "a"}])
+    assert table.compact(full=True) is not None
+    # second full compaction: nothing to do
+    assert table.compact(full=True) is None
+
+
+def test_auto_compaction_trigger(tmp_path):
+    table = pk_table(tmp_path, **{"num-sorted-run.compaction-trigger": "3"})
+    for i in range(5):
+        write_rows(table, [{"id": k, "v": f"r{i}"} for k in range(5)])
+    sid = table.compact()  # universal pick should fire (5 runs > 3)
+    assert sid is not None
+    plan = table.new_read_builder().new_scan().plan()
+    files = [f for s in plan.splits for f in s.data_files]
+    assert len(files) < 5
+    out = table.to_arrow().sort_by("id")
+    assert out.column("v").to_pylist() == ["r4"] * 5
+
+
+def test_read_after_compaction_mixed_levels(tmp_path):
+    table = pk_table(tmp_path)
+    write_rows(table, [{"id": k, "v": "old"} for k in range(10)])
+    table.compact(full=True)
+    write_rows(table, [{"id": k, "v": "new"} for k in range(5)])
+    out = table.to_arrow().sort_by("id")
+    assert out.column("v").to_pylist() == ["new"] * 5 + ["old"] * 5

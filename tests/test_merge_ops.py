@@ -1,0 +1,184 @@
+"""Device merge kernel tests, checked against a pure-Python oracle that
+mimics the reference semantics (latest-by-sequence wins, stable ties by
+arrival order, deletes dropped)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.ops.merge import KIND_COL, SEQ_COL, merge_runs
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+from paimon_tpu.types import RowKind
+
+
+def make_run(keys, seqs, kinds=None, values=None, key_type=pa.int64()):
+    n = len(keys)
+    kinds = kinds if kinds is not None else [RowKind.INSERT] * n
+    values = values if values is not None else list(range(n))
+    return pa.table({
+        "k": pa.array(keys, key_type),
+        SEQ_COL: pa.array(seqs, pa.int64()),
+        KIND_COL: pa.array(kinds, pa.int8()),
+        "v": pa.array(values, pa.int64()),
+    })
+
+
+def oracle_dedup(runs, drop_deletes=True):
+    """Reference model: per key keep record with max (seq, arrival)."""
+    best = {}
+    arrival = 0
+    for run in runs:
+        for row in run.to_pylist():
+            key = row["k"]
+            cand = (row[SEQ_COL], arrival, row)
+            if key not in best or cand[:2] > best[key][:2]:
+                best[key] = cand
+            arrival += 1
+    out = []
+    for key in sorted(best, key=lambda x: (x is None, x)):
+        row = best[key][2]
+        if drop_deletes and row[KIND_COL] in (RowKind.DELETE,
+                                              RowKind.UPDATE_BEFORE):
+            continue
+        out.append((row["k"], row["v"]))
+    return out
+
+
+def result_pairs(res):
+    t = res.take()
+    return list(zip(t.column("k").to_pylist(), t.column("v").to_pylist()))
+
+
+def test_single_run_dedup():
+    run = make_run([1, 2, 2, 3], [0, 1, 2, 3], values=[10, 20, 21, 30])
+    res = merge_runs([run], ["k"])
+    assert result_pairs(res) == [(1, 10), (2, 21), (3, 30)]
+
+
+def test_multi_run_latest_wins():
+    r1 = make_run([1, 2, 3], [0, 1, 2], values=[10, 20, 30])
+    r2 = make_run([2, 3], [3, 4], values=[21, 31])
+    res = merge_runs([r1, r2], ["k"])
+    assert result_pairs(res) == [(1, 10), (2, 21), (3, 31)]
+
+
+def test_delete_drops_key():
+    r1 = make_run([1, 2], [0, 1], values=[10, 20])
+    r2 = make_run([1], [2], kinds=[RowKind.DELETE], values=[0])
+    res = merge_runs([r1, r2], ["k"])
+    assert result_pairs(res) == [(2, 20)]
+    res_keep = merge_runs([r1, r2], ["k"], drop_deletes=False)
+    assert [k for k, _ in result_pairs(res_keep)] == [1, 2]
+
+
+def test_equal_seq_later_run_wins():
+    # user-defined sequence: ties broken by arrival order (later wins)
+    r1 = make_run([1], [5], values=[100])
+    r2 = make_run([1], [5], values=[200])
+    res = merge_runs([r1, r2], ["k"])
+    assert result_pairs(res) == [(1, 200)]
+
+
+def test_first_row_engine():
+    r1 = make_run([1, 2], [0, 1], values=[10, 20])
+    r2 = make_run([1, 2], [2, 3], values=[11, 21])
+    res = merge_runs([r1, r2], ["k"], merge_engine="first-row")
+    assert result_pairs(res) == [(1, 10), (2, 20)]
+
+
+def test_negative_and_extreme_int_keys():
+    keys = [-(1 << 62), -1, 0, 1, (1 << 62)]
+    run = make_run(keys, list(range(5)), values=list(range(5)))
+    res = merge_runs([run], ["k"])
+    assert [k for k, _ in result_pairs(res)] == sorted(keys)
+
+
+def test_float_keys():
+    keys = [3.5, -2.25, 0.0, -1e300, 1e300]
+    run = pa.table({
+        "k": pa.array(keys, pa.float64()),
+        SEQ_COL: pa.array(range(5), pa.int64()),
+        KIND_COL: pa.array([0] * 5, pa.int8()),
+        "v": pa.array(range(5), pa.int64()),
+    })
+    res = merge_runs([run], ["k"])
+    out = res.take().column("k").to_pylist()
+    assert out == sorted(keys)
+
+
+def test_string_keys_short():
+    keys = ["banana", "apple", "cherry", "apple"]
+    run = pa.table({
+        "k": pa.array(keys, pa.string()),
+        SEQ_COL: pa.array(range(4), pa.int64()),
+        KIND_COL: pa.array([0] * 4, pa.int8()),
+        "v": pa.array(range(4), pa.int64()),
+    })
+    res = merge_runs([run], ["k"])
+    assert result_pairs(res) == [("apple", 3), ("banana", 0), ("cherry", 2)]
+
+
+def test_string_keys_truncated_prefix():
+    # keys share a 16-byte prefix and differ beyond it -> host refinement
+    base = "x" * 20
+    keys = [base + "bbb", base + "aaa", base + "bbb", "short"]
+    run = pa.table({
+        "k": pa.array(keys, pa.string()),
+        SEQ_COL: pa.array(range(4), pa.int64()),
+        KIND_COL: pa.array([0] * 4, pa.int8()),
+        "v": pa.array(range(4), pa.int64()),
+    })
+    res = merge_runs([run], ["k"])
+    assert result_pairs(res) == [
+        ("short", 3), (base + "aaa", 1), (base + "bbb", 2)]
+
+
+def test_composite_keys():
+    run = pa.table({
+        "a": pa.array([1, 1, 2, 2], pa.int32()),
+        "b": pa.array(["x", "y", "x", "x"], pa.string()),
+        SEQ_COL: pa.array(range(4), pa.int64()),
+        KIND_COL: pa.array([0] * 4, pa.int8()),
+        "v": pa.array(range(4), pa.int64()),
+    })
+    res = merge_runs([run], ["a", "b"])
+    t = res.take()
+    assert t.column("a").to_pylist() == [1, 1, 2]
+    assert t.column("b").to_pylist() == ["x", "y", "x"]
+    assert t.column("v").to_pylist() == [0, 1, 3]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    runs = []
+    seq = 0
+    for _ in range(rng.integers(2, 6)):
+        n = int(rng.integers(1, 500))
+        keys = rng.integers(-50, 50, n).tolist()
+        # runs must be internally deduped on (key) like real sorted runs?
+        # No -- L0 flush dedups, but merge must handle any seq layout.
+        seqs = list(range(seq, seq + n))
+        seq += n
+        kinds = rng.choice(
+            [RowKind.INSERT, RowKind.UPDATE_AFTER, RowKind.DELETE],
+            n, p=[0.6, 0.25, 0.15]).tolist()
+        values = rng.integers(0, 10**9, n).tolist()
+        runs.append(make_run(keys, seqs, kinds, values))
+    res = merge_runs(runs, ["k"])
+    assert result_pairs(res) == oracle_dedup(runs)
+
+
+def test_large_merge_correctness():
+    rng = np.random.default_rng(42)
+    n = 200_000
+    keys = rng.integers(0, 50_000, n)
+    r1 = make_run(keys.tolist(), list(range(n)),
+                  values=rng.integers(0, 1 << 30, n).tolist())
+    keys2 = rng.integers(0, 50_000, n)
+    r2 = make_run(keys2.tolist(), list(range(n, 2 * n)),
+                  values=rng.integers(0, 1 << 30, n).tolist())
+    res = merge_runs([r1, r2], ["k"])
+    t = res.take()
+    ks = t.column("k").to_pylist()
+    assert ks == sorted(set(keys.tolist()) | set(keys2.tolist()))
