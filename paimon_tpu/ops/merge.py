@@ -60,37 +60,50 @@ def _pad_size(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str):
+    """Traceable kernel body shared by the single-chip path, the sharded
+    multi-bucket path (parallel/sharded_merge.py) and the driver entry.
+
+    lane_list: list of uint32[N] arrays (most-significant lane first).
+    Returns (perm, winner, prev_in_seg)."""
+    num_lanes = len(lane_list)
+    n = invalid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    operands = [invalid] + list(lane_list) + [seq_hi, seq_lo, iota]
+    sorted_ops = jax.lax.sort(operands, num_keys=num_lanes + 3,
+                              is_stable=True)
+    s_invalid = sorted_ops[0]
+    s_lanes = sorted_ops[1:1 + num_lanes]
+    perm = sorted_ops[-1]
+
+    lanes_mat = jnp.stack(s_lanes)          # [L, N]
+    eq_next = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
+    # a real row whose key encodes to the same lanes as padding (e.g.
+    # INT64_MIN -> all-zero lanes) must not join the padding segment:
+    # validity is part of the segment identity
+    eq_next = eq_next & (s_invalid[:-1] == s_invalid[1:])
+    eq_next = jnp.concatenate([eq_next, jnp.array([False])])
+    eq_prev = jnp.concatenate([jnp.array([False]), eq_next[:-1]])
+    valid = s_invalid == 0
+    if keep == "last":
+        winner = (~eq_next) & valid
+    else:  # "first"
+        winner = (~eq_prev) & valid
+    # previous version of each winner: its predecessor within the same
+    # segment (highest-seq non-winner), for changelog derivation
+    prev_in_seg = jnp.where(eq_prev, jnp.roll(perm, 1), -1)
+    return perm, winner, prev_in_seg
+
+
 @lru_cache(maxsize=64)
 def _merge_fn(num_lanes: int, keep: str):
     """Build the jitted merge kernel for a lane count."""
 
     @jax.jit
     def fn(lanes, seq_hi, seq_lo, invalid):
-        n = invalid.shape[0]
-        iota = jnp.arange(n, dtype=jnp.int32)
-        operands = [invalid] + [lanes[i] for i in range(num_lanes)] \
-            + [seq_hi, seq_lo, iota]
-        sorted_ops = jax.lax.sort(operands, num_keys=num_lanes + 3,
-                                  is_stable=True)
-        s_invalid = sorted_ops[0]
-        s_lanes = sorted_ops[1:1 + num_lanes]
-        perm = sorted_ops[-1]
-
-        lanes_mat = jnp.stack(s_lanes)          # [L, N]
-        eq_next = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
-        eq_next = jnp.concatenate([eq_next, jnp.array([False])])
-        eq_prev = jnp.concatenate([jnp.array([False]), eq_next[:-1]])
-        valid = s_invalid == 0
-        # padding rows never match a real row because invalid is the
-        # leading sort key and differs
-        if keep == "last":
-            winner = (~eq_next) & valid
-        else:  # "first"
-            winner = (~eq_prev) & valid
-        # previous version of each winner: its predecessor within the same
-        # segment (highest-seq non-winner), for changelog derivation
-        prev_in_seg = jnp.where(eq_prev, jnp.roll(perm, 1), -1)
-        return perm, winner, prev_in_seg
+        return segmented_merge_body(
+            [lanes[i] for i in range(num_lanes)], seq_hi, seq_lo, invalid,
+            keep)
 
     return fn
 

@@ -1,0 +1,14 @@
+"""Scale-out plane: bucket sharding over a jax device mesh.
+
+The reference scales by shuffling rows to per-bucket writer tasks over the
+engine's network (flink/sink/FlinkStreamPartitioner via ChannelComputer)
+and merging each bucket on one core. Here buckets are laid out over a
+`jax.sharding.Mesh` axis: every device merges its shard of buckets with
+the same segmented-sort kernel used single-chip, and commit-level
+statistics reduce across the mesh with `psum` over ICI.
+"""
+
+from paimon_tpu.parallel.sharded_merge import (  # noqa: F401
+    ShardedBucketMerge, bucket_mesh, merge_buckets_sharded,
+    pad_bucket_batches,
+)

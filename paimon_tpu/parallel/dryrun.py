@@ -1,0 +1,100 @@
+"""Multichip dryrun: one full sharded write→merge→commit-stats step.
+
+This is the library path the driver's `dryrun_multichip` exercises: a real
+multi-bucket primary-key table is written through the normal write/commit
+plane, every bucket's runs are encoded to key lanes, and all buckets merge
+in ONE mesh-sharded kernel launch (buckets sharded over devices, commit
+row-count reduced with psum). Shapes are tiny; the point is that the
+sharded program compiles and executes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def run(n_devices: int) -> None:
+    # Force the CPU platform before any backend initializes: the real TPU
+    # tunnel is single-client and must never be touched by dryruns.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from paimon_tpu.core.kv_file import KEY_PREFIX
+    from paimon_tpu.ops.merge import SEQ_COL
+    from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+    from paimon_tpu.parallel import bucket_mesh, merge_buckets_sharded
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType, DoubleType
+
+    n_buckets = n_devices
+    rows_per_commit = 256
+
+    with tempfile.TemporaryDirectory() as tmp:
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .primary_key("id")
+                  .options({"bucket": str(n_buckets),
+                            "write-only": "true"})
+                  .build())
+        table = FileStoreTable.create(os.path.join(tmp, "t"), schema)
+        rng = np.random.default_rng(0)
+        # two commits -> two overlapping L0 runs per bucket
+        for _ in range(2):
+            ids = rng.integers(0, rows_per_commit, rows_per_commit * 2)
+            data = pa.table({
+                "id": pa.array(ids, pa.int64()),
+                "v": pa.array(rng.random(len(ids)), pa.float64()),
+            })
+            wb = table.new_batch_write_builder()
+            w = wb.new_write()
+            w.write_arrow(data)
+            wb.new_commit().commit(w.prepare_commit())
+            w.close()
+
+        # plan all buckets, encode key lanes per bucket
+        splits = table.new_read_builder().new_scan().plan().splits
+        assert splits, "no splits planned"
+        encoder = NormalizedKeyEncoder([pa.int64()])
+        from paimon_tpu.core.read import MergeFileSplitRead
+        reader = MergeFileSplitRead(table.file_io, table.path, table.schema,
+                                    table.options)
+        lanes_list, seq_list, n_input = [], [], 0
+        for s in splits:
+            runs = []
+            from paimon_tpu.core.kv_file import read_kv_file
+            for f in s.data_files:
+                runs.append(read_kv_file(
+                    reader.file_io, reader.path_factory, s.partition,
+                    s.bucket, f, None, None))
+            t = pa.concat_tables(runs, promote_options="none")
+            lanes, _ = encoder.encode_table(t, [KEY_PREFIX + "id"])
+            seq = np.asarray(t.column(SEQ_COL).combine_chunks()
+                             .cast(pa.int64()))
+            lanes_list.append(lanes)
+            seq_list.append(seq)
+            n_input += t.num_rows
+
+        mesh = bucket_mesh(n_devices)
+        winners, total = merge_buckets_sharded(lanes_list, seq_list, mesh)
+        assert len(winners) == len(splits)
+        assert 0 < total <= n_input, (total, n_input)
+        # cross-check against the sequential single-chip read path
+        seq_total = table.to_arrow().num_rows
+        assert total == seq_total, (total, seq_total)
+        print(f"dryrun_multichip OK: {n_devices} devices, "
+              f"{len(splits)} buckets, {n_input} input rows -> "
+              f"{total} merged rows (psum over mesh)")

@@ -182,3 +182,21 @@ def test_large_merge_correctness():
     t = res.take()
     ks = t.column("k").to_pylist()
     assert ks == sorted(set(keys.tolist()) | set(keys2.tolist()))
+
+
+def test_int64_min_key_not_dropped_single_chip():
+    """Regression: INT64_MIN encodes to all-zero lanes (same as padding);
+    it must still win its segment (validity is part of segment identity)."""
+    import pyarrow as pa
+    from paimon_tpu.ops.merge import merge_runs
+    from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+
+    t = pa.table({
+        "_KEY_k": pa.array([-(1 << 63), 7, -(1 << 63)], pa.int64()),
+        "_SEQUENCE_NUMBER": pa.array([0, 1, 2], pa.int64()),
+        "_VALUE_KIND": pa.array([0, 0, 0], pa.int8()),
+    })
+    res = merge_runs([t], ["_KEY_k"])
+    got = sorted(res.take().column("_KEY_k").to_pylist())
+    assert got == [-(1 << 63), 7]
+    assert 2 in res.indices  # max-seq row wins for the dup key
