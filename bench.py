@@ -90,23 +90,25 @@ def build_table(path, rows, runs):
     return table
 
 
-def heap_merge_baseline(table, tmpdir, sample_rows=2_000_000):
-    """The reference's no-JVM compaction shape, end-to-end on the SAME
-    data files: decode parquet -> per-record min-heap k-way merge with a
-    deduplicate merge function -> encode parquet
-    (pypaimon read/reader/sort_merge_reader.py:31 + file_store_write).
-    Measured on a sample of the real runs, extrapolated linearly."""
+def heap_merge_baseline(tmpdir, sample_rows=2_000_000, runs=10):
+    """The reference's no-JVM compaction shape, end-to-end at sample
+    scale on identically-shaped data: decode parquet -> per-record
+    min-heap k-way merge with a deduplicate merge function -> encode
+    parquet (pypaimon read/reader/sort_merge_reader.py:31 +
+    file_store_write). Every decoded row is merged and counted, so
+    decode, merge and encode are all charged per counted row —
+    extrapolation to full scale is linear in rows (merge is n log k)."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
     from paimon_tpu.core.kv_file import read_kv_file
     from paimon_tpu.core.read import assemble_runs
 
+    table = build_table(os.path.join(tmpdir, "baseline_t"), sample_rows,
+                        runs)
     splits = table.new_read_builder().new_scan().plan().splits
     split = splits[0]
     runs_meta = assemble_runs(split.data_files)
-    per_run_cap = max(1, sample_rows // max(1, len(runs_meta)))
-
     scan = table.new_scan()
 
     t0 = time.perf_counter()
@@ -117,8 +119,6 @@ def heap_merge_baseline(table, tmpdir, sample_rows=2_000_000):
                              split.partition, split.bucket, f, None, None)
                 for f in run_files]
         t = pa.concat_tables(tbls, promote_options="none")
-        if t.num_rows > per_run_cap:
-            t = t.slice(0, per_run_cap)
         cols = [t.column(c).to_pylist() for c in t.column_names]
         rows = list(zip(*cols))        # (key, seq, kind, values...)
         run_rows.append(rows)
@@ -166,8 +166,7 @@ def main():
         })
         merge_runs([warm], ["_KEY_id"])
 
-        baseline = heap_merge_baseline(table, tmp,
-                                       min(rows, 2_000_000))
+        baseline = heap_merge_baseline(tmp, min(rows, 2_000_000), runs)
 
         t0 = time.perf_counter()
         sid = table.compact(full=True)

@@ -73,7 +73,9 @@ class MergeTreeCompactManager:
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]
         self.key_encoder = NormalizedKeyEncoder(
             [data_type_to_arrow(rt.get_field(k).type)
-             for k in self.trimmed_pk])
+             for k in self.trimmed_pk],
+            nullable=[rt.get_field(k).type.nullable
+                      for k in self.trimmed_pk])
 
     # -- picking -------------------------------------------------------------
 

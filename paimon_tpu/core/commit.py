@@ -111,26 +111,33 @@ class FileStoreCommit:
                   ) -> Optional[int]:
         """INSERT OVERWRITE: delete current files (optionally restricted to
         a partition spec) and add new ones atomically
-        (reference FileStoreCommitImpl.overwrite)."""
-        entries: List[ManifestEntry] = []
-        latest = self.snapshot_manager.latest_snapshot()
-        if latest is not None:
-            for e in self._read_all_entries(latest):
-                if e.kind != FileKind.ADD:
-                    continue
-                if partition_filter and not self._partition_matches(
-                        e.partition, partition_filter):
-                    continue
-                entries.append(ManifestEntry(
-                    FileKind.DELETE, e.partition, e.bucket, e.total_buckets,
-                    e.file))
+        (reference FileStoreCommitImpl.overwrite). The delete set is
+        recomputed from the latest snapshot on every CAS attempt so files
+        committed concurrently between planning and publish do not
+        survive the overwrite."""
+        adds: List[ManifestEntry] = []
         for msg in messages:
             pbytes = self._partition_codec.to_bytes(msg.partition)
             for f in msg.new_files:
-                entries.append(ManifestEntry(
+                adds.append(ManifestEntry(
                     FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
-        return self._try_commit(entries, [], commit_identifier,
-                                CommitKind.OVERWRITE)
+
+        def entries_fn(latest: Optional[Snapshot]) -> List[ManifestEntry]:
+            entries: List[ManifestEntry] = []
+            if latest is not None:
+                for e in self._read_all_entries(latest):
+                    if e.kind != FileKind.ADD:
+                        continue
+                    if partition_filter and not self._partition_matches(
+                            e.partition, partition_filter):
+                        continue
+                    entries.append(ManifestEntry(
+                        FileKind.DELETE, e.partition, e.bucket,
+                        e.total_buckets, e.file))
+            return entries + adds
+
+        return self._try_commit([], [], commit_identifier,
+                                CommitKind.OVERWRITE, entries_fn=entries_fn)
 
     def filter_committed(self, commit_identifiers: Sequence[int]
                          ) -> List[int]:
@@ -164,11 +171,18 @@ class FileStoreCommit:
                     commit_identifier: int, kind: str,
                     check_deleted_files: bool = False,
                     index_entries: Optional[list] = None,
-                    properties: Optional[Dict[str, str]] = None) -> int:
+                    properties: Optional[Dict[str, str]] = None,
+                    entries_fn=None) -> int:
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         while True:
             latest = self.snapshot_manager.latest_snapshot()
+            if entries_fn is not None:
+                # delete/add set depends on the latest snapshot (e.g.
+                # overwrite): recompute per attempt; per-attempt manifests
+                # are cleaned up on CAS loss below
+                entries = entries_fn(latest)
+                new_manifest = None
             if check_deleted_files and latest is not None:
                 self._assert_files_exist(latest, entries)
 
@@ -191,7 +205,8 @@ class FileStoreCommit:
                 prev_total = latest.total_record_count
                 prev_index = latest.index_manifest
 
-            base_metas = self._maybe_merge_manifests(base_metas)
+            base_metas, merged_manifests = \
+                self._maybe_merge_manifests(base_metas)
             base_name, base_size = self.manifest_list.write(base_metas)
             delta_metas = [new_manifest] if new_manifest else []
             delta_name, delta_size = self.manifest_list.write(delta_metas)
@@ -230,12 +245,22 @@ class FileStoreCommit:
             )
             if self.snapshot_manager.try_commit(snapshot):
                 return new_id
-            # lost the race: clean up lists we wrote for this attempt and
-            # retry against the new latest (manifest files are reusable)
+            # lost the race: clean up everything written for this attempt
+            # and retry against the new latest (the delta manifest is
+            # reusable across attempts unless the entry set is dynamic)
             self.manifest_list.delete(base_name)
             self.manifest_list.delete(delta_name)
             if changelog_name:
                 self.manifest_list.delete(changelog_name)
+            if index_manifest is not None and index_manifest != prev_index:
+                self.file_io.delete_quietly(
+                    self.index_manifest_file.path(index_manifest))
+            for m in merged_manifests:
+                self.file_io.delete_quietly(
+                    self.manifest_file.path(m.file_name))
+            if entries_fn is not None and new_manifest is not None:
+                self.file_io.delete_quietly(
+                    self.manifest_file.path(new_manifest.file_name))
 
     def _assert_files_exist(self, latest: Snapshot,
                             entries: List[ManifestEntry]):
@@ -258,21 +283,26 @@ class FileStoreCommit:
                     f"from the new snapshot.")
 
     def _maybe_merge_manifests(self, metas: List[ManifestFileMeta]
-                               ) -> List[ManifestFileMeta]:
+                               ) -> Tuple[List[ManifestFileMeta],
+                                          List[ManifestFileMeta]]:
         """Full-rewrite small manifests when there are too many
-        (reference manifest/ManifestFileMerger)."""
+        (reference manifest/ManifestFileMerger). Returns (metas,
+        newly_written) so the caller can delete fresh files if the commit
+        attempt loses the CAS."""
         if len(metas) < self.manifest_merge_min:
-            return metas
+            return metas, []
         small = [m for m in metas if m.file_size < self.manifest_target_size]
         if len(small) < 2:
-            return metas
+            return metas, []
         big = [m for m in metas if m.file_size >= self.manifest_target_size]
         entries: List[ManifestEntry] = []
         for m in small:
             entries.extend(self.manifest_file.read(m.file_name))
         merged = merge_manifest_entries(entries)
         out = list(big)
+        written = []
         if merged:
-            out.append(self.manifest_file.write(merged,
-                                                schema_id=self.schema.id))
-        return out
+            meta = self.manifest_file.write(merged, schema_id=self.schema.id)
+            out.append(meta)
+            written.append(meta)
+        return out, written

@@ -75,7 +75,9 @@ class MergeFileSplitRead:
         rt = schema.logical_row_type()
         self.key_encoder = NormalizedKeyEncoder(
             [data_type_to_arrow(rt.get_field(k).type)
-             for k in self.trimmed_pk])
+             for k in self.trimmed_pk],
+            nullable=[rt.get_field(k).type.nullable
+                      for k in self.trimmed_pk])
         self._schema_cache: Dict[int, TableSchema] = {schema.id: schema}
         self._projection: Optional[List[str]] = None
         self._predicate: Optional[Predicate] = None

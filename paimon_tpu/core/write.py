@@ -176,7 +176,9 @@ class KeyValueFileStoreWrite:
         from paimon_tpu.types import data_type_to_arrow
         self.key_encoder = NormalizedKeyEncoder(
             [data_type_to_arrow(rt.get_field(k).type)
-             for k in table_schema.trimmed_primary_keys()])
+             for k in table_schema.trimmed_primary_keys()],
+            nullable=[rt.get_field(k).type.nullable
+                      for k in table_schema.trimmed_primary_keys()])
         self._writers: Dict[Tuple, _BucketWriter] = {}
         self._restore_max_seq = restore_max_seq
         self.changelog_input = (

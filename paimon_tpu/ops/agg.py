@@ -149,7 +149,8 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         return table
     if key_encoder is None:
         key_encoder = NormalizedKeyEncoder(
-            [table.schema.field(k).type for k in key_cols])
+            [table.schema.field(k).type for k in key_cols],
+            nullable=[table.schema.field(k).nullable for k in key_cols])
     lanes, truncated = key_encoder.encode_table(table, key_cols)
     if truncated.any():
         raise NotImplementedError(

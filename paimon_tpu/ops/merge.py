@@ -147,7 +147,8 @@ def sort_table(table: pa.Table, key_names: Sequence[str],
         return np.zeros(0, dtype=np.int64)
     if key_encoder is None:
         key_encoder = NormalizedKeyEncoder(
-            [table.schema.field(k).type for k in key_names])
+            [table.schema.field(k).type for k in key_names],
+            nullable=[table.schema.field(k).nullable for k in key_names])
     lanes, truncated = key_encoder.encode_table(table, key_names)
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
     perm, _, _ = device_sorted_winners(lanes, seq, "last")
@@ -186,7 +187,8 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
 
     if key_encoder is None:
         key_encoder = NormalizedKeyEncoder(
-            [table.schema.field(k).type for k in key_names])
+            [table.schema.field(k).type for k in key_names],
+            nullable=[table.schema.field(k).nullable for k in key_names])
     lanes, truncated = key_encoder.encode_table(table, key_names)
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
 

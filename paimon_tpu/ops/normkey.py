@@ -15,9 +15,10 @@ Encodings (all big-endian style, most-significant lane first):
   callers can resolve rare prefix-equal ties on the host
 - date/time/timestamp: underlying ints
 
-Null ordering: nulls-last via a leading presence bit folded into the first
-lane of each column (primary keys are NOT NULL, but sort/cluster keys may
-be nullable).
+Null ordering: nulls-last via a dedicated leading presence LANE per
+nullable column (0 = present, 1 = null), so a null is never byte-identical
+to any real value (INT64_MAX, all-0xFF string prefixes). Columns declared
+non-nullable (primary keys) skip the lane.
 """
 
 from __future__ import annotations
@@ -54,9 +55,13 @@ class NormalizedKeyEncoder:
     """Encodes the key columns of Arrow batches into uint32 lane matrices."""
 
     def __init__(self, key_types: Sequence[pa.DataType],
-                 string_prefix_bytes: int = 16):
+                 string_prefix_bytes: int = 16,
+                 nullable: Optional[Sequence[bool]] = None):
         self.key_types = list(key_types)
         self.string_prefix_bytes = ((string_prefix_bytes + 7) // 8) * 8
+        self.nullable = (list(nullable) if nullable is not None
+                         else [True] * len(self.key_types))
+        assert len(self.nullable) == len(self.key_types)
         self.lanes_per_col: List[int] = []
         self._kinds: List[str] = []
         for t in self.key_types:
@@ -77,6 +82,10 @@ class NormalizedKeyEncoder:
                 self.lanes_per_col.append(self.string_prefix_bytes // 4)
             else:
                 raise ValueError(f"Unsupported key type {t}")
+        # one leading presence lane per nullable column (0=value, 1=null)
+        self.lanes_per_col = [
+            nl + (1 if nul else 0)
+            for nl, nul in zip(self.lanes_per_col, self.nullable)]
 
     @property
     def num_lanes(self) -> int:
@@ -90,11 +99,21 @@ class NormalizedKeyEncoder:
         lanes = np.zeros((n, self.num_lanes), dtype=np.uint32)
         truncated = np.zeros(n, dtype=bool)
         lane_pos = 0
-        for col, kind, nl, t in zip(columns, self._kinds, self.lanes_per_col,
-                                    self.key_types):
+        for col, kind, total_nl, t, nul in zip(
+                columns, self._kinds, self.lanes_per_col, self.key_types,
+                self.nullable):
             arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
                 else col
             null_mask = np.asarray(arr.is_null())
+            if nul:
+                lanes[:, lane_pos] = null_mask.astype(np.uint32)
+                lane_pos += 1
+                nl = total_nl - 1
+            else:
+                if null_mask.any():
+                    raise ValueError(
+                        "null value in a key column declared NOT NULL")
+                nl = total_nl
             if kind == "int":
                 vals = np.asarray(
                     arr.cast(pa.int64()).fill_null(0))
@@ -118,11 +137,11 @@ class NormalizedKeyEncoder:
                 lanes[:, lane_pos + 1] = lo
             else:  # bytes
                 trunc_col = self._encode_bytes(arr, lanes, lane_pos, nl)
-                truncated |= trunc_col
+                truncated |= trunc_col & ~null_mask
             if null_mask.any():
-                # nulls-last: set all lanes to max for null rows
-                lanes[null_mask, lane_pos:lane_pos + nl] = np.uint32(
-                    0xFFFFFFFF)
+                # value lanes of null rows are zeroed (presence lane alone
+                # decides the order; any residue from fill_null is wiped)
+                lanes[null_mask, lane_pos:lane_pos + nl] = np.uint32(0)
             lane_pos += nl
         return lanes, truncated
 

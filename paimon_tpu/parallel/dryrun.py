@@ -31,9 +31,7 @@ def run(n_devices: int) -> None:
     import numpy as np
     import pyarrow as pa
 
-    from paimon_tpu.core.kv_file import KEY_PREFIX
     from paimon_tpu.ops.merge import SEQ_COL
-    from paimon_tpu.ops.normkey import NormalizedKeyEncoder
     from paimon_tpu.parallel import bucket_mesh, merge_buckets_sharded
     from paimon_tpu.schema import Schema
     from paimon_tpu.table import FileStoreTable
@@ -65,23 +63,24 @@ def run(n_devices: int) -> None:
             wb.new_commit().commit(w.prepare_commit())
             w.close()
 
-        # plan all buckets, encode key lanes per bucket
+        # plan all buckets, encode key lanes per bucket with the SAME
+        # encoder/key columns the real read path derives from the schema
         splits = table.new_read_builder().new_scan().plan().splits
         assert splits, "no splits planned"
-        encoder = NormalizedKeyEncoder([pa.int64()])
+        from paimon_tpu.core.kv_file import read_kv_file
         from paimon_tpu.core.read import MergeFileSplitRead
         reader = MergeFileSplitRead(table.file_io, table.path, table.schema,
                                     table.options)
+        encoder = reader.key_encoder
         lanes_list, seq_list, n_input = [], [], 0
         for s in splits:
             runs = []
-            from paimon_tpu.core.kv_file import read_kv_file
             for f in s.data_files:
                 runs.append(read_kv_file(
                     reader.file_io, reader.path_factory, s.partition,
                     s.bucket, f, None, None))
             t = pa.concat_tables(runs, promote_options="none")
-            lanes, _ = encoder.encode_table(t, [KEY_PREFIX + "id"])
+            lanes, _ = encoder.encode_table(t, reader.key_cols)
             seq = np.asarray(t.column(SEQ_COL).combine_chunks()
                              .cast(pa.int64()))
             lanes_list.append(lanes)

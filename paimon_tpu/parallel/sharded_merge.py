@@ -38,10 +38,13 @@ def pad_bucket_batches(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Stack per-bucket (lanes uint32[N_b, L], seq int64[N_b]) into padded
     [B, N, ...] arrays with an invalid mask (padding sorts last)."""
+    from paimon_tpu.ops.merge import _pad_size
+
     b = len(lanes_list)
     num_lanes = lanes_list[0].shape[1] if b else 0
-    n = max((len(s) for s in seq_list), default=0)
-    n = max(n, 8)
+    # pad the row axis to a power of two so successive calls with nearby
+    # bucket sizes reuse the compiled sharded program
+    n = _pad_size(max((len(s) for s in seq_list), default=0))
     lanes = np.zeros((b, n, num_lanes), dtype=np.uint32)
     seq_hi = np.zeros((b, n), dtype=np.uint32)
     seq_lo = np.zeros((b, n), dtype=np.uint32)
@@ -100,7 +103,6 @@ class ShardedBucketMerge:
     def __call__(self, lanes: np.ndarray, seq_hi: np.ndarray,
                  seq_lo: np.ndarray, invalid: np.ndarray):
         import jax
-        import jax.numpy as jnp
 
         b = lanes.shape[0]
         if b % self._n_dev != 0:
@@ -113,7 +115,7 @@ class ShardedBucketMerge:
                 [seq_lo, np.zeros((pad,) + seq_lo.shape[1:], seq_lo.dtype)])
             invalid = np.concatenate(
                 [invalid, np.ones((pad,) + invalid.shape[1:], invalid.dtype)])
-        args = [jax.device_put(jnp.asarray(a), self.sharding)
+        args = [jax.device_put(a, self.sharding)
                 for a in (lanes, seq_hi, seq_lo, invalid)]
         perm, winner, total = self._fn(*args)
         jax.block_until_ready((perm, winner, total))

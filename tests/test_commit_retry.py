@@ -1,0 +1,102 @@
+"""CAS-retry correctness: overwrite delete-set recomputation and
+per-attempt manifest cleanup (ADVICE round-1 fixes)."""
+
+import os
+
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+
+def _make_table(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def _commit_rows(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def test_overwrite_recomputes_deletes_on_retry(tmp_warehouse):
+    """A file committed concurrently between overwrite planning and CAS
+    publish must still be deleted by the overwrite."""
+    table = _make_table(tmp_warehouse)
+    _commit_rows(table, [{"id": 1, "v": 1.0}])
+
+    wb = table.new_batch_write_builder().with_overwrite()
+    w = wb.new_write()
+    w.write_dicts([{"id": 100, "v": 100.0}])
+    messages = w.prepare_commit()
+    commit = wb.new_commit()
+
+    # interleave: another committer lands a row, and the overwrite's first
+    # CAS attempt loses
+    sm = commit._commit.snapshot_manager
+    real_try = sm.try_commit
+    state = {"interfered": False}
+
+    def flaky_try(snapshot):
+        if not state["interfered"]:
+            state["interfered"] = True
+            _commit_rows(table, [{"id": 2, "v": 2.0}])
+            return False
+        return real_try(snapshot)
+
+    sm.try_commit = flaky_try
+    commit.commit(messages)
+    sm.try_commit = real_try
+
+    rows = sorted(table.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert rows == [{"id": 100, "v": 100.0}], rows
+
+
+def test_retry_cleans_up_attempt_manifests(tmp_warehouse):
+    """A lost CAS attempt must not leak its per-attempt manifest lists."""
+    table = _make_table(tmp_warehouse)
+    _commit_rows(table, [{"id": 1, "v": 1.0}])
+
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 3, "v": 3.0}])
+    messages = w.prepare_commit()
+    commit = wb.new_commit()
+
+    sm = commit._commit.snapshot_manager
+    real_try = sm.try_commit
+    state = {"n": 0}
+
+    def flaky_try(snapshot):
+        state["n"] += 1
+        if state["n"] == 1:
+            _commit_rows(table, [{"id": 2, "v": 2.0}])
+            return False
+        return real_try(snapshot)
+
+    sm.try_commit = flaky_try
+    commit.commit(messages)
+    sm.try_commit = real_try
+
+    # every manifest list on disk must be referenced by some snapshot
+    mdir = os.path.join(table.path, "manifest")
+    referenced = set()
+    for snap in table.snapshot_manager.snapshots():
+        referenced.add(snap.base_manifest_list)
+        referenced.add(snap.delta_manifest_list)
+        if snap.changelog_manifest_list:
+            referenced.add(snap.changelog_manifest_list)
+    on_disk = {f for f in os.listdir(mdir)
+               if f.startswith("manifest-list-")}
+    orphans = on_disk - referenced
+    assert not orphans, orphans

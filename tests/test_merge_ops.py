@@ -200,3 +200,31 @@ def test_int64_min_key_not_dropped_single_chip():
     got = sorted(res.take().column("_KEY_k").to_pylist())
     assert got == [-(1 << 63), 7]
     assert 2 in res.indices  # max-seq row wins for the dup key
+
+
+def test_nullable_key_distinct_from_int64_max():
+    """ADVICE fix: a null key must get its own presence lane, never
+    colliding with INT64_MAX, and must sort last."""
+    import numpy as np
+    import pyarrow as pa
+    from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[True])
+    assert enc.num_lanes == 3
+    t = pa.table({"k": pa.array([5, None, (1 << 63) - 1], pa.int64())})
+    lanes, _ = enc.encode_table(t, ["k"])
+    assert not np.array_equal(lanes[1], lanes[2])  # null != INT64_MAX
+    order = sorted(range(3), key=lambda i: tuple(lanes[i]))
+    assert order == [0, 2, 1]                      # nulls last
+
+
+def test_nullable_string_key_distinct_from_ff_prefix():
+    import numpy as np
+    import pyarrow as pa
+    from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+
+    enc = NormalizedKeyEncoder([pa.string()], nullable=[True])
+    t = pa.table({"k": pa.array(["\xff" * 16, None])})
+    lanes, _ = enc.encode_table(t, ["k"])
+    assert not np.array_equal(lanes[0], lanes[1])
+    assert tuple(lanes[1]) > tuple(lanes[0])       # null sorts last

@@ -26,7 +26,7 @@ def _np_dedup_count(keys):
 
 def test_sharded_merge_matches_numpy(mesh):
     rng = np.random.default_rng(42)
-    enc = NormalizedKeyEncoder([pa.int64()])
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
     lanes_list, seq_list, expected = [], [], []
     for b in range(8):
         n = 64 + 32 * b      # ragged bucket sizes -> padding exercised
@@ -52,7 +52,7 @@ def test_sharded_merge_matches_numpy(mesh):
 def test_sharded_merge_bucket_padding(mesh):
     """B not a multiple of mesh size -> padded buckets contribute zero."""
     rng = np.random.default_rng(1)
-    enc = NormalizedKeyEncoder([pa.int64()])
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
     lanes_list, seq_list = [], []
     for b in range(5):
         keys = rng.integers(0, 10, 32)
@@ -70,7 +70,7 @@ def test_sharded_matches_sequential_kernel(mesh):
     from paimon_tpu.ops.merge import device_sorted_winners
 
     rng = np.random.default_rng(7)
-    enc = NormalizedKeyEncoder([pa.int64()])
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
     lanes_list, seq_list = [], []
     for b in range(8):
         keys = rng.integers(0, 100, 128)
@@ -87,7 +87,7 @@ def test_sharded_matches_sequential_kernel(mesh):
 
 
 def test_first_row_keep(mesh):
-    enc = NormalizedKeyEncoder([pa.int64()])
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
     keys = np.array([5, 5, 3, 3, 3, 9], dtype=np.int64)
     t = pa.table({"k": pa.array(keys, pa.int64())})
     lanes, _ = enc.encode_table(t, ["k"])
@@ -100,7 +100,7 @@ def test_first_row_keep(mesh):
 def test_int64_min_key_not_dropped(mesh):
     """Key INT64_MIN encodes to all-zero lanes, identical to padding lanes;
     the segment-boundary check must treat validity as part of the key."""
-    enc = NormalizedKeyEncoder([pa.int64()])
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
     keys = np.array([np.iinfo(np.int64).min, 7], dtype=np.int64)
     t = pa.table({"k": pa.array(keys, pa.int64())})
     lanes, _ = enc.encode_table(t, ["k"])
