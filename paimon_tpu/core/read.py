@@ -32,7 +32,9 @@ from paimon_tpu.schema.table_schema import TableSchema
 from paimon_tpu.types import RowKind, data_type_to_arrow
 from paimon_tpu.utils.path_factory import FileStorePathFactory
 
-__all__ = ["MergeFileSplitRead", "assemble_runs"]
+__all__ = ["MergeFileSplitRead", "assemble_runs", "ROW_KIND_COL"]
+
+ROW_KIND_COL = "_ROW_KIND"
 
 
 def assemble_runs(files: Sequence[DataFileMeta]) -> List[List[DataFileMeta]]:
@@ -105,12 +107,25 @@ class MergeFileSplitRead:
             out = out.filter(self._predicate.to_arrow())
         return out
 
-    def read_splits(self, splits: Sequence[DataSplit]) -> pa.Table:
+    def read_splits(self, splits: Sequence[DataSplit],
+                    streaming: Optional[bool] = None) -> pa.Table:
         tables = [self.read_split(s) for s in splits]
         tables = [t for t in tables if t.num_rows > 0]
         if not tables:
-            return pa.table({c: [] for c in self._value_columns()})
+            if streaming is None:
+                streaming = any(s.for_streaming for s in splits)
+            return self._empty_table(streaming)
         return pa.concat_tables(tables, promote_options="default")
+
+    def _empty_table(self, streaming: bool) -> pa.Table:
+        """Typed empty result with a schema identical to non-empty reads
+        (streaming polls always carry _ROW_KIND)."""
+        by_name = {f.name: f for f in self.schema.fields}
+        cols = {c: pa.array([], data_type_to_arrow(by_name[c].type))
+                for c in self._value_columns()}
+        if streaming:
+            cols[ROW_KIND_COL] = pa.array([], pa.int8())
+        return pa.table(cols)
 
     def _value_columns(self) -> List[str]:
         names = [f.name for f in self.schema.fields]
@@ -140,12 +155,25 @@ class MergeFileSplitRead:
                   for f in sorted(split.data_files,
                                   key=lambda f: f.min_key)]
         merged = pa.concat_tables(tables, promote_options="none")
+        if split.for_streaming and split.is_delta:
+            # changelog consumers observe every row with its kind
+            # (reference streaming read preserves RowKind; -U/-D survive)
+            out = merged.select(value_cols)
+            return out.append_column(
+                ROW_KIND_COL,
+                merged.column(KIND_COL).combine_chunks().cast(pa.int8()))
         kinds = np.asarray(merged.column(KIND_COL).combine_chunks()
                            .cast(pa.int8()))
         keep = (kinds == RowKind.INSERT) | (kinds == RowKind.UPDATE_AFTER)
         if not keep.all():
             merged = merged.filter(pa.array(keep))
-        return merged.select(value_cols)
+        out = merged.select(value_cols)
+        if split.for_streaming:
+            # full-phase streaming rows are the merged state: all +I
+            out = out.append_column(
+                ROW_KIND_COL,
+                pa.array(np.zeros(out.num_rows, np.int8), pa.int8()))
+        return out
 
     def _read_merged(self, split: DataSplit, read_cols: List[str],
                      value_cols: List[str]) -> pa.Table:
@@ -160,16 +188,22 @@ class MergeFileSplitRead:
         if engine == MergeEngine.FIRST_ROW:
             res = merge_runs(runs, self.key_cols, merge_engine="first-row",
                              key_encoder=self.key_encoder)
+            out = res.take(value_cols)
         elif engine in (MergeEngine.DEDUPLICATE,):
             res = merge_runs(runs, self.key_cols,
                              key_encoder=self.key_encoder)
+            out = res.take(value_cols)
         else:
             from paimon_tpu.ops.agg import merge_runs_agg
-            return merge_runs_agg(runs, self.key_cols, self.schema,
-                                  self.options,
-                                  key_encoder=self.key_encoder
-                                  ).select(value_cols)
-        return res.take(value_cols)
+            out = merge_runs_agg(runs, self.key_cols, self.schema,
+                                 self.options,
+                                 key_encoder=self.key_encoder
+                                 ).select(value_cols)
+        if split.for_streaming:
+            out = out.append_column(
+                ROW_KIND_COL,
+                pa.array(np.zeros(out.num_rows, np.int8), pa.int8()))
+        return out
 
     # -- schema evolution ----------------------------------------------------
 

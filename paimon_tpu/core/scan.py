@@ -36,6 +36,11 @@ class DataSplit:
     data_files: List[DataFileMeta]
     raw_convertible: bool = False
     deletion_vectors: Optional[Dict[str, Any]] = None   # file -> DV
+    # streaming split: reads emit a _ROW_KIND column
+    for_streaming: bool = False
+    # delta/changelog split: true row kinds preserved (-U/-D survive);
+    # full-phase streaming splits emit merged state as all +I instead
+    is_delta: bool = False
 
     @property
     def row_count(self) -> int:
@@ -46,6 +51,9 @@ class DataSplit:
 class ScanPlan:
     snapshot_id: Optional[int]
     splits: List[DataSplit]
+    # plan produced by a streaming scan (reads stay schema-stable with a
+    # _ROW_KIND column even when splits is empty)
+    streaming: bool = False
 
     @property
     def row_count(self) -> int:
@@ -105,34 +113,43 @@ class FileStoreScan:
 
     # -- planning ------------------------------------------------------------
 
-    def plan(self, snapshot: Optional[Snapshot] = None) -> ScanPlan:
+    def plan(self, snapshot: Optional[Snapshot] = None,
+             streaming: bool = False) -> ScanPlan:
         if snapshot is None:
             snapshot = self.snapshot_manager.latest_snapshot()
         if snapshot is None:
-            return ScanPlan(None, [])
+            return ScanPlan(None, [], streaming=streaming)
         entries = self.read_entries(snapshot)
         return ScanPlan(snapshot.id, self.generate_splits(
-            snapshot.id, entries))
+            snapshot.id, entries, for_streaming=streaming),
+            streaming=streaming)
 
-    def plan_delta(self, snapshot: Snapshot) -> ScanPlan:
+    def plan_delta(self, snapshot: Snapshot,
+                   streaming: bool = False) -> ScanPlan:
         """Only this snapshot's delta files (for incremental/streaming
-        reads, reference DeltaFollowUpScanner)."""
+        reads, reference DeltaFollowUpScanner). With streaming=True the
+        splits preserve row kinds for changelog consumers."""
         metas = self.manifest_list.read(snapshot.delta_manifest_list)
         entries = self._read_manifests(metas)
         adds = [e for e in entries if e.kind == FileKind.ADD]
         return ScanPlan(snapshot.id,
                         self.generate_splits(snapshot.id, adds,
-                                             for_delta=True))
+                                             for_delta=True,
+                                             for_streaming=streaming),
+                        streaming=streaming)
 
-    def plan_changelog(self, snapshot: Snapshot) -> ScanPlan:
+    def plan_changelog(self, snapshot: Snapshot,
+                       streaming: bool = False) -> ScanPlan:
         if not snapshot.changelog_manifest_list:
-            return ScanPlan(snapshot.id, [])
+            return ScanPlan(snapshot.id, [], streaming=streaming)
         metas = self.manifest_list.read(snapshot.changelog_manifest_list)
         entries = self._read_manifests(metas)
         adds = [e for e in entries if e.kind == FileKind.ADD]
         return ScanPlan(snapshot.id,
                         self.generate_splits(snapshot.id, adds,
-                                             for_delta=True))
+                                             for_delta=True,
+                                             for_streaming=streaming),
+                        streaming=streaming)
 
     def read_entries(self, snapshot: Snapshot) -> List[ManifestEntry]:
         metas = self.manifest_list.read_all(snapshot.base_manifest_list,
@@ -220,7 +237,8 @@ class FileStoreScan:
 
     def generate_splits(self, snapshot_id: int,
                         entries: List[ManifestEntry],
-                        for_delta: bool = False) -> List[DataSplit]:
+                        for_delta: bool = False,
+                        for_streaming: bool = False) -> List[DataSplit]:
         groups: Dict[Tuple, List[ManifestEntry]] = {}
         for e in entries:
             if not self._entry_visible(e):
@@ -248,6 +266,8 @@ class FileStoreScan:
                 data_files=files,
                 raw_convertible=raw or for_delta,
                 deletion_vectors=dv_index.get((pbytes, bucket)),
+                for_streaming=for_streaming,
+                is_delta=for_delta,
             ))
         return splits
 

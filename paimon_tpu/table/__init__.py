@@ -5,6 +5,7 @@ BatchWriteBuilder/StreamWriteBuilder, TableWriteImpl, TableCommitImpl).
 """
 
 from paimon_tpu.table.table import (  # noqa: F401
-    FileStoreTable, BatchWriteBuilder, ReadBuilder, TableWrite, TableCommit,
-    TableRead, TableScan,
+    FileStoreTable, BatchWriteBuilder, StreamWriteBuilder, ReadBuilder,
+    TableWrite, TableCommit, TableRead, TableScan,
 )
+from paimon_tpu.table.stream_scan import DataTableStreamScan  # noqa: F401

@@ -28,8 +28,9 @@ from paimon_tpu.snapshot import (
 )
 from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
 
-__all__ = ["FileStoreTable", "BatchWriteBuilder", "ReadBuilder",
-           "TableWrite", "TableCommit", "TableRead", "TableScan"]
+__all__ = ["FileStoreTable", "BatchWriteBuilder", "StreamWriteBuilder",
+           "ReadBuilder", "TableWrite", "TableCommit", "TableRead",
+           "TableScan"]
 
 
 class FileStoreTable:
@@ -110,6 +111,9 @@ class FileStoreTable:
     def new_batch_write_builder(self) -> "BatchWriteBuilder":
         return BatchWriteBuilder(self)
 
+    def new_stream_write_builder(self) -> "StreamWriteBuilder":
+        return StreamWriteBuilder(self)
+
     def new_read_builder(self) -> "ReadBuilder":
         return ReadBuilder(self)
 
@@ -188,6 +192,38 @@ class BatchWriteBuilder:
 
     def new_commit(self) -> "TableCommit":
         return TableCommit(self.table, self.commit_user, self._overwrite)
+
+
+class StreamWriteBuilder:
+    """Checkpoint-driven streaming writes with exactly-once commits keyed
+    by commit identifier (reference table/sink/StreamWriteBuilder.java +
+    flink/sink/CommitterOperator.java:196: on checkpoint complete, commit
+    every pending identifier not yet committed by this user).
+
+    Usage:
+        wb = table.new_stream_write_builder().with_commit_user("job-7")
+        w, c = wb.new_write(), wb.new_commit()
+        w.write_dicts(batch); msgs = w.prepare_commit()
+        c.commit(msgs, commit_identifier=checkpoint_id)
+        # on recovery: replay pending checkpoints through
+        # c.filter_committed([...]) to drop already-committed ones
+    """
+
+    def __init__(self, table: FileStoreTable):
+        self.table = table
+        self.commit_user = str(uuid.uuid4())
+
+    def with_commit_user(self, commit_user: str) -> "StreamWriteBuilder":
+        """A STABLE user id is what makes replay dedup work across
+        restarts; defaults to a random uuid like the reference."""
+        self.commit_user = commit_user
+        return self
+
+    def new_write(self) -> "TableWrite":
+        return TableWrite(self.table, self.commit_user)
+
+    def new_commit(self) -> "TableCommit":
+        return TableCommit(self.table, self.commit_user)
 
 
 class TableWrite:
@@ -351,14 +387,21 @@ class TableRead:
         t = self._read.read_split(split)
         return self._finalize(t)
 
-    def to_arrow(self, splits: Sequence[DataSplit]) -> pa.Table:
-        out = self._read.read_splits(splits)
+    def to_arrow(self, splits) -> pa.Table:
+        """Accepts a ScanPlan or a list of DataSplits."""
+        if isinstance(splits, ScanPlan):
+            out = self._read.read_splits(splits.splits, splits.streaming)
+        else:
+            out = self._read.read_splits(splits)
         return self._finalize(out)
 
     def _finalize(self, t: pa.Table) -> pa.Table:
         if self.builder._projection:
+            from paimon_tpu.core.read import ROW_KIND_COL
             cols = [c for c in self.builder._projection
                     if c in t.column_names]
+            if ROW_KIND_COL in t.column_names:
+                cols.append(ROW_KIND_COL)
             t = t.select(cols)
         if self.builder._limit is not None:
             t = t.slice(0, self.builder._limit)

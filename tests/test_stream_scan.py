@@ -1,0 +1,257 @@
+"""Streaming plane: startup modes, follow-up scanners, row-kind
+preservation, consumer progress, exactly-once stream commits.
+
+reference semantics: table/source/DataTableStreamScan.java,
+source/snapshot/DeltaFollowUpScanner.java, ChangelogFollowUpScanner.java.
+"""
+
+import os
+
+import pytest
+
+from paimon_tpu.core.read import ROW_KIND_COL
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, RowKind
+
+
+def _make_table(tmp_warehouse, opts=None):
+    options = {"bucket": "1", "write-only": "true"}
+    options.update(opts or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options(options)
+              .build())
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def _commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows, row_kinds=kinds)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def _read_plan(table, plan):
+    rb = table.new_read_builder()
+    return rb.new_read().to_arrow(plan)
+
+
+def test_latest_full_then_deltas(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}])
+    _commit(table, [{"id": 2, "v": 22.0}])
+
+    scan = table.new_read_builder().new_stream_scan()
+    first = scan.plan()
+    rows = sorted(_read_plan(table, first).to_pylist(),
+                  key=lambda r: r["id"])
+    assert all(r.pop(ROW_KIND_COL) == RowKind.INSERT for r in rows)
+    assert rows == [{"id": 1, "v": 1.0}, {"id": 2, "v": 22.0}]
+    assert scan.plan() is None              # caught up
+
+    _commit(table, [{"id": 3, "v": 3.0}])
+    nxt = scan.plan()
+    out = _read_plan(table, nxt).to_pylist()
+    assert {r["id"] for r in out} == {3}
+    assert all(r[ROW_KIND_COL] == RowKind.INSERT for r in out)
+    assert scan.plan() is None
+
+
+def test_delta_follow_up_preserves_row_kinds(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+
+    scan = table.new_read_builder().new_stream_scan()
+    scan.plan()                             # initial full
+
+    _commit(table, [{"id": 1, "v": 0.0}], kinds=[RowKind.DELETE])
+    out = _read_plan(table, scan.plan()).to_pylist()
+    assert len(out) == 1
+    assert out[0][ROW_KIND_COL] == RowKind.DELETE   # -D survives
+
+
+def test_delta_follow_up_skips_compact_snapshots(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    scan = table.new_read_builder().new_stream_scan()
+    scan.plan()
+    _commit(table, [{"id": 1, "v": 2.0}])
+    table.compact(full=True)                # COMPACT snapshot
+    plans = []
+    while True:
+        p = scan.plan()
+        if p is None:
+            break
+        plans.append(p)
+    rows = [r for p in plans for r in _read_plan(table, p).to_pylist()]
+    # only the delta of the APPEND commit; compaction rewrite is not new
+    assert [r["v"] for r in rows] == [2.0]
+
+
+def test_startup_latest_sees_only_new(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    scan = table.copy({"scan.mode": "latest"}) \
+        .new_read_builder().new_stream_scan()
+    first = scan.plan()
+    assert first.splits == []
+    _commit(table, [{"id": 2, "v": 2.0}])
+    out = _read_plan(table, scan.plan()).to_pylist()
+    assert {r["id"] for r in out} == {2}
+
+
+def test_startup_from_snapshot(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])   # snapshot 1
+    _commit(table, [{"id": 2, "v": 2.0}])   # snapshot 2
+    _commit(table, [{"id": 3, "v": 3.0}])   # snapshot 3
+    scan = table.copy({"scan.mode": "from-snapshot",
+                       "scan.snapshot-id": "2"}) \
+        .new_read_builder().new_stream_scan()
+    assert scan.plan().splits == []         # no initial full scan
+    ids = []
+    while True:
+        p = scan.plan()
+        if p is None:
+            break
+        ids.extend(r["id"] for r in _read_plan(table, p).to_pylist())
+    assert ids == [2, 3]
+
+
+def test_startup_from_snapshot_full(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    _commit(table, [{"id": 1, "v": 9.0}])   # snapshot 2
+    _commit(table, [{"id": 3, "v": 3.0}])   # snapshot 3
+    scan = table.copy({"scan.mode": "from-snapshot-full",
+                       "scan.snapshot-id": "2"}) \
+        .new_read_builder().new_stream_scan()
+    first = _read_plan(table, scan.plan()).to_pylist()
+    assert sorted(r["v"] for r in first) == [9.0]    # merged state @2
+    nxt = _read_plan(table, scan.plan()).to_pylist()
+    assert [r["id"] for r in nxt] == [3]
+
+
+def test_startup_from_timestamp(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    snap1 = table.snapshot_manager.snapshot(1)
+    _commit(table, [{"id": 2, "v": 2.0}])
+    scan = table.copy({"scan.mode": "from-timestamp",
+                       "scan.timestamp-millis":
+                           str(snap1.time_millis)}) \
+        .new_read_builder().new_stream_scan()
+    assert scan.plan().splits == []
+    ids = []
+    while True:
+        p = scan.plan()
+        if p is None:
+            break
+        ids.extend(r["id"] for r in _read_plan(table, p).to_pylist())
+    assert ids == [2]
+
+
+def test_changelog_producer_input_follow_up(tmp_warehouse):
+    table = _make_table(tmp_warehouse,
+                        {"changelog-producer": "input"})
+    _commit(table, [{"id": 1, "v": 1.0}])
+    scan = table.new_read_builder().new_stream_scan()
+    scan.plan()
+    _commit(table, [{"id": 1, "v": 2.0}])
+    _commit(table, [{"id": 1, "v": 0.0}], kinds=[RowKind.DELETE])
+    rows = []
+    while True:
+        p = scan.plan()
+        if p is None:
+            break
+        rows.extend(_read_plan(table, p).to_pylist())
+    assert [(r["v"], r[ROW_KIND_COL]) for r in rows] == \
+        [(2.0, RowKind.INSERT), (0.0, RowKind.DELETE)]
+
+
+def test_consumer_progress_and_resume(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    t2 = table.copy({"consumer-id": "job-a"})
+    scan = t2.new_read_builder().new_stream_scan()
+    scan.plan()
+    # progress is only persisted once the caller confirms processing
+    assert table.consumer_manager.consumer("job-a") is None
+    scan.notify_checkpoint_complete(scan.checkpoint())
+    assert table.consumer_manager.consumer("job-a") == 2
+
+    _commit(table, [{"id": 2, "v": 2.0}])
+    # a NEW scan with the same consumer-id resumes from the recorded
+    # progress: no initial full scan, only the un-consumed delta
+    scan2 = t2.new_read_builder().new_stream_scan()
+    out = _read_plan(table, scan2.plan()).to_pylist()
+    assert {r["id"] for r in out} == {2}
+
+
+def test_checkpoint_restore(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    scan = table.new_read_builder().new_stream_scan()
+    scan.plan()
+    cp = scan.checkpoint()
+    _commit(table, [{"id": 2, "v": 2.0}])
+    # simulate failover: new scan restored at the checkpoint
+    scan2 = table.new_read_builder().new_stream_scan()
+    scan2.restore(cp)
+    out = _read_plan(table, scan2.plan()).to_pylist()
+    assert {r["id"] for r in out} == {2}
+
+
+def test_stream_write_exactly_once(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    wb = table.new_stream_write_builder().with_commit_user("job-1")
+    w = wb.new_write()
+    c = wb.new_commit()
+    w.write_dicts([{"id": 1, "v": 1.0}])
+    msgs = w.prepare_commit()
+    c.commit(msgs, commit_identifier=7)
+
+    # recovery replays checkpoint 7: filter_committed drops it
+    wb2 = table.new_stream_write_builder().with_commit_user("job-1")
+    c2 = wb2.new_commit()
+    assert c2.filter_committed([7, 8]) == [8]
+
+
+def test_compacted_full_does_not_skip_later_appends(tmp_warehouse):
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])   # snapshot 1 APPEND
+    table.compact(full=True)                # snapshot 2 COMPACT
+    _commit(table, [{"id": 2, "v": 2.0}])   # snapshot 3 APPEND
+    scan = table.copy({"scan.mode": "compacted-full"}) \
+        .new_read_builder().new_stream_scan()
+    first = _read_plan(table, scan.plan()).to_pylist()
+    assert {r["id"] for r in first} == {1}
+    rest = []
+    while True:
+        p = scan.plan()
+        if p is None:
+            break
+        rest.extend(_read_plan(table, p).to_pylist())
+    assert {r["id"] for r in rest} == {2}   # snapshot 3 not skipped
+
+
+def test_empty_streaming_poll_has_stable_schema(tmp_warehouse):
+    import pyarrow as pa
+    from paimon_tpu import predicate as P
+
+    table = _make_table(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    rb = (table.new_read_builder()
+          .with_filter(P.equal("id", 999)))
+    scan = rb.new_stream_scan()
+    scan.plan()
+    _commit(table, [{"id": 2, "v": 2.0}])
+    p = scan.plan()
+    t = rb.new_read().to_arrow(p)
+    assert t.num_rows == 0
+    assert ROW_KIND_COL in t.column_names   # schema stable across polls
