@@ -35,13 +35,19 @@ def compact_table(table, full: bool = False,
         groups.setdefault(key, []).append(e.file)
         total_buckets[key] = e.total_buckets
 
+    is_append = not table.schema.primary_keys
     messages: List[CommitMessage] = []
     for (pbytes, bucket), files in groups.items():
         partition = scan._partition_codec.from_bytes(pbytes)
-        mgr = MergeTreeCompactManager(
-            table.file_io, table.path, table.schema, table.options,
-            partition, bucket, files)
-        result = mgr.compact(full=full)
+        if is_append:
+            result = _append_compact(table, scan.path_factory, partition,
+                                     bucket, files, full)
+        else:
+            mgr = MergeTreeCompactManager(
+                table.file_io, table.path, table.schema, table.options,
+                partition, bucket, files,
+                schema_manager=table.schema_manager)
+            result = mgr.compact(full=full)
         if result is None or result.is_empty():
             continue
         messages.append(CommitMessage(
@@ -55,3 +61,36 @@ def compact_table(table, full: bool = False,
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)
     return commit.commit(messages, BATCH_COMMIT_IDENTIFIER)
+
+
+def _append_compact(table, path_factory, partition, bucket, files, full):
+    """Concatenate small append files into target-size files (reference
+    append/BucketedAppendCompactManager: no keys, order by sequence)."""
+    import pyarrow as pa
+
+    from paimon_tpu.core.append import (
+        AppendCompactResult, AppendFileWriter, append_compact_plan,
+    )
+    from paimon_tpu.core.kv_file import read_kv_file
+    from paimon_tpu.core.read import evolve_table
+    from paimon_tpu.manifest import FileSource
+
+    picked = append_compact_plan(files, table.options, full=full)
+    if not picked:
+        return None
+    writer = AppendFileWriter(
+        table.file_io, path_factory, table.schema,
+        file_format=table.options.file_format,
+        compression=table.options.file_compression,
+        target_file_size=table.options.target_file_size)
+    cache = {table.schema.id: table.schema}
+    tables = [evolve_table(
+                  read_kv_file(table.file_io, path_factory, partition,
+                               bucket, f, None, None),
+                  f.schema_id, table.schema, table.schema_manager, cache)
+              for f in picked]
+    data = pa.concat_tables(tables, promote_options="none")
+    after = writer.write(partition, bucket, data,
+                         picked[0].min_sequence_number,
+                         file_source=FileSource.COMPACT)
+    return AppendCompactResult(before=list(picked), after=after)

@@ -49,12 +49,14 @@ class MergeTreeCompactManager:
     def __init__(self, file_io: FileIO, table_path: str,
                  schema: TableSchema, options: CoreOptions,
                  partition: Tuple, bucket: int,
-                 files: List[DataFileMeta]):
+                 files: List[DataFileMeta], schema_manager=None):
         self.file_io = file_io
         self.schema = schema
         self.options = options
         self.partition = partition
         self.bucket = bucket
+        self.schema_manager = schema_manager
+        self._schema_cache = {schema.id: schema}
         self.levels = Levels(files, options.num_levels)
         self.strategy = UniversalCompaction(
             max_size_amp=options.max_size_amplification_percent,
@@ -121,11 +123,16 @@ class MergeTreeCompactManager:
 
     def rewrite(self, files: List[DataFileMeta], output_level: int,
                 drop_delete: bool) -> List[DataFileMeta]:
+        from paimon_tpu.core.read import evolve_table
+
         runs_meta = assemble_runs(files)
         runs = []
         for run_files in runs_meta:
-            tables = [read_kv_file(self.file_io, self.path_factory,
-                                   self.partition, self.bucket, f)
+            tables = [evolve_table(
+                          read_kv_file(self.file_io, self.path_factory,
+                                       self.partition, self.bucket, f),
+                          f.schema_id, self.schema, self.schema_manager,
+                          self._schema_cache, keep_sys_cols=True)
                       for f in run_files]
             runs.append(pa.concat_tables(tables, promote_options="none")
                         if len(tables) > 1 else tables[0])

@@ -1,0 +1,331 @@
+"""Append-only tables: write, read and compaction without keys.
+
+reference: paimon-core/.../append/AppendOnlyWriter.java (rolling plain
+files, inserts only), BucketedAppendCompactManager.java (contiguous
+small-file grouping per bucket), AppendOnlyFileStoreTable /
+AppendOnlySplitGenerator; unaware-bucket mode (BucketMode.BUCKET_UNAWARE,
+bucket = -1) stores every file under bucket-0 with no shuffle.
+
+Data files carry the plain value columns only (no _KEY_/_SEQUENCE_NUMBER/
+_VALUE_KIND); ordering comes from DataFileMeta sequence ranges.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.core.bucket import FixedBucketAssigner
+from paimon_tpu.core.kv_file import _safe_stats
+from paimon_tpu.core.scan import DataSplit
+from paimon_tpu.core.write import (
+    CommitMessage, ROW_KIND_COL, group_by_partition_bucket,
+)
+from paimon_tpu.format import get_format
+from paimon_tpu.format.format import extract_simple_stats
+from paimon_tpu.fs import FileIO
+from paimon_tpu.manifest import DataFileMeta, FileSource, SimpleStats
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.predicate import Predicate
+from paimon_tpu.schema.schema_manager import SchemaManager
+from paimon_tpu.schema.table_schema import TableSchema
+from paimon_tpu.types import RowKind, data_type_to_arrow
+from paimon_tpu.utils.path_factory import FileStorePathFactory
+
+__all__ = ["AppendOnlyFileStoreWrite", "AppendSplitRead",
+           "append_compact_plan"]
+
+
+class AppendFileWriter:
+    """Rolling writer for plain-column append files."""
+
+    def __init__(self, file_io: FileIO, path_factory: FileStorePathFactory,
+                 table_schema: TableSchema, file_format: str,
+                 compression: str, target_file_size: int):
+        self.file_io = file_io
+        self.path_factory = path_factory
+        self.schema = table_schema
+        self.file_format = file_format
+        self.compression = compression
+        self.target_file_size = target_file_size
+
+    def write(self, partition: Tuple, bucket: int, table: pa.Table,
+              first_seq: int,
+              file_source: int = FileSource.APPEND) -> List[DataFileMeta]:
+        if table.num_rows == 0:
+            return []
+        n = table.num_rows
+        bytes_per_row = max(1, table.nbytes // n)
+        rows_per_file = max(1024, self.target_file_size // bytes_per_row)
+        metas = []
+        seq = first_seq
+        for start in range(0, n, rows_per_file):
+            chunk = table.slice(start, min(rows_per_file, n - start))
+            metas.append(self._write_one(partition, bucket, chunk, seq,
+                                         file_source))
+            seq += chunk.num_rows
+        return metas
+
+    def _write_one(self, partition: Tuple, bucket: int, chunk: pa.Table,
+                   first_seq: int, file_source: int) -> DataFileMeta:
+        fmt = get_format(self.file_format)
+        name = self.path_factory.new_data_file_name(fmt.extension)
+        path = self.path_factory.data_file_path(partition, bucket, name)
+        size = fmt.create_writer(self.compression).write(
+            self.file_io, path, chunk)
+        value_cols = [f.name for f in self.schema.fields]
+        vmins, vmaxs, vnulls = extract_simple_stats(chunk, value_cols)
+        value_stats = _safe_stats([f.type for f in self.schema.fields],
+                                  vmins, vmaxs, vnulls)
+        return DataFileMeta(
+            file_name=name,
+            file_size=size,
+            row_count=chunk.num_rows,
+            min_key=b"",
+            max_key=b"",
+            key_stats=SimpleStats.EMPTY,
+            value_stats=value_stats,
+            min_sequence_number=first_seq,
+            max_sequence_number=first_seq + chunk.num_rows - 1,
+            schema_id=self.schema.id,
+            level=0,
+            file_source=file_source,
+        )
+
+
+class _AppendBucketWriter:
+    def __init__(self, parent: "AppendOnlyFileStoreWrite", partition: Tuple,
+                 bucket: int):
+        self.parent = parent
+        self.partition = partition
+        self.bucket = bucket
+        self.buffers: List[pa.Table] = []
+        self.buffered_bytes = 0
+        self.next_seq: Optional[int] = None
+        self.new_files: List[DataFileMeta] = []
+
+    def write(self, table: pa.Table):
+        self.buffers.append(table)
+        self.buffered_bytes += table.nbytes
+        if self.buffered_bytes >= self.parent.options.write_buffer_size:
+            self.flush()
+
+    def flush(self):
+        if not self.buffers:
+            return
+        raw = pa.concat_tables(self.buffers, promote_options="none")
+        self.buffers = []
+        self.buffered_bytes = 0
+        if self.next_seq is None:
+            self.next_seq = self.parent.restore_max_seq(
+                self.partition, self.bucket) + 1
+        metas = self.parent.file_writer.write(
+            self.partition, self.bucket, raw, self.next_seq)
+        self.next_seq += raw.num_rows
+        self.new_files.extend(metas)
+
+    def prepare_commit(self) -> Optional[CommitMessage]:
+        self.flush()
+        msg = CommitMessage(self.partition, self.bucket,
+                            self.parent.total_buckets,
+                            new_files=list(self.new_files))
+        self.new_files = []
+        return None if msg.is_empty() else msg
+
+
+class AppendOnlyFileStoreWrite:
+    """reference operation/AppendFileStoreWrite.java + AppendOnlyWriter:
+    inserts only, bucket by bucket-key hash (or single unaware bucket)."""
+
+    def __init__(self, file_io: FileIO, table_path: str,
+                 table_schema: TableSchema, options: CoreOptions,
+                 restore_max_seq: Optional[Callable[[Tuple, int], int]]
+                 = None):
+        self.file_io = file_io
+        self.schema = table_schema
+        self.options = options
+        self.partition_keys = table_schema.partition_keys
+        self.path_factory = FileStorePathFactory(
+            table_path, self.partition_keys,
+            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.file_writer = AppendFileWriter(
+            file_io, self.path_factory, table_schema,
+            file_format=options.file_format,
+            compression=options.file_compression,
+            target_file_size=options.target_file_size)
+        self.total_buckets = options.bucket
+        self._unaware = options.bucket < 1
+        if not self._unaware:
+            bucket_keys = table_schema.bucket_keys()
+            if not bucket_keys:
+                raise ValueError(
+                    "append table with bucket >= 1 requires 'bucket-key' "
+                    "(reference SchemaValidation)")
+            rt = table_schema.logical_row_type()
+            self.bucket_assigner = FixedBucketAssigner(
+                bucket_keys, [rt.get_field(k).type for k in bucket_keys],
+                options.bucket)
+        self._writers: Dict[Tuple, _AppendBucketWriter] = {}
+        self._restore_max_seq = restore_max_seq
+
+    def restore_max_seq(self, partition: Tuple, bucket: int) -> int:
+        if self._restore_max_seq is None:
+            return -1
+        return self._restore_max_seq(partition, bucket)
+
+    def write_arrow(self, table: pa.Table,
+                    row_kinds: Optional[np.ndarray] = None):
+        if ROW_KIND_COL in table.column_names:
+            row_kinds = np.asarray(table.column(ROW_KIND_COL)
+                                   .combine_chunks().cast(pa.int8()))
+            table = table.drop_columns([ROW_KIND_COL])
+        if row_kinds is not None and \
+                (np.asarray(row_kinds, np.int8) != RowKind.INSERT).any():
+            raise ValueError("append-only table accepts INSERT rows only "
+                             "(reference AppendOnlyWriter)")
+
+        if self._unaware:
+            buckets = np.zeros(table.num_rows, dtype=np.int32)
+        else:
+            buckets = self.bucket_assigner.assign(table)
+        for (part, bucket), idx in group_by_partition_bucket(
+                table, buckets, self.partition_keys):
+            sub = table.take(pa.array(idx))
+            key = (part, bucket)
+            if key not in self._writers:
+                self._writers[key] = _AppendBucketWriter(self, part, bucket)
+            self._writers[key].write(sub)
+
+    def prepare_commit(self) -> List[CommitMessage]:
+        out = []
+        for w in self._writers.values():
+            msg = w.prepare_commit()
+            if msg is not None:
+                out.append(msg)
+        return out
+
+    def close(self):
+        self._writers.clear()
+
+
+class AppendSplitRead:
+    """No-merge read over append splits (reference RawFileSplitRead used
+    by AppendOnlyFileStoreTable)."""
+
+    def __init__(self, file_io: FileIO, table_path: str,
+                 schema: TableSchema, options: CoreOptions,
+                 schema_manager: Optional[SchemaManager] = None):
+        self.file_io = file_io
+        self.schema = schema
+        self.options = options
+        self.schema_manager = schema_manager
+        self.path_factory = FileStorePathFactory(
+            table_path, schema.partition_keys,
+            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self._schema_cache: Dict[int, TableSchema] = {schema.id: schema}
+        self._projection: Optional[List[str]] = None
+        self._predicate: Optional[Predicate] = None
+
+    def with_projection(self, columns) -> "AppendSplitRead":
+        self._projection = list(columns) if columns else None
+        return self
+
+    def with_filter(self, predicate) -> "AppendSplitRead":
+        self._predicate = predicate
+        return self
+
+    def _value_columns(self) -> List[str]:
+        names = [f.name for f in self.schema.fields]
+        if self._projection:
+            return [n for n in names if n in set(self._projection)]
+        return names
+
+    def read_split(self, split: DataSplit) -> pa.Table:
+        from paimon_tpu.core.kv_file import read_kv_file
+        from paimon_tpu.core.read import ROW_KIND_COL as RK
+
+        tables = []
+        for meta in sorted(split.data_files,
+                           key=lambda f: f.min_sequence_number):
+            t = read_kv_file(self.file_io, self.path_factory,
+                             split.partition, split.bucket, meta, None, None)
+            t = self._evolve(t, meta.schema_id)
+            if split.deletion_vectors and \
+                    meta.file_name in split.deletion_vectors:
+                dv = split.deletion_vectors[meta.file_name]
+                t = t.filter(pa.array(dv.keep_mask(t.num_rows)))
+            tables.append(t)
+        out = pa.concat_tables(tables, promote_options="none") if tables \
+            else self._empty()
+        if self._predicate is not None:
+            out = out.filter(self._predicate.to_arrow())
+        out = out.select(self._value_columns())
+        if split.for_streaming:
+            out = out.append_column(
+                RK, pa.array(np.zeros(out.num_rows, np.int8), pa.int8()))
+        return out
+
+    def read_splits(self, splits: Sequence[DataSplit],
+                    streaming: Optional[bool] = None) -> pa.Table:
+        tables = [self.read_split(s) for s in splits]
+        tables = [t for t in tables if t.num_rows > 0]
+        if not tables:
+            from paimon_tpu.core.read import ROW_KIND_COL as RK
+            if streaming is None:
+                streaming = any(s.for_streaming for s in splits)
+            out = self._empty().select(self._value_columns())
+            if streaming:
+                out = out.append_column(RK, pa.array([], pa.int8()))
+            return out
+        return pa.concat_tables(tables, promote_options="default")
+
+    def _empty(self) -> pa.Table:
+        return pa.table({f.name: pa.array([], data_type_to_arrow(f.type))
+                         for f in self.schema.fields})
+
+    def _evolve(self, table: pa.Table, file_schema_id: int) -> pa.Table:
+        from paimon_tpu.core.read import evolve_table
+        return evolve_table(table, file_schema_id, self.schema,
+                            self.schema_manager, self._schema_cache)
+
+
+@dataclass
+class AppendCompactResult:
+    before: List[DataFileMeta]
+    after: List[DataFileMeta]
+
+    def is_empty(self) -> bool:
+        return not self.before
+
+
+def append_compact_plan(files: List[DataFileMeta], options: CoreOptions,
+                        full: bool = False) -> Optional[List[DataFileMeta]]:
+    """Pick the files to rewrite (reference
+    BucketedAppendCompactManager.pickCompactBefore: contiguous run of
+    small files, oldest first, at least compaction.min.file-num, stopping
+    once the accumulated size reaches the target)."""
+    if len(files) < 2:
+        return None
+    ordered = sorted(files, key=lambda f: f.min_sequence_number)
+    if full:
+        return ordered
+    target = options.target_file_size
+    min_num = options.get(CoreOptions.COMPACTION_MIN_FILE_NUM)
+    picked: List[DataFileMeta] = []
+    size = 0
+    for f in ordered:
+        if f.file_size < target:
+            picked.append(f)
+            size += f.file_size
+            if size >= target and len(picked) >= min_num:
+                return picked
+        else:
+            if len(picked) >= min_num:
+                return picked
+            picked, size = [], 0
+    if len(picked) >= min_num:
+        return picked
+    return None

@@ -32,9 +32,45 @@ from paimon_tpu.schema.table_schema import TableSchema
 from paimon_tpu.types import RowKind, data_type_to_arrow
 from paimon_tpu.utils.path_factory import FileStorePathFactory
 
-__all__ = ["MergeFileSplitRead", "assemble_runs", "ROW_KIND_COL"]
+__all__ = ["MergeFileSplitRead", "assemble_runs", "ROW_KIND_COL",
+           "evolve_table"]
 
 ROW_KIND_COL = "_ROW_KIND"
+
+
+def evolve_table(table: pa.Table, file_schema_id: int, schema: TableSchema,
+                 schema_manager: Optional[SchemaManager],
+                 cache: Dict[int, TableSchema],
+                 keep_sys_cols: bool = False) -> pa.Table:
+    """Map an old-schema file onto the read schema by field id
+    (reference schema/SchemaEvolutionUtil.java index+cast mapping).
+    Shared by both split readers and both compaction rewriters."""
+    if file_schema_id == schema.id:
+        return table
+    old = cache.get(file_schema_id)
+    if old is None:
+        if schema_manager is None:
+            return table
+        old = schema_manager.schema(file_schema_id)
+        cache[file_schema_id] = old
+    old_by_id = {f.id: f for f in old.fields}
+    cols = {}
+    n = table.num_rows
+    if keep_sys_cols:
+        for name in table.column_names:
+            if name.startswith(KEY_PREFIX) or name in (SEQ_COL, KIND_COL):
+                cols[name] = table.column(name)
+    for f in schema.fields:
+        old_f = old_by_id.get(f.id)
+        arrow_t = data_type_to_arrow(f.type)
+        if old_f is None or old_f.name not in table.column_names:
+            cols[f.name] = pa.nulls(n, arrow_t)
+        else:
+            col = table.column(old_f.name)
+            if col.type != arrow_t:
+                col = col.cast(arrow_t)
+            cols[f.name] = col
+    return pa.table(cols)
 
 
 def assemble_runs(files: Sequence[DataFileMeta]) -> List[List[DataFileMeta]]:
@@ -208,30 +244,6 @@ class MergeFileSplitRead:
     # -- schema evolution ----------------------------------------------------
 
     def _evolve(self, table: pa.Table, file_schema_id: int) -> pa.Table:
-        """Map an old-schema file onto the read schema by field id
-        (reference schema/SchemaEvolutionUtil.java index+cast mapping)."""
-        if file_schema_id == self.schema.id:
-            return table
-        old = self._schema_cache.get(file_schema_id)
-        if old is None:
-            if self.schema_manager is None:
-                return table
-            old = self.schema_manager.schema(file_schema_id)
-            self._schema_cache[file_schema_id] = old
-        old_by_id = {f.id: f for f in old.fields}
-        cols = {}
-        n = table.num_rows
-        for name in table.column_names:
-            if name.startswith(KEY_PREFIX) or name in (SEQ_COL, KIND_COL):
-                cols[name] = table.column(name)
-        for f in self.schema.fields:
-            old_f = old_by_id.get(f.id)
-            arrow_t = data_type_to_arrow(f.type)
-            if old_f is None or old_f.name not in table.column_names:
-                cols[f.name] = pa.nulls(n, arrow_t)
-            else:
-                col = table.column(old_f.name)
-                if col.type != arrow_t:
-                    col = col.cast(arrow_t)
-                cols[f.name] = col
-        return pa.table(cols)
+        return evolve_table(table, file_schema_id, self.schema,
+                            self.schema_manager, self._schema_cache,
+                            keep_sys_cols=True)

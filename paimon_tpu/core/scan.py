@@ -253,7 +253,10 @@ class FileStoreScan:
             files = [g.file for g in group]
             total_buckets = group[0].total_buckets
             max_level = max(f.level for f in files)
-            raw = (not for_delta
+            # append tables never merge; pk tables are raw-convertible only
+            # when a single non-L0 run fully covers the bucket
+            raw = (not self.schema.primary_keys) or \
+                  (not for_delta
                    and all(f.level == max_level and max_level > 0
                            for f in files)
                    and all((f.delete_row_count or 0) == 0 for f in files)

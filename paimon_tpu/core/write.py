@@ -52,6 +52,34 @@ class CommitMessage:
                     or self.compact_changelog)
 
 
+def group_by_partition_bucket(table: pa.Table, buckets: np.ndarray,
+                              partition_keys: Sequence[str]):
+    """Split rows into (partition_tuple, bucket) groups.
+    Returns [((part, bucket), row_indices)] — shared by the pk and
+    append write paths (reference RowKeyExtractor + ChannelComputer)."""
+    group_codes = [buckets]
+    part_dicts = []
+    for pk in partition_keys:
+        enc = table.column(pk).combine_chunks().dictionary_encode()
+        part_dicts.append(enc.dictionary)
+        group_codes.append(np.asarray(enc.indices))
+    if len(group_codes) == 1:
+        uniq, inverse = np.unique(buckets, return_inverse=True)
+        groups = [((), int(b)) for b in uniq]
+    else:
+        stacked = np.stack(group_codes, axis=1)
+        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+        groups = []
+        for row in uniq:
+            part = tuple(part_dicts[i][int(row[i + 1])].as_py()
+                         for i in range(len(partition_keys)))
+            groups.append((part, int(row[0])))
+    order = np.argsort(inverse, kind="stable")
+    bounds = np.searchsorted(inverse[order], np.arange(len(groups) + 1))
+    return [(groups[gi], order[bounds[gi]:bounds[gi + 1]])
+            for gi in range(len(groups))]
+
+
 def build_kv_table(raw: pa.Table, schema: TableSchema,
                    seq: np.ndarray, kinds: np.ndarray) -> pa.Table:
     """Flatten rows into the KV file layout:
@@ -225,30 +253,8 @@ class KeyValueFileStoreWrite:
         row_kinds = np.asarray(row_kinds, dtype=np.int8)
 
         buckets = self.bucket_assigner.assign(table)
-        group_codes = [buckets]
-        part_dicts = []
-        for pk in self.partition_keys:
-            enc = table.column(pk).combine_chunks().dictionary_encode()
-            part_dicts.append(enc.dictionary)
-            group_codes.append(np.asarray(enc.indices))
-        if len(group_codes) == 1:
-            labels = buckets
-            uniq, inverse = np.unique(labels, return_inverse=True)
-            groups = [((), int(b)) for b in uniq]
-        else:
-            stacked = np.stack(group_codes, axis=1)
-            uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
-            groups = []
-            for row in uniq:
-                part = tuple(part_dicts[i][int(row[i + 1])].as_py()
-                             for i in range(len(self.partition_keys)))
-                groups.append((part, int(row[0])))
-
-        order = np.argsort(inverse, kind="stable")
-        bounds = np.searchsorted(inverse[order],
-                                 np.arange(len(groups) + 1))
-        for gi, (part, bucket) in enumerate(groups):
-            idx = order[bounds[gi]:bounds[gi + 1]]
+        for (part, bucket), idx in group_by_partition_bucket(
+                table, buckets, self.partition_keys):
             sub = table.take(pa.array(idx))
             kinds = row_kinds[idx]
             self._writer(part, bucket).write(sub, kinds)

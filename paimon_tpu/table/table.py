@@ -234,9 +234,15 @@ class TableWrite:
         def restore(partition: Tuple, bucket: int) -> int:
             return scan.max_sequence_number(partition, bucket)
 
-        self._write = KeyValueFileStoreWrite(
-            table.file_io, table.path, table.schema, table.options,
-            restore_max_seq=restore)
+        if table.primary_keys:
+            self._write = KeyValueFileStoreWrite(
+                table.file_io, table.path, table.schema, table.options,
+                restore_max_seq=restore)
+        else:
+            from paimon_tpu.core.append import AppendOnlyFileStoreWrite
+            self._write = AppendOnlyFileStoreWrite(
+                table.file_io, table.path, table.schema, table.options,
+                restore_max_seq=restore)
 
     def write_arrow(self, data: pa.Table,
                     row_kinds: Optional[np.ndarray] = None):
@@ -375,9 +381,15 @@ class TableRead:
     def __init__(self, builder: ReadBuilder):
         self.builder = builder
         table = builder.table
-        self._read = MergeFileSplitRead(
-            table.file_io, table.path, table.schema, table.options,
-            schema_manager=table.schema_manager)
+        if table.primary_keys:
+            self._read = MergeFileSplitRead(
+                table.file_io, table.path, table.schema, table.options,
+                schema_manager=table.schema_manager)
+        else:
+            from paimon_tpu.core.append import AppendSplitRead
+            self._read = AppendSplitRead(
+                table.file_io, table.path, table.schema, table.options,
+                schema_manager=table.schema_manager)
         if builder._projection:
             self._read.with_projection(builder._projection)
         if builder._predicate is not None:

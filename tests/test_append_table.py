@@ -1,0 +1,163 @@
+"""Append-only tables: write/read/compact + streaming deltas.
+
+reference: append/AppendOnlyWriter.java,
+BucketedAppendCompactManager.java, AppendOnlyFileStoreTable.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.core.read import ROW_KIND_COL
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, RowKind, VarCharType
+
+
+def _make(tmp_warehouse, opts=None):
+    options = {}
+    options.update(opts or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType())
+              .column("name", VarCharType())
+              .column("v", DoubleType())
+              .options(options)
+              .build())
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def test_append_write_read_roundtrip(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "name": "a", "v": 1.0},
+                    {"id": 1, "name": "a", "v": 1.0}])   # duplicates kept
+    _commit(table, [{"id": 2, "name": "b", "v": 2.0}])
+    out = table.to_arrow()
+    assert out.num_rows == 3                              # no dedup
+    assert sorted(out.column("id").to_pylist()) == [1, 1, 2]
+
+
+def test_append_rejects_deletes(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    with pytest.raises(ValueError):
+        w.write_dicts([{"id": 1, "name": "a", "v": 1.0}],
+                      row_kinds=[RowKind.DELETE])
+
+
+def test_append_fixed_bucket_requires_bucket_key(tmp_warehouse):
+    table = _make(tmp_warehouse, {"bucket": "4"})
+    wb = table.new_batch_write_builder()
+    with pytest.raises(ValueError):
+        wb.new_write()
+
+
+def test_append_fixed_bucket_routing(tmp_warehouse):
+    table = _make(tmp_warehouse, {"bucket": "4", "bucket-key": "id"})
+    _commit(table, [{"id": i, "name": str(i), "v": float(i)}
+                    for i in range(100)])
+    splits = table.new_read_builder().new_scan().plan().splits
+    assert len(splits) > 1                               # spread over buckets
+    assert table.to_arrow().num_rows == 100
+
+
+def test_append_compaction_concatenates_small_files(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    for i in range(6):
+        _commit(table, [{"id": i, "name": "x", "v": float(i)}])
+    splits = table.new_read_builder().new_scan().plan().splits
+    n_before = sum(len(s.data_files) for s in splits)
+    assert n_before == 6
+    sid = table.compact(full=True)
+    assert sid is not None
+    splits = table.new_read_builder().new_scan().plan().splits
+    n_after = sum(len(s.data_files) for s in splits)
+    assert n_after == 1
+    out = table.to_arrow()
+    assert sorted(out.column("v").to_pylist()) == [0.0, 1.0, 2.0, 3.0,
+                                                   4.0, 5.0]
+
+
+def test_append_small_file_picker(tmp_warehouse):
+    """Non-full compaction only fires with >= compaction.min.file-num
+    small files."""
+    table = _make(tmp_warehouse, {"compaction.min.file-num": "5"})
+    for i in range(3):
+        _commit(table, [{"id": i, "name": "x", "v": float(i)}])
+    assert table.compact() is None          # 3 < 5: nothing to do
+    for i in range(3):
+        _commit(table, [{"id": i, "name": "y", "v": float(i)}])
+    assert table.compact() is not None      # 6 >= 5
+
+
+def test_append_streaming_delta(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "name": "a", "v": 1.0}])
+    scan = table.new_read_builder().new_stream_scan()
+    rd = table.new_read_builder().new_read()
+    first = rd.to_arrow(scan.plan())
+    assert first.num_rows == 1
+    assert ROW_KIND_COL in first.column_names
+    _commit(table, [{"id": 2, "name": "b", "v": 2.0}])
+    table.compact(full=True)
+    nxt = rd.to_arrow(scan.plan())
+    assert nxt.column("id").to_pylist() == [2]
+    # compact snapshot is skipped by the delta follow-up
+    p = scan.plan()
+    assert p is None or rd.to_arrow(p).num_rows == 0
+
+
+def test_append_partitioned(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("dt", VarCharType(nullable=False))
+              .column("v", DoubleType())
+              .partition_keys("dt")
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "p"), schema)
+    _commit(table, [{"dt": "d1", "v": 1.0}, {"dt": "d2", "v": 2.0}])
+    out = table.new_read_builder().with_partition_filter({"dt": "d1"})
+    plan = out.new_scan().plan()
+    rows = out.new_read().to_arrow(plan).to_pylist()
+    assert rows == [{"dt": "d1", "v": 1.0}]
+
+
+def test_append_projection_and_predicate(tmp_warehouse):
+    from paimon_tpu import predicate as P
+
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": i, "name": str(i), "v": float(i)}
+                    for i in range(10)])
+    out = table.to_arrow(projection=["id"],
+                         predicate=P.greater_than("id", 7))
+    assert sorted(out.column("id").to_pylist()) == [8, 9]
+    assert out.column_names == ["id"]
+
+
+def test_append_compact_after_schema_evolution(tmp_warehouse):
+    """Compaction must evolve old-schema files before rewrite."""
+    from paimon_tpu.schema.schema_manager import SchemaChange
+    from paimon_tpu.types import IntType
+
+    table = _make(tmp_warehouse)
+    for i in range(3):
+        _commit(table, [{"id": i, "name": "a", "v": 1.0}])
+    table.schema_manager.commit_changes(SchemaChange.add_column(
+        "extra", IntType()))
+    table = FileStoreTable.load(table.path)
+    for i in range(3):
+        _commit(table, [{"id": i, "name": "b", "v": 2.0, "extra": i}])
+    assert table.compact(full=True) is not None
+    out = table.to_arrow()
+    assert out.num_rows == 6
+    assert out.column("extra").null_count == 3
