@@ -14,6 +14,7 @@ to HBM.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -73,8 +74,15 @@ def sequence_groups(schema: TableSchema,
     return groups
 
 
-def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray):
-    """Shared device sort -> (order over real rows, segment ids)."""
+def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
+                           truncated: Optional[np.ndarray] = None,
+                           full_key=None):
+    """Shared device sort -> (order over real rows, segment ids).
+
+    If some rows' string keys exceeded the lane prefix (`truncated`),
+    device segments may over-group prefix-equal keys; the affected spans
+    are repaired on the host by re-sorting on the full key (`full_key`:
+    row index -> comparable tuple) and splitting sub-segments."""
     n = lanes.shape[0]
     perm, winner, _ = device_sorted_winners(lanes, seq, "last")
     real = perm < n
@@ -85,25 +93,53 @@ def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray):
         seg_end[-1] = True
     seg_id = np.concatenate([[0], np.cumsum(seg_end[:-1])]) \
         if len(seg_end) else np.zeros(0, np.int64)
-    return order, seg_id.astype(np.int64), win_sorted
+    seg_id = seg_id.astype(np.int64)
+
+    if truncated is not None and truncated.any() and full_key is not None:
+        aff_ids = np.unique(seg_id[truncated[order]])
+        m = len(order)
+        if len(aff_ids) and m:
+            # seg_id is sorted, so each affected segment is one contiguous
+            # span located in O(log n); only those spans pay host work
+            starts = np.searchsorted(seg_id, aff_ids, side="left")
+            ends = np.searchsorted(seg_id, aff_ids, side="right")
+            new_order = order.copy()
+            boundaries = np.empty(m, dtype=bool)   # True = segment start
+            boundaries[0] = True
+            boundaries[1:] = seg_id[1:] != seg_id[:-1]
+            for s, e in zip(starts, ends):
+                span = order[s:e].tolist()
+                fk = {r: full_key(r) for r in span}
+                resorted = sorted(span, key=lambda r: (fk[r], int(seq[r])))
+                new_order[s:e] = resorted
+                prev_key = None
+                for k, r in enumerate(resorted):
+                    boundaries[s + k] = (fk[r] != prev_key)
+                    prev_key = fk[r]
+            order = new_order
+            seg_id = np.cumsum(boundaries) - 1
+            win_sorted = np.empty(m, dtype=bool)
+            win_sorted[:-1] = seg_id[:-1] != seg_id[1:]
+            win_sorted[-1] = True
+    return order, seg_id, win_sorted
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=2)
 def _seg_sum(vals, seg_ids, num_seg):
     return jax.ops.segment_sum(vals, seg_ids, num_segments=num_seg)
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=2)
 def _seg_max(vals, seg_ids, num_seg):
     return jax.ops.segment_max(vals, seg_ids, num_segments=num_seg)
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=2)
 def _seg_min(vals, seg_ids, num_seg):
     return jax.ops.segment_min(vals, seg_ids, num_segments=num_seg)
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=2)
 def _seg_prod(vals, seg_ids, num_seg):
     return jax.ops.segment_prod(vals, seg_ids, num_segments=num_seg)
 
@@ -152,12 +188,16 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
             [table.schema.field(k).type for k in key_cols],
             nullable=[table.schema.field(k).nullable for k in key_cols])
     lanes, truncated = key_encoder.encode_table(table, key_cols)
-    if truncated.any():
-        raise NotImplementedError(
-            "aggregation merge with >prefix string keys not supported yet; "
-            "raise tpu.key-prefix-lanes")
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
-    order, seg_id, win_sorted = _segment_ids_from_sort(lanes, seq)
+    full_key = None
+    if truncated.any():
+        kcols = [table.column(k) for k in key_cols]
+
+        def full_key(i: int):
+            return tuple(c[int(i)].as_py() for c in kcols)
+
+    order, seg_id, win_sorted = _segment_ids_from_sort(
+        lanes, seq, truncated, full_key)
     num_seg = int(seg_id[-1]) + 1 if len(seg_id) else 0
     win_pos = np.flatnonzero(win_sorted)           # last row of each segment
 
@@ -177,11 +217,40 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         out_cols[name] = sorted_tbl.column(name).take(pa.array(win_pos))
 
     add_mask = ~retract
+
+    # sequence groups (partial-update): each group's member columns take
+    # their values from the row with the LARGEST group-sequence value
+    # instead of the global sequence order (reference
+    # PartialUpdateMergeFunction sequence groups; ties -> later row wins)
+    seq_group_idx: Dict[str, np.ndarray] = {}
+    if options.merge_engine == MergeEngine.PARTIAL_UPDATE:
+        for gkey, cols in sequence_groups(schema, options).items():
+            seq_fields = [s.strip() for s in gkey.split(",")]
+            idx = _seq_group_winner_index(sorted_tbl, seq_fields, seg_id,
+                                          num_seg, add_mask)
+            for colname in dict.fromkeys(list(cols) + seq_fields):
+                if options.options.get_or(
+                        f"fields.{colname}.aggregate-function",
+                        None) is not None:
+                    raise NotImplementedError(
+                        f"aggregate-function on sequence-group member "
+                        f"{colname!r} (reference: aggregation within "
+                        f"sequence groups) is not supported yet")
+                seq_group_idx[colname] = idx
+
     for f in schema.fields:
         name = f.name
         col_sorted = sorted_tbl.column(name)
         if name not in aggs:   # key column: winner value
             out_cols[name] = col_sorted.take(pa.array(win_pos))
+            continue
+        if name in seq_group_idx:
+            idx = seq_group_idx[name]
+            taken = col_sorted.take(pa.array(np.where(idx < 0, 0, idx)))
+            nulls = pa.array(idx < 0)
+            out_cols[name] = pc.if_else(
+                nulls, pa.nulls(num_seg, taken.type),
+                taken.combine_chunks())
             continue
         func = aggs[name]
         valid = np.asarray(pc.is_valid(col_sorted.combine_chunks()))
@@ -239,6 +308,14 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
             out_cols[name] = _listagg(col_sorted, valid & add_mask, seg_id,
                                       num_seg, options, name)
             continue
+        elif func == "collect":
+            out_cols[name] = _collect(col_sorted, valid & add_mask, seg_id,
+                                      num_seg, options, name)
+            continue
+        elif func == "merge_map":
+            out_cols[name] = _merge_map(col_sorted, valid & add_mask,
+                                        seg_id, num_seg)
+            continue
         elif func in ("bool_and", "bool_or"):
             vals = np.asarray(col_sorted.combine_chunks()
                               .fill_null(func == "bool_and"))
@@ -270,6 +347,100 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
     if drop.any():
         out = out.filter(pa.array(~drop))
     return out
+
+
+def _seq_group_winner_index(sorted_tbl: pa.Table, seq_fields: List[str],
+                            seg_id: np.ndarray, num_seg: int,
+                            add_mask: np.ndarray) -> np.ndarray:
+    """Per segment: position (into sorted order) of the row with the
+    largest non-null group-sequence tuple; -1 if no row qualifies.
+    Rows with any null sequence field never update the group (reference
+    PartialUpdateMergeFunction: null sequence -> skip)."""
+    n = sorted_tbl.num_rows
+    valid = np.ones(n, dtype=bool)
+    mats = []
+    for fname in seq_fields:
+        arr = sorted_tbl.column(fname).combine_chunks()
+        valid &= np.asarray(pc.is_valid(arr))
+        t = arr.type
+        if pa.types.is_date(t) or pa.types.is_time(t):
+            # date32/time32 -> int64 is not a direct arrow cast
+            vals = np.asarray(arr.cast(pa.int32()).fill_null(0)) \
+                .astype(np.int64)
+        elif pa.types.is_integer(t) or pa.types.is_temporal(t):
+            vals = np.asarray(arr.cast(pa.int64()).fill_null(0))
+        elif pa.types.is_floating(t):
+            vals = np.asarray(arr.cast(pa.float64()).fill_null(0))
+        elif pa.types.is_decimal(t):
+            vals = np.array([0 if v is None else int(v.scaleb(t.scale))
+                             for v in arr.to_pylist()], dtype=object)
+        else:
+            raise ValueError(
+                f"sequence-group field {fname!r} must be numeric or "
+                f"temporal, got {t}")
+        # rank per field on its native dtype (no cross-field upcasting,
+        # which would collapse int64 values above 2^53 into float64)
+        _, field_rank = np.unique(vals, return_inverse=True)
+        mats.append(field_rank.astype(np.int64))
+    # order-preserving combined rank with tie equality
+    stacked = np.stack(mats, axis=1)
+    _, rank = np.unique(stacked, axis=0, return_inverse=True)
+    mask = valid & add_mask
+    masked = np.where(mask, rank.astype(np.int64), -1)
+    mx = np.asarray(_seg_max(jnp.asarray(masked), jnp.asarray(seg_id),
+                             num_seg))
+    is_max = mask & (masked == mx[seg_id]) & (mx[seg_id] >= 0)
+    return _last_index_where(is_max, seg_id, num_seg)
+
+
+def _collect(col_sorted, mask, seg_id, num_seg, options, name):
+    """reference aggregate/FieldCollectAgg: gather values into an array
+    (fields.<name>.distinct=true dedups)."""
+    distinct = options.options.get_or(f"fields.{name}.distinct",
+                                      "false") == "true"
+    vals = col_sorted.to_pylist()
+    acc: List[Optional[list]] = [None] * num_seg
+    for i in np.flatnonzero(mask):
+        g = seg_id[i]
+        if acc[g] is None:
+            acc[g] = []
+        v = vals[i]
+        if isinstance(v, list):
+            acc[g].extend(v)
+        else:
+            acc[g].append(v)
+    if distinct:
+        def _dedup(a):
+            try:
+                return list(dict.fromkeys(a))
+            except TypeError:       # unhashable elements (nested types)
+                seen, out = set(), []
+                for v in a:
+                    r = repr(v)
+                    if r not in seen:
+                        seen.add(r)
+                        out.append(v)
+                return out
+        acc = [None if a is None else _dedup(a) for a in acc]
+    return pa.array(acc, col_sorted.type if pa.types.is_list(
+        col_sorted.type) else pa.list_(col_sorted.type))
+
+
+def _merge_map(col_sorted, mask, seg_id, num_seg):
+    """reference aggregate/FieldMergeMapAgg: later maps overwrite earlier
+    keys."""
+    vals = col_sorted.to_pylist()
+    acc: List[Optional[dict]] = [None] * num_seg
+    for i in np.flatnonzero(mask):
+        g = seg_id[i]
+        v = vals[i]
+        if v is None:
+            continue
+        if acc[g] is None:
+            acc[g] = {}
+        acc[g].update(dict(v))
+    return pa.array([None if a is None else list(a.items()) for a in acc],
+                    col_sorted.type)
 
 
 def _listagg(col_sorted, mask, seg_id, num_seg, options, name):

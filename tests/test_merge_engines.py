@@ -1,0 +1,180 @@
+"""Merge-engine semantics: sequence groups, partial-update matrix, long
+string keys in agg merges, extra aggregators.
+
+reference oracle: mergetree/compact/PartialUpdateMergeFunction.java
+(sequence groups), aggregate/FieldCollectAgg, FieldMergeMapAgg.
+"""
+
+import os
+
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType, VarCharType
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def _pu_table(tmp_warehouse, opts=None):
+    options = {"bucket": "1", "merge-engine": "partial-update",
+               "write-only": "true"}
+    options.update(opts or {})
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("a", IntType())
+              .column("b", IntType())
+              .column("g1_seq", IntType())
+              .column("c", IntType())
+              .primary_key("k")
+              .options(options)
+              .build())
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def test_sequence_group_out_of_order_update_ignored(tmp_warehouse):
+    """BASELINE config-3 shape: columns a,b update only when g1_seq
+    advances; c follows the global order."""
+    table = _pu_table(tmp_warehouse,
+                      {"fields.g1_seq.sequence-group": "a,b"})
+    _commit(table, [{"k": 1, "a": 10, "b": 10, "g1_seq": 5, "c": 1}])
+    # late event: lower group sequence -> a,b must NOT regress; c updates
+    _commit(table, [{"k": 1, "a": 99, "b": 99, "g1_seq": 3, "c": 2}])
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["a"], row["b"], row["g1_seq"]) == (10, 10, 5)
+    assert row["c"] == 2
+
+
+def test_sequence_group_advance_overwrites(tmp_warehouse):
+    table = _pu_table(tmp_warehouse,
+                      {"fields.g1_seq.sequence-group": "a,b"})
+    _commit(table, [{"k": 1, "a": 1, "b": 1, "g1_seq": 1, "c": 1}])
+    _commit(table, [{"k": 1, "a": 2, "b": None, "g1_seq": 7, "c": None}])
+    row = table.to_arrow().to_pylist()[0]
+    # sequence advanced: group takes the new row's values, null included
+    assert (row["a"], row["b"], row["g1_seq"]) == (2, None, 7)
+    # c is plain partial-update: null does not overwrite
+    assert row["c"] == 1
+
+
+def test_sequence_group_null_sequence_never_updates(tmp_warehouse):
+    table = _pu_table(tmp_warehouse,
+                      {"fields.g1_seq.sequence-group": "a,b"})
+    _commit(table, [{"k": 1, "a": 1, "b": 1, "g1_seq": 4, "c": 1}])
+    _commit(table, [{"k": 1, "a": 9, "b": 9, "g1_seq": None, "c": 9}])
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["a"], row["b"], row["g1_seq"]) == (1, 1, 4)
+    assert row["c"] == 9
+
+
+def test_sequence_group_tie_later_row_wins(tmp_warehouse):
+    table = _pu_table(tmp_warehouse,
+                      {"fields.g1_seq.sequence-group": "a,b"})
+    _commit(table, [{"k": 1, "a": 1, "b": 1, "g1_seq": 5, "c": 1}])
+    _commit(table, [{"k": 1, "a": 2, "b": 2, "g1_seq": 5, "c": 2}])
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["a"], row["b"]) == (2, 2)
+
+
+def test_two_sequence_groups_independent(tmp_warehouse):
+    options = {"bucket": "1", "merge-engine": "partial-update",
+               "write-only": "true",
+               "fields.s1.sequence-group": "a",
+               "fields.s2.sequence-group": "b"}
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("a", IntType()).column("s1", IntType())
+              .column("b", IntType()).column("s2", IntType())
+              .primary_key("k").options(options).build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t2"), schema)
+    _commit(table, [{"k": 1, "a": 1, "s1": 10, "b": 1, "s2": 1}])
+    _commit(table, [{"k": 1, "a": 2, "s1": 5, "b": 2, "s2": 2}])
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["a"], row["s1"]) == (1, 10)   # s1 regressed: no update
+    assert (row["b"], row["s2"]) == (2, 2)    # s2 advanced: update
+
+
+def test_agg_merge_long_string_keys(tmp_warehouse):
+    """Lifted limitation: string PKs longer than the 16-byte lane prefix
+    must still aggregate per full key (host repair path)."""
+    schema = (Schema.builder()
+              .column("k", VarCharType(nullable=False))
+              .column("v", BigIntType())
+              .primary_key("k")
+              .options({"bucket": "1", "merge-engine": "aggregation",
+                        "fields.v.aggregate-function": "sum",
+                        "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    base = "k" * 20                       # shared 16-byte prefix
+    _commit(table, [{"k": base + "A", "v": 1},
+                    {"k": base + "B", "v": 10}])
+    _commit(table, [{"k": base + "A", "v": 2},
+                    {"k": base + "B", "v": 20},
+                    {"k": "short", "v": 100}])
+    rows = {r["k"]: r["v"] for r in table.to_arrow().to_pylist()}
+    assert rows == {base + "A": 3, base + "B": 30, "short": 100}
+
+
+def test_partial_update_remove_record_on_delete(tmp_warehouse):
+    from paimon_tpu.types import RowKind
+
+    table = _pu_table(tmp_warehouse,
+                      {"partial-update.remove-record-on-delete": "true"})
+    _commit(table, [{"k": 1, "a": 1, "b": 1, "g1_seq": 1, "c": 1},
+                    {"k": 2, "a": 2, "b": 2, "g1_seq": 2, "c": 2}])
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"k": 1, "a": None, "b": None, "g1_seq": None,
+                    "c": None}], row_kinds=[RowKind.DELETE])
+    wb.new_commit().commit(w.prepare_commit())
+    rows = table.to_arrow().to_pylist()
+    assert [r["k"] for r in rows] == [2]
+
+
+def test_collect_aggregator(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("tags", VarCharType())
+              .primary_key("k")
+              .options({"bucket": "1", "merge-engine": "aggregation",
+                        "fields.tags.aggregate-function": "collect",
+                        "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    _commit(table, [{"k": 1, "tags": "x"}])
+    _commit(table, [{"k": 1, "tags": "y"}])
+    row = table.to_arrow().to_pylist()[0]
+    assert row["tags"] == ["x", "y"]
+
+
+def test_sequence_group_date_field(tmp_warehouse):
+    from paimon_tpu.types import DateType
+    import datetime
+
+    options = {"bucket": "1", "merge-engine": "partial-update",
+               "write-only": "true", "fields.d.sequence-group": "a"}
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("a", IntType()).column("d", DateType())
+              .primary_key("k").options(options).build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "td"), schema)
+    _commit(table, [{"k": 1, "a": 1, "d": datetime.date(2026, 7, 28)}])
+    _commit(table, [{"k": 1, "a": 2, "d": datetime.date(2026, 7, 20)}])
+    row = table.to_arrow().to_pylist()[0]
+    assert row["a"] == 1                       # stale date: no update
+
+
+def test_sequence_group_member_with_agg_function_rejected(tmp_warehouse):
+    table = _pu_table(tmp_warehouse,
+                      {"fields.g1_seq.sequence-group": "a,b",
+                       "fields.a.aggregate-function": "sum"})
+    _commit(table, [{"k": 1, "a": 1, "b": 1, "g1_seq": 1, "c": 1}])
+    with pytest.raises(NotImplementedError):
+        table.to_arrow()
