@@ -1,0 +1,13 @@
+"""Maintenance operations: snapshot/partition expiration, orphan cleanup.
+
+reference: operation/SnapshotDeletion.java, ExpireSnapshotsImpl,
+operation/OrphanFilesClean.java, operation/PartitionExpire.java.
+"""
+
+from paimon_tpu.maintenance.expire import (  # noqa: F401
+    ExpireResult, expire_snapshots,
+)
+from paimon_tpu.maintenance.orphan import remove_orphan_files  # noqa: F401
+from paimon_tpu.maintenance.partition_expire import (  # noqa: F401
+    expire_partitions,
+)

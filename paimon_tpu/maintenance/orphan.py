@@ -1,0 +1,75 @@
+"""Orphan-file cleanup.
+
+reference: operation/OrphanFilesClean.java / LocalOrphanFilesClean: files
+in the table directory referenced by NO snapshot/tag/branch and older
+than a grace period (default 1 day, guards in-flight writers) are
+deleted.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional, Set
+
+from paimon_tpu.snapshot import SnapshotManager
+
+__all__ = ["remove_orphan_files"]
+
+_META_DIRS = {"snapshot", "schema", "manifest", "tag", "branch", "consumer",
+              "statistics"}
+DEFAULT_OLDER_THAN_MS = 24 * 3600 * 1000
+
+
+def _all_snapshots(table):
+    sm = table.snapshot_manager
+    out = list(sm.snapshots())
+    out.extend(table.tag_manager.tagged_snapshots())
+    for b in table.branch_manager.branches():
+        bsm = SnapshotManager(table.file_io, table.path, branch=b)
+        out.extend(bsm.snapshots())
+    return out
+
+
+def _walk_files(file_io, root: str, out: List):
+    for st in file_io.list_status(root):
+        if st.is_dir:
+            _walk_files(file_io, st.path, out)
+        else:
+            out.append(st)
+
+
+def remove_orphan_files(table, older_than_ms: Optional[int] = None,
+                        dry_run: bool = False) -> List[str]:
+    """Delete unreferenced data/manifest/index files older than the
+    grace period. Returns the deleted paths."""
+    cutoff = (int(_time.time() * 1000) - DEFAULT_OLDER_THAN_MS) \
+        if older_than_ms is None else older_than_ms
+
+    from paimon_tpu.maintenance.expire import _snapshot_refs
+    referenced: Set[str] = set()
+    for snap in _all_snapshots(table):
+        data, manifests = _snapshot_refs(table, snap)
+        referenced |= {fname for (_, _, fname) in data}
+        referenced |= manifests
+
+    candidates = []
+    for st in table.file_io.list_status(table.path):
+        base = st.path.rstrip("/").split("/")[-1]
+        if not st.is_dir:
+            continue
+        if base in _META_DIRS:
+            if base != "manifest":
+                continue
+        _walk_files(table.file_io, st.path, candidates)
+
+    deleted = []
+    for st in candidates:
+        fname = st.path.rstrip("/").split("/")[-1]
+        if fname in referenced:
+            continue
+        if st.mtime_ms and st.mtime_ms >= cutoff:
+            continue
+        deleted.append(st.path)
+        if not dry_run:
+            table.file_io.delete_quietly(st.path)
+    return deleted

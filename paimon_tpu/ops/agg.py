@@ -125,23 +125,42 @@ def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
 
 
 @partial(jax.jit, static_argnums=2)
-def _seg_sum(vals, seg_ids, num_seg):
+def _seg_sum_jit(vals, seg_ids, num_seg):
     return jax.ops.segment_sum(vals, seg_ids, num_segments=num_seg)
 
 
 @partial(jax.jit, static_argnums=2)
-def _seg_max(vals, seg_ids, num_seg):
+def _seg_max_jit(vals, seg_ids, num_seg):
     return jax.ops.segment_max(vals, seg_ids, num_segments=num_seg)
 
 
 @partial(jax.jit, static_argnums=2)
-def _seg_min(vals, seg_ids, num_seg):
+def _seg_min_jit(vals, seg_ids, num_seg):
     return jax.ops.segment_min(vals, seg_ids, num_segments=num_seg)
 
 
 @partial(jax.jit, static_argnums=2)
-def _seg_prod(vals, seg_ids, num_seg):
+def _seg_prod_jit(vals, seg_ids, num_seg):
     return jax.ops.segment_prod(vals, seg_ids, num_segments=num_seg)
+
+
+def _padded_seg(fn_jit):
+    """num_segments must be jit-static; padding it to the next power of
+    two keeps XLA compiles O(log n) across merges instead of one per
+    distinct key count. Padding segments produce the op identity and are
+    sliced off."""
+    def call(vals, seg_ids, num_seg):
+        padded = 1 << max(4, int(num_seg - 1).bit_length()) \
+            if num_seg > 0 else 1
+        out = fn_jit(jnp.asarray(vals), jnp.asarray(seg_ids), padded)
+        return jnp.asarray(out)[:num_seg]
+    return call
+
+
+_seg_sum = _padded_seg(_seg_sum_jit)
+_seg_max = _padded_seg(_seg_max_jit)
+_seg_min = _padded_seg(_seg_min_jit)
+_seg_prod = _padded_seg(_seg_prod_jit)
 
 
 def _last_index_where(mask: np.ndarray, seg_id: np.ndarray,
@@ -309,6 +328,12 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
                                       num_seg, options, name)
             continue
         elif func == "collect":
+            if not pa.types.is_list(col_sorted.type) and \
+                    not pa.types.is_large_list(col_sorted.type):
+                raise ValueError(
+                    f"collect aggregate requires field {name!r} to be "
+                    f"declared ARRAY<...>, got {f.type} (reference "
+                    f"FieldCollectAgg)")
             out_cols[name] = _collect(col_sorted, valid & add_mask, seg_id,
                                       num_seg, options, name)
             continue
@@ -363,8 +388,8 @@ def _seq_group_winner_index(sorted_tbl: pa.Table, seq_fields: List[str],
         arr = sorted_tbl.column(fname).combine_chunks()
         valid &= np.asarray(pc.is_valid(arr))
         t = arr.type
-        if pa.types.is_date(t) or pa.types.is_time(t):
-            # date32/time32 -> int64 is not a direct arrow cast
+        if pa.types.is_date32(t) or pa.types.is_time32(t):
+            # 32-bit temporals -> int64 is not a direct arrow cast
             vals = np.asarray(arr.cast(pa.int32()).fill_null(0)) \
                 .astype(np.int64)
         elif pa.types.is_integer(t) or pa.types.is_temporal(t):

@@ -141,6 +141,34 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
+    # -- maintenance ---------------------------------------------------------
+
+    def expire_snapshots(self, retain_max: Optional[int] = None,
+                         retain_min: Optional[int] = None,
+                         older_than_ms: Optional[int] = None,
+                         dry_run: bool = False):
+        """reference operation/ExpireSnapshotsImpl.java."""
+        from paimon_tpu.maintenance import expire_snapshots
+        return expire_snapshots(self, retain_max=retain_max,
+                                retain_min=retain_min,
+                                older_than_ms=older_than_ms,
+                                dry_run=dry_run)
+
+    def remove_orphan_files(self, older_than_ms: Optional[int] = None,
+                            dry_run: bool = False):
+        """reference operation/OrphanFilesClean.java."""
+        from paimon_tpu.maintenance import remove_orphan_files
+        return remove_orphan_files(self, older_than_ms=older_than_ms,
+                                   dry_run=dry_run)
+
+    def expire_partitions(self, expiration_ms: Optional[int] = None,
+                          now_ms: Optional[int] = None,
+                          dry_run: bool = False):
+        """reference operation/PartitionExpire.java."""
+        from paimon_tpu.maintenance import expire_partitions
+        return expire_partitions(self, expiration_ms=expiration_ms,
+                                 now_ms=now_ms, dry_run=dry_run)
+
     def create_tag(self, name: str, snapshot_id: Optional[int] = None):
         snap = (self.snapshot_manager.snapshot(snapshot_id)
                 if snapshot_id is not None

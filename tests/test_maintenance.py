@@ -1,0 +1,152 @@
+"""Maintenance: snapshot expiration (ref-counted, tag/consumer aware),
+orphan cleanup, partition expiration.
+
+reference: operation/ExpireSnapshotsImpl.java, SnapshotDeletion.java,
+OrphanFilesClean.java, PartitionExpire.java.
+"""
+
+import os
+import time
+
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, VarCharType
+
+
+def _make(tmp_warehouse, opts=None, partitioned=False):
+    b = (Schema.builder()
+         .column("id", BigIntType(False))
+         .column("v", DoubleType()))
+    if partitioned:
+        b = b.column("dt", VarCharType(nullable=False)).partition_keys("dt")
+    options = {"bucket": "1", "write-only": "true"}
+    options.update(opts or {})
+    schema = b.primary_key(*(["id", "dt"] if partitioned else ["id"])) \
+        .options(options).build()
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def _data_files_on_disk(table):
+    out = []
+    for root, _, files in os.walk(table.path):
+        if "/bucket-" in root or root.endswith("bucket-0"):
+            out.extend(f for f in files if f.startswith("data-"))
+    return out
+
+
+def test_expire_deletes_unreferenced_files(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    for i in range(5):
+        _commit(table, [{"id": 1, "v": float(i)}])
+    table.compact(full=True)       # snapshot 6: L0 files now unreferenced
+    n_disk_before = len(_data_files_on_disk(table))
+
+    res = table.expire_snapshots(retain_max=1, retain_min=1,
+                                 older_than_ms=int(time.time() * 1000) + 1)
+    assert res.expired_snapshots == [1, 2, 3, 4, 5]
+    assert res.deleted_data_files > 0
+    assert len(_data_files_on_disk(table)) < n_disk_before
+    # table still reads correctly
+    assert table.to_arrow().to_pylist() == [{"id": 1, "v": 4.0}]
+    assert table.snapshot_manager.earliest_snapshot_id() == 6
+
+
+def test_expire_keeps_tagged_files(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    table.create_tag("keep", 1)
+    for i in range(4):
+        _commit(table, [{"id": 1, "v": float(i)}])
+    table.compact(full=True)
+    table.expire_snapshots(retain_max=1, retain_min=1,
+                           older_than_ms=int(time.time() * 1000) + 1)
+    # the tag still reads snapshot 1's data
+    tagged = table.copy({"scan.tag-name": "keep"})
+    assert tagged.to_arrow().to_pylist() == [{"id": 1, "v": 1.0}]
+
+
+def test_expire_respects_consumer_progress(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    for i in range(5):
+        _commit(table, [{"id": 1, "v": float(i)}])
+    table.consumer_manager.record_consumer("job", 3)
+    res = table.expire_snapshots(retain_max=1, retain_min=1,
+                                 older_than_ms=int(time.time() * 1000) + 1)
+    # snapshots >= 3 are protected by the consumer
+    assert res.expired_snapshots == [1, 2]
+    assert table.snapshot_manager.earliest_snapshot_id() == 3
+
+
+def test_expire_retain_min(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    for i in range(6):
+        _commit(table, [{"id": 1, "v": float(i)}])
+    res = table.expire_snapshots(retain_max=10, retain_min=4,
+                                 older_than_ms=int(time.time() * 1000) + 1)
+    assert res.expired_snapshots == [1, 2]
+    assert table.snapshot_manager.earliest_snapshot_id() == 3
+
+
+def test_expire_time_retained_bounds(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    for i in range(4):
+        _commit(table, [{"id": 1, "v": float(i)}])
+    # nothing is older than the cutoff -> only retain_max can force out
+    res = table.expire_snapshots(retain_max=10, retain_min=1,
+                                 older_than_ms=0)
+    assert res.is_empty()
+
+
+def test_orphan_files_clean(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    # plant an orphan data file and an orphan manifest
+    bucket_dir = os.path.join(table.path, "bucket-0")
+    orphan_data = os.path.join(bucket_dir, "data-orphan-0.parquet")
+    open(orphan_data, "wb").write(b"junk")
+    orphan_manifest = os.path.join(table.path, "manifest",
+                                   "manifest-orphan-0")
+    open(orphan_manifest, "wb").write(b"junk")
+    old = time.time() - 100
+    os.utime(orphan_data, (old, old))
+    os.utime(orphan_manifest, (old, old))
+
+    deleted = table.remove_orphan_files(
+        older_than_ms=int(time.time() * 1000) - 50_000)
+    assert {os.path.basename(p) for p in deleted} == \
+        {"data-orphan-0.parquet", "manifest-orphan-0"}
+    assert not os.path.exists(orphan_data)
+    assert table.to_arrow().num_rows == 1      # live data untouched
+
+
+def test_orphan_grace_period(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    orphan = os.path.join(table.path, "bucket-0", "data-fresh-0.parquet")
+    open(orphan, "wb").write(b"junk")          # fresh: inside grace period
+    deleted = table.remove_orphan_files()
+    assert deleted == []
+    assert os.path.exists(orphan)
+
+
+def test_partition_expire(tmp_warehouse):
+    table = _make(tmp_warehouse, partitioned=True,
+                  opts={"partition.expiration-time": "7 d"})
+    _commit(table, [{"id": 1, "v": 1.0, "dt": "2026-07-01"},
+                    {"id": 2, "v": 2.0, "dt": "2026-07-27"}])
+    now = int(time.mktime((2026, 7, 28, 0, 0, 0, 0, 0, 0))) * 1000
+    expired = table.expire_partitions(now_ms=now)
+    assert expired == [("2026-07-01",)]
+    rows = table.to_arrow().to_pylist()
+    assert [r["dt"] for r in rows] == ["2026-07-27"]

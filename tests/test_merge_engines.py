@@ -139,6 +139,26 @@ def test_partial_update_remove_record_on_delete(tmp_warehouse):
 
 
 def test_collect_aggregator(tmp_warehouse):
+    from paimon_tpu.types import ArrayType
+
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("tags", ArrayType(VarCharType()))
+              .primary_key("k")
+              .options({"bucket": "1", "merge-engine": "aggregation",
+                        "fields.tags.aggregate-function": "collect",
+                        "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    _commit(table, [{"k": 1, "tags": ["x"]}])
+    _commit(table, [{"k": 1, "tags": ["y"]}])
+    row = table.to_arrow().to_pylist()[0]
+    assert row["tags"] == ["x", "y"]
+    table.compact(full=True)
+    assert table.to_arrow().to_pylist()[0]["tags"] == ["x", "y"]
+
+
+def test_collect_on_non_array_rejected(tmp_warehouse):
     schema = (Schema.builder()
               .column("k", BigIntType(False))
               .column("tags", VarCharType())
@@ -149,9 +169,8 @@ def test_collect_aggregator(tmp_warehouse):
               .build())
     table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
     _commit(table, [{"k": 1, "tags": "x"}])
-    _commit(table, [{"k": 1, "tags": "y"}])
-    row = table.to_arrow().to_pylist()[0]
-    assert row["tags"] == ["x", "y"]
+    with pytest.raises(ValueError):
+        table.to_arrow()
 
 
 def test_sequence_group_date_field(tmp_warehouse):
