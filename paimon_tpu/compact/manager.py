@@ -13,7 +13,7 @@ files. Drop-delete applies when the output is the highest non-empty level.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 import pyarrow as pa
@@ -40,9 +40,10 @@ __all__ = ["MergeTreeCompactManager", "CompactResult"]
 class CompactResult:
     before: List[DataFileMeta]
     after: List[DataFileMeta]
+    changelog: List[DataFileMeta] = field(default_factory=list)
 
     def is_empty(self) -> bool:
-        return not self.before and not self.after
+        return not self.before and not self.after and not self.changelog
 
 
 class MergeTreeCompactManager:
@@ -57,6 +58,7 @@ class MergeTreeCompactManager:
         self.bucket = bucket
         self.schema_manager = schema_manager
         self._schema_cache = {schema.id: schema}
+        self._file_cache: dict = {}
         self.levels = Levels(files, options.num_levels)
         self.strategy = UniversalCompaction(
             max_size_amp=options.max_size_amplification_percent,
@@ -102,64 +104,149 @@ class MergeTreeCompactManager:
 
     def do_compact(self, unit: CompactUnit) -> CompactResult:
         """reference MergeTreeCompactTask.doCompact:83."""
+        from paimon_tpu.options import ChangelogProducer
+
         files = unit.files
-        # upgrade fast path: single file, no rewrite needed
+        producer = self.options.changelog_producer
+        # upgrade fast path: single file, no rewrite needed. Both
+        # compaction changelog producers must force a rewrite instead:
+        # lookup for any L0 promotion (its keys were never changelog'd),
+        # full-compaction when promoting INTO the top level (reference
+        # FullChangelogMergeTreeCompactRewriter.upgradeChangelog)
         if len(files) == 1:
             f = files[0]
             if f.level == unit.output_level:
                 return CompactResult([], [])
+            blocked = (
+                (producer == ChangelogProducer.LOOKUP and f.level == 0)
+                or (producer == ChangelogProducer.FULL_COMPACTION
+                    and unit.output_level == self.levels.max_level
+                    and f.level == 0))
             # metadata-only promotion unless deletes must be dropped at the
             # top level (reference MergeTreeCompactTask.upgrade:124)
-            if unit.output_level < self.levels.max_level \
-                    or (f.delete_row_count or 0) == 0:
+            if (unit.output_level < self.levels.max_level
+                    or (f.delete_row_count or 0) == 0) and not blocked:
                 upgraded = f.upgrade(unit.output_level)
                 return CompactResult([f], [upgraded])
 
         drop_delete = (unit.output_level != 0
                        and unit.output_level
                        >= self.levels.non_empty_highest_level())
-        after = self.rewrite(files, unit.output_level, drop_delete)
-        return CompactResult(list(files), after)
+        merged = self._merged_state(files, drop_deletes=drop_delete)
+        after = self.kv_writer.write(self.partition, self.bucket, merged,
+                                     level=unit.output_level,
+                                     file_source=FileSource.COMPACT)
+        changelog = self._produce_changelog(unit, merged, drop_delete)
+        return CompactResult(list(files), after, changelog)
 
-    def rewrite(self, files: List[DataFileMeta], output_level: int,
-                drop_delete: bool) -> List[DataFileMeta]:
+    # -- changelog producers -------------------------------------------------
+
+    def _produce_changelog(self, unit: CompactUnit, merged: pa.Table,
+                           drop_delete: bool) -> List[DataFileMeta]:
+        from paimon_tpu.core.kv_file import write_changelog_file
+        from paimon_tpu.options import ChangelogProducer
+        from paimon_tpu.ops.diff import keyed_changelog_diff
+
+        producer = self.options.changelog_producer
+        value_cols = [f.name for f in self.schema.fields]
+        cl = None
+        if producer == ChangelogProducer.FULL_COMPACTION and \
+                unit.output_level == self.levels.max_level:
+            # diff previous top level vs the new full result
+            # (reference FullChangelogMergeTreeCompactRewriter)
+            top = self.levels.levels.get(self.levels.max_level)
+            before = self._merged_state(top.files) \
+                if top and top.files else None
+            live = merged if drop_delete else self._live_view(merged)
+            cl = keyed_changelog_diff(before, live, self.key_cols,
+                                      self.key_encoder, value_cols)
+        elif producer == ChangelogProducer.LOOKUP:
+            # diff the pre-existing state of levels >0 vs the visible
+            # state, restricted to keys the incoming L0 records touched
+            # (reference LookupChangelogMergeFunctionWrapper.java:54;
+            # LookupLevels.lookup becomes a bulk columnar load + joint
+            # key ranking instead of per-key point reads)
+            l0 = [f for f in unit.files if f.level == 0]
+            if l0:
+                all_files = self.levels.all_files()
+                before = self._merged_state(
+                    [f for f in all_files if f.level > 0])
+                after_state = self._merged_state(all_files)
+                restrict = pa.concat_tables(
+                    self._read_runs(l0, flatten=True),
+                    promote_options="none")
+                cl = keyed_changelog_diff(before, after_state,
+                                          self.key_cols, self.key_encoder,
+                                          value_cols,
+                                          restrict_table=restrict)
+        if cl is None or cl.num_rows == 0:
+            return []
+        return write_changelog_file(
+            self.file_io, self.path_factory, self.schema,
+            self.options.file_format, self.options.file_compression,
+            self.partition, self.bucket, cl)
+
+    # -- merged-state helpers ------------------------------------------------
+
+    def _read_file(self, f: DataFileMeta) -> pa.Table:
+        """Read+evolve one data file, memoized: changelog producers walk
+        overlapping file sets (unit, levels>0, all, L0), so each file is
+        decoded at most once per compaction."""
         from paimon_tpu.core.read import evolve_table
 
+        cached = self._file_cache.get(f.file_name)
+        if cached is not None:
+            return cached
+        t = evolve_table(
+            read_kv_file(self.file_io, self.path_factory, self.partition,
+                         self.bucket, f),
+            f.schema_id, self.schema, self.schema_manager,
+            self._schema_cache, keep_sys_cols=True)
+        self._file_cache[f.file_name] = t
+        return t
+
+    def _read_runs(self, files: List[DataFileMeta],
+                   flatten: bool = False) -> List[pa.Table]:
         runs_meta = assemble_runs(files)
         runs = []
         for run_files in runs_meta:
-            tables = [evolve_table(
-                          read_kv_file(self.file_io, self.path_factory,
-                                       self.partition, self.bucket, f),
-                          f.schema_id, self.schema, self.schema_manager,
-                          self._schema_cache, keep_sys_cols=True)
-                      for f in run_files]
-            runs.append(pa.concat_tables(tables, promote_options="none")
-                        if len(tables) > 1 else tables[0])
+            tables = [self._read_file(f) for f in run_files]
+            if flatten:
+                runs.extend(tables)
+            else:
+                runs.append(pa.concat_tables(tables,
+                                             promote_options="none")
+                            if len(tables) > 1 else tables[0])
+        return runs
+
+    def _live_view(self, merged: pa.Table) -> pa.Table:
+        import pyarrow.compute as pc
+        from paimon_tpu.ops.merge import KIND_COL
+        from paimon_tpu.types import RowKind
+        kinds = merged.column(KIND_COL).combine_chunks().cast(pa.int8())
+        keep = pc.or_(pc.equal(kinds, RowKind.INSERT),
+                      pc.equal(kinds, RowKind.UPDATE_AFTER))
+        return merged.filter(keep)
+
+    def _merged_state(self, files: List[DataFileMeta],
+                      drop_deletes: bool = True) -> Optional[pa.Table]:
+        """KV-shaped, key-sorted, key-unique merged state of `files`."""
+        if not files:
+            return None
+        runs = self._read_runs(files)
         engine = self.options.merge_engine
         if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
             res = merge_runs(
                 runs, self.key_cols,
                 merge_engine=("first-row" if engine == MergeEngine.FIRST_ROW
                               else "deduplicate"),
-                drop_deletes=drop_delete,
+                drop_deletes=drop_deletes,
                 key_encoder=self.key_encoder)
-            merged = res.take()
-        else:
-            from paimon_tpu.ops.agg import merge_runs_agg
-            merged = merge_runs_agg(runs, self.key_cols, self.schema,
-                                    self.options,
-                                    key_encoder=self.key_encoder)
-            if drop_delete:
-                import numpy as np
-                import pyarrow.compute as pc
-                from paimon_tpu.ops.merge import KIND_COL
-                from paimon_tpu.types import RowKind
-                kinds = merged.column(KIND_COL).combine_chunks() \
-                    .cast(pa.int8())
-                keep = pc.or_(pc.equal(kinds, RowKind.INSERT),
-                              pc.equal(kinds, RowKind.UPDATE_AFTER))
-                merged = merged.filter(keep)
-        return self.kv_writer.write(self.partition, self.bucket, merged,
-                                    level=output_level,
-                                    file_source=FileSource.COMPACT)
+            return res.take()
+        from paimon_tpu.ops.agg import merge_runs_agg
+        merged = merge_runs_agg(runs, self.key_cols, self.schema,
+                                self.options,
+                                key_encoder=self.key_encoder)
+        if drop_deletes:
+            merged = self._live_view(merged)
+        return merged

@@ -12,7 +12,7 @@ _VALUE_KIND); ordering comes from DataFileMeta sequence ranges.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -296,6 +296,7 @@ class AppendSplitRead:
 class AppendCompactResult:
     before: List[DataFileMeta]
     after: List[DataFileMeta]
+    changelog: List[DataFileMeta] = dc_field(default_factory=list)
 
     def is_empty(self) -> bool:
         return not self.before

@@ -75,6 +75,7 @@ class FileStoreCommit:
         append_entries: List[ManifestEntry] = []
         compact_entries: List[ManifestEntry] = []
         changelog_entries: List[ManifestEntry] = []
+        compact_changelog_entries: List[ManifestEntry] = []
         for msg in messages:
             pbytes = self._partition_codec.to_bytes(msg.partition)
             for f in msg.new_files:
@@ -90,6 +91,9 @@ class FileStoreCommit:
             for f in msg.compact_after:
                 compact_entries.append(ManifestEntry(
                     FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
+            for f in msg.compact_changelog:
+                compact_changelog_entries.append(ManifestEntry(
+                    FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
 
         last_id = None
         if append_entries or changelog_entries or index_entries:
@@ -98,9 +102,10 @@ class FileStoreCommit:
                 kind or CommitKind.APPEND, index_entries=index_entries,
                 properties=properties)
             index_entries = None
-        if compact_entries:
+        if compact_entries or compact_changelog_entries:
             last_id = self._try_commit(
-                compact_entries, [], commit_identifier, CommitKind.COMPACT,
+                compact_entries, compact_changelog_entries,
+                commit_identifier, CommitKind.COMPACT,
                 check_deleted_files=True, index_entries=index_entries,
                 properties=properties)
         return last_id

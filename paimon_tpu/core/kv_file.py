@@ -129,6 +129,30 @@ def _safe_stats(types: Sequence[DataType], mins, maxs, nulls) -> SimpleStats:
                        list(nulls))
 
 
+def write_changelog_file(file_io: FileIO,
+                         path_factory: FileStorePathFactory,
+                         schema: TableSchema, file_format: str,
+                         compression: str, partition: Tuple, bucket: int,
+                         table: pa.Table) -> List[DataFileMeta]:
+    """Write a changelog file (KV layout with _VALUE_KIND kinds kept).
+    Shared by changelog-producer=input (write path) and the compaction
+    changelog producers."""
+    import pyarrow.compute as pc
+
+    fmt = get_format(file_format)
+    name = path_factory.new_changelog_file_name(fmt.extension)
+    path = path_factory.data_file_path(partition, bucket, name)
+    size = fmt.create_writer(compression).write(file_io, path, table)
+    return [DataFileMeta(
+        file_name=name, file_size=size, row_count=table.num_rows,
+        min_key=b"", max_key=b"",
+        key_stats=SimpleStats.EMPTY,
+        value_stats=SimpleStats.EMPTY,
+        min_sequence_number=pc.min(table.column(SEQ_COL)).as_py(),
+        max_sequence_number=pc.max(table.column(SEQ_COL)).as_py(),
+        schema_id=schema.id, level=0)]
+
+
 def read_kv_file(file_io: FileIO, path_factory: FileStorePathFactory,
                  partition: Tuple, bucket: int, meta: DataFileMeta,
                  file_format: Optional[str] = None,

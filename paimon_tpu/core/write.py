@@ -222,21 +222,11 @@ class KeyValueFileStoreWrite:
 
     def write_changelog(self, partition: Tuple, bucket: int,
                         table: pa.Table) -> List[DataFileMeta]:
-        from paimon_tpu.format import get_format
-        fmt = get_format(self.options.file_format)
-        name = self.path_factory.new_changelog_file_name(fmt.extension)
-        path = self.path_factory.data_file_path(partition, bucket, name)
-        size = fmt.create_writer(self.options.file_compression).write(
-            self.file_io, path, table)
-        import pyarrow.compute as pc
-        return [DataFileMeta(
-            file_name=name, file_size=size, row_count=table.num_rows,
-            min_key=b"", max_key=b"",
-            key_stats=SimpleStats.EMPTY,
-            value_stats=SimpleStats.EMPTY,
-            min_sequence_number=pc.min(table.column(SEQ_COL)).as_py(),
-            max_sequence_number=pc.max(table.column(SEQ_COL)).as_py(),
-            schema_id=self.schema.id, level=0)]
+        from paimon_tpu.core.kv_file import write_changelog_file
+        return write_changelog_file(
+            self.file_io, self.path_factory, self.schema,
+            self.options.file_format, self.options.file_compression,
+            partition, bucket, table)
 
     # -- writes --------------------------------------------------------------
 

@@ -1,0 +1,8 @@
+"""Point-lookup plane.
+
+reference: mergetree/LookupLevels.java:56 (lookup:137), table/query/
+LocalTableQuery.java:69 (the embedded point-lookup engine behind the
+query service and Flink lookup joins).
+"""
+
+from paimon_tpu.lookup.local_query import LocalTableQuery  # noqa: F401
