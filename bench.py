@@ -33,7 +33,7 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def probe_platform(retries: int = 3, timeout: int = 240):
+def probe_platform(retries: int = 2, timeout: int = 150):
     """Check (in a throwaway subprocess) that the default jax backend
     initializes and runs one op. Returns its platform name or None."""
     code = ("import jax, jax.numpy as jnp;"

@@ -132,12 +132,78 @@ class MergeTreeCompactManager:
         drop_delete = (unit.output_level != 0
                        and unit.output_level
                        >= self.levels.non_empty_highest_level())
+        total_rows = sum(f.row_count for f in files)
+        threshold = self.options.get(
+            CoreOptions.MERGE_STREAM_THRESHOLD_ROWS)
+        if producer == ChangelogProducer.NONE and total_rows > threshold:
+            # bounded-memory path: stream key windows through the kernel
+            after = self._rewrite_streamed(files, unit.output_level,
+                                           drop_delete)
+            return CompactResult(list(files), after)
         merged = self._merged_state(files, drop_deletes=drop_delete)
         after = self.kv_writer.write(self.partition, self.bucket, merged,
                                      level=unit.output_level,
                                      file_source=FileSource.COMPACT)
         changelog = self._produce_changelog(unit, merged, drop_delete)
         return CompactResult(list(files), after, changelog)
+
+    def _rewrite_streamed(self, files: List[DataFileMeta],
+                          output_level: int,
+                          drop_delete: bool) -> List[DataFileMeta]:
+        """Streamed whole-bucket rewrite (ops/merge_stream.py): peak
+        memory ~ runs x chunk + one key window, independent of bucket
+        size — SURVEY hard part (d)."""
+        from paimon_tpu.core.read import evolve_table
+        from paimon_tpu.format import get_format
+        from paimon_tpu.ops.merge_stream import merge_runs_streamed
+
+        chunk_rows = self.options.get(CoreOptions.MERGE_CHUNK_ROWS)
+        runs_meta = assemble_runs(files)
+
+        def run_iter(run_files):
+            for f in run_files:
+                ext = f.file_name.rsplit(".", 1)[-1]
+                fmt = get_format(ext)
+                path = f.external_path or self.path_factory.data_file_path(
+                    self.partition, self.bucket, f.file_name)
+                for batch in fmt.create_reader().read_batches(
+                        self.file_io, path, batch_rows=chunk_rows):
+                    yield evolve_table(batch, f.schema_id, self.schema,
+                                       self.schema_manager,
+                                       self._schema_cache,
+                                       keep_sys_cols=True)
+
+        def merge_window(tables: List[pa.Table]) -> pa.Table:
+            return self._merge_tables(tables, drop_delete)
+
+        out: List[DataFileMeta] = []
+        acc: List[pa.Table] = []
+        acc_bytes = 0
+
+        def flush():
+            nonlocal acc, acc_bytes
+            if not acc:
+                return
+            merged = pa.concat_tables(acc, promote_options="none")
+            out.extend(self.kv_writer.write(
+                self.partition, self.bucket, merged, level=output_level,
+                file_source=FileSource.COMPACT))
+            acc, acc_bytes = [], 0
+
+        def emit(window: pa.Table):
+            nonlocal acc_bytes
+            if window.num_rows == 0:
+                return
+            acc.append(window)
+            acc_bytes += window.nbytes
+            if acc_bytes >= self.kv_writer.target_file_size:
+                flush()
+
+        merge_runs_streamed([run_iter(rf) for rf in runs_meta],
+                            self.key_cols, self.key_encoder, emit,
+                            merge_window)
+        flush()
+        return out
 
     # -- changelog producers -------------------------------------------------
 
@@ -228,25 +294,30 @@ class MergeTreeCompactManager:
                       pc.equal(kinds, RowKind.UPDATE_AFTER))
         return merged.filter(keep)
 
-    def _merged_state(self, files: List[DataFileMeta],
-                      drop_deletes: bool = True) -> Optional[pa.Table]:
-        """KV-shaped, key-sorted, key-unique merged state of `files`."""
-        if not files:
-            return None
-        runs = self._read_runs(files)
+    def _merge_tables(self, run_tables: List[pa.Table],
+                      drop_deletes: bool) -> pa.Table:
+        """Merge run-ordered tables under the table's merge engine —
+        the single dispatch shared by the one-shot and streamed paths."""
         engine = self.options.merge_engine
         if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
             res = merge_runs(
-                runs, self.key_cols,
+                run_tables, self.key_cols,
                 merge_engine=("first-row" if engine == MergeEngine.FIRST_ROW
                               else "deduplicate"),
                 drop_deletes=drop_deletes,
                 key_encoder=self.key_encoder)
             return res.take()
         from paimon_tpu.ops.agg import merge_runs_agg
-        merged = merge_runs_agg(runs, self.key_cols, self.schema,
+        merged = merge_runs_agg(run_tables, self.key_cols, self.schema,
                                 self.options,
                                 key_encoder=self.key_encoder)
         if drop_deletes:
             merged = self._live_view(merged)
         return merged
+
+    def _merged_state(self, files: List[DataFileMeta],
+                      drop_deletes: bool = True) -> Optional[pa.Table]:
+        """KV-shaped, key-sorted, key-unique merged state of `files`."""
+        if not files:
+            return None
+        return self._merge_tables(self._read_runs(files), drop_deletes)

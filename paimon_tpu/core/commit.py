@@ -68,7 +68,8 @@ class FileStoreCommit:
                commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
                kind: Optional[str] = None,
                index_entries: Optional[list] = None,
-               properties: Optional[Dict[str, str]] = None) -> Optional[int]:
+               properties: Optional[Dict[str, str]] = None,
+               expected_latest_id: Optional[int] = ...) -> Optional[int]:
         """Commit append + compact changes. Returns snapshot id (or None if
         nothing to commit). Append and compact deltas are committed as
         separate snapshots like the reference (APPEND then COMPACT)."""
@@ -100,7 +101,8 @@ class FileStoreCommit:
             last_id = self._try_commit(
                 append_entries, changelog_entries, commit_identifier,
                 kind or CommitKind.APPEND, index_entries=index_entries,
-                properties=properties)
+                properties=properties,
+                expected_latest_id=expected_latest_id)
             index_entries = None
         if compact_entries or compact_changelog_entries:
             last_id = self._try_commit(
@@ -177,11 +179,20 @@ class FileStoreCommit:
                     check_deleted_files: bool = False,
                     index_entries: Optional[list] = None,
                     properties: Optional[Dict[str, str]] = None,
-                    entries_fn=None) -> int:
+                    entries_fn=None,
+                    expected_latest_id: Optional[int] = ...) -> int:
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         while True:
             latest = self.snapshot_manager.latest_snapshot()
+            if expected_latest_id is not ... and \
+                    (latest.id if latest else None) != expected_latest_id:
+                # the caller's plan is stale (e.g. deletion vectors built
+                # against an older snapshot): surface a conflict so it can
+                # replan instead of silently losing concurrent changes
+                raise CommitConflictError(
+                    f"Snapshot advanced past "
+                    f"{expected_latest_id} before commit; replan required")
             if entries_fn is not None:
                 # delete/add set depends on the latest snapshot (e.g.
                 # overwrite): recompute per attempt; per-attempt manifests

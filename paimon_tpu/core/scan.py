@@ -121,7 +121,8 @@ class FileStoreScan:
             return ScanPlan(None, [], streaming=streaming)
         entries = self.read_entries(snapshot)
         return ScanPlan(snapshot.id, self.generate_splits(
-            snapshot.id, entries, for_streaming=streaming),
+            snapshot.id, entries, for_streaming=streaming,
+            snapshot=snapshot),
             streaming=streaming)
 
     def plan_delta(self, snapshot: Snapshot,
@@ -135,7 +136,8 @@ class FileStoreScan:
         return ScanPlan(snapshot.id,
                         self.generate_splits(snapshot.id, adds,
                                              for_delta=True,
-                                             for_streaming=streaming),
+                                             for_streaming=streaming,
+                                             snapshot=snapshot),
                         streaming=streaming)
 
     def plan_changelog(self, snapshot: Snapshot,
@@ -148,7 +150,8 @@ class FileStoreScan:
         return ScanPlan(snapshot.id,
                         self.generate_splits(snapshot.id, adds,
                                              for_delta=True,
-                                             for_streaming=streaming),
+                                             for_streaming=streaming,
+                                             snapshot=snapshot),
                         streaming=streaming)
 
     def read_entries(self, snapshot: Snapshot) -> List[ManifestEntry]:
@@ -238,15 +241,19 @@ class FileStoreScan:
     def generate_splits(self, snapshot_id: int,
                         entries: List[ManifestEntry],
                         for_delta: bool = False,
-                        for_streaming: bool = False) -> List[DataSplit]:
+                        for_streaming: bool = False,
+                        snapshot: Optional[Snapshot] = None
+                        ) -> List[DataSplit]:
         groups: Dict[Tuple, List[ManifestEntry]] = {}
         for e in entries:
             if not self._entry_visible(e):
                 continue
             groups.setdefault((e.partition, e.bucket), []).append(e)
         splits = []
-        dv_index = self._load_deletion_vectors(snapshot_id) \
-            if self.options.deletion_vectors_enabled else {}
+        # DVs are semantically required once written (DELETE FROM), so
+        # they always load; no-op when the snapshot carries no index
+        # manifest, and pruned by the scan's partition/bucket filters
+        dv_index = self._load_deletion_vectors(snapshot_id, snapshot)
         for (pbytes, bucket), group in sorted(
                 groups.items(), key=lambda kv: (kv[0][0], kv[0][1])):
             partition = self._partition_codec.from_bytes(pbytes)
@@ -274,11 +281,13 @@ class FileStoreScan:
             ))
         return splits
 
-    def _load_deletion_vectors(self, snapshot_id: int):
-        try:
-            snapshot = self.snapshot_manager.snapshot(snapshot_id)
-        except OSError:
-            return {}
+    def _load_deletion_vectors(self, snapshot_id: int,
+                               snapshot: Optional[Snapshot] = None):
+        if snapshot is None:
+            try:
+                snapshot = self.snapshot_manager.snapshot(snapshot_id)
+            except OSError:
+                return {}
         if not snapshot.index_manifest:
             return {}
         from paimon_tpu.index.deletion_vector import read_deletion_vectors
@@ -286,6 +295,19 @@ class FileStoreScan:
         for e in self.index_manifest_file.read(snapshot.index_manifest):
             if e.index_file.index_type != "DELETION_VECTORS":
                 continue
+            # honor the scan's bucket/partition filters: skip whole DV
+            # files for buckets this plan will never read
+            if self._bucket_filter is not None and \
+                    e.bucket not in self._bucket_filter:
+                continue
+            if self._partition_filter:
+                values = self._partition_codec.from_bytes(e.partition)
+                skip = any(
+                    k in self._partition_filter
+                    and str(values[i]) != str(self._partition_filter[k])
+                    for i, k in enumerate(self.schema.partition_keys))
+                if skip:
+                    continue
             dvs = read_deletion_vectors(
                 self.file_io,
                 self.path_factory.index_file_path(e.index_file.file_name),

@@ -36,6 +36,13 @@ class FormatReader:
              batch_size: int = 1 << 20) -> pa.Table:
         raise NotImplementedError
 
+    def read_batches(self, file_io: FileIO, path: str,
+                     projection: Optional[List[str]] = None,
+                     batch_rows: int = 1 << 20):
+        """Yield the file as bounded-size Arrow tables (streamed decode
+        where the format supports it; whole-file fallback otherwise)."""
+        yield self.read(file_io, path, projection)
+
 
 class FormatWriter:
     def write(self, file_io: FileIO, path: str, table: pa.Table) -> int:
@@ -47,6 +54,15 @@ class _ParquetReader(FormatReader):
     def read(self, file_io, path, projection=None, batch_size=1 << 20):
         data = file_io.read_bytes(path)
         return pq.read_table(io.BytesIO(data), columns=projection)
+
+    def read_batches(self, file_io, path, projection=None,
+                     batch_rows: int = 1 << 20):
+        # compressed bytes stay resident; decode is incremental per batch
+        data = file_io.read_bytes(path)
+        pf = pq.ParquetFile(io.BytesIO(data))
+        for rb in pf.iter_batches(batch_size=batch_rows,
+                                  columns=projection):
+            yield pa.Table.from_batches([rb])
 
 
 class _ParquetWriter(FormatWriter):

@@ -1,29 +1,36 @@
-"""Deletion vectors: per-file bitmaps of deleted row positions.
+"""Deletion vectors: per-file bitmaps of deleted row positions,
+wire-compatible with the reference.
 
-reference: paimon-core/.../deletionvectors/ (BitmapDeletionVector over
-RoaringBitmap32, DeletionVectorsIndexFile packing several bitmaps into one
-index file). This implementation stores positions as a sorted uint32/uint64
-numpy array serialized little-endian with a small header -- the apply path
-(mask rows during scan) is a vectorized isin/searchsorted, which XLA/numpy
-handle better than roaring containers.
+reference: paimon-core/.../deletionvectors/BitmapDeletionVector.java
+(RoaringBitmap32 + MAGIC 1581511376), DeletionVectorsIndexFile.java
+(VERSION byte 1, then per DV: [i32 BE length][i32 BE magic][roaring
+bytes][i32 BE crc32]; index manifest records (offset, length,
+cardinality) per data file).
 
-Serialization is NOT roaring-compatible yet; cross-reading reference DV
-files is a follow-up (magic number differs so misreads fail fast).
+In-memory the positions live as a sorted numpy array — the apply path
+(mask rows during scan) is a vectorized mask, which numpy/XLA handle
+better than roaring containers; roaring is only the wire format.
 """
 
 from __future__ import annotations
 
 import struct
+import uuid
+import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from paimon_tpu.fs import FileIO
+from paimon_tpu.index.roaring import (
+    deserialize_roaring32, serialize_roaring32,
+)
 
 __all__ = ["DeletionVector", "DeletionVectorsIndexFile",
            "read_deletion_vectors"]
 
-_MAGIC = 0x50544456  # "PTDV"
+MAGIC_V1 = 1581511376
+VERSION_V1 = 1
 
 
 class DeletionVector:
@@ -60,18 +67,31 @@ class DeletionVector:
         mask[valid] = False
         return mask
 
+    # -- wire format (reference BitmapDeletionVector.serializeTo) ------------
+
     def serialize(self) -> bytes:
-        data = self.positions.astype("<i8").tobytes()
-        return struct.pack("<II", _MAGIC, len(self.positions)) + data
+        """[i32 BE length][i32 BE MAGIC + roaring bytes][i32 BE crc32]."""
+        body = struct.pack(">i", MAGIC_V1) + \
+            serialize_roaring32(self.positions.astype(np.uint32))
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        return struct.pack(">i", len(body)) + body + struct.pack(">I", crc)
 
     @staticmethod
     def deserialize(data: bytes) -> "DeletionVector":
-        magic, n = struct.unpack_from("<II", data, 0)
-        if magic != _MAGIC:
-            raise ValueError("Not a paimon-tpu deletion vector "
-                             f"(magic {magic:#x})")
-        positions = np.frombuffer(data, dtype="<i8", count=n, offset=8)
-        return DeletionVector(positions.copy())
+        (length,) = struct.unpack_from(">i", data, 0)
+        (magic,) = struct.unpack_from(">i", data, 4)
+        if magic != MAGIC_V1:
+            raise ValueError(f"Invalid deletion vector magic {magic}")
+        body = data[4:4 + length]
+        if len(data) >= 4 + length + 4:
+            (crc,) = struct.unpack_from(">I", data, 4 + length)
+            actual = zlib.crc32(body) & 0xFFFFFFFF
+            if crc != actual:
+                raise ValueError(
+                    f"Deletion vector checksum mismatch "
+                    f"(stored {crc}, computed {actual})")
+        positions = deserialize_roaring32(body[4:])
+        return DeletionVector(positions.astype(np.int64))
 
 
 class DeletionVectorsIndexFile:
@@ -82,16 +102,24 @@ class DeletionVectorsIndexFile:
         self.file_io = file_io
         self.index_dir = index_dir.rstrip("/")
 
-    def write(self, name: str, dvs: Dict[str, DeletionVector]
+    def write(self, dvs: Dict[str, DeletionVector],
+              name: Optional[str] = None,
+              path_factory=None
               ) -> Tuple[str, int, Dict[str, Tuple[int, int, int]]]:
         """-> (file_name, file_size, ranges {data_file: (offset, len,
-        cardinality)})."""
-        blobs = []
+        cardinality)}). Layout: VERSION byte then DV entries; offsets
+        point at each entry's length field, length covers magic+bitmap
+        (reference DeletionVectorMeta semantics)."""
+        if name is None:
+            name = path_factory.new_index_file_name() if path_factory \
+                else f"index-{uuid.uuid4()}-0"
+        blobs = [bytes([VERSION_V1])]
         ranges: Dict[str, Tuple[int, int, int]] = {}
-        offset = 0
+        offset = 1
         for data_file, dv in dvs.items():
             blob = dv.serialize()
-            ranges[data_file] = (offset, len(blob), dv.cardinality())
+            # recorded length excludes the 4-byte length prefix and crc
+            ranges[data_file] = (offset, len(blob) - 8, dv.cardinality())
             blobs.append(blob)
             offset += len(blob)
         payload = b"".join(blobs)
@@ -102,14 +130,17 @@ class DeletionVectorsIndexFile:
     def read(self, name: str,
              ranges: Dict[str, Tuple[int, int, int]]
              ) -> Dict[str, DeletionVector]:
-        data = self.file_io.read_bytes(f"{self.index_dir}/{name}")
-        return {f: DeletionVector.deserialize(data[off:off + ln])
-                for f, (off, ln, _) in ranges.items()}
+        return read_deletion_vectors(
+            self.file_io, f"{self.index_dir}/{name}", ranges)
 
 
 def read_deletion_vectors(file_io: FileIO, index_path: str,
                           ranges: Dict[str, Tuple[int, int, int]]
                           ) -> Dict[str, DeletionVector]:
     data = file_io.read_bytes(index_path)
-    return {f: DeletionVector.deserialize(data[off:off + ln])
-            for f, (off, ln, _) in ranges.items()}
+    if data[:1] != bytes([VERSION_V1]):
+        raise ValueError(f"Unknown DV index version {data[:1]!r}")
+    out = {}
+    for f, (off, ln, _) in ranges.items():
+        out[f] = DeletionVector.deserialize(data[off:off + ln + 8])
+    return out

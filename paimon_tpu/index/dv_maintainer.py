@@ -1,0 +1,150 @@
+"""Row-level DELETE.
+
+reference semantics:
+- append tables: deletion vectors keyed by row position
+  (deletionvectors/BucketedDvMaintainer.java + append DV support;
+  flink DeleteAction / spark DeleteFromTableCommand)
+- primary-key tables: -D records through the normal merge path
+
+The DV path evaluates the predicate per physical file (vectorized Arrow
+compute), merges the matching positions into the bucket's existing
+deletion vectors, writes ONE roaring-wire index file per bucket and
+commits index-manifest entries (old bucket DV entries deleted, new one
+added) — readers then mask those positions during scan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.index.deletion_vector import (
+    DeletionVector, DeletionVectorsIndexFile,
+)
+from paimon_tpu.manifest import FileKind
+from paimon_tpu.manifest.index_manifest import (
+    DELETION_VECTORS_INDEX, IndexFileMeta, IndexManifestEntry,
+)
+from paimon_tpu.types import RowKind
+
+__all__ = ["delete_where"]
+
+
+def delete_where(table, predicate) -> Optional[int]:
+    """Delete all rows matching `predicate`. Returns the snapshot id, or
+    None when nothing matched."""
+    if table.primary_keys:
+        return _delete_pk(table, predicate)
+    return _delete_append_dv(table, predicate)
+
+
+def _delete_pk(table, predicate) -> Optional[int]:
+    rows = table.to_arrow(predicate=predicate)
+    if rows.num_rows == 0:
+        return None
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_arrow(rows.select([f.name for f in table.schema.fields]),
+                  row_kinds=np.full(rows.num_rows, RowKind.DELETE,
+                                    np.int8))
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def _delete_append_dv(table, predicate, max_retries: int = 5
+                      ) -> Optional[int]:
+    """Optimistic: DVs are computed against the latest snapshot and the
+    commit asserts that snapshot is still latest — a concurrent commit
+    forces a full replan so no concurrent deletes are lost."""
+    from paimon_tpu.core.commit import CommitConflictError
+
+    for _ in range(max_retries):
+        try:
+            return _delete_append_dv_once(table, predicate)
+        except CommitConflictError:
+            continue
+    raise CommitConflictError(
+        f"delete_where lost the race {max_retries} times; retry later")
+
+
+def _delete_append_dv_once(table, predicate) -> Optional[int]:
+    from paimon_tpu.core.kv_file import read_kv_file
+    from paimon_tpu.core.read import evolve_table
+
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return None
+    # value-stats pruning: files that cannot match keep their DVs as-is
+    scan = table.new_scan().with_value_filter(predicate)
+    plan = scan.plan(snapshot)
+
+    # previous DV entries per bucket (to merge + replace)
+    prev_entries: List[IndexManifestEntry] = []
+    if snapshot.index_manifest:
+        prev_entries = [
+            e for e in scan.index_manifest_file.read(snapshot.index_manifest)
+            if e.index_file.index_type == DELETION_VECTORS_INDEX]
+
+    dv_index = DeletionVectorsIndexFile(table.file_io,
+                                        f"{table.path}/index")
+    schema_cache = {table.schema.id: table.schema}
+    index_entries: List[IndexManifestEntry] = []
+    any_change = False
+    for split in plan.splits:
+        pbytes = scan._partition_codec.to_bytes(split.partition)
+        bucket_dvs: Dict[str, DeletionVector] = dict(
+            split.deletion_vectors or {})
+        changed = False
+        for meta in split.data_files:
+            t = read_kv_file(table.file_io, scan.path_factory,
+                             split.partition, split.bucket, meta, None,
+                             None)
+            t = evolve_table(t, meta.schema_id, table.schema,
+                             table.schema_manager, schema_cache)
+            mask = _eval_predicate(predicate, t)
+            existing = bucket_dvs.get(meta.file_name)
+            if existing is not None:
+                mask[existing.positions[existing.positions
+                                        < len(mask)]] = False
+            positions = np.flatnonzero(mask)
+            if len(positions) == 0:
+                continue
+            changed = True
+            dv = DeletionVector(positions)
+            bucket_dvs[meta.file_name] = existing.merge(dv) \
+                if existing is not None else dv
+        if not changed:
+            continue
+        any_change = True
+        name, size, ranges = dv_index.write(
+            bucket_dvs, path_factory=scan.path_factory)
+        total_rows = sum(dv.cardinality() for dv in bucket_dvs.values())
+        for e in prev_entries:
+            if e.partition == pbytes and e.bucket == split.bucket:
+                index_entries.append(IndexManifestEntry(
+                    FileKind.DELETE, e.partition, e.bucket, e.index_file))
+        index_entries.append(IndexManifestEntry(
+            FileKind.ADD, pbytes, split.bucket,
+            IndexFileMeta(DELETION_VECTORS_INDEX, name, size, total_rows,
+                          dv_ranges=ranges)))
+
+    if not any_change:
+        return None
+    from paimon_tpu.core.commit import FileStoreCommit
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    return commit.commit([], index_entries=index_entries,
+                         expected_latest_id=snapshot.id)
+
+
+def _eval_predicate(predicate, t: pa.Table) -> np.ndarray:
+    """Boolean row mask of `predicate` over `t` (null -> False)."""
+    import pyarrow.dataset as ds
+
+    expr = predicate.to_arrow()
+    out = ds.dataset(t).scanner(columns={"m": expr}).to_table()
+    return np.asarray(out.column("m").combine_chunks().cast(pa.bool_())
+                      .fill_null(False))

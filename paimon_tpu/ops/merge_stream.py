@@ -1,0 +1,161 @@
+"""Streamed k-way merge in bounded key windows.
+
+Kills the whole-bucket memory cliff (SURVEY hard part (d)): instead of
+concatenating every run of a bucket in RAM and padding to a power of two,
+runs stream in as bounded Arrow chunks, and the device kernel merges one
+key WINDOW at a time:
+
+1. every run keeps a small buffer of decoded chunks
+2. the window bound = MIN over non-exhausted runs of their last buffered
+   key — every key strictly below it is fully present in the buffers
+3. rows below the bound are cut from all buffers (run order preserved),
+   merged with the normal segmented-sort kernel, and emitted
+4. buffers refill; repeat until all runs drain, then flush the remainder
+
+Windows partition the keyspace, so per-key semantics (dedup last-by-seq,
+partial-update, aggregation) are EXACTLY those of the one-shot merge:
+a key's rows never straddle windows (the cut compares normalized-key
+lanes, and prefix-equal truncated keys stay in one window together).
+
+Peak memory ~ k_runs x chunk_rows + window, independent of bucket size.
+This replaces the reference's record-at-a-time spillable MergeSorter
+(mergetree/MergeSorter.java:112) with a columnar pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.ops.merge import merge_runs
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+
+__all__ = ["merge_runs_streamed"]
+
+
+def _lanes_lt(lanes: np.ndarray, bound: Tuple) -> np.ndarray:
+    """Lexicographic lanes < bound, vectorized per lane column."""
+    n, num_lanes = lanes.shape
+    lt = np.zeros(n, dtype=bool)
+    eq = np.ones(n, dtype=bool)
+    for i in range(num_lanes):
+        col = lanes[:, i]
+        b = np.uint32(bound[i])
+        lt |= eq & (col < b)
+        eq &= col == b
+    return lt
+
+
+class _RunState:
+    def __init__(self, chunks: Iterator[pa.Table], key_cols: Sequence[str],
+                 encoder: NormalizedKeyEncoder):
+        self._chunks = chunks
+        self.key_cols = list(key_cols)
+        self.encoder = encoder
+        self.buffer: List[Tuple[pa.Table, np.ndarray]] = []  # (table, lanes)
+        self.exhausted = False
+
+    @property
+    def buffered_rows(self) -> int:
+        return sum(t.num_rows for t, _ in self.buffer)
+
+    def fill_one(self) -> bool:
+        if self.exhausted:
+            return False
+        try:
+            t = next(self._chunks)
+        except StopIteration:
+            self.exhausted = True
+            return False
+        if t.num_rows == 0:
+            return self.fill_one()
+        lanes, _ = self.encoder.encode_table(t, self.key_cols)
+        self.buffer.append((t, lanes))
+        return True
+
+    def last_key(self) -> Optional[Tuple]:
+        if not self.buffer:
+            return None
+        _, lanes = self.buffer[-1]
+        return tuple(lanes[-1])
+
+    def cut_lt(self, bound: Tuple) -> List[pa.Table]:
+        """Remove and return rows with key lanes < bound (a prefix of the
+        buffer, since runs are key-sorted)."""
+        head: List[pa.Table] = []
+        new_buffer: List[Tuple[pa.Table, np.ndarray]] = []
+        for t, lanes in self.buffer:
+            if new_buffer:
+                new_buffer.append((t, lanes))   # already past the bound
+                continue
+            lt = _lanes_lt(lanes, bound)
+            k = int(lt.sum())
+            if k == t.num_rows:
+                head.append(t)
+            else:
+                if k:
+                    head.append(t.slice(0, k))
+                new_buffer.append((t.slice(k), lanes[k:]))
+        self.buffer = new_buffer
+        return head
+
+    def take_all(self) -> List[pa.Table]:
+        out = [t for t, _ in self.buffer]
+        self.buffer = []
+        return out
+
+
+def merge_runs_streamed(
+    run_chunk_iters: Sequence[Iterator[pa.Table]],
+    key_cols: Sequence[str],
+    key_encoder: NormalizedKeyEncoder,
+    emit: Callable[[pa.Table], None],
+    merge_window: Callable[[List[pa.Table]], pa.Table],
+) -> None:
+    """Stream-merge k runs (oldest first) and emit merged key windows in
+    ascending key order.
+
+    run_chunk_iters: one iterator of key-sorted KV chunks per run.
+    merge_window: merges a window's run-ordered chunk list into the final
+    rows (e.g. a merge_runs(...).take() or merge_runs_agg closure)."""
+    runs = [_RunState(it, key_cols, key_encoder)
+            for it in run_chunk_iters]
+    for r in runs:
+        r.fill_one()
+
+    while True:
+        for r in runs:
+            if not r.exhausted and not r.buffer:
+                r.fill_one()
+        non_exhausted = [r for r in runs if not r.exhausted]
+        if not non_exhausted:
+            tail = []
+            for r in runs:
+                tail.extend(r.take_all())
+            if tail:
+                emit(merge_window(tail))
+            return
+        bound = min(r.last_key() for r in non_exhausted)
+        heads: List[pa.Table] = []
+        for r in runs:                      # run order = merge stability
+            heads.extend(r.cut_lt(bound))
+        if heads:
+            emit(merge_window(heads))
+        else:
+            # every buffered row >= bound: a key group spans entire
+            # buffers; extend the runs sitting exactly at the bound
+            progressed = False
+            for r in non_exhausted:
+                if r.last_key() == bound:
+                    progressed |= r.fill_one()
+                    if r.exhausted:
+                        progressed = True
+            if not progressed:              # defensive: cannot happen
+                tail = []
+                for r in runs:
+                    tail.extend(r.take_all())
+                if tail:
+                    emit(merge_window(tail))
+                return

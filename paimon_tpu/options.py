@@ -232,6 +232,8 @@ class CoreOptions:
     CONSUMER_ID = ConfigOption("consumer-id", str, None, "")
     CONSUMER_EXPIRATION_TIME = ConfigOption("consumer.expiration-time",
                                             _parse_duration_ms, None, "")
+    # NOTE: reads always honor DVs once written (DELETE FROM); this flag
+    # reserves the reference's compaction-time DV production mode
     DELETION_VECTORS_ENABLED = ConfigOption("deletion-vectors.enabled",
                                             _parse_bool, False, "")
     DYNAMIC_BUCKET_TARGET_ROW_NUM = ConfigOption(
@@ -246,6 +248,14 @@ class CoreOptions:
                                     "Device merge batch rows (ours)")
     KEY_PREFIX_LANES = ConfigOption("tpu.key-prefix-lanes", int, 2,
                                     "u64 lanes of normalized key prefix (ours)")
+    MERGE_STREAM_THRESHOLD_ROWS = ConfigOption(
+        "tpu.merge.stream-threshold-rows", int, 32 << 20,
+        "Above this many input rows a compaction merges in streamed key "
+        "windows instead of one whole-bucket kernel; a 32M-row bucket "
+        "(~1GB of sort operands) still fits one v5e chip (ours)")
+    MERGE_CHUNK_ROWS = ConfigOption(
+        "tpu.merge.chunk-rows", int, 2 << 20,
+        "Decoded chunk rows per run for the streamed merge (ours)")
     BRANCH = ConfigOption("branch", str, "main", "")
     METASTORE_PARTITIONED_TABLE = ConfigOption("metastore.partitioned-table",
                                                _parse_bool, False, "")

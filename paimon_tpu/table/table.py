@@ -141,6 +141,13 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
+    def delete_where(self, predicate: Predicate) -> Optional[int]:
+        """Row-level DELETE: deletion vectors on append tables, -D
+        records on primary-key tables (reference DeleteAction /
+        BucketedDvMaintainer)."""
+        from paimon_tpu.index.dv_maintainer import delete_where
+        return delete_where(self, predicate)
+
     # -- maintenance ---------------------------------------------------------
 
     def expire_snapshots(self, retain_max: Optional[int] = None,

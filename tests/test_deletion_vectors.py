@@ -1,0 +1,177 @@
+"""Deletion vectors: roaring wire format + DELETE FROM write path.
+
+reference: deletionvectors/BitmapDeletionVector.java (MAGIC 1581511376,
+RoaringBitmap32 portable serialization), DeletionVectorsIndexFile.java
+(VERSION byte + [len][magic|bitmap][crc] entries).
+"""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.index.deletion_vector import (
+    MAGIC_V1, DeletionVector, DeletionVectorsIndexFile,
+)
+from paimon_tpu.index.roaring import (
+    deserialize_roaring32, serialize_roaring32,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+
+def test_roaring_roundtrip_array_container():
+    pos = np.array([1, 5, 7, 65536, 65537, 1 << 20], dtype=np.uint32)
+    data = serialize_roaring32(pos)
+    # cookie 12346 little-endian
+    assert struct.unpack_from("<I", data, 0)[0] == 12346
+    out = deserialize_roaring32(data)
+    assert np.array_equal(out, pos)
+
+
+def test_roaring_roundtrip_bitmap_container():
+    pos = np.arange(0, 10000, dtype=np.uint32)    # card > 4096 -> bitmap
+    data = serialize_roaring32(pos)
+    out = deserialize_roaring32(data)
+    assert np.array_equal(out, pos)
+
+
+def test_roaring_reads_run_container():
+    """Hand-build a run-container payload (cookie 12347) and decode it."""
+    n = 1
+    cookie = 12347 | ((n - 1) << 16)
+    run_flags = bytes([1])
+    keycards = struct.pack("<HH", 0, 9)           # key 0, card 10
+    body = struct.pack("<H", 1) + struct.pack("<HH", 3, 9)  # run 3..12
+    data = struct.pack("<I", cookie) + run_flags + keycards + body
+    out = deserialize_roaring32(data)
+    assert np.array_equal(out, np.arange(3, 13, dtype=np.uint32))
+
+
+def test_dv_wire_layout():
+    dv = DeletionVector(np.array([2, 4, 9]))
+    blob = dv.serialize()
+    (length,) = struct.unpack_from(">i", blob, 0)
+    (magic,) = struct.unpack_from(">i", blob, 4)
+    assert magic == MAGIC_V1 == 1581511376
+    body = blob[4:4 + length]
+    (crc,) = struct.unpack_from(">I", blob, 4 + length)
+    assert crc == (zlib.crc32(body) & 0xFFFFFFFF)
+    back = DeletionVector.deserialize(blob)
+    assert back.positions.tolist() == [2, 4, 9]
+
+
+def test_dv_index_file_roundtrip(tmp_path):
+    from paimon_tpu.fs import get_file_io
+
+    fio = get_file_io(str(tmp_path))
+    idx = DeletionVectorsIndexFile(fio, str(tmp_path))
+    dvs = {"data-a.parquet": DeletionVector(np.array([0, 3])),
+           "data-b.parquet": DeletionVector(np.array([7]))}
+    name, size, ranges = idx.write(dvs)
+    raw = open(os.path.join(str(tmp_path), name), "rb").read()
+    assert raw[0] == 1                            # VERSION_ID_V1
+    assert len(raw) == size
+    back = idx.read(name, ranges)
+    assert back["data-a.parquet"].positions.tolist() == [0, 3]
+    assert back["data-b.parquet"].positions.tolist() == [7]
+    assert ranges["data-a.parquet"][2] == 2       # cardinality
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_delete_where_append_table_uses_dvs(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType())
+              .column("v", DoubleType())
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    _commit(table, [{"id": i, "v": float(i)} for i in range(10)])
+    _commit(table, [{"id": i, "v": float(i)} for i in range(10, 20)])
+
+    sid = table.delete_where(P.less_than("id", 5))
+    assert sid is not None
+    out = sorted(table.to_arrow().column("id").to_pylist())
+    assert out == list(range(5, 20))
+    # data files untouched (positions masked, not rewritten)
+    snap = table.snapshot_manager.latest_snapshot()
+    assert snap.index_manifest
+
+    # second delete merges with existing DVs
+    table.delete_where(P.equal("id", 17))
+    out = sorted(table.to_arrow().column("id").to_pylist())
+    assert out == [i for i in range(5, 20) if i != 17]
+
+    # no-op delete commits nothing
+    before = table.snapshot_manager.latest_snapshot_id()
+    assert table.delete_where(P.equal("id", 999)) is None
+    assert table.snapshot_manager.latest_snapshot_id() == before
+
+
+def test_delete_where_pk_table_writes_retractions(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "p"), schema)
+    _commit(table, [{"id": i, "v": float(i)} for i in range(6)])
+    table.delete_where(P.greater_than("v", 3.5))
+    assert sorted(table.to_arrow().column("id").to_pylist()) == \
+        [0, 1, 2, 3]
+
+
+def test_roaring_rejects_out_of_range():
+    with pytest.raises(ValueError):
+        serialize_roaring32(np.array([1 << 32], dtype=np.int64))
+
+
+def test_dv_crc_validation():
+    dv = DeletionVector(np.array([1, 2, 3]))
+    blob = bytearray(dv.serialize())
+    blob[10] ^= 0xFF                      # corrupt the bitmap body
+    with pytest.raises(ValueError):
+        DeletionVector.deserialize(bytes(blob))
+
+
+def test_delete_where_conflict_replans(tmp_warehouse):
+    """A concurrent commit between DV planning and publish forces a
+    replan instead of silently dropping it."""
+    schema = (Schema.builder()
+              .column("id", BigIntType())
+              .column("v", DoubleType())
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "c"), schema)
+    _commit(table, [{"id": i, "v": float(i)} for i in range(10)])
+
+    # interleave by committing between plan and commit: patch the commit
+    # entry point used inside _delete_append_dv_once
+    from paimon_tpu.core import commit as commit_mod
+    real_commit = commit_mod.FileStoreCommit.commit
+    calls = {"n": 0}
+
+    def flaky_commit(self, *a, **k):
+        if calls["n"] == 0 and k.get("expected_latest_id") is not None:
+            calls["n"] += 1
+            _commit(table, [{"id": 100, "v": 100.0}])
+        return real_commit(self, *a, **k)
+
+    commit_mod.FileStoreCommit.commit = flaky_commit
+    try:
+        sid = table.delete_where(P.less_than("id", 3))
+    finally:
+        commit_mod.FileStoreCommit.commit = real_commit
+    assert sid is not None
+    ids = sorted(table.to_arrow().column("id").to_pylist())
+    assert ids == [3, 4, 5, 6, 7, 8, 9, 100]
