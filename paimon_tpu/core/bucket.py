@@ -25,7 +25,8 @@ from paimon_tpu.types import (
     IntType, SmallIntType, TimeType, TinyIntType,
 )
 
-__all__ = ["murmur_hash_bytes", "FixedBucketAssigner", "bucket_of"]
+__all__ = ["murmur_hash_bytes", "KeyHasher", "FixedBucketAssigner",
+           "bucket_of"]
 
 _C1 = 0xCC9E2D51
 _C2 = 0x1B873593
@@ -81,37 +82,34 @@ _FIXED_SLOT_TYPES = (BooleanType, TinyIntType, SmallIntType, IntType,
                      BigIntType, FloatType, DoubleType, DateType, TimeType)
 
 
-class FixedBucketAssigner:
-    """Vectorized fixed-bucket assignment for Arrow batches."""
+class KeyHasher:
+    """Vectorized reference-compatible murmur hash of bucket-key rows
+    (the shared base of fixed and dynamic bucket assignment)."""
 
     def __init__(self, bucket_key_names: Sequence[str],
-                 bucket_key_types: Sequence[DataType], num_buckets: int):
-        if num_buckets <= 0:
-            raise ValueError(f"bucket must be > 0, got {num_buckets}")
+                 bucket_key_types: Sequence[DataType]):
         self.names = list(bucket_key_names)
         self.types = list(bucket_key_types)
-        self.num_buckets = num_buckets
         self._codec = BinaryRowCodec(self.types)
         self._fixed_width = all(isinstance(t, _FIXED_SLOT_TYPES)
                                 for t in self.types)
 
-    def assign(self, table: pa.Table) -> np.ndarray:
+    def hashes(self, table: pa.Table) -> np.ndarray:
+        """uint64[N] murmur hashes (low 32 bits significant)."""
         if self._fixed_width:
-            return self._assign_vectorized(table)
-        return self._assign_rows(table)
+            return self._hash_vectorized(table)
+        return self._hash_rows(table)
 
-    def _assign_rows(self, table: pa.Table) -> np.ndarray:
+    def _hash_rows(self, table: pa.Table) -> np.ndarray:
         cols = [table.column(n).to_pylist() for n in self.names]
-        out = np.empty(table.num_rows, dtype=np.int32)
+        out = np.empty(table.num_rows, dtype=np.uint64)
         for i in range(table.num_rows):
             values = tuple(c[i] for c in cols)
             data = self._codec.to_bytes(values, with_arity_prefix=False)
-            out[i] = _bucket_from_hash(
-                np.array([murmur_hash_bytes(data)], dtype=np.uint64),
-                self.num_buckets)[0]
+            out[i] = murmur_hash_bytes(data)
         return out
 
-    def _assign_vectorized(self, table: pa.Table) -> np.ndarray:
+    def _hash_vectorized(self, table: pa.Table) -> np.ndarray:
         """Build the BinaryRow byte matrix for all rows at once, then run
         murmur word-mixing across rows with numpy."""
         n = table.num_rows
@@ -172,4 +170,21 @@ class FixedBucketAssigner:
         h1 ^= h1 >> np.uint64(13)
         h1 = (h1 * np.uint64(0xC2B2AE35)) & m32
         h1 ^= h1 >> np.uint64(16)
-        return _bucket_from_hash(h1, self.num_buckets)
+        return h1
+
+
+class FixedBucketAssigner:
+    """Vectorized fixed-bucket assignment for Arrow batches."""
+
+    def __init__(self, bucket_key_names: Sequence[str],
+                 bucket_key_types: Sequence[DataType], num_buckets: int):
+        if num_buckets <= 0:
+            raise ValueError(f"bucket must be > 0, got {num_buckets}")
+        self.names = list(bucket_key_names)
+        self.types = list(bucket_key_types)
+        self.num_buckets = num_buckets
+        self._hasher = KeyHasher(bucket_key_names, bucket_key_types)
+
+    def assign(self, table: pa.Table) -> np.ndarray:
+        return _bucket_from_hash(self._hasher.hashes(table),
+                                 self.num_buckets)

@@ -114,7 +114,8 @@ class FileStoreCommit:
 
     def overwrite(self, messages: Sequence[CommitMessage],
                   partition_filter: Optional[dict] = None,
-                  commit_identifier: int = BATCH_COMMIT_IDENTIFIER
+                  commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
+                  index_entries: Optional[list] = None
                   ) -> Optional[int]:
         """INSERT OVERWRITE: delete current files (optionally restricted to
         a partition spec) and add new ones atomically
@@ -144,7 +145,8 @@ class FileStoreCommit:
             return entries + adds
 
         return self._try_commit([], [], commit_identifier,
-                                CommitKind.OVERWRITE, entries_fn=entries_fn)
+                                CommitKind.OVERWRITE, entries_fn=entries_fn,
+                                index_entries=index_entries)
 
     def filter_committed(self, commit_identifiers: Sequence[int]
                          ) -> List[int]:

@@ -1,0 +1,184 @@
+"""Dynamic bucket mode: key-hash -> bucket index grown on demand.
+
+reference: index/HashBucketAssigner.java + PartitionIndex.java (per
+partition: a persistent set of key hashes per bucket, stored as raw
+4-byte big-endian ints in HASH index files referenced from the index
+manifest; new keys fill the active bucket until
+dynamic-bucket.target-row-num, then a new bucket opens),
+index/HashIndexFile.java (int file format).
+
+Assignment is vectorized: a batch's key hashes resolve against the
+in-memory {hash -> bucket} map in one numpy pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paimon_tpu.manifest import FileKind
+from paimon_tpu.manifest.index_manifest import (
+    HASH_INDEX, IndexFileMeta, IndexManifestEntry,
+)
+
+__all__ = ["PartitionIndex", "DynamicBucketAssigner"]
+
+
+class PartitionIndex:
+    """One partition's hash -> bucket mapping (reference
+    index/PartitionIndex.java). Known hashes resolve in one vectorized
+    searchsorted; Python iteration only touches UNSEEN keys."""
+
+    def __init__(self, target_row_num: int):
+        self.target_row_num = target_row_num
+        self._sorted_hashes = np.zeros(0, dtype=np.int64)
+        self._sorted_buckets = np.zeros(0, dtype=np.int32)
+        self._pending: Dict[int, int] = {}     # not yet merged into sorted
+        self.bucket_counts: Dict[int, int] = {}
+        self.dirty_buckets: set = set()
+
+    def load_bucket(self, bucket: int, hashes: np.ndarray):
+        h = np.asarray(hashes, dtype=np.int64)
+        self._sorted_hashes = np.concatenate([self._sorted_hashes, h])
+        self._sorted_buckets = np.concatenate(
+            [self._sorted_buckets, np.full(len(h), bucket, np.int32)])
+        order = np.argsort(self._sorted_hashes, kind="stable")
+        self._sorted_hashes = self._sorted_hashes[order]
+        self._sorted_buckets = self._sorted_buckets[order]
+        self.bucket_counts[bucket] = \
+            self.bucket_counts.get(bucket, 0) + len(h)
+
+    def _compact_pending(self):
+        if len(self._pending) < 65536:
+            return
+        ph = np.fromiter(self._pending.keys(), dtype=np.int64,
+                         count=len(self._pending))
+        pb = np.fromiter(self._pending.values(), dtype=np.int32,
+                         count=len(self._pending))
+        self._sorted_hashes = np.concatenate([self._sorted_hashes, ph])
+        self._sorted_buckets = np.concatenate([self._sorted_buckets, pb])
+        order = np.argsort(self._sorted_hashes, kind="stable")
+        self._sorted_hashes = self._sorted_hashes[order]
+        self._sorted_buckets = self._sorted_buckets[order]
+        self._pending = {}
+
+    def assign(self, hashes: np.ndarray) -> np.ndarray:
+        """hashes -> buckets; unseen hashes go to the first bucket with
+        capacity (new buckets open as needed)."""
+        h = np.asarray(hashes, dtype=np.int64)
+        out = np.empty(len(h), dtype=np.int32)
+        # vectorized resolve against the persisted index
+        if len(self._sorted_hashes):
+            pos = np.searchsorted(self._sorted_hashes, h)
+            pos_c = np.minimum(pos, len(self._sorted_hashes) - 1)
+            known = self._sorted_hashes[pos_c] == h
+            out[known] = self._sorted_buckets[pos_c[known]]
+        else:
+            known = np.zeros(len(h), dtype=bool)
+        # remainder: pending dict, then truly new keys
+        for i in np.flatnonzero(~known):
+            hv = int(h[i])
+            b = self._pending.get(hv)
+            if b is None:
+                b = self._bucket_with_capacity()
+                self._pending[hv] = b
+                self.bucket_counts[b] = self.bucket_counts.get(b, 0) + 1
+                self.dirty_buckets.add(b)
+            out[i] = b
+        self._compact_pending()
+        return out
+
+    def _bucket_with_capacity(self) -> int:
+        for b in sorted(self.bucket_counts):
+            if self.bucket_counts[b] < self.target_row_num:
+                return b
+        return max(self.bucket_counts, default=-1) + 1
+
+    def bucket_hashes(self, bucket: int) -> List[int]:
+        out = self._sorted_hashes[self._sorted_buckets == bucket].tolist()
+        out.extend(hv for hv, b in self._pending.items() if b == bucket)
+        return out
+
+
+class DynamicBucketAssigner:
+    """Loads per-partition hash indexes from the latest snapshot, assigns
+    buckets for new rows, and produces the replacement index-manifest
+    entries at prepare-commit."""
+
+    def __init__(self, scan, target_row_num: int):
+        self.scan = scan
+        self.target_row_num = target_row_num
+        self._indexes: Dict[Tuple, PartitionIndex] = {}
+        self._prev_entries: Optional[List[IndexManifestEntry]] = None
+
+    # -- persistent index ----------------------------------------------------
+
+    def _load_prev_entries(self) -> List[IndexManifestEntry]:
+        if self._prev_entries is not None:
+            return self._prev_entries
+        out: List[IndexManifestEntry] = []
+        snapshot = self.scan.snapshot_manager.latest_snapshot()
+        if snapshot is not None and snapshot.index_manifest:
+            out = [e for e in self.scan.index_manifest_file.read(
+                       snapshot.index_manifest)
+                   if e.index_file.index_type == HASH_INDEX]
+        self._prev_entries = out
+        return out
+
+    def _index(self, partition: Tuple) -> PartitionIndex:
+        idx = self._indexes.get(partition)
+        if idx is not None:
+            return idx
+        idx = PartitionIndex(self.target_row_num)
+        pbytes = self.scan._partition_codec.to_bytes(partition)
+        for e in self._load_prev_entries():
+            if e.partition != pbytes:
+                continue
+            path = self.scan.path_factory.index_file_path(
+                e.index_file.file_name)
+            data = self.scan.file_io.read_bytes(path)
+            hashes = np.frombuffer(data, dtype=">i4")
+            idx.load_bucket(e.bucket, hashes)
+        self._indexes[partition] = idx
+        return idx
+
+    # -- assignment ----------------------------------------------------------
+
+    def assign(self, partition: Tuple, hashes: np.ndarray) -> np.ndarray:
+        h32 = hashes.astype(np.uint64).astype(np.uint32).view(np.int32) \
+            if hashes.dtype != np.int32 else hashes
+        return self._index(partition).assign(
+            np.asarray(h32, dtype=np.int64))
+
+    # -- commit --------------------------------------------------------------
+
+    def index_entries(self) -> List[IndexManifestEntry]:
+        """Replacement HASH index entries for every dirty bucket (old
+        entry deleted, full rewritten file added — reference
+        DynamicBucketIndexMaintainer.prepareCommit)."""
+        # re-read the live entry list: a previous prepare_commit from this
+        # writer may have committed entries the DELETE list must cover
+        self._prev_entries = None
+        entries: List[IndexManifestEntry] = []
+        for partition, idx in self._indexes.items():
+            if not idx.dirty_buckets:
+                continue
+            pbytes = self.scan._partition_codec.to_bytes(partition)
+            for e in self._load_prev_entries():
+                if e.partition == pbytes and e.bucket in idx.dirty_buckets:
+                    entries.append(IndexManifestEntry(
+                        FileKind.DELETE, e.partition, e.bucket,
+                        e.index_file))
+            for b in sorted(idx.dirty_buckets):
+                hashes = np.array(idx.bucket_hashes(b), dtype=">i4")
+                name = self.scan.path_factory.new_index_file_name()
+                path = self.scan.path_factory.index_file_path(name)
+                self.scan.file_io.write_bytes(path, hashes.tobytes(),
+                                              overwrite=False)
+                entries.append(IndexManifestEntry(
+                    FileKind.ADD, pbytes, b,
+                    IndexFileMeta(HASH_INDEX, name, hashes.nbytes,
+                                  len(hashes))))
+            idx.dirty_buckets = set()
+        return entries

@@ -195,6 +195,18 @@ class FileStoreScan:
                 out.append(m)
         return out
 
+    def _partition_matches(self, pbytes: bytes) -> bool:
+        """Shared partition-filter check for data entries and DV index
+        entries."""
+        if not self._partition_filter:
+            return True
+        values = self._partition_codec.from_bytes(pbytes)
+        for i, k in enumerate(self.schema.partition_keys):
+            if k in self._partition_filter and \
+                    str(values[i]) != str(self._partition_filter[k]):
+                return False
+        return True
+
     def _entry_visible(self, e: ManifestEntry) -> bool:
         if self._bucket_filter is not None and \
                 e.bucket not in self._bucket_filter:
@@ -202,12 +214,8 @@ class FileStoreScan:
         if self._level_filter is not None and \
                 not self._level_filter(e.file.level):
             return False
-        if self._partition_filter:
-            values = self._partition_codec.from_bytes(e.partition)
-            for i, k in enumerate(self.schema.partition_keys):
-                if k in self._partition_filter and \
-                        str(values[i]) != str(self._partition_filter[k]):
-                    return False
+        if not self._partition_matches(e.partition):
+            return False
         if self._key_filter is not None and self.schema.primary_keys:
             key_types = [t.copy(False) for t in (
                 self.schema.logical_row_type().get_field(k).type
@@ -300,14 +308,8 @@ class FileStoreScan:
             if self._bucket_filter is not None and \
                     e.bucket not in self._bucket_filter:
                 continue
-            if self._partition_filter:
-                values = self._partition_codec.from_bytes(e.partition)
-                skip = any(
-                    k in self._partition_filter
-                    and str(values[i]) != str(self._partition_filter[k])
-                    for i, k in enumerate(self.schema.partition_keys))
-                if skip:
-                    continue
+            if not self._partition_matches(e.partition):
+                continue
             dvs = read_deletion_vectors(
                 self.file_io,
                 self.path_factory.index_file_path(e.index_file.file_name),

@@ -45,11 +45,13 @@ class CommitMessage:
     compact_after: List[DataFileMeta] = dc_field(default_factory=list)
     changelog_files: List[DataFileMeta] = dc_field(default_factory=list)
     compact_changelog: List[DataFileMeta] = dc_field(default_factory=list)
+    # dynamic-bucket hash index updates (reference indexIncrement)
+    index_entries: List = dc_field(default_factory=list)
 
     def is_empty(self) -> bool:
         return not (self.new_files or self.compact_before
                     or self.compact_after or self.changelog_files
-                    or self.compact_changelog)
+                    or self.compact_changelog or self.index_entries)
 
 
 def group_by_partition_bucket(table: pa.Table, buckets: np.ndarray,
@@ -180,11 +182,12 @@ class KeyValueFileStoreWrite:
     def __init__(self, file_io: FileIO, table_path: str,
                  table_schema: TableSchema, options: CoreOptions,
                  restore_max_seq: Optional[Callable[[Tuple, int], int]]
-                 = None):
+                 = None, branch: str = "main"):
         self.file_io = file_io
         self.table_path = table_path
         self.schema = table_schema
         self.options = options
+        self.branch = branch
         self.partition_keys = table_schema.partition_keys
         self.path_factory = FileStorePathFactory(
             table_path, self.partition_keys,
@@ -197,9 +200,23 @@ class KeyValueFileStoreWrite:
         rt = table_schema.logical_row_type()
         self.total_buckets = options.bucket
         bucket_keys = table_schema.bucket_keys()
-        self.bucket_assigner = FixedBucketAssigner(
-            bucket_keys, [rt.get_field(k).type for k in bucket_keys],
-            max(1, options.bucket))
+        self._dynamic = None
+        if options.bucket < 1:
+            # dynamic bucket mode (reference BucketMode.HASH_DYNAMIC)
+            from paimon_tpu.core.bucket import KeyHasher
+            from paimon_tpu.core.dynamic_bucket import DynamicBucketAssigner
+            from paimon_tpu.core.scan import FileStoreScan
+            self._key_hasher = KeyHasher(
+                bucket_keys, [rt.get_field(k).type for k in bucket_keys])
+            self._dynamic = DynamicBucketAssigner(
+                FileStoreScan(file_io, table_path, table_schema, options,
+                              branch=branch),
+                options.dynamic_bucket_target_row_num)
+            self.bucket_assigner = None
+        else:
+            self.bucket_assigner = FixedBucketAssigner(
+                bucket_keys, [rt.get_field(k).type for k in bucket_keys],
+                options.bucket)
         from paimon_tpu.ops.normkey import NormalizedKeyEncoder
         from paimon_tpu.types import data_type_to_arrow
         self.key_encoder = NormalizedKeyEncoder(
@@ -242,6 +259,21 @@ class KeyValueFileStoreWrite:
             row_kinds = np.zeros(table.num_rows, dtype=np.int8)
         row_kinds = np.asarray(row_kinds, dtype=np.int8)
 
+        if self._dynamic is not None:
+            # partition-first grouping: bucket assignment depends on the
+            # partition's hash index
+            zeros = np.zeros(table.num_rows, dtype=np.int32)
+            for (part, _), idx in group_by_partition_bucket(
+                    table, zeros, self.partition_keys):
+                sub = table.take(pa.array(idx))
+                sub_kinds = row_kinds[idx]
+                buckets = self._dynamic.assign(
+                    part, self._key_hasher.hashes(sub))
+                for (_, bucket), idx2 in group_by_partition_bucket(
+                        sub, buckets, []):
+                    self._writer(part, bucket).write(
+                        sub.take(pa.array(idx2)), sub_kinds[idx2])
+            return
         buckets = self.bucket_assigner.assign(table)
         for (part, bucket), idx in group_by_partition_bucket(
                 table, buckets, self.partition_keys):
@@ -261,6 +293,14 @@ class KeyValueFileStoreWrite:
             msg = w.prepare_commit()
             if msg is not None:
                 out.append(msg)
+        if self._dynamic is not None:
+            entries = self._dynamic.index_entries()
+            if entries:
+                if out:
+                    out[0].index_entries.extend(entries)
+                else:
+                    out.append(CommitMessage((), 0, self.total_buckets,
+                                             index_entries=entries))
         return out
 
     def close(self):

@@ -71,8 +71,10 @@ class DeletionVector:
 
     def serialize(self) -> bytes:
         """[i32 BE length][i32 BE MAGIC + roaring bytes][i32 BE crc32]."""
+        # int64 positions pass through unchanged: the roaring codec
+        # raises on values beyond the 32-bit range instead of wrapping
         body = struct.pack(">i", MAGIC_V1) + \
-            serialize_roaring32(self.positions.astype(np.uint32))
+            serialize_roaring32(self.positions)
         crc = zlib.crc32(body) & 0xFFFFFFFF
         return struct.pack(">i", len(body)) + body + struct.pack(">I", crc)
 

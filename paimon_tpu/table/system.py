@@ -1,0 +1,207 @@
+"""System tables: the queryable observability surface.
+
+reference: table/system/SystemTableLoader.java + 24 system table impls
+(SnapshotsTable, SchemasTable, FilesTable, ManifestsTable, TagsTable,
+BranchesTable, ConsumersTable, OptionsTable, PartitionsTable,
+BucketsTable, AuditLogTable...). Each loads as an Arrow table via
+`table.system_table(name)` or `catalog.get_table("db.t$snapshots")`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import pyarrow as pa
+
+__all__ = ["SYSTEM_TABLES", "load_system_table"]
+
+
+def _snapshots(table) -> pa.Table:
+    rows = []
+    for s in table.snapshot_manager.snapshots():
+        rows.append({
+            "snapshot_id": s.id, "schema_id": s.schema_id,
+            "commit_user": s.commit_user,
+            "commit_identifier": s.commit_identifier,
+            "commit_kind": s.commit_kind, "commit_time": s.time_millis,
+            "base_manifest_list": s.base_manifest_list,
+            "delta_manifest_list": s.delta_manifest_list,
+            "changelog_manifest_list": s.changelog_manifest_list,
+            "total_record_count": s.total_record_count,
+            "delta_record_count": s.delta_record_count,
+            "changelog_record_count": s.changelog_record_count,
+            "watermark": s.watermark,
+        })
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "snapshot_id": pa.array([], pa.int64())})
+
+
+def _schemas(table) -> pa.Table:
+    rows = []
+    for sid in table.schema_manager.list_all_ids():
+        ts = table.schema_manager.schema(sid)
+        rows.append({
+            "schema_id": ts.id,
+            "fields": str([f.name for f in ts.fields]),
+            "partition_keys": str(ts.partition_keys),
+            "primary_keys": str(ts.primary_keys),
+            "options": str(ts.options),
+            "comment": getattr(ts, "comment", None),
+        })
+    return pa.Table.from_pylist(rows)
+
+
+def _options(table) -> pa.Table:
+    opts = table.schema.options
+    return pa.table({
+        "key": pa.array(list(opts.keys()), pa.string()),
+        "value": pa.array([str(v) for v in opts.values()], pa.string()),
+    })
+
+
+def _files(table) -> pa.Table:
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return pa.table({"file_path": pa.array([], pa.string())})
+    scan = table.new_scan()
+    rows = []
+    for e in scan.read_entries(snapshot):
+        partition = scan._partition_codec.from_bytes(e.partition)
+        f = e.file
+        rows.append({
+            "partition": str(list(partition)),
+            "bucket": e.bucket,
+            "file_path": scan.path_factory.data_file_path(
+                partition, e.bucket, f.file_name),
+            "file_name": f.file_name,
+            "file_format": f.file_name.rsplit(".", 1)[-1],
+            "schema_id": f.schema_id,
+            "level": f.level,
+            "record_count": f.row_count,
+            "file_size_in_bytes": f.file_size,
+            "min_sequence_number": f.min_sequence_number,
+            "max_sequence_number": f.max_sequence_number,
+            "deleted_record_count": f.delete_row_count or 0,
+        })
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "file_path": pa.array([], pa.string())})
+
+
+def _manifests(table) -> pa.Table:
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return pa.table({"file_name": pa.array([], pa.string())})
+    scan = table.new_scan()
+    metas = scan.manifest_list.read_all(snapshot.base_manifest_list,
+                                        snapshot.delta_manifest_list)
+    rows = [{
+        "file_name": m.file_name,
+        "file_size": m.file_size,
+        "num_added_files": m.num_added_files,
+        "num_deleted_files": m.num_deleted_files,
+        "schema_id": m.schema_id,
+    } for m in metas]
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "file_name": pa.array([], pa.string())})
+
+
+def _tags(table) -> pa.Table:
+    rows = [{
+        "tag_name": name,
+        "snapshot_id": snap.id,
+        "schema_id": snap.schema_id,
+        "commit_time": snap.time_millis,
+        "record_count": snap.total_record_count,
+    } for name, snap in table.tag_manager.tags().items()]
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "tag_name": pa.array([], pa.string())})
+
+
+def _branches(table) -> pa.Table:
+    rows = [{"branch_name": b} for b in table.branch_manager.branches()]
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "branch_name": pa.array([], pa.string())})
+
+
+def _consumers(table) -> pa.Table:
+    rows = [{"consumer_id": cid, "next_snapshot_id": nxt}
+            for cid, nxt in table.consumer_manager.consumers().items()]
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "consumer_id": pa.array([], pa.string())})
+
+
+def _partitions(table) -> pa.Table:
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return pa.table({"partition": pa.array([], pa.string())})
+    scan = table.new_scan()
+    agg: Dict[bytes, Dict] = {}
+    for e in scan.read_entries(snapshot):
+        d = agg.setdefault(e.partition, {
+            "partition": str(list(
+                scan._partition_codec.from_bytes(e.partition))),
+            "record_count": 0, "file_size_in_bytes": 0, "file_count": 0})
+        d["record_count"] += e.file.row_count
+        d["file_size_in_bytes"] += e.file.file_size
+        d["file_count"] += 1
+    return pa.Table.from_pylist(list(agg.values())) if agg else pa.table({
+        "partition": pa.array([], pa.string())})
+
+
+def _buckets(table) -> pa.Table:
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return pa.table({"bucket": pa.array([], pa.int32())})
+    scan = table.new_scan()
+    agg: Dict = {}
+    for e in scan.read_entries(snapshot):
+        key = (e.partition, e.bucket)
+        d = agg.setdefault(key, {
+            "partition": str(list(
+                scan._partition_codec.from_bytes(e.partition))),
+            "bucket": e.bucket, "record_count": 0,
+            "file_size_in_bytes": 0, "file_count": 0})
+        d["record_count"] += e.file.row_count
+        d["file_size_in_bytes"] += e.file.file_size
+        d["file_count"] += 1
+    return pa.Table.from_pylist(list(agg.values())) if agg else pa.table({
+        "bucket": pa.array([], pa.int32())})
+
+
+def _audit_log(table) -> pa.Table:
+    """Batch audit log: the latest snapshot's rows with rowkind column
+    (reference AuditLogTable; streaming variant = stream scan)."""
+    from paimon_tpu.core.read import ROW_KIND_COL
+
+    plan = table.new_scan().plan(streaming=True)
+    rb = table.new_read_builder()
+    out = rb.new_read().to_arrow(plan)
+    kinds = out.column(ROW_KIND_COL)
+    mapping = {0: "+I", 1: "-U", 2: "+U", 3: "-D"}
+    rowkind = pa.array([mapping[k.as_py()] for k in kinds], pa.string())
+    out = out.drop_columns([ROW_KIND_COL])
+    return out.add_column(0, "rowkind", rowkind)
+
+
+SYSTEM_TABLES: Dict[str, Callable] = {
+    "snapshots": _snapshots,
+    "schemas": _schemas,
+    "options": _options,
+    "files": _files,
+    "manifests": _manifests,
+    "tags": _tags,
+    "branches": _branches,
+    "consumers": _consumers,
+    "partitions": _partitions,
+    "buckets": _buckets,
+    "audit_log": _audit_log,
+}
+
+
+def load_system_table(table, name: str) -> pa.Table:
+    """reference table/system/SystemTableLoader.java."""
+    key = name.lower()
+    if key not in SYSTEM_TABLES:
+        raise ValueError(f"Unknown system table {name!r}; available: "
+                         f"{sorted(SYSTEM_TABLES)}")
+    return SYSTEM_TABLES[key](table)

@@ -141,6 +141,12 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
+    def system_table(self, name: str) -> pa.Table:
+        """Load a system table ('snapshots', 'files', 'audit_log', ...)
+        as Arrow (reference table/system/SystemTableLoader.java)."""
+        from paimon_tpu.table.system import load_system_table
+        return load_system_table(self, name)
+
     def delete_where(self, predicate: Predicate) -> Optional[int]:
         """Row-level DELETE: deletion vectors on append tables, -D
         records on primary-key tables (reference DeleteAction /
@@ -272,7 +278,7 @@ class TableWrite:
         if table.primary_keys:
             self._write = KeyValueFileStoreWrite(
                 table.file_io, table.path, table.schema, table.options,
-                restore_max_seq=restore)
+                restore_max_seq=restore, branch=table.branch)
         else:
             from paimon_tpu.core.append import AppendOnlyFileStoreWrite
             self._write = AppendOnlyFileStoreWrite(
@@ -313,11 +319,15 @@ class TableCommit:
     def commit(self, messages: Sequence[CommitMessage],
                commit_identifier: int = BATCH_COMMIT_IDENTIFIER
                ) -> Optional[int]:
+        index_entries = [e for m in messages
+                         for e in getattr(m, "index_entries", [])]
         if self._overwrite is not None:
             return self._commit.overwrite(
                 messages, partition_filter=self._overwrite or None,
-                commit_identifier=commit_identifier)
-        return self._commit.commit(messages, commit_identifier)
+                commit_identifier=commit_identifier,
+                index_entries=index_entries or None)
+        return self._commit.commit(messages, commit_identifier,
+                                   index_entries=index_entries or None)
 
     def filter_committed(self, identifiers: Sequence[int]) -> List[int]:
         return self._commit.filter_committed(identifiers)
