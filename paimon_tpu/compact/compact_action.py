@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from paimon_tpu.compact.manager import MergeTreeCompactManager
+from paimon_tpu.options import CoreOptions
 from paimon_tpu.core.commit import FileStoreCommit
 from paimon_tpu.core.write import CommitMessage
 from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
@@ -83,7 +84,11 @@ def _append_compact(table, path_factory, partition, bucket, files, full):
         table.file_io, path_factory, table.schema,
         file_format=table.options.file_format,
         compression=table.options.file_compression,
-        target_file_size=table.options.target_file_size)
+        target_file_size=table.options.target_file_size,
+        bloom_columns=table.options.bloom_filter_columns,
+        bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+        index_in_manifest_threshold=table.options.get(
+            CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
     cache = {table.schema.id: table.schema}
     tables = [evolve_table(
                   read_kv_file(table.file_io, path_factory, partition,

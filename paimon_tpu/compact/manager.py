@@ -71,7 +71,11 @@ class MergeTreeCompactManager:
             file_io, self.path_factory, schema,
             file_format=options.file_format,
             compression=options.file_compression,
-            target_file_size=options.target_file_size)
+            target_file_size=options.target_file_size,
+            bloom_columns=options.bloom_filter_columns,
+            bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+            index_in_manifest_threshold=options.get(
+                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
         rt = schema.logical_row_type()
         self.trimmed_pk = schema.trimmed_primary_keys()
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]

@@ -44,13 +44,19 @@ class AppendFileWriter:
 
     def __init__(self, file_io: FileIO, path_factory: FileStorePathFactory,
                  table_schema: TableSchema, file_format: str,
-                 compression: str, target_file_size: int):
+                 compression: str, target_file_size: int,
+                 bloom_columns: Optional[List[str]] = None,
+                 bloom_fpp: float = 0.01,
+                 index_in_manifest_threshold: int = 500):
         self.file_io = file_io
         self.path_factory = path_factory
         self.schema = table_schema
         self.file_format = file_format
         self.compression = compression
         self.target_file_size = target_file_size
+        self.bloom_columns = bloom_columns or []
+        self.bloom_fpp = bloom_fpp
+        self.index_in_manifest_threshold = index_in_manifest_threshold
 
     def write(self, partition: Tuple, bucket: int, table: pa.Table,
               first_seq: int,
@@ -80,6 +86,16 @@ class AppendFileWriter:
         vmins, vmaxs, vnulls = extract_simple_stats(chunk, value_cols)
         value_stats = _safe_stats([f.type for f in self.schema.fields],
                                   vmins, vmaxs, vnulls)
+        embedded_index, extra_files = None, []
+        if self.bloom_columns:
+            from paimon_tpu.index.bloom import (
+                build_file_index, place_file_index,
+            )
+            blob = build_file_index(chunk, self.bloom_columns,
+                                    self.bloom_fpp)
+            embedded_index, extra_files = place_file_index(
+                self.file_io, self.path_factory, partition, bucket, name,
+                blob, self.index_in_manifest_threshold)
         return DataFileMeta(
             file_name=name,
             file_size=size,
@@ -93,6 +109,8 @@ class AppendFileWriter:
             schema_id=self.schema.id,
             level=0,
             file_source=file_source,
+            embedded_index=embedded_index,
+            extra_files=extra_files,
         )
 
 
@@ -155,7 +173,11 @@ class AppendOnlyFileStoreWrite:
             file_io, self.path_factory, table_schema,
             file_format=options.file_format,
             compression=options.file_compression,
-            target_file_size=options.target_file_size)
+            target_file_size=options.target_file_size,
+            bloom_columns=options.bloom_filter_columns,
+            bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+            index_in_manifest_threshold=options.get(
+                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
         self.total_buckets = options.bucket
         self._unaware = options.bucket < 1
         if not self._unaware:

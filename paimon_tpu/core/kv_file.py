@@ -33,13 +33,19 @@ class KeyValueFileWriter:
     def __init__(self, file_io: FileIO, path_factory: FileStorePathFactory,
                  table_schema: TableSchema, file_format: str = "parquet",
                  compression: str = "zstd",
-                 target_file_size: int = 128 << 20):
+                 target_file_size: int = 128 << 20,
+                 bloom_columns: Optional[List[str]] = None,
+                 bloom_fpp: float = 0.01,
+                 index_in_manifest_threshold: int = 500):
         self.file_io = file_io
         self.path_factory = path_factory
         self.schema = table_schema
         self.file_format = file_format
         self.compression = compression
         self.target_file_size = target_file_size
+        self.bloom_columns = bloom_columns or []
+        self.bloom_fpp = bloom_fpp
+        self.index_in_manifest_threshold = index_in_manifest_threshold
         self.trimmed_pk = table_schema.trimmed_primary_keys()
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]
         rt = table_schema.logical_row_type()
@@ -93,6 +99,17 @@ class KeyValueFileWriter:
                            .cast(pa.int8()))
         delete_rows = int(((kinds == 1) | (kinds == 3)).sum())
 
+        embedded_index, extra_files = None, []
+        if self.bloom_columns:
+            from paimon_tpu.index.bloom import (
+                build_file_index, place_file_index,
+            )
+            blob = build_file_index(chunk, self.bloom_columns,
+                                    self.bloom_fpp)
+            embedded_index, extra_files = place_file_index(
+                self.file_io, self.path_factory, partition, bucket, name,
+                blob, self.index_in_manifest_threshold)
+
         return DataFileMeta(
             file_name=name,
             file_size=size,
@@ -107,6 +124,8 @@ class KeyValueFileWriter:
             level=level,
             delete_row_count=delete_rows,
             file_source=file_source,
+            embedded_index=embedded_index,
+            extra_files=extra_files,
         )
 
 

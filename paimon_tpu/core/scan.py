@@ -85,6 +85,7 @@ class FileStoreScan:
         self.index_manifest_file = IndexManifestFile(file_io, mdir, codec)
         self._partition_filter: Optional[dict] = None
         self._bucket_filter: Optional[set] = None
+        self._bloom_hash_cache: Dict[int, list] = {}
         self._key_filter: Optional[Predicate] = None
         self._value_filter: Optional[Predicate] = None
         self._level_filter: Optional[Callable[[int], bool]] = None
@@ -207,7 +208,71 @@ class FileStoreScan:
                 return False
         return True
 
+    def _bloom_literal_hashes(self, pred) -> List[Tuple[str, int]]:
+        """[(field, literal_hash)] for a predicate's conjunctive
+        equalities — computed once per scan, not per manifest entry."""
+        cached = self._bloom_hash_cache.get(id(pred))
+        if cached is not None:
+            return cached
+        from paimon_tpu.index.bloom import hash_value
+        from paimon_tpu.predicate import conjunctive_equalities
+        from paimon_tpu.types import data_type_to_arrow
+        rt = self.schema.logical_row_type()
+        out = []
+        for field, lit in conjunctive_equalities(pred):
+            if lit is None:
+                continue
+            try:
+                at = data_type_to_arrow(rt.get_field(field).type)
+                out.append((field, hash_value(lit, at)))
+            except (KeyError, ValueError):
+                continue
+        self._bloom_hash_cache[id(pred)] = out
+        return out
+
+    def _file_bloom(self, e: ManifestEntry):
+        """Load a file's bloom index: embedded blob, or the .index
+        sidecar recorded in extra_files (above the in-manifest
+        threshold)."""
+        from paimon_tpu.index.bloom import read_file_index
+        if e.file.embedded_index is not None:
+            return read_file_index(e.file.embedded_index)
+        for extra in e.file.extra_files:
+            if extra.endswith(".index"):
+                partition = self._partition_codec.from_bytes(e.partition)
+                path = self.path_factory.data_file_path(
+                    partition, e.bucket, extra)
+                try:
+                    return read_file_index(self.file_io.read_bytes(path))
+                except FileNotFoundError:
+                    return {}
+        return {}
+
+    def _bloom_rejects(self, e: ManifestEntry, pred) -> bool:
+        """Per-file bloom index skip on conjunctive equality predicates
+        (role of reference io/FileIndexEvaluator)."""
+        if pred is None:
+            return False
+        pairs = self._bloom_literal_hashes(pred)
+        if not pairs:
+            return False
+        blooms = self._file_bloom(e)
+        if not blooms:
+            return False
+        for field, h in pairs:
+            bf = blooms.get(field)
+            if bf is not None and not bf.might_contain(h):
+                return True
+        return False
+
     def _entry_visible(self, e: ManifestEntry) -> bool:
+        """Per-file visibility. NOTE: value-predicate pruning for
+        primary-key tables is NOT applied here — a file without matching
+        values may still hold the newest version of a key whose older
+        version matches, so dropping it would corrupt the merge; value
+        pruning for pk tables happens at bucket granularity in
+        generate_splits (reference applies value filters per
+        non-overlapping section for the same reason)."""
         if self._bucket_filter is not None and \
                 e.bucket not in self._bucket_filter:
             return False
@@ -215,6 +280,8 @@ class FileStoreScan:
                 not self._level_filter(e.file.level):
             return False
         if not self._partition_matches(e.partition):
+            return False
+        if self._bloom_rejects(e, self._key_filter):
             return False
         if self._key_filter is not None and self.schema.primary_keys:
             key_types = [t.copy(False) for t in (
@@ -231,20 +298,36 @@ class FileStoreScan:
                              or [0] * len(names))),
                     e.file.row_count):
                 return False
-        if self._value_filter is not None:
-            value_types = [f.type.as_nullable() for f in self.schema.fields]
-            names = [f.name for f in self.schema.fields]
-            try:
-                mins, maxs = e.file.value_stats.decode(value_types)
-            except Exception:
-                return True
-            if not self._value_filter.test_stats(
-                    dict(zip(names, mins)), dict(zip(names, maxs)),
-                    dict(zip(names, e.file.value_stats.null_counts
-                             or [0] * len(names))),
-                    e.file.row_count):
+        if self._value_filter is not None and not self.schema.primary_keys:
+            # append tables: safe to drop individual files on value stats
+            if not self._value_stats_match(e):
+                return False
+            if self._bloom_rejects(e, self._value_filter):
                 return False
         return True
+
+    def _value_stats_match(self, e: ManifestEntry) -> bool:
+        value_types = [f.type.as_nullable() for f in self.schema.fields]
+        names = [f.name for f in self.schema.fields]
+        try:
+            mins, maxs = e.file.value_stats.decode(value_types)
+        except Exception:
+            return True
+        return self._value_filter.test_stats(
+            dict(zip(names, mins)), dict(zip(names, maxs)),
+            dict(zip(names, e.file.value_stats.null_counts
+                     or [0] * len(names))),
+            e.file.row_count)
+
+    def _bucket_value_match(self, group: List[ManifestEntry]) -> bool:
+        """Whole-bucket value pruning for pk tables: skip the bucket only
+        when NO file could match (merge-safe — if any file might match,
+        every file must be read so newer versions participate)."""
+        if self._value_filter is None or not self.schema.primary_keys:
+            return True
+        return any(self._value_stats_match(e)
+                   and not self._bloom_rejects(e, self._value_filter)
+                   for e in group)
 
     def generate_splits(self, snapshot_id: int,
                         entries: List[ManifestEntry],
@@ -264,6 +347,8 @@ class FileStoreScan:
         dv_index = self._load_deletion_vectors(snapshot_id, snapshot)
         for (pbytes, bucket), group in sorted(
                 groups.items(), key=lambda kv: (kv[0][0], kv[0][1])):
+            if not self._bucket_value_match(group):
+                continue
             partition = self._partition_codec.from_bytes(pbytes)
             files = [g.file for g in group]
             total_buckets = group[0].total_buckets

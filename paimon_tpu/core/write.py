@@ -196,7 +196,11 @@ class KeyValueFileStoreWrite:
             file_io, self.path_factory, table_schema,
             file_format=options.file_format,
             compression=options.file_compression,
-            target_file_size=options.target_file_size)
+            target_file_size=options.target_file_size,
+            bloom_columns=options.bloom_filter_columns,
+            bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+            index_in_manifest_threshold=options.get(
+                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
         rt = table_schema.logical_row_type()
         self.total_buckets = options.bucket
         bucket_keys = table_schema.bucket_keys()

@@ -287,6 +287,11 @@ class CoreOptions:
         "partition.timestamp-pattern", str, None, "")
     TAG_AUTOMATIC_CREATION = ConfigOption("tag.automatic-creation", str,
                                           "none", "")
+    FILE_INDEX_BLOOM_COLUMNS = ConfigOption(
+        "file-index.bloom-filter.columns", str, None,
+        "Columns to build per-file bloom filters for")
+    FILE_INDEX_BLOOM_FPP = ConfigOption(
+        "file-index.bloom-filter.fpp", float, 0.01, "")
     FILE_INDEX_IN_MANIFEST_THRESHOLD = ConfigOption(
         "file-index.in-manifest-threshold", parse_memory_size, 500, "")
     ROW_TRACKING_ENABLED = ConfigOption("row-tracking.enabled", _parse_bool,
@@ -381,6 +386,11 @@ class CoreOptions:
     @property
     def compaction_min_file_num(self) -> int:
         return self.options.get(CoreOptions.COMPACTION_MIN_FILE_NUM)
+
+    @property
+    def bloom_filter_columns(self):
+        v = self.options.get(CoreOptions.FILE_INDEX_BLOOM_COLUMNS)
+        return [c.strip() for c in v.split(",")] if v else []
 
     @property
     def deletion_vectors_enabled(self) -> bool:

@@ -160,6 +160,20 @@ class Leaf(Predicate):
         raise ValueError(f"Unknown op {op}")
 
 
+def conjunctive_equalities(pred):
+    """[(field, literal)] for every equality that must hold for a row to
+    match (eq leaves reachable through AND nodes only) — the conditions a
+    per-file bloom filter may safely prune on."""
+    out = []
+    if isinstance(pred, Leaf):
+        if pred.op == "eq":
+            out.append((pred.field, pred.literal))
+    elif isinstance(pred, Compound) and pred.op == "and":
+        for c in pred.children:
+            out.extend(conjunctive_equalities(c))
+    return out
+
+
 class Compound(Predicate):
     def __init__(self, op: str, children: Sequence[Predicate]):
         assert op in ("and", "or", "not")

@@ -1,0 +1,162 @@
+"""Per-file bloom filter indexes: build, serialize, scan skip.
+
+reference: fileindex/bloomfilter/, io/DataFileIndexWriter.java,
+io/FileIndexEvaluator.java.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.index.bloom import (
+    BloomFilter, build_file_index, hash_column, hash_value,
+    read_file_index,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, VarCharType
+
+
+def test_bloom_roundtrip_and_fpp():
+    rng = np.random.default_rng(3)
+    vals = rng.integers(0, 1 << 40, 10_000)
+    col = pa.chunked_array([pa.array(vals, pa.int64())])
+    hashes = hash_column(col)
+    bf = BloomFilter.build(hashes, fpp=0.01)
+    bf2 = BloomFilter.deserialize(bf.serialize())
+    # no false negatives
+    for h in hashes[:200]:
+        assert bf2.might_contain(int(h))
+    # false-positive rate near target
+    probe = hash_column(pa.chunked_array(
+        [pa.array(rng.integers(1 << 41, 1 << 42, 2000), pa.int64())]))
+    fp = sum(bf2.might_contain(int(h)) for h in probe)
+    assert fp < 2000 * 0.05
+
+
+def test_bloom_string_column():
+    col = pa.chunked_array([pa.array(["alpha", "beta", None, "gamma"])])
+    bf = BloomFilter.build(hash_column(col))
+    assert bf.might_contain(hash_value("beta", pa.string()))
+    assert not bf.might_contain(hash_value("nope-nope-nope", pa.string()))
+
+
+def test_file_index_blob_roundtrip():
+    t = pa.table({"a": pa.array([1, 2, 3], pa.int64()),
+                  "b": pa.array(["x", "y", "z"])})
+    blob = build_file_index(t, ["a", "b"])
+    idx = read_file_index(blob)
+    assert set(idx) == {"a", "b"}
+    assert idx["a"].might_contain(hash_value(2, pa.int64()))
+    assert not idx["a"].might_contain(hash_value(99, pa.int64()))
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_scan_skips_files_via_bloom(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("name", VarCharType())
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "file-index.bloom-filter.columns": "id,name"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    _commit(table, [{"id": i, "name": f"n{i}", "v": float(i)}
+                    for i in range(0, 100)])
+    _commit(table, [{"id": i, "name": f"n{i}", "v": float(i)}
+                    for i in range(1000, 1100)])
+
+    # embedded index present in the manifests
+    snap = table.snapshot_manager.latest_snapshot()
+    entries = table.new_scan().read_entries(snap)
+    assert all(e.file.embedded_index for e in entries)
+
+    # equality on a value absent from file 1 -> only file 2 planned
+    rb = table.new_read_builder().with_filter(P.equal("id", 1050))
+    plan = rb.new_scan().plan()
+    assert sum(len(s.data_files) for s in plan.splits) == 1
+    assert rb.new_read().to_arrow(plan).to_pylist() == \
+        [{"id": 1050, "name": "n1050", "v": 1050.0}]
+
+    # value-column equality on a PK table: per-file pruning would be
+    # merge-unsafe, so the whole bucket reads (both files) but the bloom
+    # still prunes the bucket entirely when NO file can match
+    rb2 = table.new_read_builder().with_filter(P.equal("name", "n42"))
+    plan2 = rb2.new_scan().plan()
+    assert sum(len(s.data_files) for s in plan2.splits) == 2
+    assert rb2.new_read().to_arrow(plan2).column("id").to_pylist() == [42]
+    rb2b = table.new_read_builder().with_filter(P.equal("name", "absent"))
+    assert rb2b.new_scan().plan().splits == []
+
+    # no-match key equality prunes everything
+    rb3 = table.new_read_builder().with_filter(P.equal("id", 555))
+    assert rb3.new_scan().plan().splits == []
+
+
+def test_bloom_survives_compaction(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "file-index.bloom-filter.columns": "id"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "c"), schema)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    _commit(table, [{"id": 2, "v": 2.0}])
+    table.compact(full=True)
+    snap = table.snapshot_manager.latest_snapshot()
+    entries = table.new_scan().read_entries(snap)
+    assert all(e.file.embedded_index for e in entries)
+    rb = table.new_read_builder().with_filter(P.equal("id", 2))
+    assert rb.new_read().to_arrow(rb.new_scan().plan()) \
+        .column("v").to_pylist() == [2.0]
+
+
+def test_value_filter_never_drops_newer_versions(tmp_warehouse):
+    """Merge-safety regression: a value filter matching only an OLD
+    version of a key must not resurrect it by pruning the newer file."""
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("name", VarCharType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "file-index.bloom-filter.columns": "name"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "v"), schema)
+    _commit(table, [{"id": 1, "name": "old"}])
+    _commit(table, [{"id": 1, "name": "new"}])
+    rb = table.new_read_builder().with_filter(P.equal("name", "old"))
+    out = rb.new_read().to_arrow(rb.new_scan().plan())
+    assert out.num_rows == 0        # id=1 is now 'new'; 'old' must NOT appear
+
+
+def test_bloom_sidecar_above_threshold(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "file-index.bloom-filter.columns": "id",
+                        "file-index.in-manifest-threshold": "64"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "s"), schema)
+    _commit(table, [{"id": i, "v": float(i)} for i in range(5000)])
+    snap = table.snapshot_manager.latest_snapshot()
+    entries = table.new_scan().read_entries(snap)
+    assert all(e.file.embedded_index is None for e in entries)
+    assert all(any(x.endswith(".index") for x in e.file.extra_files)
+               for e in entries)
+    rb = table.new_read_builder().with_filter(P.equal("id", 99999))
+    assert rb.new_scan().plan().splits == []     # sidecar consulted
