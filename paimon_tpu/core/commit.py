@@ -282,13 +282,21 @@ class FileStoreCommit:
 
     def _assert_files_exist(self, latest: Snapshot,
                             entries: List[ManifestEntry]):
-        """Compaction conflict check: all files we delete must still be
-        live (reference ConflictDetection: files-to-delete still exist)."""
+        """Compaction conflict checks (reference
+        operation/commit/ConflictDetection.java):
+        1. every file we delete must still be live
+        2. files we add at level > 0 must not overlap the key range of a
+           concurrent live file at the same level (two racing
+           compactions writing the same level would corrupt the
+           no-overlap invariant levels >= 1 rely on)"""
         deletes = [e for e in entries if e.kind == FileKind.DELETE]
-        if not deletes:
+        adds_upper = [e for e in entries
+                      if e.kind == FileKind.ADD and e.file.level > 0]
+        if not deletes and not adds_upper:
             return
-        live = {e.identifier() for e in self._read_all_entries(latest)
-                if e.kind == FileKind.ADD}
+        live_entries = [e for e in self._read_all_entries(latest)
+                        if e.kind == FileKind.ADD]
+        live = {e.identifier() for e in live_entries}
         for d in deletes:
             ident = (d.partition, d.bucket, d.file.level, d.file.file_name,
                      tuple(d.file.extra_files), d.file.embedded_index,
@@ -299,6 +307,50 @@ class FileStoreCommit:
                     f"{d.file.file_name} (level {d.file.level}); "
                     f"a concurrent compaction won. Retry the compaction "
                     f"from the new snapshot.")
+        if not adds_upper:
+            return
+        key_types = [
+            self.schema.logical_row_type().get_field(k).type.copy(False)
+            for k in self.schema.trimmed_primary_keys()]
+        if not key_types:
+            return
+        key_codec = BinaryRowCodec(key_types)
+
+        def decode_key(b: bytes):
+            # BinaryRow bytes are NOT order-comparable (little-endian
+            # slots); decode to value tuples like the reference's typed
+            # comparator
+            if not b:
+                return None
+            try:
+                return tuple(key_codec.from_bytes(b))
+            except Exception:
+                return None
+
+        deleted_names = {(d.partition, d.bucket, d.file.file_name)
+                         for d in deletes}
+        for a in adds_upper:
+            a_min = decode_key(a.file.min_key)
+            a_max = decode_key(a.file.max_key)
+            if a_min is None or a_max is None:
+                continue
+            for e in live_entries:
+                if (e.partition, e.bucket, e.file.level) != \
+                        (a.partition, a.bucket, a.file.level):
+                    continue
+                if (e.partition, e.bucket, e.file.file_name) \
+                        in deleted_names:
+                    continue       # replaced by this very commit
+                e_min = decode_key(e.file.min_key)
+                e_max = decode_key(e.file.max_key)
+                if e_min is None or e_max is None:
+                    continue
+                if a_min <= e_max and e_min <= a_max:
+                    raise CommitConflictError(
+                        f"Key range of new file {a.file.file_name} "
+                        f"(level {a.file.level}) overlaps live file "
+                        f"{e.file.file_name}; a concurrent compaction "
+                        f"wrote this level. Retry from the new snapshot.")
 
     def _maybe_merge_manifests(self, metas: List[ManifestFileMeta]
                                ) -> Tuple[List[ManifestFileMeta],

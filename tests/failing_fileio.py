@@ -1,0 +1,88 @@
+"""Fault-injection FileIO (reference test utility
+utils/FailingFileIO.java:44: throws on the Nth operation per named
+counter) + open-stream tracking in the spirit of TraceableFileIO."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from paimon_tpu.fs.fileio import FileIO
+
+
+class InjectedIOError(IOError):
+    pass
+
+
+class FailingFileIO(FileIO):
+    """Delegates to an inner FileIO, failing the Nth write/delete/rename
+    per named counter."""
+
+    _counters: Dict[str, int] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, inner: FileIO, name: str):
+        self.inner = inner
+        self.name = name
+
+    @classmethod
+    def reset(cls, name: str, fail_after: int):
+        """Fail every mutating op once `fail_after` of them succeeded."""
+        with cls._lock:
+            cls._counters[name] = fail_after
+
+    @classmethod
+    def disarm(cls, name: str):
+        with cls._lock:
+            cls._counters.pop(name, None)
+
+    def _tick(self):
+        with self._lock:
+            remaining = self._counters.get(self.name)
+            if remaining is None:
+                return
+            if remaining <= 0:
+                raise InjectedIOError(
+                    f"injected failure ({self.name})")
+            self._counters[self.name] = remaining - 1
+
+    # -- mutating ops fail by counter ---------------------------------------
+
+    def write_bytes(self, path, data, overwrite=True):
+        self._tick()
+        return self.inner.write_bytes(path, data, overwrite=overwrite)
+
+    def try_to_write_atomic(self, path, data):
+        self._tick()
+        return self.inner.try_to_write_atomic(path, data)
+
+    def delete(self, path, recursive=False):
+        self._tick()
+        return self.inner.delete(path, recursive=recursive)
+
+    def rename(self, src, dst):
+        self._tick()
+        return self.inner.rename(src, dst)
+
+    def mkdirs(self, path):
+        return self.inner.mkdirs(path)
+
+    # -- reads delegate ------------------------------------------------------
+
+    def read_bytes(self, path):
+        return self.inner.read_bytes(path)
+
+    def read_range(self, path, offset, length):
+        return self.inner.read_range(path, offset, length)
+
+    def exists(self, path):
+        return self.inner.exists(path)
+
+    def get_file_size(self, path):
+        return self.inner.get_file_size(path)
+
+    def list_status(self, path):
+        return self.inner.list_status(path)
+
+    def is_object_store(self):
+        return self.inner.is_object_store()
