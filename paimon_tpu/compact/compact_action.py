@@ -14,7 +14,52 @@ from paimon_tpu.core.commit import FileStoreCommit
 from paimon_tpu.core.write import CommitMessage
 from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
 
-__all__ = ["compact_table"]
+__all__ = ["compact_table", "sort_compact"]
+
+
+def _group_entries(scan, snapshot):
+    """{(partition_bytes, bucket): [files]} + total_buckets map."""
+    groups: Dict[Tuple[bytes, int], list] = {}
+    total_buckets: Dict[Tuple[bytes, int], int] = {}
+    for e in scan.read_entries(snapshot):
+        key = (e.partition, e.bucket)
+        groups.setdefault(key, []).append(e.file)
+        total_buckets[key] = e.total_buckets
+    return groups, total_buckets
+
+
+def _make_append_writer(table, path_factory):
+    from paimon_tpu.core.append import AppendFileWriter
+    return AppendFileWriter(
+        table.file_io, path_factory, table.schema,
+        file_format=table.options.file_format,
+        compression=table.options.file_compression,
+        target_file_size=table.options.target_file_size,
+        bloom_columns=table.options.bloom_filter_columns,
+        bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+        index_in_manifest_threshold=table.options.get(
+            CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
+
+
+def _read_bucket(table, path_factory, partition, bucket, files,
+                 dvs=None):
+    """Read+evolve a bucket's files in sequence order, applying deletion
+    vectors so rewrites never resurrect deleted rows."""
+    import pyarrow as pa
+
+    from paimon_tpu.core.kv_file import read_kv_file
+    from paimon_tpu.core.read import evolve_table
+
+    cache = {table.schema.id: table.schema}
+    tables = []
+    for f in sorted(files, key=lambda x: x.min_sequence_number):
+        t = read_kv_file(table.file_io, path_factory, partition, bucket,
+                         f, None, None)
+        if dvs and f.file_name in dvs:
+            t = t.filter(pa.array(dvs[f.file_name].keep_mask(t.num_rows)))
+        tables.append(evolve_table(t, f.schema_id, table.schema,
+                                   table.schema_manager, cache))
+    return pa.concat_tables(tables, promote_options="none")
 
 
 def compact_table(table, full: bool = False,
@@ -27,22 +72,19 @@ def compact_table(table, full: bool = False,
     snapshot = table.snapshot_manager.latest_snapshot()
     if snapshot is None:
         return None
-    entries = scan.read_entries(snapshot)
-
-    groups: Dict[Tuple[bytes, int], list] = {}
-    total_buckets: Dict[Tuple[bytes, int], int] = {}
-    for e in entries:
-        key = (e.partition, e.bucket)
-        groups.setdefault(key, []).append(e.file)
-        total_buckets[key] = e.total_buckets
+    groups, total_buckets = _group_entries(scan, snapshot)
 
     is_append = not table.schema.primary_keys
+    dv_index = scan._load_deletion_vectors(snapshot.id, snapshot) \
+        if is_append else {}
     messages: List[CommitMessage] = []
     for (pbytes, bucket), files in groups.items():
         partition = scan._partition_codec.from_bytes(pbytes)
         if is_append:
-            result = _append_compact(table, scan.path_factory, partition,
-                                     bucket, files, full)
+            result = _append_compact(
+                table, scan, partition, bucket, files, full,
+                bucket_dvs=dv_index.get((pbytes, bucket)),
+                pbytes=pbytes, snapshot=snapshot)
         else:
             mgr = MergeTreeCompactManager(
                 table.file_io, table.path, table.schema, table.options,
@@ -56,47 +98,139 @@ def compact_table(table, full: bool = False,
             total_buckets=total_buckets[(pbytes, bucket)],
             compact_before=result.before,
             compact_after=result.after,
-            compact_changelog=result.changelog))
+            compact_changelog=result.changelog,
+            index_entries=getattr(result, "index_entries", [])))
 
     if not messages:
         return None
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)
-    return commit.commit(messages, BATCH_COMMIT_IDENTIFIER)
+    index_list = [e for m in messages for e in m.index_entries]
+    return commit.commit(messages, BATCH_COMMIT_IDENTIFIER,
+                         index_entries=index_list or None)
 
 
-def _append_compact(table, path_factory, partition, bucket, files, full):
-    """Concatenate small append files into target-size files (reference
-    append/BucketedAppendCompactManager: no keys, order by sequence)."""
+def sort_compact(table, order_by, strategy: str = "zorder"):
+    """Rewrite an append table clustered by `order_by` columns
+    (reference flink sort-compact: ZorderSorter / OrderSorter over
+    append tables; commit kind OVERWRITE per rewrite)."""
     import pyarrow as pa
 
+    from paimon_tpu.manifest import FileSource
+    from paimon_tpu.ops.zorder import order_permutation, z_order_permutation
+
+    if not order_by:
+        raise ValueError("sort-compact requires at least one order-by "
+                         "column")
+    names = {f.name for f in table.schema.fields}
+    missing = [c for c in order_by if c not in names]
+    if missing:
+        raise ValueError(f"Unknown order-by columns {missing}")
+    if table.schema.primary_keys:
+        raise ValueError("sort-compact applies to append tables "
+                         "(pk tables cluster by key already)")
+    perm_fn = {"zorder": z_order_permutation,
+               "order": order_permutation}.get(strategy)
+    if perm_fn is None:
+        raise ValueError(f"Unknown sort strategy {strategy!r} "
+                         f"(zorder | order)")
+
+    scan = table.new_scan()
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return None
+    groups, total_buckets = _group_entries(scan, snapshot)
+    dv_index = scan._load_deletion_vectors(snapshot.id, snapshot)
+
+    # DV rows are physically dropped by the rewrite; the bucket's DV
+    # index entries must be deleted along with it
+    index_entries = []
+    if snapshot.index_manifest:
+        from paimon_tpu.manifest import FileKind
+        from paimon_tpu.manifest.index_manifest import (
+            DELETION_VECTORS_INDEX, IndexManifestEntry,
+        )
+        for e in scan.index_manifest_file.read(snapshot.index_manifest):
+            if e.index_file.index_type == DELETION_VECTORS_INDEX and \
+                    (e.partition, e.bucket) in groups:
+                index_entries.append(IndexManifestEntry(
+                    FileKind.DELETE, e.partition, e.bucket, e.index_file))
+
+    writer = _make_append_writer(table, scan.path_factory)
+    messages: List[CommitMessage] = []
+    for (pbytes, bucket), files in groups.items():
+        partition = scan._partition_codec.from_bytes(pbytes)
+        ordered = sorted(files, key=lambda f: f.min_sequence_number)
+        data = _read_bucket(table, scan.path_factory, partition, bucket,
+                            ordered, dvs=dv_index.get((pbytes, bucket)))
+        perm = perm_fn(data, order_by)
+        clustered = data.take(pa.array(perm))
+        after = writer.write(partition, bucket, clustered,
+                             ordered[0].min_sequence_number,
+                             file_source=FileSource.COMPACT)
+        messages.append(CommitMessage(
+            partition=partition, bucket=bucket,
+            total_buckets=total_buckets[(pbytes, bucket)],
+            compact_before=ordered, compact_after=after))
+    if not messages:
+        return None
+    if index_entries:
+        messages[0].index_entries.extend(index_entries)
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    index_list = [e for m in messages for e in m.index_entries]
+    return commit.commit(messages, BATCH_COMMIT_IDENTIFIER,
+                         index_entries=index_list or None)
+
+
+def _append_compact(table, scan, partition, bucket, files, full,
+                    bucket_dvs=None, pbytes=None, snapshot=None):
+    """Concatenate small append files into target-size files (reference
+    append/BucketedAppendCompactManager: no keys, order by sequence).
+    Deletion vectors of rewritten files are applied (rows physically
+    dropped) and the bucket's DV index entries rewritten to cover only
+    the surviving files."""
     from paimon_tpu.core.append import (
-        AppendCompactResult, AppendFileWriter, append_compact_plan,
+        AppendCompactResult, append_compact_plan,
     )
-    from paimon_tpu.core.kv_file import read_kv_file
-    from paimon_tpu.core.read import evolve_table
     from paimon_tpu.manifest import FileSource
 
     picked = append_compact_plan(files, table.options, full=full)
     if not picked:
         return None
-    writer = AppendFileWriter(
-        table.file_io, path_factory, table.schema,
-        file_format=table.options.file_format,
-        compression=table.options.file_compression,
-        target_file_size=table.options.target_file_size,
-        bloom_columns=table.options.bloom_filter_columns,
-        bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
-        index_in_manifest_threshold=table.options.get(
-            CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
-    cache = {table.schema.id: table.schema}
-    tables = [evolve_table(
-                  read_kv_file(table.file_io, path_factory, partition,
-                               bucket, f, None, None),
-                  f.schema_id, table.schema, table.schema_manager, cache)
-              for f in picked]
-    data = pa.concat_tables(tables, promote_options="none")
+    writer = _make_append_writer(table, scan.path_factory)
+    data = _read_bucket(table, scan.path_factory, partition, bucket,
+                        picked, dvs=bucket_dvs)
     after = writer.write(partition, bucket, data,
                          picked[0].min_sequence_number,
                          file_source=FileSource.COMPACT)
-    return AppendCompactResult(before=list(picked), after=after)
+    result = AppendCompactResult(before=list(picked), after=after)
+
+    picked_names = {f.file_name for f in picked}
+    if bucket_dvs and picked_names & set(bucket_dvs):
+        from paimon_tpu.index.deletion_vector import (
+            DeletionVectorsIndexFile,
+        )
+        from paimon_tpu.manifest import FileKind
+        from paimon_tpu.manifest.index_manifest import (
+            DELETION_VECTORS_INDEX, IndexFileMeta, IndexManifestEntry,
+        )
+        for e in scan.index_manifest_file.read(snapshot.index_manifest):
+            if e.index_file.index_type == DELETION_VECTORS_INDEX and \
+                    e.partition == pbytes and e.bucket == bucket:
+                result.index_entries.append(IndexManifestEntry(
+                    FileKind.DELETE, e.partition, e.bucket, e.index_file))
+        remaining = {f: dv for f, dv in bucket_dvs.items()
+                     if f not in picked_names}
+        if remaining:
+            dv_file = DeletionVectorsIndexFile(table.file_io,
+                                               f"{table.path}/index")
+            name, size, ranges = dv_file.write(
+                remaining, path_factory=scan.path_factory)
+            result.index_entries.append(IndexManifestEntry(
+                FileKind.ADD, pbytes, bucket,
+                IndexFileMeta(DELETION_VECTORS_INDEX, name, size,
+                              sum(d.cardinality()
+                                  for d in remaining.values()),
+                              dv_ranges=ranges)))
+    return result

@@ -319,6 +319,8 @@ class AppendCompactResult:
     before: List[DataFileMeta]
     after: List[DataFileMeta]
     changelog: List[DataFileMeta] = dc_field(default_factory=list)
+    # DV index rewrites accompanying the data rewrite
+    index_entries: List = dc_field(default_factory=list)
 
     def is_empty(self) -> bool:
         return not self.before

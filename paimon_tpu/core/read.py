@@ -44,9 +44,33 @@ def evolve_table(table: pa.Table, file_schema_id: int, schema: TableSchema,
                  keep_sys_cols: bool = False) -> pa.Table:
     """Map an old-schema file onto the read schema by field id
     (reference schema/SchemaEvolutionUtil.java index+cast mapping).
-    Shared by both split readers and both compaction rewriters."""
+    Shared by both split readers and both compaction rewriters.
+
+    Same-schema files still get a cheap per-column type check + cast:
+    schema-inferring formats (csv/json) may decode e.g. float32 as
+    float64 or timestamps as strings."""
     if file_schema_id == schema.id:
-        return table
+        needs_cast = False
+        for f in schema.fields:
+            if f.name in table.column_names and \
+                    table.column(f.name).type != data_type_to_arrow(f.type):
+                needs_cast = True
+                break
+        if not needs_cast:
+            return table
+        cols = {}
+        for name in table.column_names:
+            col = table.column(name)
+            if name.startswith(KEY_PREFIX) or name in (SEQ_COL, KIND_COL):
+                cols[name] = col
+                continue
+            f = next((x for x in schema.fields if x.name == name), None)
+            if f is None:
+                cols[name] = col
+                continue
+            at = data_type_to_arrow(f.type)
+            cols[name] = col.cast(at) if col.type != at else col
+        return pa.table(cols)
     old = cache.get(file_schema_id)
     if old is None:
         if schema_manager is None:

@@ -173,11 +173,71 @@ class FileFormatFactory:
         return self._writer_cls(compression)
 
 
+class _CsvReader(FormatReader):
+    def read(self, file_io, path, projection=None, batch_size=1 << 20):
+        from pyarrow import csv as pa_csv
+        data = file_io.read_bytes(path)
+        table = pa_csv.read_csv(io.BytesIO(data))
+        if projection:
+            table = table.select(projection)
+        return table
+
+
+class _CsvWriter(FormatWriter):
+    def __init__(self, compression: str = "none"):
+        pass
+
+    def write(self, file_io, path, table):
+        from pyarrow import csv as pa_csv
+        buf = io.BytesIO()
+        pa_csv.write_csv(table, buf)
+        data = buf.getvalue()
+        file_io.write_bytes(path, data, overwrite=False)
+        return len(data)
+
+
+class _JsonReader(FormatReader):
+    def read(self, file_io, path, projection=None, batch_size=1 << 20):
+        from pyarrow import json as pa_json
+        data = file_io.read_bytes(path)
+        table = pa_json.read_json(io.BytesIO(data))
+        if projection:
+            table = table.select(projection)
+        return table
+
+
+class _JsonWriter(FormatWriter):
+    def __init__(self, compression: str = "none"):
+        pass
+
+    def write(self, file_io, path, table):
+        import json as _json
+        for f in table.schema:
+            if pa.types.is_binary(f.type) or pa.types.is_large_binary(
+                    f.type):
+                raise ValueError(
+                    f"json format cannot round-trip binary column "
+                    f"{f.name!r}; use parquet/orc/avro")
+
+        def default(v):
+            # temporals serialize as ISO strings; arrow casts them back
+            # on read via the schema-aware evolve path
+            return v.isoformat() if hasattr(v, "isoformat") else str(v)
+
+        lines = [_json.dumps(r, default=default)
+                 for r in table.to_pylist()]
+        data = ("\n".join(lines) + "\n").encode("utf-8")
+        file_io.write_bytes(path, data, overwrite=False)
+        return len(data)
+
+
 _FORMATS: Dict[str, FileFormatFactory] = {
     "parquet": FileFormatFactory("parquet", _ParquetReader(),
                                  _ParquetWriter),
     "orc": FileFormatFactory("orc", _OrcReader(), _OrcWriter),
     "avro": FileFormatFactory("avro", _AvroRowReader(), _AvroRowWriter),
+    "csv": FileFormatFactory("csv", _CsvReader(), _CsvWriter),
+    "json": FileFormatFactory("json", _JsonReader(), _JsonWriter),
 }
 
 

@@ -1,0 +1,156 @@
+"""Engine-agnostic metrics registry.
+
+reference: paimon-core/.../metrics/ (MetricRegistry, Counter, Gauge,
+Histogram) with groups CommitMetrics / ScanMetrics / CompactionMetrics
+(operation/metrics/). System tables remain the queryable surface; this
+registry is the programmatic one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
+           "MetricRegistry", "global_registry"]
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._v += n
+
+    @property
+    def count(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._fn = fn
+        self._v = 0.0
+
+    def set(self, v: float):
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._fn() if self._fn is not None else self._v
+
+
+class Histogram:
+    """Sliding-window histogram (reference DescriptiveStatisticsHistogram
+    with window size 100)."""
+
+    def __init__(self, window: int = 100):
+        self.window = window
+        self._values: List[float] = []
+        self._lock = threading.Lock()
+
+    def update(self, v: float):
+        with self._lock:
+            self._values.append(v)
+            if len(self._values) > self.window:
+                self._values.pop(0)
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._values:
+                return 0.0
+            vals = sorted(self._values)
+            i = min(len(vals) - 1, int(p / 100 * len(vals)))
+            return vals[i]
+
+    @property
+    def mean(self) -> float:
+        return sum(self._values) / len(self._values) if self._values else 0.0
+
+    @property
+    def max(self) -> float:
+        return max(self._values) if self._values else 0.0
+
+
+class MetricGroup:
+    def __init__(self, name: str):
+        self.name = name
+        self.metrics: Dict[str, object] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.metrics.setdefault(name, Counter())
+
+    def gauge(self, name: str,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        return self.metrics.setdefault(name, Gauge(fn))
+
+    def histogram(self, name: str, window: int = 100) -> Histogram:
+        return self.metrics.setdefault(name, Histogram(window))
+
+    def timer(self, histogram_name: str):
+        """Context manager recording elapsed millis into a histogram."""
+        h = self.histogram(histogram_name)
+
+        class _Timer:
+            def __enter__(self_t):
+                self_t.t0 = time.perf_counter()
+                return self_t
+
+            def __exit__(self_t, *exc):
+                h.update((time.perf_counter() - self_t.t0) * 1000)
+                return False
+
+        return _Timer()
+
+
+class MetricRegistry:
+    """reference metrics/MetricRegistry.java: groups keyed by
+    (group_type, table)."""
+
+    def __init__(self):
+        self._groups: Dict[str, MetricGroup] = {}
+        self._lock = threading.Lock()
+
+    def group(self, group_type: str, table: str = "") -> MetricGroup:
+        key = f"{group_type}:{table}" if table else group_type
+        with self._lock:
+            return self._groups.setdefault(key, MetricGroup(key))
+
+    def commit_metrics(self, table: str = "") -> MetricGroup:
+        return self.group("commit", table)
+
+    def scan_metrics(self, table: str = "") -> MetricGroup:
+        return self.group("scan", table)
+
+    def compaction_metrics(self, table: str = "") -> MetricGroup:
+        return self.group("compaction", table)
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """{group: {metric: value}} for reporting."""
+        out: Dict[str, Dict[str, object]] = {}
+        for gname, group in self._groups.items():
+            d = {}
+            for mname, m in group.metrics.items():
+                if isinstance(m, Counter):
+                    d[mname] = m.count
+                elif isinstance(m, Gauge):
+                    d[mname] = m.value
+                elif isinstance(m, Histogram):
+                    d[mname] = {"count": m.count, "mean": m.mean,
+                                "p95": m.percentile(95), "max": m.max}
+            out[gname] = d
+        return out
+
+
+_GLOBAL = MetricRegistry()
+
+
+def global_registry() -> MetricRegistry:
+    return _GLOBAL

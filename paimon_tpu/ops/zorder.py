@@ -1,0 +1,78 @@
+"""Space-filling-curve keys for clustering.
+
+reference: sort/zorder/ZIndexer.java, sort/hilbert/HilbertIndexer.java,
+used by the sort-compact path (flink sorter ZorderSorter etc.) to
+cluster append tables for locality-friendly pruning.
+
+TPU-first shape: each order-by column normalizes to an order-preserving
+uint32 lane (reusing ops/normkey encodings), the z-index interleaves
+those bits into one uint64 with vectorized shift/mask rounds, and the
+permutation comes from one argsort — no per-row loops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+
+__all__ = ["z_index", "z_order_permutation", "order_permutation"]
+
+
+def _normalized_u32(table: pa.Table, columns: Sequence[str]) -> np.ndarray:
+    """[N, C] uint32: order-preserving 32-bit projection per column.
+
+    Values are RANK-normalized (np.unique inverse, scaled to the full
+    32-bit range) rather than truncated value bits: raw high bits are
+    near-constant for small numeric domains, which would collapse the
+    curve; ranks spread the actual data evenly across the bit budget
+    (better locality than the reference's fixed byte prefixes)."""
+    enc = NormalizedKeyEncoder([table.schema.field(c).type
+                                for c in columns],
+                               nullable=[table.schema.field(c).nullable
+                                         for c in columns])
+    lanes, _ = enc.encode_table(table, columns)
+    out = np.zeros((table.num_rows, len(columns)), dtype=np.uint32)
+    pos = 0
+    for i, nl in enumerate(enc.lanes_per_col):
+        sub = lanes[:, pos:pos + nl]
+        _, inv = np.unique(sub, axis=0, return_inverse=True)
+        mx = max(int(inv.max()) if len(inv) else 0, 1)
+        out[:, i] = (inv.astype(np.uint64) * np.uint64(0xFFFFFFFF)
+                     // np.uint64(mx)).astype(np.uint32)
+        pos += nl
+    return out
+
+
+def z_index(table: pa.Table, columns: Sequence[str]) -> np.ndarray:
+    """uint64[N] z-order (Morton) keys over `columns`."""
+    mat = _normalized_u32(table, columns)
+    n, c = mat.shape
+    bits_per_col = 64 // c
+    # keep the top bits_per_col bits of each column
+    vals = (mat >> np.uint32(32 - min(32, bits_per_col))).astype(np.uint64)
+    out = np.zeros(n, dtype=np.uint64)
+    for b in range(bits_per_col):
+        # bit (bits_per_col-1-b) of each column, interleaved round-robin
+        src_bit = np.uint64(bits_per_col - 1 - b)
+        for ci in range(c):
+            dst_bit = np.uint64(64 - 1 - (b * c + ci))
+            bit = (vals[:, ci] >> src_bit) & np.uint64(1)
+            out |= bit << dst_bit
+    return out
+
+
+def z_order_permutation(table: pa.Table,
+                        columns: Sequence[str]) -> np.ndarray:
+    return np.argsort(z_index(table, columns), kind="stable")
+
+
+def order_permutation(table: pa.Table,
+                      columns: Sequence[str]) -> np.ndarray:
+    """Plain lexicographic clustering (reference OrderSorter)."""
+    mat = _normalized_u32(table, columns)
+    return np.lexsort(tuple(mat[:, i] for i in reversed(range(
+        mat.shape[1]))))

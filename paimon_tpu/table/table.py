@@ -142,6 +142,13 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
+    def sort_compact(self, order_by: List[str],
+                     strategy: str = "zorder") -> Optional[int]:
+        """Cluster an append table by z-order or lexicographic order
+        (reference sort-compact action, sort/zorder/ZIndexer.java)."""
+        from paimon_tpu.compact.compact_action import sort_compact
+        return sort_compact(self, order_by, strategy)
+
     def system_table(self, name: str) -> pa.Table:
         """Load a system table ('snapshots', 'files', 'audit_log', ...)
         as Arrow (reference table/system/SystemTableLoader.java)."""

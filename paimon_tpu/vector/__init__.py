@@ -1,0 +1,12 @@
+"""Vector search: ANN indexes executed on the MXU.
+
+reference: paimon-vector native index (NativeVectorIndexLoader.java:28
+loading IVF-Flat/IVF-PQ/IVF-HNSW factories via JNI),
+table/VectorSearchTable + VectorSearchSplit. SURVEY §2.8 marks this the
+natural TPU win: brute-force and IVF probing are batched matmul + top_k,
+exactly the systolic array's shape.
+"""
+
+from paimon_tpu.vector.ann import (  # noqa: F401
+    BruteForceIndex, IVFFlatIndex, vector_search,
+)

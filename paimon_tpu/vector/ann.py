@@ -1,0 +1,182 @@
+"""ANN indexes as jitted matmul + top_k.
+
+reference capability: paimon-vector (IVF-Flat / IVF-PQ factories behind
+NativeVectorIndexLoader.java:28, JNI to a native library). TPU-first
+redesign: the similarity kernel IS a matmul — queries x corpus runs on
+the MXU in bf16/f32 and jax.lax.top_k picks candidates; IVF-Flat is a
+two-stage matmul (centroids, then gathered cluster members). No graph
+walks, no per-vector loops — the hardware's preferred shape.
+
+Metrics: 'dot' | 'cosine' | 'l2' (l2 via the ||a-b||^2 expansion so it
+stays one matmul).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+__all__ = ["BruteForceIndex", "IVFFlatIndex", "vector_search"]
+
+
+def _as_matrix(col: pa.ChunkedArray) -> np.ndarray:
+    """fixed_size_list / list<float> column -> float32 [N, D]."""
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if pa.types.is_fixed_size_list(arr.type):
+        d = arr.type.list_size
+        flat = np.asarray(arr.flatten().cast(pa.float32()))
+        return flat.reshape(len(arr), d)
+    values = arr.to_pylist()
+    return np.asarray(values, dtype=np.float32)
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _topk_scores(queries, corpus, corpus_sq, k, metric):
+    """queries [Q, D] x corpus [N, D] -> (scores [Q, k], idx [Q, k])."""
+    sims = queries @ corpus.T                       # MXU
+    if metric == "cosine":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+        cn = jnp.sqrt(corpus_sq)[None, :]
+        sims = sims / jnp.maximum(qn * cn, 1e-12)
+    elif metric == "l2":
+        qsq = jnp.sum(queries * queries, axis=1, keepdims=True)
+        sims = -(qsq + corpus_sq[None, :] - 2.0 * sims)   # -distance^2
+    return jax.lax.top_k(sims, k)
+
+
+class BruteForceIndex:
+    """Exact search: one matmul over the whole corpus."""
+
+    def __init__(self, vectors: np.ndarray, metric: str = "cosine"):
+        self.metric = metric
+        self._corpus = jnp.asarray(vectors, dtype=jnp.float32)
+        self._corpus_sq = jnp.sum(self._corpus * self._corpus, axis=1)
+
+    def __len__(self) -> int:
+        return int(self._corpus.shape[0])
+
+    def search(self, queries: np.ndarray, k: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (scores [Q, k], indices [Q, k]); higher score = closer."""
+        q = jnp.atleast_2d(jnp.asarray(queries, dtype=jnp.float32))
+        k = min(k, len(self))
+        scores, idx = _topk_scores(q, self._corpus, self._corpus_sq, k,
+                                   self.metric)
+        return np.asarray(scores), np.asarray(idx)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _kmeans(vectors, init_centroids, iters):
+    """Lloyd's iterations fully on device (assignment = matmul argmin)."""
+    def step(centroids, _):
+        d = (jnp.sum(vectors ** 2, axis=1, keepdims=True)
+             + jnp.sum(centroids ** 2, axis=1)[None, :]
+             - 2.0 * vectors @ centroids.T)
+        assign = jnp.argmin(d, axis=1)
+        one_hot = jax.nn.one_hot(assign, centroids.shape[0],
+                                 dtype=vectors.dtype)
+        sums = one_hot.T @ vectors
+        counts = jnp.sum(one_hot, axis=0)[:, None]
+        new = jnp.where(counts > 0, sums / jnp.maximum(counts, 1),
+                        centroids)
+        return new, None
+    out, _ = jax.lax.scan(step, init_centroids, None, length=iters)
+    return out
+
+
+class IVFFlatIndex:
+    """IVF-Flat: kmeans coarse quantizer + per-cluster exact search.
+
+    Probing is two matmuls: queries x centroids picks nprobe clusters,
+    then queries x (gathered members) ranks candidates."""
+
+    def __init__(self, vectors: np.ndarray, n_clusters: int = 0,
+                 metric: str = "cosine", kmeans_iters: int = 8,
+                 seed: int = 0):
+        n = len(vectors)
+        if n_clusters <= 0:
+            n_clusters = max(1, int(np.sqrt(n)))
+        n_clusters = min(n_clusters, n)
+        self.metric = metric
+        v = jnp.asarray(vectors, dtype=jnp.float32)
+        rng = np.random.default_rng(seed)
+        init = v[rng.choice(n, n_clusters, replace=False)]
+        self.centroids = np.asarray(_kmeans(v, init, kmeans_iters))
+        d = (np.sum(vectors ** 2, axis=1, keepdims=True)
+             + np.sum(self.centroids ** 2, axis=1)[None, :]
+             - 2.0 * vectors @ self.centroids.T)
+        assign = np.argmin(d, axis=1)
+        order = np.argsort(assign, kind="stable")
+        self._members = order                     # corpus idx sorted by cluster
+        self._bounds = np.searchsorted(assign[order],
+                                       np.arange(n_clusters + 1))
+        self._vectors = np.asarray(vectors, dtype=np.float32)
+        self._norms = np.linalg.norm(self._vectors, axis=1)
+        self._sq = np.sum(self._vectors ** 2, axis=1)
+
+    def __len__(self) -> int:
+        return len(self._vectors)
+
+    def search(self, queries: np.ndarray, k: int, nprobe: int = 4
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+        nprobe = min(nprobe, len(self._bounds) - 1)
+        cd = (np.sum(q ** 2, axis=1, keepdims=True)
+              + np.sum(self.centroids ** 2, axis=1)[None, :]
+              - 2.0 * q @ self.centroids.T)
+        probe = np.argsort(cd, axis=1)[:, :nprobe]
+        out_scores = np.full((len(q), k), -np.inf, dtype=np.float32)
+        out_idx = np.full((len(q), k), -1, dtype=np.int64)
+        for qi in range(len(q)):
+            cand = np.concatenate([
+                self._members[self._bounds[c]:self._bounds[c + 1]]
+                for c in probe[qi]])
+            if len(cand) == 0:
+                continue
+            # candidate sets are small and vary per query: numpy scoring
+            # avoids per-query device uploads and jit recompiles
+            sub = self._vectors[cand]
+            sims = sub @ q[qi]
+            if self.metric == "cosine":
+                qn = max(float(np.linalg.norm(q[qi])), 1e-12)
+                sims = sims / (np.maximum(self._norms[cand], 1e-12) * qn)
+            elif self.metric == "l2":
+                sims = -(self._sq[cand] + float(q[qi] @ q[qi])
+                         - 2.0 * sims)
+            kk = min(k, len(cand))
+            top = np.argpartition(-sims, kk - 1)[:kk]
+            top = top[np.argsort(-sims[top])]
+            out_scores[qi, :kk] = sims[top]
+            out_idx[qi, :kk] = cand[top]
+        return out_scores, out_idx
+
+
+def vector_search(table, column: str, query, k: int = 10,
+                  metric: str = "cosine",
+                  index: Optional[BruteForceIndex] = None) -> pa.Table:
+    """Search a table's embedding column; returns the top-k rows with a
+    `_score` column (reference VectorSearchTable / VectorSearchSplit).
+    A batch of queries ([Q, D]) returns Q*k rows with a `_query` column
+    identifying the source query."""
+    data = table.to_arrow()
+    vectors = _as_matrix(data.column(column))
+    idx = index or BruteForceIndex(vectors, metric)
+    q = np.asarray(query, dtype=np.float32)
+    batched = q.ndim == 2
+    scores, ids = idx.search(q, k)
+    parts = []
+    for qi in range(ids.shape[0]):
+        valid = ids[qi] >= 0
+        rows = data.take(pa.array(ids[qi][valid]))
+        rows = rows.append_column(
+            "_score", pa.array(scores[qi][valid], pa.float32()))
+        if batched:
+            rows = rows.append_column(
+                "_query", pa.array([qi] * rows.num_rows, pa.int32()))
+        parts.append(rows)
+    return pa.concat_tables(parts, promote_options="none")

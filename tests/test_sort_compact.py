@@ -1,0 +1,155 @@
+"""Z-order clustering + metrics + csv/json formats."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_z_index_locality():
+    from paimon_tpu.ops.zorder import z_index
+
+    t = pa.table({"x": pa.array([0, 0, 7, 7], pa.int64()),
+                  "y": pa.array([0, 7, 0, 7], pa.int64())})
+    z = z_index(t, ["x", "y"])
+    # (0,0) must be smallest; (7,7) largest
+    assert int(np.argmin(z)) == 0
+    assert int(np.argmax(z)) == 3
+
+
+def test_sort_compact_zorder_improves_pruning(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("x", BigIntType())
+              .column("y", BigIntType())
+              .column("v", DoubleType())
+              .options({"target-file-size": "4kb"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    rng = np.random.default_rng(0)
+    rows = [{"x": int(a), "y": int(b), "v": 1.0}
+            for a, b in rng.integers(0, 1000, (12000, 2))]
+    _commit(table, rows)
+    before = table.to_arrow()
+    sid = table.sort_compact(["x", "y"])
+    assert sid is not None
+    after = table.to_arrow()
+    assert after.num_rows == before.num_rows
+    # same multiset of rows
+    key = lambda r: (r["x"], r["y"], r["v"])
+    assert sorted(map(key, before.to_pylist())) == \
+        sorted(map(key, after.to_pylist()))
+    # stats-based pruning on x now skips most files
+    splits = table.new_read_builder() \
+        .with_filter(P.less_than("x", 50)).new_scan().plan().splits
+    files_hit = sum(len(s.data_files) for s in splits)
+    total = sum(len(s.data_files)
+                for s in table.new_read_builder().new_scan().plan().splits)
+    assert total > 3
+    assert files_hit < total
+
+
+def test_sort_compact_rejected_on_pk_table(tmp_warehouse):
+    schema = (Schema.builder().column("id", BigIntType(False))
+              .column("v", DoubleType()).primary_key("id")
+              .options({"bucket": "1"}).build())
+    t = FileStoreTable.create(os.path.join(tmp_warehouse, "p"), schema)
+    with pytest.raises(ValueError):
+        t.sort_compact(["v"])
+
+
+def test_metrics_registry():
+    from paimon_tpu.metrics import MetricRegistry
+
+    reg = MetricRegistry()
+    g = reg.commit_metrics("t1")
+    g.counter("commits").inc()
+    g.counter("commits").inc(2)
+    with g.timer("commit_duration_ms"):
+        pass
+    snap = reg.snapshot()
+    assert snap["commit:t1"]["commits"] == 3
+    assert snap["commit:t1"]["commit_duration_ms"]["count"] == 1
+
+
+def test_csv_json_formats(tmp_path):
+    from paimon_tpu.format import get_format
+    from paimon_tpu.fs import get_file_io
+
+    fio = get_file_io(str(tmp_path))
+    t = pa.table({"a": pa.array([1, 2], pa.int64()),
+                  "b": pa.array(["x", "y"])})
+    for fmt_name in ("csv", "json"):
+        fmt = get_format(fmt_name)
+        path = os.path.join(str(tmp_path), f"f.{fmt_name}")
+        fmt.create_writer().write(fio, path, t)
+        back = fmt.create_reader().read(fio, path)
+        assert back.column("a").to_pylist() == [1, 2]
+        assert back.column("b").to_pylist() == ["x", "y"]
+
+
+def test_sort_compact_preserves_deletes(tmp_warehouse):
+    """DV rows must stay deleted through a sort-compact rewrite."""
+    schema = (Schema.builder().column("x", BigIntType())
+              .column("y", BigIntType()).build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "dv"),
+                                  schema)
+    _commit(table, [{"x": i, "y": i} for i in range(20)])
+    table.delete_where(P.less_than("x", 5))
+    assert table.to_arrow().num_rows == 15
+    table.sort_compact(["x"])
+    out = sorted(table.to_arrow().column("x").to_pylist())
+    assert out == list(range(5, 20))
+
+
+def test_append_compact_preserves_deletes(tmp_warehouse):
+    schema = (Schema.builder().column("x", BigIntType()).build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "dc"),
+                                  schema)
+    for i in range(6):
+        _commit(table, [{"x": i}])
+    table.delete_where(P.equal("x", 2))
+    table.compact(full=True)
+    assert sorted(table.to_arrow().column("x").to_pylist()) == \
+        [0, 1, 3, 4, 5]
+    # DV index rewritten away (rows physically dropped)
+    snap = table.snapshot_manager.latest_snapshot()
+    if snap.index_manifest:
+        entries = table.new_scan().index_manifest_file.read(
+            snap.index_manifest)
+        assert not [e for e in entries
+                    if e.index_file.index_type == "DELETION_VECTORS"]
+
+
+def test_vector_search_batch_queries(tmp_warehouse):
+    from paimon_tpu.types import ArrayType, FloatType
+    from paimon_tpu.vector import vector_search
+
+    schema = (Schema.builder().column("id", BigIntType(False))
+              .column("emb", ArrayType(FloatType()))
+              .primary_key("id").options({"bucket": "1"}).build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "vb"),
+                                  schema)
+    embs = np.random.default_rng(5).standard_normal((30, 8)) \
+        .astype(np.float32)
+    _commit(table, [{"id": i, "emb": embs[i].tolist()}
+                    for i in range(30)])
+    out = vector_search(table, "emb", embs[[3, 9]], k=2)
+    assert out.num_rows == 4
+    by_q = {q: [] for q in (0, 1)}
+    for r in out.to_pylist():
+        by_q[r["_query"]].append(r["id"])
+    assert by_q[0][0] == 3 and by_q[1][0] == 9
