@@ -303,18 +303,21 @@ class MergeTreeCompactManager:
         """Merge run-ordered tables under the table's merge engine —
         the single dispatch shared by the one-shot and streamed paths."""
         engine = self.options.merge_engine
+        seq_fields = self.options.sequence_field or None
         if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
             res = merge_runs(
                 run_tables, self.key_cols,
                 merge_engine=("first-row" if engine == MergeEngine.FIRST_ROW
                               else "deduplicate"),
                 drop_deletes=drop_deletes,
-                key_encoder=self.key_encoder)
+                key_encoder=self.key_encoder,
+                seq_fields=seq_fields)
             return res.take()
         from paimon_tpu.ops.agg import merge_runs_agg
         merged = merge_runs_agg(run_tables, self.key_cols, self.schema,
                                 self.options,
-                                key_encoder=self.key_encoder)
+                                key_encoder=self.key_encoder,
+                                seq_fields=seq_fields)
         if drop_deletes:
             merged = self._live_view(merged)
         return merged

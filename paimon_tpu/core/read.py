@@ -190,10 +190,11 @@ class MergeFileSplitRead:
     def _value_columns(self) -> List[str]:
         names = [f.name for f in self.schema.fields]
         if self._projection:
-            # key/sequence columns are read regardless; output honors the
-            # projection
-            return [n for n in names if n in set(self._projection)
-                    or n in self.trimmed_pk]
+            # key, pk and user-sequence columns are read regardless;
+            # output honors the projection
+            keep = set(self._projection) | set(self.trimmed_pk) \
+                | set(self.options.sequence_field)
+            return [n for n in names if n in keep]
         return names
 
     def _read_file(self, split: DataSplit, meta: DataFileMeta,
@@ -245,19 +246,23 @@ class MergeFileSplitRead:
             runs.append(pa.concat_tables(tables, promote_options="none")
                         if len(tables) > 1 else tables[0])
         engine = self.options.merge_engine
+        seq_fields = self.options.sequence_field or None
         if engine == MergeEngine.FIRST_ROW:
             res = merge_runs(runs, self.key_cols, merge_engine="first-row",
-                             key_encoder=self.key_encoder)
+                             key_encoder=self.key_encoder,
+                             seq_fields=seq_fields)
             out = res.take(value_cols)
         elif engine in (MergeEngine.DEDUPLICATE,):
             res = merge_runs(runs, self.key_cols,
-                             key_encoder=self.key_encoder)
+                             key_encoder=self.key_encoder,
+                             seq_fields=seq_fields)
             out = res.take(value_cols)
         else:
             from paimon_tpu.ops.agg import merge_runs_agg
             out = merge_runs_agg(runs, self.key_cols, self.schema,
                                  self.options,
-                                 key_encoder=self.key_encoder
+                                 key_encoder=self.key_encoder,
+                                 seq_fields=seq_fields
                                  ).select(value_cols)
         if split.for_streaming:
             out = out.append_column(

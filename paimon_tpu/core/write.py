@@ -146,7 +146,9 @@ class _BucketWriter:
         if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
             res = merge_runs([kv], key_cols, merge_engine=engine,
                              drop_deletes=False,
-                             key_encoder=self.parent.key_encoder)
+                             key_encoder=self.parent.key_encoder,
+                             seq_fields=self.parent.options.sequence_field
+                             or None)
             sorted_kv = res.take()
         else:
             order = sort_table(kv, key_cols,

@@ -76,7 +76,7 @@ def sequence_groups(schema: TableSchema,
 
 def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
                            truncated: Optional[np.ndarray] = None,
-                           full_key=None):
+                           full_key=None, order_lanes=None):
     """Shared device sort -> (order over real rows, segment ids).
 
     If some rows' string keys exceeded the lane prefix (`truncated`),
@@ -84,7 +84,8 @@ def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
     are repaired on the host by re-sorting on the full key (`full_key`:
     row index -> comparable tuple) and splitting sub-segments."""
     n = lanes.shape[0]
-    perm, winner, _ = device_sorted_winners(lanes, seq, "last")
+    perm, winner, _ = device_sorted_winners(lanes, seq, "last",
+                                            order_lanes)
     real = perm < n
     order = perm[real].astype(np.int64)
     win_sorted = winner[real]
@@ -110,7 +111,14 @@ def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
             for s, e in zip(starts, ends):
                 span = order[s:e].tolist()
                 fk = {r: full_key(r) for r in span}
-                resorted = sorted(span, key=lambda r: (fk[r], int(seq[r])))
+                # within a key: user sequence first (when present), then
+                # internal sequence — same order the device sort used
+                resorted = sorted(
+                    span,
+                    key=lambda r: (fk[r],
+                                   tuple(order_lanes[r])
+                                   if order_lanes is not None else (),
+                                   int(seq[r])))
                 new_order[s:e] = resorted
                 prev_key = None
                 for k, r in enumerate(resorted):
@@ -193,7 +201,8 @@ _JAX_NUMERIC = {
 
 def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
                    schema: TableSchema, options: CoreOptions,
-                   key_encoder: Optional[NormalizedKeyEncoder] = None
+                   key_encoder: Optional[NormalizedKeyEncoder] = None,
+                   seq_fields: Optional[Sequence[str]] = None
                    ) -> pa.Table:
     """Merge runs under aggregation / partial-update semantics.
     Returns a KV-shaped table (keys + sys cols + aggregated values),
@@ -215,8 +224,11 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         def full_key(i: int):
             return tuple(c[int(i)].as_py() for c in kcols)
 
+    from paimon_tpu.ops.merge import user_seq_order_lanes
+    order_lanes = user_seq_order_lanes(table, seq_fields) \
+        if seq_fields else None
     order, seg_id, win_sorted = _segment_ids_from_sort(
-        lanes, seq, truncated, full_key)
+        lanes, seq, truncated, full_key, order_lanes)
     num_seg = int(seg_id[-1]) + 1 if len(seg_id) else 0
     win_pos = np.flatnonzero(win_sorted)           # last row of each segment
 

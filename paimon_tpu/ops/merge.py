@@ -35,7 +35,7 @@ from paimon_tpu.ops.normkey import NormalizedKeyEncoder
 from paimon_tpu.types import RowKind
 
 __all__ = ["merge_runs", "MergeResult", "device_sorted_winners",
-           "SEQ_COL", "KIND_COL"]
+           "user_seq_order_lanes", "SEQ_COL", "KIND_COL"]
 
 SEQ_COL = "_SEQUENCE_NUMBER"
 KIND_COL = "_VALUE_KIND"
@@ -60,20 +60,27 @@ def _pad_size(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str):
+def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
+                         num_key_lanes: Optional[int] = None):
     """Traceable kernel body shared by the single-chip path, the sharded
     multi-bucket path (parallel/sharded_merge.py) and the driver entry.
 
     lane_list: list of uint32[N] arrays (most-significant lane first).
+    The first `num_key_lanes` define SEGMENT identity; any further lanes
+    are user-defined sequence order (reference
+    utils/UserDefinedSeqComparator: rows within a key order by the
+    sequence field first, internal sequence breaks ties).
     Returns (perm, winner, prev_in_seg)."""
     num_lanes = len(lane_list)
+    if num_key_lanes is None:
+        num_key_lanes = num_lanes
     n = invalid.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
     operands = [invalid] + list(lane_list) + [seq_hi, seq_lo, iota]
     sorted_ops = jax.lax.sort(operands, num_keys=num_lanes + 3,
                               is_stable=True)
     s_invalid = sorted_ops[0]
-    s_lanes = sorted_ops[1:1 + num_lanes]
+    s_lanes = sorted_ops[1:1 + num_key_lanes]
     perm = sorted_ops[-1]
 
     lanes_mat = jnp.stack(s_lanes)          # [L, N]
@@ -96,28 +103,34 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str):
 
 
 @lru_cache(maxsize=64)
-def _merge_fn(num_lanes: int, keep: str):
+def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int):
     """Build the jitted merge kernel for a lane count."""
 
     @jax.jit
     def fn(lanes, seq_hi, seq_lo, invalid):
         return segmented_merge_body(
             [lanes[i] for i in range(num_lanes)], seq_hi, seq_lo, invalid,
-            keep)
+            keep, num_key_lanes=num_key_lanes)
 
     return fn
 
 
 def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
-                          keep: str = "last"
+                          keep: str = "last",
+                          order_lanes: Optional[np.ndarray] = None
                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the device kernel.
 
-    lanes: uint32[N, L]; seq: int64[N] (non-negative).
+    lanes: uint32[N, L] (segment identity); seq: int64[N] (non-negative);
+    order_lanes: optional uint32[N, O] user-defined sequence lanes that
+    rank within a key BEFORE the internal sequence.
     Returns (perm, winner_mask, prev_in_segment) as numpy arrays of the
     padded size; caller slices by validity via winner mask.
     """
-    n, num_lanes = lanes.shape
+    n, num_key_lanes = lanes.shape
+    if order_lanes is not None and order_lanes.shape[1] > 0:
+        lanes = np.concatenate([lanes, order_lanes], axis=1)
+    num_lanes = lanes.shape[1]
     m = _pad_size(n)
     lanes_p = np.full((m, num_lanes), 0, dtype=np.uint32)
     lanes_p[:n] = lanes
@@ -129,11 +142,36 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     invalid = np.ones(m, dtype=np.uint32)
     invalid[:n] = 0
 
-    fn = _merge_fn(num_lanes, keep)
+    fn = _merge_fn(num_lanes, keep, num_key_lanes)
     lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
     perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
                             jnp.asarray(seq_lo), jnp.asarray(invalid))
     return (np.asarray(perm), np.asarray(winner), np.asarray(prev))
+
+
+def user_seq_order_lanes(table: pa.Table,
+                         seq_fields: Sequence[str]) -> np.ndarray:
+    """uint32[N, O] order lanes for user-defined sequence columns
+    (reference utils/UserDefinedSeqComparator). Nulls rank FIRST — a row
+    with a null sequence always loses to any non-null one."""
+    for f in seq_fields:
+        t = table.schema.field(f).type
+        if pa.types.is_string(t) or pa.types.is_large_string(t) or \
+                pa.types.is_binary(t) or pa.types.is_large_binary(t):
+            raise ValueError(
+                f"sequence.field {f!r} must be numeric/temporal; string "
+                f"sequences would compare only by a fixed-width prefix")
+    enc = NormalizedKeyEncoder(
+        [table.schema.field(f).type for f in seq_fields],
+        nullable=[True] * len(seq_fields))
+    lanes, _ = enc.encode_table(table, seq_fields)
+    pos = 0
+    for nl in enc.lanes_per_col:
+        # encoder presence lane sorts nulls last; sequences need the
+        # opposite (null = smallest)
+        lanes[:, pos] = 1 - lanes[:, pos]
+        pos += nl
+    return lanes
 
 
 def sort_table(table: pa.Table, key_names: Sequence[str],
@@ -171,7 +209,8 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
                merge_engine: str = "deduplicate",
                drop_deletes: bool = True,
                key_encoder: Optional[NormalizedKeyEncoder] = None,
-               with_prev: bool = False) -> MergeResult:
+               with_prev: bool = False,
+               seq_fields: Optional[Sequence[str]] = None) -> MergeResult:
     """Merge k sorted runs (oldest first) into the latest row per key.
 
     Equivalent reference path: MergeTreeReaders.readerForMergeTree
@@ -193,7 +232,15 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
 
     keep = "first" if merge_engine == "first-row" else "last"
-    perm, winner, prev = device_sorted_winners(lanes, seq, keep)
+    if seq_fields and keep == "first":
+        # reference forbids the combo: "first by user sequence" would
+        # let later commits replace the retained first row
+        raise ValueError(
+            "sequence.field cannot be used with merge-engine first-row")
+    order_lanes = user_seq_order_lanes(table, seq_fields) \
+        if seq_fields else None
+    perm, winner, prev = device_sorted_winners(lanes, seq, keep,
+                                               order_lanes)
 
     win_pos = np.flatnonzero(winner)
     indices = perm[win_pos].astype(np.int64)

@@ -197,3 +197,97 @@ def test_sequence_group_member_with_agg_function_rejected(tmp_warehouse):
     _commit(table, [{"k": 1, "a": 1, "b": 1, "g1_seq": 1, "c": 1}])
     with pytest.raises(NotImplementedError):
         table.to_arrow()
+
+
+def test_sequence_field_out_of_order_events(tmp_warehouse):
+    """sequence.field: late-arriving events with larger user sequence win
+    regardless of commit order (reference UserDefinedSeqComparator)."""
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("v", IntType())
+              .column("event_time", BigIntType())
+              .primary_key("k")
+              .options({"bucket": "1", "write-only": "true",
+                        "sequence.field": "event_time"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "sf"),
+                                  schema)
+    _commit(table, [{"k": 1, "v": 10, "event_time": 100}])
+    # later commit with an EARLIER event time: must NOT win
+    _commit(table, [{"k": 1, "v": 99, "event_time": 50}])
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["v"], row["event_time"]) == (10, 100)
+    # compaction preserves the same resolution
+    table.compact(full=True)
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["v"], row["event_time"]) == (10, 100)
+    # larger event time wins
+    _commit(table, [{"k": 1, "v": 42, "event_time": 200}])
+    assert table.to_arrow().to_pylist()[0]["v"] == 42
+
+
+def test_sequence_field_null_always_loses(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("v", IntType())
+              .column("ts", BigIntType())
+              .primary_key("k")
+              .options({"bucket": "1", "write-only": "true",
+                        "sequence.field": "ts"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "sn"),
+                                  schema)
+    _commit(table, [{"k": 1, "v": 1, "ts": 5}])
+    _commit(table, [{"k": 1, "v": 2, "ts": None}])
+    assert table.to_arrow().to_pylist()[0]["v"] == 1
+
+
+def test_sequence_field_with_partial_update(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("k", BigIntType(False))
+              .column("a", IntType())
+              .column("ts", BigIntType())
+              .primary_key("k")
+              .options({"bucket": "1", "write-only": "true",
+                        "merge-engine": "partial-update",
+                        "sequence.field": "ts"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "sp"),
+                                  schema)
+    _commit(table, [{"k": 1, "a": 1, "ts": 10}])
+    _commit(table, [{"k": 1, "a": 2, "ts": 5}])   # stale event
+    row = table.to_arrow().to_pylist()[0]
+    assert (row["a"], row["ts"]) == (1, 10)
+
+
+def test_sequence_field_first_row_rejected(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("k", BigIntType(False)).column("ts", BigIntType())
+              .primary_key("k")
+              .options({"bucket": "1", "write-only": "true",
+                        "merge-engine": "first-row",
+                        "sequence.field": "ts"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "fr"),
+                                  schema)
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    with pytest.raises(ValueError):
+        w.write_dicts([{"k": 1, "ts": 1}])
+        wb.new_commit().commit(w.prepare_commit())
+
+
+def test_sequence_field_string_rejected(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("k", BigIntType(False)).column("s", VarCharType())
+              .primary_key("k")
+              .options({"bucket": "1", "write-only": "true",
+                        "sequence.field": "s"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "ss"),
+                                  schema)
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    with pytest.raises(ValueError):
+        w.write_dicts([{"k": 1, "s": "a"}])
+        wb.new_commit().commit(w.prepare_commit())
