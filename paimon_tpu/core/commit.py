@@ -182,7 +182,8 @@ class FileStoreCommit:
                     index_entries: Optional[list] = None,
                     properties: Optional[Dict[str, str]] = None,
                     entries_fn=None,
-                    expected_latest_id: Optional[int] = ...) -> int:
+                    expected_latest_id: Optional[int] = ...,
+                    statistics: Optional[str] = None) -> int:
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         while True:
@@ -260,6 +261,7 @@ class FileStoreCommit:
                 delta_record_count=delta_rows,
                 changelog_record_count=changelog_rows or None,
                 properties=properties,
+                statistics=statistics,
             )
             if self.snapshot_manager.try_commit(snapshot):
                 return new_id

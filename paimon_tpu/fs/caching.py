@@ -1,0 +1,113 @@
+"""Caching FileIO: LRU byte cache over immutable store files.
+
+reference: paimon-common/.../fs/cache/CachingFileIO (local page cache
+over remote object stores) + io/cache/CacheManager.java:34.
+
+Only files whose names mark them immutable (uuid'd data/manifest/index
+files, snapshot-N, schema-N) are cached; mutable refs (LATEST/EARLIEST
+hints, consumers, tags, branches) always hit the inner FileIO.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from paimon_tpu.fs.fileio import FileIO
+
+__all__ = ["CachingFileIO"]
+
+# snapshot-N files are deliberately NOT cached: rollback_to /
+# fast_forward delete and later RECREATE the same snapshot ids with
+# different content, which an external writer's mutation would never
+# evict from this process's cache. schema-N ids are append-only.
+_IMMUTABLE = re.compile(
+    r"^(data-|changelog-|manifest-|index-|stats-|schema-\d+$)")
+
+
+def _cacheable(path: str) -> bool:
+    name = path.rstrip("/").rsplit("/", 1)[-1]
+    return bool(_IMMUTABLE.search(name))
+
+
+class CachingFileIO(FileIO):
+    def __init__(self, inner: FileIO, capacity_bytes: int = 256 << 20):
+        self.inner = inner
+        self.capacity = capacity_bytes
+        self._cache: "OrderedDict[str, bytes]" = OrderedDict()
+        self._size = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    # -- cached reads --------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        if not _cacheable(path):
+            return self.inner.read_bytes(path)
+        with self._lock:
+            data = self._cache.get(path)
+            if data is not None:
+                self._cache.move_to_end(path)
+                self.hits += 1
+                return data
+        data = self.inner.read_bytes(path)
+        self.misses += 1
+        if len(data) <= self.capacity:
+            with self._lock:
+                if path not in self._cache:
+                    self._cache[path] = data
+                    self._size += len(data)
+                    while self._size > self.capacity and self._cache:
+                        _, old = self._cache.popitem(last=False)
+                        self._size -= len(old)
+        return data
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        if _cacheable(path):
+            return self.read_bytes(path)[offset:offset + length]
+        return self.inner.read_range(path, offset, length)
+
+    # -- invalidating mutations ---------------------------------------------
+
+    def _evict(self, path: str):
+        with self._lock:
+            data = self._cache.pop(path, None)
+            if data is not None:
+                self._size -= len(data)
+
+    def write_bytes(self, path, data, overwrite=True):
+        self._evict(path)
+        return self.inner.write_bytes(path, data, overwrite=overwrite)
+
+    def try_to_write_atomic(self, path, data):
+        self._evict(path)
+        return self.inner.try_to_write_atomic(path, data)
+
+    def delete(self, path, recursive=False):
+        self._evict(path)
+        return self.inner.delete(path, recursive=recursive)
+
+    def rename(self, src, dst):
+        self._evict(src)
+        self._evict(dst)
+        return self.inner.rename(src, dst)
+
+    # -- delegation ----------------------------------------------------------
+
+    def exists(self, path):
+        return self.inner.exists(path)
+
+    def get_file_size(self, path):
+        return self.inner.get_file_size(path)
+
+    def list_status(self, path):
+        return self.inner.list_status(path)
+
+    def mkdirs(self, path):
+        return self.inner.mkdirs(path)
+
+    def is_object_store(self):
+        return self.inner.is_object_store()

@@ -185,7 +185,11 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
     for fname in dead_manifests:
         table.file_io.delete_quietly(f"{scan.path_factory.manifest_dir}/"
                                      f"{fname}")
+    keep_stats = {s.statistics for s in survivors if s.statistics}
     for s in expiring:
+        if s.statistics and s.statistics not in keep_stats:
+            table.file_io.delete_quietly(
+                f"{table.path}/statistics/{s.statistics}")
         sm.delete_snapshot(s.id)
     sm.commit_earliest_hint(end)
     return result

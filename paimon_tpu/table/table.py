@@ -155,6 +155,16 @@ class FileStoreTable:
         from paimon_tpu.table.system import load_system_table
         return load_system_table(self, name)
 
+    def analyze(self, columns: Optional[List[str]] = None) -> Optional[int]:
+        """ANALYZE TABLE: compute and persist table/column statistics
+        (reference stats/StatsFileHandler)."""
+        from paimon_tpu.stats import analyze_table
+        return analyze_table(self, columns)
+
+    def statistics(self) -> Optional[Dict]:
+        from paimon_tpu.stats import read_statistics
+        return read_statistics(self)
+
     def delete_where(self, predicate: Predicate) -> Optional[int]:
         """Row-level DELETE: deletion vectors on append tables, -D
         records on primary-key tables (reference DeleteAction /

@@ -1,0 +1,178 @@
+"""ANALYZE stats, caching FileIO, FormatTable, privileges."""
+
+import os
+
+import pyarrow as pa
+import pytest
+
+import paimon_tpu
+from paimon_tpu.catalog.privilege import (
+    Privilege, PrivilegedCatalog, PrivilegeError, PrivilegeManager,
+)
+from paimon_tpu.fs import get_file_io
+from paimon_tpu.fs.caching import CachingFileIO
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.table.format_table import FormatTable
+from paimon_tpu.types import BigIntType, DoubleType, VarCharType
+
+
+def _make(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("name", VarCharType())
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1"})
+              .build())
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_analyze_statistics(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": i, "name": f"n{i}", "v": float(i)}
+                    for i in range(10)])
+    sid = table.analyze()
+    assert sid is not None
+    snap = table.snapshot_manager.latest_snapshot()
+    assert snap.commit_kind == "ANALYZE"
+    assert snap.statistics
+
+    stats = table.statistics()
+    assert stats["mergedRecordCount"] == 10
+    assert stats["colStats"]["id"]["distinctCount"] == 10
+    assert stats["colStats"]["v"]["min"] == "0.0"
+    assert stats["colStats"]["name"]["maxLen"] >= 2
+
+    # later data commits keep the stats reachable (walk back)
+    _commit(table, [{"id": 99, "name": "z", "v": 9.0}])
+    assert table.statistics()["mergedRecordCount"] == 10
+
+
+def test_caching_fileio(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "name": "a", "v": 1.0}])
+
+    cached = CachingFileIO(get_file_io(table.path))
+    ct = FileStoreTable(cached, table.path, table.schema_manager.latest())
+    assert ct.to_arrow().num_rows == 1
+    misses_first = cached.misses
+    assert ct.to_arrow().num_rows == 1
+    assert cached.hits > 0
+    assert cached.misses == misses_first      # second read fully cached
+
+    # mutable hint files are never cached: new commits become visible
+    _commit(table, [{"id": 2, "name": "b", "v": 2.0}])
+    assert ct.to_arrow().num_rows == 2
+
+
+def test_format_table_roundtrip(tmp_path):
+    ft = FormatTable(str(tmp_path / "ft"), "csv")
+    ft.write(pa.table({"a": pa.array([1, 2], pa.int64())}))
+    ft.write(pa.table({"a": pa.array([3], pa.int64())}))
+    out = ft.to_arrow()
+    assert sorted(out.column("a").to_pylist()) == [1, 2, 3]
+
+    # hive-style partitions
+    ft2 = FormatTable(str(tmp_path / "ftp"), "parquet")
+    ft2.write(pa.table({"v": pa.array([1])}), partition={"dt": "d1"})
+    ft2.write(pa.table({"v": pa.array([2])}), partition={"dt": "d2"})
+    assert ft2.to_arrow().num_rows == 2
+    assert ft2.to_arrow(partition={"dt": "d1"}).column("v").to_pylist() \
+        == [1]
+
+
+def test_privileges(tmp_path):
+    wh = str(tmp_path / "wh")
+    cat = paimon_tpu.create_catalog({"warehouse": wh})
+    cat.create_database("db")
+    cat.create_table("db.t", Schema.builder()
+                     .column("id", BigIntType(False))
+                     .primary_key("id").options({"bucket": "1"}).build())
+
+    pm = PrivilegeManager(cat.file_io, wh)
+    assert not pm.enabled()
+    pm.init("rootpw")
+    pm.create_user("alice", "pw1")
+    pm.grant("alice", Privilege.SELECT, "db.t")
+
+    root = PrivilegedCatalog(cat, "root", "rootpw")
+    root.get_table("db.t")                       # admin: everything
+
+    alice = PrivilegedCatalog(cat, "alice", "pw1")
+    alice.get_table("db.t")                      # granted
+    with pytest.raises(PrivilegeError):
+        alice.drop_table("db.t")
+    with pytest.raises(PrivilegeError):
+        alice.create_database("db2")
+    pm.grant("alice", Privilege.CREATE_DATABASE)
+    alice.create_database("db2")
+
+    with pytest.raises(PrivilegeError):
+        PrivilegedCatalog(cat, "alice", "wrong")
+
+    pm.revoke("alice", Privilege.SELECT, "db.t")
+    with pytest.raises(PrivilegeError):
+        alice.get_table("db.t")
+
+
+def test_privileged_table_blocks_writes(tmp_path):
+    wh = str(tmp_path / "wh2")
+    cat = paimon_tpu.create_catalog({"warehouse": wh})
+    cat.create_database("db")
+    cat.create_table("db.t", Schema.builder()
+                     .column("id", BigIntType(False))
+                     .primary_key("id").options({"bucket": "1"}).build())
+    pm = PrivilegeManager(cat.file_io, wh)
+    pm.init("rootpw")
+    pm.create_user("bob", "pw")
+    pm.grant("bob", Privilege.SELECT, "db.t")
+
+    bob_t = PrivilegedCatalog(cat, "bob", "pw").get_table("db.t")
+    assert bob_t.to_arrow().num_rows == 0       # read allowed
+    with pytest.raises(PrivilegeError):
+        bob_t.new_batch_write_builder()
+    with pytest.raises(PrivilegeError):
+        bob_t.create_tag("x")
+    pm.grant("bob", Privilege.INSERT, "db.t")
+    wb = bob_t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1}])
+    wb.new_commit().commit(w.prepare_commit())
+    assert bob_t.to_arrow().num_rows == 1
+
+
+def test_format_table_partition_columns(tmp_path):
+    ft = FormatTable(str(tmp_path / "fp"), "parquet")
+    ft.write(pa.table({"v": pa.array([1])}), partition={"dt": "d1"})
+    ft.write(pa.table({"v": pa.array([2])}), partition={"dt": "d2"})
+    out = ft.to_arrow()
+    assert sorted(zip(out.column("dt").to_pylist(),
+                      out.column("v").to_pylist())) == \
+        [("d1", 1), ("d2", 2)]
+
+
+def test_expire_cleans_stats_files(tmp_warehouse):
+    import time
+
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "name": "a", "v": 1.0}])
+    table.analyze()
+    old_stats = table.snapshot_manager.latest_snapshot().statistics
+    for i in range(3):
+        _commit(table, [{"id": 2 + i, "name": "b", "v": 2.0}])
+    table.analyze()
+    table.expire_snapshots(retain_max=1, retain_min=1,
+                           older_than_ms=int(time.time() * 1000) + 1)
+    assert not os.path.exists(
+        os.path.join(table.path, "statistics", old_stats))
+    # the surviving ANALYZE snapshot's stats remain readable
+    assert table.statistics() is not None
