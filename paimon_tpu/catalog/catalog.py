@@ -257,9 +257,16 @@ def create_catalog(options=None, **kwargs) -> Catalog:
     opts.update({k: str(v) for k, v in kwargs.items()})
     metastore = opts.get("metastore", "filesystem")
     warehouse = opts.get("warehouse")
-    if not warehouse:
+    if not warehouse and metastore == "filesystem":
         raise ValueError("catalog requires a 'warehouse' option")
     if metastore == "filesystem":
         return FileSystemCatalog(warehouse)
+    if metastore == "rest":
+        from paimon_tpu.catalog.rest import RESTCatalogClient
+        uri = opts.get("uri")
+        if not uri:
+            raise ValueError("rest catalog requires a 'uri' option")
+        return RESTCatalogClient(uri, token=opts.get("token"),
+                                 prefix=opts.get("prefix", "paimon"))
     raise ValueError(f"Unsupported metastore {metastore!r} "
-                     f"(available: filesystem)")
+                     f"(available: filesystem, rest)")

@@ -59,6 +59,24 @@ class PrivilegeManager:
                                  json.dumps(state, indent=2).encode(),
                                  overwrite=True)
 
+    def _mutate(self, fn):
+        """Serialize mutations through a lock file so concurrent admins
+        cannot lose each other's updates (load/modify/overwrite is not
+        atomic)."""
+        import time
+        lock = self.path + ".lock"
+        for _ in range(200):
+            if self.file_io.try_to_write_atomic(lock, b"1"):
+                try:
+                    state = self._require()
+                    fn(state)
+                    self._store(state)
+                    return
+                finally:
+                    self.file_io.delete_quietly(lock)
+            time.sleep(0.01)
+        raise TimeoutError("privilege file lock busy")
+
     def enabled(self) -> bool:
         return self._load() is not None
 
@@ -78,37 +96,37 @@ class PrivilegeManager:
         return stored is not None and stored == _hash(password)
 
     def create_user(self, user: str, password: str):
-        state = self._require()
-        if user in state["users"]:
-            raise ValueError(f"User {user!r} exists")
-        state["users"][user] = _hash(password)
-        self._store(state)
+        def fn(state):
+            if user in state["users"]:
+                raise ValueError(f"User {user!r} exists")
+            state["users"][user] = _hash(password)
+        self._mutate(fn)
 
     def drop_user(self, user: str):
-        state = self._require()
-        if user == self.ROOT:
-            raise ValueError("Cannot drop root")
-        state["users"].pop(user, None)
-        state["grants"].pop(user, None)
-        self._store(state)
+        def fn(state):
+            if user == self.ROOT:
+                raise ValueError("Cannot drop root")
+            state["users"].pop(user, None)
+            state["grants"].pop(user, None)
+        self._mutate(fn)
 
     def grant(self, user: str, privilege: str, target: str = "*"):
         """target: '*', 'db' or 'db.table'."""
-        state = self._require()
-        if user not in state["users"]:
-            raise ValueError(f"Unknown user {user!r}")
-        state["grants"].setdefault(user, {}).setdefault(
-            target, [])
-        if privilege not in state["grants"][user][target]:
-            state["grants"][user][target].append(privilege)
-        self._store(state)
+        def fn(state):
+            if user not in state["users"]:
+                raise ValueError(f"Unknown user {user!r}")
+            held = state["grants"].setdefault(user, {}).setdefault(
+                target, [])
+            if privilege not in held:
+                held.append(privilege)
+        self._mutate(fn)
 
     def revoke(self, user: str, privilege: str, target: str = "*"):
-        state = self._require()
-        grants = state.get("grants", {}).get(user, {})
-        if target in grants and privilege in grants[target]:
-            grants[target].remove(privilege)
-            self._store(state)
+        def fn(state):
+            grants = state.get("grants", {}).get(user, {})
+            if target in grants and privilege in grants[target]:
+                grants[target].remove(privilege)
+        self._mutate(fn)
 
     def check(self, user: str, privilege: str, target: str = "*"):
         state = self._load()
@@ -160,6 +178,11 @@ class PrivilegedTable:
             self._manager.check(self._user, Privilege.ALTER_TABLE,
                                 self._target)
         return getattr(self._table, name)
+
+    def copy(self, dynamic_options):
+        # stays privileged: copy() must not hand back a raw table
+        return PrivilegedTable(self._table.copy(dynamic_options),
+                               self._manager, self._user, self._target)
 
 
 class PrivilegedCatalog(Catalog):

@@ -1,0 +1,305 @@
+"""REST catalog: HTTP protocol + bearer-token auth.
+
+reference: paimon-api/.../rest/ (RESTApi + 105 DTO/auth files),
+paimon-core rest/RESTCatalog.java. Route shapes follow the reference's
+`/v1/{prefix}/databases[/{db}[/tables[/{table}]]]` layout; table DATA
+access stays direct FileIO against the path the server returns (the
+reference behaves the same for filesystem-backed REST catalogs).
+
+RESTCatalogServer wraps any Catalog (normally FileSystemCatalog) for
+serving; RESTCatalogClient is a drop-in Catalog implementation.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from paimon_tpu.catalog.catalog import (
+    Catalog, DatabaseAlreadyExistsError, DatabaseNotFoundError,
+    Identifier, TableAlreadyExistsError, TableNotFoundError,
+)
+from paimon_tpu.schema.schema import Schema
+from paimon_tpu.types import DataField
+
+__all__ = ["RESTCatalogServer", "RESTCatalogClient"]
+
+
+def _schema_to_json(schema: Schema) -> dict:
+    return {
+        "fields": [f.to_json() for f in schema.fields],
+        "partitionKeys": schema.partition_keys,
+        "primaryKeys": schema.primary_keys,
+        "options": schema.options,
+        "comment": getattr(schema, "comment", ""),
+    }
+
+
+def _schema_from_json(d: dict) -> Schema:
+    return Schema(
+        fields=[DataField.from_json(f) for f in d["fields"]],
+        partition_keys=d.get("partitionKeys") or [],
+        primary_keys=d.get("primaryKeys") or [],
+        options=d.get("options") or {},
+        comment=d.get("comment", ""),
+    )
+
+
+_ERRORS = {
+    "DatabaseNotFound": DatabaseNotFoundError,
+    "DatabaseAlreadyExists": DatabaseAlreadyExistsError,
+    "TableNotFound": TableNotFoundError,
+    "TableAlreadyExists": TableAlreadyExistsError,
+}
+
+
+class RESTCatalogServer:
+    """Serves a Catalog over HTTP (in-process; reference RESTCatalog's
+    server side is an external service — this doubles as the conformance
+    test double and a usable single-host catalog service)."""
+
+    def __init__(self, catalog, token: Optional[str] = None,
+                 prefix: str = "paimon", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.catalog = catalog
+        self.token = token
+        self.prefix = prefix
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- request handling ----------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, kind: str, message: str):
+                self._reply(code, {"error": kind, "message": message})
+
+            def _authorized(self) -> bool:
+                if server.token is None:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                return auth == f"Bearer {server.token}"
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _route(self, method: str):
+                if not self._authorized():
+                    return self._error(401, "Unauthorized", "bad token")
+                parts = [p for p in self.path.split("/") if p]
+                # /v1/{prefix}/databases[/{db}[/tables[/{table}]]]
+                if len(parts) < 3 or parts[0] != "v1" or \
+                        parts[1] != server.prefix or \
+                        parts[2] != "databases":
+                    return self._error(404, "NotFound", self.path)
+                cat = server.catalog
+                try:
+                    if len(parts) == 3:
+                        if method == "GET":
+                            return self._reply(200, {
+                                "databases": cat.list_databases()})
+                        if method == "POST":
+                            b = self._body()
+                            cat.create_database(
+                                b["name"],
+                                properties=b.get("properties"))
+                            return self._reply(200, {})
+                    db = parts[3]
+                    if len(parts) == 4:
+                        if method == "GET":
+                            return self._reply(200, {
+                                "name": db,
+                                "properties":
+                                    cat.load_database_properties(db)})
+                        if method == "DELETE":
+                            cat.drop_database(db, cascade=True)
+                            return self._reply(200, {})
+                    if len(parts) >= 5 and parts[4] == "tables":
+                        if len(parts) == 5:
+                            if method == "GET":
+                                return self._reply(200, {
+                                    "tables": cat.list_tables(db)})
+                            if method == "POST":
+                                b = self._body()
+                                t = cat.create_table(
+                                    f"{db}.{b['name']}",
+                                    _schema_from_json(b["schema"]))
+                                return self._reply(200, {"path": t.path})
+                        name = parts[5]
+                        ident = f"{db}.{name}"
+                        if method == "GET":
+                            t = cat.get_table(ident)
+                            return self._reply(200, {
+                                "name": name,
+                                "path": t.path,
+                                "schema": json.loads(
+                                    t.schema_manager.latest().to_json()),
+                            })
+                        if method == "DELETE":
+                            cat.drop_table(ident)
+                            return self._reply(200, {})
+                        if method == "POST":        # rename
+                            b = self._body()
+                            cat.rename_table(ident,
+                                             f"{db}.{b['newName']}")
+                            return self._reply(200, {})
+                except DatabaseNotFoundError as e:
+                    return self._error(404, "DatabaseNotFound", str(e))
+                except DatabaseAlreadyExistsError as e:
+                    return self._error(409, "DatabaseAlreadyExists",
+                                       str(e))
+                except TableNotFoundError as e:
+                    return self._error(404, "TableNotFound", str(e))
+                except TableAlreadyExistsError as e:
+                    return self._error(409, "TableAlreadyExists", str(e))
+                except Exception as e:          # noqa: BLE001
+                    return self._error(500, "Internal", str(e))
+                return self._error(404, "NotFound", self.path)
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        return Handler
+
+
+class RESTCatalogClient(Catalog):
+    """reference rest/RESTCatalog.java with BearTokenAuthProvider."""
+
+    def __init__(self, uri: str, token: Optional[str] = None,
+                 prefix: str = "paimon"):
+        self.uri = uri.rstrip("/")
+        self.token = token
+        self.prefix = prefix
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> dict:
+        url = f"{self.uri}/v1/{self.prefix}/{path}"
+        data = json.dumps(body).encode("utf-8") if body is not None \
+            else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                payload = {"error": "Internal", "message": str(e)}
+            exc = _ERRORS.get(payload.get("error"))
+            if exc is not None:
+                raise exc(payload.get("message", ""))
+            raise RuntimeError(
+                f"REST catalog error {e.code}: {payload}") from e
+
+    # -- Catalog API ---------------------------------------------------------
+
+    def list_databases(self) -> List[str]:
+        return self._request("GET", "databases")["databases"]
+
+    def create_database(self, name: str, ignore_if_exists: bool = False,
+                        properties: Optional[Dict[str, str]] = None):
+        try:
+            self._request("POST", "databases",
+                          {"name": name, "properties": properties})
+        except DatabaseAlreadyExistsError:
+            if not ignore_if_exists:
+                raise
+
+    def load_database_properties(self, name: str) -> Dict[str, str]:
+        return self._request("GET", f"databases/{name}")["properties"]
+
+    def drop_database(self, name: str, ignore_if_not_exists: bool = False,
+                      cascade: bool = False):
+        try:
+            self._request("DELETE", f"databases/{name}")
+        except DatabaseNotFoundError:
+            if not ignore_if_not_exists:
+                raise
+
+    def list_tables(self, database: str) -> List[str]:
+        return self._request("GET",
+                             f"databases/{database}/tables")["tables"]
+
+    def create_table(self, identifier, schema: Schema,
+                     ignore_if_exists: bool = False):
+        i = self._ident(identifier)
+        try:
+            self._request("POST", f"databases/{i.database}/tables",
+                          {"name": i.table,
+                           "schema": _schema_to_json(schema)})
+        except TableAlreadyExistsError:
+            if not ignore_if_exists:
+                raise
+        return self.get_table(identifier)
+
+    def get_table(self, identifier):
+        from paimon_tpu.table.table import FileStoreTable
+
+        i = self._ident(identifier)
+        info = self._request(
+            "GET", f"databases/{i.database}/tables/{i.table}")
+        dynamic = {"branch": i.branch} if i.branch else None
+        return FileStoreTable.load(info["path"], dynamic_options=dynamic)
+
+    def drop_table(self, identifier, ignore_if_not_exists: bool = False):
+        i = self._no_branch(self._ident(identifier), "drop")
+        try:
+            self._request("DELETE",
+                          f"databases/{i.database}/tables/{i.table}")
+        except TableNotFoundError:
+            if not ignore_if_not_exists:
+                raise
+
+    def rename_table(self, src, dst, ignore_if_not_exists: bool = False):
+        s = self._no_branch(self._ident(src), "rename")
+        d = self._no_branch(self._ident(dst), "rename")
+        try:
+            self._request("POST",
+                          f"databases/{s.database}/tables/{s.table}",
+                          {"newName": d.table})
+        except TableNotFoundError:
+            if not ignore_if_not_exists:
+                raise

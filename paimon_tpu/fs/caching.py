@@ -67,7 +67,13 @@ class CachingFileIO(FileIO):
 
     def read_range(self, path: str, offset: int, length: int) -> bytes:
         if _cacheable(path):
-            return self.read_bytes(path)[offset:offset + length]
+            with self._lock:
+                data = self._cache.get(path)
+                if data is not None:
+                    self._cache.move_to_end(path)
+                    self.hits += 1
+                    return data[offset:offset + length]
+        # not cached: delegate the range — never force a full-object GET
         return self.inner.read_range(path, offset, length)
 
     # -- invalidating mutations ---------------------------------------------

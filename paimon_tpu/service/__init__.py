@@ -1,0 +1,12 @@
+"""Query service: remote point lookups.
+
+reference: paimon-service/ (KvQueryServer/KvServerHandler over a Netty
+binary protocol, ServiceManager registering 'primary-key-lookup'
+addresses in the table directory, KvQueryClient). The transport here is
+HTTP+JSON over the same LocalTableQuery engine — the service plane is
+the capability, not the wire bytes.
+"""
+
+from paimon_tpu.service.query_service import (  # noqa: F401
+    KvQueryClient, KvQueryServer, ServiceManager,
+)

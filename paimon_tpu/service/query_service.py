@@ -1,0 +1,133 @@
+"""KV query service over LocalTableQuery.
+
+reference: paimon-service/.../KvQueryServer.java + KvQueryClient.java +
+ServiceManager.java ('primary-key-lookup' address files under
+`<table>/service/`). Powers remote lookup joins
+(PrimaryKeyPartialLookupTable remote mode).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from paimon_tpu.lookup import LocalTableQuery
+
+__all__ = ["KvQueryServer", "KvQueryClient", "ServiceManager"]
+
+PRIMARY_KEY_LOOKUP = "primary-key-lookup"
+
+
+class ServiceManager:
+    """Address registry in the table dir (reference ServiceManager)."""
+
+    def __init__(self, file_io, table_path: str):
+        self.file_io = file_io
+        self.dir = f"{table_path.rstrip('/')}/service"
+
+    def _path(self, service: str) -> str:
+        return f"{self.dir}/{service}"
+
+    def register(self, service: str, address: str):
+        self.file_io.write_bytes(self._path(service),
+                                 json.dumps([address]).encode(),
+                                 overwrite=True)
+
+    def unregister(self, service: str):
+        self.file_io.delete_quietly(self._path(service))
+
+    def addresses(self, service: str) -> List[str]:
+        if not self.file_io.exists(self._path(service)):
+            return []
+        return json.loads(self.file_io.read_bytes(self._path(service)))
+
+
+class KvQueryServer:
+    def __init__(self, table, host: str = "127.0.0.1", port: int = 0):
+        self.table = table
+        self.query = LocalTableQuery(table)
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.address = f"http://{host}:{self.port}"
+        self.services = ServiceManager(table.file_io, table.path)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "KvQueryServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.services.register(PRIMARY_KEY_LOOKUP, self.address)
+        return self
+
+    def stop(self):
+        self.services.unregister(PRIMARY_KEY_LOOKUP)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path != "/lookup":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                try:
+                    rows = server.query.lookup(
+                        req["keys"],
+                        partition=tuple(req.get("partition") or ()))
+                    body = json.dumps({"rows": rows},
+                                      default=str).encode()
+                    self.send_response(200)
+                except Exception as e:      # noqa: BLE001
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler
+
+
+class KvQueryClient:
+    """Remote point lookups; resolves the server address from the
+    table's service registry (reference KvQueryClient + ServiceManager
+    discovery)."""
+
+    def __init__(self, table=None, address: Optional[str] = None):
+        if address is None:
+            if table is None:
+                raise ValueError("need a table or an address")
+            addrs = ServiceManager(table.file_io, table.path) \
+                .addresses(PRIMARY_KEY_LOOKUP)
+            if not addrs:
+                raise RuntimeError(
+                    "no primary-key-lookup service registered")
+            address = addrs[0]
+        self.address = address.rstrip("/")
+
+    def lookup(self, keys: List[dict],
+               partition: tuple = ()) -> List[Optional[dict]]:
+        req = urllib.request.Request(
+            f"{self.address}/lookup",
+            data=json.dumps({"keys": keys,
+                             "partition": list(partition)}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = json.loads(resp.read())
+        return payload["rows"]
+
+    def lookup_row(self, key: dict,
+                   partition: tuple = ()) -> Optional[dict]:
+        return self.lookup([key], partition)[0]

@@ -61,11 +61,11 @@ def analyze_table(table, columns: Optional[List[str]] = None
     rb = table.new_read_builder()
     plan = rb.new_scan().plan(snapshot_id=snapshot.id)
     data = rb.new_read().to_arrow(plan)
-    col_stats = {}
     names = columns or [f.name for f in table.schema.fields]
-    for name in names:
-        if name in data.column_names:
-            col_stats[name] = _col_stats(data.column(name))
+    unknown = [n for n in names if n not in data.column_names]
+    if unknown:
+        raise ValueError(f"Unknown columns for ANALYZE: {unknown}")
+    col_stats = {name: _col_stats(data.column(name)) for name in names}
     stats = {
         "snapshotId": snapshot.id,
         "schemaId": table.schema.id,
