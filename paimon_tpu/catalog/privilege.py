@@ -65,7 +65,19 @@ class PrivilegeManager:
         atomic)."""
         import time
         lock = self.path + ".lock"
-        for _ in range(200):
+        for attempt in range(200):
+            if attempt and attempt % 50 == 0:
+                # stale-lock takeover: a crashed holder must not brick
+                # privilege mutations forever
+                try:
+                    st = [x for x in self.file_io.list_status(
+                              self.path.rsplit("/", 1)[0])
+                          if x.path == lock]
+                    if st and st[0].mtime_ms and \
+                            st[0].mtime_ms < (time.time() - 10) * 1000:
+                        self.file_io.delete_quietly(lock)
+                except Exception:
+                    pass
             if self.file_io.try_to_write_atomic(lock, b"1"):
                 try:
                     state = self._require()

@@ -120,7 +120,9 @@ class RESTCatalogServer:
             def _route(self, method: str):
                 if not self._authorized():
                     return self._error(401, "Unauthorized", "bad token")
-                parts = [p for p in self.path.split("/") if p]
+                from urllib.parse import urlparse
+                parts = [p for p in urlparse(self.path).path.split("/")
+                         if p]
                 # /v1/{prefix}/databases[/{db}[/tables[/{table}]]]
                 if len(parts) < 3 or parts[0] != "v1" or \
                         parts[1] != server.prefix or \
@@ -146,7 +148,11 @@ class RESTCatalogServer:
                                 "properties":
                                     cat.load_database_properties(db)})
                         if method == "DELETE":
-                            cat.drop_database(db, cascade=True)
+                            from urllib.parse import parse_qs, urlparse
+                            q = parse_qs(urlparse(self.path).query)
+                            cascade = q.get("cascade",
+                                            ["false"])[0] == "true"
+                            cat.drop_database(db, cascade=cascade)
                             return self._reply(200, {})
                     if len(parts) >= 5 and parts[4] == "tables":
                         if len(parts) == 5:
@@ -254,7 +260,8 @@ class RESTCatalogClient(Catalog):
     def drop_database(self, name: str, ignore_if_not_exists: bool = False,
                       cascade: bool = False):
         try:
-            self._request("DELETE", f"databases/{name}")
+            flag = "true" if cascade else "false"
+            self._request("DELETE", f"databases/{name}?cascade={flag}")
         except DatabaseNotFoundError:
             if not ignore_if_not_exists:
                 raise
@@ -265,15 +272,19 @@ class RESTCatalogClient(Catalog):
 
     def create_table(self, identifier, schema: Schema,
                      ignore_if_exists: bool = False):
-        i = self._ident(identifier)
+        from paimon_tpu.table.table import FileStoreTable
+
+        i = self._no_branch(self._ident(identifier), "create")
         try:
-            self._request("POST", f"databases/{i.database}/tables",
-                          {"name": i.table,
-                           "schema": _schema_to_json(schema)})
+            resp = self._request("POST",
+                                 f"databases/{i.database}/tables",
+                                 {"name": i.table,
+                                  "schema": _schema_to_json(schema)})
+            return FileStoreTable.load(resp["path"])
         except TableAlreadyExistsError:
             if not ignore_if_exists:
                 raise
-        return self.get_table(identifier)
+            return self.get_table(identifier)
 
     def get_table(self, identifier):
         from paimon_tpu.table.table import FileStoreTable

@@ -1,0 +1,135 @@
+"""Cross-partition upsert: primary keys that do NOT contain the
+partition keys.
+
+reference: crosspartition/GlobalIndexAssigner.java (RocksDB-backed
+key -> (partition, bucket); on partition change routes a -D to the old
+partition then the +I to the new one), IndexBootstrap.java (bootstrap
+the index from the table), KEY_DYNAMIC bucket mode.
+
+TPU-first shape: the global index bootstraps as ONE projected columnar
+scan (pk + partition columns) into a host dict keyed by pk tuples —
+IndexBootstrap as a single vectorized read instead of row-at-a-time
+RocksDB loads. Batches update the index with a dict pass proportional to
+the batch, not the table.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.types import RowKind
+
+__all__ = ["CrossPartitionUpsertWrite"]
+
+
+class CrossPartitionUpsertWrite:
+    """Wraps the (dynamic-bucket) KeyValueFileStoreWrite: incoming rows
+    whose key already lives in another partition first retract the old
+    row (reference ExistingProcessor#DELETE semantics)."""
+
+    def __init__(self, inner, table):
+        self.inner = inner
+        self.table = table
+        self.pk = table.schema.trimmed_primary_keys()
+        self.partition_keys = table.schema.partition_keys
+        self._index: Optional[Dict[Tuple, Tuple]] = None
+
+    # -- bootstrap (reference IndexBootstrap) --------------------------------
+
+    def _bootstrap(self) -> Dict[Tuple, Tuple]:
+        if self._index is not None:
+            return self._index
+        index: Dict[Tuple, Tuple] = {}
+        snapshot = self.table.snapshot_manager.latest_snapshot()
+        if snapshot is not None:
+            cols = list(dict.fromkeys(self.pk + self.partition_keys))
+            data = self.table.to_arrow(projection=cols)
+            pk_cols = [data.column(k).to_pylist() for k in self.pk]
+            part_cols = [data.column(k).to_pylist()
+                         for k in self.partition_keys]
+            for i in range(data.num_rows):
+                key = tuple(c[i] for c in pk_cols)
+                index[key] = tuple(c[i] for c in part_cols)
+        self._index = index
+        return index
+
+    # -- writes --------------------------------------------------------------
+
+    def write_arrow(self, table: pa.Table,
+                    row_kinds: Optional[np.ndarray] = None):
+        from paimon_tpu.core.write import ROW_KIND_COL
+
+        if ROW_KIND_COL in table.column_names:
+            row_kinds = np.asarray(table.column(ROW_KIND_COL)
+                                   .combine_chunks().cast(pa.int8()))
+            table = table.drop_columns([ROW_KIND_COL])
+        if row_kinds is None:
+            row_kinds = np.zeros(table.num_rows, dtype=np.int8)
+        row_kinds = np.asarray(row_kinds, dtype=np.int8)
+
+        index = self._bootstrap()
+        n = table.num_rows
+        pk_cols = [table.column(k).to_pylist() for k in self.pk]
+        part_cols = [table.column(k).to_pylist()
+                     for k in self.partition_keys]
+
+        drop = np.zeros(n, dtype=bool)   # superseded within this batch
+        # key -> (i, part, was_insert)
+        batch_last: Dict[Tuple, Tuple[int, Tuple, bool]] = {}
+        retracts: Dict[Tuple, Tuple[int, Tuple]] = {}    # key -> (i, old)
+        for i in range(n):
+            key = tuple(c[i] for c in pk_cols)
+            new_part = tuple(c[i] for c in part_cols)
+            kind = int(row_kinds[i])
+            prev = batch_last.get(key)
+            if prev is not None and prev[1] != new_part and prev[2]:
+                # an earlier in-batch INSERT moved partitions before any
+                # flush: it never materializes. Earlier RETRACTS must
+                # still be written — they delete persisted rows.
+                drop[prev[0]] = True
+            persisted_old = index.get(key)
+            if kind in (RowKind.DELETE, RowKind.UPDATE_BEFORE):
+                # a retract routes to wherever the key actually lives
+                if persisted_old is not None and \
+                        persisted_old != new_part and key not in retracts:
+                    retracts[key] = (i, persisted_old)
+                    drop[i] = True       # rerouted copy replaces it
+                index.pop(key, None)
+                batch_last[key] = (i, new_part, False)
+                continue
+            if persisted_old is not None and persisted_old != new_part \
+                    and key not in retracts:
+                retracts[key] = (i, persisted_old)
+            index[key] = new_part
+            batch_last[key] = (i, new_part, True)
+
+        if retracts:
+            items = list(retracts.values())
+            idx = [i for i, _ in items]
+            old = table.take(pa.array(idx))
+            # rewrite the partition columns to the OLD partition so the
+            # delete routes there (keep the original FIELD incl. the
+            # non-null flag so buffered batches concat)
+            for ci, kname in enumerate(self.partition_keys):
+                vals = [p[ci] for _, p in items]
+                col = pa.array(vals, old.column(kname).type)
+                old = old.set_column(old.column_names.index(kname),
+                                     old.schema.field(kname), col)
+            self.inner.write_arrow(
+                old, np.full(old.num_rows, RowKind.DELETE, np.int8))
+
+        keep = ~drop
+        if not keep.all():
+            table = table.filter(pa.array(keep))
+            row_kinds = row_kinds[keep]
+        if table.num_rows:
+            self.inner.write_arrow(table, row_kinds)
+
+    def prepare_commit(self):
+        return self.inner.prepare_commit()
+
+    def close(self):
+        self.inner.close()

@@ -74,6 +74,7 @@ class CachingFileIO(FileIO):
                     self.hits += 1
                     return data[offset:offset + length]
         # not cached: delegate the range — never force a full-object GET
+        self.misses += 1
         return self.inner.read_range(path, offset, length)
 
     # -- invalidating mutations ---------------------------------------------

@@ -43,15 +43,18 @@ class Schema:
         for k in self.primary_keys:
             if k not in names:
                 raise ValueError(f"Primary key {k!r} not in fields {names}")
-        # Primary keys must contain all partition keys
-        # (reference schema/SchemaValidation.java)
+        # Primary keys must contain all partition keys UNLESS the table
+        # runs in cross-partition upsert mode (dynamic bucket, bucket=-1:
+        # reference schema/SchemaValidation.java + BucketMode.KEY_DYNAMIC)
         if self.primary_keys:
             missing = [p for p in self.partition_keys
                        if p not in self.primary_keys]
-            if missing:
+            dynamic_bucket = int(self.options.get("bucket", "-1")) == -1
+            if missing and not dynamic_bucket:
                 raise ValueError(
                     f"Primary key must include all partition fields, "
-                    f"missing {missing}")
+                    f"missing {missing} (or use dynamic bucket=-1 for "
+                    f"cross-partition upsert)")
 
     def row_type(self) -> RowType:
         return RowType(self.fields, nullable=False)

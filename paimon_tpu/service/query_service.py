@@ -16,6 +16,52 @@ from typing import List, Optional
 
 from paimon_tpu.lookup import LocalTableQuery
 
+
+def _encode_value(v):
+    """JSON-safe encoding preserving types across the wire (datetime/
+    date/time -> tagged ISO, Decimal -> tagged str, bytes -> tagged
+    base64) so remote lookups return the same values as local ones."""
+    import base64
+    import datetime
+    import decimal
+    if isinstance(v, datetime.datetime):
+        return {"__t": "dt", "v": v.isoformat()}
+    if isinstance(v, datetime.date):
+        return {"__t": "d", "v": v.isoformat()}
+    if isinstance(v, datetime.time):
+        return {"__t": "t", "v": v.isoformat()}
+    if isinstance(v, decimal.Decimal):
+        return {"__t": "dec", "v": str(v)}
+    if isinstance(v, (bytes, bytearray)):
+        return {"__t": "b", "v": base64.b64encode(v).decode()}
+    if isinstance(v, list):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    return v
+
+
+def _decode_value(v):
+    import base64
+    import datetime
+    import decimal
+    if isinstance(v, dict):
+        tag = v.get("__t")
+        if tag == "dt":
+            return datetime.datetime.fromisoformat(v["v"])
+        if tag == "d":
+            return datetime.date.fromisoformat(v["v"])
+        if tag == "t":
+            return datetime.time.fromisoformat(v["v"])
+        if tag == "dec":
+            return decimal.Decimal(v["v"])
+        if tag == "b":
+            return base64.b64decode(v["v"])
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
 __all__ = ["KvQueryServer", "KvQueryClient", "ServiceManager"]
 
 PRIMARY_KEY_LOOKUP = "primary-key-lookup"
@@ -85,8 +131,11 @@ class KvQueryServer:
                     rows = server.query.lookup(
                         req["keys"],
                         partition=tuple(req.get("partition") or ()))
-                    body = json.dumps({"rows": rows},
-                                      default=str).encode()
+                    body = json.dumps(
+                        {"rows": [None if r is None else
+                                  {k: _encode_value(x)
+                                   for k, x in r.items()}
+                                  for r in rows]}).encode()
                     self.send_response(200)
                 except Exception as e:      # noqa: BLE001
                     body = json.dumps({"error": str(e)}).encode()
@@ -126,7 +175,9 @@ class KvQueryClient:
         req.add_header("Content-Type", "application/json")
         with urllib.request.urlopen(req, timeout=30) as resp:
             payload = json.loads(resp.read())
-        return payload["rows"]
+        return [None if r is None else
+                {k: _decode_value(v) for k, v in r.items()}
+                for r in payload["rows"]]
 
     def lookup_row(self, key: dict,
                    partition: tuple = ()) -> Optional[dict]:

@@ -297,6 +297,14 @@ class TableWrite:
             self._write = KeyValueFileStoreWrite(
                 table.file_io, table.path, table.schema, table.options,
                 restore_max_seq=restore, branch=table.branch)
+            if table.schema.cross_partition_update():
+                # pk does not cover the partition keys: route partition
+                # changes as -D old + +I new via the global index
+                # (reference crosspartition/GlobalIndexAssigner)
+                from paimon_tpu.core.cross_partition import (
+                    CrossPartitionUpsertWrite,
+                )
+                self._write = CrossPartitionUpsertWrite(self._write, table)
         else:
             from paimon_tpu.core.append import AppendOnlyFileStoreWrite
             self._write = AppendOnlyFileStoreWrite(

@@ -100,3 +100,14 @@ def test_kv_query_service(tmp_path):
     # address unregistered on stop
     with pytest.raises(RuntimeError):
         KvQueryClient(table)
+
+
+def test_rest_drop_database_cascade_guard(served):
+    cat = paimon_tpu.create_catalog(
+        {"metastore": "rest", "uri": served.uri, "token": "s3cr3t"})
+    cat.create_database("db")
+    cat.create_table("db.t", _schema())
+    with pytest.raises(RuntimeError):
+        cat.drop_database("db")          # non-empty, cascade=False
+    cat.drop_database("db", cascade=True)
+    assert cat.list_databases() == []
