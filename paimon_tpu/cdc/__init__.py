@@ -1,0 +1,12 @@
+"""CDC ingestion: change-event parsing + schema-evolving sink.
+
+reference: paimon-flink-cdc (action/cdc/: mysql/postgres/kafka sync
+actions; format/: debezium, canal, maxwell parsers; sink/cdc/:
+CdcRecordStoreMultiWriteOperator applying schema changes through
+SchemaManager before writing).
+"""
+
+from paimon_tpu.cdc.sink import CdcSinkWriter  # noqa: F401
+from paimon_tpu.cdc.formats import (  # noqa: F401
+    parse_canal, parse_debezium, parse_maxwell,
+)

@@ -1,0 +1,77 @@
+"""CDC event format parsers -> (row dict, RowKind) changes.
+
+reference: paimon-flink-cdc format/ parsers (DebeziumRecordParser,
+CanalRecordParser, MaxwellRecordParser). Each parser yields zero or more
+(row, kind) pairs per event; updates expand to -U/+U pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from paimon_tpu.types import RowKind
+
+__all__ = ["parse_debezium", "parse_canal", "parse_maxwell"]
+
+Change = Tuple[Dict, int]
+
+
+def parse_debezium(event: dict) -> List[Change]:
+    """Debezium envelope: {op: c|r|u|d, before: {...}, after: {...}}
+    (payload unwrapping handled)."""
+    payload = event.get("payload", event)
+    op = payload.get("op")
+    before = payload.get("before")
+    after = payload.get("after")
+    if op in ("c", "r"):
+        return [(after, RowKind.INSERT)] if after else []
+    if op == "u":
+        out: List[Change] = []
+        if before:
+            out.append((before, RowKind.UPDATE_BEFORE))
+        if after:
+            out.append((after, RowKind.UPDATE_AFTER))
+        return out
+    if op == "d":
+        return [(before, RowKind.DELETE)] if before else []
+    raise ValueError(f"Unknown debezium op {op!r}")
+
+
+def parse_canal(event: dict) -> List[Change]:
+    """Canal JSON: {type: INSERT|UPDATE|DELETE, data: [...], old: [...]}."""
+    etype = (event.get("type") or "").upper()
+    data = event.get("data") or []
+    old = event.get("old") or []
+    out: List[Change] = []
+    if etype == "INSERT":
+        out.extend((row, RowKind.INSERT) for row in data)
+    elif etype == "DELETE":
+        out.extend((row, RowKind.DELETE) for row in data)
+    elif etype == "UPDATE":
+        for i, row in enumerate(data):
+            if i < len(old) and old[i]:
+                merged = dict(row)
+                merged.update(old[i])
+                out.append((merged, RowKind.UPDATE_BEFORE))
+            out.append((row, RowKind.UPDATE_AFTER))
+    else:
+        raise ValueError(f"Unknown canal type {etype!r}")
+    return out
+
+
+def parse_maxwell(event: dict) -> List[Change]:
+    """Maxwell JSON: {type: insert|update|delete, data: {...},
+    old: {...}}."""
+    etype = (event.get("type") or "").lower()
+    data = event.get("data") or {}
+    old = event.get("old") or {}
+    if etype == "insert" or etype == "bootstrap-insert":
+        return [(data, RowKind.INSERT)]
+    if etype == "delete":
+        return [(data, RowKind.DELETE)]
+    if etype == "update":
+        before = dict(data)
+        before.update(old)
+        return [(before, RowKind.UPDATE_BEFORE),
+                (data, RowKind.UPDATE_AFTER)]
+    raise ValueError(f"Unknown maxwell type {etype!r}")

@@ -1,0 +1,136 @@
+"""Schema-evolving CDC sink.
+
+reference: paimon-flink-cdc sink/cdc/CdcRecordStoreMultiWriteOperator +
+UpdatedDataFieldsProcessFunction: unseen columns trigger ADD COLUMN
+through the SchemaManager (optimistic-lock DDL), then the writer reloads
+the evolved schema and writes the batch with proper row kinds.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.cdc.formats import (
+    parse_canal, parse_debezium, parse_maxwell,
+)
+from paimon_tpu.schema.schema_manager import SchemaChange
+from paimon_tpu.table.table import FileStoreTable
+from paimon_tpu.types import (
+    BigIntType, BooleanType, DataType, DoubleType, TimestampType,
+    VarCharType,
+)
+
+__all__ = ["CdcSinkWriter"]
+
+_PARSERS: Dict[str, Callable] = {
+    "debezium": parse_debezium,
+    "canal": parse_canal,
+    "maxwell": parse_maxwell,
+}
+
+
+def _infer_type(values: List) -> DataType:
+    """Conservative type inference for a new CDC column (reference
+    TypeMapping: unknown -> STRING)."""
+    non_null = [v for v in values if v is not None]
+    if not non_null:
+        return VarCharType()
+    if all(isinstance(v, bool) for v in non_null):
+        return BooleanType()
+    if all(isinstance(v, int) and not isinstance(v, bool)
+           for v in non_null):
+        return BigIntType()
+    if all(isinstance(v, (int, float, decimal.Decimal))
+           and not isinstance(v, bool) for v in non_null):
+        return DoubleType()
+    if all(isinstance(v, datetime.datetime) for v in non_null):
+        return TimestampType()
+    return VarCharType()
+
+
+class CdcSinkWriter:
+    """Parses CDC events, evolves the schema for unseen columns and
+    writes through the normal table write path."""
+
+    def __init__(self, table: FileStoreTable, format: str = "debezium",
+                 commit_user: Optional[str] = None):
+        if format not in _PARSERS:
+            raise ValueError(f"Unknown CDC format {format!r}; "
+                             f"available: {sorted(_PARSERS)}")
+        self._parse = _PARSERS[format]
+        self.table = table
+        self.commit_user = commit_user or "cdc"
+        self._writer = None
+        self._pending_msgs = []
+
+    def _ensure_schema(self, rows: List[Dict]):
+        """ADD COLUMN for keys the table does not know yet."""
+        known = {f.name for f in self.table.schema.fields}
+        unseen: Dict[str, List] = {}
+        for row in rows:
+            for k, v in row.items():
+                if k not in known:
+                    unseen.setdefault(k, []).append(v)
+        if not unseen:
+            return
+        changes = [SchemaChange.add_column(name, _infer_type(vals))
+                   for name, vals in unseen.items()]
+        if self._writer is not None:
+            # the old writer may hold buffered, uncommitted rows: turn
+            # them into pending commit messages before discarding it
+            self._pending_msgs.extend(self._writer.prepare_commit())
+            self._writer.close()
+            self._writer = None
+        self.table.schema_manager.commit_changes(*changes)
+        dynamic = dict(self.table.schema.options)
+        if self.table.branch != "main":
+            dynamic["branch"] = self.table.branch
+        self.table = FileStoreTable.load(
+            self.table.path, file_io=self.table.file_io,
+            dynamic_options=dynamic)
+
+    def write_events(self, events: List[dict]):
+        changes = []
+        for event in events:
+            changes.extend(self._parse(event))
+        if not changes:
+            return
+        rows = [c[0] for c in changes]
+        kinds = np.array([c[1] for c in changes], dtype=np.int8)
+        self._ensure_schema(rows)
+        if self._writer is None:
+            wb = self.table.new_stream_write_builder() \
+                .with_commit_user(self.commit_user)
+            self._wb = wb
+            self._writer = wb.new_write()
+        schema = self.table.arrow_schema()
+        normalized = [{f.name: row.get(f.name) for f in schema}
+                      for row in rows]
+        batch = pa.Table.from_pylist(normalized, schema=schema)
+        self._writer.write_arrow(batch, kinds)
+
+    def commit(self, commit_identifier: int) -> Optional[int]:
+        if self._writer is None and not self._pending_msgs:
+            return None
+        if self._writer is None:
+            wb = self.table.new_stream_write_builder() \
+                .with_commit_user(self.commit_user)
+            self._wb = wb
+        commit = self._wb.new_commit()
+        msgs = list(self._pending_msgs)
+        self._pending_msgs = []
+        if self._writer is not None:
+            msgs.extend(self._writer.prepare_commit())
+        if not commit.filter_committed([commit_identifier]):
+            return None          # replayed checkpoint: exactly-once
+        return commit.commit(msgs, commit_identifier=commit_identifier)
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

@@ -273,6 +273,10 @@ class FileStoreScan:
         pruning for pk tables happens at bucket granularity in
         generate_splits (reference applies value filters per
         non-overlapping section for the same reason)."""
+        if e.bucket == -2 and (self._bucket_filter is None
+                               or -2 not in self._bucket_filter):
+            # postpone staging data is invisible until rescaled
+            return False
         if self._bucket_filter is not None and \
                 e.bucket not in self._bucket_filter:
             return False

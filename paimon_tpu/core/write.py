@@ -207,7 +207,13 @@ class KeyValueFileStoreWrite:
         self.total_buckets = options.bucket
         bucket_keys = table_schema.bucket_keys()
         self._dynamic = None
-        if options.bucket < 1:
+        self._postpone = options.bucket == -2
+        if self._postpone:
+            # postpone mode (reference postpone/PostponeBucketFileStoreWrite):
+            # rows stage un-hashed under bucket-postpone; rescale_postpone
+            # redistributes them later
+            self.bucket_assigner = None
+        elif options.bucket < 1:
             # dynamic bucket mode (reference BucketMode.HASH_DYNAMIC)
             from paimon_tpu.core.bucket import KeyHasher
             from paimon_tpu.core.dynamic_bucket import DynamicBucketAssigner
@@ -265,6 +271,13 @@ class KeyValueFileStoreWrite:
             row_kinds = np.zeros(table.num_rows, dtype=np.int8)
         row_kinds = np.asarray(row_kinds, dtype=np.int8)
 
+        if self._postpone:
+            buckets = np.full(table.num_rows, -2, dtype=np.int32)
+            for (part, bucket), idx in group_by_partition_bucket(
+                    table, buckets, self.partition_keys):
+                sub = table.take(pa.array(idx))
+                self._writer(part, bucket).write(sub, row_kinds[idx])
+            return
         if self._dynamic is not None:
             # partition-first grouping: bucket assignment depends on the
             # partition's hash index

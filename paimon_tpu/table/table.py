@@ -142,6 +142,12 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
+    def rescale_postpone(self) -> Optional[int]:
+        """Move bucket-postpone staging data into real buckets (reference
+        postpone/ rescale job; bucket=-2 tables)."""
+        from paimon_tpu.compact.compact_action import rescale_postpone
+        return rescale_postpone(self)
+
     def sort_compact(self, order_by: List[str],
                      strategy: str = "zorder") -> Optional[int]:
         """Cluster an append table by z-order or lexicographic order

@@ -73,6 +73,10 @@ class FileStorePathFactory:
     def bucket_dir(self, partition: Sequence[Any], bucket: int) -> str:
         pp = self.partition_path(partition)
         base = f"{self.table_path}/{pp}" if pp else self.table_path
+        if bucket == -2:
+            # postpone mode (reference BucketMode.POSTPONE_MODE):
+            # un-hashed staging dir, rescaled into real buckets later
+            return f"{base}/bucket-postpone"
         return f"{base}/bucket-{bucket}"
 
     def data_file_path(self, partition: Sequence[Any], bucket: int,
