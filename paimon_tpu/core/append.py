@@ -80,6 +80,14 @@ class AppendFileWriter:
         fmt = get_format(self.file_format)
         name = self.path_factory.new_data_file_name(fmt.extension)
         path = self.path_factory.data_file_path(partition, bucket, name)
+        from paimon_tpu.format.blob import blob_column_names
+        blob_cols = blob_column_names(self.schema)
+        blob_extras: List[str] = []
+        if blob_cols:
+            from paimon_tpu.format.blob import externalize_blobs
+            chunk, blob_extras = externalize_blobs(
+                self.file_io, self.path_factory, partition, bucket, name,
+                chunk, blob_cols)
         size = fmt.create_writer(self.compression).write(
             self.file_io, path, chunk)
         value_cols = [f.name for f in self.schema.fields]
@@ -110,7 +118,7 @@ class AppendFileWriter:
             level=0,
             file_source=file_source,
             embedded_index=embedded_index,
-            extra_files=extra_files,
+            extra_files=extra_files + blob_extras,
         )
 
 
@@ -269,11 +277,18 @@ class AppendSplitRead:
         from paimon_tpu.core.kv_file import read_kv_file
         from paimon_tpu.core.read import ROW_KIND_COL as RK
 
+        from paimon_tpu.format.blob import maybe_resolve_blobs
+        wanted = set(self._value_columns())
         tables = []
         for meta in sorted(split.data_files,
                            key=lambda f: f.min_sequence_number):
             t = read_kv_file(self.file_io, self.path_factory,
                              split.partition, split.bucket, meta, None, None)
+            t = maybe_resolve_blobs(self.file_io, self.path_factory,
+                                    split.partition, split.bucket, meta,
+                                    t, self.schema,
+                                    schema_manager=self.schema_manager,
+                                    wanted=wanted)
             t = self._evolve(t, meta.schema_id)
             if split.deletion_vectors and \
                     meta.file_name in split.deletion_vectors:

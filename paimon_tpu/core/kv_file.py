@@ -76,6 +76,14 @@ class KeyValueFileWriter:
         fmt = get_format(self.file_format)
         name = self.path_factory.new_data_file_name(fmt.extension)
         path = self.path_factory.data_file_path(partition, bucket, name)
+        from paimon_tpu.format.blob import blob_column_names
+        blob_cols = blob_column_names(self.schema)
+        blob_extras: List[str] = []
+        if blob_cols:
+            from paimon_tpu.format.blob import externalize_blobs
+            chunk, blob_extras = externalize_blobs(
+                self.file_io, self.path_factory, partition, bucket, name,
+                chunk, blob_cols)
         size = fmt.create_writer(self.compression).write(
             self.file_io, path, chunk)
 
@@ -125,7 +133,7 @@ class KeyValueFileWriter:
             delete_row_count=delete_rows,
             file_source=file_source,
             embedded_index=embedded_index,
-            extra_files=extra_files,
+            extra_files=extra_files + blob_extras,
         )
 
 

@@ -202,6 +202,11 @@ class MergeFileSplitRead:
         table = read_kv_file(
             self.file_io, self.path_factory, split.partition, split.bucket,
             meta, file_format=None, projection=None)
+        from paimon_tpu.format.blob import maybe_resolve_blobs
+        table = maybe_resolve_blobs(
+            self.file_io, self.path_factory, split.partition,
+            split.bucket, meta, table, self.schema,
+            schema_manager=self.schema_manager, wanted=set(read_cols))
         table = self._evolve(table, meta.schema_id)
         if split.deletion_vectors and \
                 meta.file_name in split.deletion_vectors:
