@@ -54,16 +54,12 @@ def _read_bucket(table, path_factory, partition, bucket, files,
     from paimon_tpu.core.kv_file import read_kv_file
     from paimon_tpu.core.read import evolve_table
 
-    from paimon_tpu.format.blob import maybe_resolve_blobs
-
     cache = {table.schema.id: table.schema}
     tables = []
     for f in sorted(files, key=lambda x: x.min_sequence_number):
         t = read_kv_file(table.file_io, path_factory, partition, bucket,
-                         f, None, None)
-        t = maybe_resolve_blobs(table.file_io, path_factory, partition,
-                                bucket, f, t, table.schema,
-                                schema_manager=table.schema_manager)
+                         f, None, None, schema=table.schema,
+                         schema_manager=table.schema_manager)
         if dvs and f.file_name in dvs:
             t = t.filter(pa.array(dvs[f.file_name].keep_mask(t.num_rows)))
         tables.append(evolve_table(t, f.schema_id, table.schema,
@@ -152,14 +148,10 @@ def rescale_postpone(table) -> Optional[int]:
         partition = scan._partition_codec.from_bytes(pbytes)
         es.sort(key=lambda e: e.file.min_sequence_number)
         tables = []
-        from paimon_tpu.format.blob import maybe_resolve_blobs
         for e in es:
             t = read_kv_file(table.file_io, scan.path_factory, partition,
-                             -2, e.file, None, None)
-            t = maybe_resolve_blobs(table.file_io, scan.path_factory,
-                                    partition, -2, e.file, t,
-                                    table.schema,
-                                    schema_manager=table.schema_manager)
+                             -2, e.file, None, None, schema=table.schema,
+                             schema_manager=table.schema_manager)
             tables.append(evolve_table(t, e.file.schema_id, table.schema,
                                        table.schema_manager, cache,
                                        keep_sys_cols=True))

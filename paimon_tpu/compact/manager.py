@@ -165,29 +165,27 @@ class MergeTreeCompactManager:
         runs_meta = assemble_runs(files)
 
         from paimon_tpu.format.blob import blob_column_names
+        has_blobs = bool(blob_column_names(self.schema))
 
         def run_iter(run_files):
             for f in run_files:
-                ext = f.file_name.rsplit(".", 1)[-1]
-                fmt = get_format(ext)
-                path = f.external_path or self.path_factory.data_file_path(
-                    self.partition, self.bucket, f.file_name)
-                if blob_column_names(self.schema):
+                if has_blobs:
                     # blob descriptors must resolve against the whole
                     # sidecar: read this file unstreamed (bounded by
                     # target-file-size), still windowed downstream
-                    from paimon_tpu.format.blob import maybe_resolve_blobs
                     t = read_kv_file(self.file_io, self.path_factory,
-                                     self.partition, self.bucket, f)
-                    t = maybe_resolve_blobs(
-                        self.file_io, self.path_factory, self.partition,
-                        self.bucket, f, t, self.schema,
-                        schema_manager=self.schema_manager)
+                                     self.partition, self.bucket, f,
+                                     schema=self.schema,
+                                     schema_manager=self.schema_manager)
                     yield evolve_table(t, f.schema_id, self.schema,
                                        self.schema_manager,
                                        self._schema_cache,
                                        keep_sys_cols=True)
                     continue
+                ext = f.file_name.rsplit(".", 1)[-1]
+                fmt = get_format(ext)
+                path = f.external_path or self.path_factory.data_file_path(
+                    self.partition, self.bucket, f.file_name)
                 for batch in fmt.create_reader().read_batches(
                         self.file_io, path, batch_rows=chunk_rows):
                     yield evolve_table(batch, f.schema_id, self.schema,
@@ -285,13 +283,9 @@ class MergeTreeCompactManager:
         cached = self._file_cache.get(f.file_name)
         if cached is not None:
             return cached
-        from paimon_tpu.format.blob import maybe_resolve_blobs
         raw = read_kv_file(self.file_io, self.path_factory, self.partition,
-                           self.bucket, f)
-        raw = maybe_resolve_blobs(self.file_io, self.path_factory,
-                                  self.partition, self.bucket, f, raw,
-                                  self.schema,
-                                  schema_manager=self.schema_manager)
+                           self.bucket, f, schema=self.schema,
+                           schema_manager=self.schema_manager)
         t = evolve_table(raw, f.schema_id, self.schema,
                          self.schema_manager, self._schema_cache,
                          keep_sys_cols=True)

@@ -277,18 +277,15 @@ class AppendSplitRead:
         from paimon_tpu.core.kv_file import read_kv_file
         from paimon_tpu.core.read import ROW_KIND_COL as RK
 
-        from paimon_tpu.format.blob import maybe_resolve_blobs
         wanted = set(self._value_columns())
         tables = []
         for meta in sorted(split.data_files,
                            key=lambda f: f.min_sequence_number):
             t = read_kv_file(self.file_io, self.path_factory,
-                             split.partition, split.bucket, meta, None, None)
-            t = maybe_resolve_blobs(self.file_io, self.path_factory,
-                                    split.partition, split.bucket, meta,
-                                    t, self.schema,
-                                    schema_manager=self.schema_manager,
-                                    wanted=wanted)
+                             split.partition, split.bucket, meta, None,
+                             None, schema=self.schema,
+                             schema_manager=self.schema_manager,
+                             wanted=wanted)
             t = self._evolve(t, meta.schema_id)
             if split.deletion_vectors and \
                     meta.file_name in split.deletion_vectors:

@@ -183,11 +183,22 @@ def write_changelog_file(file_io: FileIO,
 def read_kv_file(file_io: FileIO, path_factory: FileStorePathFactory,
                  partition: Tuple, bucket: int, meta: DataFileMeta,
                  file_format: Optional[str] = None,
-                 projection: Optional[List[str]] = None) -> pa.Table:
-    """Read one KV data file into Arrow."""
+                 projection: Optional[List[str]] = None,
+                 schema=None, schema_manager=None,
+                 wanted=None) -> pa.Table:
+    """Read one KV data file into Arrow. When `schema` is given, blob
+    descriptor columns resolve against their .blob sidecars here — every
+    reader is blob-safe by construction."""
     ext = meta.file_name.rsplit(".", 1)[-1]
     fmt = get_format(file_format or ext)
     path = path_factory.data_file_path(partition, bucket, meta.file_name)
     if meta.external_path:
         path = meta.external_path
-    return fmt.create_reader().read(file_io, path, projection=projection)
+    table = fmt.create_reader().read(file_io, path, projection=projection)
+    if schema is not None:
+        from paimon_tpu.format.blob import maybe_resolve_blobs
+        table = maybe_resolve_blobs(file_io, path_factory, partition,
+                                    bucket, meta, table, schema,
+                                    schema_manager=schema_manager,
+                                    wanted=wanted)
+    return table

@@ -201,11 +201,7 @@ class MergeFileSplitRead:
                    read_cols: List[str]) -> pa.Table:
         table = read_kv_file(
             self.file_io, self.path_factory, split.partition, split.bucket,
-            meta, file_format=None, projection=None)
-        from paimon_tpu.format.blob import maybe_resolve_blobs
-        table = maybe_resolve_blobs(
-            self.file_io, self.path_factory, split.partition,
-            split.bucket, meta, table, self.schema,
+            meta, file_format=None, projection=None, schema=self.schema,
             schema_manager=self.schema_manager, wanted=set(read_cols))
         table = self._evolve(table, meta.schema_id)
         if split.deletion_vectors and \

@@ -46,31 +46,36 @@ def externalize_blobs(file_io, path_factory, partition, bucket,
     cols = [c for c in blob_columns if c in chunk.column_names]
     if not cols:
         return chunk, []
-    payload = bytearray()
+    payload_parts: List[bytes] = []
+    payload_len = 0
     out = chunk
     for name in cols:
-        arr = out.column(name).combine_chunks()
-        offsets, lengths = [], []
-        for v in arr.to_pylist():
-            if v is None:
-                offsets.append(None)
-                lengths.append(None)
-                continue
-            b = v if isinstance(v, (bytes, bytearray)) else bytes(v)
-            offsets.append(len(payload))
-            lengths.append(len(b))
-            payload.extend(b)
+        arr = out.column(name).combine_chunks().cast(pa.large_binary())
+        # zero-copy: arrow binary arrays already hold a contiguous value
+        # buffer + offsets; slice buffers instead of per-row pylists
+        buf_offsets = np.frombuffer(arr.buffers()[1], dtype=np.int64,
+                                    count=len(arr) + 1, offset=0)
+        data_buf = arr.buffers()[2]
+        raw = bytes(data_buf) if data_buf is not None else b""
+        null_mask = np.asarray(arr.is_null())
+        lengths = (buf_offsets[1:] - buf_offsets[:-1]).astype(np.int64)
+        starts = buf_offsets[:-1] + 0
+        offsets_out = starts + payload_len
+        payload_parts.append(raw)
+        payload_len += len(raw)
         desc = pa.StructArray.from_arrays(
-            [pa.array(offsets, pa.int64()), pa.array(lengths, pa.int64())],
+            [pa.array(offsets_out, pa.int64()),
+             pa.array(lengths, pa.int64())],
             fields=list(DESCRIPTOR_TYPE),
-            mask=pa.array([o is None for o in offsets]))
+            mask=pa.array(null_mask))
         out = out.set_column(out.column_names.index(name), name, desc)
+    payload = b"".join(payload_parts)
     if not payload:
         return out, []
     sidecar = blob_sidecar_name(data_file_name)
     file_io.write_bytes(
         path_factory.data_file_path(partition, bucket, sidecar),
-        bytes(payload), overwrite=False)
+        payload, overwrite=False)
     return out, [sidecar]
 
 

@@ -101,7 +101,8 @@ def _delete_append_dv_once(table, predicate) -> Optional[int]:
         for meta in split.data_files:
             t = read_kv_file(table.file_io, scan.path_factory,
                              split.partition, split.bucket, meta, None,
-                             None)
+                             None, schema=table.schema,
+                             schema_manager=table.schema_manager)
             t = evolve_table(t, meta.schema_id, table.schema,
                              table.schema_manager, schema_cache)
             mask = _eval_predicate(predicate, t)

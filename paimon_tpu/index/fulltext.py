@@ -1,0 +1,78 @@
+"""Full-text search index.
+
+reference capability: paimon-full-text (native tantivy-like inverted
+indexer behind NativeFullTextGlobalIndexer.java) + paimon-eslib (Lucene
+analyzers). Here: an in-process inverted index with TF-IDF ranking —
+postings are numpy arrays, scoring one vectorized pass per query term.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+__all__ = ["FullTextIndex", "full_text_search"]
+
+_TOKEN = re.compile(r"[a-z0-9]+")
+
+
+def tokenize(text: str) -> List[str]:
+    return _TOKEN.findall(text.lower())
+
+
+class FullTextIndex:
+    """Inverted index over one text column: term -> (row ids, term
+    frequencies). Ranking: TF-IDF with length normalization."""
+
+    def __init__(self, texts: List[Optional[str]]):
+        self.n = len(texts)
+        postings: Dict[str, Dict[int, int]] = {}
+        self.doc_len = np.zeros(self.n, dtype=np.float32)
+        for i, t in enumerate(texts):
+            if not t:
+                continue
+            toks = tokenize(t)
+            self.doc_len[i] = len(toks)
+            for tok in toks:
+                d = postings.setdefault(tok, {})
+                d[i] = d.get(i, 0) + 1
+        self.postings: Dict[str, Tuple[np.ndarray, np.ndarray]] = {
+            term: (np.fromiter(d.keys(), dtype=np.int64, count=len(d)),
+                   np.fromiter(d.values(), dtype=np.float32,
+                               count=len(d)))
+            for term, d in postings.items()}
+
+    def search(self, query: str, k: int = 10
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (row_ids, scores) ranked best-first."""
+        scores = np.zeros(self.n, dtype=np.float32)
+        for term in tokenize(query):
+            p = self.postings.get(term)
+            if p is None:
+                continue
+            rows, tf = p
+            idf = math.log(1 + self.n / len(rows))
+            scores[rows] += tf * idf
+        norm = np.where(self.doc_len > 0, np.sqrt(self.doc_len), 1.0)
+        scores = scores / norm
+        hit = np.flatnonzero(scores > 0)
+        if len(hit) == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, np.float32))
+        order = hit[np.argsort(-scores[hit], kind="stable")][:k]
+        return order, scores[order]
+
+
+def full_text_search(table, column: str, query: str, k: int = 10,
+                     index: Optional[FullTextIndex] = None) -> pa.Table:
+    """Search a table's text column; returns the top-k rows with a
+    `_score` column (reference FullTextSearchTable /
+    FullTextSearchSplit)."""
+    data = table.to_arrow()
+    idx = index or FullTextIndex(data.column(column).to_pylist())
+    rows, scores = idx.search(query, k)
+    out = data.take(pa.array(rows))
+    return out.append_column("_score", pa.array(scores, pa.float32()))

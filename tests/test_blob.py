@@ -99,3 +99,19 @@ def test_blob_projection_skips_sidecar(tmp_warehouse):
     out = table.to_arrow(projection=["id"])
     assert out.column_names == ["id"]
     assert out.num_rows == 1
+
+
+def test_delete_where_on_blob_table(tmp_warehouse):
+    from paimon_tpu import predicate as P
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("payload", BlobType())
+              .build())                     # append table with DVs
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "dv"),
+                                  schema)
+    _commit(table, [{"id": i, "payload": bytes([i])} for i in range(5)])
+    assert table.delete_where(P.equal("id", 2)) is not None
+    rows = {r["id"]: r["payload"] for r in table.to_arrow().to_pylist()}
+    assert sorted(rows) == [0, 1, 3, 4]
+    assert rows[3] == bytes([3])

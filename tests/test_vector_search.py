@@ -78,3 +78,36 @@ def test_table_vector_search(tmp_warehouse):
     assert out.num_rows == 3
     assert out.column("id").to_pylist()[0] == 7     # itself first
     assert "_score" in out.column_names
+
+
+def test_full_text_search(tmp_warehouse):
+    from paimon_tpu.index.fulltext import FullTextIndex, full_text_search
+    from paimon_tpu.types import VarCharType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("body", VarCharType())
+              .primary_key("id")
+              .options({"bucket": "1"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "ft"),
+                                  schema)
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([
+        {"id": 1, "body": "the quick brown fox jumps over the lazy dog"},
+        {"id": 2, "body": "a fast auburn fox"},
+        {"id": 3, "body": "completely unrelated text about databases"},
+        {"id": 4, "body": None},
+    ])
+    wb.new_commit().commit(w.prepare_commit())
+
+    out = full_text_search(table, "body", "brown fox", k=3)
+    ids = out.column("id").to_pylist()
+    assert ids[0] == 1                     # both terms match
+    assert set(ids) == {1, 2}              # doc 3/4 never match
+    assert full_text_search(table, "body", "zebra").num_rows == 0
+
+    idx = FullTextIndex(["alpha beta", "beta beta gamma"])
+    rows, scores = idx.search("beta")
+    assert rows.tolist()[0] == 1           # higher tf ranks first
