@@ -1,0 +1,8 @@
+"""Iceberg compatibility: dual-write Iceberg metadata so Iceberg readers
+can open paimon-tpu tables.
+
+reference: paimon-core/.../iceberg/ (IcebergCommitCallback, metadata/
+IcebergMetadata JSON, manifest/ avro manifests) + paimon-iceberg module.
+"""
+
+from paimon_tpu.iceberg.metadata import sync_iceberg  # noqa: F401

@@ -161,6 +161,12 @@ class FileStoreTable:
         from paimon_tpu.table.system import load_system_table
         return load_system_table(self, name)
 
+    def sync_iceberg(self) -> Optional[str]:
+        """Export the current snapshot as Iceberg v2 metadata under
+        <table>/metadata/ (reference iceberg/IcebergCommitCallback)."""
+        from paimon_tpu.iceberg import sync_iceberg
+        return sync_iceberg(self)
+
     def analyze(self, columns: Optional[List[str]] = None) -> Optional[int]:
         """ANALYZE TABLE: compute and persist table/column statistics
         (reference stats/StatsFileHandler)."""
