@@ -315,6 +315,10 @@ class MergeTreeCompactManager:
                       pc.equal(kinds, RowKind.UPDATE_AFTER))
         return merged.filter(keep)
 
+    def _record_level_expire(self, merged: pa.Table) -> pa.Table:
+        from paimon_tpu.core.read import record_level_expire_filter
+        return record_level_expire_filter(self.options, merged)
+
     def _merge_tables(self, run_tables: List[pa.Table],
                       drop_deletes: bool) -> pa.Table:
         """Merge run-ordered tables under the table's merge engine —
@@ -329,7 +333,7 @@ class MergeTreeCompactManager:
                 drop_deletes=drop_deletes,
                 key_encoder=self.key_encoder,
                 seq_fields=seq_fields)
-            return res.take()
+            return self._record_level_expire(res.take())
         from paimon_tpu.ops.agg import merge_runs_agg
         merged = merge_runs_agg(run_tables, self.key_cols, self.schema,
                                 self.options,
@@ -337,7 +341,7 @@ class MergeTreeCompactManager:
                                 seq_fields=seq_fields)
         if drop_deletes:
             merged = self._live_view(merged)
-        return merged
+        return self._record_level_expire(merged)
 
     def _merged_state(self, files: List[DataFileMeta],
                       drop_deletes: bool = True) -> Optional[pa.Table]:

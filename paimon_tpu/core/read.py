@@ -38,6 +38,37 @@ __all__ = ["MergeFileSplitRead", "assemble_runs", "ROW_KIND_COL",
 ROW_KIND_COL = "_ROW_KIND"
 
 
+def record_level_expire_filter(options: CoreOptions,
+                               table: pa.Table) -> pa.Table:
+    """Hide rows whose time field passed record-level.expire-time
+    (reference io/RecordLevelExpire wrapping every reader; physical
+    removal happens at compaction rewrite)."""
+    import time as _time
+
+    import pyarrow.compute as pc
+
+    expire_ms = options.record_level_expire_time_ms
+    field = options.record_level_time_field
+    if not expire_ms or not field or field not in table.column_names:
+        return table
+    col = table.column(field).combine_chunks()
+    t = col.type
+    if pa.types.is_timestamp(t):
+        vals_ms = np.asarray(col.cast(pa.int64()).fill_null(0))
+        unit = {"s": 1000, "ms": 1, "us": 1 / 1000,
+                "ns": 1 / 1_000_000}[t.unit]
+        vals_ms = (vals_ms * unit).astype(np.int64)
+    elif pa.types.is_int32(t):
+        vals_ms = np.asarray(col.fill_null(0)).astype(np.int64) * 1000
+    else:
+        vals_ms = np.asarray(col.cast(pa.int64()).fill_null(0))
+    cutoff = int(_time.time() * 1000) - expire_ms
+    keep = (vals_ms >= cutoff) | np.asarray(pc.is_null(col))
+    if keep.all():
+        return table
+    return table.filter(pa.array(keep))
+
+
 def evolve_table(table: pa.Table, file_schema_id: int, schema: TableSchema,
                  schema_manager: Optional[SchemaManager],
                  cache: Dict[int, TableSchema],
@@ -163,6 +194,7 @@ class MergeFileSplitRead:
             out = self._read_raw(split, read_cols, value_cols)
         else:
             out = self._read_merged(split, read_cols, value_cols)
+        out = record_level_expire_filter(self.options, out)
         if self._predicate is not None:
             out = out.filter(self._predicate.to_arrow())
         return out
@@ -190,10 +222,12 @@ class MergeFileSplitRead:
     def _value_columns(self) -> List[str]:
         names = [f.name for f in self.schema.fields]
         if self._projection:
-            # key, pk and user-sequence columns are read regardless;
-            # output honors the projection
+            # key, pk, user-sequence and record-expire time columns are
+            # read regardless; output honors the projection
             keep = set(self._projection) | set(self.trimmed_pk) \
                 | set(self.options.sequence_field)
+            if self.options.record_level_time_field:
+                keep.add(self.options.record_level_time_field)
             return [n for n in names if n in keep]
         return names
 

@@ -184,12 +184,16 @@ class KeyValueFileStoreWrite:
     def __init__(self, file_io: FileIO, table_path: str,
                  table_schema: TableSchema, options: CoreOptions,
                  restore_max_seq: Optional[Callable[[Tuple, int], int]]
-                 = None, branch: str = "main"):
+                 = None, branch: str = "main",
+                 bucket_files_map: Optional[Callable[[], Dict]]
+                 = None, schema_manager=None):
         self.file_io = file_io
         self.table_path = table_path
         self.schema = table_schema
         self.options = options
         self.branch = branch
+        self._bucket_files_map = bucket_files_map
+        self._schema_manager = schema_manager
         self.partition_keys = table_schema.partition_keys
         self.path_factory = FileStorePathFactory(
             table_path, self.partition_keys,
@@ -308,9 +312,16 @@ class KeyValueFileStoreWrite:
 
     def prepare_commit(self) -> List[CommitMessage]:
         out = []
+        auto_compact = not self.options.write_only and not self._postpone
+        existing_map = None
+        if auto_compact and self._bucket_files_map is not None:
+            # ONE manifest read for the whole commit, not one per bucket
+            existing_map = self._bucket_files_map()
         for w in self._writers.values():
             msg = w.prepare_commit()
             if msg is not None:
+                if auto_compact:
+                    self._maybe_compact(msg, existing_map or {})
                 out.append(msg)
         if self._dynamic is not None:
             entries = self._dynamic.index_entries()
@@ -321,6 +332,28 @@ class KeyValueFileStoreWrite:
                     out.append(CommitMessage((), 0, self.total_buckets,
                                              index_entries=entries))
         return out
+
+    def _maybe_compact(self, msg: CommitMessage, existing_map: Dict):
+        """Inline compaction at prepare-commit when the bucket's sorted
+        runs exceed the trigger (reference MergeTreeWriter: compaction
+        fires at flush unless write-only). The picked unit may include
+        the message's own new L0 files: commit() publishes APPEND before
+        COMPACT, so the conflict check still sees them."""
+        existing = existing_map.get((msg.partition, msg.bucket), [])
+        files = existing + msg.new_files
+        if len(files) < 2:
+            return
+        from paimon_tpu.compact.manager import MergeTreeCompactManager
+        mgr = MergeTreeCompactManager(
+            self.file_io, self.table_path, self.schema, self.options,
+            msg.partition, msg.bucket, files,
+            schema_manager=self._schema_manager)
+        result = mgr.compact(full=False)
+        if result is None or result.is_empty():
+            return
+        msg.compact_before = result.before
+        msg.compact_after = result.after
+        msg.compact_changelog = result.changelog
 
     def close(self):
         self._writers.clear()

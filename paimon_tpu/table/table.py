@@ -305,10 +305,22 @@ class TableWrite:
         def restore(partition: Tuple, bucket: int) -> int:
             return scan.max_sequence_number(partition, bucket)
 
+        def bucket_files_map():
+            snapshot = table.snapshot_manager.latest_snapshot()
+            if snapshot is None:
+                return {}
+            out = {}
+            for e in scan.read_entries(snapshot):
+                part = scan._partition_codec.from_bytes(e.partition)
+                out.setdefault((part, e.bucket), []).append(e.file)
+            return out
+
         if table.primary_keys:
             self._write = KeyValueFileStoreWrite(
                 table.file_io, table.path, table.schema, table.options,
-                restore_max_seq=restore, branch=table.branch)
+                restore_max_seq=restore, branch=table.branch,
+                bucket_files_map=bucket_files_map,
+                schema_manager=table.schema_manager)
             if table.schema.cross_partition_update():
                 # pk does not cover the partition keys: route partition
                 # changes as -D old + +I new via the global index

@@ -1,0 +1,101 @@
+"""Auto-compaction at commit (write-only=false) + record-level expire."""
+
+import os
+import time
+
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def test_auto_compaction_bounds_sorted_runs(tmp_warehouse):
+    """Default (non write-only) tables compact inline when the run count
+    crosses num-sorted-run.compaction-trigger."""
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1",
+                        "num-sorted-run.compaction-trigger": "3"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "t"),
+                                  schema)
+    for i in range(8):
+        _commit(table, [{"id": i % 3, "v": float(i)}])
+    splits = table.new_read_builder().new_scan().plan().splits
+    n_runs = sum(len(s.data_files) for s in splits)
+    assert n_runs <= 4              # unbounded would be 8
+    rows = {r["id"]: r["v"] for r in table.to_arrow().to_pylist()}
+    assert rows == {0: 6.0, 1: 7.0, 2: 5.0}
+    # COMPACT snapshots were committed along the way
+    kinds = {s.commit_kind
+             for s in table.snapshot_manager.snapshots()}
+    assert "COMPACT" in kinds
+
+
+def test_write_only_never_auto_compacts(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "num-sorted-run.compaction-trigger": "2"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "w"),
+                                  schema)
+    for i in range(5):
+        _commit(table, [{"id": 1, "v": float(i)}])
+    splits = table.new_read_builder().new_scan().plan().splits
+    assert sum(len(s.data_files) for s in splits) == 5
+
+
+def test_record_level_expire(tmp_warehouse):
+    now = int(time.time())
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("created", BigIntType())      # epoch millis
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "record-level.expire-time": "1 h",
+                        "record-level.time-field": "created"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "e"),
+                                  schema)
+    _commit(table, [
+        {"id": 1, "created": (now - 7200) * 1000},   # 2h old: expired
+        {"id": 2, "created": now * 1000},            # fresh
+        {"id": 3, "created": None},                  # null: kept
+    ])
+    table.compact(full=True)
+    ids = sorted(table.to_arrow().column("id").to_pylist())
+    assert ids == [2, 3]
+
+
+def test_record_level_expire_with_projection(tmp_warehouse):
+    """Projection must not resurrect expired rows."""
+    now = int(time.time())
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("created", BigIntType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "record-level.expire-time": "1 h",
+                        "record-level.time-field": "created"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "ep"),
+                                  schema)
+    _commit(table, [{"id": 1, "created": (now - 7200) * 1000},
+                    {"id": 2, "created": now * 1000}])
+    out = table.to_arrow(projection=["id"])
+    assert out.column("id").to_pylist() == [2]

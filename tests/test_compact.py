@@ -129,7 +129,11 @@ def test_compaction_noop_when_compacted(tmp_path):
 
 
 def test_auto_compaction_trigger(tmp_path):
-    table = pk_table(tmp_path, **{"num-sorted-run.compaction-trigger": "3"})
+    # write-only: runs accumulate so the MANUAL universal pick fires
+    # (non write-only tables now auto-compact at commit)
+    table = pk_table(tmp_path,
+                     **{"num-sorted-run.compaction-trigger": "3",
+                        "write-only": "true"})
     for i in range(5):
         write_rows(table, [{"id": k, "v": f"r{i}"} for k in range(5)])
     sid = table.compact()  # universal pick should fire (5 runs > 3)
