@@ -150,6 +150,10 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu(fallback)" if not forced_cpu else "cpu(forced)"
+    if platform.startswith("cpu") and "BENCH_ROWS" not in os.environ:
+        # CPU fallback: cap the default scale so the run stays inside a
+        # driver timeout; scale is recorded in the JSON unit either way
+        rows = min(rows, 8_000_000)
 
     with tempfile.TemporaryDirectory() as tmp:
         table = build_table(os.path.join(tmp, "t"), rows, runs)

@@ -10,5 +10,5 @@ fs/RenamingTwoPhaseOutputStream.java).
 
 from paimon_tpu.fs.fileio import (  # noqa: F401
     FileIO, FileStatus, LocalFileIO, MemoryFileIO, get_file_io,
-    register_file_io,
+    register_file_io, safe_join,
 )

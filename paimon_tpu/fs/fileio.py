@@ -27,6 +27,16 @@ class FileStatus:
     mtime_ms: int = 0
 
 
+def safe_join(root: str, rel_path: str) -> str:
+    """Join a user-supplied relative path under `root`, rejecting any
+    traversal ('..', absolute paths) that would escape it."""
+    rel = rel_path.lstrip("/")
+    parts = [p for p in rel.split("/") if p not in ("", ".")]
+    if any(p == ".." for p in parts):
+        raise ValueError(f"Path escapes the root: {rel_path!r}")
+    return f"{root.rstrip('/')}/{'/'.join(parts)}"
+
+
 class FileIO:
     """Abstract file IO. All paths are absolute strings."""
 
@@ -53,6 +63,15 @@ class FileIO:
 
     def list_files(self, path: str) -> List[str]:
         return [s.path for s in self.list_status(path) if not s.is_dir]
+
+    def list_status_recursive(self, path: str) -> List["FileStatus"]:
+        out: List[FileStatus] = []
+        for st in self.list_status(path):
+            if st.is_dir:
+                out.extend(self.list_status_recursive(st.path))
+            else:
+                out.append(st)
+        return out
 
     # -- writing -------------------------------------------------------------
 

@@ -31,11 +31,7 @@ def _all_snapshots(table):
 
 
 def _walk_files(file_io, root: str, out: List):
-    for st in file_io.list_status(root):
-        if st.is_dir:
-            _walk_files(file_io, st.path, out)
-        else:
-            out.append(st)
+    out.extend(file_io.list_status_recursive(root))
 
 
 def remove_orphan_files(table, older_than_ms: Optional[int] = None,

@@ -34,17 +34,9 @@ class FormatTable:
             root = f"{self.path}/{parts}"
             if not self.file_io.exists(root):
                 return []
-        out: List[str] = []
-
-        def walk(d):
-            for st in self.file_io.list_status(d):
-                if st.is_dir:
-                    walk(st.path)
-                elif st.path.endswith("." + self.format.extension):
-                    out.append(st.path)
-
-        walk(root)
-        return sorted(out)
+        return sorted(
+            st.path for st in self.file_io.list_status_recursive(root)
+            if st.path.endswith("." + self.format.extension))
 
     @staticmethod
     def _partition_of(path: str, root: str) -> Dict[str, str]:
