@@ -189,7 +189,9 @@ def sort_compact(table, order_by, strategy: str = "zorder"):
     import pyarrow as pa
 
     from paimon_tpu.manifest import FileSource
-    from paimon_tpu.ops.zorder import order_permutation, z_order_permutation
+    from paimon_tpu.ops.zorder import (
+        hilbert_permutation, order_permutation, z_order_permutation,
+    )
 
     if not order_by:
         raise ValueError("sort-compact requires at least one order-by "
@@ -202,10 +204,11 @@ def sort_compact(table, order_by, strategy: str = "zorder"):
         raise ValueError("sort-compact applies to append tables "
                          "(pk tables cluster by key already)")
     perm_fn = {"zorder": z_order_permutation,
+               "hilbert": hilbert_permutation,
                "order": order_permutation}.get(strategy)
     if perm_fn is None:
         raise ValueError(f"Unknown sort strategy {strategy!r} "
-                         f"(zorder | order)")
+                         f"(zorder | hilbert | order)")
 
     scan = table.new_scan()
     snapshot = table.snapshot_manager.latest_snapshot()

@@ -19,7 +19,8 @@ import pyarrow as pa
 
 from paimon_tpu.ops.normkey import NormalizedKeyEncoder
 
-__all__ = ["z_index", "z_order_permutation", "order_permutation"]
+__all__ = ["z_index", "z_order_permutation", "order_permutation",
+           "hilbert_index", "hilbert_permutation"]
 
 
 def _normalized_u32(table: pa.Table, columns: Sequence[str]) -> np.ndarray:
@@ -76,3 +77,53 @@ def order_permutation(table: pa.Table,
     mat = _normalized_u32(table, columns)
     return np.lexsort(tuple(mat[:, i] for i in reversed(range(
         mat.shape[1]))))
+
+
+def hilbert_index(table: pa.Table, columns: Sequence[str]) -> np.ndarray:
+    """uint64[N] Hilbert-curve keys (Skilling's transpose algorithm,
+    vectorized over rows — loops run over bits x dims only; reference
+    sort/hilbert/HilbertIndexer.java)."""
+    mat = _normalized_u32(table, columns)
+    n_rows, n_dims = mat.shape
+    bits = min(32, max(1, 63 // n_dims))
+    # rank-normalized values truncated to `bits` per dimension
+    X = [(mat[:, i] >> np.uint32(32 - bits)).astype(np.uint64)
+         for i in range(n_dims)]
+
+    # AxesToTranspose (Skilling, AIP Conf. Proc. 707, 2004) — public
+    # domain algorithm, vectorized per row
+    M = np.uint64(1 << (bits - 1))
+    Q = int(M)
+    while Q > 1:
+        P = np.uint64(Q - 1)
+        Qu = np.uint64(Q)
+        for i in range(n_dims):
+            cond = (X[i] & Qu) != 0
+            X[0] = np.where(cond, X[0] ^ P, X[0])
+            t = np.where(cond, np.uint64(0), (X[0] ^ X[i]) & P)
+            X[0] ^= t
+            X[i] ^= t
+        Q >>= 1
+    for i in range(1, n_dims):
+        X[i] ^= X[i - 1]
+    t = np.zeros(n_rows, dtype=np.uint64)
+    Q = int(M)
+    while Q > 1:
+        has = (X[n_dims - 1] & np.uint64(Q)) != 0
+        t = np.where(has, t ^ np.uint64(Q - 1), t)
+        Q >>= 1
+    for i in range(n_dims):
+        X[i] ^= t
+
+    # interleave the transpose bits (most-significant first)
+    out = np.zeros(n_rows, dtype=np.uint64)
+    for b in range(bits - 1, -1, -1):
+        for i in range(n_dims):
+            bit = (X[i] >> np.uint64(b)) & np.uint64(1)
+            out = (out << np.uint64(1)) | bit
+    return out
+
+
+def hilbert_permutation(table: pa.Table,
+                        columns: Sequence[str]) -> np.ndarray:
+    return np.argsort(hilbert_index(table, columns), kind="stable")

@@ -153,3 +153,49 @@ def test_vector_search_batch_queries(tmp_warehouse):
     for r in out.to_pylist():
         by_q[r["_query"]].append(r["id"])
     assert by_q[0][0] == 3 and by_q[1][0] == 9
+
+
+def test_hilbert_curve_properties():
+    """Adjacent Hilbert indexes must be adjacent points (unit steps) —
+    the property that makes it cluster better than z-order."""
+    from paimon_tpu.ops.zorder import hilbert_index
+
+    n = 16
+    pts = [(x, y) for x in range(n) for y in range(n)]
+    t = pa.table({"x": pa.array([p[0] for p in pts], pa.int64()),
+                  "y": pa.array([p[1] for p in pts], pa.int64())})
+    h = hilbert_index(t, ["x", "y"])
+    order = np.argsort(h)
+    walked = [pts[i] for i in order]
+    # every consecutive pair of curve points is one grid step apart
+    steps = [abs(a[0] - b[0]) + abs(a[1] - b[1])
+             for a, b in zip(walked, walked[1:])]
+    assert all(s == 1 for s in steps)
+    assert len(set(h.tolist())) == n * n     # bijective on the grid
+
+
+def test_sort_compact_hilbert(tmp_warehouse):
+    schema = (Schema.builder()
+              .column("x", BigIntType())
+              .column("y", BigIntType())
+              .options({"target-file-size": "4kb"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "h"),
+                                  schema)
+    rng = np.random.default_rng(1)
+    _commit(table, [{"x": int(a), "y": int(b)}
+                    for a, b in rng.integers(0, 500, (8000, 2))])
+    before = sorted(map(lambda r: (r["x"], r["y"]),
+                        table.to_arrow().to_pylist()))
+    assert table.sort_compact(["x", "y"], strategy="hilbert") is not None
+    after = sorted(map(lambda r: (r["x"], r["y"]),
+                       table.to_arrow().to_pylist()))
+    assert after == before
+
+
+def test_hilbert_single_column(tmp_warehouse):
+    from paimon_tpu.ops.zorder import hilbert_index
+
+    t = pa.table({"x": pa.array([5, 1, 9], pa.int64())})
+    h = hilbert_index(t, ["x"])
+    assert np.argsort(h).tolist() == [1, 0, 2]   # order-preserving in 1D
