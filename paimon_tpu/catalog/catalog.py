@@ -261,6 +261,13 @@ def create_catalog(options=None, **kwargs) -> Catalog:
         raise ValueError("catalog requires a 'warehouse' option")
     if metastore == "filesystem":
         return FileSystemCatalog(warehouse)
+    if metastore == "jdbc":
+        from paimon_tpu.catalog.jdbc import JdbcCatalog
+        uri = opts.get("uri")
+        if not uri or not warehouse:
+            raise ValueError("jdbc catalog requires 'uri' and "
+                             "'warehouse' options")
+        return JdbcCatalog(uri, warehouse)
     if metastore == "rest":
         from paimon_tpu.catalog.rest import RESTCatalogClient
         uri = opts.get("uri")
@@ -269,4 +276,4 @@ def create_catalog(options=None, **kwargs) -> Catalog:
         return RESTCatalogClient(uri, token=opts.get("token"),
                                  prefix=opts.get("prefix", "paimon"))
     raise ValueError(f"Unsupported metastore {metastore!r} "
-                     f"(available: filesystem, rest)")
+                     f"(available: filesystem, jdbc, rest)")

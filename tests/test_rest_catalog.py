@@ -111,3 +111,51 @@ def test_rest_drop_database_cascade_guard(served):
         cat.drop_database("db")          # non-empty, cascade=False
     cat.drop_database("db", cascade=True)
     assert cat.list_databases() == []
+
+
+def test_jdbc_catalog(tmp_path):
+    cat = paimon_tpu.create_catalog({
+        "metastore": "jdbc",
+        "uri": str(tmp_path / "catalog.db"),
+        "warehouse": str(tmp_path / "wh"),
+    })
+    cat.create_database("db", properties={"owner": "x"})
+    assert cat.list_databases() == ["db"]
+    assert cat.load_database_properties("db") == {"owner": "x"}
+    t = cat.create_table("db.t", _schema())
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1, "v": 1.0}])
+    wb.new_commit().commit(w.prepare_commit())
+
+    # a SECOND catalog instance over the same DB sees everything
+    cat2 = paimon_tpu.create_catalog({
+        "metastore": "jdbc",
+        "uri": str(tmp_path / "catalog.db"),
+        "warehouse": str(tmp_path / "wh"),
+    })
+    assert cat2.list_tables("db") == ["t"]
+    assert cat2.get_table("db.t").to_arrow().num_rows == 1
+    with pytest.raises(TableAlreadyExistsError):
+        cat2.create_table("db.t", _schema())
+    cat2.rename_table("db.t", "db.u")
+    assert cat.list_tables("db") == ["u"]
+    with pytest.raises(ValueError):
+        cat.drop_database("db")
+    cat.drop_database("db", cascade=True)
+    assert cat2.list_databases() == []
+    cat.close(); cat2.close()
+
+
+def test_jdbc_rename_into_missing_database_rejected(tmp_path):
+    cat = paimon_tpu.create_catalog({
+        "metastore": "jdbc",
+        "uri": str(tmp_path / "c2.db"),
+        "warehouse": str(tmp_path / "wh2"),
+    })
+    cat.create_database("db")
+    cat.create_table("db.t", _schema())
+    with pytest.raises(DatabaseNotFoundError):
+        cat.rename_table("db.t", "nope.u")
+    assert cat.list_tables("db") == ["t"]
+    cat.close()
