@@ -39,7 +39,7 @@ def _make_append_writer(table, path_factory):
         file_format=table.options.file_format,
         compression=table.options.file_compression,
         target_file_size=table.options.target_file_size,
-        bloom_columns=table.options.bloom_filter_columns,
+        index_spec=table.options.file_index_spec,
         bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
         index_in_manifest_threshold=table.options.get(
             CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))

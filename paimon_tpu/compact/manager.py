@@ -72,7 +72,7 @@ class MergeTreeCompactManager:
             file_format=options.file_format,
             compression=options.file_compression,
             target_file_size=options.target_file_size,
-            bloom_columns=options.bloom_filter_columns,
+            index_spec=options.file_index_spec,
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
@@ -121,11 +121,19 @@ class MergeTreeCompactManager:
             f = files[0]
             if f.level == unit.output_level:
                 return CompactResult([], [])
+            from paimon_tpu.options import MergeEngine as ME
             blocked = (
                 (producer == ChangelogProducer.LOOKUP and f.level == 0)
                 or (producer == ChangelogProducer.FULL_COMPACTION
                     and unit.output_level == self.levels.max_level
-                    and f.level == 0))
+                    and f.level == 0)
+                # deferred-merge engines (partial-update / aggregation)
+                # sort but do NOT merge at L0 flush (core/write.py flush),
+                # so an L0 file may hold several versions of one key;
+                # promoting it without rewrite would let raw-convertible
+                # reads surface the duplicates
+                or (f.level == 0 and self.options.merge_engine in
+                    (ME.PARTIAL_UPDATE, ME.AGGREGATE)))
             # metadata-only promotion unless deletes must be dropped at the
             # top level (reference MergeTreeCompactTask.upgrade:124)
             if (unit.output_level < self.levels.max_level

@@ -45,7 +45,7 @@ class AppendFileWriter:
     def __init__(self, file_io: FileIO, path_factory: FileStorePathFactory,
                  table_schema: TableSchema, file_format: str,
                  compression: str, target_file_size: int,
-                 bloom_columns: Optional[List[str]] = None,
+                 index_spec: Optional[Dict[str, List[str]]] = None,
                  bloom_fpp: float = 0.01,
                  index_in_manifest_threshold: int = 500):
         self.file_io = file_io
@@ -54,7 +54,7 @@ class AppendFileWriter:
         self.file_format = file_format
         self.compression = compression
         self.target_file_size = target_file_size
-        self.bloom_columns = bloom_columns or []
+        self.index_spec = index_spec or {}
         self.bloom_fpp = bloom_fpp
         self.index_in_manifest_threshold = index_in_manifest_threshold
 
@@ -95,12 +95,11 @@ class AppendFileWriter:
         value_stats = _safe_stats([f.type for f in self.schema.fields],
                                   vmins, vmaxs, vnulls)
         embedded_index, extra_files = None, []
-        if self.bloom_columns:
-            from paimon_tpu.index.bloom import (
-                build_file_index, place_file_index,
-            )
-            blob = build_file_index(chunk, self.bloom_columns,
-                                    self.bloom_fpp)
+        if self.index_spec:
+            from paimon_tpu.index.bloom import place_file_index
+            from paimon_tpu.index.file_index import build_indexes_blob
+            blob = build_indexes_blob(chunk, self.index_spec,
+                                      self.bloom_fpp)
             embedded_index, extra_files = place_file_index(
                 self.file_io, self.path_factory, partition, bucket, name,
                 blob, self.index_in_manifest_threshold)
@@ -182,7 +181,7 @@ class AppendOnlyFileStoreWrite:
             file_format=options.file_format,
             compression=options.file_compression,
             target_file_size=options.target_file_size,
-            bloom_columns=options.bloom_filter_columns,
+            index_spec=options.file_index_spec,
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
@@ -258,6 +257,8 @@ class AppendSplitRead:
         self._schema_cache: Dict[int, TableSchema] = {schema.id: schema}
         self._projection: Optional[List[str]] = None
         self._predicate: Optional[Predicate] = None
+        self._file_index_cache: Dict[str, object] = {}
+        self._arrow_types: Optional[Dict[str, object]] = None
 
     def with_projection(self, columns) -> "AppendSplitRead":
         self._projection = list(columns) if columns else None
@@ -273,6 +274,43 @@ class AppendSplitRead:
             return [n for n in names if n in set(self._projection)]
         return names
 
+    def _index_selection(self, split: DataSplit, meta, num_rows: int):
+        """Superset row mask from the file's bitmap/BSI/range-bitmap
+        indexes (reference fileindex/bitmap/BitmapIndexResult.java row
+        filtering); None when no index narrows the file.  The exact
+        predicate is re-applied after, so supersets are safe."""
+        if self._predicate is None:
+            return None
+        from paimon_tpu.index.file_index import (
+            read_indexes_blob, row_selection,
+        )
+        fi = self._file_index_cache.get(meta.file_name)
+        if fi is None:
+            fi = read_indexes_blob(meta.embedded_index)
+            if not fi:
+                for extra in meta.extra_files:
+                    if extra.endswith(".index"):
+                        path = self.path_factory.data_file_path(
+                            split.partition, split.bucket, extra)
+                        try:
+                            fi = read_indexes_blob(
+                                self.file_io.read_bytes(path))
+                        except FileNotFoundError:
+                            pass
+                        break
+            self._file_index_cache[meta.file_name] = fi
+        if not fi:
+            return None
+        if self._arrow_types is None:
+            self._arrow_types = {}
+            for f in self.schema.fields:
+                try:
+                    self._arrow_types[f.name] = data_type_to_arrow(f.type)
+                except ValueError:
+                    pass
+        return row_selection(fi, self._predicate, num_rows,
+                             self._arrow_types)
+
     def read_split(self, split: DataSplit) -> pa.Table:
         from paimon_tpu.core.kv_file import read_kv_file
         from paimon_tpu.core.read import ROW_KIND_COL as RK
@@ -287,10 +325,14 @@ class AppendSplitRead:
                              schema_manager=self.schema_manager,
                              wanted=wanted)
             t = self._evolve(t, meta.schema_id)
+            keep = self._index_selection(split, meta, t.num_rows)
             if split.deletion_vectors and \
                     meta.file_name in split.deletion_vectors:
                 dv = split.deletion_vectors[meta.file_name]
-                t = t.filter(pa.array(dv.keep_mask(t.num_rows)))
+                dv_keep = np.asarray(dv.keep_mask(t.num_rows))
+                keep = dv_keep if keep is None else (keep & dv_keep)
+            if keep is not None:
+                t = t.filter(pa.array(keep))
             tables.append(t)
         out = pa.concat_tables(tables, promote_options="none") if tables \
             else self._empty()

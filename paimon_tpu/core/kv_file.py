@@ -7,7 +7,7 @@ RollingFileWriter (target-size rolling), KeyValueFileReaderFactory.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -34,7 +34,7 @@ class KeyValueFileWriter:
                  table_schema: TableSchema, file_format: str = "parquet",
                  compression: str = "zstd",
                  target_file_size: int = 128 << 20,
-                 bloom_columns: Optional[List[str]] = None,
+                 index_spec: Optional[Dict[str, List[str]]] = None,
                  bloom_fpp: float = 0.01,
                  index_in_manifest_threshold: int = 500):
         self.file_io = file_io
@@ -43,7 +43,7 @@ class KeyValueFileWriter:
         self.file_format = file_format
         self.compression = compression
         self.target_file_size = target_file_size
-        self.bloom_columns = bloom_columns or []
+        self.index_spec = index_spec or {}
         self.bloom_fpp = bloom_fpp
         self.index_in_manifest_threshold = index_in_manifest_threshold
         self.trimmed_pk = table_schema.trimmed_primary_keys()
@@ -108,12 +108,11 @@ class KeyValueFileWriter:
         delete_rows = int(((kinds == 1) | (kinds == 3)).sum())
 
         embedded_index, extra_files = None, []
-        if self.bloom_columns:
-            from paimon_tpu.index.bloom import (
-                build_file_index, place_file_index,
-            )
-            blob = build_file_index(chunk, self.bloom_columns,
-                                    self.bloom_fpp)
+        if self.index_spec:
+            from paimon_tpu.index.bloom import place_file_index
+            from paimon_tpu.index.file_index import build_indexes_blob
+            blob = build_indexes_blob(chunk, self.index_spec,
+                                      self.bloom_fpp)
             embedded_index, extra_files = place_file_index(
                 self.file_io, self.path_factory, partition, bucket, name,
                 blob, self.index_in_manifest_threshold)

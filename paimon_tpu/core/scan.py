@@ -85,7 +85,8 @@ class FileStoreScan:
         self.index_manifest_file = IndexManifestFile(file_io, mdir, codec)
         self._partition_filter: Optional[dict] = None
         self._bucket_filter: Optional[set] = None
-        self._bloom_hash_cache: Dict[int, list] = {}
+        self._file_index_cache: Dict[str, object] = {}
+        self._arrow_types: Optional[Dict[str, object]] = None
         self._key_filter: Optional[Predicate] = None
         self._value_filter: Optional[Predicate] = None
         self._level_filter: Optional[Callable[[int], bool]] = None
@@ -208,62 +209,55 @@ class FileStoreScan:
                 return False
         return True
 
-    def _bloom_literal_hashes(self, pred) -> List[Tuple[str, int]]:
-        """[(field, literal_hash)] for a predicate's conjunctive
-        equalities — computed once per scan, not per manifest entry."""
-        cached = self._bloom_hash_cache.get(id(pred))
+    def _arrow_type_map(self) -> Dict[str, object]:
+        if self._arrow_types is None:
+            from paimon_tpu.types import data_type_to_arrow
+            out = {}
+            for f in self.schema.fields:
+                try:
+                    out[f.name] = data_type_to_arrow(f.type)
+                except ValueError:
+                    pass
+            self._arrow_types = out
+        return self._arrow_types
+
+    def _file_indexes(self, e: ManifestEntry):
+        """Load a file's column indexes (bloom/bitmap/bsi/range-bitmap):
+        embedded blob, or the .index sidecar recorded in extra_files
+        (above the in-manifest threshold).  Cached per data file for the
+        scan's lifetime."""
+        from paimon_tpu.index.file_index import read_indexes_blob
+        cached = self._file_index_cache.get(e.file.file_name)
         if cached is not None:
             return cached
-        from paimon_tpu.index.bloom import hash_value
-        from paimon_tpu.predicate import conjunctive_equalities
-        from paimon_tpu.types import data_type_to_arrow
-        rt = self.schema.logical_row_type()
-        out = []
-        for field, lit in conjunctive_equalities(pred):
-            if lit is None:
-                continue
-            try:
-                at = data_type_to_arrow(rt.get_field(field).type)
-                out.append((field, hash_value(lit, at)))
-            except (KeyError, ValueError):
-                continue
-        self._bloom_hash_cache[id(pred)] = out
-        return out
-
-    def _file_bloom(self, e: ManifestEntry):
-        """Load a file's bloom index: embedded blob, or the .index
-        sidecar recorded in extra_files (above the in-manifest
-        threshold)."""
-        from paimon_tpu.index.bloom import read_file_index
-        if e.file.embedded_index is not None:
-            return read_file_index(e.file.embedded_index)
-        for extra in e.file.extra_files:
-            if extra.endswith(".index"):
-                partition = self._partition_codec.from_bytes(e.partition)
-                path = self.path_factory.data_file_path(
-                    partition, e.bucket, extra)
-                try:
-                    return read_file_index(self.file_io.read_bytes(path))
-                except FileNotFoundError:
-                    return {}
-        return {}
+        fi = read_indexes_blob(e.file.embedded_index)
+        if not fi:
+            for extra in e.file.extra_files:
+                if extra.endswith(".index"):
+                    partition = self._partition_codec.from_bytes(
+                        e.partition)
+                    path = self.path_factory.data_file_path(
+                        partition, e.bucket, extra)
+                    try:
+                        fi = read_indexes_blob(
+                            self.file_io.read_bytes(path))
+                    except FileNotFoundError:
+                        pass
+                    break
+        self._file_index_cache[e.file.file_name] = fi
+        return fi
 
     def _bloom_rejects(self, e: ManifestEntry, pred) -> bool:
-        """Per-file bloom index skip on conjunctive equality predicates
-        (role of reference io/FileIndexEvaluator)."""
+        """Per-file index skip: bloom equality misses plus bitmap/BSI/
+        range-bitmap emptiness proofs (role of reference
+        io/FileIndexEvaluator + FileIndexPredicate)."""
         if pred is None:
             return False
-        pairs = self._bloom_literal_hashes(pred)
-        if not pairs:
+        fi = self._file_indexes(e)
+        if not fi:
             return False
-        blooms = self._file_bloom(e)
-        if not blooms:
-            return False
-        for field, h in pairs:
-            bf = blooms.get(field)
-            if bf is not None and not bf.might_contain(h):
-                return True
-        return False
+        from paimon_tpu.index.file_index import evaluate_skip
+        return evaluate_skip(fi, pred, self._arrow_type_map())
 
     def _entry_visible(self, e: ManifestEntry) -> bool:
         """Per-file visibility. NOTE: value-predicate pruning for

@@ -203,7 +203,7 @@ class KeyValueFileStoreWrite:
             file_format=options.file_format,
             compression=options.file_compression,
             target_file_size=options.target_file_size,
-            bloom_columns=options.bloom_filter_columns,
+            index_spec=options.file_index_spec,
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))

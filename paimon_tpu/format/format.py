@@ -244,6 +244,10 @@ _FORMATS: Dict[str, FileFormatFactory] = {
 def get_format(identifier: str) -> FileFormatFactory:
     """reference FileFormat.fromIdentifier (FileFormat.java:76)."""
     ident = identifier.lower()
+    if ident == "mosaic" and ident not in _FORMATS:
+        # registered lazily to keep module import order simple
+        from paimon_tpu.format.mosaic import MOSAIC_FACTORY
+        _FORMATS["mosaic"] = MOSAIC_FACTORY
     if ident not in _FORMATS:
         raise ValueError(f"Unknown file format {identifier!r}; "
                          f"available: {sorted(_FORMATS)}")

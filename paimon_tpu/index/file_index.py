@@ -1,0 +1,231 @@
+"""Unified per-file column-index container + predicate evaluator.
+
+reference: paimon-common/src/main/java/org/apache/paimon/fileindex/
+FileIndexFormat.java (multi-column multi-index container),
+FileIndexPredicate.java + io/FileIndexEvaluator (skip decision), and
+reader row-selection via bitmap results
+(fileindex/bitmap/BitmapIndexResult.java).
+
+Blob layout (versioned, superset of the round-1 bloom-only v1 format):
+
+  v1: "PTFI" 0x01 then (name_len u16, blob_len u32, name, bloom_blob)*
+  v2: "PTFI" 0x02 then (type u8, name_len u16, blob_len u32, name, blob)*
+
+Types: 0 bloom, 1 bitmap, 2 bit-sliced, 3 range-bitmap.  Small blobs
+embed in the manifest entry (DataFileMeta.embedded_index); large ones
+spill to a `<data-file>.index` sidecar — same placement rule as v1.
+
+Evaluation returns dense bool selections with superset semantics: every
+mask is a superset of the truly-matching rows, so an empty mask proves
+the file irrelevant (skip) and a non-empty mask is a safe row prefilter
+(the read path re-applies the exact predicate after).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.index.bitmap import BSIIndex, BitmapIndex, RangeBitmapIndex
+from paimon_tpu.index.bloom import BloomFilter, hash_value
+
+__all__ = ["FileIndexes", "build_indexes_blob", "read_indexes_blob",
+           "evaluate_skip", "row_selection", "INDEX_TYPES"]
+
+_MAGIC = b"PTFI"
+_V2 = 2
+
+TYPE_BLOOM, TYPE_BITMAP, TYPE_BSI, TYPE_RANGE = 0, 1, 2, 3
+INDEX_TYPES = {
+    "bloom-filter": TYPE_BLOOM,
+    "bitmap": TYPE_BITMAP,
+    "bsi": TYPE_BSI,
+    "range-bitmap": TYPE_RANGE,
+}
+_DESERIALIZERS = {
+    TYPE_BLOOM: BloomFilter.deserialize,
+    TYPE_BITMAP: BitmapIndex.deserialize,
+    TYPE_BSI: BSIIndex.deserialize,
+    TYPE_RANGE: RangeBitmapIndex.deserialize,
+}
+
+
+class FileIndexes:
+    """column -> {type tag -> index object}."""
+
+    def __init__(self):
+        self.by_column: Dict[str, Dict[int, object]] = {}
+
+    def add(self, column: str, type_tag: int, index):
+        self.by_column.setdefault(column, {})[type_tag] = index
+
+    def __bool__(self):
+        return bool(self.by_column)
+
+
+def build_indexes_blob(table: pa.Table, spec: Dict[str, List[str]],
+                       bloom_fpp: float = 0.01) -> Optional[bytes]:
+    """spec: index-type name -> column list (e.g. {"bitmap": ["city"]})."""
+    import struct
+    entries = []
+
+    def emit(type_tag: int, column: str, blob: bytes):
+        cname = column.encode("utf-8")
+        entries.append(struct.pack("<BHI", type_tag, len(cname), len(blob))
+                       + cname + blob)
+
+    for c in spec.get("bloom-filter", []):
+        if c not in table.column_names:
+            continue
+        try:
+            from paimon_tpu.index.bloom import hash_column
+            hashes = hash_column(table.column(c))
+        except ValueError:
+            continue
+        emit(TYPE_BLOOM, c, BloomFilter.build(hashes, bloom_fpp).serialize())
+    for c in spec.get("bitmap", []):
+        if c not in table.column_names:
+            continue
+        try:
+            idx = BitmapIndex.build(table.column(c))
+        except ValueError:
+            continue
+        if idx is not None:
+            emit(TYPE_BITMAP, c, idx.serialize())
+    for c in spec.get("bsi", []):
+        if c not in table.column_names:
+            continue
+        try:
+            idx = BSIIndex.build(table.column(c))
+        except ValueError:
+            continue
+        if idx is not None:
+            emit(TYPE_BSI, c, idx.serialize())
+    for c in spec.get("range-bitmap", []):
+        if c not in table.column_names:
+            continue
+        try:
+            idx = RangeBitmapIndex.build(table.column(c))
+        except ValueError:
+            continue
+        if idx is not None:
+            emit(TYPE_RANGE, c, idx.serialize())
+    if not entries:
+        return None
+    return _MAGIC + bytes([_V2]) + b"".join(entries)
+
+
+def read_indexes_blob(data: Optional[bytes]) -> FileIndexes:
+    import struct
+    fi = FileIndexes()
+    if not data or data[:4] != _MAGIC:
+        return fi
+    version = data[4]
+    p = 5
+    if version == 1:                      # bloom-only legacy layout
+        while p < len(data):
+            nlen, blen = struct.unpack_from("<HI", data, p)
+            p += 6
+            name = data[p:p + nlen].decode("utf-8")
+            p += nlen
+            fi.add(name, TYPE_BLOOM,
+                   BloomFilter.deserialize(data[p:p + blen]))
+            p += blen
+        return fi
+    while p < len(data):
+        type_tag, nlen, blen = struct.unpack_from("<BHI", data, p)
+        p += 7
+        name = data[p:p + nlen].decode("utf-8")
+        p += nlen
+        deser = _DESERIALIZERS.get(type_tag)
+        if deser is not None:
+            fi.add(name, type_tag, deser(data[p:p + blen]))
+        p += blen
+    return fi
+
+
+# -- evaluation --------------------------------------------------------------
+
+# structures able to produce row selections, in preference order
+_SELECTIVE = (TYPE_BITMAP, TYPE_BSI, TYPE_RANGE)
+
+
+def _leaf_mask(fi: FileIndexes, leaf, arrow_type=None) \
+        -> Optional[np.ndarray]:
+    idxs = fi.by_column.get(leaf.field)
+    if not idxs:
+        return None
+    for tag in _SELECTIVE:
+        idx = idxs.get(tag)
+        if idx is None:
+            continue
+        mask, _exact = idx.eval(leaf.op, leaf.literal)
+        if mask is not None:
+            return mask
+    bf = idxs.get(TYPE_BLOOM)
+    if isinstance(bf, BloomFilter) and arrow_type is not None and \
+            leaf.op in ("eq", "in"):
+        lits = leaf.literal if leaf.op == "in" else [leaf.literal]
+        try:
+            hit = any(bf.might_contain(hash_value(v, arrow_type))
+                      for v in lits)
+        except (ValueError, pa.ArrowInvalid):
+            return None
+        if not hit:
+            return np.zeros(1, dtype=bool)   # provably no match
+    return None
+
+
+def _eval(fi: FileIndexes, pred, types: Dict[str, pa.DataType]) \
+        -> Optional[np.ndarray]:
+    from paimon_tpu.predicate import Compound, Leaf
+    if isinstance(pred, Leaf):
+        return _leaf_mask(fi, pred, types.get(pred.field))
+    if isinstance(pred, Compound):
+        if pred.op == "and":
+            masks = [m for m in (_eval(fi, c, types) for c in pred.children)
+                     if m is not None]
+            if not masks:
+                return None
+            n = max(len(m) for m in masks)
+            out = np.ones(n, dtype=bool)
+            for m in masks:
+                out &= m if len(m) == n else \
+                    (np.zeros(n, bool) if not m.any() else np.ones(n, bool))
+            return out
+        if pred.op == "or":
+            masks = [_eval(fi, c, types) for c in pred.children]
+            if any(m is None for m in masks):
+                return None
+            n = max(len(m) for m in masks)
+            out = np.zeros(n, dtype=bool)
+            for m in masks:
+                out |= m if len(m) == n else \
+                    (np.ones(n, bool) if m.any() else np.zeros(n, bool))
+            return out
+        return None                        # NOT of a superset is unsafe
+    return None
+
+
+def evaluate_skip(fi: FileIndexes, pred,
+                  types: Optional[Dict[str, pa.DataType]] = None) -> bool:
+    """True when the indexes prove no row of the file can match."""
+    if not fi or pred is None:
+        return False
+    mask = _eval(fi, pred, types or {})
+    return mask is not None and not mask.any()
+
+
+def row_selection(fi: FileIndexes, pred, num_rows: int,
+                  types: Optional[Dict[str, pa.DataType]] = None
+                  ) -> Optional[np.ndarray]:
+    """Superset row mask for prefiltering, or None when indexes cannot
+    narrow the file (bloom-only hits, unsupported ops, ...)."""
+    if not fi or pred is None:
+        return None
+    mask = _eval(fi, pred, types or {})
+    if mask is None or len(mask) != num_rows:
+        return None
+    return mask

@@ -294,6 +294,18 @@ class CoreOptions:
         "file-index.bloom-filter.fpp", float, 0.01, "")
     FILE_INDEX_IN_MANIFEST_THRESHOLD = ConfigOption(
         "file-index.in-manifest-threshold", parse_memory_size, 500, "")
+    FILE_INDEX_BITMAP_COLUMNS = ConfigOption(
+        "file-index.bitmap.columns", str, None,
+        "Columns to build per-file value->row-position bitmap indexes "
+        "for (reference fileindex/bitmap/BitmapFileIndex.java)")
+    FILE_INDEX_BSI_COLUMNS = ConfigOption(
+        "file-index.bsi.columns", str, None,
+        "Integer columns to build per-file bit-sliced indexes for "
+        "(reference fileindex/bsi/BitSliceIndexBitmap.java)")
+    FILE_INDEX_RANGE_BITMAP_COLUMNS = ConfigOption(
+        "file-index.range-bitmap.columns", str, None,
+        "Numeric columns to build per-file range-encoded bin bitmaps "
+        "for (reference fileindex/rangebitmap/RangeBitmap.java)")
     ROW_TRACKING_ENABLED = ConfigOption("row-tracking.enabled", _parse_bool,
                                         False, "")
     DATA_EVOLUTION_ENABLED = ConfigOption("data-evolution.enabled",
@@ -391,6 +403,24 @@ class CoreOptions:
     def bloom_filter_columns(self):
         v = self.options.get(CoreOptions.FILE_INDEX_BLOOM_COLUMNS)
         return [c.strip() for c in v.split(",")] if v else []
+
+    @property
+    def file_index_spec(self):
+        """index-type name -> column list, for every configured
+        file-index kind (consumed by index/file_index.py)."""
+        spec = {}
+        for name, opt in (
+                ("bloom-filter", CoreOptions.FILE_INDEX_BLOOM_COLUMNS),
+                ("bitmap", CoreOptions.FILE_INDEX_BITMAP_COLUMNS),
+                ("bsi", CoreOptions.FILE_INDEX_BSI_COLUMNS),
+                ("range-bitmap",
+                 CoreOptions.FILE_INDEX_RANGE_BITMAP_COLUMNS)):
+            v = self.options.get(opt)
+            cols = [c.strip() for c in v.split(",") if c.strip()] \
+                if v else []
+            if cols:
+                spec[name] = cols
+        return spec
 
     @property
     def deletion_vectors_enabled(self) -> bool:

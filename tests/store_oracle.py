@@ -1,0 +1,303 @@
+"""Randomized store-level correctness oracle.
+
+The reference's master correctness fixture drives the full store with
+random KV workloads and checks every state against a replayed in-memory
+model (paimon-core/src/test/java/org/apache/paimon/TestFileStore.java,
+TestKeyValueGenerator.java).  This module is that harness for the TPU
+store: a seeded generator produces random interleavings of
+
+  - write batches (random sizes/keys/partitions, inserts/updates/deletes)
+  - minor + full compactions
+  - snapshot expiry
+  - mid-stream schema evolution (add-column)
+
+across all four merge engines and the changelog producers, while an
+``OracleModel`` replays the exact merge semantics in plain Python dicts.
+After every mutation the full merge-on-read scan must equal the model;
+at the end every retained snapshot is time-travel read and checked
+against the recorded per-snapshot model state, and (for changelog runs)
+the drained changelog stream applied event-by-event must reproduce the
+final state.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from paimon_tpu.schema import Schema, SchemaChange, SchemaManager
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import (
+    BigIntType, DoubleType, IntType, RowKind, VarCharType,
+)
+
+VALUE_FIELDS = ["v1", "v2", "name"]
+
+
+class OracleModel:
+    """In-memory replay of per-engine merge semantics.
+
+    Keys are (pt, id); values are plain row dicts.  Mirrors the merge
+    functions the store applies on read/compaction:
+    DeduplicateMergeFunction, FirstRowMergeFunction,
+    PartialUpdateMergeFunction (no sequence groups here — those have
+    dedicated example tests), and the aggregation engine with
+    v1 -> sum, v2 -> max, others -> last_non_null_value.
+    """
+
+    def __init__(self, engine: str):
+        self.engine = engine
+        self.state: Dict[Tuple, Dict] = {}
+        self.fields: List[str] = ["v1", "v2", "name"]
+
+    def add_field(self, name: str):
+        self.fields.append(name)
+        for row in self.state.values():
+            row.setdefault(name, None)
+
+    def apply(self, key: Tuple, values: Dict, kind: int):
+        values = dict(values)
+        for f in self.fields:
+            values.setdefault(f, None)
+        if self.engine == "deduplicate":
+            if kind in (RowKind.INSERT, RowKind.UPDATE_AFTER):
+                self.state[key] = values
+            else:
+                self.state.pop(key, None)
+        elif self.engine == "first-row":
+            self.state.setdefault(key, values)
+        elif self.engine == "partial-update":
+            cur = self.state.setdefault(
+                key, {f: None for f in self.fields})
+            for f, v in values.items():
+                if v is not None:
+                    cur[f] = v
+        elif self.engine == "aggregation":
+            cur = self.state.get(key)
+            if cur is None:
+                self.state[key] = values
+                return
+            if values["v1"] is not None:
+                cur["v1"] = (cur["v1"] or 0) + values["v1"]
+            if values["v2"] is not None:
+                cur["v2"] = values["v2"] if cur["v2"] is None \
+                    else max(cur["v2"], values["v2"])
+            for f in self.fields:
+                if f in ("v1", "v2"):
+                    continue
+                if values.get(f) is not None:
+                    cur[f] = values[f]
+        else:
+            raise ValueError(self.engine)
+
+    def rows(self) -> List[Dict]:
+        out = []
+        for (pt, kid), vals in self.state.items():
+            row = {"pt": pt, "id": kid}
+            row.update({f: vals.get(f) for f in self.fields})
+            out.append(row)
+        return sorted(out, key=lambda r: (r["pt"], r["id"]))
+
+
+def _rows_equal(actual: List[Dict], expected: List[Dict]) -> Optional[str]:
+    if len(actual) != len(expected):
+        return f"row count {len(actual)} != {len(expected)}"
+    for a, e in zip(actual, expected):
+        if set(a) != set(e):
+            return f"columns {sorted(a)} != {sorted(e)}"
+        for f in e:
+            av, ev = a[f], e[f]
+            if isinstance(ev, float) and isinstance(av, float):
+                if not (math.isclose(av, ev, rel_tol=1e-12, abs_tol=1e-12)):
+                    return f"{f}: {av} != {ev} in {a} vs {e}"
+            elif av != ev:
+                return f"{f}: {av!r} != {ev!r} in {a} vs {e}"
+    return None
+
+
+class StoreOracle:
+    """Seeded random workload driver + checker."""
+
+    def __init__(self, path: str, seed: int, engine: str = "deduplicate",
+                 changelog_producer: str = "none", bucket: str = "2",
+                 partitioned: bool = True, key_space: int = 40,
+                 allow_expire: bool = True, allow_schema_add: bool = True):
+        self.rng = random.Random(seed)
+        self.engine = engine
+        self.producer = changelog_producer
+        self.partitioned = partitioned
+        self.key_space = key_space
+        # expiry drops old changelog with it; the changelog-replay check
+        # needs the full stream, so expiry only runs without a producer
+        self.allow_expire = allow_expire and changelog_producer == "none"
+        self.allow_schema_add = allow_schema_add
+        self.model = OracleModel(engine)
+        self.snapshots: Dict[int, List[Dict]] = {}   # sid -> expected rows
+        self.expired: set = set()
+        self.extra_added = False
+
+        b = (Schema.builder()
+             .column("pt", IntType(False))
+             .column("id", BigIntType(False))
+             .column("v1", IntType())
+             .column("v2", DoubleType())
+             .column("name", VarCharType.string_type()))
+        if partitioned:
+            b = b.partition_keys("pt")
+        opts = {"bucket": bucket, "write-only": "true",
+                "merge-engine": engine}
+        if changelog_producer != "none":
+            opts["changelog-producer"] = changelog_producer
+        if engine == "aggregation":
+            opts["fields.v1.aggregate-function"] = "sum"
+            opts["fields.v2.aggregate-function"] = "max"
+        self.table = FileStoreTable.create(
+            path, b.primary_key("pt", "id").options(opts).build())
+
+    # -- workload steps ------------------------------------------------------
+
+    def _gen_row(self) -> Tuple[Tuple, Dict]:
+        pt = self.rng.randrange(3) if self.partitioned else 0
+        kid = self.rng.randrange(self.key_space)
+        vals = {
+            "v1": self.rng.randrange(1000)
+            if self.rng.random() > 0.1 else None,
+            "v2": round(self.rng.uniform(0, 100), 6)
+            if self.rng.random() > 0.1 else None,
+            "name": self.rng.choice(["a", "b", "c", "longer-value", None]),
+        }
+        if self.extra_added:
+            vals["extra"] = self.rng.randrange(50) \
+                if self.rng.random() > 0.3 else None
+        return (pt, kid), vals
+
+    def step_write(self):
+        n = self.rng.randint(1, 40)
+        rows, kinds = [], []
+        for _ in range(n):
+            key, vals = self._gen_row()
+            if self.engine == "deduplicate" and self.rng.random() < 0.15:
+                kind = RowKind.DELETE
+            else:
+                kind = RowKind.INSERT
+            row = {"pt": key[0], "id": key[1]}
+            row.update(vals)
+            rows.append(row)
+            kinds.append(kind)
+            self.model.apply(key, vals, kind)
+        wb = self.table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts(rows, row_kinds=kinds)
+        sid = wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        if sid is not None:
+            self.snapshots[sid] = copy.deepcopy(self.model.rows())
+        return f"write({n})"
+
+    def step_compact(self):
+        full = self.rng.random() < 0.5
+        sid = self.table.compact(full=full)
+        if sid is not None:
+            self.snapshots[sid] = copy.deepcopy(self.model.rows())
+        return f"compact(full={full})"
+
+    def step_expire(self):
+        retain = self.rng.randint(3, 6)
+        latest = self.table.latest_snapshot()
+        self.table.expire_snapshots(retain_max=retain, retain_min=1)
+        if latest is not None:
+            for sid in list(self.snapshots):
+                if sid <= latest.id - retain:
+                    self.expired.add(sid)
+        return f"expire(retain={retain})"
+
+    def step_schema_add(self):
+        sm = SchemaManager(self.table.file_io, self.table.path)
+        sm.commit_changes(SchemaChange.add_column("extra", IntType()))
+        self.table = FileStoreTable.load(self.table.path,
+                                         self.table.file_io)
+        self.model.add_field("extra")
+        self.extra_added = True
+        return "add_column(extra)"
+
+    # -- checks --------------------------------------------------------------
+
+    def check_now(self, context: str):
+        actual = sorted(self.table.to_arrow().to_pylist(),
+                        key=lambda r: (r["pt"], r["id"]))
+        diff = _rows_equal(actual, self.model.rows())
+        assert diff is None, f"after {context}: {diff}"
+
+    def check_time_travel(self, sample: int = 4):
+        live = [s for s in self.snapshots if s not in self.expired]
+        for sid in self.rng.sample(live, min(sample, len(live))):
+            fs_scan = self.table.new_scan()
+            snap = fs_scan.snapshot_manager.snapshot(sid)
+            plan = fs_scan.plan(snapshot=snap)
+            t = self.table.new_read_builder().new_read() \
+                .to_arrow(plan.splits)
+            actual = sorted(t.to_pylist(), key=lambda r: (r["pt"], r["id"]))
+            expected = self.snapshots[sid]
+            if self.extra_added and expected and \
+                    "extra" not in expected[0]:
+                # snapshot predates the add-column; read maps old files
+                # through the current schema with nulls for the new field
+                expected = [dict(r, extra=None) for r in expected]
+            diff = _rows_equal(actual, expected)
+            assert diff is None, f"time-travel snapshot {sid}: {diff}"
+
+    def check_changelog_replay(self):
+        """Drain the changelog stream from the beginning and apply it
+        event-by-event; the result must equal the final model state.
+        Valid for deduplicate (events are whole-row upserts/deletes)."""
+        if self.producer == "none" or self.engine != "deduplicate":
+            return
+        if self.producer in ("lookup", "full-compaction"):
+            # changelog is produced at compaction time; flush the tail
+            sid = self.table.compact(full=True)
+            if sid is not None:
+                self.snapshots[sid] = copy.deepcopy(self.model.rows())
+        scan = self.table.copy({"scan.mode": "from-snapshot-full",
+                                "scan.snapshot-id": "1"}) \
+            .new_read_builder().new_stream_scan()
+        applied: Dict[Tuple, Dict] = {}
+        read = self.table.new_read_builder().new_read()
+        while True:
+            plan = scan.plan()
+            if plan is None:
+                break
+            t = read.to_arrow(plan)
+            for row in t.to_pylist():
+                kind = row.pop("_ROW_KIND", RowKind.INSERT)
+                key = (row["pt"], row["id"])
+                if kind in (RowKind.INSERT, RowKind.UPDATE_AFTER):
+                    applied[key] = row
+                elif kind == RowKind.DELETE:
+                    applied.pop(key, None)
+                # UPDATE_BEFORE: superseded by its UPDATE_AFTER
+        actual = sorted(applied.values(), key=lambda r: (r["pt"], r["id"]))
+        diff = _rows_equal(actual, self.model.rows())
+        assert diff is None, f"changelog replay: {diff}"
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self, steps: int = 20):
+        schema_add_at = self.rng.randrange(steps) \
+            if self.allow_schema_add else -1
+        for i in range(steps):
+            r = self.rng.random()
+            if i == schema_add_at and not self.extra_added:
+                ctx = self.step_schema_add()
+            elif r < 0.70 or self.table.latest_snapshot() is None:
+                ctx = self.step_write()
+            elif r < 0.85:
+                ctx = self.step_compact()
+            elif self.allow_expire:
+                ctx = self.step_expire()
+            else:
+                ctx = self.step_compact()
+            self.check_now(f"step {i}: {ctx}")
+        self.check_time_travel()
+        self.check_changelog_replay()

@@ -1,0 +1,299 @@
+"""Bitmap / BSI / range-bitmap file-index family.
+
+reference tests: paimon-common/src/test/.../fileindex/
+BitmapFileIndexTest.java, BitSliceIndexBitmapTest.java,
+RangeBitmapTest.java, and io/FileIndexEvaluator skip behavior.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.index.bitmap import BSIIndex, BitmapIndex, RangeBitmapIndex
+from paimon_tpu.index.file_index import (
+    build_indexes_blob, evaluate_skip, read_indexes_blob, row_selection,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, IntType, VarCharType
+
+
+def _mask_positions(mask):
+    return sorted(np.flatnonzero(mask).tolist())
+
+
+def _expected(vals, fn):
+    return sorted(i for i, v in enumerate(vals)
+                  if v is not None and fn(v))
+
+
+# -- BitmapIndex -------------------------------------------------------------
+
+class TestBitmapIndex:
+    VALS = [3, 1, None, 3, 7, 1, 9, None, 3, 5]
+
+    def _idx(self, vals=None, typ=pa.int64()):
+        col = pa.chunked_array([pa.array(vals or self.VALS, typ)])
+        idx = BitmapIndex.build(col)
+        # round-trip through the wire format on every test
+        return BitmapIndex.deserialize(idx.serialize())
+
+    def test_eq(self):
+        m, exact = self._idx().eval("eq", 3)
+        assert exact and _mask_positions(m) == [0, 3, 8]
+
+    def test_eq_missing_value(self):
+        m, _ = self._idx().eval("eq", 4)
+        assert not m.any()
+
+    def test_ne_excludes_nulls(self):
+        m, _ = self._idx().eval("ne", 3)
+        assert _mask_positions(m) == _expected(self.VALS, lambda v: v != 3)
+
+    def test_in_and_not_in(self):
+        m, _ = self._idx().eval("in", [1, 9])
+        assert _mask_positions(m) == [1, 5, 6]
+        m, _ = self._idx().eval("not_in", [1, 9])
+        assert _mask_positions(m) == \
+            _expected(self.VALS, lambda v: v not in (1, 9))
+
+    def test_null_ops(self):
+        m, _ = self._idx().eval("is_null", None)
+        assert _mask_positions(m) == [2, 7]
+        m, _ = self._idx().eval("is_not_null", None)
+        assert _mask_positions(m) == \
+            _expected(self.VALS, lambda v: True)
+
+    def test_range_ops_over_sorted_distincts(self):
+        for op, fn in [("lt", lambda v: v < 5), ("le", lambda v: v <= 5),
+                       ("gt", lambda v: v > 3), ("ge", lambda v: v >= 3)]:
+            m, exact = self._idx().eval(op, 5 if op in ("lt", "le") else 3)
+            assert exact and _mask_positions(m) == \
+                _expected(self.VALS, fn), op
+
+    def test_between(self):
+        m, _ = self._idx().eval("between", (3, 7))
+        assert _mask_positions(m) == \
+            _expected(self.VALS, lambda v: 3 <= v <= 7)
+
+    def test_strings_and_starts_with(self):
+        vals = ["apple", "banana", None, "apricot", "cherry", "apple"]
+        idx = self._idx(vals, pa.string())
+        m, _ = idx.eval("eq", "apple")
+        assert _mask_positions(m) == [0, 5]
+        m, exact = idx.eval("starts_with", "ap")
+        assert exact and _mask_positions(m) == [0, 3, 5]
+
+    def test_starts_with_astral_continuation(self):
+        vals = ["a\U0001F600", "ab", "b"]
+        idx = self._idx(vals, pa.string())
+        m, _ = idx.eval("starts_with", "a")
+        assert _mask_positions(m) == [0, 1]
+
+    def test_high_cardinality_declines(self):
+        col = pa.chunked_array([pa.array(list(range(100)), pa.int64())])
+        assert BitmapIndex.build(col, max_distinct=50) is None
+
+
+# -- BSIIndex ----------------------------------------------------------------
+
+class TestBSIIndex:
+    VALS = [100, -3, None, 42, 0, 7, -3, 99999, None, 100]
+
+    def _idx(self):
+        col = pa.chunked_array([pa.array(self.VALS, pa.int64())])
+        return BSIIndex.deserialize(BSIIndex.build(col).serialize())
+
+    @pytest.mark.parametrize("op,lit,fn", [
+        ("eq", 100, lambda v: v == 100),
+        ("ne", 100, lambda v: v != 100),
+        ("lt", 42, lambda v: v < 42),
+        ("le", 42, lambda v: v <= 42),
+        ("gt", 0, lambda v: v > 0),
+        ("ge", 0, lambda v: v >= 0),
+        ("lt", -100, lambda v: False),
+        ("gt", 10 ** 7, lambda v: False),
+        ("le", 10 ** 7, lambda v: True),
+        ("between", (-3, 100), lambda v: -3 <= v <= 100),
+    ])
+    def test_ops(self, op, lit, fn):
+        m, exact = self._idx().eval(op, lit)
+        assert exact and _mask_positions(m) == _expected(self.VALS, fn)
+
+    def test_nulls(self):
+        m, _ = self._idx().eval("is_null", None)
+        assert _mask_positions(m) == [2, 8]
+
+    def test_floats_decline(self):
+        col = pa.chunked_array([pa.array([1.5, 2.5], pa.float64())])
+        assert BSIIndex.build(col) is None
+
+    def test_randomized_vs_numpy(self):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(-10 ** 6, 10 ** 6, 500).tolist()
+        col = pa.chunked_array([pa.array(vals, pa.int64())])
+        idx = BSIIndex.deserialize(BSIIndex.build(col).serialize())
+        for c in [-10 ** 6, -12345, 0, 54321, 10 ** 6]:
+            m, _ = idx.eval("le", c)
+            assert _mask_positions(m) == _expected(vals, lambda v: v <= c)
+
+
+# -- RangeBitmapIndex --------------------------------------------------------
+
+class TestRangeBitmapIndex:
+    def test_superset_semantics(self):
+        rng = np.random.default_rng(11)
+        vals = rng.integers(0, 10 ** 4, 1000).tolist()
+        col = pa.chunked_array([pa.array(vals, pa.int64())])
+        idx = RangeBitmapIndex.deserialize(
+            RangeBitmapIndex.build(col).serialize())
+        for op, lit, fn in [
+                ("lt", 5000, lambda v: v < 5000),
+                ("ge", 2500, lambda v: v >= 2500),
+                ("between", (100, 200), lambda v: 100 <= v <= 200),
+                ("eq", vals[0], lambda v: v == vals[0])]:
+            m, exact = idx.eval(op, lit)
+            truth = set(_expected(vals, fn))
+            got = set(_mask_positions(m))
+            assert truth <= got, (op, lit)   # never drops a match
+
+    def test_out_of_range_skips(self):
+        col = pa.chunked_array([pa.array([10, 20, 30], pa.int64())])
+        idx = RangeBitmapIndex.build(col)
+        m, _ = idx.eval("gt", 1000)
+        assert not m.any()
+        m, _ = idx.eval("lt", 5)
+        assert not m.any()
+
+    def test_negative_values_are_supersets(self):
+        rng = np.random.default_rng(5)
+        vals = rng.integers(-1000, 1000, 777).tolist()
+        col = pa.chunked_array([pa.array(vals, pa.int64())])
+        idx = RangeBitmapIndex.deserialize(
+            RangeBitmapIndex.build(col).serialize())
+        for c in [-1000, -501, -1, 0, 1, 499, 1000]:
+            for op, fn in [("le", lambda v: v <= c),
+                           ("ge", lambda v: v >= c),
+                           ("eq", lambda v: v == c)]:
+                m, _ = idx.eval(op, c)
+                truth = set(_expected(vals, fn))
+                assert truth <= set(_mask_positions(m)), (op, c)
+
+    def test_all_null_column(self):
+        col = pa.chunked_array([pa.array([None, None, None], pa.int64())])
+        idx = RangeBitmapIndex.deserialize(
+            RangeBitmapIndex.build(col).serialize())
+        for op, lit in [("eq", 5), ("lt", 5), ("le", 5), ("gt", 5),
+                        ("ge", 5), ("between", (1, 9))]:
+            m, _ = idx.eval(op, lit)
+            assert m is None or not m.any(), op
+        m, _ = idx.eval("is_null", None)
+        assert _mask_positions(m) == [0, 1, 2]
+
+
+# -- container + evaluator ---------------------------------------------------
+
+def test_blob_round_trip_multi_index():
+    t = pa.table({
+        "a": pa.array([1, 2, 2, 3], pa.int64()),
+        "b": pa.array(["x", "y", None, "x"], pa.string()),
+        "c": pa.array([10, 20, 30, 40], pa.int64()),
+    })
+    blob = build_indexes_blob(t, {"bloom-filter": ["a"], "bitmap": ["b"],
+                                  "bsi": ["c"], "range-bitmap": ["c"]})
+    fi = read_indexes_blob(blob)
+    assert set(fi.by_column) == {"a", "b", "c"}
+    assert len(fi.by_column["c"]) == 2
+
+    assert evaluate_skip(fi, P.equal("b", "zzz"), {})
+    assert not evaluate_skip(fi, P.equal("b", "x"), {})
+    assert evaluate_skip(fi, P.greater_than("c", 100), {})
+    assert evaluate_skip(fi, P.and_(P.equal("b", "y"),
+                                    P.greater_than("c", 35)), {})
+    assert not evaluate_skip(fi, P.or_(P.equal("b", "zzz"),
+                                       P.less_than("c", 15)), {})
+
+    sel = row_selection(fi, P.equal("b", "x"), 4, {})
+    assert _mask_positions(sel) == [0, 3]
+
+
+def test_v1_bloom_blob_still_readable():
+    from paimon_tpu.index.bloom import build_file_index
+    t = pa.table({"a": pa.array([1, 2, 3], pa.int64())})
+    v1 = build_file_index(t, ["a"])
+    fi = read_indexes_blob(v1)
+    assert "a" in fi.by_column
+    assert evaluate_skip(fi, P.equal("a", 999999),
+                         {"a": pa.int64()})
+    assert not evaluate_skip(fi, P.equal("a", 2), {"a": pa.int64()})
+
+
+# -- end-to-end through the table --------------------------------------------
+
+def _append_table(tmp_path, opts):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("city", VarCharType.string_type())
+              .column("n", IntType())
+              .options({"bucket": "-1", **opts})
+              .build())
+    return FileStoreTable.create(str(tmp_path / "t"), schema)
+
+
+def _write(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_scan_skips_files_via_bitmap(tmp_path):
+    table = _append_table(tmp_path, {"file-index.bitmap.columns": "city",
+                                     "file-index.bsi.columns": "n"})
+    _write(table, [{"id": i, "city": "sf", "n": i} for i in range(50)])
+    _write(table, [{"id": i, "city": "nyc", "n": 100 + i}
+                   for i in range(50)])
+    _write(table, [{"id": i, "city": "tok", "n": 200 + i}
+                   for i in range(50)])
+
+    rb = table.new_read_builder().with_filter(P.equal("city", "nyc"))
+    plan = rb.new_scan().plan()
+    files = sum(len(s.data_files) for s in plan.splits)
+    assert files == 1                      # two files skipped by bitmap
+
+    out = rb.new_read().to_arrow(plan.splits)
+    assert out.num_rows == 50
+    assert set(out.column("city").to_pylist()) == {"nyc"}
+
+    # BSI range skip: n >= 200 only lives in the third file
+    rb = table.new_read_builder().with_filter(P.greater_or_equal("n", 200))
+    plan = rb.new_scan().plan()
+    assert sum(len(s.data_files) for s in plan.splits) == 1
+
+
+def test_row_filtering_via_index_selection(tmp_path):
+    table = _append_table(tmp_path, {"file-index.bitmap.columns": "city"})
+    rows = [{"id": i, "city": ["sf", "nyc", "tok"][i % 3], "n": i}
+            for i in range(90)]
+    _write(table, rows)
+    out = table.to_arrow(predicate=P.in_("city", ["sf", "tok"]))
+    assert out.num_rows == 60
+    assert set(out.column("city").to_pylist()) == {"sf", "tok"}
+
+
+def test_pk_table_bitmap_value_skip_is_merge_safe(tmp_path):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("city", VarCharType.string_type())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "file-index.bitmap.columns": "city"})
+              .build())
+    table = FileStoreTable.create(str(tmp_path / "t"), schema)
+    _write(table, [{"id": 1, "city": "sf"}, {"id": 2, "city": "nyc"}])
+    _write(table, [{"id": 1, "city": "tok"}])   # newer version of key 1
+    out = table.to_arrow(predicate=P.equal("city", "sf"))
+    # the sf version of key 1 is superseded; merge must see the newer file
+    assert out.num_rows == 0

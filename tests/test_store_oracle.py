@@ -1,0 +1,62 @@
+"""Randomized store-level oracle sweep.
+
+Mirrors the reference's TestFileStore/TestKeyValueGenerator randomized
+harness (paimon-core/src/test/java/org/apache/paimon/TestFileStore.java):
+random workload interleavings, replayed against an in-memory model.
+
+Fast CI sweep: every (merge engine x changelog producer) cell at a few
+seeds, plus a wider seed sweep on the deduplicate engine.  Long mode:
+ORACLE_SEEDS / ORACLE_STEPS env vars scale the sweep up (e.g.
+ORACLE_SEEDS=50 ORACLE_STEPS=60 python -m pytest tests/test_store_oracle.py).
+"""
+
+import os
+
+import pytest
+
+from tests.store_oracle import StoreOracle
+
+SEEDS = int(os.environ.get("ORACLE_SEEDS", "0"))
+STEPS = int(os.environ.get("ORACLE_STEPS", "18"))
+
+
+@pytest.mark.parametrize("engine,producer", [
+    ("deduplicate", "none"),
+    ("deduplicate", "input"),
+    ("deduplicate", "lookup"),
+    ("deduplicate", "full-compaction"),
+    ("partial-update", "none"),
+    ("partial-update", "lookup"),
+    ("aggregation", "none"),
+    ("aggregation", "full-compaction"),
+    ("first-row", "none"),
+    ("first-row", "lookup"),
+])
+@pytest.mark.parametrize("seed", [11, 42])
+def test_oracle_engine_producer_matrix(tmp_path, engine, producer, seed):
+    oracle = StoreOracle(str(tmp_path / "t"), seed=seed, engine=engine,
+                         changelog_producer=producer)
+    oracle.run(steps=STEPS)
+
+
+@pytest.mark.parametrize("seed", list(range(100, 100 + max(SEEDS, 20))))
+def test_oracle_dedup_seed_sweep(tmp_path, seed):
+    oracle = StoreOracle(str(tmp_path / "t"), seed=seed,
+                         engine="deduplicate", changelog_producer="none")
+    oracle.run(steps=int(os.environ.get("ORACLE_STEPS", "12")))
+
+
+@pytest.mark.parametrize("seed", [7, 23])
+def test_oracle_dynamic_bucket(tmp_path, seed):
+    oracle = StoreOracle(str(tmp_path / "t"), seed=seed,
+                         engine="deduplicate", bucket="-1",
+                         partitioned=False, allow_schema_add=False)
+    oracle.run(steps=12)
+
+
+@pytest.mark.parametrize("seed", [5])
+def test_oracle_single_bucket_unpartitioned(tmp_path, seed):
+    oracle = StoreOracle(str(tmp_path / "t"), seed=seed,
+                         engine="deduplicate", bucket="1",
+                         partitioned=False)
+    oracle.run(steps=15)
