@@ -80,6 +80,13 @@ def compact_table(table, full: bool = False,
     groups, total_buckets = _group_entries(scan, snapshot)
 
     is_append = not table.schema.primary_keys
+    if is_append and table.options.get(CoreOptions.ROW_TRACKING_ENABLED):
+        # row-tracked files own dense id ranges; plain rewrite would
+        # reassign positions and orphan evolution overlays / row-id DVs.
+        # The reference uses dedicated dataevolution compact tasks
+        # (append/dataevolution/DataEvolutionCompactTask.java); until
+        # that lands here, compaction on tracked tables is a no-op.
+        return None
     dv_index = scan._load_deletion_vectors(snapshot.id, snapshot) \
         if is_append else {}
     messages: List[CommitMessage] = []

@@ -268,6 +268,31 @@ class AppendSplitRead:
         self._predicate = predicate
         return self
 
+    def with_row_ids(self, flag: bool = True) -> "AppendSplitRead":
+        """Materialize `_ROW_ID` (file first_row_id + offset) on reads
+        of row-tracked tables (reference SpecialFields.ROW_ID)."""
+        self._with_row_ids = flag
+        return self
+
+    def arrow_type_of(self, column: str):
+        for f in self.schema.fields:
+            if f.name == column:
+                return data_type_to_arrow(f.type)
+        raise KeyError(column)
+
+    def read_file(self, split: DataSplit, meta,
+                  wanted=None) -> pa.Table:
+        """One file, schema-evolved, unfiltered (evolution groups need
+        whole ranges so row positions stay aligned); `wanted` pushes
+        column projection into the format reader."""
+        from paimon_tpu.core.kv_file import read_kv_file
+        t = read_kv_file(self.file_io, self.path_factory,
+                         split.partition, split.bucket, meta, None,
+                         None, schema=self.schema,
+                         schema_manager=self.schema_manager,
+                         wanted=set(wanted) if wanted else None)
+        return self._evolve(t, meta.schema_id)
+
     def _value_columns(self) -> List[str]:
         names = [f.name for f in self.schema.fields]
         if self._projection:
@@ -314,31 +339,68 @@ class AppendSplitRead:
     def read_split(self, split: DataSplit) -> pa.Table:
         from paimon_tpu.core.kv_file import read_kv_file
         from paimon_tpu.core.read import ROW_KIND_COL as RK
+        from paimon_tpu.core.row_tracking import (
+            ROW_ID_COL, anchor_of, group_row_ranges, read_evolution_group,
+        )
 
         wanted = set(self._value_columns())
+        want_rid = getattr(self, "_with_row_ids", False)
+        groups = group_row_ranges(split.data_files)
+        has_evolution = any(len(g) > 1 for g in groups)
+
         tables = []
-        for meta in sorted(split.data_files,
-                           key=lambda f: f.min_sequence_number):
-            t = read_kv_file(self.file_io, self.path_factory,
-                             split.partition, split.bucket, meta, None,
-                             None, schema=self.schema,
-                             schema_manager=self.schema_manager,
-                             wanted=wanted)
-            t = self._evolve(t, meta.schema_id)
-            keep = self._index_selection(split, meta, t.num_rows)
-            if split.deletion_vectors and \
-                    meta.file_name in split.deletion_vectors:
-                dv = split.deletion_vectors[meta.file_name]
-                dv_keep = np.asarray(dv.keep_mask(t.num_rows))
-                keep = dv_keep if keep is None else (keep & dv_keep)
-            if keep is not None:
-                t = t.filter(pa.array(keep))
-            tables.append(t)
+        if has_evolution or want_rid:
+            # row-range path (reference DataEvolutionSplitRead): each
+            # group yields its current rows, columns from newest writers
+            cols = list(self._value_columns())
+            if want_rid:
+                cols.append(ROW_ID_COL)
+            for group in sorted(
+                    groups,
+                    key=lambda g: (anchor_of(g).first_row_id
+                                   if anchor_of(g).first_row_id is not None
+                                   else -1,
+                                   anchor_of(g).min_sequence_number)):
+                anchor = anchor_of(group)
+                if len(group) == 1 and anchor.first_row_id is None:
+                    t = self.read_file(split, anchor) \
+                        .select(self._value_columns())
+                    if want_rid:
+                        t = t.append_column(
+                            ROW_ID_COL, pa.nulls(t.num_rows, pa.int64()))
+                else:
+                    t = read_evolution_group(self, split, group, cols)
+                if split.deletion_vectors and \
+                        anchor.file_name in split.deletion_vectors:
+                    dv = split.deletion_vectors[anchor.file_name]
+                    t = t.filter(pa.array(dv.keep_mask(t.num_rows)))
+                tables.append(t)
+        else:
+            for meta in sorted(split.data_files,
+                               key=lambda f: f.min_sequence_number):
+                t = read_kv_file(self.file_io, self.path_factory,
+                                 split.partition, split.bucket, meta, None,
+                                 None, schema=self.schema,
+                                 schema_manager=self.schema_manager,
+                                 wanted=wanted)
+                t = self._evolve(t, meta.schema_id)
+                keep = self._index_selection(split, meta, t.num_rows)
+                if split.deletion_vectors and \
+                        meta.file_name in split.deletion_vectors:
+                    dv = split.deletion_vectors[meta.file_name]
+                    dv_keep = np.asarray(dv.keep_mask(t.num_rows))
+                    keep = dv_keep if keep is None else (keep & dv_keep)
+                if keep is not None:
+                    t = t.filter(pa.array(keep))
+                tables.append(t)
         out = pa.concat_tables(tables, promote_options="none") if tables \
             else self._empty()
         if self._predicate is not None:
             out = out.filter(self._predicate.to_arrow())
-        out = out.select(self._value_columns())
+        keep_cols = self._value_columns()
+        if want_rid and ROW_ID_COL in out.column_names:
+            keep_cols = keep_cols + [ROW_ID_COL]
+        out = out.select(keep_cols)
         if split.for_streaming:
             out = out.append_column(
                 RK, pa.array(np.zeros(out.num_rows, np.int8), pa.int8()))

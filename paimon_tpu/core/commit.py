@@ -61,6 +61,12 @@ class FileStoreCommit:
             CoreOptions.MANIFEST_TARGET_FILE_SIZE)
         self.manifest_merge_min = options.get(
             CoreOptions.MANIFEST_MERGE_MIN_COUNT)
+        # append tables with row-tracking.enabled get dense row ids
+        # assigned at commit (reference FileStoreCommitImpl
+        # .assignRowTracking:1046)
+        self.row_tracking = (
+            options.get(CoreOptions.ROW_TRACKING_ENABLED)
+            and not table_schema.primary_keys)
 
     # -- public API ----------------------------------------------------------
 
@@ -186,6 +192,7 @@ class FileStoreCommit:
                     statistics: Optional[str] = None) -> int:
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
+        entries_orig = list(entries)
         while True:
             latest = self.snapshot_manager.latest_snapshot()
             if expected_latest_id is not ... and \
@@ -201,6 +208,23 @@ class FileStoreCommit:
                 # overwrite): recompute per attempt; per-attempt manifests
                 # are cleaned up on CAS loss below
                 entries = entries_fn(latest)
+                new_manifest = None
+            next_row_id = latest.next_row_id if latest else None
+            if self.row_tracking and any(
+                    e.kind == FileKind.ADD and e.file.first_row_id is None
+                    for e in entries_orig):
+                # row-id start depends on the latest snapshot, so the
+                # assignment re-runs from the ORIGINAL entries (and the
+                # manifest is rewritten) on every CAS attempt
+                from paimon_tpu.core.row_tracking import assign_row_ids
+                start = next_row_id
+                if start is None:
+                    # tracking enabled on an existing table: ids for old
+                    # files stay unassigned; new ids start past all rows
+                    start = latest.total_record_count if latest else 0
+                entries, next_row_id = assign_row_ids(
+                    entries if entries_fn is not None else entries_orig,
+                    start)
                 new_manifest = None
             if check_deleted_files and latest is not None:
                 self._assert_files_exist(latest, entries)
@@ -262,6 +286,7 @@ class FileStoreCommit:
                 changelog_record_count=changelog_rows or None,
                 properties=properties,
                 statistics=statistics,
+                next_row_id=next_row_id,
             )
             if self.snapshot_manager.try_commit(snapshot):
                 return new_id
@@ -278,9 +303,14 @@ class FileStoreCommit:
             for m in merged_manifests:
                 self.file_io.delete_quietly(
                     self.manifest_file.path(m.file_name))
-            if entries_fn is not None and new_manifest is not None:
+            if (entries_fn is not None or self.row_tracking) and \
+                    new_manifest is not None:
+                # the entry set was rebuilt for this attempt (dynamic
+                # entries or per-attempt row-id assignment): its manifest
+                # is stale too, and must not be referenced by the retry
                 self.file_io.delete_quietly(
                     self.manifest_file.path(new_manifest.file_name))
+                new_manifest = None
 
     def _assert_files_exist(self, latest: Snapshot,
                             entries: List[ManifestEntry]):

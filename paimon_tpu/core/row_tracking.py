@@ -1,0 +1,344 @@
+"""Row tracking + data evolution for append tables.
+
+reference:
+- row-id assignment at commit: `FileStoreCommitImpl.assignRowTracking`
+  (paimon-core/.../operation/FileStoreCommitImpl.java:1046) — every ADD
+  file of an append snapshot gets `firstRowId`, the snapshot records
+  `nextRowId`, and a file's rows own ids [firstRowId, firstRowId+rows).
+- column-level updates: evolution files carry a SUBSET of columns
+  (`DataFileMeta.writeCols`) for an existing row range; reads group
+  files by row range and take each column from the newest file that
+  wrote it, anchored on the oldest full file
+  (operation/DataEvolutionSplitRead.java:190 mergeRangesAndSort +
+  utils/DataEvolutionUtils.retrieveAnchorFile:41).
+- row-id deletes: deletion vectors resolved by row id
+  (append/dataevolution/DataEvolutionCompactDeletionVectorRewriter.java).
+
+TPU-first shape: ranges are dense, so every mapping here is arithmetic
+on numpy vectors — row id -> (file, position) is a searchsorted over
+range starts, update application is one scatter per file, and the
+evolution read assembles Arrow columns without touching row data of
+unchanged columns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.manifest import (
+    DataFileMeta, FileKind, FileSource, ManifestEntry, SimpleStats,
+)
+
+ROW_ID_COL = "_ROW_ID"
+
+__all__ = ["ROW_ID_COL", "assign_row_ids", "group_row_ranges",
+           "read_evolution_group", "update_columns", "delete_by_row_ids"]
+
+
+def assign_row_ids(entries: List[ManifestEntry], start: int
+                   ) -> Tuple[List[ManifestEntry], int]:
+    """Give every ADD entry without a first_row_id a dense id range
+    starting at `start`; returns the rewritten entries and the next free
+    row id (reference FileStoreCommitImpl.assignRowTracking)."""
+    out = []
+    nxt = start
+    for e in entries:
+        if e.kind == FileKind.ADD and e.file.first_row_id is None:
+            out.append(ManifestEntry(
+                e.kind, e.partition, e.bucket, e.total_buckets,
+                replace(e.file, first_row_id=nxt)))
+            nxt += e.file.row_count
+        else:
+            out.append(e)
+    return out, nxt
+
+
+def group_row_ranges(files: Sequence[DataFileMeta]
+                     ) -> List[List[DataFileMeta]]:
+    """Group files whose [first_row_id, first_row_id + rows) ranges
+    overlap; groups come back sorted by range start (reference
+    DataEvolutionSplitRead.mergeRangesAndSort).  Files without a row id
+    each form their own group."""
+    untracked = [f for f in files if f.first_row_id is None]
+    tracked = sorted((f for f in files if f.first_row_id is not None),
+                     key=lambda f: (f.first_row_id, f.max_sequence_number,
+                                    f.file_name))
+    groups: List[List[DataFileMeta]] = [[f] for f in untracked]
+    cur: List[DataFileMeta] = []
+    cur_end = -1
+    for f in tracked:
+        if cur and f.first_row_id < cur_end:
+            cur.append(f)
+            cur_end = max(cur_end, f.first_row_id + f.row_count)
+        else:
+            if cur:
+                groups.append(cur)
+            cur = [f]
+            cur_end = f.first_row_id + f.row_count
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def anchor_of(group: Sequence[DataFileMeta]) -> DataFileMeta:
+    """The oldest file of a range group — the full-row base every
+    evolution file overlays (reference DataEvolutionUtils
+    .retrieveAnchorFile: min by (maxSequenceNumber, fileName))."""
+    return min(group, key=lambda f: (f.max_sequence_number, f.file_name))
+
+
+def _column_source(group: Sequence[DataFileMeta], column: str,
+                   schema_cols: Sequence[str]) -> Optional[DataFileMeta]:
+    """Newest file in the group that wrote `column`."""
+    best = None
+    for f in group:
+        cols = f.write_cols if f.write_cols is not None else schema_cols
+        if column in cols:
+            if best is None or (f.max_sequence_number, f.file_name) > \
+                    (best.max_sequence_number, best.file_name):
+                best = f
+    return best
+
+
+def read_evolution_group(read, split, group: Sequence[DataFileMeta],
+                         wanted: Sequence[str]) -> pa.Table:
+    """Assemble the current rows of one row-range group: each wanted
+    column comes whole from its newest writer; `_ROW_ID` (when in
+    `wanted`) derives from the anchor's first_row_id.  `read` is the
+    AppendSplitRead (supplies file reading + schema evolution)."""
+    anchor = anchor_of(group)
+    schema_cols = [f.name for f in read.schema.fields]
+
+    # plan column -> source file first so every file is read exactly
+    # once with only the columns it supplies (projection pushdown)
+    sources: Dict[str, DataFileMeta] = {}
+    per_file: Dict[str, List[str]] = {}
+    metas: Dict[str, DataFileMeta] = {}
+    for c in wanted:
+        if c == ROW_ID_COL:
+            continue
+        src = _column_source(group, c, schema_cols)
+        if src is None:                   # column added after every file
+            src = anchor
+        sources[c] = src
+        metas[src.file_name] = src
+        per_file.setdefault(src.file_name, []).append(c)
+
+    cache: Dict[str, pa.Table] = {
+        fname: read.read_file(split, metas[fname], wanted=cols)
+        for fname, cols in per_file.items()}
+
+    cols, names = [], []
+    for c in wanted:
+        if c == ROW_ID_COL:
+            continue
+        t = cache[sources[c].file_name]
+        if c in t.column_names:
+            col = t.column(c)
+        else:
+            arrow_t = read.arrow_type_of(c)
+            col = pa.nulls(anchor.row_count, arrow_t)
+        names.append(c)
+        cols.append(col)
+    out = pa.table(dict(zip(names, cols))) if names else \
+        pa.table({"__dummy": pa.nulls(anchor.row_count)}) \
+        .drop_columns(["__dummy"])
+    if ROW_ID_COL in wanted and anchor.first_row_id is not None:
+        rid = pa.array(np.arange(anchor.first_row_id,
+                                 anchor.first_row_id + anchor.row_count,
+                                 dtype=np.int64), pa.int64())
+        out = out.append_column(ROW_ID_COL, rid)
+    return out
+
+
+# -- update by row id --------------------------------------------------------
+
+def update_columns(table, row_ids: np.ndarray,
+                   updates: pa.Table) -> Optional[int]:
+    """Column-level UPDATE: rewrite only the updated columns of the
+    row-range groups that contain `row_ids`, as evolution files sharing
+    the group's first_row_id with write_cols = updated columns
+    (reference append/dataevolution write path).  Unchanged columns'
+    bytes are never rewritten."""
+    from paimon_tpu.core.commit import FileStoreCommit
+    from paimon_tpu.format import get_format
+    from paimon_tpu.format.format import extract_simple_stats
+
+    if len(row_ids) != updates.num_rows:
+        raise ValueError("row_ids and updates must align")
+    if table.primary_keys:
+        raise ValueError("update_columns is for append tables "
+                         "(row-tracking.enabled)")
+    upd_cols = list(updates.column_names)
+    for c in upd_cols:
+        if c not in [f.name for f in table.schema.fields]:
+            raise ValueError(f"unknown column {c!r}")
+
+    order = np.argsort(row_ids, kind="stable")
+    row_ids = np.asarray(row_ids, dtype=np.int64)[order]
+    updates = updates.take(pa.array(order))
+
+    snapshot = table.latest_snapshot()
+    if snapshot is None:
+        return None
+    fs_scan = table.new_scan()
+    plan = fs_scan.plan(snapshot)
+    read = table.new_read_builder().new_read()._read
+    max_seq = max((f.max_sequence_number for s in plan.splits
+                   for f in s.data_files), default=-1) + 1
+
+    new_msgs = []
+    covered = np.zeros(len(row_ids), dtype=bool)
+    for split in plan.splits:
+        for group in group_row_ranges(split.data_files):
+            anchor = anchor_of(group)
+            if anchor.first_row_id is None:
+                continue
+            lo = anchor.first_row_id
+            hi = lo + anchor.row_count
+            a = np.searchsorted(row_ids, lo, side="left")
+            b = np.searchsorted(row_ids, hi, side="left")
+            if a == b:
+                continue
+            covered[a:b] = True
+            local = (row_ids[a:b] - lo).astype(np.int64)
+            current = read_evolution_group(read, split, group, upd_cols)
+            cols_out = {}
+            for c in upd_cols:
+                old = current.column(c).combine_chunks()
+                new_vals = updates.column(c).slice(
+                    a, b - a).combine_chunks().cast(old.type)
+                # vectorized scatter: concat old+new, take with an index
+                # vector whose updated slots point into the new tail
+                combined = pa.concat_arrays([old, new_vals])
+                idx = np.arange(len(old), dtype=np.int64)
+                idx[local] = len(old) + np.arange(len(new_vals),
+                                                  dtype=np.int64)
+                cols_out[c] = combined.take(pa.array(idx))
+            chunk = pa.table(cols_out)
+
+            fmt = get_format(table.options.file_format)
+            name = fs_scan.path_factory.new_data_file_name(fmt.extension)
+            path = fs_scan.path_factory.data_file_path(
+                split.partition, split.bucket, name)
+            size = fmt.create_writer(
+                table.options.file_compression).write(
+                table.file_io, path, chunk)
+            mins, maxs, nulls = extract_simple_stats(chunk, upd_cols)
+            # stats come back in upd_cols order; types must align 1:1
+            by_name = {f.name: f.type for f in table.schema.fields}
+            types = [by_name[c] for c in upd_cols]
+            from paimon_tpu.core.kv_file import _safe_stats
+            meta = DataFileMeta(
+                file_name=name, file_size=size,
+                row_count=anchor.row_count,
+                min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
+                value_stats=_safe_stats(types, mins, maxs, nulls),
+                min_sequence_number=max_seq,
+                max_sequence_number=max_seq,
+                schema_id=table.schema.id, level=0,
+                file_source=FileSource.APPEND,
+                value_stats_cols=upd_cols,
+                first_row_id=anchor.first_row_id,
+                write_cols=upd_cols)
+            from paimon_tpu.core.write import CommitMessage
+            new_msgs.append(CommitMessage(
+                split.partition, split.bucket, split.total_buckets,
+                new_files=[meta]))
+    if not covered.all():
+        missing = row_ids[~covered][:5].tolist()
+        raise ValueError(f"row ids not found in any tracked range "
+                         f"(e.g. {missing}); is row-tracking.enabled on?")
+    if not new_msgs:
+        return None
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    return commit.commit(new_msgs)
+
+
+def delete_by_row_ids(table, row_ids: Sequence[int],
+                      max_retries: int = 5) -> Optional[int]:
+    """Row-id DELETE on a tracked append table: ids resolve to (anchor
+    file, position) by pure range arithmetic — no data reads — and merge
+    into the deletion-vector index (reference row-id keyed DVs).
+    Optimistic like predicate deletes: replans on commit conflicts."""
+    from paimon_tpu.core.commit import CommitConflictError
+
+    for _ in range(max_retries):
+        try:
+            return _delete_by_row_ids_once(table, row_ids)
+        except CommitConflictError:
+            continue
+    raise CommitConflictError(
+        f"delete_by_row_ids lost the race {max_retries} times")
+
+
+def _delete_by_row_ids_once(table, row_ids: Sequence[int]
+                            ) -> Optional[int]:
+    from paimon_tpu.index.deletion_vector import (
+        DeletionVector, DeletionVectorsIndexFile,
+    )
+    from paimon_tpu.index.dv_maintainer import (
+        DELETION_VECTORS_INDEX, replace_bucket_dv_entries,
+    )
+    from paimon_tpu.core.commit import FileStoreCommit
+
+    row_ids = np.unique(np.asarray(list(row_ids), dtype=np.int64))
+    if len(row_ids) == 0:
+        return None
+    snapshot = table.latest_snapshot()
+    if snapshot is None:
+        return None
+    fs_scan = table.new_scan()
+    plan = fs_scan.plan(snapshot)
+
+    prev_entries = []
+    if snapshot.index_manifest:
+        prev_entries = [
+            e for e in
+            fs_scan.index_manifest_file.read(snapshot.index_manifest)
+            if e.index_file.index_type == DELETION_VECTORS_INDEX]
+    dv_index = DeletionVectorsIndexFile(table.file_io,
+                                        f"{table.path}/index")
+    index_entries = []
+    any_change = False
+    covered = np.zeros(len(row_ids), dtype=bool)
+    for split in plan.splits:
+        pbytes = fs_scan._partition_codec.to_bytes(split.partition)
+        bucket_dvs = dict(split.deletion_vectors or {})
+        changed = False
+        for group in group_row_ranges(split.data_files):
+            anchor = anchor_of(group)
+            if anchor.first_row_id is None:
+                continue
+            lo = anchor.first_row_id
+            a = np.searchsorted(row_ids, lo, side="left")
+            b = np.searchsorted(row_ids, lo + anchor.row_count, "left")
+            if a == b:
+                continue
+            covered[a:b] = True
+            positions = (row_ids[a:b] - lo).astype(np.int64)
+            existing = bucket_dvs.get(anchor.file_name)
+            dv = DeletionVector(positions)
+            bucket_dvs[anchor.file_name] = existing.merge(dv) \
+                if existing is not None else dv
+            changed = True
+        if not changed:
+            continue
+        any_change = True
+        index_entries.extend(replace_bucket_dv_entries(
+            fs_scan, pbytes, split.bucket, bucket_dvs, prev_entries,
+            dv_index))
+    if not covered.all():
+        missing = row_ids[~covered][:5].tolist()
+        raise ValueError(f"row ids not found (e.g. {missing})")
+    if not any_change:
+        return None
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    return commit.commit([], index_entries=index_entries,
+                         expected_latest_id=snapshot.id)

@@ -297,6 +297,13 @@ class FileStoreScan:
                     e.file.row_count):
                 return False
         if self._value_filter is not None and not self.schema.primary_keys:
+            if self.options.get(CoreOptions.ROW_TRACKING_ENABLED):
+                # row-tracked append files form row-range groups whose
+                # columns merge across files (evolution overlays); a
+                # per-file stats prune could drop the anchor while its
+                # overlay survives, null-filling every other column on
+                # read — so tracked tables prune only at read time
+                return True
             # append tables: safe to drop individual files on value stats
             if not self._value_stats_match(e):
                 return False

@@ -70,6 +70,28 @@ def _delete_append_dv(table, predicate, max_retries: int = 5
         f"delete_where lost the race {max_retries} times; retry later")
 
 
+def replace_bucket_dv_entries(fs_scan, pbytes, bucket: int,
+                              bucket_dvs: Dict[str, DeletionVector],
+                              prev_entries: List[IndexManifestEntry],
+                              dv_index: DeletionVectorsIndexFile
+                              ) -> List[IndexManifestEntry]:
+    """Write the merged per-bucket DV file and emit the index-manifest
+    DELETE (previous files of this bucket) + ADD (new file) entries —
+    shared by predicate deletes and row-id deletes."""
+    name, size, ranges = dv_index.write(bucket_dvs,
+                                        path_factory=fs_scan.path_factory)
+    total = sum(dv.cardinality() for dv in bucket_dvs.values())
+    entries = [IndexManifestEntry(FileKind.DELETE, e.partition, e.bucket,
+                                  e.index_file)
+               for e in prev_entries
+               if e.partition == pbytes and e.bucket == bucket]
+    entries.append(IndexManifestEntry(
+        FileKind.ADD, pbytes, bucket,
+        IndexFileMeta(DELETION_VECTORS_INDEX, name, size, total,
+                      dv_ranges=ranges)))
+    return entries
+
+
 def _delete_append_dv_once(table, predicate) -> Optional[int]:
     from paimon_tpu.core.kv_file import read_kv_file
     from paimon_tpu.core.read import evolve_table
@@ -120,17 +142,9 @@ def _delete_append_dv_once(table, predicate) -> Optional[int]:
         if not changed:
             continue
         any_change = True
-        name, size, ranges = dv_index.write(
-            bucket_dvs, path_factory=scan.path_factory)
-        total_rows = sum(dv.cardinality() for dv in bucket_dvs.values())
-        for e in prev_entries:
-            if e.partition == pbytes and e.bucket == split.bucket:
-                index_entries.append(IndexManifestEntry(
-                    FileKind.DELETE, e.partition, e.bucket, e.index_file))
-        index_entries.append(IndexManifestEntry(
-            FileKind.ADD, pbytes, split.bucket,
-            IndexFileMeta(DELETION_VECTORS_INDEX, name, size, total_rows,
-                          dv_ranges=ranges)))
+        index_entries.extend(replace_bucket_dv_entries(
+            scan, pbytes, split.bucket, bucket_dvs, prev_entries,
+            dv_index))
 
     if not any_change:
         return None

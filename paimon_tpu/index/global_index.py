@@ -1,0 +1,128 @@
+"""Sorted key -> row-id global index for row-tracked append tables.
+
+reference: paimon-common/src/main/java/org/apache/paimon/globalindex/
+sorted/ (sorted run files probed by binary search) and btree/ (the
+B+-tree variant); union/offset readers combine runs.  The TPU-first
+shape collapses this to one sorted columnar run per build: lookups are
+a single vectorized np.searchsorted over the key column — one probe
+per query key, no tree walks — and rebuilds are a full-column argsort,
+which the device sort kernel handles at millions of rows.
+
+Layout: `{table}/index/global/{column}/index-{snapshot_id}.parquet`
+holding (key, row_id) sorted by key, plus `meta.json` recording the
+snapshot the index was built from (stale indexes rebuild lazily).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+__all__ = ["SortedGlobalIndex"]
+
+
+class SortedGlobalIndex:
+    def __init__(self, table, column: str, keys: pa.Array,
+                 row_ids: np.ndarray, snapshot_id: int):
+        self.table = table
+        self.column = column
+        self.keys = keys                  # sorted
+        self.row_ids = row_ids            # aligned to keys
+        self.snapshot_id = snapshot_id
+        self._np_keys = None
+
+    # -- build / persist -----------------------------------------------------
+
+    @staticmethod
+    def _dir(table, column: str) -> str:
+        return f"{table.path}/index/global/{column}"
+
+    @classmethod
+    def load_or_build(cls, table, column: str,
+                      rebuild: bool = False) -> "SortedGlobalIndex":
+        latest = table.latest_snapshot()
+        if latest is None:
+            raise ValueError("empty table has no index")
+        d = cls._dir(table, column)
+        meta_path = f"{d}/meta.json"
+        if not rebuild:
+            try:
+                meta = json.loads(table.file_io.read_bytes(meta_path))
+                if meta["snapshot_id"] == latest.id and \
+                        meta["column"] == column:
+                    import io as _io
+                    import pyarrow.parquet as pq
+                    data = table.file_io.read_bytes(
+                        f"{d}/{meta['file']}")
+                    t = pq.read_table(_io.BytesIO(data))
+                    return cls(table, column,
+                               t.column("key").combine_chunks(),
+                               np.asarray(t.column("row_id")),
+                               meta["snapshot_id"])
+            except (FileNotFoundError, OSError, KeyError):
+                pass
+        return cls.build(table, column)
+
+    @classmethod
+    def build(cls, table, column: str) -> "SortedGlobalIndex":
+        from paimon_tpu.core.row_tracking import ROW_ID_COL
+        latest = table.latest_snapshot()
+        t = table.to_arrow(projection=[column], with_row_ids=True)
+        # files written before row-tracking.enabled have no ids — they
+        # cannot be indexed, so they drop out rather than poison the run
+        t = t.filter(pc.is_valid(t.column(ROW_ID_COL)))
+        keys = t.column(column).combine_chunks()
+        rids = np.asarray(t.column(ROW_ID_COL).combine_chunks()
+                          .cast(pa.int64()))
+        order = np.asarray(pc.sort_indices(keys)).astype(np.int64)
+        keys = keys.take(pa.array(order))
+        rids = rids[order]
+
+        import io as _io
+        import pyarrow.parquet as pq
+        buf = _io.BytesIO()
+        pq.write_table(pa.table({"key": keys,
+                                 "row_id": pa.array(rids, pa.int64())}),
+                       buf)
+        d = cls._dir(table, column)
+        fname = f"index-{latest.id}.parquet"
+        table.file_io.write_bytes(f"{d}/{fname}", buf.getvalue(),
+                                  overwrite=True)
+        table.file_io.write_bytes(
+            f"{d}/meta.json",
+            json.dumps({"snapshot_id": latest.id, "column": column,
+                        "file": fname,
+                        "num_rows": len(rids)}).encode(),
+            overwrite=True)
+        return cls(table, column, keys, rids, latest.id)
+
+    # -- lookups -------------------------------------------------------------
+
+    def _np(self) -> np.ndarray:
+        if self._np_keys is None:
+            self._np_keys = np.asarray(self.keys)
+        return self._np_keys
+
+    def lookup(self, values: Sequence) -> np.ndarray:
+        """First row id per query value (-1 = absent), one vectorized
+        searchsorted for the whole batch."""
+        ks = self._np()
+        q = np.asarray(list(values), dtype=ks.dtype if len(ks) else None)
+        if len(ks) == 0:
+            return np.full(len(q), -1, dtype=np.int64)
+        pos = np.searchsorted(ks, q, side="left")
+        pos_c = np.minimum(pos, len(ks) - 1)
+        hit = (pos < len(ks)) & (ks[pos_c] == q)
+        out = np.where(hit, self.row_ids[pos_c], -1)
+        return out.astype(np.int64)
+
+    def lookup_all(self, value) -> np.ndarray:
+        """Every row id bearing `value` (duplicate keys allowed)."""
+        ks = self._np()
+        lo = np.searchsorted(ks, value, side="left")
+        hi = np.searchsorted(ks, value, side="right")
+        return self.row_ids[lo:hi].astype(np.int64)

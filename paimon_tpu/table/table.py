@@ -125,12 +125,15 @@ class FileStoreTable:
     # -- convenience ---------------------------------------------------------
 
     def to_arrow(self, projection: Optional[List[str]] = None,
-                 predicate: Optional[Predicate] = None) -> pa.Table:
+                 predicate: Optional[Predicate] = None,
+                 with_row_ids: bool = False) -> pa.Table:
         rb = self.new_read_builder()
         if projection:
             rb = rb.with_projection(projection)
         if predicate is not None:
             rb = rb.with_filter(predicate)
+        if with_row_ids:
+            rb = rb.with_row_ids()
         scan = rb.new_scan()
         return rb.new_read().to_arrow(scan.plan().splits)
 
@@ -183,6 +186,29 @@ class FileStoreTable:
         BucketedDvMaintainer)."""
         from paimon_tpu.index.dv_maintainer import delete_where
         return delete_where(self, predicate)
+
+    # -- row tracking / data evolution ---------------------------------------
+
+    def update_columns(self, row_ids, updates) -> Optional[int]:
+        """Column-level UPDATE by row id on a row-tracked append table:
+        only the touched columns of the touched row ranges are rewritten
+        as evolution files (reference append/dataevolution/,
+        operation/DataEvolutionSplitRead.java)."""
+        from paimon_tpu.core.row_tracking import update_columns
+        return update_columns(self, row_ids, updates)
+
+    def delete_by_row_ids(self, row_ids) -> Optional[int]:
+        """DELETE by row id: pure range arithmetic into deletion
+        vectors, no data reads (reference row-id keyed append DVs)."""
+        from paimon_tpu.core.row_tracking import delete_by_row_ids
+        return delete_by_row_ids(self, row_ids)
+
+    def global_index(self, column: str, rebuild: bool = False):
+        """Sorted key -> row-id global index over a row-tracked append
+        table (reference paimon-common/.../globalindex/sorted/)."""
+        from paimon_tpu.index.global_index import SortedGlobalIndex
+        return SortedGlobalIndex.load_or_build(self, column,
+                                               rebuild=rebuild)
 
     # -- maintenance ---------------------------------------------------------
 
@@ -417,6 +443,11 @@ class ReadBuilder:
         self._limit = limit
         return self
 
+    def with_row_ids(self, flag: bool = True) -> "ReadBuilder":
+        """Materialize `_ROW_ID` on append-table reads (row tracking)."""
+        self._with_row_ids = flag
+        return self
+
     def new_scan(self) -> "TableScan":
         return TableScan(self)
 
@@ -531,6 +562,8 @@ class TableRead:
             self._read = AppendSplitRead(
                 table.file_io, table.path, table.schema, table.options,
                 schema_manager=table.schema_manager)
+            if getattr(builder, "_with_row_ids", False):
+                self._read.with_row_ids(True)
         if builder._projection:
             self._read.with_projection(builder._projection)
         if builder._predicate is not None:
@@ -551,10 +584,14 @@ class TableRead:
     def _finalize(self, t: pa.Table) -> pa.Table:
         if self.builder._projection:
             from paimon_tpu.core.read import ROW_KIND_COL
+            from paimon_tpu.core.row_tracking import ROW_ID_COL
             cols = [c for c in self.builder._projection
                     if c in t.column_names]
             if ROW_KIND_COL in t.column_names:
                 cols.append(ROW_KIND_COL)
+            if ROW_ID_COL in t.column_names and \
+                    getattr(self.builder, "_with_row_ids", False):
+                cols.append(ROW_ID_COL)
             t = t.select(cols)
         if self.builder._limit is not None:
             t = t.slice(0, self.builder._limit)

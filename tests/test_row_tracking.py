@@ -1,0 +1,180 @@
+"""Row tracking + data evolution: row ids, column updates, row-id
+deletes, sorted global index.
+
+reference: operation/FileStoreCommitImpl.assignRowTracking (id
+assignment), operation/DataEvolutionSplitRead.java (row-range column
+merge), append/dataevolution/ (update path), globalindex/sorted/.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType, VarCharType
+
+
+def tracked_table(tmp_path, **opts):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("name", VarCharType.string_type())
+              .column("score", DoubleType())
+              .options({"bucket": "-1", "row-tracking.enabled": "true",
+                        **opts})
+              .build())
+    return FileStoreTable.create(str(tmp_path / "t"), schema)
+
+
+def write(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def test_row_ids_assigned_densely_across_commits(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": f"n{i}", "score": float(i)}
+              for i in range(10)])
+    assert t.latest_snapshot().next_row_id == 10
+    write(t, [{"id": 10 + i, "name": "x", "score": 0.0}
+              for i in range(5)])
+    assert t.latest_snapshot().next_row_id == 15
+    out = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID")
+    assert out.column("_ROW_ID").to_pylist() == list(range(15))
+    assert out.column("id").to_pylist() == list(range(15))
+
+
+def test_file_meta_carries_first_row_id(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": 1, "name": "a", "score": 1.0}])
+    write(t, [{"id": 2, "name": "b", "score": 2.0}])
+    files = sorted((f for s in t.new_read_builder().new_scan().plan()
+                    .splits for f in s.data_files),
+                   key=lambda f: f.first_row_id)
+    assert [f.first_row_id for f in files] == [0, 1]
+
+
+def test_update_columns_rewrites_only_touched_columns(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": f"n{i}", "score": float(i)}
+              for i in range(20)])
+    sid = t.update_columns(
+        np.array([3, 7, 15]),
+        pa.table({"score": pa.array([30.0, 70.0, 150.0])}))
+    assert sid is not None
+    out = t.to_arrow().sort_by("id").to_pylist()
+    assert out[3]["score"] == 30.0 and out[7]["score"] == 70.0 \
+        and out[15]["score"] == 150.0
+    assert out[4]["score"] == 4.0
+    # names untouched
+    assert [r["name"] for r in out] == [f"n{i}" for i in range(20)]
+    # the evolution file wrote only the score column
+    files = [f for s in t.new_read_builder().new_scan().plan().splits
+             for f in s.data_files]
+    evo = [f for f in files if f.write_cols is not None]
+    assert evo and all(f.write_cols == ["score"] for f in evo)
+    base = [f for f in files if f.write_cols is None]
+    assert all(f.first_row_id is not None for f in base)
+
+
+def test_update_layering_newest_wins(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": "a", "score": 0.0} for i in range(8)])
+    t.update_columns(np.array([2]), pa.table({"score": [20.0]}))
+    t.update_columns(np.array([2, 3]),
+                     pa.table({"score": [200.0, 30.0]}))
+    out = t.to_arrow().sort_by("id").to_pylist()
+    assert out[2]["score"] == 200.0 and out[3]["score"] == 30.0
+
+
+def test_update_two_columns_and_row_ids_survive(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": "a", "score": 0.0} for i in range(6)])
+    t.update_columns(
+        np.array([1, 4]),
+        pa.table({"name": ["u1", "u4"], "score": [1.0, 4.0]}))
+    out = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID").to_pylist()
+    assert out[1]["name"] == "u1" and out[4]["score"] == 4.0
+    assert [r["_ROW_ID"] for r in out] == list(range(6))
+
+
+def test_update_unknown_row_id_raises(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": 0, "name": "a", "score": 0.0}])
+    with pytest.raises(ValueError, match="not found"):
+        t.update_columns(np.array([99]), pa.table({"score": [1.0]}))
+
+
+def test_delete_by_row_ids(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": "a", "score": float(i)}
+              for i in range(10)])
+    sid = t.delete_by_row_ids([2, 5, 9])
+    assert sid is not None
+    out = t.to_arrow(with_row_ids=True)
+    assert sorted(out.column("_ROW_ID").to_pylist()) == \
+        [0, 1, 3, 4, 6, 7, 8]
+
+
+def test_delete_then_update_coexist(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": "a", "score": 0.0} for i in range(10)])
+    t.delete_by_row_ids([0, 1])
+    t.update_columns(np.array([5]), pa.table({"score": [55.0]}))
+    out = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID").to_pylist()
+    assert [r["_ROW_ID"] for r in out] == list(range(2, 10))
+    assert [r for r in out if r["_ROW_ID"] == 5][0]["score"] == 55.0
+
+
+def test_compact_is_noop_on_tracked_tables(tmp_path):
+    t = tracked_table(tmp_path)
+    for i in range(4):
+        write(t, [{"id": i, "name": "a", "score": 0.0}])
+    assert t.compact(full=True) is None
+    out = t.to_arrow(with_row_ids=True)
+    assert sorted(out.column("_ROW_ID").to_pylist()) == [0, 1, 2, 3]
+
+
+def test_global_index_lookup_and_update_by_key(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": 100 - i, "name": f"k{i}", "score": float(i)}
+              for i in range(50)])
+    gi = t.global_index("id")
+    rids = gi.lookup([100, 51, 77, 9999])
+    out = t.to_arrow(with_row_ids=True)
+    by_rid = {r["_ROW_ID"]: r for r in out.to_pylist()}
+    assert by_rid[rids[0]]["id"] == 100
+    assert by_rid[rids[1]]["id"] == 51
+    assert by_rid[rids[2]]["id"] == 77
+    assert rids[3] == -1
+
+    # update-by-key: index -> row ids -> column update
+    targets = gi.lookup([80, 60])
+    t.update_columns(targets, pa.table({"score": [800.0, 600.0]}))
+    out = t.to_arrow(predicate=P.in_("id", [80, 60])).to_pylist()
+    assert sorted(r["score"] for r in out) == [600.0, 800.0]
+
+
+def test_global_index_rebuild_on_new_snapshot(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": 1, "name": "a", "score": 0.0}])
+    gi = t.global_index("id")
+    assert gi.lookup([1])[0] == 0
+    write(t, [{"id": 2, "name": "b", "score": 0.0}])
+    gi2 = t.global_index("id")        # stale meta -> rebuild
+    assert gi2.lookup([2])[0] == 1
+    # cached load when snapshot unchanged
+    gi3 = t.global_index("id")
+    assert gi3.snapshot_id == gi2.snapshot_id
+
+
+def test_row_ids_with_projection(tmp_path):
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": "a", "score": 0.0} for i in range(3)])
+    out = t.to_arrow(projection=["id"], with_row_ids=True)
+    assert out.column_names == ["id", "_ROW_ID"]
