@@ -363,7 +363,9 @@ class AppendSplitRead:
                                    anchor_of(g).min_sequence_number)):
                 anchor = anchor_of(group)
                 if len(group) == 1 and anchor.first_row_id is None:
-                    t = self.read_file(split, anchor) \
+                    t = self.read_file(
+                        split, anchor,
+                        wanted=self._value_columns()) \
                         .select(self._value_columns())
                     if want_rid:
                         t = t.append_column(

@@ -210,22 +210,24 @@ class FileStoreCommit:
                 entries = entries_fn(latest)
                 new_manifest = None
             next_row_id = latest.next_row_id if latest else None
+            candidates = entries if entries_fn is not None else \
+                entries_orig
+            ids_assigned = False
             if self.row_tracking and any(
                     e.kind == FileKind.ADD and e.file.first_row_id is None
-                    for e in entries_orig):
+                    for e in candidates):
                 # row-id start depends on the latest snapshot, so the
-                # assignment re-runs from the ORIGINAL entries (and the
-                # manifest is rewritten) on every CAS attempt
+                # assignment re-runs from the pre-assignment entries
+                # (and the manifest is rewritten) on every CAS attempt
                 from paimon_tpu.core.row_tracking import assign_row_ids
                 start = next_row_id
                 if start is None:
                     # tracking enabled on an existing table: ids for old
                     # files stay unassigned; new ids start past all rows
                     start = latest.total_record_count if latest else 0
-                entries, next_row_id = assign_row_ids(
-                    entries if entries_fn is not None else entries_orig,
-                    start)
+                entries, next_row_id = assign_row_ids(candidates, start)
                 new_manifest = None
+                ids_assigned = True
             if check_deleted_files and latest is not None:
                 self._assert_files_exist(latest, entries)
 
@@ -303,7 +305,7 @@ class FileStoreCommit:
             for m in merged_manifests:
                 self.file_io.delete_quietly(
                     self.manifest_file.path(m.file_name))
-            if (entries_fn is not None or self.row_tracking) and \
+            if (entries_fn is not None or ids_assigned) and \
                     new_manifest is not None:
                 # the entry set was rebuilt for this attempt (dynamic
                 # entries or per-attempt row-id assignment): its manifest

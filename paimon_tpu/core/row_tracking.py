@@ -157,13 +157,31 @@ def read_evolution_group(read, split, group: Sequence[DataFileMeta],
 
 # -- update by row id --------------------------------------------------------
 
-def update_columns(table, row_ids: np.ndarray,
-                   updates: pa.Table) -> Optional[int]:
+def update_columns(table, row_ids: np.ndarray, updates: pa.Table,
+                   max_retries: int = 5) -> Optional[int]:
     """Column-level UPDATE: rewrite only the updated columns of the
     row-range groups that contain `row_ids`, as evolution files sharing
     the group's first_row_id with write_cols = updated columns
     (reference append/dataevolution write path).  Unchanged columns'
-    bytes are never rewritten."""
+    bytes are never rewritten.
+
+    Optimistic: the overlay bakes in the CURRENT values of untouched
+    rows, so the commit asserts the planning snapshot is still latest
+    and replans on conflict — otherwise two concurrent updates of one
+    range would silently revert each other's rows."""
+    from paimon_tpu.core.commit import CommitConflictError
+
+    for _ in range(max_retries):
+        try:
+            return _update_columns_once(table, row_ids, updates)
+        except CommitConflictError:
+            continue
+    raise CommitConflictError(
+        f"update_columns lost the race {max_retries} times")
+
+
+def _update_columns_once(table, row_ids: np.ndarray,
+                         updates: pa.Table) -> Optional[int]:
     from paimon_tpu.core.commit import FileStoreCommit
     from paimon_tpu.format import get_format
     from paimon_tpu.format.format import extract_simple_stats
@@ -257,7 +275,7 @@ def update_columns(table, row_ids: np.ndarray,
         return None
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)
-    return commit.commit(new_msgs)
+    return commit.commit(new_msgs, expected_latest_id=snapshot.id)
 
 
 def delete_by_row_ids(table, row_ids: Sequence[int],

@@ -115,11 +115,26 @@ def _delete_append_dv_once(table, predicate) -> Optional[int]:
     schema_cache = {table.schema.id: table.schema}
     index_entries: List[IndexManifestEntry] = []
     any_change = False
+    from paimon_tpu.options import CoreOptions
+    tracked = table.options.get(CoreOptions.ROW_TRACKING_ENABLED)
     for split in plan.splits:
         pbytes = scan._partition_codec.to_bytes(split.partition)
         bucket_dvs: Dict[str, DeletionVector] = dict(
             split.deletion_vectors or {})
         changed = False
+        if tracked:
+            # row-tracked files form evolution groups whose CURRENT
+            # values merge across overlays; evaluate the predicate on
+            # the merged view and key the DV on the group's anchor
+            # (the only file whose DV the evolution read applies)
+            changed = _delete_tracked_groups(
+                table, split, predicate, bucket_dvs)
+            if changed:
+                any_change = True
+                index_entries.extend(replace_bucket_dv_entries(
+                    scan, pbytes, split.bucket, bucket_dvs,
+                    prev_entries, dv_index))
+            continue
         for meta in split.data_files:
             t = read_kv_file(table.file_io, scan.path_factory,
                              split.partition, split.bucket, meta, None,
@@ -153,6 +168,39 @@ def _delete_append_dv_once(table, predicate) -> Optional[int]:
                              table.options, branch=table.branch)
     return commit.commit([], index_entries=index_entries,
                          expected_latest_id=snapshot.id)
+
+
+def _delete_tracked_groups(table, split, predicate, bucket_dvs) -> bool:
+    """Predicate delete over evolution groups: read each row-range
+    group's merged current values, mask, and DV the anchor file."""
+    from paimon_tpu.core.append import AppendSplitRead
+    from paimon_tpu.core.row_tracking import (
+        anchor_of, group_row_ranges, read_evolution_group,
+    )
+
+    read = AppendSplitRead(table.file_io, table.path, table.schema,
+                           table.options,
+                           schema_manager=table.schema_manager)
+    fields = sorted(set(predicate.fields()))
+    changed = False
+    for group in group_row_ranges(split.data_files):
+        anchor = anchor_of(group)
+        current = read_evolution_group(read, split, group, fields) \
+            if anchor.first_row_id is not None or len(group) > 1 \
+            else read.read_file(split, anchor, wanted=fields)
+        mask = _eval_predicate(predicate, current)
+        existing = bucket_dvs.get(anchor.file_name)
+        if existing is not None:
+            mask[existing.positions[existing.positions < len(mask)]] = \
+                False
+        positions = np.flatnonzero(mask)
+        if len(positions) == 0:
+            continue
+        changed = True
+        dv = DeletionVector(positions)
+        bucket_dvs[anchor.file_name] = existing.merge(dv) \
+            if existing is not None else dv
+    return changed
 
 
 def _eval_predicate(predicate, t: pa.Table) -> np.ndarray:

@@ -12,3 +12,9 @@ from paimon_tpu.parallel.sharded_merge import (  # noqa: F401
     ShardedBucketMerge, bucket_mesh, merge_buckets_sharded,
     pad_bucket_batches,
 )
+from paimon_tpu.parallel.sharded_compact import (  # noqa: F401
+    ShardedCompactStats, compact_table_sharded,
+)
+from paimon_tpu.parallel.rescale import (  # noqa: F401
+    rescale_dispatch_sharded, rescale_table_buckets,
+)

@@ -1,11 +1,20 @@
-"""Multichip dryrun: one full sharded write→merge→commit-stats step.
+"""Multichip dryrun: sharded write -> end-to-end mesh compaction ->
+all_to_all bucket rescale, at >= 1M rows.
 
-This is the library path the driver's `dryrun_multichip` exercises: a real
-multi-bucket primary-key table is written through the normal write/commit
-plane, every bucket's runs are encoded to key lanes, and all buckets merge
-in ONE mesh-sharded kernel launch (buckets sharded over devices, commit
-row-count reduced with psum). Shapes are tiny; the point is that the
-sharded program compiles and executes.
+This is the library path the driver's `dryrun_multichip` exercises: a
+real multi-bucket primary-key table is written through the normal
+write/commit plane, then
+
+1. `compact_table_sharded` runs EVERY bucket's full compaction in one
+   mesh program (bucket-axis sharding, vmapped segmented merge, commit
+   stats psum'd on device) and commits the COMPACT snapshot;
+2. `rescale_table_buckets` re-routes every row to 2x the buckets with
+   the all_to_all dispatch collective and commits the overwrite;
+3. the read-back after both is checked against the pre-compaction
+   merge-on-read state.
+
+Scale: DRYRUN_ROWS rows (default 1,000,000) so the dryrun proves
+meaningful data volumes, not just compilation.
 """
 
 from __future__ import annotations
@@ -31,14 +40,17 @@ def run(n_devices: int) -> None:
     import numpy as np
     import pyarrow as pa
 
-    from paimon_tpu.ops.merge import SEQ_COL
-    from paimon_tpu.parallel import bucket_mesh, merge_buckets_sharded
+    from paimon_tpu.parallel import (
+        bucket_mesh, compact_table_sharded, rescale_table_buckets,
+    )
     from paimon_tpu.schema import Schema
     from paimon_tpu.table import FileStoreTable
     from paimon_tpu.types import BigIntType, DoubleType
 
     n_buckets = n_devices
-    rows_per_commit = 256
+    # write-path flush pre-merges duplicate keys, so size the keyspace
+    # so that >= 1M rows survive into the sharded compaction itself
+    total_rows = int(os.environ.get("DRYRUN_ROWS", "1300000"))
 
     with tempfile.TemporaryDirectory() as tmp:
         schema = (Schema.builder()
@@ -52,7 +64,7 @@ def run(n_devices: int) -> None:
         rng = np.random.default_rng(0)
         # two commits -> two overlapping L0 runs per bucket
         for _ in range(2):
-            ids = rng.integers(0, rows_per_commit, rows_per_commit * 2)
+            ids = rng.integers(0, total_rows, total_rows // 2)
             data = pa.table({
                 "id": pa.array(ids, pa.int64()),
                 "v": pa.array(rng.random(len(ids)), pa.float64()),
@@ -63,37 +75,28 @@ def run(n_devices: int) -> None:
             wb.new_commit().commit(w.prepare_commit())
             w.close()
 
-        # plan all buckets, encode key lanes per bucket with the SAME
-        # encoder/key columns the real read path derives from the schema
-        splits = table.new_read_builder().new_scan().plan().splits
-        assert splits, "no splits planned"
-        from paimon_tpu.core.kv_file import read_kv_file
-        from paimon_tpu.core.read import MergeFileSplitRead
-        reader = MergeFileSplitRead(table.file_io, table.path, table.schema,
-                                    table.options)
-        encoder = reader.key_encoder
-        lanes_list, seq_list, n_input = [], [], 0
-        for s in splits:
-            runs = []
-            for f in s.data_files:
-                runs.append(read_kv_file(
-                    reader.file_io, reader.path_factory, s.partition,
-                    s.bucket, f, None, None))
-            t = pa.concat_tables(runs, promote_options="none")
-            lanes, _ = encoder.encode_table(t, reader.key_cols)
-            seq = np.asarray(t.column(SEQ_COL).combine_chunks()
-                             .cast(pa.int64()))
-            lanes_list.append(lanes)
-            seq_list.append(seq)
-            n_input += t.num_rows
+        expected = table.to_arrow().num_rows   # merge-on-read truth
+        n_input = sum(
+            f.row_count for s in
+            table.new_read_builder().new_scan().plan().splits
+            for f in s.data_files)
 
         mesh = bucket_mesh(n_devices)
-        winners, total = merge_buckets_sharded(lanes_list, seq_list, mesh)
-        assert len(winners) == len(splits)
-        assert 0 < total <= n_input, (total, n_input)
-        # cross-check against the sequential single-chip read path
-        seq_total = table.to_arrow().num_rows
-        assert total == seq_total, (total, seq_total)
+        stats = compact_table_sharded(table, mesh)
+        assert stats.snapshot_id is not None
+        assert stats.buckets == n_buckets, (stats.buckets, n_buckets)
+        assert stats.output_rows == expected, (stats.output_rows,
+                                               expected)
+        assert table.latest_snapshot().commit_kind == "COMPACT"
+
+        sid = rescale_table_buckets(table, 2 * n_buckets, mesh=mesh)
+        assert sid is not None
+        table2 = FileStoreTable.load(table.path)
+        assert table2.options.bucket == 2 * n_buckets
+        after = table2.to_arrow().num_rows
+        assert after == expected, (after, expected)
+
         print(f"dryrun_multichip OK: {n_devices} devices, "
-              f"{len(splits)} buckets, {n_input} input rows -> "
-              f"{total} merged rows (psum over mesh)")
+              f"{n_buckets}->{2 * n_buckets} buckets, "
+              f"{n_input} input rows -> {expected} merged rows "
+              f"(sharded compact + all_to_all rescale on mesh)")

@@ -145,6 +145,15 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
+    def rescale_buckets(self, new_buckets: int, mesh=None
+                        ) -> Optional[int]:
+        """Change a fixed-bucket pk table's bucket count: the device
+        mesh computes the row routing (abs(hash % B) + all_to_all
+        repartition), the host rewrites files and commits an overwrite
+        (reference rescale-bucket procedure via ChannelComputer)."""
+        from paimon_tpu.parallel.rescale import rescale_table_buckets
+        return rescale_table_buckets(self, new_buckets, mesh=mesh)
+
     def rescale_postpone(self) -> Optional[int]:
         """Move bucket-postpone staging data into real buckets (reference
         postpone/ rescale job; bucket=-2 tables)."""

@@ -131,6 +131,25 @@ def test_delete_then_update_coexist(tmp_path):
     assert [r for r in out if r["_ROW_ID"] == 5][0]["score"] == 55.0
 
 
+def test_delete_where_sees_updated_values(tmp_path):
+    """Predicate deletes must evaluate the evolution-merged CURRENT
+    values, not each physical file's stale columns."""
+    t = tracked_table(tmp_path)
+    write(t, [{"id": i, "name": "a", "score": float(i)}
+              for i in range(10)])
+    # row 3's score becomes 50; row 5 keeps score 5
+    t.update_columns(np.array([3]), pa.table({"score": [50.0]}))
+    t.delete_where(P.equal("score", 5.0))      # must delete row 5 only
+    out = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID").to_pylist()
+    ids = [r["_ROW_ID"] for r in out]
+    assert 5 not in ids and 3 in ids
+    assert [r for r in out if r["_ROW_ID"] == 3][0]["score"] == 50.0
+    # deleting by the NEW value must hit the updated row
+    t.delete_where(P.equal("score", 50.0))
+    ids = t.to_arrow(with_row_ids=True).column("_ROW_ID").to_pylist()
+    assert 3 not in ids
+
+
 def test_compact_is_noop_on_tracked_tables(tmp_path):
     t = tracked_table(tmp_path)
     for i in range(4):
