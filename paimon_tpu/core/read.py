@@ -123,7 +123,11 @@ def evolve_table(table: pa.Table, file_schema_id: int, schema: TableSchema,
         else:
             col = table.column(old_f.name)
             if col.type != arrow_t:
-                col = col.cast(arrow_t)
+                # evolve-time type change: apply the CastExecutor rule
+                # matrix (Java narrowing/parse/temporal semantics), not
+                # the bare Arrow cast (paimon-common casting/)
+                from paimon_tpu.data.casting import cast_array
+                col = cast_array(col, old_f.type, f.type)
             cols[f.name] = col
     return pa.table(cols)
 

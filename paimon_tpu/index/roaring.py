@@ -132,3 +132,74 @@ def deserialize_roaring32(data: bytes) -> np.ndarray:
     if not out:
         return np.zeros(0, dtype=np.uint32)
     return np.concatenate(out)
+
+
+def serialize_roaring64(positions: "np.ndarray") -> bytes:
+    """RoaringBitmap64 portable wire format (reference
+    utils/RoaringBitmap64.java -> Roaring64NavigableMap portable
+    serialization): u64 LE bucket count, then per bucket the u32 high
+    word + the bucket's roaring32 bytes, highs ascending."""
+    positions = np.asarray(positions, dtype=np.uint64)
+    positions = np.unique(positions)
+    highs = (positions >> np.uint64(32)).astype(np.uint32)
+    lows = (positions & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    parts = [struct.pack("<Q", len(np.unique(highs)))]
+    for h in np.unique(highs):
+        sel = highs == h
+        parts.append(struct.pack("<I", int(h)))
+        parts.append(serialize_roaring32(lows[sel]))
+    return b"".join(parts)
+
+
+def deserialize_roaring64(data: bytes) -> "np.ndarray":
+    mv = memoryview(data)
+    (n,) = struct.unpack_from("<Q", data, 0)
+    p = 8
+    out: List[np.ndarray] = []
+    for _ in range(n):
+        (high,) = struct.unpack_from("<I", data, p)
+        p += 4
+        end = p + _roaring32_size(data, p)
+        # memoryview slice: no tail copy per bucket
+        lows = deserialize_roaring32(mv[p:end])
+        p = end
+        out.append((np.uint64(high) << np.uint64(32))
+                   | lows.astype(np.uint64))
+    if not out:
+        return np.zeros(0, dtype=np.uint64)
+    return np.concatenate(out)
+
+
+def _roaring32_size(data: bytes, off: int) -> int:
+    """Byte length of the roaring32 stream starting at `off` (needed
+    when streams are concatenated, as in roaring64); computed from the
+    header + per-container cardinalities without copying the payload."""
+    (cookie,) = struct.unpack_from("<I", data, off)
+    if (cookie & 0xFFFF) == SERIAL_COOKIE:
+        n = (cookie >> 16) + 1
+        p = off + 4 + (n + 7) // 8
+        has_offsets = n >= NO_OFFSET_THRESHOLD
+        flags = np.frombuffer(data, np.uint8, (n + 7) // 8, off + 4)
+        run_flags = np.unpackbits(flags, bitorder="little")[:n]
+    elif cookie == SERIAL_COOKIE_NO_RUNCONTAINER:
+        (n,) = struct.unpack_from("<I", data, off + 4)
+        p = off + 8
+        has_offsets = True
+        run_flags = None
+    else:
+        raise ValueError(f"bad roaring cookie {cookie}")
+    keys_cards = np.frombuffer(data, "<u2", 2 * n, p).reshape(n, 2)
+    p += 4 * n
+    if has_offsets:
+        p += 4 * n
+    end = p
+    for i in range(n):
+        card = int(keys_cards[i, 1]) + 1
+        if run_flags is not None and run_flags[i]:
+            (n_runs,) = struct.unpack_from("<H", data, end)
+            end += 2 + 4 * n_runs
+        elif card <= ARRAY_MAX:
+            end += 2 * card
+        else:
+            end += 8 * 1024
+    return end - off

@@ -298,13 +298,23 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
                 out_cols[name] = pa.array(result, pa.int64())
                 continue
             if func == "sum":
-                signed = np.where(retract, -vals, vals)
+                ignore_retract = options.options.get_or(
+                    f"fields.{name}.ignore-retract", "false") == "true"
+                if ignore_retract:
+                    # reference FieldIgnoreRetractAgg: retracts are
+                    # no-ops instead of subtracting, and do not count
+                    # as a contribution (all-retract segment -> null)
+                    signed = np.where(retract, 0, vals)
+                    contributed = valid & ~retract
+                else:
+                    signed = np.where(retract, -vals, vals)
+                    contributed = valid
                 signed = np.where(valid, signed, 0)
                 dev = _seg_sum(jnp.asarray(signed), jnp.asarray(seg_id),
                                num_seg)
                 result = np.asarray(dev)
                 any_valid = np.asarray(_seg_max(
-                    jnp.asarray(valid.astype(np.int32)),
+                    jnp.asarray(contributed.astype(np.int32)),
                     jnp.asarray(seg_id), num_seg)) > 0
                 out_cols[name] = pa.array(
                     [result[i].item() if any_valid[i] else None
@@ -352,6 +362,22 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         elif func == "merge_map":
             out_cols[name] = _merge_map(col_sorted, valid & add_mask,
                                         seg_id, num_seg)
+            continue
+        elif func == "primary_key":
+            # reference FieldPrimaryKeyAgg: the first value sticks
+            idx = _first_index_where(valid & add_mask, seg_id, num_seg)
+        elif func in ("rbm32", "rbm64"):
+            out_cols[name] = _rbm_agg(col_sorted, valid & add_mask,
+                                      seg_id, num_seg, func, name)
+            continue
+        elif func in ("hll_sketch", "theta_sketch"):
+            out_cols[name] = _sketch_agg(col_sorted, valid & add_mask,
+                                         seg_id, num_seg, func, name)
+            continue
+        elif func == "nested_update":
+            out_cols[name] = _nested_update(col_sorted, valid & add_mask,
+                                            seg_id, num_seg, options,
+                                            name, f)
             continue
         elif func in ("bool_and", "bool_or"):
             vals = np.asarray(col_sorted.combine_chunks()
@@ -461,6 +487,106 @@ def _collect(col_sorted, mask, seg_id, num_seg, options, name):
         acc = [None if a is None else _dedup(a) for a in acc]
     return pa.array(acc, col_sorted.type if pa.types.is_list(
         col_sorted.type) else pa.list_(col_sorted.type))
+
+
+def _seg_bounds(seg_id: np.ndarray, num_seg: int):
+    """[start, end) of each segment in the (seg-sorted) row order."""
+    starts = np.searchsorted(seg_id, np.arange(num_seg))
+    ends = np.searchsorted(seg_id, np.arange(num_seg), side="right")
+    return starts, ends
+
+
+def _rbm_agg(col_sorted, mask, seg_id, num_seg, func: str, name: str):
+    """Roaring-bitmap OR-union aggregate over pre-serialized bitmap
+    blobs (reference FieldRoaringBitmap32Agg / FieldRoaringBitmap64Agg;
+    wire format index/roaring.py)."""
+    from paimon_tpu.index.roaring import (
+        deserialize_roaring32, deserialize_roaring64,
+        serialize_roaring32, serialize_roaring64,
+    )
+    deser = deserialize_roaring32 if func == "rbm32" \
+        else deserialize_roaring64
+    ser = serialize_roaring32 if func == "rbm32" else serialize_roaring64
+    t = col_sorted.type
+    if not (pa.types.is_binary(t) or pa.types.is_large_binary(t)):
+        raise ValueError(f"{func} aggregate requires field {name!r} to "
+                         f"be VARBINARY of serialized bitmaps")
+    vals = col_sorted.combine_chunks().to_pylist()
+    starts, ends = _seg_bounds(seg_id, num_seg)
+    out = []
+    for s, e in zip(starts, ends):
+        parts = [deser(vals[i]) for i in range(s, e)
+                 if mask[i] and vals[i] is not None]
+        out.append(None if not parts
+                   else bytes(ser(np.unique(np.concatenate(parts)))))
+    return pa.array(out, t)
+
+
+def _sketch_agg(col_sorted, mask, seg_id, num_seg, func: str, name: str):
+    """HLL / theta sketch union aggregate (reference FieldHllSketchAgg,
+    FieldThetaSketchAgg; wire format ops/sketch.py)."""
+    from paimon_tpu.ops.sketch import hll_union, theta_union
+    union = hll_union if func == "hll_sketch" else theta_union
+    t = col_sorted.type
+    if not (pa.types.is_binary(t) or pa.types.is_large_binary(t)):
+        raise ValueError(f"{func} aggregate requires field {name!r} to "
+                         f"be VARBINARY of serialized sketches")
+    vals = col_sorted.combine_chunks().to_pylist()
+    starts, ends = _seg_bounds(seg_id, num_seg)
+    out = []
+    for s, e in zip(starts, ends):
+        merged = union(vals[i] for i in range(s, e)
+                       if mask[i] and vals[i] is not None)
+        out.append(merged)
+    return pa.array(out, t)
+
+
+def _nested_update(col_sorted, mask, seg_id, num_seg, options,
+                   name: str, field):
+    """ARRAY<ROW> accumulation (reference FieldNestedUpdateAgg):
+    concatenate nested rows across versions; with
+    `fields.<name>.nested-key = a,b` rows dedup by that key, last
+    writer wins."""
+    t = col_sorted.type
+    if not (pa.types.is_list(t) or pa.types.is_large_list(t)) or \
+            not pa.types.is_struct(t.value_type):
+        raise ValueError(f"nested_update requires field {name!r} to be "
+                         f"ARRAY<ROW<...>>, got {field.type}")
+    keys_opt = options.options.get_or(f"fields.{name}.nested-key", None)
+    nested_keys = [k.strip() for k in keys_opt.split(",")] \
+        if keys_opt else None
+    if nested_keys:
+        struct_fields = {t.value_type.field(i).name
+                         for i in range(t.value_type.num_fields)}
+        unknown = [k for k in nested_keys if k not in struct_fields]
+        if unknown:
+            raise ValueError(
+                f"fields.{name}.nested-key names {unknown} not in the "
+                f"nested row {sorted(struct_fields)} (reference "
+                f"FieldNestedUpdateAgg key resolution)")
+    vals = col_sorted.combine_chunks().to_pylist()
+    starts, ends = _seg_bounds(seg_id, num_seg)
+    out = []
+    for s, e in zip(starts, ends):
+        acc: list = []
+        seen = {}
+        any_val = False
+        for i in range(s, e):
+            if not mask[i] or vals[i] is None:
+                continue
+            any_val = True
+            for row in vals[i]:
+                if nested_keys is None:
+                    acc.append(row)
+                    continue
+                k = tuple(row.get(c) for c in nested_keys)
+                if k in seen:
+                    acc[seen[k]] = row    # in-place update keeps order
+                else:
+                    seen[k] = len(acc)
+                    acc.append(row)
+        out.append(acc if any_val else None)
+    return pa.array(out, t)
 
 
 def _merge_map(col_sorted, mask, seg_id, num_seg):

@@ -237,4 +237,11 @@ def _check_type_evolution(old: DataType, new: DataType):
             return
     if o == "TIMESTAMP" and n == "TIMESTAMP":
         return
+    # beyond implicit widening: the reference permits any update whose
+    # explicit cast rule resolves (SchemaManager.java:525
+    # DataTypeCasts.supportsCast(..., allowExplicit) +
+    # CastExecutors.resolve != null); our rule matrix is that resolver
+    from paimon_tpu.data.casting import can_cast
+    if can_cast(old, new):
+        return
     raise ValueError(f"Unsupported type evolution {old} -> {new}")

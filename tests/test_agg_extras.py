@@ -1,0 +1,170 @@
+"""New aggregators: roaring bitmaps, HLL/theta sketches, nested
+update, primary_key, ignore-retract.
+
+reference: mergetree/compact/aggregate/FieldRoaringBitmap32Agg.java,
+FieldRoaringBitmap64Agg.java, FieldHllSketchAgg.java,
+FieldThetaSketchAgg.java, FieldNestedUpdateAgg.java,
+FieldPrimaryKeyAgg.java, FieldIgnoreRetractAgg.java.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.index.roaring import (
+    deserialize_roaring32, deserialize_roaring64, serialize_roaring32,
+    serialize_roaring64,
+)
+from paimon_tpu.ops.sketch import (
+    hll_build, hll_estimate, theta_build, theta_estimate,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import (
+    ArrayType, BigIntType, IntType, RowType, VarBinaryType, VarCharType,
+)
+
+
+def agg_table(tmp_warehouse, columns, field_opts):
+    b = Schema.builder().column("k", BigIntType(False))
+    for name, typ in columns:
+        b = b.column(name, typ)
+    opts = {"bucket": "1", "write-only": "true",
+            "merge-engine": "aggregation"}
+    opts.update(field_opts)
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"),
+                                 b.primary_key("k").options(opts).build())
+
+
+def commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows, row_kinds=kinds)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_rbm32_union(tmp_warehouse):
+    t = agg_table(tmp_warehouse,
+                  [("bits", VarBinaryType.bytes_type())],
+                  {"fields.bits.aggregate-function": "rbm32"})
+    commit(t, [{"k": 1, "bits": bytes(serialize_roaring32(
+        np.array([1, 5, 9], np.uint32)))}])
+    commit(t, [{"k": 1, "bits": bytes(serialize_roaring32(
+        np.array([5, 100], np.uint32)))}])
+    out = t.to_arrow().to_pylist()[0]
+    assert deserialize_roaring32(out["bits"]).tolist() == [1, 5, 9, 100]
+
+
+def test_rbm64_union(tmp_warehouse):
+    t = agg_table(tmp_warehouse,
+                  [("bits", VarBinaryType.bytes_type())],
+                  {"fields.bits.aggregate-function": "rbm64"})
+    big = 1 << 40
+    commit(t, [{"k": 1, "bits": bytes(serialize_roaring64(
+        np.array([3, big], np.uint64)))}])
+    commit(t, [{"k": 1, "bits": bytes(serialize_roaring64(
+        np.array([big, big + 7], np.uint64)))}])
+    out = t.to_arrow().to_pylist()[0]
+    assert deserialize_roaring64(out["bits"]).tolist() == \
+        [3, big, big + 7]
+
+
+def test_hll_sketch_merge_estimates(tmp_warehouse):
+    t = agg_table(tmp_warehouse,
+                  [("sk", VarBinaryType.bytes_type())],
+                  {"fields.sk.aggregate-function": "hll_sketch"})
+    a = hll_build(pa.array(range(0, 6000), pa.int64()))
+    b = hll_build(pa.array(range(4000, 10000), pa.int64()))
+    commit(t, [{"k": 1, "sk": a}])
+    commit(t, [{"k": 1, "sk": b}])
+    merged = t.to_arrow().to_pylist()[0]["sk"]
+    est = hll_estimate(merged)
+    assert abs(est - 10000) / 10000 < 0.05    # ~1.6% expected at p=12
+
+
+def test_theta_sketch_merge_estimates(tmp_warehouse):
+    t = agg_table(tmp_warehouse,
+                  [("sk", VarBinaryType.bytes_type())],
+                  {"fields.sk.aggregate-function": "theta_sketch"})
+    a = theta_build(pa.array(range(0, 6000), pa.int64()))
+    b = theta_build(pa.array(range(4000, 10000), pa.int64()))
+    commit(t, [{"k": 1, "sk": a}])
+    commit(t, [{"k": 1, "sk": b}])
+    est = theta_estimate(t.to_arrow().to_pylist()[0]["sk"])
+    assert abs(est - 10000) / 10000 < 0.08
+
+
+def test_nested_update_append_and_keyed(tmp_warehouse):
+    from paimon_tpu.types import DataField
+    row_t = RowType([DataField(100, "oid", BigIntType()),
+                     DataField(101, "st", VarCharType.string_type())])
+    t = agg_table(
+        tmp_warehouse, [("orders", ArrayType(row_t))],
+        {"fields.orders.aggregate-function": "nested_update",
+         "fields.orders.nested-key": "oid"})
+    commit(t, [{"k": 1, "orders": [{"oid": 1, "st": "new"},
+                                   {"oid": 2, "st": "new"}]}])
+    commit(t, [{"k": 1, "orders": [{"oid": 1, "st": "paid"}]}])
+    out = t.to_arrow().to_pylist()[0]["orders"]
+    assert out == [{"oid": 1, "st": "paid"}, {"oid": 2, "st": "new"}]
+
+
+def test_nested_update_unkeyed_concats(tmp_warehouse):
+    from paimon_tpu.types import DataField
+    row_t = RowType([DataField(100, "x", IntType())])
+    t = agg_table(
+        tmp_warehouse, [("vs", ArrayType(row_t))],
+        {"fields.vs.aggregate-function": "nested_update"})
+    commit(t, [{"k": 1, "vs": [{"x": 1}]}])
+    commit(t, [{"k": 1, "vs": [{"x": 1}, {"x": 2}]}])
+    assert t.to_arrow().to_pylist()[0]["vs"] == \
+        [{"x": 1}, {"x": 1}, {"x": 2}]
+
+
+def test_primary_key_agg_keeps_first(tmp_warehouse):
+    t = agg_table(tmp_warehouse, [("v", IntType())],
+                  {"fields.v.aggregate-function": "primary_key"})
+    commit(t, [{"k": 1, "v": 10}])
+    commit(t, [{"k": 1, "v": 99}])
+    assert t.to_arrow().to_pylist()[0]["v"] == 10
+
+
+def test_ignore_retract_sum(tmp_warehouse):
+    from paimon_tpu.types import RowKind
+    t = agg_table(tmp_warehouse, [("a", IntType()), ("b", IntType())],
+                  {"fields.a.aggregate-function": "sum",
+                   "fields.b.aggregate-function": "sum",
+                   "fields.b.ignore-retract": "true"})
+    commit(t, [{"k": 1, "a": 10, "b": 10}])
+    commit(t, [{"k": 1, "a": 3, "b": 3}],
+           kinds=[RowKind.UPDATE_BEFORE])
+    commit(t, [{"k": 1, "a": 1, "b": 1}])
+    row = t.to_arrow().to_pylist()[0]
+    assert row["a"] == 8          # 10 - 3 + 1
+    assert row["b"] == 11         # retract ignored: 10 + 1
+
+
+def test_ignore_retract_all_retract_is_null(tmp_warehouse):
+    from paimon_tpu.types import RowKind
+    t = agg_table(tmp_warehouse, [("b", IntType())],
+                  {"fields.b.aggregate-function": "sum",
+                   "fields.b.ignore-retract": "true"})
+    commit(t, [{"k": 1, "b": 5}], kinds=[RowKind.UPDATE_BEFORE])
+    rows = t.to_arrow().to_pylist()
+    assert rows == [] or rows[0]["b"] is None
+
+
+def test_nested_update_bad_key_raises(tmp_warehouse):
+    from paimon_tpu.types import DataField
+    row_t = RowType([DataField(100, "x", IntType())])
+    t = agg_table(
+        tmp_warehouse, [("vs", ArrayType(row_t))],
+        {"fields.vs.aggregate-function": "nested_update",
+         "fields.vs.nested-key": "xx"})
+    commit(t, [{"k": 1, "vs": [{"x": 1}]}])
+    commit(t, [{"k": 1, "vs": [{"x": 2}]}])
+    with pytest.raises(ValueError, match="nested-key"):
+        t.to_arrow()

@@ -78,15 +78,21 @@ def test_alter_validation(manager):
 
 def test_type_evolution(manager):
     manager.create_table(sample_schema())
-    # widening is allowed
+    # widening is allowed implicitly
     ts = manager.commit_changes(
-        SchemaChange.update_column_type("amount", VarCharType.string_type())
-        if False else
         SchemaChange.update_column_type("amount", DoubleType()))
     assert ts.id == 1
+    # narrowing is allowed too — the reference admits any update whose
+    # explicit cast rule resolves (SchemaManager.java:525); data casts
+    # with Java truncation semantics at read time
+    ts = manager.commit_changes(
+        SchemaChange.update_column_type("amount", IntType()))
+    assert ts.id == 2
+    # pairs without a cast rule still refuse
+    from paimon_tpu.types import DateType
     with pytest.raises(ValueError):
         manager.commit_changes(
-            SchemaChange.update_column_type("amount", IntType()))
+            SchemaChange.update_column_type("amount", DateType()))
 
 
 def test_key_value_row_type(manager):
