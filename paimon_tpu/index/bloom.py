@@ -101,6 +101,20 @@ class BloomFilter:
                              np.uint64(1) << (pos & np.uint64(63)))
         return BloomFilter(bits, k)
 
+    def might_contain_many(self, hashes: np.ndarray) -> np.ndarray:
+        """Vectorized membership test: bool[n] for uint64 hashes[n] —
+        the same double-hash probe sequence as build(), no per-key
+        Python loop."""
+        m = np.uint64(self.num_bits)
+        h1 = hashes.astype(np.uint64)
+        h2 = _splitmix64(h1)
+        out = np.ones(len(h1), dtype=bool)
+        for i in range(self.k):
+            pos = (h1 + np.uint64(i) * h2) % m
+            words = self.bits[(pos >> np.uint64(6)).astype(np.int64)]
+            out &= (words >> (pos & np.uint64(63))) & np.uint64(1) != 0
+        return out
+
     def might_contain(self, h: int) -> bool:
         m = self.num_bits
         h1 = int(h) & 0xFFFFFFFFFFFFFFFF

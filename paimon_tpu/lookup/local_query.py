@@ -1,33 +1,42 @@
-"""LocalTableQuery: embedded point lookups over the LSM.
+"""LocalTableQuery: embedded point lookups over the LSM, backed by a
+persistent, size-bounded local SST store.
 
 reference: table/query/LocalTableQuery.java:69 (lookup:226) over
-mergetree/LookupLevels.java:137, which downloads remote files into local
-sorted SSTs with bloom filters and probes them per key.
+mergetree/LookupLevels.java:137, which downloads remote files into
+local sorted SSTs with bloom filters (lookup/sort/
+SortLookupStoreFactory.java:39) and evicts them by disk size
+(LookupLevels.java:308).
 
-TPU-first deviation: a bucket's merged state is materialized ONCE as a
-key-sorted Arrow table + normalized-key rank array; each lookup batch is
-a joint key-ranking plus one vectorized searchsorted — thousands of
-probes per call instead of per-key block reads. The cache invalidates on
-snapshot change (refresh(), reference LookupLevels file eviction).
+TPU-first shape: a bucket's merged state is materialized once, sorted
+by normalized-key lanes, and SPILLED to a local SST file
+(lookup/sst.py) — RAM holds only a byte-bounded block cache, disk a
+byte-bounded file set.  A lookup batch is one vectorized block-index
+searchsorted plus one in-block searchsorted per touched block;
+thousands of probes per call, no per-key block reads.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import tempfile
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
 
 from paimon_tpu.core.bucket import FixedBucketAssigner
-from paimon_tpu.ops.diff import joint_key_ranks
+from paimon_tpu.lookup.sst import (
+    BlockCache, LookupStore, SstReader, pack_lanes,
+)
 from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+from paimon_tpu.options import CoreOptions
 from paimon_tpu.types import data_type_to_arrow
 
 __all__ = ["LocalTableQuery"]
 
 
 class LocalTableQuery:
-    def __init__(self, table):
+    def __init__(self, table, cache_dir: Optional[str] = None,
+                 max_memory_bytes: int = 256 << 20):
         if not table.primary_keys:
             raise ValueError("LocalTableQuery requires a primary-key table")
         self.table = table
@@ -40,34 +49,57 @@ class LocalTableQuery:
         self.assigner = FixedBucketAssigner(
             bucket_keys, [rt.get_field(k).type for k in bucket_keys],
             max(1, table.options.bucket))
-        # (partition, bucket) -> (state_table, state_ranks_sorted)
-        self._cache: Dict[Tuple, Tuple[pa.Table, np.ndarray]] = {}
+        self.block_cache = BlockCache(max_memory_bytes)
+        self.store = LookupStore(
+            cache_dir or tempfile.mkdtemp(prefix="paimon-lookup-"),
+            max_disk_bytes=table.options.get(
+                CoreOptions.LOOKUP_CACHE_MAX_DISK_SIZE),
+            block_cache=self.block_cache)
         self._snapshot_id: Optional[int] = None
+        self._empty: set = set()          # negative cache: empty buckets
 
     def refresh(self):
-        """Drop cached bucket states (call after new commits)."""
-        self._cache.clear()
+        """Drop spilled state (call after new commits)."""
+        self.store.drop_all()
+        self._empty.clear()
         self._snapshot_id = None
 
     def _check_snapshot(self):
         latest = self.table.snapshot_manager.latest_snapshot_id()
         if latest != self._snapshot_id:
-            self._cache.clear()
+            self.store.drop_all()
+            self._empty.clear()
             self._snapshot_id = latest
 
-    def _bucket_state(self, partition: Tuple, bucket: int) -> pa.Table:
-        key = (partition, bucket)
-        state = self._cache.get(key)
-        if state is not None:
-            return state[0]
+    def _encode_lanes(self, t: pa.Table) -> np.ndarray:
+        lanes, _ = self.encoder.encode_table(t, self.pk)
+        return lanes
+
+    def _bucket_reader(self, partition: Tuple,
+                       bucket: int) -> Optional[SstReader]:
+        import json
+        # unambiguous composite key: joining values with a separator
+        # would collide for e.g. ('a_b','c') vs ('a','b_c')
+        key = json.dumps([list(map(repr, partition)), bucket,
+                          self._snapshot_id])
+        if key in self._empty:
+            return None
+        reader = self.store.get(key)
+        if reader is not None:
+            return reader
         rb = self.table.new_read_builder().with_buckets([bucket])
         if partition and self.table.partition_keys:
             rb = rb.with_partition_filter(
                 dict(zip(self.table.partition_keys, partition)))
         plan = rb.new_scan().plan()
         t = rb.new_read().to_arrow(plan)
-        self._cache[key] = (t, None)
-        return t
+        if t.num_rows == 0:
+            self._empty.add(key)
+            return None
+        lanes = self._encode_lanes(t)
+        order = np.argsort(pack_lanes(lanes), kind="stable")
+        return self.store.put(key, lanes[order],
+                              t.take(pa.array(order)))
 
     def lookup(self, keys: Sequence[dict],
                partition: Tuple = ()) -> List[Optional[dict]]:
@@ -86,21 +118,20 @@ class LocalTableQuery:
         out: List[Optional[dict]] = [None] * len(keys)
         for b in np.unique(buckets):
             sel = np.flatnonzero(buckets == b)
-            state = self._bucket_state(partition, int(b))
-            if state.num_rows == 0:
+            reader = self._bucket_reader(partition, int(b))
+            if reader is None:
                 continue
             sub = query.take(pa.array(sel))
-            state_ranks, query_ranks = joint_key_ranks(
-                [state, sub], self.pk, self.encoder)
-            order = np.argsort(state_ranks, kind="stable")
-            sorted_ranks = state_ranks[order]
-            pos = np.searchsorted(sorted_ranks, query_ranks)
-            pos_c = np.minimum(pos, len(sorted_ranks) - 1)
-            hit = sorted_ranks[pos_c] == query_ranks
-            rows = state.take(pa.array(order[pos_c])).to_pylist()
-            for qi, h, row in zip(sel, hit, rows):
-                if h:
-                    out[int(qi)] = row
+            hit_pos, rows = reader.probe(self._encode_lanes(sub))
+            if rows is None:
+                continue
+            row_dicts = rows.to_pylist()
+            for qi, row in zip(hit_pos, row_dicts):
+                q = keys[int(sel[qi])]
+                # lanes may be prefix-truncated for long string keys:
+                # confirm the full key before accepting the hit
+                if all(row.get(k) == q[k] for k in self.pk):
+                    out[int(sel[qi])] = row
         return out
 
     def lookup_row(self, key: dict, partition: Tuple = ()

@@ -1,0 +1,346 @@
+"""Local sorted-run (SST) lookup files with bloom filters and bounded
+caches.
+
+reference: paimon-common/src/main/java/org/apache/paimon/sst/
+SstFileReader.java + paimon-core/.../lookup/sort/
+SortLookupStoreFactory.java:39,65 — remote LSM files spill into local
+sorted block files with bloom filters; probes touch one block; total
+local disk usage is bounded and files evict LRU
+(mergetree/LookupLevels.java:308).
+
+TPU-first probe shape: keys are the normalized-key LANES (uint32[L])
+already used by the merge kernel, packed big-endian per row into fixed
+width byte strings so numpy compares them lexicographically; a probe
+batch is ONE vectorized searchsorted over the block index, then one
+searchsorted inside each touched block — no per-key tree walks.
+
+File layout:
+    "PTSST1"
+    block 0: zstd Arrow IPC (lane columns + row columns), key-sorted
+    block 1: ...
+    footer (zstd JSON): per-block {offset, size, rows, first_key(b64)},
+        bloom filter (b64) over splitmix64 of the packed keys, num_rows
+    u32 footer_len, "PTSST1"
+
+Both caches are bounded: the in-RAM block cache globally by bytes
+(lookup.cache-max-memory-size), the on-disk store per table by
+lookup.cache-max-disk-size with LRU file eviction.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import struct
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.index.bloom import BloomFilter, _splitmix64
+
+__all__ = ["SstWriter", "SstReader", "BlockCache", "LookupStore",
+           "pack_lanes"]
+
+_MAGIC = b"PTSST1"
+DEFAULT_BLOCK_ROWS = 4096
+
+
+def pack_lanes(lanes: np.ndarray) -> np.ndarray:
+    """uint32[N, L] -> |S(4L)| fixed-width byte keys whose bytewise
+    order equals the lanes' lexicographic order."""
+    n, num_lanes = lanes.shape
+    be = lanes.astype(">u4")
+    return np.frombuffer(be.tobytes(), dtype=f"S{4 * num_lanes}",
+                         count=n)
+
+
+def _key_hashes(packed: np.ndarray) -> np.ndarray:
+    """uint64 hash per packed key (first 8 bytes + length mix; packed
+    keys are fixed width so a cheap vectorized fold suffices)."""
+    width = packed.dtype.itemsize
+    raw = np.frombuffer(packed.tobytes(), dtype=np.uint8) \
+        .reshape(len(packed), width)
+    acc = np.zeros(len(packed), dtype=np.uint64)
+    for i in range(0, width, 8):
+        chunk = raw[:, i:i + 8]
+        if chunk.shape[1] < 8:
+            pad = np.zeros((len(packed), 8 - chunk.shape[1]), np.uint8)
+            chunk = np.concatenate([chunk, pad], axis=1)
+        acc ^= _splitmix64(chunk.copy().view(np.uint64).reshape(-1))
+    return _splitmix64(acc)
+
+
+class SstWriter:
+    def __init__(self, block_rows: int = DEFAULT_BLOCK_ROWS,
+                 bloom_fpp: float = 0.01, compression: str = "zstd"):
+        self.block_rows = block_rows
+        self.bloom_fpp = bloom_fpp
+        self.compression = compression
+
+    def write(self, path: str, lanes: np.ndarray,
+              table: pa.Table) -> int:
+        """`table` rows sorted by `lanes`; returns file size."""
+        n = table.num_rows
+        assert lanes.shape[0] == n
+        packed = pack_lanes(lanes)
+        num_lanes = lanes.shape[1]
+        lane_cols = {f"__lane{i}": pa.array(lanes[:, i], pa.uint32())
+                     for i in range(num_lanes)}
+        full = table
+        for name, col in lane_cols.items():
+            full = full.append_column(name, col)
+
+        out = io.BytesIO()
+        out.write(_MAGIC)
+        blocks = []
+        try:
+            opts = pa.ipc.IpcWriteOptions(compression=self.compression)
+        except (pa.ArrowInvalid, TypeError):
+            opts = pa.ipc.IpcWriteOptions()
+        for start in range(0, max(n, 1), self.block_rows):
+            chunk = full.slice(start, min(self.block_rows, n - start)) \
+                if n else full
+            sink = io.BytesIO()
+            with pa.ipc.new_stream(sink, full.schema, options=opts) as w:
+                w.write_table(chunk)
+            blob = sink.getvalue()
+            blocks.append({
+                "offset": out.tell(), "size": len(blob),
+                "rows": chunk.num_rows,
+                "first_key": base64.b64encode(
+                    packed[start].tobytes() if n else b"").decode(),
+            })
+            out.write(blob)
+            if n == 0:
+                break
+        bloom = BloomFilter.build(_key_hashes(packed), self.bloom_fpp) \
+            if n else None
+        footer = {
+            "num_rows": n, "num_lanes": num_lanes,
+            "key_width": 4 * num_lanes,
+            "blocks": blocks,
+            "bloom": base64.b64encode(bloom.serialize()).decode()
+            if bloom else None,
+        }
+        fb = json.dumps(footer).encode()
+        comp = pa.Codec("zstd").compress(fb)
+        comp = comp.to_pybytes() if isinstance(comp, pa.Buffer) else comp
+        tail = struct.pack("<I", len(fb)) + comp
+        out.write(tail)
+        out.write(struct.pack("<I", len(tail)))
+        out.write(_MAGIC)
+        data = out.getvalue()
+        with open(path, "wb") as f:
+            f.write(data)
+        return len(data)
+
+
+class BlockCache:
+    """Global byte-bounded LRU over decoded blocks (role of reference
+    io/cache/CacheManager for lookup pages)."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self.max_bytes = max_bytes
+        self._lru: "OrderedDict[Tuple, pa.Table]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, key: Tuple) -> Optional[pa.Table]:
+        t = self._lru.get(key)
+        if t is not None:
+            self._lru.move_to_end(key)
+        return t
+
+    def put(self, key: Tuple, t: pa.Table):
+        if key in self._lru:
+            self._lru.move_to_end(key)
+            return
+        self._lru[key] = t
+        self._bytes += t.nbytes
+        while self._bytes > self.max_bytes and len(self._lru) > 1:
+            _, old = self._lru.popitem(last=False)
+            self._bytes -= old.nbytes
+
+    def drop_file(self, path: str):
+        for k in [k for k in self._lru if k[0] == path]:
+            self._bytes -= self._lru.pop(k).nbytes
+
+
+_GLOBAL_BLOCK_CACHE = BlockCache()
+
+
+class SstReader:
+    def __init__(self, path: str,
+                 block_cache: Optional[BlockCache] = None):
+        self.path = path
+        self.cache = block_cache or _GLOBAL_BLOCK_CACHE
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(size - 10)
+            tail_len, magic = struct.unpack("<I6s", f.read(10))
+            if magic != _MAGIC:
+                raise ValueError(f"not an SST file: {path}")
+            f.seek(size - 10 - tail_len)
+            tail = f.read(tail_len)
+        (raw_len,) = struct.unpack_from("<I", tail, 0)
+        fb = pa.Codec("zstd").decompress(tail[4:],
+                                         decompressed_size=raw_len)
+        if isinstance(fb, pa.Buffer):
+            fb = fb.to_pybytes()
+        self.footer = json.loads(fb)
+        self.num_rows = self.footer["num_rows"]
+        kw = self.footer["key_width"]
+        self._first_keys = np.array(
+            [base64.b64decode(b["first_key"]) for b in
+             self.footer["blocks"]], dtype=f"S{kw}") \
+            if self.footer["blocks"] else np.zeros(0, dtype=f"S{kw}")
+        self._bloom = BloomFilter.deserialize(
+            base64.b64decode(self.footer["bloom"])) \
+            if self.footer.get("bloom") else None
+
+    @property
+    def file_size(self) -> int:
+        return os.path.getsize(self.path)
+
+    def _block(self, i: int) -> pa.Table:
+        key = (self.path, i)
+        t = self.cache.get(key)
+        if t is None:
+            b = self.footer["blocks"][i]
+            with open(self.path, "rb") as f:
+                f.seek(b["offset"])
+                blob = f.read(b["size"])
+            with pa.ipc.open_stream(pa.BufferReader(blob)) as r:
+                t = r.read_all()
+            self.cache.put(key, t)
+        return t
+
+    def probe(self, lanes: np.ndarray) -> Tuple[np.ndarray, pa.Table]:
+        """Batch probe: query lanes uint32[M, L] ->
+        (hit_query_positions int64[H], matched rows pa.Table[H] minus
+        lane columns, aligned with the positions)."""
+        m = lanes.shape[0]
+        if m == 0 or self.num_rows == 0:
+            return np.zeros(0, np.int64), None
+        packed = pack_lanes(lanes)
+        cand = np.arange(m)
+        if self._bloom is not None:
+            keep = self._bloom.might_contain_many(_key_hashes(packed))
+            cand = cand[keep]
+            if len(cand) == 0:
+                return np.zeros(0, np.int64), None
+        q = packed[cand]
+        # block of each candidate: RIGHTMOST block whose first key <= q.
+        # A run of equal packed keys (possible: lanes are prefix-
+        # truncated for long strings) always ENDS in that block, but may
+        # start in earlier blocks — extended backward below.
+        blk = np.searchsorted(self._first_keys, q, side="right") - 1
+        blk = np.maximum(blk, 0)
+        hits: List[int] = []
+        rows: List[pa.Table] = []
+
+        def block_keys(b: int):
+            t = self._block(b)
+            nl = self.footer["num_lanes"]
+            lanes_mat = np.stack(
+                [np.asarray(t.column(f"__lane{i}")) for i in range(nl)],
+                axis=1).astype(np.uint32)
+            return t, pack_lanes(lanes_mat)
+
+        for b in np.unique(blk):
+            sel = blk == b
+            t, bk = block_keys(int(b))
+            lo = np.searchsorted(bk, q[sel], side="left")
+            hi = np.searchsorted(bk, q[sel], side="right")
+            for qi, key, s, e in zip(cand[sel], q[sel], lo, hi):
+                if s == e:
+                    continue
+                hits.extend([int(qi)] * (e - s))
+                rows.append(t.slice(s, e - s))
+                pb = int(b)
+                while s == 0 and pb > 0:
+                    pb -= 1
+                    tp, bkp = block_keys(pb)
+                    s2 = int(np.searchsorted(bkp, key, side="left"))
+                    e2 = int(np.searchsorted(bkp, key, side="right"))
+                    if s2 == e2:
+                        break
+                    hits.extend([int(qi)] * (e2 - s2))
+                    rows.append(tp.slice(s2, e2 - s2))
+                    s = s2
+        if not hits:
+            return np.zeros(0, np.int64), None
+        out = pa.concat_tables(rows, promote_options="none")
+        drop = [c for c in out.column_names if c.startswith("__lane")]
+        return (np.array(hits, dtype=np.int64), out.drop_columns(drop))
+
+
+class LookupStore:
+    """Size-bounded local store of SST files, keyed by (partition,
+    bucket, snapshot): files evict least-recently-used when the disk
+    budget is exceeded (reference SortLookupStoreFactory + LookupLevels
+    file eviction at mergetree/LookupLevels.java:308)."""
+
+    def __init__(self, directory: str,
+                 max_disk_bytes: int = 10 << 30,
+                 block_cache: Optional[BlockCache] = None):
+        self.dir = directory
+        self.max_disk = max_disk_bytes
+        self.block_cache = block_cache or _GLOBAL_BLOCK_CACHE
+        os.makedirs(directory, exist_ok=True)
+        # the store is a CACHE: files from a previous process can never
+        # be trusted (snapshot may have moved) and would escape the
+        # disk budget — start clean
+        for name in os.listdir(directory):
+            if name.endswith(".sst"):
+                try:
+                    os.remove(os.path.join(directory, name))
+                except OSError:
+                    pass
+        self._readers: "OrderedDict[str, SstReader]" = OrderedDict()
+
+    def _evict_to_budget(self):
+        total = sum(r.file_size for r in self._readers.values())
+        while total > self.max_disk and len(self._readers) > 1:
+            name, reader = self._readers.popitem(last=False)
+            total -= reader.file_size
+            self.block_cache.drop_file(reader.path)
+            try:
+                os.remove(reader.path)
+            except OSError:
+                pass
+
+    def get(self, key: str) -> Optional[SstReader]:
+        r = self._readers.get(key)
+        if r is not None:
+            self._readers.move_to_end(key)
+        return r
+
+    def put(self, key: str, lanes: np.ndarray, table: pa.Table,
+            writer: Optional[SstWriter] = None) -> SstReader:
+        import hashlib
+        # hash the key into the file name: composite keys (partition
+        # values etc.) must never collide after path sanitization
+        digest = hashlib.sha1(key.encode("utf-8")).hexdigest()[:24]
+        path = os.path.join(self.dir, digest + ".sst")
+        (writer or SstWriter()).write(path, lanes, table)
+        reader = SstReader(path, self.block_cache)
+        old = self._readers.pop(key, None)
+        if old is not None:
+            self.block_cache.drop_file(old.path)
+        self._readers[key] = reader
+        self._evict_to_budget()
+        return self._readers.get(key)
+
+    def drop_all(self):
+        for _, r in list(self._readers.items()):
+            self.block_cache.drop_file(r.path)
+            try:
+                os.remove(r.path)
+            except OSError:
+                pass
+        self._readers.clear()

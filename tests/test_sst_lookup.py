@@ -1,0 +1,167 @@
+"""SST lookup files + bounded LookupStore + SST-backed
+LocalTableQuery.
+
+reference: sst/SstFileReader.java, lookup/sort/
+SortLookupStoreFactory.java, mergetree/LookupLevels.java (disk-size
+eviction), table/query/LocalTableQuery.java.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.lookup.sst import (
+    BlockCache, LookupStore, SstReader, SstWriter, pack_lanes,
+)
+
+
+def make_sorted(n, num_lanes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    lanes = rng.integers(0, 1 << 32, (n, num_lanes), dtype=np.uint64) \
+        .astype(np.uint32)
+    order = np.argsort(pack_lanes(lanes), kind="stable")
+    lanes = lanes[order]
+    t = pa.table({"v": pa.array(np.arange(n), pa.int64())})
+    return lanes, t
+
+
+class TestSstFile:
+    def test_write_probe_round_trip(self, tmp_path):
+        lanes, t = make_sorted(10_000)
+        path = str(tmp_path / "f.sst")
+        SstWriter(block_rows=512).write(path, lanes, t)
+        r = SstReader(path, BlockCache())
+        # probe every 97th key + some misses
+        q_idx = np.arange(0, 10_000, 97)
+        queries = lanes[q_idx]
+        miss = np.full((5, lanes.shape[1]), 0xFFFFFFFF, np.uint32)
+        q = np.concatenate([queries, miss])
+        hit_pos, rows = r.probe(q)
+        assert set(hit_pos.tolist()) == set(range(len(q_idx)))
+        got = dict(zip(hit_pos.tolist(),
+                       rows.column("v").to_pylist()))
+        for i, qi in enumerate(q_idx):
+            assert got[i] == int(t.column("v")[qi].as_py())
+
+    def test_probe_only_touches_needed_blocks(self, tmp_path):
+        lanes, t = make_sorted(8192)
+        path = str(tmp_path / "f.sst")
+        SstWriter(block_rows=256).write(path, lanes, t)
+        cache = BlockCache()
+        r = SstReader(path, cache)
+        r.probe(lanes[:1])
+        assert len(cache._lru) <= 2      # one block (plus none extra)
+
+    def test_block_cache_bounded(self, tmp_path):
+        lanes, t = make_sorted(50_000)
+        path = str(tmp_path / "f.sst")
+        SstWriter(block_rows=256).write(path, lanes, t)
+        cache = BlockCache(max_bytes=64 << 10)
+        r = SstReader(path, cache)
+        r.probe(lanes[::37])             # touch many blocks
+        assert cache._bytes <= 2 * (64 << 10)
+
+    def test_empty_table(self, tmp_path):
+        lanes = np.zeros((0, 2), np.uint32)
+        t = pa.table({"v": pa.array([], pa.int64())})
+        path = str(tmp_path / "e.sst")
+        SstWriter().write(path, lanes, t)
+        r = SstReader(path, BlockCache())
+        hit, rows = r.probe(np.zeros((3, 2), np.uint32))
+        assert len(hit) == 0 and rows is None
+
+
+class TestLookupStore:
+    def test_disk_budget_evicts_lru(self, tmp_path):
+        store = LookupStore(str(tmp_path / "cache"),
+                            max_disk_bytes=200_000,
+                            block_cache=BlockCache())
+        for i in range(6):
+            lanes, t = make_sorted(5000, seed=i)
+            store.put(f"b{i}", lanes, t)
+        on_disk = os.listdir(str(tmp_path / "cache"))
+        total = sum(os.path.getsize(os.path.join(
+            str(tmp_path / "cache"), f)) for f in on_disk)
+        assert total <= 300_000          # within ~1 file of budget
+        assert store.get("b5") is not None   # newest survives
+        assert store.get("b0") is None       # oldest evicted
+
+    def test_replace_same_key_drops_old(self, tmp_path):
+        store = LookupStore(str(tmp_path / "c"),
+                            block_cache=BlockCache())
+        lanes, t = make_sorted(100)
+        store.put("k", lanes, t)
+        store.put("k", lanes, t)
+        assert len(store._readers) == 1
+
+
+class TestLocalQuerySstBacked:
+    def _table(self, tmp_path, n=500, buckets=2):
+        from paimon_tpu.schema import Schema
+        from paimon_tpu.table import FileStoreTable
+        from paimon_tpu.types import BigIntType, VarCharType
+
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("name", VarCharType.string_type())
+                  .primary_key("id")
+                  .options({"bucket": str(buckets),
+                            "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": i, "name": f"n{i}"} for i in range(n)])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        return t
+
+    def test_lookup_hits_and_misses(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        t = self._table(tmp_path)
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "cache"))
+        out = q.lookup([{"id": 3}, {"id": 499}, {"id": 10_000}])
+        assert out[0] == {"id": 3, "name": "n3"}
+        assert out[1] == {"id": 499, "name": "n499"}
+        assert out[2] is None
+        # state actually spilled to disk
+        assert any(f.endswith(".sst")
+                   for f in os.listdir(str(tmp_path / "cache")))
+
+    def test_snapshot_change_invalidates(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        t = self._table(tmp_path, n=50)
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "cache"))
+        assert q.lookup_row({"id": 7})["name"] == "n7"
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": 7, "name": "updated"}])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        assert q.lookup_row({"id": 7})["name"] == "updated"
+
+    def test_string_pk_long_keys(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        from paimon_tpu.schema import Schema
+        from paimon_tpu.table import FileStoreTable
+        from paimon_tpu.types import IntType, VarCharType
+
+        schema = (Schema.builder()
+                  .column("k", VarCharType.string_type(False))
+                  .column("v", IntType())
+                  .primary_key("k")
+                  .options({"bucket": "1", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        prefix = "x" * 40                # beyond the lane prefix
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"k": prefix + "a", "v": 1},
+                       {"k": prefix + "b", "v": 2}])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "cache"))
+        assert q.lookup_row({"k": prefix + "b"})["v"] == 2
+        assert q.lookup_row({"k": prefix + "zzz"}) is None
