@@ -192,6 +192,7 @@ class SstReader:
         if isinstance(fb, pa.Buffer):
             fb = fb.to_pybytes()
         self.footer = json.loads(fb)
+        self._file_size = size
         self.num_rows = self.footer["num_rows"]
         kw = self.footer["key_width"]
         self._first_keys = np.array(
@@ -204,7 +205,7 @@ class SstReader:
 
     @property
     def file_size(self) -> int:
-        return os.path.getsize(self.path)
+        return self._file_size
 
     def _block(self, i: int) -> pa.Table:
         key = (self.path, i)
@@ -302,12 +303,12 @@ class LookupStore:
                 except OSError:
                     pass
         self._readers: "OrderedDict[str, SstReader]" = OrderedDict()
+        self._disk_bytes = 0              # running total: no per-put stats
 
     def _evict_to_budget(self):
-        total = sum(r.file_size for r in self._readers.values())
-        while total > self.max_disk and len(self._readers) > 1:
+        while self._disk_bytes > self.max_disk and len(self._readers) > 1:
             name, reader = self._readers.popitem(last=False)
-            total -= reader.file_size
+            self._disk_bytes -= reader.file_size
             self.block_cache.drop_file(reader.path)
             try:
                 os.remove(reader.path)
@@ -332,7 +333,9 @@ class LookupStore:
         old = self._readers.pop(key, None)
         if old is not None:
             self.block_cache.drop_file(old.path)
+            self._disk_bytes -= old.file_size
         self._readers[key] = reader
+        self._disk_bytes += reader.file_size
         self._evict_to_budget()
         return self._readers.get(key)
 
@@ -344,3 +347,4 @@ class LookupStore:
             except OSError:
                 pass
         self._readers.clear()
+        self._disk_bytes = 0

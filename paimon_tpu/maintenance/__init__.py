@@ -5,7 +5,7 @@ operation/OrphanFilesClean.java, operation/PartitionExpire.java.
 """
 
 from paimon_tpu.maintenance.expire import (  # noqa: F401
-    ExpireResult, expire_snapshots,
+    ExpireResult, expire_changelogs, expire_snapshots,
 )
 from paimon_tpu.maintenance.orphan import remove_orphan_files  # noqa: F401
 from paimon_tpu.maintenance.partition_expire import (  # noqa: F401

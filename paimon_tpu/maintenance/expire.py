@@ -89,6 +89,110 @@ def _snapshot_refs(table, snapshot: Snapshot
     return data, manifests
 
 
+def _changelog_refs(table, snapshot, scan=None):
+    """(data refs, manifest names) pinned by a snapshot's CHANGELOG
+    plane only."""
+    if scan is None:
+        scan = table.new_scan()
+    data: Set[Tuple] = set()
+    manifests: Set[str] = set()
+    if not snapshot.changelog_manifest_list:
+        return data, manifests
+    manifests.add(snapshot.changelog_manifest_list)
+    try:
+        metas = scan.manifest_list.read(snapshot.changelog_manifest_list)
+    except FileNotFoundError:
+        return data, manifests
+    for m in metas:
+        manifests.add(m.file_name)
+        try:
+            for e in scan.manifest_file.read(m.file_name):
+                if e.kind == FileKind.ADD:
+                    data.add((e.partition, e.bucket, e.file.file_name))
+                    for extra in e.file.extra_files:
+                        data.add((e.partition, e.bucket, extra))
+        except FileNotFoundError:
+            continue
+    return data, manifests
+
+
+def expire_changelogs(table, retain_max: Optional[int] = None,
+                      retain_min: Optional[int] = None,
+                      dry_run: bool = False) -> "ExpireResult":
+    """Trim the decoupled changelog set beyond
+    changelog.num-retained.max, deleting the preserved metadata AND the
+    changelog data files it pinned (reference ExpireChangelogImpl)."""
+    from paimon_tpu.snapshot.changelog_manager import ChangelogManager
+
+    options = table.options
+    if retain_max is None:
+        retain_max = options.get(CoreOptions.CHANGELOG_NUM_RETAINED_MAX)
+    if retain_min is None:
+        retain_min = options.get(
+            CoreOptions.CHANGELOG_NUM_RETAINED_MIN) or 1
+    result = ExpireResult()
+    if retain_max is None:
+        return result
+    cm = ChangelogManager(table.file_io, table.path, table.branch)
+    ids = cm._ids()
+    # live snapshots also count toward the retained changelog window
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id() or 0
+    earliest_snap = sm.earliest_snapshot_id() or 0
+    live = latest - earliest_snap + 1 if latest else 0
+    excess = len(ids) + live - max(retain_min, retain_max)
+    if excess <= 0:
+        return result
+    to_drop = ids[:excess]
+    scan = table.new_scan()
+
+    # anything still pinned survives: live snapshots, TAGS (reference
+    # ExpireChangelogImpl takes the TagManager for exactly this), and
+    # the changelog entries that are retained
+    keep_data: Set[Tuple] = set()
+    keep_manifests: Set[str] = set()
+    pinners: List[Snapshot] = []
+    for sid in range(earliest_snap, latest + 1):
+        try:
+            pinners.append(sm.snapshot(sid))
+        except FileNotFoundError:
+            continue
+    pinners.extend(table.tag_manager.tagged_snapshots())
+    for s in pinners:
+        d, m = _snapshot_refs(table, s)
+        keep_data |= d
+        keep_manifests |= m
+    for cid in ids[excess:]:
+        snap = cm.try_changelog(cid)
+        if snap is not None:
+            d, m = _changelog_refs(table, snap, scan)
+            keep_data |= d
+            keep_manifests |= m
+
+    for cid in to_drop:
+        snap = cm.try_changelog(cid)
+        if snap is None:
+            continue
+        data, manifests = _changelog_refs(table, snap, scan)
+        data -= keep_data
+        manifests -= keep_manifests
+        result.expired_snapshots.append(cid)
+        result.deleted_data_files += len(data)
+        result.deleted_manifest_files += len(manifests)
+        if dry_run:
+            continue
+        for (pbytes, bucket, fname) in data:
+            partition = scan._partition_codec.from_bytes(pbytes)
+            table.file_io.delete_quietly(
+                scan.path_factory.data_file_path(partition, bucket,
+                                                 fname))
+        for fname in manifests:
+            table.file_io.delete_quietly(
+                f"{scan.path_factory.manifest_dir}/{fname}")
+        cm.delete_changelog(cid)
+    return result
+
+
 def expire_snapshots(table, retain_max: Optional[int] = None,
                      retain_min: Optional[int] = None,
                      older_than_ms: Optional[int] = None,
@@ -167,6 +271,24 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
         d, m = _snapshot_refs(table, s)
         dead_data |= d - keep_data
         dead_manifests |= m - keep_manifests
+
+    # decoupled changelog retention: when configured, an expiring
+    # snapshot's changelog survives as changelog/changelog-<id> and its
+    # changelog files are NOT deleted here (reference
+    # utils/ChangelogManager.java; trimmed later by expire_changelogs)
+    decoupled = options.get(
+        CoreOptions.CHANGELOG_NUM_RETAINED_MAX) is not None
+    if decoupled:
+        from paimon_tpu.snapshot.changelog_manager import ChangelogManager
+        cm = ChangelogManager(table.file_io, table.path, table.branch)
+        for s in expiring:
+            if not s.changelog_manifest_list:
+                continue
+            if not dry_run:
+                cm.commit_changelog(s)
+            pinned, pinned_manifests = _changelog_refs(table, s, scan)
+            dead_data -= pinned
+            dead_manifests -= pinned_manifests
 
     result.expired_snapshots = [s.id for s in expiring]
     result.deleted_data_files = len(dead_data)

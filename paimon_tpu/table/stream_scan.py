@@ -122,6 +122,16 @@ class DataTableStreamScan:
                 raise ValueError("scan.mode=from-snapshot requires "
                                  "scan.snapshot-id")
             earliest = sm.earliest_snapshot_id() or 1
+            # decoupled changelog extends readable history below the
+            # earliest snapshot (reference ChangelogManager)
+            from paimon_tpu.snapshot.changelog_manager import (
+                ChangelogManager,
+            )
+            ecl = ChangelogManager(self.table.file_io, self.table.path,
+                                   self.table.branch) \
+                .earliest_changelog_id()
+            if ecl is not None:
+                earliest = min(earliest, ecl)
             self._first = False
             self._next = max(sid, earliest)
             return ScanPlan(None, [], streaming=True)
@@ -144,6 +154,19 @@ class DataTableStreamScan:
                                  "scan.timestamp-millis")
             snap = sm.earlier_or_equal_time_mills(ts)
             earliest = sm.earliest_snapshot_id() or 1
+            if snap is None:
+                # the timestamp predates every live snapshot: decoupled
+                # changelog may reach further back (reference
+                # ChangelogManager.earlierOrEqualTimeMills)
+                from paimon_tpu.snapshot.changelog_manager import (
+                    ChangelogManager,
+                )
+                cm = ChangelogManager(self.table.file_io,
+                                      self.table.path, self.table.branch)
+                older = [c for c in cm.changelogs()
+                         if c.time_millis > ts]
+                if older:
+                    earliest = min(earliest, min(c.id for c in older))
             self._first = False
             self._next = earliest if snap is None else snap.id + 1
             return ScanPlan(None, [], streaming=True)
@@ -155,7 +178,21 @@ class DataTableStreamScan:
         latest = sm.latest_snapshot_id()
         if latest is None or self._next is None or self._next > latest:
             return None
-        snapshot = sm.snapshot(self._next)
+        try:
+            snapshot = sm.snapshot(self._next)
+        except FileNotFoundError:
+            # the snapshot expired, but with decoupled changelog
+            # retention its changelog may live on under changelog/
+            # (reference ChangelogManager; consumers read past snapshot
+            # expiry)
+            from paimon_tpu.snapshot.changelog_manager import (
+                ChangelogManager,
+            )
+            cm = ChangelogManager(self.table.file_io, self.table.path,
+                                  self.table.branch)
+            snapshot = cm.try_changelog(self._next)
+            if snapshot is None:
+                raise
         self._next += 1
         if self._use_changelog:
             # reference ChangelogFollowUpScanner: read the snapshot's

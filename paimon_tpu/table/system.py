@@ -183,6 +183,168 @@ def _audit_log(table) -> pa.Table:
     return out.add_column(0, "rowkind", rowkind)
 
 
+def _read_optimized(table) -> pa.Table:
+    """Rows from the highest level only — a no-merge fast view that
+    trades freshness for raw-read speed (reference ReadOptimizedTable:
+    'files with maximum level, strongly read-optimized')."""
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return table.to_arrow().slice(0, 0)
+    if not table.primary_keys:
+        return table.to_arrow()
+    max_level = table.options.num_levels - 1
+    scan = table.new_scan().with_level_filter(
+        lambda level: level == max_level)
+    plan = scan.plan(snapshot)
+    return table.new_read_builder().new_read().to_arrow(plan.splits)
+
+
+def _aggregation_fields(table) -> pa.Table:
+    """Per-field aggregate configuration (reference
+    AggregationFieldsTable)."""
+    from paimon_tpu.ops.agg import field_aggregators
+    aggs = field_aggregators(table.schema, table.options)
+    rows = []
+    for f in table.schema.fields:
+        func = aggs.get(f.name)
+        opts = {k: v for k, v in table.schema.options.items()
+                if k.startswith(f"fields.{f.name}.")}
+        rows.append({
+            "field_name": f.name,
+            "field_type": str(f.type),
+            "function": func if f.name in aggs else "primary-key",
+            "function_options": str(opts) if opts else "",
+            "comment": getattr(f, "description", None),
+        })
+    return pa.Table.from_pylist(rows)
+
+
+def _statistics(table) -> pa.Table:
+    """Latest ANALYZE result (reference StatisticTable)."""
+    import json
+    stats = table.statistics()
+    if not stats:
+        return pa.table({"snapshot_id": pa.array([], pa.int64())})
+    return pa.Table.from_pylist([{
+        "snapshot_id": stats.get("snapshotId"),
+        "schema_id": stats.get("schemaId"),
+        "merged_record_count": stats.get("mergedRecordCount"),
+        "merged_record_size": stats.get("mergedRecordSize"),
+        "col_stats": json.dumps(stats.get("colStats", {}),
+                                default=str),
+    }])
+
+
+def _binlog(table) -> pa.Table:
+    """Changelog packed one row per change: -U/+U pairs of a key fold
+    into single rows whose columns are [before, after] arrays; +I/-D
+    become single-element arrays (reference BinlogTable)."""
+    from paimon_tpu.core.read import ROW_KIND_COL
+
+    plan = table.new_scan().plan(streaming=True)
+    raw = table.new_read_builder().new_read().to_arrow(plan)
+    kinds = [k.as_py() for k in raw.column(ROW_KIND_COL)]
+    raw = raw.drop_columns([ROW_KIND_COL])
+    value_cols = raw.column_names
+    lists = raw.to_pylist()
+    rows = []
+    i = 0
+    while i < len(lists):
+        kind = kinds[i]
+        if kind == 1 and i + 1 < len(lists) and kinds[i + 1] == 2:
+            before, after = lists[i], lists[i + 1]
+            rows.append({"rowkind": "+U",
+                         **{c: [before[c], after[c]]
+                            for c in value_cols}})
+            i += 2
+            continue
+        label = {0: "+I", 1: "-U", 2: "+U", 3: "-D"}[kind]
+        rows.append({"rowkind": label,
+                     **{c: [lists[i][c]] for c in value_cols}})
+        i += 1
+    if not rows:
+        return pa.table({"rowkind": pa.array([], pa.string())})
+    return pa.Table.from_pylist(rows)
+
+
+def _table_indexes(table) -> pa.Table:
+    """Index manifest inventory: DVs, dynamic-bucket hash indexes...
+    (reference TableIndexesTable)."""
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None or not snapshot.index_manifest:
+        return pa.table({"index_type": pa.array([], pa.string())})
+    scan = table.new_scan()
+    rows = []
+    for e in scan.index_manifest_file.read(snapshot.index_manifest):
+        rows.append({
+            "partition": str(list(
+                scan._partition_codec.from_bytes(e.partition))),
+            "bucket": e.bucket,
+            "index_type": e.index_file.index_type,
+            "file_name": e.index_file.file_name,
+            "file_size": e.index_file.file_size,
+            "row_count": e.index_file.row_count,
+        })
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "index_type": pa.array([], pa.string())})
+
+
+def _file_key_ranges(table) -> pa.Table:
+    """Decoded per-file primary-key ranges (reference
+    FileKeyRangesTable)."""
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return pa.table({"file_name": pa.array([], pa.string())})
+    from paimon_tpu.data.binary_row import BinaryRowCodec
+    scan = table.new_scan()
+    pk_types = [table.schema.logical_row_type().get_field(k).type
+                .copy(False)
+                for k in table.schema.trimmed_primary_keys()]
+    codec = BinaryRowCodec(pk_types) if pk_types else None
+    rows = []
+    for e in scan.read_entries(snapshot):
+        f = e.file
+        rows.append({
+            "partition": str(list(
+                scan._partition_codec.from_bytes(e.partition))),
+            "bucket": e.bucket,
+            "file_name": f.file_name,
+            "level": f.level,
+            "min_key": str(list(codec.from_bytes(f.min_key)))
+            if codec and f.min_key else None,
+            "max_key": str(list(codec.from_bytes(f.max_key)))
+            if codec and f.max_key else None,
+            "record_count": f.row_count,
+        })
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "file_name": pa.array([], pa.string())})
+
+
+def _row_tracking(table) -> pa.Table:
+    """Row-id ranges per data file of a tracked append table
+    (reference RowTrackingTable)."""
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return pa.table({"file_name": pa.array([], pa.string())})
+    scan = table.new_scan()
+    rows = []
+    for e in scan.read_entries(snapshot):
+        f = e.file
+        rows.append({
+            "partition": str(list(
+                scan._partition_codec.from_bytes(e.partition))),
+            "bucket": e.bucket,
+            "file_name": f.file_name,
+            "first_row_id": f.first_row_id,
+            "row_count": f.row_count,
+            "write_cols": str(f.write_cols) if f.write_cols else None,
+            "next_row_id_after": None if f.first_row_id is None
+            else f.first_row_id + f.row_count,
+        })
+    return pa.Table.from_pylist(rows) if rows else pa.table({
+        "file_name": pa.array([], pa.string())})
+
+
 SYSTEM_TABLES: Dict[str, Callable] = {
     "snapshots": _snapshots,
     "schemas": _schemas,
@@ -195,6 +357,13 @@ SYSTEM_TABLES: Dict[str, Callable] = {
     "partitions": _partitions,
     "buckets": _buckets,
     "audit_log": _audit_log,
+    "read_optimized": _read_optimized,
+    "aggregation_fields": _aggregation_fields,
+    "statistics": _statistics,
+    "binlog": _binlog,
+    "table_indexes": _table_indexes,
+    "file_key_ranges": _file_key_ranges,
+    "row_tracking": _row_tracking,
 }
 
 

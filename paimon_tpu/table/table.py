@@ -512,7 +512,36 @@ class TableScan:
                 ts_millis)
             if snapshot is None:
                 return ScanPlan(None, [])
-        return self._scan.plan(snapshot)
+        plan = self._scan.plan(snapshot)
+        fallback = opts.get(CoreOptions.SCAN_FALLBACK_BRANCH)
+        if fallback and fallback != table.branch:
+            plan = self._with_fallback_partitions(plan, fallback)
+        return plan
+
+    def _with_fallback_partitions(self, plan: ScanPlan,
+                                  fallback_branch: str) -> ScanPlan:
+        """Partition-level branch fallback: partitions with no data in
+        the current branch read from `scan.fallback-branch` instead
+        (reference table/FallbackReadFileStoreTable.java — e.g. a
+        streaming branch backfilled by a batch branch)."""
+        table = self.builder.table
+        fb = FileStoreTable.load(
+            table.path, table.file_io,
+            dynamic_options={"branch": fallback_branch,
+                             "scan.fallback-branch": ""})
+        rb = fb.new_read_builder()
+        if self.builder._partition_filter:
+            rb = rb.with_partition_filter(self.builder._partition_filter)
+        if self.builder._predicate is not None:
+            rb = rb.with_filter(self.builder._predicate)
+        if self.builder._buckets:
+            rb = rb.with_buckets(self.builder._buckets)
+        fb_plan = rb.new_scan().plan()
+        have = {tuple(s.partition) for s in plan.splits}
+        extra = [s for s in fb_plan.splits
+                 if tuple(s.partition) not in have]
+        return ScanPlan(plan.snapshot_id, list(plan.splits) + extra,
+                        streaming=plan.streaming)
 
     def _plan_incremental(self, between: str) -> ScanPlan:
         """Batch incremental read of the deltas in (start, end]

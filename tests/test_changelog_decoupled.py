@@ -1,0 +1,235 @@
+"""Decoupled changelog retention + branch-fallback reads + new system
+tables.
+
+reference: utils/ChangelogManager.java + Changelog.java (changelog
+outlives snapshots), table/FallbackReadFileStoreTable.java
+(scan.fallback-branch partition fallback),
+table/system/SystemTableLoader.java (full loader set).
+"""
+
+import os
+
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.maintenance import expire_changelogs
+from paimon_tpu.schema import Schema
+from paimon_tpu.snapshot.changelog_manager import ChangelogManager
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType, RowKind
+
+
+def cl_table(tmp_path, **opts):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "changelog-producer": "input",
+                        "changelog.num-retained.max": "50",
+                        **opts})
+              .build())
+    return FileStoreTable.create(str(tmp_path / "t"), schema)
+
+
+def commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows, row_kinds=kinds)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+class TestDecoupledChangelog:
+    def test_changelog_survives_snapshot_expiry(self, tmp_path):
+        t = cl_table(tmp_path)
+        for i in range(6):
+            commit(t, [{"id": i, "v": float(i)}])
+        t.expire_snapshots(retain_max=2, retain_min=1)
+        sm = t.snapshot_manager
+        assert sm.earliest_snapshot_id() == 5
+        cm = ChangelogManager(t.file_io, t.path)
+        ids = cm._ids()
+        assert ids and min(ids) == 1          # expired snapshots' logs
+        # the preserved entry still points at readable changelog files
+        scan = t.new_scan()
+        for cid in ids:
+            snap = cm.changelog(cid)
+            plan = scan.plan_changelog(snap, streaming=True)
+            rows = t.new_read_builder().new_read().to_arrow(plan)
+            assert rows.num_rows == 1
+
+    def test_stream_consumer_reads_past_expiry(self, tmp_path):
+        t = cl_table(tmp_path)
+        for i in range(5):
+            commit(t, [{"id": i, "v": float(i)}])
+        scan = t.copy({"scan.mode": "from-snapshot",
+                       "scan.snapshot-id": "1"}) \
+            .new_read_builder().new_stream_scan()
+        t.expire_snapshots(retain_max=2, retain_min=1)
+        read = t.new_read_builder().new_read()
+        seen = []
+        while True:
+            plan = scan.plan()
+            if plan is None:
+                break
+            rows = read.to_arrow(plan)
+            seen.extend(rows.to_pylist())
+        assert sorted(r["id"] for r in seen) == [0, 1, 2, 3, 4]
+
+    def test_expire_changelogs_trims(self, tmp_path):
+        t = cl_table(tmp_path, **{"changelog.num-retained.max": "4"})
+        for i in range(8):
+            commit(t, [{"id": i, "v": float(i)}])
+        t.expire_snapshots(retain_max=2, retain_min=1)
+        cm = ChangelogManager(t.file_io, t.path)
+        before = cm._ids()
+        assert before
+        res = expire_changelogs(t)
+        after = cm._ids()
+        assert len(after) < len(before)
+        assert res.expired_snapshots
+        # survivors still readable
+        scan = t.new_scan()
+        for cid in after:
+            plan = scan.plan_changelog(cm.changelog(cid),
+                                       streaming=True)
+            assert t.new_read_builder().new_read() \
+                .to_arrow(plan).num_rows == 1
+
+    def test_expire_changelogs_respects_tags(self, tmp_path):
+        """A tag pins its snapshot's changelog files even after the
+        decoupled entry is trimmed (reference ExpireChangelogImpl
+        takes the TagManager)."""
+        t = cl_table(tmp_path, **{"changelog.num-retained.max": "1"})
+        for i in range(4):
+            commit(t, [{"id": i, "v": float(i)}])
+        t.create_tag("pin", snapshot_id=2)
+        t.expire_snapshots(retain_max=1, retain_min=1)
+        expire_changelogs(t)
+        # the tagged snapshot's changelog files must still be readable
+        tagged = t.tag_manager.get_tag("pin")
+        scan = t.new_scan()
+        plan = scan.plan_changelog(tagged, streaming=True)
+        rows = t.new_read_builder().new_read().to_arrow(plan)
+        assert rows.num_rows == 1
+
+    def test_without_option_changelog_dies_with_snapshot(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .primary_key("id")
+                  .options({"bucket": "1", "write-only": "true",
+                            "changelog-producer": "input"})
+                  .build())
+        t4 = FileStoreTable.create(str(tmp_path / "plain"), schema)
+        for i in range(5):
+            commit(t4, [{"id": i, "v": 0.0}])
+        t4.expire_snapshots(retain_max=2, retain_min=1)
+        assert ChangelogManager(t4.file_io, t4.path)._ids() == []
+
+
+class TestFallbackBranch:
+    def test_partition_fallback_reads(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("pt", IntType(False))
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .partition_keys("pt")
+                  .primary_key("pt", "id")
+                  .options({"bucket": "1", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        # main branch: partitions 0 and 1
+        commit(t, [{"pt": 0, "id": 1, "v": 0.1},
+                   {"pt": 1, "id": 1, "v": 1.1}])
+        t.create_tag("base")
+        t.create_branch("backfill", "base")
+        # backfill branch gets partition 2 (and its own pt=1 the main
+        # branch must shadow)
+        fb = FileStoreTable.load(t.path, dynamic_options={
+            "branch": "backfill"})
+        commit(fb, [{"pt": 2, "id": 1, "v": 2.2},
+                    {"pt": 1, "id": 9, "v": 9.9}])
+
+        plain = t.to_arrow().to_pylist()
+        assert {r["pt"] for r in plain} == {0, 1}
+
+        with_fb = t.copy({"scan.fallback-branch": "backfill"})
+        rows = sorted(with_fb.to_arrow().to_pylist(),
+                      key=lambda r: (r["pt"], r["id"]))
+        # pt 2 came from the fallback; pt 1 stayed main-branch only
+        assert {r["pt"] for r in rows} == {0, 1, 2}
+        assert [r for r in rows if r["pt"] == 2][0]["v"] == 2.2
+        assert all(r["id"] != 9 for r in rows if r["pt"] == 1)
+
+
+class TestNewSystemTables:
+    def _table(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", IntType())
+                  .primary_key("id")
+                  .options({"bucket": "1", "write-only": "true",
+                            "merge-engine": "aggregation",
+                            "fields.v.aggregate-function": "sum"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        commit(t, [{"id": 1, "v": 5}, {"id": 2, "v": 6}])
+        commit(t, [{"id": 1, "v": 1}])
+        return t
+
+    def test_aggregation_fields(self, tmp_path):
+        t = self._table(tmp_path)
+        rows = t.system_table("aggregation_fields").to_pylist()
+        by = {r["field_name"]: r for r in rows}
+        assert by["v"]["function"] == "sum"
+        assert by["id"]["function"] == "primary-key"
+
+    def test_read_optimized(self, tmp_path):
+        t = self._table(tmp_path)
+        assert t.system_table("read_optimized").num_rows == 0  # all L0
+        t.compact(full=True)
+        ro = t.system_table("read_optimized")
+        assert sorted(ro.column("id").to_pylist()) == [1, 2]
+
+    def test_binlog_pairs_updates(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", IntType())
+                  .primary_key("id")
+                  .options({"bucket": "1", "write-only": "true",
+                            "changelog-producer": "input"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        commit(t, [{"id": 1, "v": 10}])
+        rows = t.system_table("binlog").to_pylist()
+        assert rows[0]["rowkind"] == "+I"
+        assert rows[0]["v"] == [10]
+
+    def test_file_key_ranges_and_table_indexes(self, tmp_path):
+        t = self._table(tmp_path)
+        kr = t.system_table("file_key_ranges").to_pylist()
+        assert kr and kr[0]["min_key"] is not None
+        # indexes table: empty but well-formed here
+        ti = t.system_table("table_indexes")
+        assert "index_type" in ti.column_names
+
+    def test_statistics(self, tmp_path):
+        t = self._table(tmp_path)
+        t.analyze()
+        st = t.system_table("statistics").to_pylist()
+        assert st and st[0]["snapshot_id"] is not None
+
+    def test_row_tracking_table(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .options({"bucket": "-1",
+                            "row-tracking.enabled": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "rt"), schema)
+        commit(t, [{"id": 1}, {"id": 2}])
+        rows = t.system_table("row_tracking").to_pylist()
+        assert rows[0]["first_row_id"] == 0
+        assert rows[0]["next_row_id_after"] == 2
