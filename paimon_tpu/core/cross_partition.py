@@ -15,7 +15,7 @@ the batch, not the table.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -35,26 +35,125 @@ class CrossPartitionUpsertWrite:
         self.table = table
         self.pk = table.schema.trimmed_primary_keys()
         self.partition_keys = table.schema.partition_keys
-        self._index: Optional[Dict[Tuple, Tuple]] = None
+        # two-tier index: a PERSISTENT sorted base (SST spilled next to
+        # the table, shared across writers at the same snapshot —
+        # reference GlobalIndexAssigner's RocksDB) plus an in-RAM
+        # overlay of this writer's own changes (None = deleted)
+        self._overlay: Dict[Tuple, Optional[Tuple]] = {}
+        self._reader = None
+        self._encoder = None
+        self._dict_index: Optional[Dict[Tuple, Tuple]] = None
+        self._bootstrapped = False
 
     # -- bootstrap (reference IndexBootstrap) --------------------------------
 
-    def _bootstrap(self) -> Dict[Tuple, Tuple]:
-        if self._index is not None:
-            return self._index
-        index: Dict[Tuple, Tuple] = {}
+    def _index_dir(self) -> str:
+        return f"{self.table.path}/index/cross-partition"
+
+    def _bootstrap_store(self):
+        """Build or load the persistent base index for the latest
+        snapshot.  Non-local FileIO (e.g. memory://) falls back to the
+        in-RAM dict bootstrap."""
+        if self._bootstrapped:
+            return
+        self._bootstrapped = True
+        import os
+
+        from paimon_tpu.fs import LocalFileIO
+        from paimon_tpu.lookup.sst import SstReader, SstWriter, pack_lanes
+        from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+        from paimon_tpu.types import data_type_to_arrow
+
         snapshot = self.table.snapshot_manager.latest_snapshot()
-        if snapshot is not None:
-            cols = list(dict.fromkeys(self.pk + self.partition_keys))
-            data = self.table.to_arrow(projection=cols)
-            pk_cols = [data.column(k).to_pylist() for k in self.pk]
-            part_cols = [data.column(k).to_pylist()
-                         for k in self.partition_keys]
-            for i in range(data.num_rows):
-                key = tuple(c[i] for c in pk_cols)
-                index[key] = tuple(c[i] for c in part_cols)
-        self._index = index
+        rt = self.table.schema.logical_row_type()
+        self._encoder = NormalizedKeyEncoder(
+            [data_type_to_arrow(rt.get_field(k).type) for k in self.pk],
+            nullable=[rt.get_field(k).type.nullable for k in self.pk])
+        if snapshot is None:
+            return
+        if not isinstance(self.table.file_io, LocalFileIO):
+            self._dict_index = self._scan_index()
+            return
+        path = os.path.join(self._index_dir(),
+                            f"snapshot-{snapshot.id}.sst")
+        if os.path.exists(path):
+            self._reader = SstReader(path)
+            return
+        cols = list(dict.fromkeys(self.pk + self.partition_keys))
+        data = self.table.to_arrow(projection=cols)
+        lanes, _ = self._encoder.encode_table(data, self.pk)
+        order = np.argsort(pack_lanes(lanes), kind="stable")
+        os.makedirs(self._index_dir(), exist_ok=True)
+        tmp = path + ".tmp"
+        SstWriter().write(tmp, lanes[order],
+                          data.take(pa.array(order)))
+        try:
+            os.rename(tmp, path)         # atomic publish; racers agree
+        except OSError:
+            pass
+        self._reader = SstReader(path)
+        # trim spilled indexes well behind the head; a trailing window
+        # stays so concurrent writers still probing a recent snapshot's
+        # file never lose it mid-write
+        from paimon_tpu.lookup.sst import _GLOBAL_BLOCK_CACHE
+        for name in os.listdir(self._index_dir()):
+            if not name.endswith(".sst"):
+                continue
+            try:
+                sid = int(name[len("snapshot-"):-len(".sst")])
+            except ValueError:
+                continue
+            if sid < snapshot.id - 5:
+                stale = os.path.join(self._index_dir(), name)
+                _GLOBAL_BLOCK_CACHE.drop_file(stale)
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+
+    def _scan_index(self) -> Dict[Tuple, Tuple]:
+        index: Dict[Tuple, Tuple] = {}
+        cols = list(dict.fromkeys(self.pk + self.partition_keys))
+        data = self.table.to_arrow(projection=cols)
+        pk_cols = [data.column(k).to_pylist() for k in self.pk]
+        part_cols = [data.column(k).to_pylist()
+                     for k in self.partition_keys]
+        for i in range(data.num_rows):
+            index[tuple(c[i] for c in pk_cols)] = \
+                tuple(c[i] for c in part_cols)
         return index
+
+    def _probe_batch(self, table: pa.Table,
+                     pk_cols) -> Dict[Tuple, Optional[Tuple]]:
+        """Current partition of every key in the batch: overlay first,
+        then ONE vectorized SST probe for the rest."""
+        self._bootstrap_store()
+        n = table.num_rows
+        keys = [tuple(c[i] for c in pk_cols) for i in range(n)]
+        view: Dict[Tuple, Optional[Tuple]] = {}
+        need: List[int] = []
+        for i, k in enumerate(keys):
+            if k in view:
+                continue
+            if k in self._overlay:
+                view[k] = self._overlay[k]
+            elif self._dict_index is not None:
+                view[k] = self._dict_index.get(k)
+            else:
+                view[k] = None
+                need.append(i)
+        if need and self._reader is not None:
+            sub = table.take(pa.array(need)).select(self.pk)
+            lanes, _ = self._encoder.encode_table(sub, self.pk)
+            hit_pos, rows = self._reader.probe(lanes)
+            if rows is not None:
+                row_dicts = rows.to_pylist()
+                for pos, row in zip(hit_pos, row_dicts):
+                    k = keys[need[int(pos)]]
+                    if tuple(row[c] for c in self.pk) == k:
+                        view[k] = tuple(row[c]
+                                        for c in self.partition_keys)
+        return view
 
     # -- writes --------------------------------------------------------------
 
@@ -70,11 +169,12 @@ class CrossPartitionUpsertWrite:
             row_kinds = np.zeros(table.num_rows, dtype=np.int8)
         row_kinds = np.asarray(row_kinds, dtype=np.int8)
 
-        index = self._bootstrap()
         n = table.num_rows
         pk_cols = [table.column(k).to_pylist() for k in self.pk]
         part_cols = [table.column(k).to_pylist()
                      for k in self.partition_keys]
+        index = self._probe_batch(table, pk_cols)
+        overlay = self._overlay
 
         drop = np.zeros(n, dtype=bool)   # superseded within this batch
         # key -> (i, part, was_insert)
@@ -97,13 +197,15 @@ class CrossPartitionUpsertWrite:
                         persisted_old != new_part and key not in retracts:
                     retracts[key] = (i, persisted_old)
                     drop[i] = True       # rerouted copy replaces it
-                index.pop(key, None)
+                index[key] = None
+                overlay[key] = None
                 batch_last[key] = (i, new_part, False)
                 continue
             if persisted_old is not None and persisted_old != new_part \
                     and key not in retracts:
                 retracts[key] = (i, persisted_old)
             index[key] = new_part
+            overlay[key] = new_part
             batch_last[key] = (i, new_part, True)
 
         if retracts:

@@ -176,6 +176,56 @@ class _BucketWriter:
         return None if msg.is_empty() else msg
 
 
+class LocalMerger:
+    """Pre-shuffle hot-key dedup (reference mergetree/localmerge/
+    HashMapLocalMerger.java): rows buffer BEFORE bucket routing; when
+    the buffer reaches `local-merge-buffer-size`, duplicate keys
+    collapse to their winning version with the device merge kernel, so
+    a hot key reaches the bucket writers once per flush instead of once
+    per update.  Row kinds ride along — a DELETE that wins the merge
+    still propagates as a DELETE."""
+
+    def __init__(self, store: "KeyValueFileStoreWrite",
+                 buffer_bytes: int):
+        self.store = store
+        self.buffer_bytes = buffer_bytes
+        self._tables: List[pa.Table] = []
+        self._kinds: List[np.ndarray] = []
+        self._nbytes = 0
+
+    def add(self, table: pa.Table, kinds: np.ndarray):
+        self._tables.append(table)
+        self._kinds.append(kinds)
+        self._nbytes += table.nbytes
+        if self._nbytes >= self.buffer_bytes:
+            self.flush()
+
+    def flush(self):
+        if not self._tables:
+            return
+        raw = pa.concat_tables(self._tables, promote_options="none")
+        kinds = np.concatenate(self._kinds)
+        self._tables, self._kinds, self._nbytes = [], [], 0
+        if raw.num_rows == 0:
+            return
+        schema = self.store.schema
+        engine = self.store.options.merge_engine
+        kv = build_kv_table(raw, schema,
+                            np.arange(raw.num_rows, dtype=np.int64),
+                            kinds)
+        # the merge runs BEFORE partition routing, so the fold key must
+        # include the partition columns — trimmed pks alone would
+        # collapse distinct rows across partitions (and swallow
+        # cross-partition reroute deletes)
+        key_cols = list(schema.partition_keys) + \
+            [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
+        res = merge_runs(
+            [kv], key_cols, merge_engine=engine, drop_deletes=False,
+            seq_fields=self.store.options.sequence_field or None)
+        idx = res.indices
+        self.store._dispatch(raw.take(pa.array(idx)), kinds[idx])
+
+
 class KeyValueFileStoreWrite:
     """Routes rows to per-(partition,bucket) writers.
 
@@ -245,6 +295,21 @@ class KeyValueFileStoreWrite:
         self.changelog_input = (
             options.changelog_producer == "input")
         self._changelog_counter = 0
+        self._local_merger: Optional[LocalMerger] = None
+        lm_size = options.get(CoreOptions.LOCAL_MERGE_BUFFER_SIZE)
+        if lm_size:
+            from paimon_tpu.options import MergeEngine
+            if options.merge_engine not in (MergeEngine.DEDUPLICATE,
+                                            MergeEngine.FIRST_ROW):
+                raise ValueError(
+                    "local-merge-buffer-size supports deduplicate / "
+                    "first-row merge engines (reference "
+                    "HashMapLocalMerger applies whole-row merges)")
+            if self.changelog_input:
+                raise ValueError(
+                    "local-merge-buffer-size folds input rows, which "
+                    "would drop changelog-producer=input events")
+            self._local_merger = LocalMerger(self, lm_size)
 
     # -- seam for restore (reference operation/WriteRestore.java) ------------
 
@@ -275,6 +340,12 @@ class KeyValueFileStoreWrite:
             row_kinds = np.zeros(table.num_rows, dtype=np.int8)
         row_kinds = np.asarray(row_kinds, dtype=np.int8)
 
+        if self._local_merger is not None and not self._postpone:
+            self._local_merger.add(table, row_kinds)
+            return
+        self._dispatch(table, row_kinds)
+
+    def _dispatch(self, table: pa.Table, row_kinds: np.ndarray):
         if self._postpone:
             buckets = np.full(table.num_rows, -2, dtype=np.int32)
             for (part, bucket), idx in group_by_partition_bucket(
@@ -311,6 +382,8 @@ class KeyValueFileStoreWrite:
         return self._writers[key]
 
     def prepare_commit(self) -> List[CommitMessage]:
+        if self._local_merger is not None:
+            self._local_merger.flush()
         out = []
         auto_compact = not self.options.write_only and not self._postpone
         existing_map = None

@@ -89,6 +89,31 @@ def _snapshot_refs(table, snapshot: Snapshot
     return data, manifests
 
 
+def _walk_manifest_list(scan, list_name: str, data: Set[Tuple],
+                        manifests: Set[str]):
+    """Record every manifest name and ADDed data ref (incl. extra
+    files) reachable from one manifest list — the single traversal
+    shared by snapshot-plane and changelog-plane ref collection."""
+    entries = []
+    manifests.add(list_name)
+    try:
+        metas = scan.manifest_list.read(list_name)
+    except FileNotFoundError:
+        return entries
+    for m in metas:
+        manifests.add(m.file_name)
+        try:
+            entries.extend(scan.manifest_file.read(m.file_name))
+        except FileNotFoundError:
+            continue
+    for e in entries:
+        if e.kind == FileKind.ADD:
+            data.add((e.partition, e.bucket, e.file.file_name))
+            for extra in e.file.extra_files:
+                data.add((e.partition, e.bucket, extra))
+    return entries
+
+
 def _changelog_refs(table, snapshot, scan=None):
     """(data refs, manifest names) pinned by a snapshot's CHANGELOG
     plane only."""
@@ -96,23 +121,9 @@ def _changelog_refs(table, snapshot, scan=None):
         scan = table.new_scan()
     data: Set[Tuple] = set()
     manifests: Set[str] = set()
-    if not snapshot.changelog_manifest_list:
-        return data, manifests
-    manifests.add(snapshot.changelog_manifest_list)
-    try:
-        metas = scan.manifest_list.read(snapshot.changelog_manifest_list)
-    except FileNotFoundError:
-        return data, manifests
-    for m in metas:
-        manifests.add(m.file_name)
-        try:
-            for e in scan.manifest_file.read(m.file_name):
-                if e.kind == FileKind.ADD:
-                    data.add((e.partition, e.bucket, e.file.file_name))
-                    for extra in e.file.extra_files:
-                        data.add((e.partition, e.bucket, extra))
-        except FileNotFoundError:
-            continue
+    if snapshot.changelog_manifest_list:
+        _walk_manifest_list(scan, snapshot.changelog_manifest_list,
+                            data, manifests)
     return data, manifests
 
 
@@ -282,10 +293,13 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
         from paimon_tpu.snapshot.changelog_manager import ChangelogManager
         cm = ChangelogManager(table.file_io, table.path, table.branch)
         for s in expiring:
-            if not s.changelog_manifest_list:
-                continue
+            # EVERY expiring snapshot gets an entry — a gap at a
+            # changelog-less id (e.g. a COMPACT commit) would strand
+            # stream consumers walking ids past expiry
             if not dry_run:
                 cm.commit_changelog(s)
+            if not s.changelog_manifest_list:
+                continue
             pinned, pinned_manifests = _changelog_refs(table, s, scan)
             dead_data -= pinned
             dead_manifests -= pinned_manifests

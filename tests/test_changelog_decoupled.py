@@ -78,6 +78,38 @@ class TestDecoupledChangelog:
             seen.extend(rows.to_pylist())
         assert sorted(r["id"] for r in seen) == [0, 1, 2, 3, 4]
 
+    def test_compact_snapshot_gap_does_not_strand_consumers(
+            self, tmp_path):
+        """Changelog-less snapshots (COMPACT commits) still leave a
+        decoupled entry so consumers walking expired ids never hit a
+        permanent FileNotFoundError gap."""
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .primary_key("id")
+                  .options({"bucket": "1", "write-only": "true",
+                            "changelog-producer": "input",
+                            "changelog.num-retained.max": "50"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        commit(t, [{"id": 0, "v": 0.0}])
+        commit(t, [{"id": 1, "v": 1.0}])
+        t.compact(full=True)              # snapshot 3: COMPACT
+        commit(t, [{"id": 2, "v": 2.0}])
+        commit(t, [{"id": 3, "v": 3.0}])
+        scan = t.copy({"scan.mode": "from-snapshot",
+                       "scan.snapshot-id": "1"}) \
+            .new_read_builder().new_stream_scan()
+        t.expire_snapshots(retain_max=1, retain_min=1)
+        read = t.new_read_builder().new_read()
+        seen = []
+        while True:
+            plan = scan.plan()
+            if plan is None:
+                break
+            seen.extend(read.to_arrow(plan).to_pylist())
+        assert sorted(r["id"] for r in seen) == [0, 1, 2, 3]
+
     def test_expire_changelogs_trims(self, tmp_path):
         t = cl_table(tmp_path, **{"changelog.num-retained.max": "4"})
         for i in range(8):

@@ -95,3 +95,21 @@ def test_cdc_retract_then_insert_same_batch(tmp_warehouse):
             kinds=[RowKind.UPDATE_BEFORE, RowKind.UPDATE_AFTER])
     rows = table.to_arrow().to_pylist()
     assert rows == [{"id": 1, "dt": "d2", "v": 2.0}]
+
+
+def test_persistent_index_shared_across_writers(tmp_warehouse):
+    """The bootstrapped index spills to an SST next to the table; a
+    second writer at the same snapshot loads it instead of rescanning
+    (reference GlobalIndexAssigner persists via RocksDB)."""
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "dt": "d1", "v": 1.0},
+                    {"id": 2, "dt": "d1", "v": 2.0}])
+    # first writer bootstraps and spills
+    _commit(table, [{"id": 1, "dt": "d2", "v": 10.0}])
+    idx_dir = os.path.join(table.path, "index", "cross-partition")
+    assert any(f.endswith(".sst") for f in os.listdir(idx_dir))
+    # second writer (fresh object) moves the key again using the index
+    t2 = FileStoreTable.load(table.path)
+    _commit(t2, [{"id": 1, "dt": "d3", "v": 100.0}])
+    rows = sorted(t2.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert [(r["dt"], r["id"]) for r in rows] == [("d3", 1), ("d1", 2)]

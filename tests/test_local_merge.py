@@ -1,0 +1,121 @@
+"""Local merge: pre-shuffle hot-key dedup in the write path.
+
+reference: mergetree/localmerge/HashMapLocalMerger.java (+ LocalMerger
+SPI wired by MergeTreeWriter when local-merge-buffer-size is set).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, RowKind
+
+
+def lm_table(tmp_path, **opts):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "2", "write-only": "true",
+                        "local-merge-buffer-size": "1mb", **opts})
+              .build())
+    return FileStoreTable.create(str(tmp_path / "t"), schema)
+
+
+def test_hot_key_collapses_before_bucket_write(tmp_path):
+    t = lm_table(tmp_path)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    # 1000 updates of ONE hot key + some cold keys, many small writes
+    for i in range(100):
+        w.write_dicts([{"id": 7, "v": float(i)},
+                       {"id": 1000 + i, "v": 1.0}])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    out = t.to_arrow().sort_by("id").to_pylist()
+    assert [r for r in out if r["id"] == 7][0]["v"] == 99.0
+    assert len(out) == 101
+    # the hot key reached storage once: total stored rows == distinct
+    files = [f for s in t.new_read_builder().new_scan().plan().splits
+             for f in s.data_files]
+    assert sum(f.row_count for f in files) == 101
+
+
+def test_delete_wins_through_local_merge(tmp_path):
+    t = lm_table(tmp_path)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}])
+    w.write_dicts([{"id": 1, "v": 1.0}], row_kinds=[RowKind.DELETE])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    out = t.to_arrow().to_pylist()
+    assert [r["id"] for r in out] == [2]
+
+
+def test_sequence_field_respected(tmp_path):
+    t = lm_table(tmp_path, **{"sequence.field": "v"})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1, "v": 9.0}])
+    w.write_dicts([{"id": 1, "v": 3.0}])     # lower sequence: loses
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    assert t.to_arrow().to_pylist()[0]["v"] == 9.0
+
+
+def test_buffer_flush_at_threshold(tmp_path):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "local-merge-buffer-size": "4kb"})
+              .build())
+    t = FileStoreTable.create(str(tmp_path / "t"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    for i in range(50):
+        w.write_dicts([{"id": j, "v": float(i)} for j in range(64)])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    out = t.to_arrow()
+    assert out.num_rows == 64
+    assert set(out.column("v").to_pylist()) == {49.0}
+
+
+def test_partitioned_rows_do_not_collapse(tmp_path):
+    """The fold key must include partition columns: same id in two
+    partitions is two rows (pk = (pt, id))."""
+    from paimon_tpu.types import IntType
+    schema = (Schema.builder()
+              .column("pt", IntType(False))
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .partition_keys("pt")
+              .primary_key("pt", "id")
+              .options({"bucket": "1", "write-only": "true",
+                        "local-merge-buffer-size": "1mb"})
+              .build())
+    t = FileStoreTable.create(str(tmp_path / "t"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"pt": 1, "id": 1, "v": 1.0},
+                   {"pt": 2, "id": 1, "v": 2.0}])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    rows = sorted(t.to_arrow().to_pylist(), key=lambda r: r["pt"])
+    assert [(r["pt"], r["v"]) for r in rows] == [(1, 1.0), (2, 2.0)]
+
+
+def test_incompatible_configs_refuse(tmp_path):
+    with pytest.raises(ValueError, match="local-merge"):
+        t = lm_table(tmp_path, **{"merge-engine": "partial-update"})
+        wb = t.new_batch_write_builder()
+        wb.new_write()
+    with pytest.raises(ValueError, match="changelog"):
+        t = lm_table(tmp_path / "b", **{"changelog-producer": "input"})
+        wb = t.new_batch_write_builder()
+        wb.new_write()
