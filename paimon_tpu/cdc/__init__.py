@@ -7,6 +7,7 @@ SchemaManager before writing).
 """
 
 from paimon_tpu.cdc.sink import CdcSinkWriter  # noqa: F401
+from paimon_tpu.cdc.database_sync import CdcDatabaseSync  # noqa: F401
 from paimon_tpu.cdc.formats import (  # noqa: F401
     parse_canal, parse_debezium, parse_maxwell,
 )

@@ -53,12 +53,39 @@ def _infer_type(values: List) -> DataType:
     return VarCharType()
 
 
+_WIDEN_RANK = {"BOOLEAN": 0, "TINYINT": 1, "SMALLINT": 2, "INT": 3,
+               "BIGINT": 4, "FLOAT": 5, "DOUBLE": 6, "DECIMAL": 6,
+               "TIMESTAMP": 8, "TIMESTAMP_WITH_LOCAL_TIME_ZONE": 8,
+               "CHAR": 9, "VARCHAR": 9}
+_NUMERIC_RANKS = {0, 1, 2, 3, 4, 5, 6}
+
+
+def _widen(cur: DataType, want: DataType) -> Optional[DataType]:
+    """The type `cur` must become to also hold `want`-shaped values, or
+    None when it already can (reference UpdatedDataFieldsProcessFunction
+    .canConvert widening lattice).  Numeric widths widen within the
+    lattice (INT -> BIGINT -> DOUBLE); any cross-family conflict —
+    e.g. numeric meeting TIMESTAMP, whose cast old files cannot
+    satisfy — falls back to STRING."""
+    a = _WIDEN_RANK.get(cur.root, 9)
+    b = _WIDEN_RANK.get(want.root, 9)
+    if b <= a:
+        return None
+    if b == 9:
+        return VarCharType()
+    if a in _NUMERIC_RANKS and b in _NUMERIC_RANKS:
+        return DoubleType() if b >= 5 else want
+    # cross-family (numeric vs temporal): only STRING holds both
+    return VarCharType()
+
+
 class CdcSinkWriter:
     """Parses CDC events, evolves the schema for unseen columns and
     writes through the normal table write path."""
 
     def __init__(self, table: FileStoreTable, format: str = "debezium",
-                 commit_user: Optional[str] = None):
+                 commit_user: Optional[str] = None,
+                 computed_columns: Optional[List[str]] = None):
         if format not in _PARSERS:
             raise ValueError(f"Unknown CDC format {format!r}; "
                              f"available: {sorted(_PARSERS)}")
@@ -67,19 +94,38 @@ class CdcSinkWriter:
         self.commit_user = commit_user or "cdc"
         self._writer = None
         self._pending_msgs = []
+        self._computed = None
+        if computed_columns:
+            from paimon_tpu.cdc.computed import parse_computed_columns
+            self._computed = parse_computed_columns(computed_columns)
 
     def _ensure_schema(self, rows: List[Dict]):
-        """ADD COLUMN for keys the table does not know yet."""
-        known = {f.name for f in self.table.schema.fields}
+        """ADD COLUMN for unseen keys; widen existing columns whose
+        incoming values no longer fit (reference
+        UpdatedDataFieldsProcessFunction type merging).  Columns seen
+        only as null are DEFERRED — creating them as STRING on a
+        null-only first batch would lock in the wrong type."""
+        by_name = {f.name: f for f in self.table.schema.fields}
         unseen: Dict[str, List] = {}
+        seen_vals: Dict[str, List] = {}
         for row in rows:
             for k, v in row.items():
-                if k not in known:
+                if k not in by_name:
                     unseen.setdefault(k, []).append(v)
-        if not unseen:
-            return
+                elif v is not None:
+                    seen_vals.setdefault(k, []).append(v)
         changes = [SchemaChange.add_column(name, _infer_type(vals))
-                   for name, vals in unseen.items()]
+                   for name, vals in unseen.items()
+                   if any(v is not None for v in vals)]
+        for name, vals in seen_vals.items():
+            cur = by_name[name].type
+            want = _infer_type(vals)
+            widened = _widen(cur, want)
+            if widened is not None:
+                changes.append(
+                    SchemaChange.update_column_type(name, widened))
+        if not changes:
+            return
         if self._writer is not None:
             # the old writer may hold buffered, uncommitted rows: turn
             # them into pending commit messages before discarding it
@@ -100,8 +146,11 @@ class CdcSinkWriter:
             changes.extend(self._parse(event))
         if not changes:
             return
-        rows = [c[0] for c in changes]
+        rows = [dict(c[0]) for c in changes]
         kinds = np.array([c[1] for c in changes], dtype=np.int8)
+        if self._computed:
+            from paimon_tpu.cdc.computed import apply_computed_columns
+            apply_computed_columns(rows, self._computed)
         self._ensure_schema(rows)
         if self._writer is None:
             wb = self.table.new_stream_write_builder() \
@@ -109,8 +158,21 @@ class CdcSinkWriter:
             self._wb = wb
             self._writer = wb.new_write()
         schema = self.table.arrow_schema()
-        normalized = [{f.name: row.get(f.name) for f in schema}
-                      for row in rows]
+
+        def coerce(v, f):
+            # a column widened to STRING keeps ingesting the source's
+            # native values: render them (datetime -> ISO) instead of
+            # failing the arrow build
+            if v is None or not (pa.types.is_string(f.type)
+                                 or pa.types.is_large_string(f.type)):
+                return v
+            if isinstance(v, str):
+                return v
+            return v.isoformat(sep=" ") if hasattr(v, "isoformat") \
+                else str(v)
+
+        normalized = [{f.name: coerce(row.get(f.name), f)
+                       for f in schema} for row in rows]
         batch = pa.Table.from_pylist(normalized, schema=schema)
         self._writer.write_arrow(batch, kinds)
 

@@ -145,7 +145,18 @@ class CrossPartitionUpsertWrite:
         if need and self._reader is not None:
             sub = table.take(pa.array(need)).select(self.pk)
             lanes, _ = self._encoder.encode_table(sub, self.pk)
-            hit_pos, rows = self._reader.probe(lanes)
+            try:
+                hit_pos, rows = self._reader.probe(lanes)
+            except FileNotFoundError:
+                # a newer writer trimmed our snapshot's spilled index
+                # from behind the safety window: re-bootstrap at the
+                # current snapshot and retry once
+                self._bootstrapped = False
+                self._reader = None
+                self._bootstrap_store()
+                if self._reader is None:
+                    return view
+                hit_pos, rows = self._reader.probe(lanes)
             if rows is not None:
                 row_dicts = rows.to_pylist()
                 for pos, row in zip(hit_pos, row_dicts):

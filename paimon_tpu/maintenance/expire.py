@@ -76,9 +76,9 @@ def _snapshot_refs(table, snapshot: Snapshot
         if e.kind == FileKind.ADD:
             _add_file(e)
     if snapshot.changelog_manifest_list:
-        for e in _read_list(snapshot.changelog_manifest_list):
-            if e.kind == FileKind.ADD:
-                _add_file(e)
+        # changelog plane: raw ADDs, no merge — the shared walk
+        _walk_manifest_list(scan, snapshot.changelog_manifest_list,
+                            data, manifests)
     if snapshot.index_manifest:
         manifests.add(snapshot.index_manifest)
         try:
@@ -92,8 +92,10 @@ def _snapshot_refs(table, snapshot: Snapshot
 def _walk_manifest_list(scan, list_name: str, data: Set[Tuple],
                         manifests: Set[str]):
     """Record every manifest name and ADDed data ref (incl. extra
-    files) reachable from one manifest list — the single traversal
-    shared by snapshot-plane and changelog-plane ref collection."""
+    files) reachable from one manifest list — the raw-ADD traversal
+    used for the changelog plane by both _snapshot_refs and
+    _changelog_refs (the base+delta plane needs merge-cancellation
+    semantics and keeps its own walk)."""
     entries = []
     manifests.add(list_name)
     try:

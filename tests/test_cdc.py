@@ -12,7 +12,7 @@ from paimon_tpu.cdc import (
 )
 from paimon_tpu.schema import Schema
 from paimon_tpu.table import FileStoreTable
-from paimon_tpu.types import BigIntType, DoubleType, RowKind
+from paimon_tpu.types import BigIntType, DoubleType, RowKind, VarCharType
 
 
 def test_parse_debezium():
@@ -116,3 +116,142 @@ def test_cdc_schema_evolution_mid_checkpoint_keeps_buffered_rows(
                   key=lambda r: r["id"])
     assert [r["id"] for r in rows] == [1, 2]
     assert rows[1]["extra"] == 7
+
+
+# -- computed columns / widening / database sync ------------------------------
+
+def test_computed_columns_partition_from_timestamp(tmp_warehouse):
+    from paimon_tpu.cdc import CdcSinkWriter
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("ts", VarCharType.string_type())
+              .column("dt", VarCharType.string_type())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "cc"), schema)
+    w = CdcSinkWriter(table, format="debezium",
+                      computed_columns=["dt=date_format(ts, yyyy-MM-dd)"])
+    w.write_events([{"op": "c", "after": {"id": 1,
+                                          "ts": "2024-03-05 10:00:00"}}])
+    w.commit(1)
+    row = w.table.to_arrow().to_pylist()[0]
+    assert row["dt"] == "2024-03-05"
+
+
+def test_null_first_column_defers_then_infers(tmp_warehouse):
+    from paimon_tpu.cdc import CdcSinkWriter
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "nf"), schema)
+    w = CdcSinkWriter(table, format="debezium")
+    # first batch: new column arrives as all-null -> no ADD COLUMN yet
+    w.write_events([{"op": "c", "after": {"id": 1, "extra": None}}])
+    assert "extra" not in [f.name for f in w.table.schema.fields]
+    # later batch: ints -> created as BIGINT, not STRING
+    w.write_events([{"op": "c", "after": {"id": 2, "extra": 42}}])
+    w.commit(1)
+    f = [f for f in w.table.schema.fields if f.name == "extra"][0]
+    assert f.type.root == "BIGINT"
+    rows = sorted(w.table.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert rows[1]["extra"] == 42 and rows[0]["extra"] is None
+
+
+def test_type_widens_on_drift(tmp_warehouse):
+    from paimon_tpu.cdc import CdcSinkWriter
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "wd"), schema)
+    w = CdcSinkWriter(table, format="debezium")
+    w.write_events([{"op": "c", "after": {"id": 1, "x": 10}}])     # BIGINT
+    w.write_events([{"op": "c", "after": {"id": 2, "x": 1.5}}])    # widen
+    w.commit(1)
+    f = [f for f in w.table.schema.fields if f.name == "x"][0]
+    assert f.type.root == "DOUBLE"
+    rows = sorted(w.table.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert rows == [{"id": 1, "x": 10.0}, {"id": 2, "x": 1.5}]
+
+
+def test_database_sync_multi_table(tmp_warehouse):
+    from paimon_tpu.catalog import create_catalog
+    from paimon_tpu.cdc import CdcDatabaseSync
+
+    catalog = create_catalog({"warehouse": os.path.join(tmp_warehouse,
+                                                        "wh")})
+    sync = CdcDatabaseSync(
+        catalog, "appdb", format="maxwell",
+        excluding_tables="tmp_.*",
+        primary_keys={"users": ["uid"], "orders": ["oid"]})
+    sync.write_events([
+        {"database": "appdb", "table": "users", "type": "insert",
+         "data": {"uid": 1, "name": "ada"},
+         "primary_key_columns": ["uid"]},
+        {"database": "appdb", "table": "orders", "type": "insert",
+         "data": {"oid": 100, "uid": 1, "amt": 9.5},
+         "primary_key_columns": ["oid"]},
+        {"database": "appdb", "table": "tmp_scratch", "type": "insert",
+         "data": {"k": 1}},
+    ])
+    sync.write_events([
+        {"database": "appdb", "table": "users", "type": "update",
+         "data": {"uid": 1, "name": "ada l."},
+         "old": {"name": "ada"}},
+    ])
+    sync.commit(1)
+    assert sync.tables() == ["orders", "users"]
+    users = catalog.get_table("appdb.users").to_arrow().to_pylist()
+    assert users == [{"uid": 1, "name": "ada l."}]
+    orders = catalog.get_table("appdb.orders").to_arrow().to_pylist()
+    assert orders == [{"oid": 100, "uid": 1, "amt": 9.5}]
+    assert not catalog.table_exists("appdb.tmp_scratch")
+    sync.close()
+
+
+def test_widen_int_to_bigint_and_timestamp_conflict(tmp_warehouse):
+    from paimon_tpu.cdc import CdcSinkWriter
+    from paimon_tpu.types import IntType
+    import datetime
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("x", IntType())
+              .column("y", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "wl"), schema)
+    w = CdcSinkWriter(table, format="debezium")
+    w.write_events([{"op": "c", "after": {"id": 1, "x": 1 << 40,
+                                          "y": 0.5}}])
+    # INT widens to BIGINT; DOUBLE meeting datetime falls back to STRING
+    w.write_events([{"op": "c", "after": {
+        "id": 2, "x": 1, "y": datetime.datetime(2024, 1, 1)}}])
+    w.commit(1)
+    by = {f.name: f.type.root for f in w.table.schema.fields}
+    assert by["x"] == "BIGINT"
+    assert by["y"] == "VARCHAR"
+    rows = sorted(w.table.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert rows[0]["x"] == 1 << 40
+
+
+def test_database_sync_filters_foreign_database(tmp_warehouse):
+    from paimon_tpu.catalog import create_catalog
+    from paimon_tpu.cdc import CdcDatabaseSync
+    catalog = create_catalog({"warehouse": os.path.join(tmp_warehouse,
+                                                        "wh2")})
+    sync = CdcDatabaseSync(catalog, "appdb", format="maxwell",
+                           primary_keys={"users": ["uid"]})
+    sync.write_events([
+        {"database": "appdb", "table": "users", "type": "insert",
+         "data": {"uid": 1, "name": "a"}},
+        {"database": "otherdb", "table": "users", "type": "insert",
+         "data": {"uid": 99, "name": "evil"}},
+    ])
+    sync.commit(1)
+    users = catalog.get_table("appdb.users").to_arrow().to_pylist()
+    assert users == [{"uid": 1, "name": "a"}]
