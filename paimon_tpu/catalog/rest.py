@@ -129,11 +129,34 @@ class RESTCatalogServer:
                         parts[2] != "databases":
                     return self._error(404, "NotFound", self.path)
                 cat = server.catalog
+                from urllib.parse import parse_qs
+                query = parse_qs(urlparse(self.path).query)
+
+                def paged(items, key):
+                    """maxResults/pageToken pagination (reference
+                    RESTApi.MAX_RESULTS/PAGE_TOKEN; token = last
+                    name of the previous page over the sorted list)."""
+                    items = sorted(items)
+                    token = query.get("pageToken", [None])[0]
+                    if token:
+                        import bisect
+                        items = items[bisect.bisect_right(items, token):]
+                    try:
+                        max_results = int(
+                            query.get("maxResults", ["0"])[0])
+                    except ValueError:
+                        max_results = 0
+                    out = {key: items}
+                    if max_results > 0 and len(items) > max_results:
+                        out[key] = items[:max_results]
+                        out["nextPageToken"] = out[key][-1]
+                    return out
+
                 try:
                     if len(parts) == 3:
                         if method == "GET":
-                            return self._reply(200, {
-                                "databases": cat.list_databases()})
+                            return self._reply(200, paged(
+                                cat.list_databases(), "databases"))
                         if method == "POST":
                             b = self._body()
                             cat.create_database(
@@ -148,17 +171,15 @@ class RESTCatalogServer:
                                 "properties":
                                     cat.load_database_properties(db)})
                         if method == "DELETE":
-                            from urllib.parse import parse_qs, urlparse
-                            q = parse_qs(urlparse(self.path).query)
-                            cascade = q.get("cascade",
-                                            ["false"])[0] == "true"
+                            cascade = query.get("cascade",
+                                                ["false"])[0] == "true"
                             cat.drop_database(db, cascade=cascade)
                             return self._reply(200, {})
                     if len(parts) >= 5 and parts[4] == "tables":
                         if len(parts) == 5:
                             if method == "GET":
-                                return self._reply(200, {
-                                    "tables": cat.list_tables(db)})
+                                return self._reply(200, paged(
+                                    cat.list_tables(db), "tables"))
                             if method == "POST":
                                 b = self._body()
                                 t = cat.create_table(
@@ -209,41 +230,107 @@ class RESTCatalogServer:
 
 
 class RESTCatalogClient(Catalog):
-    """reference rest/RESTCatalog.java with BearTokenAuthProvider."""
+    """reference rest/RESTCatalog.java with BearTokenAuthProvider
+    (static token), BearTokenFileAuthProvider (token_file: re-read when
+    the file changes, for rotated credentials) and a custom
+    token_provider callable (role of the DLF/custom auth providers)."""
 
     def __init__(self, uri: str, token: Optional[str] = None,
-                 prefix: str = "paimon"):
+                 prefix: str = "paimon",
+                 token_file: Optional[str] = None,
+                 token_provider=None):
         self.uri = uri.rstrip("/")
         self.token = token
+        self.token_file = token_file
+        self.token_provider = token_provider
         self.prefix = prefix
+        self._file_mtime = None
+
+    def _current_token(self, force: bool = False) -> Optional[str]:
+        if self.token_provider is not None:
+            return self.token_provider()
+        if self.token_file:
+            import os
+            try:
+                st = os.stat(self.token_file)
+                sig = (st.st_mtime_ns, st.st_size)
+                if force or sig != self._file_mtime:
+                    with open(self.token_file) as f:
+                        self.token = f.read().strip()
+                    self._file_mtime = sig
+            except OSError:
+                pass
+        return self.token
 
     def _request(self, method: str, path: str,
-                 body: Optional[dict] = None) -> dict:
+                 body: Optional[dict] = None,
+                 _retry_auth: bool = True) -> dict:
         url = f"{self.uri}/v1/{self.prefix}/{path}"
         data = json.dumps(body).encode("utf-8") if body is not None \
             else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        token = self._current_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read())
-            except Exception:
-                payload = {"error": "Internal", "message": str(e)}
-            exc = _ERRORS.get(payload.get("error"))
-            if exc is not None:
-                raise exc(payload.get("message", ""))
-            raise RuntimeError(
-                f"REST catalog error {e.code}: {payload}") from e
+            if e.code == 401 and _retry_auth and self.token_file:
+                # rotated credentials may land inside the stat
+                # signature's granularity: force one re-read and retry
+                if self._current_token(force=True) != token:
+                    return self._request(method, path, body,
+                                         _retry_auth=False)
+            return self._handle_http_error(e)
+
+    def _handle_http_error(self, e) -> dict:
+        try:
+            payload = json.loads(e.read())
+        except Exception:
+            payload = {"error": "Internal", "message": str(e)}
+        exc = _ERRORS.get(payload.get("error"))
+        if exc is not None:
+            raise exc(payload.get("message", ""))
+        raise RuntimeError(
+            f"REST catalog error {e.code}: {payload}") from e
 
     # -- Catalog API ---------------------------------------------------------
 
-    def list_databases(self) -> List[str]:
-        return self._request("GET", "databases")["databases"]
+    def _paged(self, path: str, key: str,
+               max_results: Optional[int] = None,
+               page_token: Optional[str] = None):
+        """One page (reference RESTApi maxResults/pageToken):
+        -> (items, next_page_token)."""
+        from urllib.parse import quote, urlencode
+        q = {}
+        if max_results:
+            q["maxResults"] = str(max_results)
+        if page_token:
+            q["pageToken"] = page_token
+        full = path + ("?" + urlencode(q, quote_via=quote) if q else "")
+        resp = self._request("GET", full)
+        return resp[key], resp.get("nextPageToken")
+
+    def _list_all(self, path: str, key: str,
+                  page_size: Optional[int] = None) -> List[str]:
+        out: List[str] = []
+        token = None
+        while True:
+            items, token = self._paged(path, key, page_size, token)
+            out.extend(items)
+            if not token:
+                return out
+
+    def list_databases(self, page_size: Optional[int] = None
+                       ) -> List[str]:
+        return self._list_all("databases", "databases", page_size)
+
+    def list_databases_paged(self, max_results: Optional[int] = None,
+                             page_token: Optional[str] = None):
+        return self._paged("databases", "databases", max_results,
+                           page_token)
 
     def create_database(self, name: str, ignore_if_exists: bool = False,
                         properties: Optional[Dict[str, str]] = None):
@@ -266,9 +353,16 @@ class RESTCatalogClient(Catalog):
             if not ignore_if_not_exists:
                 raise
 
-    def list_tables(self, database: str) -> List[str]:
-        return self._request("GET",
-                             f"databases/{database}/tables")["tables"]
+    def list_tables(self, database: str,
+                    page_size: Optional[int] = None) -> List[str]:
+        return self._list_all(f"databases/{database}/tables", "tables",
+                              page_size)
+
+    def list_tables_paged(self, database: str,
+                          max_results: Optional[int] = None,
+                          page_token: Optional[str] = None):
+        return self._paged(f"databases/{database}/tables", "tables",
+                           max_results, page_token)
 
     def create_table(self, identifier, schema: Schema,
                      ignore_if_exists: bool = False):

@@ -155,11 +155,7 @@ class MosaicWriter(FormatWriter):
         return len(data)
 
 
-def read_footer(data: bytes) -> Dict:
-    if data[:4] != _MAGIC or data[-4:] != _MAGIC:
-        raise ValueError("not a mosaic file (bad magic)")
-    (flen,) = struct.unpack_from("<I", data, len(data) - 8)
-    raw = data[len(data) - 8 - flen:len(data) - 8]
+def _parse_footer_tail(raw: bytes) -> Dict:
     if raw[:1] == b"Z":
         (raw_len,) = struct.unpack_from("<I", raw, 1)
         body = pa.Codec("zstd").decompress(raw[5:],
@@ -169,6 +165,13 @@ def read_footer(data: bytes) -> Dict:
     else:
         body = raw[1:]
     return json.loads(body)
+
+
+def read_footer(data: bytes) -> Dict:
+    if data[:4] != _MAGIC or data[-4:] != _MAGIC:
+        raise ValueError("not a mosaic file (bad magic)")
+    (flen,) = struct.unpack_from("<I", data, len(data) - 8)
+    return _parse_footer_tail(data[len(data) - 8 - flen:len(data) - 8])
 
 
 def _decode_stat(v):
@@ -208,21 +211,37 @@ class MosaicReader(FormatReader):
     def read_batches(self, file_io: FileIO, path: str,
                      projection: Optional[List[str]] = None,
                      batch_size: int = 1 << 20, predicate=None):
-        data = file_io.read_bytes(path)
-        footer = read_footer(data)
+        # footer first (two small tail reads), then ONE vectored read
+        # of exactly the surviving row groups' needed bucket ranges —
+        # a projection never pays for unprojected columns' bytes
+        # (reference fs/VectoredReadable + mosaic partial IO)
+        size = file_io.get_file_size(path)
+        if size < 12:
+            raise ValueError(f"not a mosaic file (too small): {path}")
+        (tail,) = file_io.read_ranges(path, [(size - 8, 8)])
+        (flen,) = struct.unpack_from("<I", tail, 0)
+        if tail[4:] != _MAGIC or flen > size - 12:
+            raise ValueError(f"not a mosaic file (bad magic): {path}")
+        (raw,) = file_io.read_ranges(path,
+                                     [(size - 8 - flen, flen + 8)])
+        footer = _parse_footer_tail(raw[:flen])
         buckets: List[List[str]] = footer["column_buckets"]
         wanted = list(projection) if projection else \
             [c for b in buckets for c in b]
         need = [i for i, cols in enumerate(buckets)
                 if any(c in wanted for c in cols)]
-        for rg in footer["row_groups"]:
-            if predicate is not None and not self._rg_matches(rg,
-                                                              predicate):
-                continue
+        groups = [rg for rg in footer["row_groups"]
+                  if predicate is None or self._rg_matches(rg,
+                                                           predicate)]
+        ranges = [(rg["buckets"][i]["offset"], rg["buckets"][i]["size"])
+                  for rg in groups for i in need]
+        blobs = file_io.read_ranges(path, ranges) if ranges else []
+        pos = 0
+        for rg in groups:
             parts = []
-            for i in need:
-                bm = rg["buckets"][i]
-                blob = data[bm["offset"]:bm["offset"] + bm["size"]]
+            for _ in need:
+                blob = blobs[pos]
+                pos += 1
                 with pa.ipc.open_stream(pa.BufferReader(blob)) as r:
                     parts.append(r.read_all())
             if not parts:

@@ -13,7 +13,7 @@ import tempfile
 import threading
 import uuid
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["FileIO", "FileStatus", "LocalFileIO", "MemoryFileIO",
            "get_file_io", "register_file_io"]
@@ -48,6 +48,26 @@ class FileIO:
     def read_range(self, path: str, offset: int, length: int) -> bytes:
         data = self.read_bytes(path)
         return data[offset:offset + length]
+
+    def read_ranges(self, path: str,
+                    ranges: List[Tuple[int, int]]) -> List[bytes]:
+        """Vectored read: many (offset, length) ranges in one call
+        (reference fs/VectoredReadable — object stores coalesce these
+        into ranged GETs; the local impl seeks within one open file).
+        Default: one whole-file read, sliced."""
+        data = self.read_bytes(path)
+        return [bytes(data[o:o + ln]) for o, ln in ranges]
+
+    # -- two-phase writes ----------------------------------------------------
+
+    def new_two_phase_stream(self, path: str) -> "TwoPhaseOutputStream":
+        """Write-then-publish stream: bytes go to an invisible staging
+        location; `close_for_commit()` returns a committer whose
+        commit() makes the file visible atomically and whose discard()
+        leaves no trace (reference fs/TwoPhaseOutputStream.java,
+        RenamingTwoPhaseOutputStream) — the building block for
+        multi-file atomic operations."""
+        return _BufferedTwoPhaseStream(self, path)
 
     def read_utf8(self, path: str) -> str:
         return self.read_bytes(path).decode("utf-8")
@@ -110,6 +130,51 @@ class FileIO:
         return False
 
 
+class TwoPhaseOutputStream:
+    """write() bytes, then close_for_commit() -> Committer."""
+
+    def write(self, data: bytes):
+        raise NotImplementedError
+
+    def close_for_commit(self) -> "TwoPhaseCommitter":
+        raise NotImplementedError
+
+
+class TwoPhaseCommitter:
+    def commit(self):
+        raise NotImplementedError
+
+    def discard(self):
+        raise NotImplementedError
+
+
+class _BufferedTwoPhaseStream(TwoPhaseOutputStream):
+    """Generic fallback: buffer in memory, publish via
+    try_to_write_atomic on commit."""
+
+    def __init__(self, file_io: "FileIO", path: str):
+        self._io = file_io
+        self._path = path
+        self._parts: List[bytes] = []
+
+    def write(self, data: bytes):
+        self._parts.append(bytes(data))
+
+    def close_for_commit(self) -> TwoPhaseCommitter:
+        io_, path, blob = self._io, self._path, b"".join(self._parts)
+        self._parts = []
+
+        class C(TwoPhaseCommitter):
+            def commit(self):
+                if not io_.try_to_write_atomic(path, blob):
+                    raise FileExistsError(path)
+
+            def discard(self):
+                pass
+
+        return C()
+
+
 class LocalFileIO(FileIO):
     """Local filesystem (reference fs/local/LocalFileIO.java)."""
 
@@ -127,6 +192,19 @@ class LocalFileIO(FileIO):
         with open(self._strip(path), "rb") as f:
             f.seek(offset)
             return f.read(length)
+
+    def read_ranges(self, path: str,
+                    ranges: List[Tuple[int, int]]) -> List[bytes]:
+        """One open, N seeks — never the whole file."""
+        out = []
+        with open(self._strip(path), "rb") as f:
+            for offset, length in ranges:
+                f.seek(offset)
+                out.append(f.read(length))
+        return out
+
+    def new_two_phase_stream(self, path: str) -> "TwoPhaseOutputStream":
+        return _LocalTwoPhaseStream(self, path)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(self._strip(path))
@@ -201,6 +279,51 @@ class LocalFileIO(FileIO):
             return True
         except OSError:
             return False
+
+
+class _LocalTwoPhaseStream(TwoPhaseOutputStream):
+    """Stage in a hidden sibling file, fsync'd, published by rename
+    (reference fs/RenamingTwoPhaseOutputStream.java)."""
+
+    def __init__(self, file_io: "LocalFileIO", path: str):
+        import uuid as _uuid
+        self._io = file_io
+        self._final = file_io._strip(path)
+        os.makedirs(os.path.dirname(self._final), exist_ok=True)
+        self._tmp = os.path.join(
+            os.path.dirname(self._final),
+            f".{os.path.basename(self._final)}."
+            f"{_uuid.uuid4().hex}.inprogress")
+        self._f = open(self._tmp, "wb")
+
+    def write(self, data: bytes):
+        self._f.write(data)
+
+    def close_for_commit(self) -> TwoPhaseCommitter:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        tmp, final = self._tmp, self._final
+
+        class C(TwoPhaseCommitter):
+            def commit(self):
+                # link(2) fails with EEXIST instead of silently
+                # overwriting like rename(2) — the same CAS primitive
+                # try_to_write_atomic uses
+                try:
+                    os.link(tmp, final)
+                except FileExistsError:
+                    os.remove(tmp)
+                    raise FileExistsError(final)
+                os.remove(tmp)
+
+            def discard(self):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+        return C()
 
 
 class MemoryFileIO(FileIO):

@@ -85,3 +85,40 @@ def test_options():
     assert co.merge_engine == "deduplicate"
     assert parse_memory_size("1g") == 1 << 30
     assert co.num_levels == 6  # trigger(5) + 1
+
+
+def test_vectored_read_ranges(tmp_path):
+    from paimon_tpu.fs import LocalFileIO, MemoryFileIO
+    for fio, path in ((LocalFileIO(), str(tmp_path / "v.bin")),
+                      (MemoryFileIO(), "memory://x/v.bin")):
+        fio.write_bytes(path, bytes(range(100)))
+        out = fio.read_ranges(path, [(0, 5), (95, 5), (10, 1)])
+        assert out == [bytes(range(5)), bytes(range(95, 100)),
+                       bytes([10])]
+
+
+def test_two_phase_stream_commit_and_discard(tmp_path):
+    from paimon_tpu.fs import LocalFileIO, MemoryFileIO
+    for fio, base in ((LocalFileIO(), str(tmp_path / "a")),
+                      (MemoryFileIO(), "memory://y")):
+        path = f"{base}/out.bin"
+        s = fio.new_two_phase_stream(path)
+        s.write(b"hello ")
+        s.write(b"world")
+        committer = s.close_for_commit()
+        assert not fio.exists(path)          # invisible until commit
+        committer.commit()
+        assert fio.read_bytes(path) == b"hello world"
+
+        s2 = fio.new_two_phase_stream(f"{base}/gone.bin")
+        s2.write(b"x")
+        s2.close_for_commit().discard()
+        assert not fio.exists(f"{base}/gone.bin")
+
+        # committing onto an existing file fails (CAS semantics)
+        s3 = fio.new_two_phase_stream(path)
+        s3.write(b"later")
+        import pytest as _pytest
+        with _pytest.raises(FileExistsError):
+            s3.close_for_commit().commit()
+        assert fio.read_bytes(path) == b"hello world"

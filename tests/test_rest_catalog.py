@@ -159,3 +159,51 @@ def test_jdbc_rename_into_missing_database_rejected(tmp_path):
         cat.rename_table("db.t", "nope.u")
     assert cat.list_tables("db") == ["t"]
     cat.close()
+
+
+def test_pagination_and_token_file(tmp_path):
+    """maxResults/pageToken paging (reference RESTApi.MAX_RESULTS /
+    PAGE_TOKEN) and rotating bearer-token files."""
+    from paimon_tpu.catalog import create_catalog
+    from paimon_tpu.catalog.rest import RESTCatalogClient, RESTCatalogServer
+
+    inner = create_catalog({"warehouse": str(tmp_path / "wh")})
+    for i in range(7):
+        inner.create_database(f"db{i}")
+    token_file = tmp_path / "token"
+    token_file.write_text("secret-1\n")
+    server = RESTCatalogServer(inner, token="secret-1")
+    server.start()
+    try:
+        client = RESTCatalogClient(server.uri,
+                                   token_file=str(token_file))
+        # raw page walk
+        page1, tok = client.list_databases_paged(max_results=3)
+        assert len(page1) == 3 and tok == page1[-1]
+        page2, tok2 = client.list_databases_paged(max_results=3,
+                                                  page_token=tok)
+        assert len(page2) == 3 and page2[0] > page1[-1]
+        # auto-paged listing sees everything exactly once
+        names = client.list_databases(page_size=2)
+        assert sorted(n for n in names if n.startswith("db")) == \
+            [f"db{i}" for i in range(7)]
+
+        # token rotation: server now requires a new secret
+        server.token = "secret-2"
+        token_file.write_text("secret-2\n")
+        assert "db0" in client.list_databases()
+
+        # tables paging
+        from paimon_tpu.schema import Schema
+        from paimon_tpu.types import IntType
+        for i in range(5):
+            inner.create_table(
+                f"db0.t{i}",
+                Schema.builder().column("a", IntType())
+                .options({"bucket": "-1"}).build())
+        ts, tok = client.list_tables_paged("db0", max_results=2)
+        assert ts == ["t0", "t1"] and tok == "t1"
+        assert client.list_tables("db0", page_size=2) == \
+            [f"t{i}" for i in range(5)]
+    finally:
+        server.stop()
