@@ -115,6 +115,28 @@ def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int):
     return fn
 
 
+def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
+                         num_key_lanes: int
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CPU-backend fallback with EXACTLY the kernel's semantics: when no
+    accelerator is attached, np.lexsort beats a single-threaded XLA
+    host sort ~2x and skips the device round-trip + power-of-two
+    padding entirely.  Accelerator runs never take this path."""
+    n, num_lanes = lanes.shape
+    useq = seq.astype(np.int64).view(np.uint64)
+    keys = ((useq & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            (useq >> np.uint64(32)).astype(np.uint32),
+            *(lanes[:, i] for i in range(num_lanes - 1, -1, -1)))
+    perm = np.lexsort(keys).astype(np.int32)
+    s_lanes = lanes[:, :num_key_lanes][perm]
+    eq_next = np.all(s_lanes[:-1] == s_lanes[1:], axis=1)
+    eq_next = np.concatenate([eq_next, [False]])
+    eq_prev = np.concatenate([[False], eq_next[:-1]])
+    winner = ~eq_next if keep == "last" else ~eq_prev
+    prev = np.where(eq_prev, np.roll(perm, 1), -1)
+    return perm, winner, prev
+
+
 def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                           keep: str = "last",
                           order_lanes: Optional[np.ndarray] = None
@@ -124,10 +146,20 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     lanes: uint32[N, L] (segment identity); seq: int64[N] (non-negative);
     order_lanes: optional uint32[N, O] user-defined sequence lanes that
     rank within a key BEFORE the internal sequence.
-    Returns (perm, winner_mask, prev_in_segment) as numpy arrays of the
-    padded size; caller slices by validity via winner mask.
+    Returns (perm, winner_mask, prev_in_segment) as numpy arrays — of
+    the power-of-two padded size on an accelerator backend, UNPADDED
+    (length N, all rows valid) on the cpu backend's lexsort fallback.
+    Callers must select via the winner mask / `perm < n`, never assume
+    a padded length.  Set PAIMON_FORCE_DEVICE_SORT=1 to exercise the
+    kernel path on cpu (tests of the padding/validity logic).
     """
+    import os as _os
     n, num_key_lanes = lanes.shape
+    if jax.default_backend() == "cpu" and n > 0 and \
+            _os.environ.get("PAIMON_FORCE_DEVICE_SORT") != "1":
+        full = lanes if order_lanes is None or order_lanes.shape[1] == 0 \
+            else np.concatenate([lanes, order_lanes], axis=1)
+        return _host_sorted_winners(full, seq, keep, num_key_lanes)
     if order_lanes is not None and order_lanes.shape[1] > 0:
         lanes = np.concatenate([lanes, order_lanes], axis=1)
     num_lanes = lanes.shape[1]
