@@ -176,6 +176,20 @@ class _BucketWriter:
         return None if msg.is_empty() else msg
 
 
+def extract_row_kinds(table: pa.Table,
+                      row_kinds: Optional[np.ndarray]
+                      ) -> Tuple[pa.Table, np.ndarray]:
+    """Honor an inline `_ROW_KIND` column or an explicit kinds array;
+    defaults to all-INSERT."""
+    if ROW_KIND_COL in table.column_names:
+        row_kinds = np.asarray(table.column(ROW_KIND_COL)
+                               .combine_chunks().cast(pa.int8()))
+        table = table.drop_columns([ROW_KIND_COL])
+    if row_kinds is None:
+        row_kinds = np.zeros(table.num_rows, dtype=np.int8)
+    return table, np.asarray(row_kinds, dtype=np.int8)
+
+
 class LocalMerger:
     """Pre-shuffle hot-key dedup (reference mergetree/localmerge/
     HashMapLocalMerger.java): rows buffer BEFORE bucket routing; when
@@ -329,23 +343,21 @@ class KeyValueFileStoreWrite:
     # -- writes --------------------------------------------------------------
 
     def write_arrow(self, table: pa.Table,
-                    row_kinds: Optional[np.ndarray] = None):
+                    row_kinds: Optional[np.ndarray] = None,
+                    buckets: Optional[np.ndarray] = None):
         """Write a batch of rows (full table schema). Optional `row_kinds`
-        int8[N] (RowKind codes); a `_ROW_KIND` column is also honored."""
-        if ROW_KIND_COL in table.column_names:
-            row_kinds = np.asarray(table.column(ROW_KIND_COL)
-                                   .combine_chunks().cast(pa.int8()))
-            table = table.drop_columns([ROW_KIND_COL])
-        if row_kinds is None:
-            row_kinds = np.zeros(table.num_rows, dtype=np.int8)
-        row_kinds = np.asarray(row_kinds, dtype=np.int8)
+        int8[N] (RowKind codes); a `_ROW_KIND` column is also honored.
+        `buckets` skips re-hashing when the caller already assigned
+        them (the multi-writer topology's shuffle)."""
+        table, row_kinds = extract_row_kinds(table, row_kinds)
 
         if self._local_merger is not None and not self._postpone:
             self._local_merger.add(table, row_kinds)
             return
-        self._dispatch(table, row_kinds)
+        self._dispatch(table, row_kinds, buckets)
 
-    def _dispatch(self, table: pa.Table, row_kinds: np.ndarray):
+    def _dispatch(self, table: pa.Table, row_kinds: np.ndarray,
+                  precomputed_buckets: Optional[np.ndarray] = None):
         if self._postpone:
             buckets = np.full(table.num_rows, -2, dtype=np.int32)
             for (part, bucket), idx in group_by_partition_bucket(
@@ -368,7 +380,8 @@ class KeyValueFileStoreWrite:
                     self._writer(part, bucket).write(
                         sub.take(pa.array(idx2)), sub_kinds[idx2])
             return
-        buckets = self.bucket_assigner.assign(table)
+        buckets = precomputed_buckets if precomputed_buckets is not None \
+            else self.bucket_assigner.assign(table)
         for (part, bucket), idx in group_by_partition_bucket(
                 table, buckets, self.partition_keys):
             sub = table.take(pa.array(idx))

@@ -371,8 +371,12 @@ class TableWrite:
                 restore_max_seq=restore)
 
     def write_arrow(self, data: pa.Table,
-                    row_kinds: Optional[np.ndarray] = None):
-        self._write.write_arrow(data, row_kinds)
+                    row_kinds: Optional[np.ndarray] = None,
+                    buckets=None):
+        if buckets is not None:
+            self._write.write_arrow(data, row_kinds, buckets=buckets)
+        else:
+            self._write.write_arrow(data, row_kinds)
 
     def write_pandas(self, df):
         self.write_arrow(pa.Table.from_pandas(df, preserve_index=False))

@@ -48,6 +48,16 @@ def test_host_matches_device_kernel(keep, seed):
     # winner per segment must be identical, not just same count
     assert set(h.tolist()) == set(d.tolist())
 
+    # prev_in_segment feeds changelog derivation: winner -> predecessor
+    # maps must agree too
+    def prev_map(perm, winner, prev):
+        perm, winner, prev = (np.asarray(perm), np.asarray(winner, bool),
+                              np.asarray(prev))
+        pos = np.flatnonzero(winner & (perm < n))
+        return {int(perm[i]): int(prev[i]) for i in pos}
+
+    assert prev_map(hp, hw, hprev) == prev_map(dp, dw, dprev)
+
 
 def test_order_lanes_agree():
     rng = np.random.default_rng(3)
