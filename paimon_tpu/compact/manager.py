@@ -46,6 +46,58 @@ class CompactResult:
         return not self.before and not self.after and not self.changelog
 
 
+def _prefetch(it, depth: int = 2):
+    """Run a chunk iterator in a background thread with a small bounded
+    queue so file decode overlaps the merge kernel (decode releases the
+    GIL). One thread per sorted run of a streamed rewrite.  The pump
+    polls a cancel flag on every bounded put, so a consumer that
+    abandons the generator early (merge error elsewhere) releases the
+    thread and its pinned chunks instead of leaking them."""
+    import queue as _queue
+    import threading as _threading
+
+    q: "_queue.Queue" = _queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+    cancelled = _threading.Event()
+
+    def pump():
+        try:
+            for item in it:
+                while not cancelled.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if cancelled.is_set():
+                    return
+            q.put(_SENTINEL)
+        except BaseException as e:       # noqa: BLE001
+            if not cancelled.is_set():
+                q.put(("__prefetch_error__", e))
+
+    _threading.Thread(target=pump, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[0] == "__prefetch_error__":
+                raise item[1]
+            yield item
+    finally:
+        cancelled.set()
+
+
+def _get_busy_timer():
+    from paimon_tpu.metrics import CompactTimer
+    return CompactTimer()
+
+
+_BUSY_TIMER = _get_busy_timer()
+
+
 class MergeTreeCompactManager:
     def __init__(self, file_io: FileIO, table_path: str,
                  schema: TableSchema, options: CoreOptions,
@@ -108,6 +160,29 @@ class MergeTreeCompactManager:
 
     def do_compact(self, unit: CompactUnit) -> CompactResult:
         """reference MergeTreeCompactTask.doCompact:83."""
+        from paimon_tpu.metrics import global_registry
+        import time as _time
+
+        group = global_registry().group("compaction")
+        # managers are constructed per compaction task, so the busy
+        # window lives at module scope — a per-instance timer would
+        # leave the gauge bound to the first (dead) task's timer
+        timer = _BUSY_TIMER
+        group.gauge("busy_ratio_1m", timer.busy_ratio)
+        timer.start()
+        t0 = _time.perf_counter()
+        try:
+            result = self._do_compact(unit)
+        finally:
+            timer.stop()
+            group.histogram("duration_ms").update(
+                (_time.perf_counter() - t0) * 1000)
+            group.counter("tasks").inc()
+        group.counter("input_files").inc(len(unit.files))
+        group.counter("output_files").inc(len(result.after))
+        return result
+
+    def _do_compact(self, unit: CompactUnit) -> CompactResult:
         from paimon_tpu.options import ChangelogProducer
 
         files = unit.files
@@ -227,7 +302,7 @@ class MergeTreeCompactManager:
             if acc_bytes >= self.kv_writer.target_file_size:
                 flush()
 
-        merge_runs_streamed([run_iter(rf) for rf in runs_meta],
+        merge_runs_streamed([_prefetch(run_iter(rf)) for rf in runs_meta],
                             self.key_cols, self.key_encoder, emit,
                             merge_window)
         flush()
@@ -255,24 +330,41 @@ class MergeTreeCompactManager:
             cl = keyed_changelog_diff(before, live, self.key_cols,
                                       self.key_encoder, value_cols)
         elif producer == ChangelogProducer.LOOKUP:
-            # diff the pre-existing state of levels >0 vs the visible
-            # state, restricted to keys the incoming L0 records touched
-            # (reference LookupChangelogMergeFunctionWrapper.java:54;
-            # LookupLevels.lookup becomes a bulk columnar load + joint
-            # key ranking instead of per-key point reads)
-            l0 = [f for f in unit.files if f.level == 0]
+            # the reference's lookup producer changelogs EVERY commit
+            # (LookupChangelogMergeFunctionWrapper.java:54); batched at
+            # compaction time, completeness demands replaying the L0
+            # deltas in commit order against an evolving state — one
+            # aggregate before/after diff would silently swallow a key
+            # that was inserted AND deleted between two compactions
+            # (its +I was visible to any from-snapshot-full consumer)
+            l0 = sorted((f for f in unit.files if f.level == 0),
+                        key=lambda f: (f.max_sequence_number,
+                                       f.min_sequence_number))
             if l0:
                 all_files = self.levels.all_files()
-                before = self._merged_state(
+                self._read_runs(l0, flatten=True)   # warm via the pool
+                state = self._merged_state(
                     [f for f in all_files if f.level > 0])
-                after_state = self._merged_state(all_files)
-                restrict = pa.concat_tables(
-                    self._read_runs(l0, flatten=True),
-                    promote_options="none")
-                cl = keyed_changelog_diff(before, after_state,
-                                          self.key_cols, self.key_encoder,
-                                          value_cols,
-                                          restrict_table=restrict)
+                pieces = []
+                for f in l0:
+                    delta = self._read_runs([f], flatten=True)[0]
+                    runs = ([state] if state is not None and
+                            state.num_rows else []) + [delta]
+                    # ENGINE-AWARE replay: the evolving state must merge
+                    # exactly like the table (partial-update/aggregation
+                    # fold, not last-write-wins)
+                    new_state = self._merge_tables(runs,
+                                                   drop_deletes=True)
+                    piece = keyed_changelog_diff(
+                        state, new_state, self.key_cols,
+                        self.key_encoder, value_cols,
+                        restrict_table=delta)
+                    if piece is not None and piece.num_rows:
+                        pieces.append(piece)
+                    state = new_state
+                if pieces:
+                    cl = pa.concat_tables(pieces,
+                                          promote_options="none")
         if cl is None or cl.num_rows == 0:
             return []
         return write_changelog_file(
@@ -303,6 +395,17 @@ class MergeTreeCompactManager:
     def _read_runs(self, files: List[DataFileMeta],
                    flatten: bool = False) -> List[pa.Table]:
         runs_meta = assemble_runs(files)
+        # parquet/orc decode releases the GIL: fan the file reads over a
+        # small thread pool (reference compaction reads files with
+        # per-task IO threads; here one pool per whole-bucket rewrite)
+        flat = [f for rf in runs_meta for f in rf]
+        uncached = [f for f in flat
+                    if f.file_name not in self._file_cache]
+        if len(uncached) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(uncached))) as pool:
+                list(pool.map(self._read_file, uncached))
         runs = []
         for run_files in runs_meta:
             tables = [self._read_file(f) for f in run_files]

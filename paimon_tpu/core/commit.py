@@ -190,10 +190,17 @@ class FileStoreCommit:
                     entries_fn=None,
                     expected_latest_id: Optional[int] = ...,
                     statistics: Optional[str] = None) -> int:
+        from paimon_tpu.metrics import global_registry
+        import time as _time
+
+        _metrics = global_registry().group("commit")
+        _t0 = _time.perf_counter()
+        _attempts = 0
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         entries_orig = list(entries)
         while True:
+            _attempts += 1
             latest = self.snapshot_manager.latest_snapshot()
             if expected_latest_id is not ... and \
                     (latest.id if latest else None) != expected_latest_id:
@@ -291,6 +298,11 @@ class FileStoreCommit:
                 next_row_id=next_row_id,
             )
             if self.snapshot_manager.try_commit(snapshot):
+                _metrics.counter("commits").inc()
+                if _attempts > 1:
+                    _metrics.counter("retries").inc(_attempts - 1)
+                _metrics.histogram("duration_ms").update(
+                    (_time.perf_counter() - _t0) * 1000)
                 return new_id
             # lost the race: clean up everything written for this attempt
             # and retry against the new latest (the delta manifest is

@@ -117,15 +117,23 @@ class FileStoreScan:
 
     def plan(self, snapshot: Optional[Snapshot] = None,
              streaming: bool = False) -> ScanPlan:
+        from paimon_tpu.metrics import global_registry
+        import time as _time
+
+        t0 = _time.perf_counter()
         if snapshot is None:
             snapshot = self.snapshot_manager.latest_snapshot()
         if snapshot is None:
             return ScanPlan(None, [], streaming=streaming)
         entries = self.read_entries(snapshot)
-        return ScanPlan(snapshot.id, self.generate_splits(
+        plan = ScanPlan(snapshot.id, self.generate_splits(
             snapshot.id, entries, for_streaming=streaming,
             snapshot=snapshot),
             streaming=streaming)
+        g = global_registry().group("scan")
+        g.histogram("plan_ms").update((_time.perf_counter() - t0) * 1000)
+        g.counter("plans").inc()
+        return plan
 
     def plan_delta(self, snapshot: Snapshot,
                    streaming: bool = False) -> ScanPlan:

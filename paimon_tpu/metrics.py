@@ -154,3 +154,63 @@ _GLOBAL = MetricRegistry()
 
 def global_registry() -> MetricRegistry:
     return _GLOBAL
+
+
+class CompactTimer:
+    """Sliding-window busy-time tracker: how many milliseconds of the
+    last `window_ms` were spent compacting (reference
+    compact/CompactTimer.java — O(1) amortized interval bookkeeping;
+    the numbers feed write-stall decisions and busy gauges)."""
+
+    def __init__(self, window_ms: int = 60_000, clock=None):
+        import threading as _threading
+        import time as _time
+        self.window_ms = window_ms
+        self._clock = clock or (lambda: int(_time.time() * 1000))
+        self._intervals: list = []      # [start, end or None]
+        self._depth = 0                 # overlapping tasks share one
+        self._lock = _threading.Lock()  # interval (thread-safe like
+                                        # the reference @ThreadSafe)
+
+    @property
+    def _active(self) -> bool:
+        return self._depth > 0
+
+    def start(self, now: Optional[int] = None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._trim(now)
+            if self._depth == 0:
+                self._intervals.append([now, None])
+            self._depth += 1
+
+    def stop(self, now: Optional[int] = None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self._depth > 0:
+                self._depth -= 1
+                if self._depth == 0:
+                    self._intervals[-1][1] = now
+
+    def _trim(self, now: int):
+        horizon = now - self.window_ms
+        self._intervals = [
+            iv for iv in self._intervals
+            if iv[1] is None or iv[1] > horizon]
+
+    def busy_millis(self, now: Optional[int] = None) -> int:
+        """Compaction-busy milliseconds within the trailing window."""
+        now = self._clock() if now is None else now
+        horizon = now - self.window_ms
+        with self._lock:
+            self._trim(now)
+            total = 0
+            for start, end in self._intervals:
+                e = now if end is None else min(end, now)
+                s = max(start, horizon)
+                if e > s:
+                    total += e - s
+            return total
+
+    def busy_ratio(self, now: Optional[int] = None) -> float:
+        return self.busy_millis(now) / self.window_ms

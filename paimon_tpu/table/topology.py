@@ -52,7 +52,10 @@ class _Worker:
                                            buckets=buckets)
                 elif kind == "prepare":
                     _, out, done = item
-                    out.extend(self.write.prepare_commit())
+                    if self.error is None:
+                        # a failed worker never drains its staging:
+                        # the topology is fail-stop (see checkpoint)
+                        out.extend(self.write.prepare_commit())
                     done.set()
             except BaseException as e:     # noqa: BLE001
                 self.error = e
@@ -114,9 +117,6 @@ class StreamIngestTopology:
         else:
             self._assigner = None
         self._rr = 0
-        # committables whose checkpoint failed mid-gather: preserved so
-        # a retry cannot silently commit without them
-        self._pending: List = []
 
     # -- the shuffle (reference ChannelComputer) -----------------------------
 
@@ -162,18 +162,15 @@ class StreamIngestTopology:
         exactly once under `commit_identifier` (a replayed identifier
         is a no-op, like the reference's filter on recovery).
 
-        If any worker fails mid-gather, already-prepared committables
-        (whose writers have cleared their staging lists) survive in
-        `_pending` and ride the next successful checkpoint instead of
-        being lost."""
-        msgs: List = list(self._pending)
-        self._pending = []
-        try:
-            for w in self._workers:
-                msgs.extend(w.prepare())
-        except BaseException:
-            self._pending = msgs
-            raise
+        FAIL-STOP like the reference job model: if any worker failed,
+        checkpoint raises, NOTHING from this checkpoint commits, and
+        recovery is a NEW topology replaying every batch since the last
+        committed identifier — the exactly-once filter makes the replay
+        safe and the abandoned staged files become orphans for
+        remove_orphan_files."""
+        msgs: List = []
+        for w in self._workers:
+            msgs.extend(w.prepare())
         commit = self._builder.new_commit()
         if not commit.filter_committed([commit_identifier]):
             # replayed checkpoint: its rewritten files are duplicates of

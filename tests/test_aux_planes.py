@@ -176,3 +176,48 @@ def test_expire_cleans_stats_files(tmp_warehouse):
         os.path.join(table.path, "statistics", old_stats))
     # the surviving ANALYZE snapshot's stats remain readable
     assert table.statistics() is not None
+
+
+def test_compact_timer_window():
+    """reference compact/CompactTimer.java busy-window semantics."""
+    from paimon_tpu.metrics import CompactTimer
+    now = [100_000]
+    t = CompactTimer(window_ms=1000, clock=lambda: now[0])
+    t.start()
+    now[0] += 300
+    t.stop()
+    assert t.busy_millis() == 300
+    now[0] += 500
+    assert t.busy_millis() == 300
+    now[0] += 600                       # interval slides out of window
+    assert t.busy_millis() < 300
+    t.start()
+    now[0] += 200
+    assert t.busy_millis() >= 200       # unfinished interval counts
+    t.stop()
+
+
+def test_metrics_wired_into_commit_scan_compact(tmp_path):
+    from paimon_tpu.metrics import global_registry
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType
+
+    schema = (Schema.builder().column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"}).build())
+    t = FileStoreTable.create(str(tmp_path / "m"), schema)
+    before = global_registry().group("commit").counter("commits").count
+    for i in range(2):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": i}])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+    t.compact(full=True)
+    t.to_arrow()
+    reg = global_registry()
+    assert reg.group("commit").counter("commits").count >= before + 2
+    assert reg.group("compaction").counter("tasks").count >= 1
+    assert reg.group("scan").counter("plans").count >= 1
+    assert reg.group("compaction").histogram("duration_ms").count >= 1
