@@ -65,15 +65,28 @@ class _ParquetReader(FormatReader):
             yield pa.Table.from_batches([rb])
 
 
+def split_compression(spec: str):
+    """'zstd' or 'zstd:7' -> (codec, level or None)
+    (file.compression.zstd-level wiring)."""
+    if spec and ":" in spec:
+        codec, _, lvl = spec.partition(":")
+        try:
+            return codec, int(lvl)
+        except ValueError:
+            return codec, None
+    return spec, None
+
+
 class _ParquetWriter(FormatWriter):
     def __init__(self, compression: str = "zstd",
                  row_group_rows: int = 1 << 20):
-        self.compression = compression
+        self.compression, self.level = split_compression(compression)
         self.row_group_rows = row_group_rows
 
     def write(self, file_io, path, table):
         buf = io.BytesIO()
         pq.write_table(table, buf, compression=self.compression,
+                       compression_level=self.level,
                        row_group_size=self.row_group_rows,
                        use_dictionary=True, write_statistics=True)
         data = buf.getvalue()
@@ -92,7 +105,7 @@ class _OrcReader(FormatReader):
 
 class _OrcWriter(FormatWriter):
     def __init__(self, compression: str = "zstd"):
-        self.compression = compression
+        self.compression, _ = split_compression(compression)
 
     def write(self, file_io, path, table):
         if pa_orc is None:
@@ -117,6 +130,7 @@ class _AvroRowReader(FormatReader):
 
 class _AvroRowWriter(FormatWriter):
     def __init__(self, compression: str = "zstd"):
+        compression, _ = split_compression(compression)
         self.codec = {"zstd": "zstandard", "none": "null",
                       "gzip": "deflate"}.get(compression, compression)
 

@@ -72,8 +72,20 @@ class MosaicWriter(FormatWriter):
                  row_group_rows: int = DEFAULT_ROW_GROUP_ROWS,
                  num_buckets: Optional[int] = None,
                  stats_columns: Optional[Sequence[str]] = None):
-        self.compression = None if compression in ("none", None) \
-            else compression
+        from paimon_tpu.format.format import split_compression
+        codec, level = split_compression(compression or "none")
+        if codec in ("none", None):
+            self.compression = None
+        elif level is not None:
+            try:
+                self.compression = pa.Codec(codec,
+                                            compression_level=level)
+            except (pa.ArrowInvalid, TypeError, ValueError):
+                # codec has no level knob: keep the codec, drop the
+                # level (same fallback posture as _ipc_bytes)
+                self.compression = codec
+        else:
+            self.compression = codec
         self.row_group_rows = row_group_rows
         self.num_buckets = num_buckets      # None -> one bucket per column
         self.stats_columns = list(stats_columns) if stats_columns \

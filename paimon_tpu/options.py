@@ -174,6 +174,10 @@ class CoreOptions:
     BUCKET_KEY = ConfigOption("bucket-key", str, None, "Comma-separated bucket key")
     PATH = ConfigOption("path", str, None, "Table path")
     FILE_FORMAT = ConfigOption("file.format", str, "parquet", "Data file format")
+    FILE_COMPRESSION_ZSTD_LEVEL = ConfigOption(
+        "file.compression.zstd-level", int, None,
+        "zstd level for data files (reference CoreOptions"
+        ".FILE_COMPRESSION_ZSTD_LEVEL); None = codec default")
     FILE_COMPRESSION = ConfigOption("file.compression", str, "zstd",
                                     "Data file compression")
     MANIFEST_FORMAT = ConfigOption("manifest.format", str, "avro",
@@ -341,7 +345,12 @@ class CoreOptions:
 
     @property
     def file_compression(self) -> str:
-        return self.options.get(CoreOptions.FILE_COMPRESSION)
+        codec = self.options.get(CoreOptions.FILE_COMPRESSION)
+        level = self.options.get(CoreOptions.FILE_COMPRESSION_ZSTD_LEVEL)
+        if level is not None and codec == "zstd":
+            # "codec:level" spec understood by the format writers
+            return f"zstd:{level}"
+        return codec
 
     @property
     def merge_engine(self) -> str:

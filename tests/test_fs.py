@@ -122,3 +122,32 @@ def test_two_phase_stream_commit_and_discard(tmp_path):
         with _pytest.raises(FileExistsError):
             s3.close_for_commit().commit()
         assert fio.read_bytes(path) == b"hello world"
+
+
+def test_zstd_level_option_changes_output(tmp_path):
+    """file.compression.zstd-level wires through to the format writers
+    (reference CoreOptions.FILE_COMPRESSION_ZSTD_LEVEL)."""
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType, VarCharType
+
+    sizes = {}
+    for lvl in ("1", "19"):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("s", VarCharType.string_type())
+                  .options({"bucket": "-1",
+                            "file.compression.zstd-level": lvl})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / f"t{lvl}"), schema)
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": i, "s": f"value-{i % 50}" * 8}
+                       for i in range(20000)])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        files = [f for s in t.new_read_builder().new_scan().plan().splits
+                 for f in s.data_files]
+        sizes[lvl] = sum(f.file_size for f in files)
+        assert t.to_arrow().num_rows == 20000
+    assert sizes["19"] < sizes["1"]
