@@ -123,7 +123,8 @@ class StoreOracle:
     def __init__(self, path: str, seed: int, engine: str = "deduplicate",
                  changelog_producer: str = "none", bucket: str = "2",
                  partitioned: bool = True, key_space: int = 40,
-                 allow_expire: bool = True, allow_schema_add: bool = True):
+                 allow_expire: bool = True, allow_schema_add: bool = True,
+                 allow_rollback: bool = False):
         self.rng = random.Random(seed)
         self.engine = engine
         self.producer = changelog_producer
@@ -133,6 +134,10 @@ class StoreOracle:
         # needs the full stream, so expiry only runs without a producer
         self.allow_expire = allow_expire and changelog_producer == "none"
         self.allow_schema_add = allow_schema_add
+        # rollback truncates changelog history, so the replay check
+        # only composes with producer=none
+        self.allow_rollback = allow_rollback and \
+            changelog_producer == "none"
         self.model = OracleModel(engine)
         self.snapshots: Dict[int, List[Dict]] = {}   # sid -> expected rows
         self.expired: set = set()
@@ -212,6 +217,23 @@ class StoreOracle:
                 if sid <= latest.id - retain:
                     self.expired.add(sid)
         return f"expire(retain={retain})"
+
+    def step_rollback(self):
+        """Roll back to a random earlier retained snapshot; the model
+        rewinds to its recorded state and later history is forgotten
+        (reference RollbackHelper)."""
+        live = sorted(s for s in self.snapshots if s not in self.expired)
+        if len(live) < 2:
+            return self.step_write()
+        target = self.rng.choice(live[:-1])
+        self.table.rollback_to(target)
+        self.model.state = {
+            (r["pt"], r["id"]): {f: r.get(f) for f in self.model.fields}
+            for r in self.snapshots[target]}
+        for sid in list(self.snapshots):
+            if sid > target:
+                del self.snapshots[sid]
+        return f"rollback({target})"
 
     def step_schema_add(self):
         sm = SchemaManager(self.table.file_io, self.table.path)
@@ -302,6 +324,8 @@ class StoreOracle:
                 ctx = self.step_write()
             elif r < 0.85:
                 ctx = self.step_compact()
+            elif r < 0.92 and self.allow_rollback:
+                ctx = self.step_rollback()
             elif self.allow_expire:
                 ctx = self.step_expire()
             else:

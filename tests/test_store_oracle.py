@@ -54,6 +54,14 @@ def test_oracle_dynamic_bucket(tmp_path, seed):
     oracle.run(steps=12)
 
 
+@pytest.mark.parametrize("seed", [3, 19, 57])
+def test_oracle_with_rollbacks(tmp_path, seed):
+    oracle = StoreOracle(str(tmp_path / "t"), seed=seed,
+                         engine="deduplicate", allow_rollback=True,
+                         allow_expire=False)
+    oracle.run(steps=25)
+
+
 @pytest.mark.parametrize("seed", [5])
 def test_oracle_single_bucket_unpartitioned(tmp_path, seed):
     oracle = StoreOracle(str(tmp_path / "t"), seed=seed,
