@@ -87,11 +87,31 @@ class DataTableStreamScan:
         latest = sm.latest_snapshot_id()
 
         if mode in (StartupMode.LATEST_FULL, StartupMode.FULL):
-            if latest is None:
+            fallback = self.options.get(CoreOptions.SCAN_FALLBACK_BRANCH)
+            use_fallback = fallback and fallback != self.table.branch
+            if latest is None and not use_fallback:
                 return None
             self._first = False
-            self._next = latest + 1
-            return self._scan.plan(sm.snapshot(latest), streaming=True)
+            self._next = (latest or 0) + 1
+            plan = self._scan.plan(sm.snapshot(latest), streaming=True) \
+                if latest is not None else ScanPlan(None, [],
+                                                    streaming=True)
+            if use_fallback:
+                # chain-table streaming (reference
+                # ChainTableFileStoreTable.newStreamScan + ChainTable
+                # StreamScan): the initial FULL result unions missing
+                # partitions from the fallback chain (honoring this
+                # scan's filters), then follow-up stays delta-only on
+                # this branch
+                from paimon_tpu.table.table import (
+                    with_fallback_partitions,
+                )
+                b = self.builder
+                plan = with_fallback_partitions(
+                    self.table, plan, fallback,
+                    partition_filter=b._partition_filter,
+                    predicate=b._predicate, buckets=b._buckets)
+            return plan
 
         if mode == StartupMode.LATEST:
             # only changes from now on (reference

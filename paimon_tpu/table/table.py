@@ -478,6 +478,36 @@ class ReadBuilder:
         return rt
 
 
+def with_fallback_partitions(table, plan: ScanPlan,
+                             fallback_branch: str,
+                             partition_filter=None, predicate=None,
+                             buckets=None) -> ScanPlan:
+    """Partition-level branch fallback: partitions with no data in the
+    current branch read from `scan.fallback-branch` instead (reference
+    table/FallbackReadFileStoreTable.java — e.g. a streaming branch
+    backfilled by a batch branch).  Shared by batch scans and the
+    chain-table streaming initial full load."""
+    fb = FileStoreTable.load(
+        table.path, table.file_io,
+        dynamic_options={"branch": fallback_branch,
+                         "scan.fallback-branch": ""})
+    rb = fb.new_read_builder()
+    if partition_filter:
+        rb = rb.with_partition_filter(partition_filter)
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    if buckets:
+        rb = rb.with_buckets(buckets)
+    fb_plan = rb.new_scan().plan()
+    have = {tuple(s.partition) for s in plan.splits}
+    from dataclasses import replace as _dc_replace
+    extra = [_dc_replace(s, for_streaming=plan.streaming)
+             for s in fb_plan.splits
+             if tuple(s.partition) not in have]
+    return ScanPlan(plan.snapshot_id, list(plan.splits) + extra,
+                    streaming=plan.streaming)
+
+
 class TableScan:
     def __init__(self, builder: ReadBuilder):
         self.builder = builder
@@ -524,28 +554,11 @@ class TableScan:
 
     def _with_fallback_partitions(self, plan: ScanPlan,
                                   fallback_branch: str) -> ScanPlan:
-        """Partition-level branch fallback: partitions with no data in
-        the current branch read from `scan.fallback-branch` instead
-        (reference table/FallbackReadFileStoreTable.java — e.g. a
-        streaming branch backfilled by a batch branch)."""
-        table = self.builder.table
-        fb = FileStoreTable.load(
-            table.path, table.file_io,
-            dynamic_options={"branch": fallback_branch,
-                             "scan.fallback-branch": ""})
-        rb = fb.new_read_builder()
-        if self.builder._partition_filter:
-            rb = rb.with_partition_filter(self.builder._partition_filter)
-        if self.builder._predicate is not None:
-            rb = rb.with_filter(self.builder._predicate)
-        if self.builder._buckets:
-            rb = rb.with_buckets(self.builder._buckets)
-        fb_plan = rb.new_scan().plan()
-        have = {tuple(s.partition) for s in plan.splits}
-        extra = [s for s in fb_plan.splits
-                 if tuple(s.partition) not in have]
-        return ScanPlan(plan.snapshot_id, list(plan.splits) + extra,
-                        streaming=plan.streaming)
+        return with_fallback_partitions(
+            self.builder.table, plan, fallback_branch,
+            partition_filter=self.builder._partition_filter,
+            predicate=self.builder._predicate,
+            buckets=self.builder._buckets)
 
     def _plan_incremental(self, between: str) -> ScanPlan:
         """Batch incremental read of the deltas in (start, end]

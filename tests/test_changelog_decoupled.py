@@ -197,6 +197,86 @@ class TestFallbackBranch:
         assert all(r["id"] != 9 for r in rows if r["pt"] == 1)
 
 
+class TestChainStreaming:
+    def test_latest_full_stream_unions_fallback_then_delta_only(
+            self, tmp_path):
+        """Chain-table streaming (reference ChainTableFileStoreTable):
+        the initial full result includes fallback-branch partitions;
+        follow-up reads deltas of the primary branch only."""
+        schema = (Schema.builder()
+                  .column("pt", IntType(False))
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .partition_keys("pt")
+                  .primary_key("pt", "id")
+                  .options({"bucket": "1", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        commit(t, [{"pt": 0, "id": 1, "v": 0.0}])
+        t.create_tag("base")
+        t.create_branch("hist", "base")
+        hist = FileStoreTable.load(t.path,
+                                   dynamic_options={"branch": "hist"})
+        commit(hist, [{"pt": 9, "id": 1, "v": 9.0}])   # backfill part
+
+        chained = t.copy({"scan.fallback-branch": "hist"})
+        scan = chained.new_read_builder().new_stream_scan()
+        read = chained.new_read_builder().new_read()
+        first = read.to_arrow(scan.plan())
+        assert {r["pt"] for r in first.to_pylist()} == {0, 9}
+
+        # new delta on the primary branch streams through; fallback
+        # partitions do NOT re-emit
+        commit(t, [{"pt": 0, "id": 2, "v": 0.2}])
+        nxt = read.to_arrow(scan.plan())
+        assert [r["id"] for r in nxt.to_pylist()] == [2]
+
+    def test_stream_filters_apply_to_fallback(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("pt", IntType(False))
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .partition_keys("pt")
+                  .primary_key("pt", "id")
+                  .options({"bucket": "1", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        commit(t, [{"pt": 0, "id": 1, "v": 0.0}])
+        t.create_tag("base")
+        t.create_branch("hist", "base")
+        hist = FileStoreTable.load(t.path,
+                                   dynamic_options={"branch": "hist"})
+        commit(hist, [{"pt": 9, "id": 1, "v": 9.0},
+                      {"pt": 5, "id": 1, "v": 5.0}])
+        chained = t.copy({"scan.fallback-branch": "hist"})
+        rb = chained.new_read_builder().with_partition_filter({"pt": 5})
+        scan = rb.new_stream_scan()
+        first = rb.new_read().to_arrow(scan.plan())
+        assert {r["pt"] for r in first.to_pylist()} == {5}
+
+    def test_empty_primary_branch_still_serves_fallback(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("pt", IntType(False))
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .partition_keys("pt")
+                  .primary_key("pt", "id")
+                  .options({"bucket": "1", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        t.create_branch("hist")
+        hist = FileStoreTable.load(t.path,
+                                   dynamic_options={"branch": "hist"})
+        commit(hist, [{"pt": 1, "id": 1, "v": 1.0}])
+        chained = t.copy({"scan.fallback-branch": "hist"})
+        scan = chained.new_read_builder().new_stream_scan()
+        plan = scan.plan()
+        assert plan is not None
+        rows = chained.new_read_builder().new_read() \
+            .to_arrow(plan).to_pylist()
+        assert rows and rows[0]["pt"] == 1
+
+
 class TestNewSystemTables:
     def _table(self, tmp_path):
         schema = (Schema.builder()
