@@ -209,7 +209,9 @@ def _update_columns_once(table, row_ids: np.ndarray,
     max_seq = max((f.max_sequence_number for s in plan.splits
                    for f in s.data_files), default=-1) + 1
 
-    new_msgs = []
+    # coverage first (pure range arithmetic, no IO): unknown row ids
+    # must fail BEFORE any overlay file is written
+    targets = []
     covered = np.zeros(len(row_ids), dtype=bool)
     for split in plan.splits:
         for group in group_row_ranges(split.data_files):
@@ -223,59 +225,73 @@ def _update_columns_once(table, row_ids: np.ndarray,
             if a == b:
                 continue
             covered[a:b] = True
-            local = (row_ids[a:b] - lo).astype(np.int64)
-            current = read_evolution_group(read, split, group, upd_cols)
-            cols_out = {}
-            for c in upd_cols:
-                old = current.column(c).combine_chunks()
-                new_vals = updates.column(c).slice(
-                    a, b - a).combine_chunks().cast(old.type)
-                # vectorized scatter: concat old+new, take with an index
-                # vector whose updated slots point into the new tail
-                combined = pa.concat_arrays([old, new_vals])
-                idx = np.arange(len(old), dtype=np.int64)
-                idx[local] = len(old) + np.arange(len(new_vals),
-                                                  dtype=np.int64)
-                cols_out[c] = combined.take(pa.array(idx))
-            chunk = pa.table(cols_out)
-
-            fmt = get_format(table.options.file_format)
-            name = fs_scan.path_factory.new_data_file_name(fmt.extension)
-            path = fs_scan.path_factory.data_file_path(
-                split.partition, split.bucket, name)
-            size = fmt.create_writer(
-                table.options.file_compression).write(
-                table.file_io, path, chunk)
-            mins, maxs, nulls = extract_simple_stats(chunk, upd_cols)
-            # stats come back in upd_cols order; types must align 1:1
-            by_name = {f.name: f.type for f in table.schema.fields}
-            types = [by_name[c] for c in upd_cols]
-            from paimon_tpu.core.kv_file import _safe_stats
-            meta = DataFileMeta(
-                file_name=name, file_size=size,
-                row_count=anchor.row_count,
-                min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
-                value_stats=_safe_stats(types, mins, maxs, nulls),
-                min_sequence_number=max_seq,
-                max_sequence_number=max_seq,
-                schema_id=table.schema.id, level=0,
-                file_source=FileSource.APPEND,
-                value_stats_cols=upd_cols,
-                first_row_id=anchor.first_row_id,
-                write_cols=upd_cols)
-            from paimon_tpu.core.write import CommitMessage
-            new_msgs.append(CommitMessage(
-                split.partition, split.bucket, split.total_buckets,
-                new_files=[meta]))
+            targets.append((split, group, anchor, a, b))
     if not covered.all():
         missing = row_ids[~covered][:5].tolist()
         raise ValueError(f"row ids not found in any tracked range "
                          f"(e.g. {missing}); is row-tracking.enabled on?")
+
+    new_msgs = []
+    written_paths = []
+    for split, group, anchor, a, b in targets:
+        lo = anchor.first_row_id
+        local = (row_ids[a:b] - lo).astype(np.int64)
+        current = read_evolution_group(read, split, group, upd_cols)
+        cols_out = {}
+        for c in upd_cols:
+            old = current.column(c).combine_chunks()
+            new_vals = updates.column(c).slice(
+                a, b - a).combine_chunks().cast(old.type)
+            # vectorized scatter: concat old+new, take with an index
+            # vector whose updated slots point into the new tail
+            combined = pa.concat_arrays([old, new_vals])
+            idx = np.arange(len(old), dtype=np.int64)
+            idx[local] = len(old) + np.arange(len(new_vals),
+                                              dtype=np.int64)
+            cols_out[c] = combined.take(pa.array(idx))
+        chunk = pa.table(cols_out)
+
+        fmt = get_format(table.options.file_format)
+        name = fs_scan.path_factory.new_data_file_name(fmt.extension)
+        path = fs_scan.path_factory.data_file_path(
+            split.partition, split.bucket, name)
+        size = fmt.create_writer(
+            table.options.file_compression).write(
+            table.file_io, path, chunk)
+        written_paths.append(path)
+        mins, maxs, nulls = extract_simple_stats(chunk, upd_cols)
+        # stats come back in upd_cols order; types must align 1:1
+        by_name = {f.name: f.type for f in table.schema.fields}
+        types = [by_name[c] for c in upd_cols]
+        from paimon_tpu.core.kv_file import _safe_stats
+        meta = DataFileMeta(
+            file_name=name, file_size=size,
+            row_count=anchor.row_count,
+            min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
+            value_stats=_safe_stats(types, mins, maxs, nulls),
+            min_sequence_number=max_seq,
+            max_sequence_number=max_seq,
+            schema_id=table.schema.id, level=0,
+            file_source=FileSource.APPEND,
+            value_stats_cols=upd_cols,
+            first_row_id=anchor.first_row_id,
+            write_cols=upd_cols)
+        from paimon_tpu.core.write import CommitMessage
+        new_msgs.append(CommitMessage(
+            split.partition, split.bucket, split.total_buckets,
+            new_files=[meta]))
     if not new_msgs:
         return None
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)
-    return commit.commit(new_msgs, expected_latest_id=snapshot.id)
+    try:
+        return commit.commit(new_msgs, expected_latest_id=snapshot.id)
+    except BaseException:
+        # the retry wrapper replans and rewrites: this attempt's overlay
+        # files would otherwise linger until orphan cleanup
+        for p in written_paths:
+            table.file_io.delete_quietly(p)
+        raise
 
 
 def delete_by_row_ids(table, row_ids: Sequence[int],

@@ -205,11 +205,14 @@ class LocalMerger:
         self.buffer_bytes = buffer_bytes
         self._tables: List[pa.Table] = []
         self._kinds: List[np.ndarray] = []
+        self._buckets: List[Optional[np.ndarray]] = []
         self._nbytes = 0
 
-    def add(self, table: pa.Table, kinds: np.ndarray):
+    def add(self, table: pa.Table, kinds: np.ndarray,
+            buckets: Optional[np.ndarray] = None):
         self._tables.append(table)
         self._kinds.append(kinds)
+        self._buckets.append(buckets)
         self._nbytes += table.nbytes
         if self._nbytes >= self.buffer_bytes:
             self.flush()
@@ -219,7 +222,12 @@ class LocalMerger:
             return
         raw = pa.concat_tables(self._tables, promote_options="none")
         kinds = np.concatenate(self._kinds)
-        self._tables, self._kinds, self._nbytes = [], [], 0
+        # precomputed bucket assignments survive the fold when every
+        # buffered batch carried them (the topology shuffle always does)
+        buckets = np.concatenate(self._buckets) \
+            if all(b is not None for b in self._buckets) else None
+        self._tables, self._kinds, self._buckets = [], [], []
+        self._nbytes = 0
         if raw.num_rows == 0:
             return
         schema = self.store.schema
@@ -237,7 +245,8 @@ class LocalMerger:
             [kv], key_cols, merge_engine=engine, drop_deletes=False,
             seq_fields=self.store.options.sequence_field or None)
         idx = res.indices
-        self.store._dispatch(raw.take(pa.array(idx)), kinds[idx])
+        self.store._dispatch(raw.take(pa.array(idx)), kinds[idx],
+                             None if buckets is None else buckets[idx])
 
 
 class KeyValueFileStoreWrite:
@@ -352,7 +361,7 @@ class KeyValueFileStoreWrite:
         table, row_kinds = extract_row_kinds(table, row_kinds)
 
         if self._local_merger is not None and not self._postpone:
-            self._local_merger.add(table, row_kinds)
+            self._local_merger.add(table, row_kinds, buckets)
             return
         self._dispatch(table, row_kinds, buckets)
 

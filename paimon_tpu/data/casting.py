@@ -97,8 +97,16 @@ def _float_to_int(arr, src: DataType, dst: DataType):
     vals = np.asarray(_chunked(arr).cast(pa.float64()).fill_null(0))
     trunc = np.trunc(vals)
     trunc = np.where(np.isnan(trunc), 0.0, trunc)
-    clipped = np.clip(trunc, float(lo), float(hi)).astype(np.int64)
-    return pa.array(clipped.astype(_NP_INT[db]), data_type_to_arrow(dst),
+    # float64 cannot represent 2^63-1 (rounds up to 2^63), so a
+    # float-space clip followed by astype would WRAP huge positives to
+    # Long.MIN; saturate with explicit masks instead
+    hi_f = float(1 << (sat_bits - 1))     # 2^(bits-1), exact in float
+    hi_mask = trunc >= hi_f
+    lo_mask = trunc <= float(lo)          # lo itself is exact
+    safe = np.where(hi_mask | lo_mask, 0.0, trunc)
+    out = np.where(hi_mask, hi, np.where(lo_mask, lo,
+                                         safe.astype(np.int64)))
+    return pa.array(out.astype(_NP_INT[db]), data_type_to_arrow(dst),
                     mask=np.asarray(pc.is_null(_chunked(arr))))
 
 
@@ -163,7 +171,9 @@ def _str_to_time(arr, src, dst):
                     continue
                 h, m = int(p[0]), int(p[1])
                 sec = float(p[2]) if len(p) > 2 else 0.0
-                out.append(int((h * 3600 + m * 60) * 1000 + sec * 1000))
+                # round, not truncate: 0.57*1000 is 569.999... in float
+                out.append((h * 3600 + m * 60) * 1000
+                           + round(sec * 1000))
             return pa.array(out, pa.time32("ms")).cast(
                 data_type_to_arrow(dst))
         except (ValueError, IndexError) as e:
@@ -284,7 +294,13 @@ def _any_to_string(arr, src, dst):
             isinstance(src, _TS_TYPES) or isinstance(src, TimeType):
         return _str_to_str(pc.cast(base, pa.string()), src, dst)
     if isinstance(src, _FLOAT_TYPES):
-        return _str_to_str(pc.cast(base, pa.string()), src, dst)
+        # Java Double.toString always carries a decimal point
+        # ("1.0", not "1"); python repr matches that shape (exponent
+        # spelling differs only at extreme magnitudes)
+        rendered = pa.array(
+            [None if v is None else repr(float(v))
+             for v in base.to_pylist()], pa.string())
+        return _str_to_str(rendered, src, dst)
     if isinstance(src, (ArrayType, MapType, MultisetType, RowType)):
         import json
 

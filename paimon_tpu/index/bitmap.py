@@ -89,6 +89,16 @@ def _norm_literal(v, kind: int):
     return bytes(v) if isinstance(v, (bytes, bytearray)) else None
 
 
+def _prefix_successor(prefix: str):
+    """Smallest string greater than every string with this prefix:
+    drop trailing U+10FFFF chars, bump the last remaining code point.
+    None = no upper bound (prefix was all U+10FFFF)."""
+    trimmed = prefix.rstrip(chr(0x10FFFF))
+    if not trimmed:
+        return None
+    return trimmed[:-1] + chr(ord(trimmed[-1]) + 1)
+
+
 def _mask_of(positions: np.ndarray, n: int) -> np.ndarray:
     m = np.zeros(n, dtype=bool)
     m[positions] = True
@@ -212,10 +222,11 @@ class BitmapIndex:
         if op == "starts_with" and self.kind == _KIND_STR:
             import bisect
             lo = bisect.bisect_left(self.distinct, literal)
-            # chr(0x10FFFF) (not U+FFFF) so astral-plane continuations
-            # stay inside the half-open range
-            hi = bisect.bisect_right(self.distinct,
-                                     literal + chr(0x10FFFF))
+            succ = _prefix_successor(literal)
+            # half-open [literal, successor): covers EVERY continuation
+            # including U+10FFFF (an appended-sentinel bound would not)
+            hi = bisect.bisect_left(self.distinct, succ) \
+                if succ is not None else len(self.distinct)
             return _mask_of(self._union(lo, hi), n), True
         return None, False
 

@@ -204,9 +204,19 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None
         messages.append(CommitMessage((), int(b), new_buckets,
                                       new_files=metas))
 
-    commit = FileStoreCommit(table.file_io, table.path, table.schema,
-                             table.options, branch=table.branch)
-    sid = commit.overwrite(messages)
+    # reference procedure order: ALTER the bucket option first, then
+    # INSERT OVERWRITE the reorganized data (writers must be paused for
+    # the whole rescale, like the reference's offline rescale job).  If
+    # the overwrite fails, roll the option back so the pre-rescale
+    # layout stays consistent with the schema.
     sm = SchemaManager(table.file_io, table.path, table.branch)
     sm.commit_changes(SchemaChange.set_option("bucket", str(new_buckets)))
+    try:
+        commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                                 table.options, branch=table.branch)
+        sid = commit.overwrite(messages)
+    except BaseException:
+        sm.commit_changes(SchemaChange.set_option(
+            "bucket", str(table.options.bucket)))
+        raise
     return sid

@@ -247,11 +247,15 @@ def _binlog(table) -> pa.Table:
     raw = raw.drop_columns([ROW_KIND_COL])
     value_cols = raw.column_names
     lists = raw.to_pylist()
+    pk = table.schema.primary_keys
     rows = []
     i = 0
     while i < len(lists):
         kind = kinds[i]
-        if kind == 1 and i + 1 < len(lists) and kinds[i + 1] == 2:
+        if kind == 1 and i + 1 < len(lists) and kinds[i + 1] == 2 and \
+                pk and all(lists[i][k] == lists[i + 1][k] for k in pk):
+            # fold only a true -U/+U pair OF THE SAME KEY; adjacent
+            # events of different keys stay separate rows
             before, after = lists[i], lists[i + 1]
             rows.append({"rowkind": "+U",
                          **{c: [before[c], after[c]]

@@ -297,3 +297,12 @@ def test_pk_table_bitmap_value_skip_is_merge_safe(tmp_path):
     out = table.to_arrow(predicate=P.equal("city", "sf"))
     # the sf version of key 1 is superseded; merge must see the newer file
     assert out.num_rows == 0
+
+
+def test_starts_with_max_codepoint_continuation():
+    """prefix+U+10FFFF values must stay inside the exact mask."""
+    vals = ["foo", "foo\U0010FFFFx", "foobar", "fop"]
+    col = pa.chunked_array([pa.array(vals, pa.string())])
+    idx = BitmapIndex.deserialize(BitmapIndex.build(col).serialize())
+    m, exact = idx.eval("starts_with", "foo")
+    assert exact and _mask_positions(m) == [0, 1, 2]

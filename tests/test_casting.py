@@ -195,3 +195,18 @@ def test_unsupported_pairs_refuse():
     assert not can_cast(DateType(), IntType())
     with pytest.raises(CastError):
         cast_array(pa.array([1], pa.int32()), DateType(), IntType())
+
+
+def test_double_to_bigint_saturates_not_wraps():
+    out = cast([1e19, -1e19, float(2**63)], DoubleType(), BigIntType())
+    assert out == [2**63 - 1, -(2**63), 2**63 - 1]
+
+
+def test_float_to_string_java_rendering():
+    assert cast([1.0, 2.5, None], DoubleType(), S) == \
+        ["1.0", "2.5", None]
+
+
+def test_string_to_time_rounds_millis():
+    out = cast(["0:05:00.570"], S, TimeType())
+    assert out == [datetime.time(0, 5, 0, 570000)]
