@@ -61,7 +61,8 @@ def _pad_size(n: int) -> int:
 
 
 def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
-                         num_key_lanes: Optional[int] = None):
+                         num_key_lanes: Optional[int] = None,
+                         use_pallas: bool = False):
     """Traceable kernel body shared by the single-chip path, the sharded
     multi-bucket path (parallel/sharded_merge.py) and the driver entry.
 
@@ -83,13 +84,20 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
     s_lanes = sorted_ops[1:1 + num_key_lanes]
     perm = sorted_ops[-1]
 
-    lanes_mat = jnp.stack(s_lanes)          # [L, N]
-    eq_next = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
-    # a real row whose key encodes to the same lanes as padding (e.g.
-    # INT64_MIN -> all-zero lanes) must not join the padding segment:
-    # validity is part of the segment identity
-    eq_next = eq_next & (s_invalid[:-1] == s_invalid[1:])
-    eq_next = jnp.concatenate([eq_next, jnp.array([False])])
+    if use_pallas:
+        # fused VMEM pass over all lanes at once; eq_next_mask itself
+        # falls back to the identical XLA ops for unsupported shapes or
+        # backends (ops/pallas_kernels.py)
+        from paimon_tpu.ops.pallas_kernels import eq_next_mask
+        eq_next = eq_next_mask(list(s_lanes), s_invalid)
+    else:
+        lanes_mat = jnp.stack(s_lanes)      # [L, N]
+        eq_next = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
+        # a real row whose key encodes to the same lanes as padding
+        # (e.g. INT64_MIN -> all-zero lanes) must not join the padding
+        # segment: validity is part of the segment identity
+        eq_next = eq_next & (s_invalid[:-1] == s_invalid[1:])
+        eq_next = jnp.concatenate([eq_next, jnp.array([False])])
     eq_prev = jnp.concatenate([jnp.array([False]), eq_next[:-1]])
     valid = s_invalid == 0
     if keep == "last":
@@ -103,14 +111,17 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
 
 
 @lru_cache(maxsize=64)
-def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int):
-    """Build the jitted merge kernel for a lane count."""
+def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int,
+              use_pallas: bool):
+    """Build the jitted merge kernel for a lane count.  `use_pallas`
+    is part of the cache key so the PAIMON_DISABLE_PALLAS kill switch
+    takes effect on the next call, not the next process."""
 
     @jax.jit
     def fn(lanes, seq_hi, seq_lo, invalid):
         return segmented_merge_body(
             [lanes[i] for i in range(num_lanes)], seq_hi, seq_lo, invalid,
-            keep, num_key_lanes=num_key_lanes)
+            keep, num_key_lanes=num_key_lanes, use_pallas=use_pallas)
 
     return fn
 
@@ -174,7 +185,8 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     invalid = np.ones(m, dtype=np.uint32)
     invalid[:n] = 0
 
-    fn = _merge_fn(num_lanes, keep, num_key_lanes)
+    from paimon_tpu.ops.pallas_kernels import pallas_enabled
+    fn = _merge_fn(num_lanes, keep, num_key_lanes, pallas_enabled())
     lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
     perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
                             jnp.asarray(seq_lo), jnp.asarray(invalid))

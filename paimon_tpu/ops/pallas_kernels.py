@@ -1,0 +1,113 @@
+"""Pallas TPU kernels for the merge plane.
+
+The segmented winner-select that follows the device sort is a chain of
+elementwise neighbor comparisons over L lane vectors (ops/merge.py
+segmented_merge_body): XLA emits it as several fused VPU loops over
+HBM-resident operands.  This kernel fuses the WHOLE chain — L lane
+equality compares, the validity guard and the boundary mask — into one
+VMEM pass per (8, 128) tile, so each lane element is read from HBM
+exactly once and the mask never materializes intermediate arrays.
+
+Layout: 1-D arrays of padded length N (power of two >= 1024, as the
+merge plane guarantees) are viewed as [N/128, 128] — the natural
+(sublane, lane) tiling for 32-bit data — and the grid walks row blocks
+of 8 sublanes.  The neighbor shift happens OUTSIDE the kernel (one XLA
+roll), keeping every kernel operand block-aligned.
+
+On non-TPU backends the kernel runs in interpret mode, so CPU tests
+exercise the identical program; set PAIMON_DISABLE_PALLAS=1 to force
+the plain XLA path.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["eq_next_mask", "pallas_enabled", "PALLAS_TILE"]
+
+_BLOCK_ROWS = 8
+_LANE = 128
+PALLAS_TILE = _BLOCK_ROWS * _LANE     # N must be a multiple of this
+
+
+def pallas_enabled() -> bool:
+    """Kernel on for TPU (compiled) and cpu (interpret mode, so tests
+    run the identical program); other accelerators keep the fused XLA
+    path — interpret-emulating a grid there would be a regression."""
+    if os.environ.get("PAIMON_DISABLE_PALLAS") == "1":
+        return False
+    return jax.default_backend() in ("tpu", "cpu")
+
+
+@lru_cache(maxsize=16)
+def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    rows = n // _LANE
+    grid = (rows // _BLOCK_ROWS,)
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANE), lambda i: (i, 0))
+
+    def kernel(*refs):
+        # refs: cur lanes... nxt lanes... inv_cur, inv_nxt, out
+        cur = refs[:num_lanes]
+        nxt = refs[num_lanes:2 * num_lanes]
+        inv_cur = refs[2 * num_lanes]
+        inv_nxt = refs[2 * num_lanes + 1]
+        out = refs[-1]
+        eq = cur[0][...] == nxt[0][...]
+        for l in range(1, num_lanes):
+            eq = jnp.logical_and(eq, cur[l][...] == nxt[l][...])
+        eq = jnp.logical_and(eq, inv_cur[...] == inv_nxt[...])
+        out[...] = eq.astype(jnp.uint32)
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec] * (2 * num_lanes + 2),
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.uint32),
+        interpret=interpret,
+    )
+
+    def run(lane_list, invalid):
+        def shaped(a):
+            return a.reshape(rows, _LANE)
+
+        def shifted(a):
+            return shaped(jnp.roll(a, -1))
+
+        args = ([shaped(a) for a in lane_list]
+                + [shifted(a) for a in lane_list]
+                + [shaped(invalid), shifted(invalid)])
+        eq = fn(*args).reshape(n)
+        # the final element wraps around to position 0: never a segment
+        # continuation
+        return eq.at[n - 1].set(0).astype(jnp.bool_)
+
+    return run
+
+
+def _eq_next_xla(lane_list, invalid):
+    lanes_mat = jnp.stack(list(lane_list))
+    eq = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
+    eq = eq & (invalid[:-1] == invalid[1:])
+    return jnp.concatenate([eq, jnp.array([False])])
+
+
+def eq_next_mask(lane_list: Sequence[jnp.ndarray],
+                 invalid: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: position i continues the same (validity, lanes...)
+    segment at i+1.  Fused Pallas pass on tpu/cpu backends for
+    tile-aligned N; every other case takes the equivalent XLA ops, so
+    callers never need their own shape/backend gate."""
+    n = invalid.shape[0]
+    if n == 0 or n % PALLAS_TILE != 0 or not pallas_enabled():
+        return _eq_next_xla(lane_list, invalid)
+    interpret = jax.default_backend() != "tpu"
+    run = _eq_next_fn(len(lane_list), n, interpret)
+    return run(list(lane_list), invalid)
