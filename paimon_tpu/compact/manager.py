@@ -127,7 +127,8 @@ class MergeTreeCompactManager:
             index_spec=options.file_index_spec,
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
-                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
+                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
+            format_per_level=options.file_format_per_level)
         rt = schema.logical_row_type()
         self.trimmed_pk = schema.trimmed_primary_keys()
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]
@@ -208,7 +209,15 @@ class MergeTreeCompactManager:
                 # promoting it without rewrite would let raw-convertible
                 # reads surface the duplicates
                 or (f.level == 0 and self.options.merge_engine in
-                    (ME.PARTIAL_UPDATE, ME.AGGREGATE)))
+                    (ME.PARTIAL_UPDATE, ME.AGGREGATE))
+                # file.format.per.level: a metadata-only promotion would
+                # carry the wrong format into the target level
+                # (reference upgrade rewrites on format change)
+                or (self.kv_writer.format_per_level and
+                    self.kv_writer.format_per_level.get(
+                        unit.output_level,
+                        self.options.file_format.lower())
+                    != f.file_name.rsplit(".", 1)[-1].lower()))
             # metadata-only promotion unless deletes must be dropped at the
             # top level (reference MergeTreeCompactTask.upgrade:124)
             if (unit.output_level < self.levels.max_level

@@ -36,11 +36,13 @@ class KeyValueFileWriter:
                  target_file_size: int = 128 << 20,
                  index_spec: Optional[Dict[str, List[str]]] = None,
                  bloom_fpp: float = 0.01,
-                 index_in_manifest_threshold: int = 500):
+                 index_in_manifest_threshold: int = 500,
+                 format_per_level: Optional[Dict[int, str]] = None):
         self.file_io = file_io
         self.path_factory = path_factory
         self.schema = table_schema
         self.file_format = file_format
+        self.format_per_level = format_per_level or {}
         self.compression = compression
         self.target_file_size = target_file_size
         self.index_spec = index_spec or {}
@@ -73,7 +75,8 @@ class KeyValueFileWriter:
 
     def _write_one(self, partition: Tuple, bucket: int, chunk: pa.Table,
                    level: int, file_source: int) -> DataFileMeta:
-        fmt = get_format(self.file_format)
+        fmt = get_format(self.format_per_level.get(level,
+                                                   self.file_format))
         name = self.path_factory.new_data_file_name(fmt.extension)
         path = self.path_factory.data_file_path(partition, bucket, name)
         from paimon_tpu.format.blob import blob_column_names

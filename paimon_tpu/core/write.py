@@ -279,7 +279,8 @@ class KeyValueFileStoreWrite:
             index_spec=options.file_index_spec,
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
-                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
+                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
+            format_per_level=options.file_format_per_level)
         rt = table_schema.logical_row_type()
         self.total_buckets = options.bucket
         bucket_keys = table_schema.bucket_keys()

@@ -91,13 +91,11 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
         from paimon_tpu.ops.pallas_kernels import eq_next_mask
         eq_next = eq_next_mask(list(s_lanes), s_invalid)
     else:
-        lanes_mat = jnp.stack(s_lanes)      # [L, N]
-        eq_next = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
-        # a real row whose key encodes to the same lanes as padding
-        # (e.g. INT64_MIN -> all-zero lanes) must not join the padding
-        # segment: validity is part of the segment identity
-        eq_next = eq_next & (s_invalid[:-1] == s_invalid[1:])
-        eq_next = jnp.concatenate([eq_next, jnp.array([False])])
+        # single source of truth for the mask semantics (incl. the
+        # validity guard: a real row whose key encodes like padding
+        # must not join the padding segment)
+        from paimon_tpu.ops.pallas_kernels import _eq_next_xla
+        eq_next = _eq_next_xla(list(s_lanes), s_invalid)
     eq_prev = jnp.concatenate([jnp.array([False]), eq_next[:-1]])
     valid = s_invalid == 0
     if keep == "last":

@@ -174,6 +174,11 @@ class CoreOptions:
     BUCKET_KEY = ConfigOption("bucket-key", str, None, "Comma-separated bucket key")
     PATH = ConfigOption("path", str, None, "Table path")
     FILE_FORMAT = ConfigOption("file.format", str, "parquet", "Data file format")
+    FILE_FORMAT_PER_LEVEL = ConfigOption(
+        "file.format.per.level", str, None,
+        "Per-LSM-level format overrides, e.g. '0:avro,5:parquet' — "
+        "fast row codec for hot L0, columnar for settled levels "
+        "(reference CoreOptions file.format.per.level)")
     FILE_COMPRESSION_ZSTD_LEVEL = ConfigOption(
         "file.compression.zstd-level", int, None,
         "zstd level for data files (reference CoreOptions"
@@ -342,6 +347,28 @@ class CoreOptions:
     @property
     def file_format(self) -> str:
         return self.options.get(CoreOptions.FILE_FORMAT)
+
+    @property
+    def file_format_per_level(self):
+        """{level: format} overrides (reference
+        CoreOptions.fileFormatPerLevel)."""
+        v = self.options.get(CoreOptions.FILE_FORMAT_PER_LEVEL)
+        out = {}
+        if v:
+            for part in v.split(","):
+                lvl, sep, fmt = part.partition(":")
+                if not sep or not fmt.strip() or not lvl.strip():
+                    raise ValueError(
+                        f"file.format.per.level entry {part!r} must be "
+                        f"'<level>:<format>' (e.g. '0:avro,5:parquet')")
+                try:
+                    level = int(lvl.strip())
+                except ValueError:
+                    raise ValueError(
+                        f"file.format.per.level level {lvl.strip()!r} "
+                        f"is not an integer") from None
+                out[level] = fmt.strip().lower()
+        return out
 
     @property
     def file_compression(self) -> str:

@@ -188,7 +188,8 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None
         compression=table.options.file_compression,
         target_file_size=table.options.target_file_size,
         index_spec=table.options.file_index_spec,
-        bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP))
+        bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+        format_per_level=table.options.file_format_per_level)
     max_level = table.options.num_levels - 1
 
     messages: List[CommitMessage] = []

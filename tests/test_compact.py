@@ -152,3 +152,48 @@ def test_read_after_compaction_mixed_levels(tmp_path):
     write_rows(table, [{"id": k, "v": "new"} for k in range(5)])
     out = table.to_arrow().sort_by("id")
     assert out.column("v").to_pylist() == ["new"] * 5 + ["old"] * 5
+
+
+def test_file_format_per_level(tmp_warehouse):
+    """'0:avro' puts hot L0 flushes in the row codec while compaction
+    rewrites settle into parquet (reference file.format.per.level)."""
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType, DoubleType
+    import os
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "file.format.per.level": "0:avro"})
+              .build())
+    t = FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+    for i in range(3):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": i, "v": float(i)}])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+    files = [f for s in t.new_read_builder().new_scan().plan().splits
+             for f in s.data_files]
+    assert all(f.file_name.endswith(".avro") for f in files)
+    t.compact(full=True)
+    files = [f for s in t.new_read_builder().new_scan().plan().splits
+             for f in s.data_files]
+    assert all(f.file_name.endswith(".parquet") for f in files)
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [0, 1, 2]
+
+
+def test_file_format_per_level_validation():
+    from paimon_tpu.options import CoreOptions, Options
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="file.format.per.level"):
+        CoreOptions(Options({"file.format.per.level": "avro"})) \
+            .file_format_per_level
+    with _pytest.raises(ValueError, match="not an integer"):
+        CoreOptions(Options({"file.format.per.level": "L0:avro"})) \
+            .file_format_per_level
+    assert CoreOptions(Options({"file.format.per.level": "0:AVRO"})) \
+        .file_format_per_level == {0: "avro"}
