@@ -82,11 +82,13 @@ def compact_table(table, full: bool = False,
     is_append = not table.schema.primary_keys
     if is_append and table.options.get(CoreOptions.ROW_TRACKING_ENABLED):
         # row-tracked files own dense id ranges; plain rewrite would
-        # reassign positions and orphan evolution overlays / row-id DVs.
-        # The reference uses dedicated dataevolution compact tasks
-        # (append/dataevolution/DataEvolutionCompactTask.java); until
-        # that lands here, compaction on tracked tables is a no-op.
-        return None
+        # reassign positions and orphan evolution overlays / row-id
+        # DVs. Their compaction folds each row-range group's overlays
+        # into one full file that KEEPS the group's firstRowId
+        # (reference append/dataevolution/DataEvolutionCompactTask)
+        from paimon_tpu.core.row_tracking import compact_row_tracked
+        return compact_row_tracked(table,
+                                   partition_filter=partition_filter)
     dv_index = scan._load_deletion_vectors(snapshot.id, snapshot) \
         if is_append else {}
     messages: List[CommitMessage] = []

@@ -155,6 +155,56 @@ def read_evolution_group(read, split, group: Sequence[DataFileMeta],
     return out
 
 
+def _load_bucket_dv_state(table, fs_scan, snapshot):
+    """(prev DV index-manifest entries, DV index file writer) — the
+    bootstrap shared by row-id deletes and evolution compaction."""
+    from paimon_tpu.index.deletion_vector import DeletionVectorsIndexFile
+    from paimon_tpu.index.dv_maintainer import DELETION_VECTORS_INDEX
+
+    prev_entries = []
+    if snapshot.index_manifest:
+        prev_entries = [
+            e for e in
+            fs_scan.index_manifest_file.read(snapshot.index_manifest)
+            if e.index_file.index_type == DELETION_VECTORS_INDEX]
+    dv_index = DeletionVectorsIndexFile(table.file_io,
+                                        f"{table.path}/index")
+    return prev_entries, dv_index
+
+
+def _write_tracked_file(table, fs_scan, split, chunk, *, row_count,
+                        first_row_id, min_seq, max_seq, level=0,
+                        file_source=None, write_cols=None,
+                        stats_cols=None):
+    """Encode one row-tracked data file + its DataFileMeta (shared by
+    update_columns overlays and evolution compaction)."""
+    from paimon_tpu.format import get_format
+    from paimon_tpu.format.format import extract_simple_stats
+    from paimon_tpu.core.kv_file import _safe_stats
+
+    cols = stats_cols or [f.name for f in table.schema.fields]
+    fmt = get_format(table.options.file_format)
+    name = fs_scan.path_factory.new_data_file_name(fmt.extension)
+    path = fs_scan.path_factory.data_file_path(
+        split.partition, split.bucket, name)
+    size = fmt.create_writer(table.options.file_compression).write(
+        table.file_io, path, chunk)
+    mins, maxs, nulls = extract_simple_stats(chunk, cols)
+    by_name = {f.name: f.type for f in table.schema.fields}
+    types = [by_name[c] for c in cols]
+    meta = DataFileMeta(
+        file_name=name, file_size=size, row_count=row_count,
+        min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
+        value_stats=_safe_stats(types, mins, maxs, nulls),
+        min_sequence_number=min_seq, max_sequence_number=max_seq,
+        schema_id=table.schema.id, level=level,
+        file_source=FileSource.APPEND if file_source is None
+        else file_source,
+        value_stats_cols=stats_cols,
+        first_row_id=first_row_id, write_cols=write_cols)
+    return meta, path
+
+
 # -- update by row id --------------------------------------------------------
 
 def update_columns(table, row_ids: np.ndarray, updates: pa.Table,
@@ -251,31 +301,11 @@ def _update_columns_once(table, row_ids: np.ndarray,
             cols_out[c] = combined.take(pa.array(idx))
         chunk = pa.table(cols_out)
 
-        fmt = get_format(table.options.file_format)
-        name = fs_scan.path_factory.new_data_file_name(fmt.extension)
-        path = fs_scan.path_factory.data_file_path(
-            split.partition, split.bucket, name)
-        size = fmt.create_writer(
-            table.options.file_compression).write(
-            table.file_io, path, chunk)
+        meta, path = _write_tracked_file(
+            table, fs_scan, split, chunk, row_count=anchor.row_count,
+            first_row_id=anchor.first_row_id, min_seq=max_seq,
+            max_seq=max_seq, write_cols=upd_cols, stats_cols=upd_cols)
         written_paths.append(path)
-        mins, maxs, nulls = extract_simple_stats(chunk, upd_cols)
-        # stats come back in upd_cols order; types must align 1:1
-        by_name = {f.name: f.type for f in table.schema.fields}
-        types = [by_name[c] for c in upd_cols]
-        from paimon_tpu.core.kv_file import _safe_stats
-        meta = DataFileMeta(
-            file_name=name, file_size=size,
-            row_count=anchor.row_count,
-            min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
-            value_stats=_safe_stats(types, mins, maxs, nulls),
-            min_sequence_number=max_seq,
-            max_sequence_number=max_seq,
-            schema_id=table.schema.id, level=0,
-            file_source=FileSource.APPEND,
-            value_stats_cols=upd_cols,
-            first_row_id=anchor.first_row_id,
-            write_cols=upd_cols)
         from paimon_tpu.core.write import CommitMessage
         new_msgs.append(CommitMessage(
             split.partition, split.bucket, split.total_buckets,
@@ -330,14 +360,8 @@ def _delete_by_row_ids_once(table, row_ids: Sequence[int]
     fs_scan = table.new_scan()
     plan = fs_scan.plan(snapshot)
 
-    prev_entries = []
-    if snapshot.index_manifest:
-        prev_entries = [
-            e for e in
-            fs_scan.index_manifest_file.read(snapshot.index_manifest)
-            if e.index_file.index_type == DELETION_VECTORS_INDEX]
-    dv_index = DeletionVectorsIndexFile(table.file_io,
-                                        f"{table.path}/index")
+    prev_entries, dv_index = _load_bucket_dv_state(table, fs_scan,
+                                                    snapshot)
     index_entries = []
     any_change = False
     covered = np.zeros(len(row_ids), dtype=bool)
@@ -376,3 +400,101 @@ def _delete_by_row_ids_once(table, row_ids: Sequence[int]
                              table.options, branch=table.branch)
     return commit.commit([], index_entries=index_entries,
                          expected_latest_id=snapshot.id)
+
+
+def compact_row_tracked(table, partition_filter=None,
+                        max_retries: int = 5) -> Optional[int]:
+    """Retry wrapper: a concurrent commit between plan and publish
+    replans instead of surfacing, like the other tracked mutations."""
+    from paimon_tpu.core.commit import CommitConflictError
+
+    for _ in range(max_retries):
+        try:
+            return _compact_row_tracked_once(table, partition_filter)
+        except CommitConflictError:
+            continue
+    raise CommitConflictError(
+        f"evolution compaction lost the race {max_retries} times")
+
+
+def _compact_row_tracked_once(table, partition_filter=None
+                              ) -> Optional[int]:
+    """Data-evolution compaction: fold each row-range group's overlay
+    files into ONE full file that keeps the group's firstRowId (row ids
+    never move — reference append/dataevolution/
+    DataEvolutionCompactTask.java / DataEvolutionNormalCompactTask).
+    Deletion vectors stay row-position keyed: they re-key from the old
+    anchor file to the rewritten file in the same commit.  Groups with
+    a single file are already settled and stay untouched."""
+    from paimon_tpu.core.append import AppendSplitRead
+    from paimon_tpu.core.commit import FileStoreCommit
+    from paimon_tpu.core.write import CommitMessage
+    from paimon_tpu.index.dv_maintainer import replace_bucket_dv_entries
+
+    snapshot = table.latest_snapshot()
+    if snapshot is None:
+        return None
+    fs_scan = table.new_scan()
+    if partition_filter:
+        fs_scan.with_partition_filter(partition_filter)
+    plan = fs_scan.plan(snapshot)
+    read = AppendSplitRead(table.file_io, table.path, table.schema,
+                           table.options,
+                           schema_manager=table.schema_manager)
+    value_cols = [f.name for f in table.schema.fields]
+
+    prev_dv_entries, dv_index = _load_bucket_dv_state(table, fs_scan,
+                                                       snapshot)
+
+    messages = []
+    index_entries = []
+    written_paths = []
+    for split in plan.splits:
+        groups = [g for g in group_row_ranges(split.data_files)
+                  if len(g) > 1]
+        if not groups:
+            continue
+        bucket_dvs = dict(split.deletion_vectors or {})
+        dv_changed = False
+        before: List[DataFileMeta] = []
+        after: List[DataFileMeta] = []
+        for group in groups:
+            anchor = anchor_of(group)
+            merged = read_evolution_group(read, split, group, value_cols)
+            meta, path = _write_tracked_file(
+                table, fs_scan, split, merged,
+                row_count=anchor.row_count,
+                first_row_id=anchor.first_row_id,
+                min_seq=anchor.min_sequence_number,
+                max_seq=max(f.max_sequence_number for f in group),
+                level=max(f.level for f in group),
+                file_source=FileSource.COMPACT)
+            written_paths.append(path)
+            before.extend(group)
+            after.append(meta)
+            dv = bucket_dvs.pop(anchor.file_name, None)
+            if dv is not None:
+                # positions are unchanged: the DV just follows the file
+                bucket_dvs[meta.file_name] = dv
+                dv_changed = True
+        if not before:
+            continue
+        messages.append(CommitMessage(
+            split.partition, split.bucket, split.total_buckets,
+            compact_before=before, compact_after=after))
+        if dv_changed:
+            pbytes = fs_scan._partition_codec.to_bytes(split.partition)
+            index_entries.extend(replace_bucket_dv_entries(
+                fs_scan, pbytes, split.bucket, bucket_dvs,
+                prev_dv_entries, dv_index))
+    if not messages:
+        return None
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    try:
+        return commit.commit(messages, index_entries=index_entries,
+                             expected_latest_id=snapshot.id)
+    except BaseException:
+        for p in written_paths:
+            table.file_io.delete_quietly(p)
+        raise

@@ -150,13 +150,39 @@ def test_delete_where_sees_updated_values(tmp_path):
     assert 3 not in ids
 
 
-def test_compact_is_noop_on_tracked_tables(tmp_path):
+def test_compact_folds_overlays_and_keeps_row_ids(tmp_path):
+    """Data-evolution compaction: overlay groups fold into one full
+    file per range, row ids stay put, DVs follow the rewritten file."""
     t = tracked_table(tmp_path)
-    for i in range(4):
-        write(t, [{"id": i, "name": "a", "score": 0.0}])
+    write(t, [{"id": i, "name": f"n{i}", "score": float(i)}
+              for i in range(10)])
+    t.update_columns(np.array([2, 7]),
+                     pa.table({"score": [20.0, 70.0]}))
+    t.update_columns(np.array([2]), pa.table({"name": ["u2"]}))
+    t.delete_by_row_ids([5])
+    before = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID").to_pylist()
+    files_before = sum(len(s.data_files) for s in
+                      t.new_read_builder().new_scan().plan().splits)
+    assert files_before == 3              # base + two overlays
+
+    sid = t.compact(full=True)
+    assert sid is not None
+    assert t.latest_snapshot().commit_kind == "COMPACT"
+    after = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID").to_pylist()
+    assert after == before                # same rows, same ids, no 5
+    plan = t.new_read_builder().new_scan().plan()
+    assert sum(len(s.data_files) for s in plan.splits) == 1
+    f = plan.splits[0].data_files[0]
+    assert f.first_row_id == 0 and f.write_cols is None
+
+    # further updates keep working against the folded file
+    t.update_columns(np.array([2]), pa.table({"score": [200.0]}))
+    rows = t.to_arrow(with_row_ids=True).sort_by("_ROW_ID").to_pylist()
+    assert [r for r in rows if r["_ROW_ID"] == 2][0]["score"] == 200.0
+
+    # settled tables are a compaction no-op
+    t.compact(full=True)
     assert t.compact(full=True) is None
-    out = t.to_arrow(with_row_ids=True)
-    assert sorted(out.column("_ROW_ID").to_pylist()) == [0, 1, 2, 3]
 
 
 def test_global_index_lookup_and_update_by_key(tmp_path):
