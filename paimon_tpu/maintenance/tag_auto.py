@@ -1,0 +1,117 @@
+"""Automatic tag creation at commit time.
+
+reference: paimon-core/src/main/java/org/apache/paimon/tag/
+TagAutoManager.java + TagAutoCreation.java — with
+`tag.automatic-creation` enabled, each commit checks whether a tag
+period (daily/hourly, or a custom duration) has completed; the first
+snapshot past `period end + tag.creation-delay` is tagged with the
+period's formatted name, and `tag.num-retained-max` expires the oldest
+auto tags.  `process-time` uses the snapshot's commit time,
+`watermark` the snapshot's watermark.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import List, Optional
+
+from paimon_tpu.options import CoreOptions
+
+__all__ = ["maybe_create_tags"]
+
+# names this module creates: 'YYYY-MM-DD', 'YYYY-MM-DD HH', or the
+# dash-less variants — ONLY these are subject to auto-tag expiry
+_AUTO_TAG_RE = re.compile(r"^\d{4}-\d{2}-\d{2}( \d{2})?$|^\d{8}(\d{2})?$")
+
+
+def _list_tag_names(table) -> List[str]:
+    """Tag names without reading each tag's snapshot file."""
+    from paimon_tpu.snapshot.tag_manager import TAG_PREFIX
+    try:
+        sts = table.file_io.list_status(table.tag_manager.tag_dir)
+    except (FileNotFoundError, OSError):
+        return []
+    out = []
+    for st in sts:
+        fname = st.path.rstrip("/").split("/")[-1]
+        if fname.startswith(TAG_PREFIX):
+            out.append(fname[len(TAG_PREFIX):])
+    return sorted(out)
+
+
+def _period_millis(options: CoreOptions) -> int:
+    dur = options.options.get_or("tag.creation-period-duration", None)
+    if dur:
+        from paimon_tpu.options import _parse_duration_ms
+        return _parse_duration_ms(dur)
+    period = options.options.get_or("tag.creation-period", "daily")
+    return {"daily": 86_400_000, "hourly": 3_600_000,
+            "two-hours": 7_200_000}.get(period, 86_400_000)
+
+
+def _format_period(start_ms: int, period_ms: int,
+                   formatter: str) -> str:
+    dt = datetime.datetime.fromtimestamp(start_ms / 1000,
+                                         tz=datetime.timezone.utc)
+    if period_ms >= 86_400_000:
+        out = dt.strftime("%Y-%m-%d")
+    else:
+        out = dt.strftime("%Y-%m-%d %H")
+    if formatter == "without_dashes":
+        out = out.replace("-", "").replace(" ", "")
+    return out
+
+
+def maybe_create_tags(table) -> List[str]:
+    """Create any due auto tags for the latest snapshot; returns the
+    names created.  Call after a successful commit (the reference wires
+    TagAutoManager into the commit callback)."""
+    options = table.options
+    mode = options.get(CoreOptions.TAG_AUTOMATIC_CREATION)
+    if mode in (None, "none"):
+        return []
+    snapshot = table.latest_snapshot()
+    if snapshot is None:
+        return []
+    if mode == "watermark":
+        now_ms = snapshot.watermark
+        if now_ms is None:
+            return []
+    else:                                 # process-time
+        now_ms = snapshot.time_millis
+    period_ms = _period_millis(options)
+    from paimon_tpu.options import _parse_duration_ms
+    delay_raw = options.options.get_or("tag.creation-delay", None)
+    delay_ms = _parse_duration_ms(delay_raw) if delay_raw else 0
+    formatter = options.options.get_or("tag.period-formatter",
+                                       "with_dashes")
+
+    # the latest fully-elapsed period whose (end + delay) has passed
+    last_complete = ((now_ms - delay_ms) // period_ms) * period_ms \
+        - period_ms
+    if last_complete < 0:
+        return []
+    name = _format_period(last_complete, period_ms, formatter)
+    created: List[str] = []
+    if not table.tag_manager.tag_exists(name):
+        # ignore_if_exists: two committers racing the same period must
+        # both see their DATA commit succeed
+        table.tag_manager.create_tag(snapshot, name,
+                                     ignore_if_exists=True)
+        created.append(name)
+        _expire_auto_tags(table, options)
+    return created
+
+
+def _expire_auto_tags(table, options: CoreOptions):
+    """Only tags MATCHING the auto-naming pattern count toward (and are
+    removed by) tag.num-retained-max — manual tags are never touched
+    (reference TagAutoCreation expires its own tags only)."""
+    retain = options.options.get_or("tag.num-retained-max", None)
+    if not retain:
+        return
+    retain = int(retain)
+    auto = [n for n in _list_tag_names(table) if _AUTO_TAG_RE.match(n)]
+    while len(auto) > retain:
+        table.delete_tag(auto.pop(0))

@@ -411,12 +411,20 @@ class TableCommit:
         index_entries = [e for m in messages
                          for e in getattr(m, "index_entries", [])]
         if self._overwrite is not None:
-            return self._commit.overwrite(
+            sid = self._commit.overwrite(
                 messages, partition_filter=self._overwrite or None,
                 commit_identifier=commit_identifier,
                 index_entries=index_entries or None)
-        return self._commit.commit(messages, commit_identifier,
-                                   index_entries=index_entries or None)
+        else:
+            sid = self._commit.commit(
+                messages, commit_identifier,
+                index_entries=index_entries or None)
+        if sid is not None and self.table.options.get(
+                CoreOptions.TAG_AUTOMATIC_CREATION) not in (None, "none"):
+            # reference TagAutoManager rides the commit callback
+            from paimon_tpu.maintenance.tag_auto import maybe_create_tags
+            maybe_create_tags(self.table)
+        return sid
 
     def filter_committed(self, identifiers: Sequence[int]) -> List[int]:
         return self._commit.filter_committed(identifiers)

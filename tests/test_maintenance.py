@@ -150,3 +150,89 @@ def test_partition_expire(tmp_warehouse):
     assert expired == [("2026-07-01",)]
     rows = table.to_arrow().to_pylist()
     assert [r["dt"] for r in rows] == ["2026-07-27"]
+
+
+def test_tag_automatic_creation(tmp_path):
+    """reference tag/TagAutoManager + TagAutoCreation: commits tag the
+    last completed period; tag.num-retained-max expires old auto tags."""
+    import datetime
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "tag.automatic-creation": "process-time",
+                        "tag.creation-period": "daily",
+                        "tag.num-retained-max": "2"})
+              .build())
+    t = FileStoreTable.create(str(tmp_path / "t"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1}])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    tags = t.tag_manager.tags()
+    assert len(tags) == 1
+    name = next(iter(tags))
+    snap_dt = datetime.datetime.fromtimestamp(
+        t.latest_snapshot().time_millis / 1000,
+        tz=datetime.timezone.utc)
+    yesterday = snap_dt - datetime.timedelta(days=1)
+    assert name == yesterday.strftime("%Y-%m-%d")
+    # a second commit in the same period creates nothing new
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 2}])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    # same period: no new tag (unless the test straddled midnight,
+    # where exactly one more is legitimate)
+    assert len(t.tag_manager.tags()) <= 2
+
+
+def test_manual_tags_survive_auto_expiry(tmp_path):
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "tag.automatic-creation": "process-time",
+                        "tag.num-retained-max": "1"})
+              .build())
+    t = FileStoreTable.create(str(tmp_path / "mt"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1}])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    t.create_tag("1.0-release")
+    # force another expiry pass
+    from paimon_tpu.maintenance.tag_auto import _expire_auto_tags
+    _expire_auto_tags(t, t.options)
+    assert "1.0-release" in t.tag_manager.tags()
+
+
+def test_tag_auto_watermark_mode_needs_watermark(tmp_path):
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "tag.automatic-creation": "watermark"})
+              .build())
+    t = FileStoreTable.create(str(tmp_path / "wm"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": 1}])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    assert t.tag_manager.tags() == {}    # no watermark -> no tag
