@@ -75,7 +75,8 @@ class FileStoreCommit:
                kind: Optional[str] = None,
                index_entries: Optional[list] = None,
                properties: Optional[Dict[str, str]] = None,
-               expected_latest_id: Optional[int] = ...) -> Optional[int]:
+               expected_latest_id: Optional[int] = ...,
+               watermark: Optional[int] = None) -> Optional[int]:
         """Commit append + compact changes. Returns snapshot id (or None if
         nothing to commit). Append and compact deltas are committed as
         separate snapshots like the reference (APPEND then COMPACT)."""
@@ -108,21 +109,22 @@ class FileStoreCommit:
                 append_entries, changelog_entries, commit_identifier,
                 kind or CommitKind.APPEND, index_entries=index_entries,
                 properties=properties,
-                expected_latest_id=expected_latest_id)
+                expected_latest_id=expected_latest_id,
+                watermark=watermark)
             index_entries = None
         if compact_entries or compact_changelog_entries:
             last_id = self._try_commit(
                 compact_entries, compact_changelog_entries,
                 commit_identifier, CommitKind.COMPACT,
                 check_deleted_files=True, index_entries=index_entries,
-                properties=properties)
+                properties=properties, watermark=watermark)
         return last_id
 
     def overwrite(self, messages: Sequence[CommitMessage],
                   partition_filter: Optional[dict] = None,
                   commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
-                  index_entries: Optional[list] = None
-                  ) -> Optional[int]:
+                  index_entries: Optional[list] = None,
+                  watermark: Optional[int] = None) -> Optional[int]:
         """INSERT OVERWRITE: delete current files (optionally restricted to
         a partition spec) and add new ones atomically
         (reference FileStoreCommitImpl.overwrite). The delete set is
@@ -152,7 +154,8 @@ class FileStoreCommit:
 
         return self._try_commit([], [], commit_identifier,
                                 CommitKind.OVERWRITE, entries_fn=entries_fn,
-                                index_entries=index_entries)
+                                index_entries=index_entries,
+                                watermark=watermark)
 
     def filter_committed(self, commit_identifiers: Sequence[int]
                          ) -> List[int]:
@@ -189,7 +192,8 @@ class FileStoreCommit:
                     properties: Optional[Dict[str, str]] = None,
                     entries_fn=None,
                     expected_latest_id: Optional[int] = ...,
-                    statistics: Optional[str] = None) -> int:
+                    statistics: Optional[str] = None,
+                    watermark: Optional[int] = None) -> int:
         from paimon_tpu.metrics import global_registry
         import time as _time
 
@@ -271,6 +275,12 @@ class FileStoreCommit:
             index_manifest = self.index_manifest_file.combine(
                 prev_index, index_entries or [])
 
+            # watermarks only advance (reference FileStoreCommitImpl:
+            # max of provided and previous)
+            wm_vals = [w for w in
+                       (watermark, latest.watermark if latest else None)
+                       if w is not None]
+            new_watermark = max(wm_vals) if wm_vals else None
             delta_rows = sum(
                 (e.file.row_count if e.kind == FileKind.ADD
                  else -e.file.row_count) for e in entries)
@@ -296,6 +306,7 @@ class FileStoreCommit:
                 properties=properties,
                 statistics=statistics,
                 next_row_id=next_row_id,
+                watermark=new_watermark,
             )
             if self.snapshot_manager.try_commit(snapshot):
                 _metrics.counter("commits").inc()

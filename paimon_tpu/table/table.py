@@ -406,19 +406,25 @@ class TableCommit:
         self._overwrite = overwrite
 
     def commit(self, messages: Sequence[CommitMessage],
-               commit_identifier: int = BATCH_COMMIT_IDENTIFIER
-               ) -> Optional[int]:
+               commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
+               watermark: Optional[int] = None) -> Optional[int]:
+        """`watermark` (epoch millis) records event-time progress in the
+        snapshot — it only ever advances — feeding watermark-mode auto
+        tags and the snapshots system table (reference
+        TableCommitImpl#withWatermark)."""
         index_entries = [e for m in messages
                          for e in getattr(m, "index_entries", [])]
         if self._overwrite is not None:
             sid = self._commit.overwrite(
                 messages, partition_filter=self._overwrite or None,
                 commit_identifier=commit_identifier,
-                index_entries=index_entries or None)
+                index_entries=index_entries or None,
+                watermark=watermark)
         else:
             sid = self._commit.commit(
                 messages, commit_identifier,
-                index_entries=index_entries or None)
+                index_entries=index_entries or None,
+                watermark=watermark)
         if sid is not None and self.table.options.get(
                 CoreOptions.TAG_AUTOMATIC_CREATION) not in (None, "none"):
             # reference TagAutoManager rides the commit callback

@@ -236,3 +236,33 @@ def test_tag_auto_watermark_mode_needs_watermark(tmp_path):
     wb.new_commit().commit(w.prepare_commit())
     w.close()
     assert t.tag_manager.tags() == {}    # no watermark -> no tag
+
+
+def test_watermark_advances_and_drives_tags(tmp_path):
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true",
+                        "tag.automatic-creation": "watermark",
+                        "tag.creation-period": "daily"})
+              .build())
+    t = FileStoreTable.create(str(tmp_path / "w"), schema)
+
+    def commit(rows, wm):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts(rows)
+        wb.new_commit().commit(w.prepare_commit(), watermark=wm)
+        w.close()
+
+    day = 86_400_000
+    commit([{"id": 1}], wm=3 * day + 1000)
+    assert t.latest_snapshot().watermark == 3 * day + 1000
+    assert "1970-01-03" in t.tag_manager.tags()   # day 2 completed
+    # watermarks never regress
+    commit([{"id": 2}], wm=2 * day)
+    assert t.latest_snapshot().watermark == 3 * day + 1000
