@@ -1,0 +1,271 @@
+"""Object-store FileIO: commit semantics without rename(2).
+
+reference: paimon's object-store FileIOs (paimon-filesystems/ s3/oss/
+gcs modules) differ from local filesystems in exactly the ways modeled
+here — no atomic rename, flat keys instead of directories, LIST by
+prefix, and conditional writes (If-None-Match: * / ETag preconditions)
+as the only CAS primitive.  `ObjectStoreFileIO` adapts any
+`ObjectStoreBackend` to the FileIO SPI:
+
+- `try_to_write_atomic` = conditional PUT (the snapshot commit CAS) —
+  no staging file + link(2) like LocalFileIO
+- two-phase streams stage under a hidden key and publish with a
+  conditional server-side copy, then delete the stage
+- `mkdirs` is a no-op (keys are flat); directory listing derives from
+  key prefixes
+
+`LocalObjectStoreBackend` emulates a bucket on the local disk with the
+same constraints (everything goes through put/get/list/head/delete +
+preconditions, never rename), so the object-store commit path is fully
+exercised in tests; a real S3/GCS backend only has to implement the
+five backend calls.  Network egress is unavailable in this
+environment, so no remote backend ships yet.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from paimon_tpu.fs.fileio import (
+    FileIO, FileStatus, TwoPhaseCommitter, TwoPhaseOutputStream,
+)
+
+__all__ = ["ObjectStoreBackend", "LocalObjectStoreBackend",
+           "ObjectStoreFileIO"]
+
+
+class PreconditionFailed(Exception):
+    pass
+
+
+class ObjectStoreBackend:
+    """Five calls every real object store offers."""
+
+    def put(self, key: str, data: bytes,
+            if_none_match: bool = False) -> None:
+        """if_none_match=True -> fail with PreconditionFailed when the
+        key already exists (S3 If-None-Match: *, GCS
+        x-goog-if-generation-match: 0)."""
+        raise NotImplementedError
+
+    def get(self, key: str, offset: int = 0,
+            length: Optional[int] = None) -> bytes:
+        raise NotImplementedError
+
+    def head(self, key: str) -> Optional[int]:
+        """Size in bytes, or None when absent."""
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[Tuple[str, int]]:
+        """[(key, size)] under prefix."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalObjectStoreBackend(ObjectStoreBackend):
+    """A 'bucket' on local disk with object-store semantics ONLY: flat
+    keys (encoded to one directory level), no rename anywhere, and
+    conditional PUT serialized by a lock (real stores serialize
+    server-side)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        # staging lives OUTSIDE the flat key namespace so in-flight or
+        # orphaned temp writes can never appear in listings
+        self._staging = os.path.join(root, ".staging")
+        os.makedirs(self._staging, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        # flat namespace: escape separators so no directories exist
+        return os.path.join(self.root, key.replace("/", "%2F"))
+
+    def put(self, key: str, data: bytes,
+            if_none_match: bool = False) -> None:
+        with self._lock:
+            p = self._path(key)
+            if if_none_match and os.path.exists(p):
+                raise PreconditionFailed(key)
+            tmp = os.path.join(self._staging, uuid.uuid4().hex)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            # emulates the server's atomic object swap (not a FileIO
+            # rename: this is inside the backend, like the store's own
+            # internal commit)
+            os.replace(tmp, p)
+
+    def get(self, key: str, offset: int = 0,
+            length: Optional[int] = None) -> bytes:
+        p = self._path(key)
+        if not os.path.exists(p):
+            raise FileNotFoundError(key)
+        with open(p, "rb") as f:
+            f.seek(offset)
+            return f.read(length if length is not None else -1)
+
+    def head(self, key: str) -> Optional[int]:
+        p = self._path(key)
+        return os.path.getsize(p) if os.path.exists(p) else None
+
+    def list(self, prefix: str) -> List[Tuple[str, int]]:
+        enc = prefix.replace("/", "%2F")
+        out = []
+        for name in os.listdir(self.root):
+            p = os.path.join(self.root, name)
+            if name.startswith(enc) and os.path.isfile(p):
+                out.append((name.replace("%2F", "/"),
+                            os.path.getsize(p)))
+        return sorted(out)
+
+    def delete(self, key: str) -> bool:
+        p = self._path(key)
+        if os.path.exists(p):
+            os.remove(p)
+            return True
+        return False
+
+
+class ObjectStoreFileIO(FileIO):
+    """FileIO over an ObjectStoreBackend (scheme e.g. 'objfs://')."""
+
+    def __init__(self, backend: ObjectStoreBackend,
+                 scheme: str = "objfs://"):
+        self.backend = backend
+        self.scheme = scheme
+
+    def _key(self, path: str) -> str:
+        if path.startswith(self.scheme):
+            path = path[len(self.scheme):]
+        return path.lstrip("/")
+
+    # -- reads ---------------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        return self.backend.get(self._key(path))
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        return self.backend.get(self._key(path), offset, length)
+
+    def read_ranges(self, path, ranges):
+        # ranged GETs, one per range (real stores coalesce via HTTP
+        # multi-range; the per-call shape is the same)
+        key = self._key(path)
+        return [self.backend.get(key, o, ln) for o, ln in ranges]
+
+    def exists(self, path: str) -> bool:
+        key = self._key(path)
+        if self.backend.head(key) is not None:
+            return True
+        return bool(self.backend.list(key.rstrip("/") + "/"))
+
+    def get_file_size(self, path: str) -> int:
+        size = self.backend.head(self._key(path))
+        if size is None:
+            raise FileNotFoundError(path)
+        return size
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        prefix = self._key(path).rstrip("/") + "/"
+        out: Dict[str, FileStatus] = {}
+        for key, size in self.backend.list(prefix):
+            rest = key[len(prefix):]
+            if "/" in rest:               # synthetic directory entry
+                child = prefix + rest.split("/", 1)[0]
+                out.setdefault(child, FileStatus(
+                    f"{self.scheme}{child}", 0, True))
+            else:
+                out[key] = FileStatus(f"{self.scheme}{key}", size, False)
+        return sorted(out.values(), key=lambda s: s.path)
+
+    # -- writes --------------------------------------------------------------
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = True):
+        key = self._key(path)
+        if not overwrite and self.backend.head(key) is not None:
+            raise FileExistsError(path)
+        self.backend.put(key, data)
+
+    def try_to_write_atomic(self, path: str, data: bytes) -> bool:
+        """THE commit CAS on object stores: conditional PUT, no rename
+        (reference object-store SnapshotCommit implementations)."""
+        try:
+            self.backend.put(self._key(path), data, if_none_match=True)
+            return True
+        except PreconditionFailed:
+            return False
+
+    def new_two_phase_stream(self, path: str) -> TwoPhaseOutputStream:
+        io_, final = self, path
+        stage = (f"{path}.{uuid.uuid4().hex}.staging")
+        parts: List[bytes] = []
+
+        class S(TwoPhaseOutputStream):
+            def write(self, data: bytes):
+                parts.append(bytes(data))
+
+            def close_for_commit(self) -> TwoPhaseCommitter:
+                io_.backend.put(io_._key(stage), b"".join(parts))
+
+                class C(TwoPhaseCommitter):
+                    def commit(self):
+                        blob = io_.backend.get(io_._key(stage))
+                        try:
+                            io_.backend.put(io_._key(final), blob,
+                                            if_none_match=True)
+                        except PreconditionFailed:
+                            io_.backend.delete(io_._key(stage))
+                            raise FileExistsError(final)
+                        io_.backend.delete(io_._key(stage))
+
+                    def discard(self):
+                        io_.backend.delete(io_._key(stage))
+
+                return C()
+
+        return S()
+
+    def mkdirs(self, path: str) -> bool:
+        return True                        # flat keys: nothing to do
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        key = self._key(path)
+        ok = False
+        if self.backend.head(key) is not None:
+            ok = self.backend.delete(key)
+        if recursive:
+            # a key may exist BOTH as an object and as a prefix: drop
+            # every child too
+            for k, _ in self.backend.list(key.rstrip("/") + "/"):
+                ok = self.backend.delete(k) or ok
+        return ok
+
+    def rename(self, src: str, dst: str) -> bool:
+        # object stores have no rename: copy + delete per key
+        # (non-atomic, which is exactly why commits use
+        # try_to_write_atomic). Matches the FileIO contract: False when
+        # src is absent or dst already exists; prefix (directory)
+        # renames move every child key.
+        skey, dkey = self._key(src), self._key(dst)
+        if self.backend.head(dkey) is not None or                 self.backend.list(dkey.rstrip("/") + "/"):
+            return False
+        moved = False
+        if self.backend.head(skey) is not None:
+            self.backend.put(dkey, self.backend.get(skey))
+            self.backend.delete(skey)
+            moved = True
+        prefix = skey.rstrip("/") + "/"
+        for k, _ in self.backend.list(prefix):
+            self.backend.put(dkey.rstrip("/") + "/" + k[len(prefix):],
+                             self.backend.get(k))
+            self.backend.delete(k)
+            moved = True
+        return moved
+
+    def is_object_store(self) -> bool:
+        return True
