@@ -124,14 +124,57 @@ def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int,
     return fn
 
 
+def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
+                              keep: str
+                              ) -> Tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]:
+    """Packed-key fast path for the hottest shape (exactly two key
+    lanes — a fixed-width 64-bit key, so lanes are never
+    prefix-truncated — and no changelog predecessor needed): ONE stable
+    argsort on a u64 key instead of a 4-key lexsort, then the winner
+    per segment via segmented max/min of (seq, arrival) with reduceat.
+    Semantics identical to the full sort: winner = max seq (ties -> the
+    later arrival) for keep=last, min seq (ties -> earlier arrival) for
+    keep=first.  ~1.6x faster than the lexsort path at 8M rows."""
+    n = lanes.shape[0]
+    key = (lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | lanes[:, 1].astype(np.uint64)
+    perm = np.argsort(key, kind="stable").astype(np.int32)
+    k_sorted = key[perm]
+    starts_mask = np.empty(n, dtype=bool)
+    starts_mask[0] = True
+    starts_mask[1:] = k_sorted[1:] != k_sorted[:-1]
+    seg_starts = np.flatnonzero(starts_mask)
+    seg_id = np.cumsum(starts_mask) - 1
+    seq_sorted = seq[perm]
+    if keep == "last":
+        best_seq = np.maximum.reduceat(seq_sorted, seg_starts)
+        tie = seq_sorted == best_seq[seg_id]
+        cand = np.where(tie, perm, -1)
+        best_arrival = np.maximum.reduceat(cand, seg_starts)
+    else:
+        best_seq = np.minimum.reduceat(seq_sorted, seg_starts)
+        tie = seq_sorted == best_seq[seg_id]
+        cand = np.where(tie, perm, n)
+        best_arrival = np.minimum.reduceat(cand, seg_starts)
+    winner = tie & (perm == best_arrival[seg_id])
+    # winners_only contract: prev is never read — O(1) placeholder
+    prev = np.broadcast_to(np.int64(-1), n)
+    return perm, winner, prev
+
+
 def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
-                         num_key_lanes: int
+                         num_key_lanes: int,
+                         need_prev: bool = True
                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """CPU-backend fallback with EXACTLY the kernel's semantics: when no
     accelerator is attached, np.lexsort beats a single-threaded XLA
     host sort ~2x and skips the device round-trip + power-of-two
     padding entirely.  Accelerator runs never take this path."""
     n, num_lanes = lanes.shape
+    if num_lanes == 2 and num_key_lanes == 2 and not need_prev \
+            and n > 0:
+        return _host_sorted_winners_fast(lanes, seq, keep)
     useq = seq.astype(np.int64).view(np.uint64)
     keys = ((useq & np.uint64(0xFFFFFFFF)).astype(np.uint32),
             (useq >> np.uint64(32)).astype(np.uint32),
@@ -148,13 +191,17 @@ def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
 
 def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                           keep: str = "last",
-                          order_lanes: Optional[np.ndarray] = None
+                          order_lanes: Optional[np.ndarray] = None,
+                          winners_only: bool = False
                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the device kernel.
 
     lanes: uint32[N, L] (segment identity); seq: int64[N] (non-negative);
     order_lanes: optional uint32[N, O] user-defined sequence lanes that
     rank within a key BEFORE the internal sequence.
+    `winners_only=True` promises the caller uses ONLY the winner rows
+    (never full perm ordering within segments nor prev), unlocking the
+    packed-key fast path for fixed-width two-lane keys.
     Returns (perm, winner_mask, prev_in_segment) as numpy arrays — of
     the power-of-two padded size on an accelerator backend, UNPADDED
     (length N, all rows valid) on the cpu backend's lexsort fallback.
@@ -168,7 +215,8 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
             _os.environ.get("PAIMON_FORCE_DEVICE_SORT") != "1":
         full = lanes if order_lanes is None or order_lanes.shape[1] == 0 \
             else np.concatenate([lanes, order_lanes], axis=1)
-        return _host_sorted_winners(full, seq, keep, num_key_lanes)
+        return _host_sorted_winners(full, seq, keep, num_key_lanes,
+                                    need_prev=not winners_only)
     if order_lanes is not None and order_lanes.shape[1] > 0:
         lanes = np.concatenate([lanes, order_lanes], axis=1)
     num_lanes = lanes.shape[1]
@@ -281,8 +329,13 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
             "sequence.field cannot be used with merge-engine first-row")
     order_lanes = user_seq_order_lanes(table, seq_fields) \
         if seq_fields else None
-    perm, winner, prev = device_sorted_winners(lanes, seq, keep,
-                                               order_lanes)
+    # without changelog derivation the caller consumes only winner
+    # rows, so the packed-key fast path is admissible — unless any key
+    # was prefix-truncated: _refine_truncated needs the full path's
+    # seq-ordered segments with winners at segment boundaries
+    perm, winner, prev = device_sorted_winners(
+        lanes, seq, keep, order_lanes,
+        winners_only=not with_prev and not truncated.any())
 
     win_pos = np.flatnonzero(winner)
     indices = perm[win_pos].astype(np.int64)

@@ -82,3 +82,22 @@ def test_device_path_padding_still_covered():
         assert win.tolist() == [1]                   # max-seq row wins
     finally:
         os.environ.pop("PAIMON_FORCE_DEVICE_SORT", None)
+
+
+@pytest.mark.parametrize("keep", ["last", "first"])
+@pytest.mark.parametrize("seed", [1, 9, 42])
+def test_winners_only_fast_path_matches_full_sort(keep, seed):
+    """The packed-key argsort + segmented-argmax fast path must pick
+    byte-identical winners to the full (key, seq) sort."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(50, 8000))
+    lanes = rng.integers(0, 9, (n, 2), dtype=np.uint64) \
+        .astype(np.uint32)                 # heavy duplication
+    # non-unique sequences so arrival-order tie-breaks matter
+    seq = rng.integers(0, 12, n).astype(np.int64)
+
+    fast = device_sorted_winners(lanes, seq, keep, winners_only=True)
+    full = device_sorted_winners(lanes, seq, keep, winners_only=False)
+    w_fast = set(_winners(fast[0], fast[1], n).tolist())
+    w_full = set(_winners(full[0], full[1], n).tolist())
+    assert w_fast == w_full
