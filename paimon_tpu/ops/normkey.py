@@ -115,14 +115,21 @@ class NormalizedKeyEncoder:
                         "null value in a key column declared NOT NULL")
                 nl = total_nl
             if kind == "int":
-                vals = np.asarray(
-                    arr.cast(pa.int64()).fill_null(0))
+                cast = arr.cast(pa.int64())
+                # fill_null is a full copy at millions of rows: skip it
+                # for null-free columns (the common pk case)
+                if cast.null_count:
+                    cast = cast.fill_null(0)
+                vals = np.asarray(cast)
                 u = _ints_to_u64(vals)
                 hi, lo = _split_u64(u)
                 lanes[:, lane_pos] = hi
                 lanes[:, lane_pos + 1] = lo
             elif kind == "float":
-                vals = np.asarray(arr.cast(pa.float64()).fill_null(0))
+                cast = arr.cast(pa.float64())
+                if cast.null_count:
+                    cast = cast.fill_null(0)
+                vals = np.asarray(cast)
                 hi, lo = _split_u64(_floats_to_u64(vals))
                 lanes[:, lane_pos] = hi
                 lanes[:, lane_pos + 1] = lo
