@@ -104,13 +104,18 @@ class NormalizedKeyEncoder:
                 self.nullable):
             arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
                 else col
-            null_mask = np.asarray(arr.is_null())
+            # null_count is O(1) metadata: null-free columns (the
+            # common pk case) skip materializing a per-row mask
+            has_nulls = bool(arr.null_count)
+            null_mask = np.asarray(arr.is_null()) if has_nulls \
+                else np.zeros(n, dtype=bool)
             if nul:
-                lanes[:, lane_pos] = null_mask.astype(np.uint32)
+                if has_nulls:
+                    lanes[:, lane_pos] = null_mask.astype(np.uint32)
                 lane_pos += 1
                 nl = total_nl - 1
             else:
-                if null_mask.any():
+                if has_nulls:
                     raise ValueError(
                         "null value in a key column declared NOT NULL")
                 nl = total_nl
