@@ -231,11 +231,23 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     invalid = np.ones(m, dtype=np.uint32)
     invalid[:n] = 0
 
-    from paimon_tpu.ops.pallas_kernels import pallas_enabled
-    fn = _merge_fn(num_lanes, keep, num_key_lanes, pallas_enabled())
+    from paimon_tpu.ops.pallas_kernels import (disable_pallas_runtime,
+                                               pallas_enabled)
     lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
-    perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
-                            jnp.asarray(seq_lo), jnp.asarray(invalid))
+    use_pallas = pallas_enabled()
+    try:
+        fn = _merge_fn(num_lanes, keep, num_key_lanes, use_pallas)
+        perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
+                                jnp.asarray(seq_lo), jnp.asarray(invalid))
+    except jax.errors.JaxRuntimeError:
+        # a Mosaic compile rejection on the real backend must not fail
+        # the merge: drop to the pure-XLA kernel for the whole process
+        if not use_pallas:
+            raise
+        disable_pallas_runtime("Mosaic compile failed")
+        fn = _merge_fn(num_lanes, keep, num_key_lanes, False)
+        perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
+                                jnp.asarray(seq_lo), jnp.asarray(invalid))
     return (np.asarray(perm), np.asarray(winner), np.asarray(prev))
 
 

@@ -34,12 +34,30 @@ _BLOCK_ROWS = 8
 _LANE = 128
 PALLAS_TILE = _BLOCK_ROWS * _LANE     # N must be a multiple of this
 
+# flipped by disable_pallas_runtime() when a real-hardware Mosaic
+# compile fails mid-run: callers retry on the pure-XLA path and every
+# later merge skips the kernel for the life of the process
+_RUNTIME_DISABLED = False
+
+
+def disable_pallas_runtime(reason: str = "") -> None:
+    """Permanently (for this process) turn the Pallas path off — called
+    when Mosaic rejects the kernel on the actual backend so the merge
+    plane can recompile without it instead of failing the job."""
+    global _RUNTIME_DISABLED
+    if not _RUNTIME_DISABLED:
+        import sys
+        sys.stderr.write(
+            f"paimon_tpu: disabling Pallas kernels for this process"
+            f"{': ' + reason if reason else ''}\n")
+    _RUNTIME_DISABLED = True
+
 
 def pallas_enabled() -> bool:
     """Kernel on for TPU (compiled) and cpu (interpret mode, so tests
     run the identical program); other accelerators keep the fused XLA
     path — interpret-emulating a grid there would be a regression."""
-    if os.environ.get("PAIMON_DISABLE_PALLAS") == "1":
+    if _RUNTIME_DISABLED or os.environ.get("PAIMON_DISABLE_PALLAS") == "1":
         return False
     return jax.default_backend() in ("tpu", "cpu")
 
@@ -50,7 +68,12 @@ def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
 
     rows = n // _LANE
     grid = (rows // _BLOCK_ROWS,)
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANE), lambda i: (i, 0))
+    # the 0 column index MUST be pinned to int32: the package enables
+    # jax x64 (ops/__init__.py) and a weak `0` traces to i64, giving
+    # the index map a mixed (i32, i64) signature that Mosaic rejects
+    # ("failed to legalize operation 'func.return'") on real TPUs
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANE),
+                        lambda i: (i, jnp.int32(0)))
 
     def kernel(*refs):
         # refs: cur lanes... nxt lanes... inv_cur, inv_nxt, out

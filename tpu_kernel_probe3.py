@@ -1,0 +1,48 @@
+"""Verify the x64 index-map fix: the library kernel must now compile
+and produce correct results on the real TPU.  ONE client at a time."""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    print("backend:", jax.default_backend(), flush=True)
+    from paimon_tpu.ops import pallas_kernels as pk
+    import jax.numpy as jnp
+    n = 2048
+    lanes = [jnp.asarray(np.repeat(np.arange(n // 2, dtype=np.uint32), 2)),
+             jnp.asarray(np.zeros(n, dtype=np.uint32))]
+    invalid = jnp.asarray(np.zeros(n, dtype=np.uint32))
+    try:
+        m = np.asarray(pk.eq_next_mask(lanes, invalid))
+        # every even position equals its successor
+        expect = np.zeros(n, dtype=bool)
+        expect[0::2] = True
+        expect[n - 1] = False
+        ok = bool((m == expect).all())
+        print(f"library eq_next_mask: {'PASS' if ok else 'WRONG'}",
+              flush=True)
+    except Exception as e:
+        print(f"library eq_next_mask: FAIL {type(e).__name__}: "
+              f"{str(e).splitlines()[0][:200]}", flush=True)
+        return 1
+    # and the full merge path end-to-end on device
+    from paimon_tpu.ops.merge import device_sorted_winners
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 500, 4096, dtype=np.uint32)
+    lanes2 = np.stack([keys, np.zeros(4096, np.uint32)], axis=1)
+    seq = np.arange(4096, dtype=np.int64)
+    perm, winner, prev = device_sorted_winners(lanes2, seq, "last")
+    w = perm[winner[: len(perm)]] if len(winner) else []
+    uniq = len(np.unique(keys))
+    print(f"device_sorted_winners: winners={int(np.sum(winner))} "
+          f"uniq={uniq} {'PASS' if int(np.sum(winner)) == uniq else 'WRONG'}",
+          flush=True)
+    _ = (w, prev)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
