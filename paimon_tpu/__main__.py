@@ -1,0 +1,7 @@
+"""`python -m paimon_tpu` — the CLI entry point (see cli.py)."""
+
+import sys
+
+from paimon_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,407 @@
+"""Command-line interface: `python -m paimon_tpu ...`.
+
+The reference ships a `paimon` CLI over the Python catalog
+(pypaimon/cli/cli.py: table/db/catalog/sql/branch/tag subcommands with
+a yaml catalog config).  This is the same surface over paimon_tpu:
+
+  paimon --warehouse /wh db list|create|drop
+  paimon --warehouse /wh table list|get|read|snapshot|create|drop|
+                           compact|import|rename|set-option|add-column
+  paimon --warehouse /wh tag list|create|delete <db.table> [...]
+  paimon --warehouse /wh branch list|create|delete|fast-forward ...
+  paimon --warehouse /wh sql "SELECT ..." | sql   (interactive REPL)
+
+Catalog selection: --warehouse PATH (filesystem), or --config FILE — a
+JSON file of catalog options ({"warehouse": ..., "metastore": ...}),
+or the PAIMON_WAREHOUSE environment variable.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+import pyarrow as pa
+
+
+def _load_catalog(args):
+    from paimon_tpu.catalog.catalog import create_catalog
+    opts = {}
+    if getattr(args, "config", None):
+        with open(args.config) as f:
+            opts.update(json.load(f))
+    if getattr(args, "warehouse", None):
+        opts["warehouse"] = args.warehouse
+    if not opts.get("warehouse") and os.environ.get("PAIMON_WAREHOUSE"):
+        opts["warehouse"] = os.environ["PAIMON_WAREHOUSE"]
+    if not opts:
+        raise SystemExit("no catalog configured: pass --warehouse, "
+                         "--config, or set PAIMON_WAREHOUSE")
+    return create_catalog(opts)
+
+
+def _print_table(t: pa.Table, fmt: str, out=None):
+    out = out or sys.stdout
+    if fmt == "json":
+        for row in t.to_pylist():
+            out.write(json.dumps(row, default=str) + "\n")
+        return
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        buf = pa.BufferOutputStream()
+        pacsv.write_csv(t, buf)
+        out.write(buf.getvalue().to_pybytes().decode())
+        return
+    # plain aligned text table
+    cols = t.column_names
+    rows = [[("" if v is None else str(v)) for v in row.values()]
+            for row in t.to_pylist()]
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out.write(line + "\n")
+    out.write("|" + "|".join(f" {c.ljust(w)} "
+                             for c, w in zip(cols, widths)) + "|\n")
+    out.write(line + "\n")
+    for r in rows:
+        out.write("|" + "|".join(f" {v.ljust(w)} "
+                                 for v, w in zip(r, widths)) + "|\n")
+    out.write(line + "\n")
+    out.write(f"{t.num_rows} row(s)\n")
+
+
+def _table(catalog, name: str):
+    from paimon_tpu.catalog.catalog import Identifier
+    if "." not in name:
+        raise SystemExit(f"table must be db.table, got {name!r}")
+    return catalog.get_table(Identifier.parse(name))
+
+
+# -- subcommand handlers ----------------------------------------------------
+
+def cmd_db(args):
+    catalog = _load_catalog(args)
+    if args.db_cmd == "list":
+        for d in sorted(catalog.list_databases()):
+            print(d)
+    elif args.db_cmd == "create":
+        catalog.create_database(args.name, ignore_if_exists=args.if_not_exists)
+        print("OK")
+    elif args.db_cmd == "drop":
+        catalog.drop_database(args.name, ignore_if_not_exists=True,
+                              cascade=getattr(args, "cascade", False))
+        print("OK")
+
+
+def cmd_table(args):
+    catalog = _load_catalog(args)
+    cmd = args.table_cmd
+    if cmd == "list":
+        for t in sorted(catalog.list_tables(args.database)):
+            print(t)
+        return
+    table = None
+    if cmd == "get":
+        table = _table(catalog, args.table)
+        schema = table.schema
+        info = {
+            "name": args.table,
+            "fields": [{"name": f.name, "type": str(f.type),
+                        "comment": getattr(f, "description", None)}
+                       for f in schema.fields],
+            "primary_keys": schema.primary_keys,
+            "partition_keys": schema.partition_keys,
+            "options": schema.options,
+        }
+        print(json.dumps(info, indent=2, default=str))
+    elif cmd == "read":
+        table = _table(catalog, args.table)
+        from paimon_tpu import predicate as P  # noqa: F401
+        projection = args.columns.split(",") if args.columns else None
+        out = table.to_arrow(projection=projection)
+        if args.limit:
+            out = out.slice(0, args.limit)
+        _print_table(out, args.format)
+    elif cmd == "snapshot":
+        table = _table(catalog, args.table)
+        snap = table.latest_snapshot()
+        if snap is None:
+            print("no snapshots")
+        else:
+            print(snap.to_json())
+    elif cmd == "snapshots":
+        table = _table(catalog, args.table)
+        _print_table(table.system_table("snapshots"), args.format)
+    elif cmd == "create":
+        from paimon_tpu.catalog.catalog import Identifier
+        from paimon_tpu.schema import Schema
+        from paimon_tpu.types import parse_data_type
+        b = Schema.builder()
+        for coldef in args.column:
+            name, _, typ = coldef.partition(":")
+            b.column(name, parse_data_type(typ or "STRING"))
+        if args.primary_key:
+            b.primary_key(*args.primary_key.split(","))
+        if args.partition_by:
+            b.partition_keys(*args.partition_by.split(","))
+        for opt in args.option or []:
+            k, _, v = opt.partition("=")
+            b.option(k, v)
+        catalog.create_table(Identifier.parse(args.table), b.build(),
+                             ignore_if_exists=args.if_not_exists)
+        print("OK")
+    elif cmd == "drop":
+        from paimon_tpu.catalog.catalog import Identifier
+        catalog.drop_table(Identifier.parse(args.table),
+                           ignore_if_not_exists=True)
+        print("OK")
+    elif cmd == "rename":
+        from paimon_tpu.catalog.catalog import Identifier
+        catalog.rename_table(Identifier.parse(args.table),
+                             Identifier.parse(args.to))
+        print("OK")
+    elif cmd == "compact":
+        table = _table(catalog, args.table)
+        sid = table.compact(full=args.full)
+        print(f"snapshot {sid}" if sid else "nothing to do")
+    elif cmd == "import":
+        table = _table(catalog, args.table)
+        path = args.file
+        if path.endswith(".csv"):
+            import pyarrow.csv as pacsv
+            data = pacsv.read_csv(path)
+        elif path.endswith(".json") or path.endswith(".jsonl"):
+            import pyarrow.json as pajson
+            data = pajson.read_json(path)
+        elif path.endswith(".parquet"):
+            import pyarrow.parquet as pq
+            data = pq.read_table(path)
+        else:
+            raise SystemExit(f"unsupported import format: {path}")
+        schema = table.arrow_schema()
+        data = data.select([c for c in data.column_names
+                            if c in schema.names]).cast(
+            pa.schema([schema.field(c) for c in data.column_names
+                       if c in schema.names]))
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_arrow(data)
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        print(f"{data.num_rows} rows imported")
+    elif cmd == "set-option":
+        from paimon_tpu.catalog.catalog import Identifier
+        from paimon_tpu.schema.schema_manager import SchemaChange
+        catalog.alter_table(Identifier.parse(args.table),
+                            [SchemaChange.set_option(args.key, args.value)])
+        print("OK")
+    elif cmd == "remove-option":
+        from paimon_tpu.catalog.catalog import Identifier
+        from paimon_tpu.schema.schema_manager import SchemaChange
+        catalog.alter_table(Identifier.parse(args.table),
+                            [SchemaChange.remove_option(args.key)])
+        print("OK")
+    elif cmd == "add-column":
+        from paimon_tpu.catalog.catalog import Identifier
+        from paimon_tpu.schema.schema_manager import SchemaChange
+        from paimon_tpu.types import parse_data_type
+        catalog.alter_table(
+            Identifier.parse(args.table),
+            [SchemaChange.add_column(args.name,
+                                     parse_data_type(args.type))])
+        print("OK")
+    elif cmd == "expire-snapshots":
+        table = _table(catalog, args.table)
+        n = table.expire_snapshots(retain_max=args.retain_max)
+        print(f"{n or 0} snapshots expired")
+
+
+def cmd_tag(args):
+    catalog = _load_catalog(args)
+    table = _table(catalog, args.table)
+    if args.tag_cmd == "list":
+        _print_table(table.system_table("tags"), args.format)
+    elif args.tag_cmd == "create":
+        table.create_tag(args.name, args.snapshot)
+        print("OK")
+    elif args.tag_cmd == "delete":
+        table.delete_tag(args.name)
+        print("OK")
+
+
+def cmd_branch(args):
+    catalog = _load_catalog(args)
+    table = _table(catalog, args.table)
+    if args.branch_cmd == "list":
+        _print_table(table.system_table("branches"), args.format)
+    elif args.branch_cmd == "create":
+        table.create_branch(args.name, args.tag)
+        print("OK")
+    elif args.branch_cmd == "delete":
+        table.delete_branch(args.name)
+        print("OK")
+    elif args.branch_cmd == "fast-forward":
+        table.fast_forward(args.name)
+        print("OK")
+
+
+def cmd_sql(args):
+    from paimon_tpu.sql import SQLContext
+    catalog = _load_catalog(args)
+    ctx = SQLContext(catalog, database=args.database)
+    if args.query:
+        out = ctx.sql(args.query)
+        _print_table(out, args.format)
+        return
+    # interactive REPL (reference cli_sql.py _interactive_repl)
+    print("paimon sql — ';' terminates a statement, exit/quit leaves")
+    buf: List[str] = []
+    while True:
+        try:
+            prompt = "paimon> " if not buf else "   ...> "
+            line = input(prompt)
+        except EOFError:
+            break
+        if not buf and line.strip().lower() in ("exit", "quit", "\\q"):
+            break
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            query = "\n".join(buf).rstrip().rstrip(";")
+            buf = []
+            if not query.strip():
+                continue
+            try:
+                _print_table(ctx.sql(query), args.format)
+            except Exception as e:                 # noqa: BLE001
+                print(f"error: {e}", file=sys.stderr)
+
+
+# -- parser -----------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paimon", description="paimon_tpu command line interface")
+    p.add_argument("--warehouse", "-w", help="filesystem warehouse path")
+    p.add_argument("--config", "-c", help="JSON file of catalog options")
+    p.add_argument("--format", "-f", default="table",
+                   choices=["table", "csv", "json"], help="output format")
+    sub = p.add_subparsers(dest="command")
+
+    db = sub.add_parser("db", help="database operations")
+    dbsub = db.add_subparsers(dest="db_cmd", required=True)
+    dbsub.add_parser("list")
+    c = dbsub.add_parser("create")
+    c.add_argument("name")
+    c.add_argument("--if-not-exists", action="store_true")
+    c = dbsub.add_parser("drop")
+    c.add_argument("name")
+    c.add_argument("--cascade", action="store_true")
+    db.set_defaults(func=cmd_db)
+
+    t = sub.add_parser("table", help="table operations")
+    tsub = t.add_subparsers(dest="table_cmd", required=True)
+    c = tsub.add_parser("list")
+    c.add_argument("database")
+    c = tsub.add_parser("get")
+    c.add_argument("table")
+    c = tsub.add_parser("read")
+    c.add_argument("table")
+    c.add_argument("--columns", help="comma-separated projection")
+    c.add_argument("--limit", type=int)
+    c = tsub.add_parser("snapshot")
+    c.add_argument("table")
+    c = tsub.add_parser("snapshots")
+    c.add_argument("table")
+    c = tsub.add_parser("create")
+    c.add_argument("table")
+    c.add_argument("--column", action="append", default=[],
+                   metavar="NAME:TYPE", help="repeatable column def")
+    c.add_argument("--primary-key")
+    c.add_argument("--partition-by")
+    c.add_argument("--option", action="append", metavar="K=V")
+    c.add_argument("--if-not-exists", action="store_true")
+    c = tsub.add_parser("drop")
+    c.add_argument("table")
+    c = tsub.add_parser("rename")
+    c.add_argument("table")
+    c.add_argument("to")
+    c = tsub.add_parser("compact")
+    c.add_argument("table")
+    c.add_argument("--full", action="store_true")
+    c = tsub.add_parser("import")
+    c.add_argument("table")
+    c.add_argument("file", help="csv/json/parquet file")
+    c = tsub.add_parser("set-option")
+    c.add_argument("table")
+    c.add_argument("key")
+    c.add_argument("value")
+    c = tsub.add_parser("remove-option")
+    c.add_argument("table")
+    c.add_argument("key")
+    c = tsub.add_parser("add-column")
+    c.add_argument("table")
+    c.add_argument("name")
+    c.add_argument("type")
+    c = tsub.add_parser("expire-snapshots")
+    c.add_argument("table")
+    c.add_argument("--retain-max", type=int)
+    t.set_defaults(func=cmd_table)
+
+    tg = sub.add_parser("tag", help="tag operations")
+    tgsub = tg.add_subparsers(dest="tag_cmd", required=True)
+    c = tgsub.add_parser("list")
+    c.add_argument("table")
+    c = tgsub.add_parser("create")
+    c.add_argument("table")
+    c.add_argument("name")
+    c.add_argument("--snapshot", type=int)
+    c = tgsub.add_parser("delete")
+    c.add_argument("table")
+    c.add_argument("name")
+    tg.set_defaults(func=cmd_tag)
+
+    br = sub.add_parser("branch", help="branch operations")
+    brsub = br.add_subparsers(dest="branch_cmd", required=True)
+    c = brsub.add_parser("list")
+    c.add_argument("table")
+    c = brsub.add_parser("create")
+    c.add_argument("table")
+    c.add_argument("name")
+    c.add_argument("--tag")
+    c = brsub.add_parser("delete")
+    c.add_argument("table")
+    c.add_argument("name")
+    c = brsub.add_parser("fast-forward")
+    c.add_argument("table")
+    c.add_argument("name")
+    br.set_defaults(func=cmd_branch)
+
+    s = sub.add_parser("sql", help="run SQL (or start a REPL)")
+    s.add_argument("query", nargs="?", help="statement; omit for a REPL")
+    s.add_argument("--database", "-d", default="default")
+    s.set_defaults(func=cmd_sql)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 0
+    try:
+        args.func(args)
+    except SystemExit as e:
+        if isinstance(e.code, int):
+            return e.code
+        print(f"error: {e.code}", file=sys.stderr)
+        return 1
+    except Exception as e:                         # noqa: BLE001
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

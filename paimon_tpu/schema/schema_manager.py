@@ -117,9 +117,12 @@ class SchemaManager:
             raise RuntimeError("Concurrent table creation detected")
         return ts
 
-    def commit_changes(self, *changes: SchemaChange) -> TableSchema:
+    def commit_changes(self, *changes) -> TableSchema:
         """Apply DDL with optimistic retry (reference
-        SchemaManager.commitChanges)."""
+        SchemaManager.commitChanges).  Accepts either varargs of
+        SchemaChange or a single list/tuple of them."""
+        if len(changes) == 1 and isinstance(changes[0], (list, tuple)):
+            changes = tuple(changes[0])
         while True:
             latest = self.latest()
             if latest is None:

@@ -1,0 +1,1121 @@
+"""SQL execution over Arrow compute with predicate pushdown into scans.
+
+`SQLContext` is the analog of the reference's DataFusion-backed
+SQLContext (pypaimon/sql/__init__.py) and of the statement surface the
+JVM engines expose.  Queries compile to pyarrow.compute kernels; WHERE
+conjuncts that mention a single base-table column with literals are
+converted to paimon predicates and pushed into the scan (manifest/stats/
+index pruning), with the full WHERE re-applied on the decoded batch so
+pushdown is purely an optimization.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from paimon_tpu import predicate as P
+from paimon_tpu.catalog.catalog import Catalog, Identifier
+from paimon_tpu.schema import Schema
+from paimon_tpu.schema.schema_manager import SchemaChange
+from paimon_tpu.sql import parser as ast
+from paimon_tpu.sql.parser import SQLError, parse
+from paimon_tpu.types import parse_data_type
+
+_AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+
+
+def _result(rows: List[str], name: str = "result") -> pa.Table:
+    return pa.table({name: pa.array(rows, pa.string())})
+
+
+class Scope:
+    """A resolved relation: an Arrow table whose columns are internally
+    qualified ("alias.col"), plus the bare-name resolution map."""
+
+    def __init__(self, table: pa.Table, order: List[str]):
+        self.table = table
+        self.order = order                      # qualified names, in order
+        self.bare: Dict[str, List[str]] = {}
+        for q in order:
+            bare = q.split(".", 1)[1] if "." in q else q
+            self.bare.setdefault(bare, []).append(q)
+
+    def resolve(self, col: ast.Column) -> str:
+        if col.qualifier:
+            q = f"{col.qualifier}.{col.name}"
+            if q in self.table.column_names:
+                return q
+            raise SQLError(f"unknown column {q}")
+        cands = self.bare.get(col.name, [])
+        if len(cands) == 1:
+            return cands[0]
+        if not cands:
+            raise SQLError(f"unknown column {col.name!r}")
+        raise SQLError(f"ambiguous column {col.name!r}: {cands}")
+
+
+class Compiler:
+    """Compile AST expressions to Arrow arrays against a Scope.  When
+    `subst` is set (post-aggregation), any sub-expression whose repr is a
+    key in it resolves to that column instead of being re-evaluated."""
+
+    def __init__(self, scope: Scope, subst: Optional[Dict[str, str]] = None):
+        self.scope = scope
+        self.subst = subst or {}
+
+    def _rows(self) -> int:
+        return self.scope.table.num_rows
+
+    def compile(self, e) -> Any:
+        if self.subst:
+            key = repr(e)
+            if key in self.subst:
+                return self.scope.table.column(self.subst[key])
+        return self._compile(e)
+
+    def as_array(self, e) -> pa.ChunkedArray:
+        v = self.compile(e)
+        if isinstance(v, (pa.ChunkedArray, pa.Array)):
+            return v
+        if not isinstance(v, pa.Scalar):
+            v = pa.scalar(v)
+        # broadcast a scalar expression across the relation
+        if v.type == pa.null():
+            return pa.nulls(self._rows())
+        return pa.chunked_array([pa.repeat(v, self._rows())])
+
+    def _compile(self, e) -> Any:
+        if isinstance(e, ast.Literal):
+            return pa.scalar(e.value)
+        if isinstance(e, ast.Column):
+            return self.scope.table.column(self.scope.resolve(e))
+        if isinstance(e, ast.Unary):
+            v = self.compile(e.operand)
+            return pc.invert(v) if e.op == "NOT" else pc.negate(v)
+        if isinstance(e, ast.Binary):
+            return self._binary(e)
+        if isinstance(e, ast.IsNull):
+            v = self.as_array(e.expr)
+            return pc.is_valid(v) if e.negated else pc.is_null(v)
+        if isinstance(e, ast.InList):
+            v = self.as_array(e.expr)
+            vals = [self._literal(x) for x in e.values]
+            res = pc.is_in(v, value_set=pa.array(vals))
+            return pc.invert(res) if e.negated else res
+        if isinstance(e, ast.BetweenExpr):
+            v = self.compile(e.expr)
+            res = pc.and_kleene(
+                pc.greater_equal(v, self.compile(e.lo)),
+                pc.less_equal(v, self.compile(e.hi)))
+            return pc.invert(res) if e.negated else res
+        if isinstance(e, ast.LikeExpr):
+            res = pc.match_like(self.as_array(e.expr), e.pattern)
+            return pc.invert(res) if e.negated else res
+        if isinstance(e, ast.Case):
+            return self._case(e)
+        if isinstance(e, ast.Cast):
+            from paimon_tpu.data.casting import cast_array
+            from paimon_tpu.types import data_type_from_arrow
+            arr = self.as_array(e.expr)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            src = data_type_from_arrow(arr.type)
+            return cast_array(arr, src, parse_data_type(e.type_str))
+        if isinstance(e, ast.Func):
+            return self._func(e)
+        if isinstance(e, ast.Star):
+            raise SQLError("* is only valid in SELECT items and COUNT(*)")
+        raise SQLError(f"cannot evaluate expression: {e!r}")
+
+    def _literal(self, e) -> Any:
+        if isinstance(e, ast.Literal):
+            return e.value
+        if isinstance(e, ast.Unary) and e.op == "NEG" and \
+                isinstance(e.operand, ast.Literal):
+            return -e.operand.value
+        raise SQLError(f"expected a literal, got {e!r}")
+
+    def _binary(self, e: ast.Binary):
+        op = e.op
+        if op in ("AND", "OR"):
+            l_, r_ = self.compile(e.left), self.compile(e.right)
+            return (pc.and_kleene if op == "AND" else pc.or_kleene)(l_, r_)
+        if op == "||":
+            l_, r_ = self.as_array(e.left), self.as_array(e.right)
+            return pc.binary_join_element_wise(
+                pc.cast(l_, pa.string()), pc.cast(r_, pa.string()), "")
+        l_, r_ = self.compile(e.left), self.compile(e.right)
+        fn = {"+": pc.add, "-": pc.subtract, "*": pc.multiply,
+              "/": pc.divide, "%": lambda a, b: pc.subtract(
+                  a, pc.multiply(pc.cast(pc.divide(a, b), pa.int64()), b)),
+              "=": pc.equal, "<>": pc.not_equal, "<": pc.less,
+              "<=": pc.less_equal, ">": pc.greater,
+              ">=": pc.greater_equal}.get(op)
+        if fn is None:
+            raise SQLError(f"unsupported operator {op}")
+        return fn(l_, r_)
+
+    def _case(self, e: ast.Case):
+        result = self.as_array(e.default) if e.default is not None \
+            else pa.nulls(self._rows())
+        for cond, val in reversed(e.whens):
+            c = self.as_array(cond)
+            result = pc.if_else(pc.fill_null(c, False),
+                                self.as_array(val), result)
+        return result
+
+    def _func(self, e: ast.Func):
+        name, args = e.name, e.args
+        if name in _AGG_FUNCS:
+            raise SQLError(f"aggregate {name}() not allowed here")
+        a = [self.compile(x) for x in args]
+        if name == "abs":
+            return pc.abs(a[0])
+        if name == "upper":
+            return pc.utf8_upper(a[0])
+        if name == "lower":
+            return pc.utf8_lower(a[0])
+        if name in ("length", "char_length"):
+            return pc.utf8_length(a[0])
+        if name == "trim":
+            return pc.utf8_trim_whitespace(a[0])
+        if name == "concat":
+            arrs = [pc.cast(self.as_array(x), pa.string()) for x in args]
+            return pc.binary_join_element_wise(*arrs, "")
+        if name == "coalesce":
+            # NULL literals (type null) never contribute a value
+            live = [x for x in a if x.type != pa.null()]
+            if not live:
+                return pa.nulls(self._rows())
+            return live[0] if len(live) == 1 else pc.coalesce(*live)
+        if name == "nullif":
+            return pc.if_else(pc.fill_null(pc.equal(a[0], a[1]), False),
+                              pa.nulls(self._rows()), self.as_array(args[0]))
+        if name == "round":
+            nd = self._literal(args[1]) if len(args) > 1 else 0
+            return pc.round(a[0], ndigits=nd)
+        if name == "floor":
+            return pc.floor(a[0])
+        if name == "ceil":
+            return pc.ceil(a[0])
+        if name == "sqrt":
+            return pc.sqrt(a[0])
+        if name == "power":
+            return pc.power(a[0], a[1])
+        if name in ("substr", "substring"):
+            start = self._literal(args[1]) - 1       # SQL is 1-based
+            stop = start + self._literal(args[2]) if len(args) > 2 else None
+            return pc.utf8_slice_codeunits(a[0], start, stop)
+        if name == "replace":
+            return pc.replace_substring(a[0],
+                                        pattern=self._literal(args[1]),
+                                        replacement=self._literal(args[2]))
+        if name in ("year", "month", "day", "hour", "minute", "second"):
+            return getattr(pc, name)(a[0])
+        if name == "if":
+            return pc.if_else(pc.fill_null(self.as_array(args[0]), False),
+                              self.as_array(args[1]), self.as_array(args[2]))
+        raise SQLError(f"unknown function {name}()")
+
+
+# ---------------------------------------------------------------------------
+# WHERE -> paimon predicate pushdown
+# ---------------------------------------------------------------------------
+
+def _flip(op: str) -> str:
+    return {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+            "=": "=", "<>": "<>"}[op]
+
+
+def expr_to_predicate(e, scope: Scope, base_qualifier: str
+                      ) -> Optional[P.Predicate]:
+    """Convert an expression into a paimon Predicate over bare column
+    names of the base table, or None when any part is not convertible
+    (the full WHERE is still evaluated after decode, so None just means
+    no pruning from this subtree)."""
+
+    def bare(col: ast.Column) -> Optional[str]:
+        try:
+            q = scope.resolve(col)
+        except SQLError:
+            return None
+        qual, _, name = q.rpartition(".")
+        return name if qual == base_qualifier else None
+
+    def lit(x) -> Tuple[bool, Any]:
+        if isinstance(x, ast.Literal):
+            return True, x.value
+        if isinstance(x, ast.Unary) and x.op == "NEG" and \
+                isinstance(x.operand, ast.Literal):
+            return True, -x.operand.value
+        return False, None
+
+    def conv(e) -> Optional[P.Predicate]:
+        if isinstance(e, ast.Binary) and e.op in ("AND", "OR"):
+            l_, r_ = conv(e.left), conv(e.right)
+            if e.op == "AND":
+                if l_ is not None and r_ is not None:
+                    return P.and_(l_, r_)
+                return l_ if l_ is not None else r_   # partial AND prunes
+            if l_ is not None and r_ is not None:     # OR needs both arms
+                return P.or_(l_, r_)
+            return None
+        if isinstance(e, ast.Unary) and e.op == "NOT":
+            # NOT over AND/OR is never pushed: conv() may convert those
+            # subtrees PARTIALLY (a pruning subset), and negating a
+            # subset over-prunes.  Simple leaves convert exactly, so
+            # their negation is sound.
+            if isinstance(e.operand, ast.Binary) and \
+                    e.operand.op in ("AND", "OR"):
+                return None
+            inner = conv(e.operand)
+            if inner is not None and isinstance(e.operand,
+                                                (ast.Binary, ast.IsNull,
+                                                 ast.InList, ast.LikeExpr,
+                                                 ast.BetweenExpr)):
+                return P.not_(inner)
+            return None
+        if isinstance(e, ast.Binary):
+            left_col = isinstance(e.left, ast.Column)
+            right_col = isinstance(e.right, ast.Column)
+            if left_col and not right_col:
+                ok, v = lit(e.right)
+                f = bare(e.left)
+                if ok and f:
+                    return _leaf(e.op, f, v)
+            elif right_col and not left_col:
+                ok, v = lit(e.left)
+                f = bare(e.right)
+                if ok and f:
+                    return _leaf(_flip(e.op), f, v)
+            return None
+        if isinstance(e, ast.IsNull):
+            if isinstance(e.expr, ast.Column):
+                f = bare(e.expr)
+                if f:
+                    return P.is_not_null(f) if e.negated else P.is_null(f)
+            return None
+        if isinstance(e, ast.InList):
+            if isinstance(e.expr, ast.Column):
+                f = bare(e.expr)
+                vals = []
+                for x in e.values:
+                    ok, v = lit(x)
+                    if not ok:
+                        return None
+                    vals.append(v)
+                if f:
+                    return P.not_in(f, vals) if e.negated \
+                        else P.in_(f, vals)
+            return None
+        if isinstance(e, ast.BetweenExpr):
+            if isinstance(e.expr, ast.Column):
+                f = bare(e.expr)
+                ok1, lo = lit(e.lo)
+                ok2, hi = lit(e.hi)
+                if f and ok1 and ok2:
+                    b = P.between(f, lo, hi)
+                    return P.not_(b) if e.negated else b
+            return None
+        if isinstance(e, ast.LikeExpr) and not e.negated:
+            if isinstance(e.expr, ast.Column):
+                f = bare(e.expr)
+                m = re.fullmatch(r"([^%_]*)%", e.pattern)
+                if f and m:
+                    return P.starts_with(f, m.group(1))
+            return None
+        return None
+
+    def _leaf(op, f, v):
+        return {"=": P.equal, "<>": P.not_equal, "<": P.less_than,
+                "<=": P.less_or_equal, ">": P.greater_than,
+                ">=": P.greater_or_equal}[op](f, v)
+
+    return conv(e)
+
+
+# ---------------------------------------------------------------------------
+# SQLContext
+# ---------------------------------------------------------------------------
+
+class SQLContext:
+    """Run SQL against a catalog.  `sql()` returns a pyarrow Table for
+    queries; DDL/DML return a one-column result table."""
+
+    def __init__(self, catalog: Catalog, database: str = "default"):
+        self.catalog = catalog
+        self.database = database
+        self._views: Dict[str, pa.Table] = {}
+
+    # -- public -------------------------------------------------------------
+    def register(self, name: str, table: pa.Table):
+        """Register an in-memory Arrow table as a queryable view."""
+        self._views[name] = table
+
+    def sql(self, query: str) -> pa.Table:
+        stmt = parse(query)
+        handler = {
+            ast.Select: self._exec_select_stmt,
+            ast.Explain: self._exec_explain,
+            ast.Insert: self._exec_insert,
+            ast.CreateTable: self._exec_create_table,
+            ast.CreateDatabase: self._exec_create_database,
+            ast.DropTable: self._exec_drop_table,
+            ast.DropDatabase: self._exec_drop_database,
+            ast.ShowTables: self._exec_show_tables,
+            ast.ShowDatabases: self._exec_show_databases,
+            ast.ShowCreateTable: self._exec_show_create,
+            ast.Describe: self._exec_describe,
+            ast.Use: self._exec_use,
+            ast.Delete: self._exec_delete,
+            ast.Update: self._exec_update,
+            ast.AlterTable: self._exec_alter,
+            ast.Call: self._exec_call,
+        }.get(type(stmt))
+        if handler is None:
+            raise SQLError(f"unsupported statement {type(stmt).__name__}")
+        return handler(stmt)
+
+    # -- helpers ------------------------------------------------------------
+    def _ident(self, name: str) -> Identifier:
+        if "." in name:
+            db, t = name.split(".", 1)
+            return Identifier(db, t)
+        return Identifier(self.database, name)
+
+    def _load_relation(self, ref: ast.TableRef) -> Tuple[pa.Table, str]:
+        """Resolve a table reference to (arrow table, qualifier)."""
+        alias = ref.alias or ref.name.split(".")[-1]
+        if ref.name in self._views:
+            return self._views[ref.name], alias
+        name = ref.name
+        system = None
+        if "$" in name.split(".")[-1]:
+            base, system = name.rsplit("$", 1)
+            name = base
+            alias = ref.alias or f"{base.split('.')[-1]}${system}"
+        table = self.catalog.get_table(self._ident(name))
+        dyn: Dict[str, str] = {}
+        if ref.snapshot_id is not None:
+            dyn["scan.snapshot-id"] = str(ref.snapshot_id)
+        if ref.tag is not None:
+            dyn["scan.tag-name"] = ref.tag
+        if ref.timestamp_ms is not None:
+            dyn["scan.timestamp-millis"] = str(ref.timestamp_ms)
+        if dyn:
+            table = table.copy(dyn)
+        if system is not None:
+            return table.system_table(system), alias
+        return table, alias
+
+    def _scan_base(self, ref: ast.TableRef, select: ast.Select,
+                   collect_plan: Optional[dict] = None) -> Scope:
+        """Scan the FROM base table with WHERE pushdown."""
+        rel, alias = self._load_relation(ref)
+        pushed = None
+        if isinstance(rel, pa.Table):
+            out = rel
+        else:
+            table = rel
+            if select.where is not None and not select.joins:
+                cols = [f.name for f in table.row_type().fields]
+                probe = _probe_scope(cols, alias)
+                pushed = expr_to_predicate(select.where, probe, alias)
+            out = table.to_arrow(predicate=pushed)
+        if collect_plan is not None:
+            collect_plan["pushed"] = repr(pushed) if pushed is not None \
+                else None
+        qualified = out.rename_columns(
+            [f"{alias}.{c}" for c in out.column_names])
+        return Scope(qualified, list(qualified.column_names))
+
+    def _relation_scope(self, ref, select: ast.Select,
+                        collect_plan: Optional[dict] = None) -> Scope:
+        if isinstance(ref, ast.SubqueryRef):
+            sub = self._exec_select(ref.select)
+            q = sub.rename_columns(
+                [f"{ref.alias}.{c}" for c in sub.column_names])
+            return Scope(q, list(q.column_names))
+        if isinstance(ref, ast.TableRef):
+            rel, alias = self._load_relation(ref)
+            if isinstance(rel, pa.Table):
+                q = rel.rename_columns(
+                    [f"{alias}.{c}" for c in rel.column_names])
+                return Scope(q, list(q.column_names))
+            return self._scan_base(ref, select, collect_plan)
+        raise SQLError(f"unsupported FROM item {ref!r}")
+
+    # -- SELECT -------------------------------------------------------------
+    def _exec_select_stmt(self, s: ast.Select) -> pa.Table:
+        return self._exec_select(s)
+
+    def _exec_select(self, s: ast.Select,
+                     collect_plan: Optional[dict] = None) -> pa.Table:
+        if s.union_all is not None:
+            left = self._exec_select(
+                ast.Select(s.items, s.from_, s.joins, s.where, s.group_by,
+                           s.having, [], None, None, s.distinct))
+            right = self._exec_select(s.union_all)
+            right = right.rename_columns(left.column_names)
+            out = pa.concat_tables(
+                [left, right.cast(left.schema)], promote_options="none")
+            # trailing ORDER BY / LIMIT bind to the whole union
+            if s.order_by:
+                keys = []
+                for e, asc, pl in s.order_by:
+                    direction = "ascending" if asc else "descending"
+                    if isinstance(e, ast.Literal) and \
+                            isinstance(e.value, int):
+                        name = out.column_names[
+                            _ordinal(e.value, out.num_columns) - 1]
+                    elif isinstance(e, ast.Column) and \
+                            e.qualifier is None and \
+                            e.name in out.column_names:
+                        name = e.name
+                    else:
+                        raise SQLError("ORDER BY over a UNION must "
+                                       "reference output columns")
+                    keys.append((name, direction, pl))
+                out = out.take(pc.sort_indices(out, sort_keys=keys))
+            if s.limit is not None:
+                out = out.slice(s.offset or 0, s.limit)
+            elif s.offset:
+                out = out.slice(s.offset)
+            return out
+        if s.from_ is None:
+            scope = Scope(pa.table({"__dual": pa.array([0])}), ["__dual"])
+            comp = Compiler(scope)
+            cols, names = [], []
+            for item in s.items:
+                names.append(item.alias or _display_name(item.expr))
+                cols.append(comp.as_array(item.expr))
+            return pa.table(dict(zip(names, cols)))
+
+        scope = self._relation_scope(s.from_, s, collect_plan)
+        for j in s.joins:
+            scope = self._join(scope, j, s)
+        # full WHERE on the decoded relation (pushdown already pruned)
+        if s.where is not None:
+            mask = Compiler(scope).as_array(s.where)
+            scope = Scope(scope.table.filter(pc.fill_null(mask, False)),
+                          scope.order)
+
+        has_agg = any(_find_aggs(i.expr) for i in s.items) or \
+            (s.having is not None and _find_aggs(s.having)) or s.group_by
+        if s.having is not None and not has_agg:
+            raise SQLError("HAVING requires GROUP BY or an aggregate; "
+                           "use WHERE for row filters")
+        if has_agg:
+            out = self._aggregate(scope, s)
+        else:
+            out = self._project(scope, s, subst=None)
+        if s.distinct:
+            out = out.group_by(out.column_names,
+                               use_threads=False).aggregate([])
+        if s.limit is not None:
+            off = s.offset or 0
+            out = out.slice(off, s.limit)
+        elif s.offset:
+            out = out.slice(s.offset)
+        return out
+
+    def _join(self, left: Scope, j: ast.JoinClause, s: ast.Select) -> Scope:
+        right = self._relation_scope(j.right, s)
+        lt, rt = left.table, right.table
+        if j.kind == "cross":
+            lk = lt.append_column("__cj", pa.array([1] * lt.num_rows))
+            rk = rt.append_column("__cj", pa.array([1] * rt.num_rows))
+            out = lk.join(rk, keys=["__cj"], join_type="inner")
+            out = out.drop_columns(["__cj"])
+            return Scope(out, left.order + right.order)
+        if j.condition is None:
+            raise SQLError(f"{j.kind} JOIN requires ON")
+        # split ON into equi-conjuncts (one side each) + residual
+        probe_cols = {q: pa.array([], lt.column(q).type)
+                      for q in left.order}
+        probe_cols.update({q: pa.array([], rt.column(q).type)
+                           for q in right.order})
+        probe = Scope(pa.table(probe_cols), left.order + right.order)
+        equi, residual = [], []
+        for conj in _split_conjuncts(j.condition):
+            pair = _equi_pair(conj, probe, left, right)
+            if pair:
+                equi.append(pair)
+            else:
+                residual.append(conj)
+        if not equi:
+            raise SQLError("JOIN ON requires at least one equality "
+                           "between the two sides")
+        # join on temp key copies so both sides' original (qualified)
+        # columns survive Arrow's key coalescing
+        order = left.order + right.order
+        # residual (non-equi) ON conditions participate in the MATCH:
+        # for outer joins, run an inner join + residual filter, then add
+        # back unmatched rows null-padded — filtering the outer result
+        # would wrongly drop its null rows
+        aug = bool(residual) and j.kind != "inner"
+        if aug:
+            import numpy as np
+            lt = lt.append_column("__lrow",
+                                  pa.array(np.arange(lt.num_rows)))
+            rt = rt.append_column("__rrow",
+                                  pa.array(np.arange(rt.num_rows)))
+        for i, (lq, rq) in enumerate(equi):
+            lt = lt.append_column(f"__jk{i}", lt.column(lq))
+            rt = rt.append_column(f"__jk{i}", rt.column(rq))
+        jk = [f"__jk{i}" for i in range(len(equi))]
+        out = lt.join(rt, keys=jk, join_type="inner" if aug else j.kind,
+                      coalesce_keys=True)
+        out = out.drop_columns(jk)
+        keep = order + (["__lrow", "__rrow"] if aug else [])
+        out = out.select(keep)        # Arrow join may reorder columns
+        if residual:
+            mask = None
+            comp = Compiler(Scope(out, keep))
+            for conj in residual:
+                m = comp.as_array(conj)
+                mask = m if mask is None else pc.and_kleene(mask, m)
+            out = out.filter(pc.fill_null(mask, False))
+        if aug:
+            import numpy as np
+            parts = [out.select(order)]
+            if j.kind in ("left outer", "full outer"):
+                miss = ~np.isin(np.arange(lt.num_rows),
+                                np.asarray(out.column("__lrow")))
+                missing = lt.filter(pa.array(miss))
+                pad = {q: missing.column(q) for q in left.order}
+                pad.update({q: pa.nulls(missing.num_rows,
+                                        rt.column(q).type)
+                            for q in right.order})
+                parts.append(pa.table(pad).select(order))
+            if j.kind in ("right outer", "full outer"):
+                miss = ~np.isin(np.arange(rt.num_rows),
+                                np.asarray(out.column("__rrow")))
+                missing = rt.filter(pa.array(miss))
+                pad = {q: pa.nulls(missing.num_rows, lt.column(q).type)
+                       for q in left.order}
+                pad.update({q: missing.column(q) for q in right.order})
+                parts.append(pa.table(pad).select(order))
+            out = pa.concat_tables(parts, promote_options="none")
+        else:
+            out = out.select(order)
+        return Scope(out, order)
+
+    def _project(self, scope: Scope, s: ast.Select,
+                 subst: Optional[Dict[str, str]]) -> pa.Table:
+        comp = Compiler(scope, subst)
+        names: List[str] = []
+        cols: List[Any] = []
+        for item in s.items:
+            if isinstance(item.expr, ast.Star):
+                q = item.expr.qualifier
+                for qual_name in scope.order:
+                    if qual_name.startswith("__"):
+                        continue
+                    qualifier, _, bare = qual_name.rpartition(".")
+                    if q is None or qualifier == q:
+                        names.append(bare)
+                        cols.append(scope.table.column(qual_name))
+                continue
+            names.append(item.alias or _display_name(item.expr))
+            cols.append(comp.as_array(item.expr))
+        out = pa.table(dict(zip(_dedup(names), cols)))
+        if s.order_by:
+            out = self._order(out, scope, s, subst, names)
+        return out
+
+    def _order(self, out: pa.Table, scope: Scope, s: ast.Select,
+               subst: Optional[Dict[str, str]],
+               names: List[str]) -> pa.Table:
+        comp = Compiler(scope, subst)
+        sort_cols, keys = [], []
+        tmp = out
+        for idx, (e, asc, pl) in enumerate(s.order_by):
+            direction = "ascending" if asc else "descending"
+            if isinstance(e, ast.Literal) and isinstance(e.value, int):
+                pos = _ordinal(e.value, out.num_columns)
+                keys.append((out.column_names[pos - 1], direction, pl))
+                continue
+            if isinstance(e, ast.Column) and e.qualifier is None and \
+                    e.name in out.column_names:
+                keys.append((e.name, direction, pl))
+                continue
+            col = comp.as_array(e)
+            cn = f"__ord{idx}"
+            tmp = tmp.append_column(cn, col)
+            sort_cols.append(cn)
+            keys.append((cn, direction, pl))
+        idxs = pc.sort_indices(tmp, sort_keys=keys)
+        return tmp.take(idxs).drop_columns(sort_cols) if sort_cols \
+            else tmp.take(idxs)
+
+    def _aggregate(self, scope: Scope, s: ast.Select) -> pa.Table:
+        aggs: Dict[str, ast.Func] = {}
+        for item in s.items:
+            for f in _find_aggs(item.expr):
+                aggs.setdefault(repr(f), f)
+        if s.having is not None:
+            for f in _find_aggs(s.having):
+                aggs.setdefault(repr(f), f)
+        for e, _, _ in s.order_by:
+            for f in _find_aggs(e):
+                aggs.setdefault(repr(f), f)
+        comp = Compiler(scope)
+        work = scope.table
+        subst: Dict[str, str] = {}
+        for i, ge in enumerate(s.group_by):
+            cn = f"__g{i}"
+            # GROUP BY may name a select alias or a position
+            target = ge
+            if isinstance(ge, ast.Literal) and isinstance(ge.value, int):
+                target = s.items[_ordinal(ge.value, len(s.items)) - 1].expr
+            elif isinstance(ge, ast.Column) and ge.qualifier is None:
+                for item in s.items:
+                    if item.alias == ge.name:
+                        target = item.expr
+                        break
+            work = work.append_column(cn, comp.as_array(target))
+            subst[repr(target)] = cn
+            if repr(ge) != repr(target):
+                subst[repr(ge)] = cn
+        specs: List[Tuple[str, str]] = []
+        out_names: List[Tuple[str, str]] = []     # (arrow result, subst key)
+        for k, (key, f) in enumerate(aggs.items()):
+            cn = f"__a{k}"
+            if f.name == "count" and (not f.args or
+                                      isinstance(f.args[0], ast.Star)):
+                ones = pa.chunked_array(
+                    [pa.repeat(pa.scalar(1), work.num_rows)])
+                work = work.append_column(cn, ones)
+                specs.append((cn, "sum"))
+                out_names.append((f"{cn}_sum", key))
+                continue
+            work = work.append_column(cn, comp.as_array(f.args[0]))
+            if f.distinct:
+                fname = "count_distinct"
+            else:
+                fname = {"count": "count", "sum": "sum", "min": "min",
+                         "max": "max", "avg": "mean"}[f.name]
+            specs.append((cn, fname))
+            out_names.append((f"{cn}_{fname}", key))
+        if not s.group_by:
+            work = work.append_column("__gall",
+                                      pa.chunked_array(
+                                          [pa.repeat(pa.scalar(1),
+                                                     work.num_rows)]))
+            keys = ["__gall"]
+        else:
+            keys = [f"__g{i}" for i in range(len(s.group_by))]
+        gtable = work.group_by(keys, use_threads=False).aggregate(specs)
+        order = list(gtable.column_names)
+        if not s.group_by and gtable.num_rows == 0:
+            # a global aggregate over empty input still yields one row
+            # (counts become 0 below, other aggregates NULL)
+            gtable = pa.table({name: pa.nulls(1, gtable.column(name).type)
+                               for name in order})
+        # substitution: each aggregate expression (by structural repr)
+        # resolves to its arrow result column (e.g. "__a0_sum")
+        agg_subst = {key: name for name, key in out_names}
+        agg_subst.update(subst)
+        # count()/count(*) never return NULL — fill empty groups with 0
+        for key, f in aggs.items():
+            cn = agg_subst[key]
+            if f.name == "count":
+                filled = pc.fill_null(pc.cast(gtable.column(cn),
+                                              pa.int64()), 0)
+                gtable = gtable.set_column(
+                    gtable.column_names.index(cn), cn, filled)
+        gscope = Scope(gtable, order)
+        if s.having is not None:
+            mask = Compiler(gscope, agg_subst).as_array(s.having)
+            gtable = gtable.filter(pc.fill_null(mask, False))
+            gscope = Scope(gtable, order)
+        return self._project(gscope, s, subst=agg_subst)
+
+    # -- EXPLAIN ------------------------------------------------------------
+    def _exec_explain(self, e: ast.Explain) -> pa.Table:
+        info: dict = {}
+        s = e.select
+        lines = ["== Logical Plan =="]
+        if isinstance(s.from_, ast.TableRef):
+            self._relation_scope(s.from_, s, collect_plan=info)
+            lines.append(f"Scan: {s.from_.name}")
+            if info.get("pushed"):
+                lines.append(f"  pushed predicate: {info['pushed']}")
+            elif s.where is not None:
+                lines.append("  pushed predicate: none")
+        if s.where is not None:
+            lines.append(f"Filter: {s.where!r}")
+        for j in s.joins:
+            lines.append(f"Join[{j.kind}]: {j.condition!r}")
+        if s.group_by or any(_find_aggs(i.expr) for i in s.items):
+            lines.append(f"Aggregate: group_by={s.group_by!r}")
+        if s.order_by:
+            lines.append(f"Sort: {len(s.order_by)} key(s)")
+        if s.limit is not None:
+            lines.append(f"Limit: {s.limit}")
+        return _result(lines, "plan")
+
+    # -- DML ----------------------------------------------------------------
+    def _exec_insert(self, ins: ast.Insert) -> pa.Table:
+        table = self.catalog.get_table(self._ident(ins.table))
+        schema = table.arrow_schema()
+        if ins.select is not None:
+            data = self._exec_select(ins.select)
+            if ins.columns is None:
+                # positional mapping onto the table's leading fields
+                cols = [f.name for f in schema][:data.num_columns]
+                data = data.rename_columns(cols)
+            else:
+                cols = ins.columns
+        else:
+            scope = Scope(pa.table({"__dual": pa.array([0])}), ["__dual"])
+            comp = Compiler(scope)
+            n_cols = len(ins.rows[0])
+            cols = ins.columns or [f.name for f in schema][:n_cols]
+            arrays: List[List[Any]] = [[] for _ in range(n_cols)]
+            for row in ins.rows:
+                if len(row) != n_cols:
+                    raise SQLError("VALUES rows have inconsistent arity")
+                for i, cell in enumerate(row):
+                    v = comp.compile(cell)
+                    arrays[i].append(v.as_py() if isinstance(v, pa.Scalar)
+                                     else v)
+            data = pa.table({c: pa.array(vals)
+                             for c, vals in zip(cols, arrays)})
+        batch: Dict[str, pa.ChunkedArray] = {}
+        for field in schema:
+            if field.name in cols:
+                src = data.column(cols.index(field.name)) \
+                    if isinstance(data, pa.Table) else None
+                batch[field.name] = pc.cast(src, field.type)
+            else:
+                batch[field.name] = pa.nulls(data.num_rows, field.type)
+        out = pa.table(batch)
+        wb = table.new_batch_write_builder()
+        if ins.overwrite:
+            wb = wb.with_overwrite()
+        w = wb.new_write()
+        w.write_arrow(out)
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        return _result([f"{out.num_rows} rows inserted"])
+
+    def _exec_delete(self, d: ast.Delete) -> pa.Table:
+        table = self.catalog.get_table(self._ident(d.table))
+        if d.where is None:
+            raise SQLError("DELETE without WHERE is not supported; "
+                           "DROP TABLE or overwrite instead")
+        cols = [f.name for f in table.row_type().fields]
+        alias = d.table.split(".")[-1]
+        pred = expr_to_predicate(d.where, _probe_scope(cols, alias), alias)
+        if pred is None:
+            raise SQLError("DELETE WHERE must be expressible as column/"
+                           f"literal comparisons, got: {d.where!r}")
+        # delete_where returns a snapshot id; count matches for the
+        # rows-affected result with a pushdown scan projected to the
+        # predicate's own columns (the filter runs after projection)
+        count_cols = sorted(set(pred.fields())) or [cols[0]]
+        n = table.to_arrow(projection=count_cols, predicate=pred).num_rows
+        table.delete_where(pred)
+        return _result([f"{n} rows deleted"])
+
+    def _exec_update(self, u: ast.Update) -> pa.Table:
+        table = self.catalog.get_table(self._ident(u.table))
+        if not table.primary_keys:
+            raise SQLError("UPDATE requires a primary-key table")
+        alias = u.table.split(".")[-1]
+        sel = ast.Select(items=[ast.SelectItem(ast.Star())],
+                         from_=ast.TableRef(u.table, alias=alias),
+                         where=u.where)
+        matched = self._exec_select(sel)
+        if matched.num_rows == 0:
+            return _result(["0 rows updated"])
+        q = matched.rename_columns(
+            [f"{alias}.{c}" for c in matched.column_names])
+        scope = Scope(q, list(q.column_names))
+        comp = Compiler(scope)
+        out = matched
+        schema = table.arrow_schema()
+        for col, e in u.assignments:
+            if col in (table.partition_keys or []) or \
+                    col in table.primary_keys:
+                raise SQLError(f"cannot UPDATE key column {col!r}")
+            idx = out.column_names.index(col)
+            val = pc.cast(comp.as_array(e), schema.field(col).type)
+            out = out.set_column(idx, col, val)
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_arrow(out.cast(schema))
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        return _result([f"{out.num_rows} rows updated"])
+
+    # -- DDL ----------------------------------------------------------------
+    def _exec_create_table(self, c: ast.CreateTable) -> pa.Table:
+        b = Schema.builder()
+        for col in c.columns:
+            b.column(col.name, parse_data_type(col.type_str),
+                     description=col.comment)
+        if c.primary_key:
+            b.primary_key(*c.primary_key)
+        if c.partitioned_by:
+            b.partition_keys(*c.partitioned_by)
+        b.options(c.options)
+        if c.comment:
+            b.comment(c.comment)
+        self.catalog.create_table(self._ident(c.table), b.build(),
+                                  ignore_if_exists=c.if_not_exists)
+        return _result(["OK"])
+
+    def _exec_create_database(self, c: ast.CreateDatabase) -> pa.Table:
+        self.catalog.create_database(c.name,
+                                     ignore_if_exists=c.if_not_exists)
+        return _result(["OK"])
+
+    def _exec_drop_table(self, d: ast.DropTable) -> pa.Table:
+        self.catalog.drop_table(self._ident(d.table),
+                                ignore_if_not_exists=d.if_exists)
+        return _result(["OK"])
+
+    def _exec_drop_database(self, d: ast.DropDatabase) -> pa.Table:
+        self.catalog.drop_database(d.name,
+                                   ignore_if_not_exists=d.if_exists)
+        return _result(["OK"])
+
+    def _exec_show_tables(self, s: ast.ShowTables) -> pa.Table:
+        db = s.database or self.database
+        return pa.table({"table_name":
+                         pa.array(sorted(self.catalog.list_tables(db)))})
+
+    def _exec_show_databases(self, s: ast.ShowDatabases) -> pa.Table:
+        return pa.table({"database_name":
+                         pa.array(sorted(self.catalog.list_databases()))})
+
+    def _exec_show_create(self, s: ast.ShowCreateTable) -> pa.Table:
+        table = self.catalog.get_table(self._ident(s.table))
+        schema = table.schema
+        lines = [f"CREATE TABLE `{s.table}` ("]
+        defs = []
+        for f in schema.fields:
+            d = f"  `{f.name}` {f.type}"
+            if getattr(f, "description", None):
+                d += f" COMMENT '{f.description}'"
+            defs.append(d)
+        if schema.primary_keys:
+            defs.append("  PRIMARY KEY (" +
+                        ", ".join(f"`{k}`" for k in schema.primary_keys) +
+                        ") NOT ENFORCED")
+        lines.append(",\n".join(defs))
+        lines.append(")")
+        if schema.partition_keys:
+            lines.append("PARTITIONED BY (" +
+                         ", ".join(f"`{k}`"
+                                   for k in schema.partition_keys) + ")")
+        if schema.options:
+            opts = ",\n".join(f"  '{k}' = '{v}'"
+                              for k, v in sorted(schema.options.items()))
+            lines.append(f"WITH (\n{opts}\n)")
+        return _result(["\n".join(lines)], "create_table")
+
+    def _exec_describe(self, d: ast.Describe) -> pa.Table:
+        table = self.catalog.get_table(self._ident(d.table))
+        schema = table.schema
+        pk = set(schema.primary_keys or [])
+        part = set(schema.partition_keys or [])
+        return pa.table({
+            "name": pa.array([f.name for f in schema.fields]),
+            "type": pa.array([str(f.type) for f in schema.fields]),
+            "key": pa.array(["PRI" if f.name in pk else
+                             ("PAR" if f.name in part else "")
+                             for f in schema.fields]),
+            "comment": pa.array([getattr(f, "description", None)
+                                 for f in schema.fields], pa.string()),
+        })
+
+    def _exec_use(self, u: ast.Use) -> pa.Table:
+        if not self.catalog.database_exists(u.database):
+            raise SQLError(f"database {u.database!r} does not exist")
+        self.database = u.database
+        return _result(["OK"])
+
+    def _exec_alter(self, a: ast.AlterTable) -> pa.Table:
+        ident = self._ident(a.table)
+        changes: List[SchemaChange] = []
+        if a.action == "set-options":
+            changes = [SchemaChange.set_option(k, v)
+                       for k, v in a.payload.items()]
+        elif a.action == "reset":
+            changes = [SchemaChange.remove_option(k) for k in a.payload]
+        elif a.action == "add-column":
+            cd: ast.ColumnDef = a.payload
+            changes = [SchemaChange.add_column(cd.name,
+                                               parse_data_type(cd.type_str))]
+        elif a.action == "drop-column":
+            changes = [SchemaChange.drop_column(a.payload)]
+        elif a.action == "rename-column":
+            changes = [SchemaChange.rename_column(*a.payload)]
+        self.catalog.alter_table(ident, changes)
+        return _result(["OK"])
+
+    # -- CALL procedures ----------------------------------------------------
+    def _exec_call(self, c: ast.Call) -> pa.Table:
+        proc = c.procedure.lower()
+        if proc.startswith("sys."):
+            proc = proc[4:]
+        args = list(c.args)
+        if not args:
+            raise SQLError("CALL procedures take the table name first")
+        table = self.catalog.get_table(self._ident(str(args[0])))
+        rest = args[1:]
+        if proc == "compact":
+            sid = table.compact(full=bool(rest[0]) if rest else False)
+            return _result([f"snapshot {sid}" if sid else "nothing to do"])
+        if proc == "sort_compact":
+            order_by = [c.strip() for c in str(rest[0]).split(",")]
+            strategy = str(rest[1]) if len(rest) > 1 else "order"
+            sid = table.sort_compact(order_by, strategy=strategy)
+            return _result([f"snapshot {sid}" if sid else "nothing to do"])
+        if proc == "create_tag":
+            table.create_tag(str(rest[0]),
+                             int(rest[1]) if len(rest) > 1 else None)
+            return _result(["OK"])
+        if proc == "delete_tag":
+            table.delete_tag(str(rest[0]))
+            return _result(["OK"])
+        if proc == "create_branch":
+            table.create_branch(str(rest[0]),
+                                str(rest[1]) if len(rest) > 1 else None)
+            return _result(["OK"])
+        if proc == "delete_branch":
+            table.delete_branch(str(rest[0]))
+            return _result(["OK"])
+        if proc == "fast_forward":
+            table.fast_forward(str(rest[0]))
+            return _result(["OK"])
+        if proc == "rollback_to":
+            table.rollback_to(int(rest[0]))
+            return _result(["OK"])
+        if proc == "expire_snapshots":
+            n = table.expire_snapshots(
+                retain_max=int(rest[0]) if rest else None)
+            return _result([f"{n or 0} snapshots expired"])
+        if proc == "expire_partitions":
+            n = table.expire_partitions(
+                expiration_ms=int(rest[0]) if rest else None)
+            return _result([f"{n or 0} partitions expired"])
+        if proc == "remove_orphan_files":
+            n = table.remove_orphan_files(
+                older_than_ms=int(rest[0]) if rest else None)
+            return _result([f"{n or 0} orphan files removed"])
+        if proc == "rescale":
+            table.rescale_buckets(int(rest[0]))
+            return _result(["OK"])
+        if proc == "rewrite_file_index" or proc == "analyze":
+            n = table.analyze()
+            return _result([f"{n or 0} rows analyzed"])
+        raise SQLError(f"unknown procedure {c.procedure!r}")
+
+
+# ---------------------------------------------------------------------------
+# small AST utilities
+# ---------------------------------------------------------------------------
+
+def _ordinal(v: int, n: int) -> int:
+    """Validate a 1-based positional reference (ORDER BY 2, GROUP BY 1)."""
+    if not 1 <= v <= n:
+        raise SQLError(f"positional reference {v} out of range 1..{n}")
+    return v
+
+
+def _probe_scope(cols: List[str], alias: str) -> Scope:
+    """A zero-row Scope for name resolution during predicate
+    conversion (pushdown / DELETE), shared by both conversion sites."""
+    return Scope(pa.table({f"{alias}.{c}": pa.array([], pa.null())
+                           for c in cols}),
+                 [f"{alias}.{c}" for c in cols])
+
+
+def _split_conjuncts(e) -> List[Any]:
+    if isinstance(e, ast.Binary) and e.op == "AND":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _equi_pair(e, probe: Scope, left: Scope, right: Scope
+               ) -> Optional[Tuple[str, str]]:
+    """`a.x = b.y` with one side in each scope -> (left_q, right_q)."""
+    if not (isinstance(e, ast.Binary) and e.op == "=" and
+            isinstance(e.left, ast.Column) and
+            isinstance(e.right, ast.Column)):
+        return None
+    try:
+        lq = probe.resolve(e.left)
+        rq = probe.resolve(e.right)
+    except SQLError:
+        return None
+    if lq in left.table.column_names and rq in right.table.column_names:
+        return (lq, rq)
+    if rq in left.table.column_names and lq in right.table.column_names:
+        return (rq, lq)
+    return None
+
+
+def _find_aggs(e) -> List[ast.Func]:
+    out: List[ast.Func] = []
+
+    def walk(x):
+        if isinstance(x, ast.Func):
+            if x.name in _AGG_FUNCS:
+                out.append(x)
+                return                      # no nested aggregates
+            for a in x.args:
+                walk(a)
+        elif isinstance(x, ast.Binary):
+            walk(x.left)
+            walk(x.right)
+        elif isinstance(x, ast.Unary):
+            walk(x.operand)
+        elif isinstance(x, ast.Case):
+            for c, v in x.whens:
+                walk(c)
+                walk(v)
+            if x.default is not None:
+                walk(x.default)
+        elif isinstance(x, ast.Cast):
+            walk(x.expr)
+        elif isinstance(x, (ast.IsNull, ast.LikeExpr)):
+            walk(x.expr)
+        elif isinstance(x, ast.InList):
+            walk(x.expr)
+        elif isinstance(x, ast.BetweenExpr):
+            walk(x.expr)
+            walk(x.lo)
+            walk(x.hi)
+    walk(e)
+    return out
+
+
+def _display_name(e) -> str:
+    if isinstance(e, ast.Column):
+        return e.name
+    if isinstance(e, ast.Func):
+        return e.name
+    if isinstance(e, ast.Literal):
+        return str(e.value)
+    return "expr"
+
+
+def _dedup(names: List[str]) -> List[str]:
+    seen: Dict[str, int] = {}
+    out = []
+    for n in names:
+        if n in seen:
+            seen[n] += 1
+            out.append(f"{n}_{seen[n]}")
+        else:
+            seen[n] = 0
+            out.append(n)
+    return out

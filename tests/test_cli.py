@@ -1,0 +1,125 @@
+"""CLI tests (reference pypaimon/cli/): drive `paimon_tpu.cli.main`
+in-process with --warehouse pointing at a temp filesystem catalog."""
+
+import json
+
+import pytest
+
+from paimon_tpu.cli import main
+
+
+@pytest.fixture()
+def wh(tmp_path):
+    return str(tmp_path / "wh")
+
+
+def run(capsys, *argv):
+    rc = main(list(argv))
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+def _bootstrap(capsys, wh):
+    assert run(capsys, "-w", wh, "db", "create", "d1")[0] == 0
+    rc, out, err = run(
+        capsys, "-w", wh, "table", "create", "d1.t",
+        "--column", "id:BIGINT NOT NULL", "--column", "v:DOUBLE",
+        "--primary-key", "id", "--option", "bucket=1")
+    assert rc == 0, err
+    rc, out, err = run(
+        capsys, "-w", wh, "sql",
+        "INSERT INTO d1.t VALUES (1, 1.5), (2, 2.5)")
+    assert rc == 0, err
+
+
+class TestCli:
+    def test_db_lifecycle(self, capsys, wh):
+        assert run(capsys, "-w", wh, "db", "create", "mydb")[0] == 0
+        rc, out, _ = run(capsys, "-w", wh, "db", "list")
+        assert "mydb" in out.splitlines()
+        assert run(capsys, "-w", wh, "db", "drop", "mydb")[0] == 0
+        rc, out, _ = run(capsys, "-w", wh, "db", "list")
+        assert "mydb" not in out
+
+    def test_table_create_read(self, capsys, wh):
+        _bootstrap(capsys, wh)
+        rc, out, _ = run(capsys, "-w", wh, "table", "list", "d1")
+        assert out.splitlines() == ["t"]
+        rc, out, _ = run(capsys, "-w", wh, "table", "get", "d1.t")
+        info = json.loads(out)
+        assert info["primary_keys"] == ["id"]
+        assert info["options"]["bucket"] == "1"
+        rc, out, _ = run(capsys, "-f", "json", "-w", wh,
+                         "table", "read", "d1.t")
+        rows = [json.loads(line) for line in out.splitlines()]
+        assert rows == [{"id": 1, "v": 1.5}, {"id": 2, "v": 2.5}]
+
+    def test_read_formats(self, capsys, wh):
+        _bootstrap(capsys, wh)
+        rc, out, _ = run(capsys, "-f", "csv", "-w", wh,
+                         "table", "read", "d1.t", "--columns", "id")
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        assert lines[0].strip('"') == "id"
+        assert [ln for ln in lines[1:]] == ["1", "2"]
+        rc, out, _ = run(capsys, "-w", wh, "table", "read", "d1.t",
+                         "--limit", "1")
+        assert "1 row(s)" in out
+
+    def test_sql_subcommand(self, capsys, wh):
+        _bootstrap(capsys, wh)
+        rc, out, _ = run(capsys, "-f", "json", "-w", wh, "sql",
+                         "SELECT sum(v) AS s FROM d1.t", "-d", "d1")
+        assert json.loads(out.splitlines()[0]) == {"s": 4.0}
+
+    def test_compact_and_snapshot(self, capsys, wh):
+        _bootstrap(capsys, wh)
+        rc, out, _ = run(capsys, "-w", wh, "table", "compact", "d1.t",
+                         "--full")
+        assert "snapshot" in out
+        rc, out, _ = run(capsys, "-w", wh, "table", "snapshot", "d1.t")
+        snap = json.loads(out)
+        assert snap["commitKind"] == "COMPACT"
+
+    def test_tags_and_branches(self, capsys, wh):
+        _bootstrap(capsys, wh)
+        assert run(capsys, "-w", wh, "tag", "create", "d1.t", "v1")[0] == 0
+        rc, out, _ = run(capsys, "-f", "json", "-w", wh,
+                         "tag", "list", "d1.t")
+        assert any(json.loads(l).get("tag_name") == "v1"
+                   for l in out.splitlines())
+        assert run(capsys, "-w", wh, "branch", "create", "d1.t", "b1",
+                   "--tag", "v1")[0] == 0
+        rc, out, _ = run(capsys, "-f", "json", "-w", wh,
+                         "branch", "list", "d1.t")
+        assert any(json.loads(l).get("branch_name") == "b1"
+                   for l in out.splitlines())
+        assert run(capsys, "-w", wh, "tag", "delete", "d1.t", "v1")[0] == 0
+
+    def test_import_csv(self, capsys, wh, tmp_path):
+        _bootstrap(capsys, wh)
+        f = tmp_path / "data.csv"
+        f.write_text("id,v\n10,10.5\n11,11.5\n")
+        rc, out, _ = run(capsys, "-w", wh, "table", "import", "d1.t",
+                         str(f))
+        assert "2 rows imported" in out
+        rc, out, _ = run(capsys, "-f", "json", "-w", wh, "sql",
+                         "SELECT count(*) AS n FROM d1.t", "-d", "d1")
+        assert json.loads(out.splitlines()[0]) == {"n": 4}
+
+    def test_options_and_columns(self, capsys, wh):
+        _bootstrap(capsys, wh)
+        assert run(capsys, "-w", wh, "table", "set-option", "d1.t",
+                   "snapshot.num-retained.max", "20")[0] == 0
+        assert run(capsys, "-w", wh, "table", "add-column", "d1.t",
+                   "note", "STRING")[0] == 0
+        rc, out, _ = run(capsys, "-w", wh, "table", "get", "d1.t")
+        info = json.loads(out)
+        assert info["options"]["snapshot.num-retained.max"] == "20"
+        assert info["fields"][-1]["name"] == "note"
+
+    def test_error_paths(self, capsys, wh):
+        rc, out, err = run(capsys, "-w", wh, "table", "get", "nope.t")
+        assert rc == 1 and "error:" in err
+        rc, out, err = run(capsys, "-w", wh, "table", "get", "badname")
+        assert rc != 0
+        assert main([]) == 0          # bare invocation prints help

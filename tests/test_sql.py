@@ -1,0 +1,449 @@
+"""SQL layer tests: parser, executor, pushdown, DDL/DML, procedures.
+
+Mirrors the statement surface the reference drives through its SQL
+entry points (pypaimon/sql SQLContext, cli/cli_sql.py) and the Flink
+SQL examples in the reference docs.
+"""
+
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.sql import SQLContext
+from paimon_tpu.sql.parser import SQLError, parse
+from paimon_tpu.catalog.catalog import create_catalog
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    cat = create_catalog(warehouse=str(tmp_path / "wh"))
+    cat.create_database("default", ignore_if_exists=True)
+    return SQLContext(cat)
+
+
+def _setup_orders(ctx):
+    ctx.sql("""
+        CREATE TABLE orders (
+            id BIGINT NOT NULL,
+            customer STRING,
+            amount DOUBLE,
+            qty INT,
+            PRIMARY KEY (id) NOT ENFORCED
+        ) WITH ('bucket' = '2')
+    """)
+    ctx.sql("""
+        INSERT INTO orders VALUES
+            (1, 'alice', 10.0, 2),
+            (2, 'bob', 20.5, 1),
+            (3, 'alice', 5.25, 4),
+            (4, 'carol', 40.0, 3),
+            (5, 'bob', 15.0, 2)
+    """)
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+class TestParser:
+    def test_select_roundtrip(self):
+        s = parse("SELECT a, b AS x FROM t WHERE a > 1 "
+                  "GROUP BY a HAVING count(*) > 2 "
+                  "ORDER BY a DESC LIMIT 10 OFFSET 2")
+        assert len(s.items) == 2
+        assert s.items[1].alias == "x"
+        assert s.limit == 10 and s.offset == 2
+        assert not s.order_by[0][1]          # DESC
+
+    def test_string_escapes_and_comments(self):
+        s = parse("SELECT 'it''s' -- trailing\nFROM t /* block */")
+        assert s.items[0].expr.value == "it's"
+
+    def test_time_travel(self):
+        s = parse("SELECT * FROM t VERSION AS OF 3")
+        assert s.from_.snapshot_id == 3
+        s = parse("SELECT * FROM t VERSION AS OF 'my-tag'")
+        assert s.from_.tag == "my-tag"
+        s = parse("SELECT * FROM t FOR SYSTEM_TIME AS OF 1700000000000")
+        assert s.from_.timestamp_ms == 1700000000000
+
+    def test_create_table(self):
+        c = parse("CREATE TABLE IF NOT EXISTS db.t ("
+                  "  id BIGINT NOT NULL COMMENT 'pk',"
+                  "  v DECIMAL(10, 2),"
+                  "  PRIMARY KEY (id) NOT ENFORCED"
+                  ") PARTITIONED BY (dt) WITH ('bucket' = '4')")
+        assert c.if_not_exists
+        assert c.columns[0].type_str == "BIGINT NOT NULL"
+        assert c.columns[1].type_str == "DECIMAL(10, 2)"
+        assert c.primary_key == ["id"]
+        assert c.partitioned_by == ["dt"]
+        assert c.options == {"bucket": "4"}
+
+    def test_errors(self):
+        with pytest.raises(SQLError):
+            parse("SELECT FROM t")
+        with pytest.raises(SQLError):
+            parse("SELECT * FROM t WHERE")
+        with pytest.raises(SQLError):
+            parse("FLUSH TABLES")
+
+
+# ---------------------------------------------------------------------------
+# queries
+# ---------------------------------------------------------------------------
+
+class TestQueries:
+    def test_select_star_order(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT * FROM orders ORDER BY id")
+        assert out.column_names == ["id", "customer", "amount", "qty"]
+        assert out.column("id").to_pylist() == [1, 2, 3, 4, 5]
+
+    def test_projection_expressions(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT id, amount * qty AS total, "
+                      "upper(customer) AS cust "
+                      "FROM orders WHERE id = 3")
+        assert out.to_pylist() == [{"id": 3, "total": 21.0,
+                                    "cust": "ALICE"}]
+
+    def test_where_variants(self, ctx):
+        _setup_orders(ctx)
+        q = "SELECT id FROM orders WHERE {} ORDER BY id"
+        cases = {
+            "amount > 10 AND qty >= 2": [4, 5],
+            "customer IN ('alice', 'bob')": [1, 2, 3, 5],
+            "customer NOT IN ('alice')": [2, 4, 5],
+            "amount BETWEEN 10 AND 21": [1, 2, 5],
+            "customer LIKE 'a%'": [1, 3],
+            "customer LIKE '%aro%'": [4],
+            "NOT (qty = 2)": [2, 3, 4],
+            "id % 2 = 0": [2, 4],
+        }
+        for cond, expect in cases.items():
+            assert ctx.sql(q.format(cond)).column("id").to_pylist() == \
+                expect, cond
+
+    def test_aggregation(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT customer, count(*) AS n, sum(amount) AS s, "
+                      "avg(qty) AS a, min(amount) AS lo, max(amount) AS hi "
+                      "FROM orders GROUP BY customer ORDER BY customer")
+        rows = out.to_pylist()
+        assert rows[0] == {"customer": "alice", "n": 2, "s": 15.25,
+                           "a": 3.0, "lo": 5.25, "hi": 10.0}
+        assert [r["customer"] for r in rows] == ["alice", "bob", "carol"]
+
+    def test_global_aggregate(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT count(*) AS n, sum(amount) AS total "
+                      "FROM orders")
+        assert out.to_pylist() == [{"n": 5, "total": 90.75}]
+
+    def test_global_aggregate_empty(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT count(*) AS n, max(amount) AS m "
+                      "FROM orders WHERE id > 100")
+        assert out.to_pylist() == [{"n": 0, "m": None}]
+
+    def test_having_and_count_distinct(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT customer, count(DISTINCT qty) AS dq "
+                      "FROM orders GROUP BY customer "
+                      "HAVING count(*) > 1 ORDER BY customer")
+        assert out.to_pylist() == [{"customer": "alice", "dq": 2},
+                                   {"customer": "bob", "dq": 2}]
+
+    def test_group_by_expression(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT qty % 2 AS parity, count(*) AS n "
+                      "FROM orders GROUP BY qty % 2 ORDER BY parity")
+        assert out.to_pylist() == [{"parity": 0, "n": 3},
+                                   {"parity": 1, "n": 2}]
+
+    def test_case_cast_functions(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql(
+            "SELECT id, CASE WHEN amount >= 20 THEN 'big' "
+            "ELSE 'small' END AS size_, "
+            "CAST(amount AS INT) AS ai, "
+            "coalesce(NULL, customer) AS c, "
+            "substr(customer, 1, 3) AS pre "
+            "FROM orders WHERE id <= 2 ORDER BY id")
+        assert out.to_pylist() == [
+            {"id": 1, "size_": "small", "ai": 10, "c": "alice",
+             "pre": "ali"},
+            # CAST truncates toward zero (Java (int) semantics,
+            # data/casting.py numeric narrowing rule)
+            {"id": 2, "size_": "big", "ai": 20, "c": "bob", "pre": "bob"},
+        ]
+
+    def test_distinct_union_all(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT DISTINCT customer FROM orders")
+        assert sorted(out.column("customer").to_pylist()) == \
+            ["alice", "bob", "carol"]
+        out = ctx.sql("SELECT id FROM orders WHERE id = 1 "
+                      "UNION ALL SELECT id FROM orders WHERE id = 2")
+        assert sorted(out.column("id").to_pylist()) == [1, 2]
+
+    def test_subquery(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT cust, total FROM ("
+                      "  SELECT customer AS cust, sum(amount) AS total"
+                      "  FROM orders GROUP BY customer) t "
+                      "WHERE total > 16 ORDER BY total DESC")
+        assert out.to_pylist() == [{"cust": "carol", "total": 40.0},
+                                   {"cust": "bob", "total": 35.5}]
+
+    def test_select_without_from(self, ctx):
+        out = ctx.sql("SELECT 1 + 2 AS three, 'x' AS s")
+        assert out.to_pylist() == [{"three": 3, "s": "x"}]
+
+    def test_order_nulls_and_position(self, ctx):
+        ctx.sql("CREATE TABLE tn (id INT, v INT)")
+        ctx.sql("INSERT INTO tn VALUES (1, NULL), (2, 5), (3, 1)")
+        out = ctx.sql("SELECT id, v FROM tn ORDER BY v ASC NULLS FIRST")
+        assert out.column("id").to_pylist() == [1, 3, 2]
+        out = ctx.sql("SELECT id, v FROM tn ORDER BY 2 DESC")
+        assert out.column("id").to_pylist()[:2] == [2, 3]
+
+    def test_registered_view(self, ctx):
+        ctx.register("v", pa.table({"a": [1, 2, 3]}))
+        out = ctx.sql("SELECT sum(a) AS s FROM v")
+        assert out.to_pylist() == [{"s": 6}]
+
+    def test_union_order_limit_bind_whole_union(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT id FROM orders WHERE id >= 4 "
+                      "UNION ALL SELECT id FROM orders WHERE id <= 2 "
+                      "ORDER BY id")
+        assert out.column("id").to_pylist() == [1, 2, 4, 5]
+        out = ctx.sql("SELECT id FROM orders WHERE id >= 4 "
+                      "UNION ALL SELECT id FROM orders WHERE id <= 2 "
+                      "ORDER BY id DESC LIMIT 2")
+        assert out.column("id").to_pylist() == [5, 4]
+
+    def test_having_without_aggregate_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        with pytest.raises(SQLError, match="HAVING"):
+            ctx.sql("SELECT id FROM orders HAVING id > 2")
+
+    def test_order_by_ordinal_validation(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        with pytest.raises(SQLError, match="positional"):
+            ctx.sql("SELECT id FROM orders ORDER BY 0")
+        with pytest.raises(SQLError, match="positional"):
+            ctx.sql("SELECT id FROM orders ORDER BY 2")
+
+
+class TestJoins:
+    def _setup(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE TABLE customers (name STRING NOT NULL, "
+                "tier STRING, PRIMARY KEY (name) NOT ENFORCED) "
+                "WITH ('bucket' = '1')")
+        ctx.sql("INSERT INTO customers VALUES ('alice', 'gold'), "
+                "('bob', 'silver'), ('dave', 'bronze')")
+
+    def test_inner_join(self, ctx):
+        self._setup(ctx)
+        out = ctx.sql(
+            "SELECT o.id, c.tier FROM orders o "
+            "JOIN customers c ON o.customer = c.name ORDER BY o.id")
+        assert out.to_pylist() == [
+            {"id": 1, "tier": "gold"}, {"id": 2, "tier": "silver"},
+            {"id": 3, "tier": "gold"}, {"id": 5, "tier": "silver"}]
+
+    def test_left_join(self, ctx):
+        self._setup(ctx)
+        out = ctx.sql(
+            "SELECT o.id, c.tier FROM orders o "
+            "LEFT JOIN customers c ON o.customer = c.name ORDER BY o.id")
+        assert out.column("tier").to_pylist() == \
+            ["gold", "silver", "gold", None, "silver"]
+
+    def test_join_residual_condition(self, ctx):
+        self._setup(ctx)
+        out = ctx.sql(
+            "SELECT o.id FROM orders o JOIN customers c "
+            "ON o.customer = c.name AND o.amount > 12 ORDER BY o.id")
+        assert out.column("id").to_pylist() == [2, 5]
+
+    def test_cross_join(self, ctx):
+        self._setup(ctx)
+        out = ctx.sql("SELECT count(*) AS n FROM orders CROSS JOIN "
+                      "customers")
+        assert out.to_pylist() == [{"n": 15}]
+
+    def test_left_join_residual_keeps_outer_rows(self, ctx):
+        # residual ON conditions participate in the match; LEFT JOIN
+        # still emits every left row
+        self._setup(ctx)
+        out = ctx.sql(
+            "SELECT o.id, c.tier FROM orders o LEFT JOIN customers c "
+            "ON o.customer = c.name AND o.amount > 12 ORDER BY o.id")
+        assert out.column("tier").to_pylist() == \
+            [None, "silver", None, None, "silver"]
+        assert out.column("id").to_pylist() == [1, 2, 3, 4, 5]
+
+    def test_join_aggregate(self, ctx):
+        self._setup(ctx)
+        out = ctx.sql(
+            "SELECT c.tier, sum(o.amount) AS s FROM orders o "
+            "JOIN customers c ON o.customer = c.name "
+            "GROUP BY c.tier ORDER BY c.tier")
+        assert out.to_pylist() == [{"tier": "gold", "s": 15.25},
+                                   {"tier": "silver", "s": 35.5}]
+
+
+# ---------------------------------------------------------------------------
+# pushdown
+# ---------------------------------------------------------------------------
+
+class TestPushdown:
+    def test_explain_shows_pushdown(self, ctx):
+        _setup_orders(ctx)
+        plan = ctx.sql("EXPLAIN SELECT id FROM orders WHERE id > 3 "
+                       "AND upper(customer) = 'BOB'")
+        text = "\n".join(plan.column("plan").to_pylist())
+        assert "pushed predicate" in text
+        assert "id" in text and "gt" in text.lower() or ">" in text
+
+    def test_pushdown_correctness_vs_residual(self, ctx):
+        _setup_orders(ctx)
+        # mixed pushable + non-pushable conjuncts must both apply
+        out = ctx.sql("SELECT id FROM orders "
+                      "WHERE id >= 2 AND length(customer) = 3 ORDER BY id")
+        assert out.column("id").to_pylist() == [2, 5]
+
+    def test_or_not_pushed_still_correct(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT id FROM orders "
+                      "WHERE id = 1 OR length(customer) = 5 ORDER BY id")
+        assert out.column("id").to_pylist() == [1, 3, 4]
+
+    def test_not_over_partially_convertible_and(self, ctx):
+        # NOT(a AND f(b)): the AND converts partially, so pushing
+        # NOT(partial) would over-prune — must not be pushed
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT id FROM orders WHERE NOT "
+                      "(customer = 'alice' AND length(customer) = 9) "
+                      "ORDER BY id")
+        assert out.column("id").to_pylist() == [1, 2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# DDL / DML / procedures
+# ---------------------------------------------------------------------------
+
+class TestDdlDml:
+    def test_show_describe(self, ctx):
+        _setup_orders(ctx)
+        assert ctx.sql("SHOW TABLES").column("table_name").to_pylist() \
+            == ["orders"]
+        assert "default" in ctx.sql("SHOW DATABASES") \
+            .column("database_name").to_pylist()
+        d = ctx.sql("DESCRIBE orders")
+        assert d.column("name").to_pylist() == \
+            ["id", "customer", "amount", "qty"]
+        assert d.column("key").to_pylist()[0] == "PRI"
+        ddl = ctx.sql("SHOW CREATE TABLE orders") \
+            .column("create_table")[0].as_py()
+        assert "PRIMARY KEY (`id`)" in ddl and "'bucket' = '2'" in ddl
+
+    def test_use_and_qualified_names(self, ctx):
+        ctx.sql("CREATE DATABASE db2")
+        ctx.sql("CREATE TABLE db2.t2 (a INT)")
+        ctx.sql("INSERT INTO db2.t2 VALUES (7)")
+        assert ctx.sql("SELECT * FROM db2.t2").to_pylist() == [{"a": 7}]
+        ctx.sql("USE db2")
+        assert ctx.sql("SELECT * FROM t2").to_pylist() == [{"a": 7}]
+
+    def test_insert_select_and_overwrite(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE TABLE summary (customer STRING NOT NULL, "
+                "total DOUBLE, PRIMARY KEY (customer) NOT ENFORCED) "
+                "WITH ('bucket' = '1')")
+        ctx.sql("INSERT INTO summary SELECT customer, sum(amount) "
+                "FROM orders GROUP BY customer")
+        out = ctx.sql("SELECT * FROM summary ORDER BY customer")
+        assert out.column("total").to_pylist() == [15.25, 35.5, 40.0]
+        ctx.sql("INSERT OVERWRITE summary VALUES ('zed', 1.0)")
+        assert ctx.sql("SELECT * FROM summary").to_pylist() == \
+            [{"customer": "zed", "total": 1.0}]
+
+    def test_insert_partial_columns(self, ctx):
+        ctx.sql("CREATE TABLE p (a INT, b STRING, c DOUBLE)")
+        ctx.sql("INSERT INTO p (a, c) VALUES (1, 2.5)")
+        assert ctx.sql("SELECT * FROM p").to_pylist() == \
+            [{"a": 1, "b": None, "c": 2.5}]
+
+    def test_pk_upsert_via_insert(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("INSERT INTO orders VALUES (1, 'alice', 99.0, 9)")
+        out = ctx.sql("SELECT amount FROM orders WHERE id = 1")
+        assert out.column("amount").to_pylist() == [99.0]
+
+    def test_delete(self, ctx):
+        _setup_orders(ctx)
+        r = ctx.sql("DELETE FROM orders WHERE customer = 'bob'")
+        assert "2 rows deleted" in r.column("result")[0].as_py()
+        assert ctx.sql("SELECT count(*) AS n FROM orders") \
+            .to_pylist() == [{"n": 3}]
+
+    def test_update(self, ctx):
+        _setup_orders(ctx)
+        r = ctx.sql("UPDATE orders SET amount = amount + 1, qty = 0 "
+                    "WHERE customer = 'alice'")
+        assert "2 rows updated" in r.column("result")[0].as_py()
+        out = ctx.sql("SELECT id, amount, qty FROM orders "
+                      "WHERE customer = 'alice' ORDER BY id")
+        assert out.to_pylist() == [{"id": 1, "amount": 11.0, "qty": 0},
+                                   {"id": 3, "amount": 6.25, "qty": 0}]
+
+    def test_alter(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("ALTER TABLE orders SET ('snapshot.num-retained.max' = "
+                "'10')")
+        t = ctx.catalog.get_table(ctx._ident("orders"))
+        assert t.schema.options["snapshot.num-retained.max"] == "10"
+        ctx.sql("ALTER TABLE orders ADD COLUMN note STRING")
+        out = ctx.sql("SELECT note FROM orders WHERE id = 1")
+        assert out.column("note").to_pylist() == [None]
+
+    def test_drop(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("DROP TABLE orders")
+        assert ctx.sql("SHOW TABLES").num_rows == 0
+        ctx.sql("DROP TABLE IF EXISTS orders")   # no error
+
+
+class TestProceduresAndTravel:
+    def test_call_compact_and_tags(self, ctx):
+        _setup_orders(ctx)
+        r = ctx.sql("CALL sys.compact('orders', TRUE)")
+        assert "snapshot" in r.column("result")[0].as_py()
+        ctx.sql("CALL sys.create_tag('orders', 'v1')")
+        ctx.sql("INSERT INTO orders VALUES (9, 'zed', 1.0, 1)")
+        out = ctx.sql("SELECT count(*) AS n FROM orders "
+                      "VERSION AS OF 'v1'")
+        assert out.to_pylist() == [{"n": 5}]
+        assert ctx.sql("SELECT count(*) AS n FROM orders") \
+            .to_pylist() == [{"n": 6}]
+
+    def test_snapshot_travel_and_system_table(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("INSERT INTO orders VALUES (10, 'x', 1.0, 1)")
+        snaps = ctx.sql("SELECT * FROM orders$snapshots")
+        assert snaps.num_rows >= 2
+        out = ctx.sql("SELECT count(*) AS n FROM orders VERSION AS OF 1")
+        assert out.to_pylist() == [{"n": 5}]
+
+    def test_call_expire(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("INSERT INTO orders VALUES (11, 'y', 2.0, 1)")
+        r = ctx.sql("CALL sys.expire_snapshots('orders', 1)")
+        assert "expired" in r.column("result")[0].as_py()
