@@ -115,7 +115,11 @@ class MergeTreeCompactManager:
         self.strategy = UniversalCompaction(
             max_size_amp=options.max_size_amplification_percent,
             size_ratio=options.size_ratio,
-            num_run_trigger=options.num_sorted_runs_compaction_trigger)
+            num_run_trigger=options.num_sorted_runs_compaction_trigger,
+            total_size_threshold=options.get(
+                CoreOptions.COMPACTION_TOTAL_SIZE_THRESHOLD),
+            file_num_limit=options.get(
+                CoreOptions.COMPACTION_FILE_NUM_LIMIT))
         self.path_factory = FileStorePathFactory(
             table_path, schema.partition_keys,
             options.get(CoreOptions.PARTITION_DEFAULT_NAME))
@@ -378,8 +382,10 @@ class MergeTreeCompactManager:
             return []
         return write_changelog_file(
             self.file_io, self.path_factory, self.schema,
-            self.options.file_format, self.options.file_compression,
-            self.partition, self.bucket, cl)
+            self.options.changelog_file_format,
+            self.options.changelog_file_compression,
+            self.partition, self.bucket, cl,
+            prefix=self.options.changelog_file_prefix)
 
     # -- merged-state helpers ------------------------------------------------
 
@@ -452,7 +458,8 @@ class MergeTreeCompactManager:
                               else "deduplicate"),
                 drop_deletes=drop_deletes,
                 key_encoder=self.key_encoder,
-                seq_fields=seq_fields)
+                seq_fields=seq_fields,
+                seq_desc=self.options.sequence_field_descending)
             return self._record_level_expire(res.take())
         from paimon_tpu.ops.agg import merge_runs_agg
         merged = merge_runs_agg(run_tables, self.key_cols, self.schema,

@@ -35,14 +35,31 @@ class CompactUnit:
 
 class UniversalCompaction:
     def __init__(self, max_size_amp: int = 200, size_ratio: int = 1,
-                 num_run_trigger: int = 5):
+                 num_run_trigger: int = 5,
+                 total_size_threshold: Optional[int] = None,
+                 file_num_limit: Optional[int] = None):
         self.max_size_amp = max_size_amp
         self.size_ratio = size_ratio
         self.num_run_trigger = num_run_trigger
+        self.total_size_threshold = total_size_threshold
+        self.file_num_limit = file_num_limit
 
     def pick(self, num_levels: int,
              runs: List[LevelSortedRun]) -> Optional[CompactUnit]:
         max_level = num_levels - 1
+        # tiny buckets full-compact outright: below the threshold a
+        # whole-bucket rewrite is cheaper than tracking run shapes
+        # (reference compaction.total-size-threshold)
+        if self.total_size_threshold is not None and len(runs) > 1 and \
+                sum(r.run.total_size for r in runs) < \
+                self.total_size_threshold:
+            return CompactUnit.from_runs(max_level, runs)
+        # too many loose files (regardless of run sizes): force a pick
+        # (reference compaction.file-num-limit)
+        if self.file_num_limit is not None and \
+                sum(len(r.run.files) for r in runs) >= \
+                self.file_num_limit and len(runs) > 1:
+            return CompactUnit.from_runs(max_level, runs)
         unit = self.pick_for_size_amp(max_level, runs)
         if unit is not None:
             return unit

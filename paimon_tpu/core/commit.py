@@ -76,7 +76,8 @@ class FileStoreCommit:
                index_entries: Optional[list] = None,
                properties: Optional[Dict[str, str]] = None,
                expected_latest_id: Optional[int] = ...,
-               watermark: Optional[int] = None) -> Optional[int]:
+               watermark: Optional[int] = None,
+               force_create: bool = False) -> Optional[int]:
         """Commit append + compact changes. Returns snapshot id (or None if
         nothing to commit). Append and compact deltas are committed as
         separate snapshots like the reference (APPEND then COMPACT)."""
@@ -104,7 +105,13 @@ class FileStoreCommit:
                     FileKind.ADD, pbytes, msg.bucket, msg.total_buckets, f))
 
         last_id = None
-        if append_entries or changelog_entries or index_entries:
+        force_empty = (
+            force_create or
+            self.options.get(CoreOptions.COMMIT_FORCE_CREATE_SNAPSHOT) or
+            self.options.get(
+                CoreOptions.SNAPSHOT_IGNORE_EMPTY_COMMIT) is False)
+        if append_entries or changelog_entries or index_entries or \
+                (force_empty and not compact_entries):
             last_id = self._try_commit(
                 append_entries, changelog_entries, commit_identifier,
                 kind or CommitKind.APPEND, index_entries=index_entries,
@@ -200,10 +207,30 @@ class FileStoreCommit:
         _metrics = global_registry().group("commit")
         _t0 = _time.perf_counter()
         _attempts = 0
+        _max_retries = self.options.get(CoreOptions.COMMIT_MAX_RETRIES)
+        _min_wait = self.options.get(CoreOptions.COMMIT_MIN_RETRY_WAIT)
+        _max_wait = self.options.get(CoreOptions.COMMIT_MAX_RETRY_WAIT)
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         entries_orig = list(entries)
         while True:
+            if _attempts > _max_retries:
+                # the per-attempt cleanup keeps the (reusable) delta and
+                # changelog manifest FILES; on giving up they would be
+                # orphaned with no snapshot referencing them
+                for m in (new_manifest, changelog_manifest):
+                    if m is not None:
+                        self.file_io.delete_quietly(
+                            self.manifest_file.path(m.file_name))
+                raise CommitConflictError(
+                    f"Commit lost the snapshot CAS race "
+                    f"{_max_retries} times (commit.max-retries); "
+                    f"giving up")
+            if _attempts > 0:
+                # exponential backoff between retry-wait bounds
+                # (reference CoreOptions commit.min/max-retry-wait)
+                wait = min(_min_wait * (2 ** (_attempts - 1)), _max_wait)
+                _time.sleep(wait / 1000.0)
             _attempts += 1
             latest = self.snapshot_manager.latest_snapshot()
             if expected_latest_id is not ... and \

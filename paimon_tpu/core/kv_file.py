@@ -162,14 +162,16 @@ def write_changelog_file(file_io: FileIO,
                          path_factory: FileStorePathFactory,
                          schema: TableSchema, file_format: str,
                          compression: str, partition: Tuple, bucket: int,
-                         table: pa.Table) -> List[DataFileMeta]:
+                         table: pa.Table,
+                         prefix: Optional[str] = None
+                         ) -> List[DataFileMeta]:
     """Write a changelog file (KV layout with _VALUE_KIND kinds kept).
     Shared by changelog-producer=input (write path) and the compaction
     changelog producers."""
     import pyarrow.compute as pc
 
     fmt = get_format(file_format)
-    name = path_factory.new_changelog_file_name(fmt.extension)
+    name = path_factory.new_changelog_file_name(fmt.extension, prefix)
     path = path_factory.data_file_path(partition, bucket, name)
     size = fmt.create_writer(compression).write(file_io, path, table)
     return [DataFileMeta(

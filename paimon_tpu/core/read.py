@@ -286,15 +286,16 @@ class MergeFileSplitRead:
                         if len(tables) > 1 else tables[0])
         engine = self.options.merge_engine
         seq_fields = self.options.sequence_field or None
+        seq_desc = self.options.sequence_field_descending
         if engine == MergeEngine.FIRST_ROW:
             res = merge_runs(runs, self.key_cols, merge_engine="first-row",
                              key_encoder=self.key_encoder,
-                             seq_fields=seq_fields)
+                             seq_fields=seq_fields, seq_desc=seq_desc)
             out = res.take(value_cols)
         elif engine in (MergeEngine.DEDUPLICATE,):
             res = merge_runs(runs, self.key_cols,
                              key_encoder=self.key_encoder,
-                             seq_fields=seq_fields)
+                             seq_fields=seq_fields, seq_desc=seq_desc)
             out = res.take(value_cols)
         else:
             from paimon_tpu.ops.agg import merge_runs_agg

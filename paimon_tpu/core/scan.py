@@ -374,17 +374,39 @@ class FileStoreScan:
                            for f in files)
                    and all((f.delete_row_count or 0) == 0 for f in files)
                    and (pbytes, bucket) not in dv_index)
-            splits.append(DataSplit(
-                snapshot_id=snapshot_id,
-                partition=partition,
-                bucket=bucket,
-                total_buckets=total_buckets,
-                data_files=files,
-                raw_convertible=raw or for_delta,
-                deletion_vectors=dv_index.get((pbytes, bucket)),
-                for_streaming=for_streaming,
-                is_delta=for_delta,
-            ))
+            # append tables never merge across files, so a big bucket
+            # bins into several size-bounded splits for parallel readers
+            # (reference source.split.target-size / open-file-cost in
+            # append splits; pk buckets must stay whole for the merge)
+            file_bins = [files]
+            if not self.schema.primary_keys and len(files) > 1:
+                target = self.options.get(
+                    CoreOptions.SOURCE_SPLIT_TARGET_SIZE)
+                open_cost = self.options.get(
+                    CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST)
+                file_bins = []
+                cur, cur_size = [], 0
+                for f in files:
+                    sz = max(f.file_size, open_cost)
+                    if cur and cur_size + sz > target:
+                        file_bins.append(cur)
+                        cur, cur_size = [], 0
+                    cur.append(f)
+                    cur_size += sz
+                if cur:
+                    file_bins.append(cur)
+            for bin_files in file_bins:
+                splits.append(DataSplit(
+                    snapshot_id=snapshot_id,
+                    partition=partition,
+                    bucket=bucket,
+                    total_buckets=total_buckets,
+                    data_files=bin_files,
+                    raw_convertible=raw or for_delta,
+                    deletion_vectors=dv_index.get((pbytes, bucket)),
+                    for_streaming=for_streaming,
+                    is_delta=for_delta,
+                ))
         return splits
 
     def _load_deletion_vectors(self, snapshot_id: int,

@@ -148,7 +148,9 @@ class _BucketWriter:
                              drop_deletes=False,
                              key_encoder=self.parent.key_encoder,
                              seq_fields=self.parent.options.sequence_field
-                             or None)
+                             or None,
+                             seq_desc=self.parent.options
+                             .sequence_field_descending)
             sorted_kv = res.take()
         else:
             order = sort_table(kv, key_cols,
@@ -243,7 +245,8 @@ class LocalMerger:
             [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
         res = merge_runs(
             [kv], key_cols, merge_engine=engine, drop_deletes=False,
-            seq_fields=self.store.options.sequence_field or None)
+            seq_fields=self.store.options.sequence_field or None,
+            seq_desc=self.store.options.sequence_field_descending)
         idx = res.indices
         self.store._dispatch(raw.take(pa.array(idx)), kinds[idx],
                              None if buckets is None else buckets[idx])
@@ -347,8 +350,10 @@ class KeyValueFileStoreWrite:
         from paimon_tpu.core.kv_file import write_changelog_file
         return write_changelog_file(
             self.file_io, self.path_factory, self.schema,
-            self.options.file_format, self.options.file_compression,
-            partition, bucket, table)
+            self.options.changelog_file_format,
+            self.options.changelog_file_compression,
+            partition, bucket, table,
+            prefix=self.options.changelog_file_prefix)
 
     # -- writes --------------------------------------------------------------
 

@@ -1,0 +1,8 @@
+"""Engine/data-loader integrations (reference paimon-python engines:
+pypaimon/ray/, pypaimon/daft/, plus the JVM connectors' role).
+
+- torch_data:  PyTorch IterableDataset / DataLoader over table scans
+- jax_data:    device-placed jax batch iterator (the TPU-native loader)
+- ray_data:    Ray Datasets adapter (gated on ray being installed)
+- daft_data:   Daft DataFrame adapter (gated on daft being installed)
+"""

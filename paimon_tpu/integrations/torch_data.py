@@ -1,0 +1,95 @@
+"""PyTorch integration: stream a paimon table as an IterableDataset.
+
+The reference integrates with Python training stacks through Ray/Daft
+readers (pypaimon/ray/ray_paimon.py, daft/daft_datasource.py) whose
+unit of parallelism is the paimon split.  Same design here: the scan
+plan's splits are the shard unit — split across DataLoader workers (and
+optionally across distributed ranks), each worker merge-reads only its
+own splits, so no two workers decode the same file.
+
+Numeric columns become torch tensors; strings/binaries/other types stay
+as Python lists per batch.
+"""
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import pyarrow as pa
+import torch.utils.data as _tud
+
+
+def _to_torch_batch(t: pa.Table) -> Dict[str, Any]:
+    import numpy as np
+    import torch
+
+    out: Dict[str, Any] = {}
+    for name in t.column_names:
+        col = t.column(name)
+        if pa.types.is_integer(col.type) or pa.types.is_floating(col.type) \
+                or pa.types.is_boolean(col.type):
+            np_col = col.to_numpy(zero_copy_only=False)
+            if np_col.dtype == np.bool_:
+                np_col = np_col.astype(np.uint8)
+            out[name] = torch.from_numpy(np_col)
+        else:
+            out[name] = col.to_pylist()
+    return out
+
+
+class PaimonIterableDataset(_tud.IterableDataset):
+    """`torch.utils.data.IterableDataset` over a table scan.
+
+    Splits are deterministically assigned round-robin to
+    (rank, worker) pairs, so the union over all workers of all ranks is
+    exactly one pass over the table.  A plain module-level subclass so
+    instances pickle for spawn/forkserver DataLoader workers.
+    """
+
+    def __init__(self, table, projection: Optional[List[str]] = None,
+                 predicate=None, batch_size: int = 8192,
+                 rank: int = 0, world_size: int = 1):
+        self.table = table
+        self.projection = projection
+        self.predicate = predicate
+        self.batch_size = batch_size
+        self.rank = rank
+        self.world_size = world_size
+
+    def _read_builder(self):
+        rb = self.table.new_read_builder()
+        if self.projection:
+            rb = rb.with_projection(self.projection)
+        if self.predicate is not None:
+            rb = rb.with_filter(self.predicate)
+        return rb
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        import torch.utils.data as tud
+
+        info = tud.get_worker_info()
+        wid = info.id if info is not None else 0
+        nworkers = info.num_workers if info is not None else 1
+        shard = self.rank * nworkers + wid
+        nshards = self.world_size * nworkers
+
+        rb = self._read_builder()
+        splits = rb.new_scan().plan().splits
+        read = rb.new_read()
+        for i, split in enumerate(splits):
+            if i % nshards != shard:
+                continue
+            t = read.read_split(split)
+            for start in range(0, t.num_rows, self.batch_size):
+                yield _to_torch_batch(t.slice(start, self.batch_size))
+
+
+def to_torch_dataloader(table, projection: Optional[List[str]] = None,
+                        predicate=None, batch_size: int = 8192,
+                        num_workers: int = 0, **loader_kwargs):
+    """A DataLoader of column-dict batches.  Batching happens at the
+    Arrow layer (batch_size rows per yielded dict), so the loader runs
+    with batch_size=None (no re-collation)."""
+    import torch.utils.data as tud
+
+    ds = PaimonIterableDataset(table, projection, predicate, batch_size)
+    return tud.DataLoader(ds, batch_size=None, num_workers=num_workers,
+                          **loader_kwargs)

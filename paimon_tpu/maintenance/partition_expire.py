@@ -73,10 +73,15 @@ def expire_partitions(table, expiration_ms: Optional[int] = None,
         except ValueError:
             continue        # unparseable partitions never expire
         if ts.timestamp() * 1000 < cutoff:
-            expired_parts.add(pbytes)
+            expired_parts.add((ts.timestamp(), pbytes))
 
     if not expired_parts:
         return []
+    # cap the batch, oldest first (reference partition.expiration-max-num:
+    # one call never drops more than this many partitions); keep the
+    # sorted order so callers see a deterministic oldest-first list
+    max_num = options.get(CoreOptions.PARTITION_EXPIRATION_MAX_NUM)
+    expired_parts = [p for _, p in sorted(expired_parts)[:max_num]]
     out = [by_part[p][0] for p in expired_parts]
     if dry_run:
         return out

@@ -225,7 +225,8 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
             return tuple(c[int(i)].as_py() for c in kcols)
 
     from paimon_tpu.ops.merge import user_seq_order_lanes
-    order_lanes = user_seq_order_lanes(table, seq_fields) \
+    order_lanes = user_seq_order_lanes(
+        table, seq_fields, options.sequence_field_descending) \
         if seq_fields else None
     order, seg_id, win_sorted = _segment_ids_from_sort(
         lanes, seq, truncated, full_key, order_lanes)
@@ -239,8 +240,8 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
               (kinds_sorted == RowKind.UPDATE_BEFORE)
 
     aggs = field_aggregators(schema, options)
-    remove_on_delete = options.options.get_or(
-        "partial-update.remove-record-on-delete", "false") == "true"
+    remove_on_delete = options.get(
+        CoreOptions.PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE)
 
     out_cols: Dict[str, pa.Array] = {}
     # keys + sequence + kind from the segment winner row

@@ -252,10 +252,14 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
 
 
 def user_seq_order_lanes(table: pa.Table,
-                         seq_fields: Sequence[str]) -> np.ndarray:
+                         seq_fields: Sequence[str],
+                         descending: bool = False) -> np.ndarray:
     """uint32[N, O] order lanes for user-defined sequence columns
     (reference utils/UserDefinedSeqComparator). Nulls rank FIRST — a row
-    with a null sequence always loses to any non-null one."""
+    with a null sequence always loses to any non-null one (in either
+    sort order).  `descending` implements
+    sequence.field.sort-order=descending: the SMALLER user sequence
+    wins, via bitwise inversion of the value lanes."""
     for f in seq_fields:
         t = table.schema.field(f).type
         if pa.types.is_string(t) or pa.types.is_large_string(t) or \
@@ -270,8 +274,11 @@ def user_seq_order_lanes(table: pa.Table,
     pos = 0
     for nl in enc.lanes_per_col:
         # encoder presence lane sorts nulls last; sequences need the
-        # opposite (null = smallest)
+        # opposite (null = smallest, so null always loses)
         lanes[:, pos] = 1 - lanes[:, pos]
+        if descending:
+            for p in range(pos + 1, pos + nl):
+                lanes[:, p] = np.uint32(0xFFFFFFFF) - lanes[:, p]
         pos += nl
     return lanes
 
@@ -312,7 +319,8 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
                drop_deletes: bool = True,
                key_encoder: Optional[NormalizedKeyEncoder] = None,
                with_prev: bool = False,
-               seq_fields: Optional[Sequence[str]] = None) -> MergeResult:
+               seq_fields: Optional[Sequence[str]] = None,
+               seq_desc: bool = False) -> MergeResult:
     """Merge k sorted runs (oldest first) into the latest row per key.
 
     Equivalent reference path: MergeTreeReaders.readerForMergeTree
@@ -339,7 +347,7 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
         # let later commits replace the retained first row
         raise ValueError(
             "sequence.field cannot be used with merge-engine first-row")
-    order_lanes = user_seq_order_lanes(table, seq_fields) \
+    order_lanes = user_seq_order_lanes(table, seq_fields, seq_desc) \
         if seq_fields else None
     # without changelog derivation the caller consumes only winner
     # rows, so the packed-key fast path is admissible — unless any key

@@ -325,6 +325,79 @@ class CoreOptions:
     METADATA_STATS_MODE = ConfigOption("metadata.stats-mode", str, "truncate(16)", "")
     MANIFEST_COMPRESSION = ConfigOption("manifest.compression", str, "zstd", "")
 
+    # -- commit / retry (reference CoreOptions.java:919-933) -----------------
+    COMMIT_MAX_RETRIES = ConfigOption(
+        "commit.max-retries", int, 10,
+        "CAS attempts before the commit raises a conflict")
+    COMMIT_MIN_RETRY_WAIT = ConfigOption(
+        "commit.min-retry-wait", _parse_duration_ms, 10, "")
+    COMMIT_MAX_RETRY_WAIT = ConfigOption(
+        "commit.max-retry-wait", _parse_duration_ms, 10_000, "")
+    COMMIT_FORCE_CREATE_SNAPSHOT = ConfigOption(
+        "commit.force-create-snapshot", _parse_bool, False, "")
+    SNAPSHOT_IGNORE_EMPTY_COMMIT = ConfigOption(
+        "snapshot.ignore-empty-commit", _parse_bool, None,
+        "Skip the snapshot when a commit carries no changes (defaults "
+        "on for batch writers, off for streaming exactly-once "
+        "progress; reference CoreOptions.java:2497)")
+
+    # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
+    SCAN_PLAN_SORT_PARTITION = ConfigOption(
+        "scan.plan-sort-partition", _parse_bool, False,
+        "Sort plan splits by partition value")
+    SCAN_BOUNDED_WATERMARK = ConfigOption(
+        "scan.bounded.watermark", int, None,
+        "End a stream once a snapshot watermark passes this bound")
+    STREAMING_READ_OVERWRITE = ConfigOption(
+        "streaming-read-overwrite", _parse_bool, False,
+        "Follow-up scanners also read OVERWRITE snapshots' deltas")
+    CONSUMER_IGNORE_PROGRESS = ConfigOption(
+        "consumer.ignore-progress", _parse_bool, False,
+        "Start fresh instead of resuming the consumer's progress")
+
+    # -- sequence / merge (reference CoreOptions.java:1090) ------------------
+    SEQUENCE_FIELD_SORT_ORDER = ConfigOption(
+        "sequence.field.sort-order", str, "ascending",
+        "ascending: larger sequence wins; descending: smaller wins")
+    PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE = ConfigOption(
+        "partial-update.remove-record-on-delete", _parse_bool, False,
+        "-D on a partial-update table drops the whole row instead of "
+        "being ignored")
+
+    # -- compaction tuning (reference CoreOptions.java:1018-1080) ------------
+    COMPACTION_TOTAL_SIZE_THRESHOLD = ConfigOption(
+        "compaction.total-size-threshold", parse_memory_size, None,
+        "Full-compact a bucket whenever its total size is below this")
+    COMPACTION_FILE_NUM_LIMIT = ConfigOption(
+        "compaction.file-num-limit", int, None,
+        "Force a compaction pick once a bucket holds this many files")
+
+    # -- changelog files (reference CoreOptions.java:640-690) ----------------
+    CHANGELOG_FILE_FORMAT = ConfigOption(
+        "changelog-file.format", str, None,
+        "Changelog files' format; defaults to file.format")
+    CHANGELOG_FILE_COMPRESSION = ConfigOption(
+        "changelog-file.compression", str, None,
+        "Changelog files' compression; defaults to file.compression")
+    CHANGELOG_FILE_PREFIX = ConfigOption("changelog-file.prefix", str,
+                                         "changelog-", "")
+
+    # -- maintenance (reference CoreOptions.java:1330-1340) ------------------
+    PARTITION_EXPIRATION_MAX_NUM = ConfigOption(
+        "partition.expiration-max-num", int, 100,
+        "Partitions expired per expire_partitions() call, oldest first")
+
+    # -- manifests (reference CoreOptions.java:560-600) ----------------------
+    MANIFEST_TARGET_FILE_SIZE = ConfigOption(
+        "manifest.target-file-size", parse_memory_size, 8 << 20, "")
+
+    # -- source splits (reference CoreOptions.java:2230-2250) ----------------
+    SOURCE_SPLIT_TARGET_SIZE = ConfigOption(
+        "source.split.target-size", parse_memory_size, 128 << 20,
+        "Append-table buckets bin into splits of about this size")
+    SOURCE_SPLIT_OPEN_FILE_COST = ConfigOption(
+        "source.split.open-file-cost", parse_memory_size, 4 << 20, "")
+
     def __init__(self, options):
         if isinstance(options, dict):
             options = Options(options)
@@ -391,6 +464,26 @@ class CoreOptions:
     def sequence_field(self):
         v = self.options.get(CoreOptions.SEQUENCE_FIELD)
         return [s.strip() for s in v.split(",")] if v else []
+
+    @property
+    def sequence_field_descending(self) -> bool:
+        return self.options.get(
+            CoreOptions.SEQUENCE_FIELD_SORT_ORDER) == "descending"
+
+    @property
+    def changelog_file_format(self) -> str:
+        return self.options.get(CoreOptions.CHANGELOG_FILE_FORMAT) or \
+            self.file_format
+
+    @property
+    def changelog_file_compression(self) -> str:
+        return self.options.get(
+            CoreOptions.CHANGELOG_FILE_COMPRESSION) or \
+            self.file_compression
+
+    @property
+    def changelog_file_prefix(self) -> str:
+        return self.options.get(CoreOptions.CHANGELOG_FILE_PREFIX)
 
     @property
     def target_file_size(self) -> int:

@@ -76,12 +76,14 @@ class Compiler:
         return self._compile(e)
 
     def as_array(self, e) -> pa.ChunkedArray:
-        v = self.compile(e)
+        return self.broadcast(self.compile(e))
+
+    def broadcast(self, v) -> pa.ChunkedArray:
+        """Expand an already-compiled scalar across the relation."""
         if isinstance(v, (pa.ChunkedArray, pa.Array)):
             return v
         if not isinstance(v, pa.Scalar):
             v = pa.scalar(v)
-        # broadcast a scalar expression across the relation
         if v.type == pa.null():
             return pa.nulls(self._rows())
         return pa.chunked_array([pa.repeat(v, self._rows())])
@@ -182,7 +184,7 @@ class Compiler:
         if name == "trim":
             return pc.utf8_trim_whitespace(a[0])
         if name == "concat":
-            arrs = [pc.cast(self.as_array(x), pa.string()) for x in args]
+            arrs = [pc.cast(self.broadcast(v), pa.string()) for v in a]
             return pc.binary_join_element_wise(*arrs, "")
         if name == "coalesce":
             # NULL literals (type null) never contribute a value
@@ -192,7 +194,7 @@ class Compiler:
             return live[0] if len(live) == 1 else pc.coalesce(*live)
         if name == "nullif":
             return pc.if_else(pc.fill_null(pc.equal(a[0], a[1]), False),
-                              pa.nulls(self._rows()), self.as_array(args[0]))
+                              pa.nulls(self._rows()), self.broadcast(a[0]))
         if name == "round":
             nd = self._literal(args[1]) if len(args) > 1 else 0
             return pc.round(a[0], ndigits=nd)
@@ -215,8 +217,8 @@ class Compiler:
         if name in ("year", "month", "day", "hour", "minute", "second"):
             return getattr(pc, name)(a[0])
         if name == "if":
-            return pc.if_else(pc.fill_null(self.as_array(args[0]), False),
-                              self.as_array(args[1]), self.as_array(args[2]))
+            return pc.if_else(pc.fill_null(self.broadcast(a[0]), False),
+                              self.broadcast(a[1]), self.broadcast(a[2]))
         raise SQLError(f"unknown function {name}()")
 
 
@@ -229,12 +231,16 @@ def _flip(op: str) -> str:
             "=": "=", "<>": "<>"}[op]
 
 
-def expr_to_predicate(e, scope: Scope, base_qualifier: str
-                      ) -> Optional[P.Predicate]:
+def expr_to_predicate(e, scope: Scope, base_qualifier: str,
+                      exact: bool = False) -> Optional[P.Predicate]:
     """Convert an expression into a paimon Predicate over bare column
-    names of the base table, or None when any part is not convertible
-    (the full WHERE is still evaluated after decode, so None just means
-    no pruning from this subtree)."""
+    names of the base table, or None when any part is not convertible.
+
+    exact=False (pushdown): an AND may convert PARTIALLY — a superset
+    predicate is fine for pruning because the full WHERE re-applies
+    after decode.  exact=True (DELETE): every conjunct must convert or
+    the whole conversion fails — a partial predicate would act on rows
+    the full WHERE does not match."""
 
     def bare(col: ast.Column) -> Optional[str]:
         try:
@@ -258,6 +264,8 @@ def expr_to_predicate(e, scope: Scope, base_qualifier: str
             if e.op == "AND":
                 if l_ is not None and r_ is not None:
                     return P.and_(l_, r_)
+                if exact:
+                    return None                       # all-or-nothing
                 return l_ if l_ is not None else r_   # partial AND prunes
             if l_ is not None and r_ is not None:     # OR needs both arms
                 return P.or_(l_, r_)
@@ -410,26 +418,13 @@ class SQLContext:
             return table.system_table(system), alias
         return table, alias
 
-    def _scan_base(self, ref: ast.TableRef, select: ast.Select,
-                   collect_plan: Optional[dict] = None) -> Scope:
-        """Scan the FROM base table with WHERE pushdown."""
-        rel, alias = self._load_relation(ref)
-        pushed = None
-        if isinstance(rel, pa.Table):
-            out = rel
-        else:
-            table = rel
-            if select.where is not None and not select.joins:
-                cols = [f.name for f in table.row_type().fields]
-                probe = _probe_scope(cols, alias)
-                pushed = expr_to_predicate(select.where, probe, alias)
-            out = table.to_arrow(predicate=pushed)
-        if collect_plan is not None:
-            collect_plan["pushed"] = repr(pushed) if pushed is not None \
-                else None
-        qualified = out.rename_columns(
-            [f"{alias}.{c}" for c in out.column_names])
-        return Scope(qualified, list(qualified.column_names))
+    def _pushed_predicate(self, table, alias: str, select: ast.Select):
+        """WHERE -> pruning predicate, resolution-only (no I/O)."""
+        if select.where is None or select.joins:
+            return None
+        cols = [f.name for f in table.row_type().fields]
+        return expr_to_predicate(select.where, _probe_scope(cols, alias),
+                                 alias)
 
     def _relation_scope(self, ref, select: ast.Select,
                         collect_plan: Optional[dict] = None) -> Scope:
@@ -441,10 +436,16 @@ class SQLContext:
         if isinstance(ref, ast.TableRef):
             rel, alias = self._load_relation(ref)
             if isinstance(rel, pa.Table):
-                q = rel.rename_columns(
-                    [f"{alias}.{c}" for c in rel.column_names])
-                return Scope(q, list(q.column_names))
-            return self._scan_base(ref, select, collect_plan)
+                out = rel
+            else:
+                pushed = self._pushed_predicate(rel, alias, select)
+                if collect_plan is not None:
+                    collect_plan["pushed"] = repr(pushed) \
+                        if pushed is not None else None
+                out = rel.to_arrow(predicate=pushed)
+            q = out.rename_columns(
+                [f"{alias}.{c}" for c in out.column_names])
+            return Scope(q, list(q.column_names))
         raise SQLError(f"unsupported FROM item {ref!r}")
 
     # -- SELECT -------------------------------------------------------------
@@ -736,14 +737,16 @@ class SQLContext:
 
     # -- EXPLAIN ------------------------------------------------------------
     def _exec_explain(self, e: ast.Explain) -> pa.Table:
-        info: dict = {}
         s = e.select
         lines = ["== Logical Plan =="]
         if isinstance(s.from_, ast.TableRef):
-            self._relation_scope(s.from_, s, collect_plan=info)
+            # resolution only — EXPLAIN never reads data files
+            rel, alias = self._load_relation(s.from_)
             lines.append(f"Scan: {s.from_.name}")
-            if info.get("pushed"):
-                lines.append(f"  pushed predicate: {info['pushed']}")
+            pushed = None if isinstance(rel, pa.Table) else \
+                self._pushed_predicate(rel, alias, s)
+            if pushed is not None:
+                lines.append(f"  pushed predicate: {pushed!r}")
             elif s.where is not None:
                 lines.append("  pushed predicate: none")
         if s.where is not None:
@@ -810,7 +813,8 @@ class SQLContext:
                            "DROP TABLE or overwrite instead")
         cols = [f.name for f in table.row_type().fields]
         alias = d.table.split(".")[-1]
-        pred = expr_to_predicate(d.where, _probe_scope(cols, alias), alias)
+        pred = expr_to_predicate(d.where, _probe_scope(cols, alias),
+                                 alias, exact=True)
         if pred is None:
             raise SQLError("DELETE WHERE must be expressible as column/"
                            f"literal comparisons, got: {d.where!r}")

@@ -814,8 +814,15 @@ class Parser:
             self.accept_kw("INTO")
         table = self.qualified_name()
         columns = None
+
+        def at_paren_select() -> bool:
+            return self.peek().kind == "OP" and \
+                self.peek().value == "(" and \
+                self.peek(1).kind == "KEYWORD" and \
+                self.peek(1).value == "SELECT"
+
         if self.peek().kind == "OP" and self.peek().value == "(" and \
-                not self.at_kw("VALUES", "SELECT"):
+                not at_paren_select():
             self.next()
             columns = [self.ident()]
             while self.accept_op(","):
@@ -826,6 +833,12 @@ class Parser:
             while self.accept_op(","):
                 rows.append(self.value_row())
             return Insert(table, columns, rows, None, overwrite)
+        if at_paren_select():
+            # INSERT INTO t [(cols)] (SELECT ...)
+            self.next()
+            sel = self.select()
+            self.expect_op(")")
+            return Insert(table, columns, None, sel, overwrite)
         return Insert(table, columns, None, self.select(), overwrite)
 
     def value_row(self) -> List[Any]:

@@ -46,7 +46,8 @@ class DataTableStreamScan:
         self._next: Optional[int] = None
         self._first = True
         cid = self.options.consumer_id
-        if cid is not None:
+        if cid is not None and not self.options.get(
+                CoreOptions.CONSUMER_IGNORE_PROGRESS):
             progress = self.consumer_manager.consumer(cid)
             if progress is not None:
                 # resume where the consumer left off; no initial full scan
@@ -213,12 +214,23 @@ class DataTableStreamScan:
             snapshot = cm.try_changelog(self._next)
             if snapshot is None:
                 raise
+        bound = self.options.get(CoreOptions.SCAN_BOUNDED_WATERMARK)
+        if bound is not None and snapshot.watermark is not None and \
+                snapshot.watermark > bound:
+            # bounded stream: event time passed the bound — end of
+            # stream (reference BoundedWatermarkFollowUpScanner)
+            self._next = None
+            return None
         self._next += 1
         if self._use_changelog:
             # reference ChangelogFollowUpScanner: read the snapshot's
             # changelog files (empty plan if it carries none)
             return self._scan.plan_changelog(snapshot, streaming=True)
-        # reference DeltaFollowUpScanner: APPEND snapshots only
+        # reference DeltaFollowUpScanner: APPEND snapshots only (plus
+        # OVERWRITE deltas when streaming-read-overwrite is on)
         if snapshot.commit_kind == CommitKind.APPEND:
+            return self._scan.plan_delta(snapshot, streaming=True)
+        if snapshot.commit_kind == CommitKind.OVERWRITE and \
+                self.options.get(CoreOptions.STREAMING_READ_OVERWRITE):
             return self._scan.plan_delta(snapshot, streaming=True)
         return ScanPlan(snapshot.id, [], streaming=True)

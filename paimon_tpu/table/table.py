@@ -414,6 +414,18 @@ class TableCommit:
         TableCommitImpl#withWatermark)."""
         index_entries = [e for m in messages
                          for e in getattr(m, "index_entries", [])]
+        # empty batch commits produce no snapshot unless forced
+        # (reference snapshot.ignore-empty-commit, default on for batch
+        # writers; streaming keeps empty snapshots for exactly-once
+        # progress tracking)
+        ignore_empty = self.table.options.get(
+            CoreOptions.SNAPSHOT_IGNORE_EMPTY_COMMIT)
+        if ignore_empty is None:
+            ignore_empty = commit_identifier == BATCH_COMMIT_IDENTIFIER
+        if ignore_empty and not messages and not index_entries and \
+                self._overwrite is None and not self.table.options.get(
+                    CoreOptions.COMMIT_FORCE_CREATE_SNAPSHOT):
+            return None
         if self._overwrite is not None:
             sid = self._commit.overwrite(
                 messages, partition_filter=self._overwrite or None,
@@ -424,7 +436,10 @@ class TableCommit:
             sid = self._commit.commit(
                 messages, commit_identifier,
                 index_entries=index_entries or None,
-                watermark=watermark)
+                watermark=watermark,
+                # a streaming empty commit still snapshots so the
+                # identifier is durable for exactly-once replay dedup
+                force_create=not ignore_empty)
         if sid is not None and self.table.options.get(
                 CoreOptions.TAG_AUTOMATIC_CREATION) not in (None, "none"):
             # reference TagAutoManager rides the commit callback
@@ -564,6 +579,15 @@ class TableScan:
         fallback = opts.get(CoreOptions.SCAN_FALLBACK_BRANCH)
         if fallback and fallback != table.branch:
             plan = self._with_fallback_partitions(plan, fallback)
+        if opts.get(CoreOptions.SCAN_PLAN_SORT_PARTITION):
+            # raw partition values (typed order, not lexicographic str);
+            # None sorts first within its position
+            plan = ScanPlan(
+                plan.snapshot_id,
+                sorted(plan.splits,
+                       key=lambda s: tuple((v is not None, v)
+                                           for v in s.partition)),
+                streaming=plan.streaming)
         return plan
 
     def _with_fallback_partitions(self, plan: ScanPlan,

@@ -90,11 +90,12 @@ class FileStorePathFactory:
         self._counter += 1
         return f"{self.data_file_prefix}{self._write_uuid}-{n}.{extension}"
 
-    def new_changelog_file_name(self, extension: str = "parquet") -> str:
+    def new_changelog_file_name(self, extension: str = "parquet",
+                                prefix: str = None) -> str:
         n = self._counter
         self._counter += 1
-        return (f"{self.changelog_file_prefix}{self._write_uuid}-{n}"
-                f".{extension}")
+        return (f"{prefix or self.changelog_file_prefix}"
+                f"{self._write_uuid}-{n}.{extension}")
 
     def new_index_file_name(self) -> str:
         return f"index-{uuid.uuid4()}-0"

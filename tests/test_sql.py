@@ -394,6 +394,36 @@ class TestDdlDml:
         assert ctx.sql("SELECT count(*) AS n FROM orders") \
             .to_pylist() == [{"n": 3}]
 
+    def test_delete_rejects_partial_where(self, ctx):
+        # a WHERE whose AND only partially converts must error, not
+        # delete the superset matched by the convertible conjunct
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        with pytest.raises(SQLError, match="DELETE WHERE"):
+            ctx.sql("DELETE FROM orders WHERE customer = 'bob' "
+                    "AND length(customer) = 99")
+        assert ctx.sql("SELECT count(*) AS n FROM orders") \
+            .to_pylist() == [{"n": 5}]
+
+    def test_insert_paren_select(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE TABLE t2 (id BIGINT)")
+        ctx.sql("INSERT INTO t2 (SELECT id FROM orders WHERE id <= 2)")
+        assert sorted(ctx.sql("SELECT * FROM t2")
+                      .column("id").to_pylist()) == [1, 2]
+
+    def test_explain_reads_no_data(self, ctx, monkeypatch):
+        _setup_orders(ctx)
+        from paimon_tpu.table.table import FileStoreTable
+
+        def boom(self, *a, **k):
+            raise AssertionError("EXPLAIN must not read data")
+
+        monkeypatch.setattr(FileStoreTable, "to_arrow", boom)
+        plan = ctx.sql("EXPLAIN SELECT id FROM orders WHERE id > 3")
+        assert "pushed predicate" in \
+            "\n".join(plan.column("plan").to_pylist())
+
     def test_update(self, ctx):
         _setup_orders(ctx)
         r = ctx.sql("UPDATE orders SET amount = amount + 1, qty = 0 "

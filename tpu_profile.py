@@ -1,0 +1,109 @@
+"""One-shot TPU profile: where does merge time go on the tunneled chip?
+Measures H2D bandwidth, multi-operand sort time, winner-select time,
+D2H, and MXU sanity.  Run as the ONLY TPU client."""
+
+import time
+
+import numpy as np
+
+
+def timeit(label, fn, n=3):
+    import jax
+    # first call includes compile; report both
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    first = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label}: first={first:.3f}s best={best:.3f}s", flush=True)
+    return out, best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend(), flush=True)
+
+    # --- H2D bandwidth ---
+    for mb in (64, 256):
+        arr = np.random.randint(0, 1 << 30, (mb << 20) // 4,
+                                dtype=np.int32)
+        t0 = time.perf_counter()
+        d = jax.device_put(arr)
+        d.block_until_ready()
+        dt = time.perf_counter() - t0
+        print(f"h2d {mb}MB: {dt:.3f}s = {mb / dt:.0f} MB/s", flush=True)
+    # --- D2H ---
+    t0 = time.perf_counter()
+    _ = np.asarray(d)
+    dt = time.perf_counter() - t0
+    print(f"d2h 256MB: {dt:.3f}s = {256 / dt:.0f} MB/s", flush=True)
+
+    # --- MXU sanity: bf16 matmul ---
+    a = jnp.ones((8192, 8192), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a):
+        return a @ a
+
+    _, best = timeit("matmul 8192^3 bf16", lambda: mm(a))
+    print(f"  -> {2 * 8192**3 / best / 1e12:.1f} TFLOP/s", flush=True)
+
+    # --- the merge plane's actual shape: 16M padded rows, 3 lanes ---
+    n = 1 << 24
+    lanes = [jnp.asarray(np.random.randint(0, 1 << 31, n, np.uint32))
+             for _ in range(3)]
+    seq_hi = jnp.zeros(n, jnp.uint32)
+    seq_lo = jnp.asarray(np.arange(n, dtype=np.uint32))
+    inv = jnp.zeros(n, jnp.uint32)
+
+    @jax.jit
+    def sort_only(lanes, seq_hi, seq_lo, inv):
+        import jax.lax as lax
+        n_ = lanes[0].shape[0]
+        iota = lax.iota(jnp.uint32, n_)
+        ops = [inv] + list(lanes) + [seq_hi, seq_lo, iota]
+        out = lax.sort(tuple(ops), num_keys=len(ops) - 1)
+        return out[-1]
+
+    timeit("lax.sort 16M x (6 keys)", lambda: sort_only(
+        lanes, seq_hi, seq_lo, inv))
+
+    # packed 2-lane -> u64 single-key variant
+    @jax.jit
+    def sort_packed(l0, l1, seq):
+        import jax.lax as lax
+        n_ = l0.shape[0]
+        key = (l0.astype(jnp.uint64) << 32) | l1.astype(jnp.uint64)
+        iota = lax.iota(jnp.uint32, n_)
+        out = lax.sort((key, seq, iota), num_keys=2)
+        return out[-1]
+
+    seq64 = jnp.asarray(np.arange(n, dtype=np.uint64))
+    timeit("lax.sort 16M packed u64+seq",
+           lambda: sort_packed(lanes[0], lanes[1], seq64))
+
+    # full device_sorted_winners end-to-end (incl. transfers both ways)
+    from paimon_tpu.ops.merge import device_sorted_winners
+    lanes_np = np.stack([np.asarray(x) for x in lanes[:2]], axis=1)
+    seq_np = np.arange(n, dtype=np.int64)
+    t0 = time.perf_counter()
+    perm, winner, prev = device_sorted_winners(lanes_np, seq_np, "last")
+    dt = time.perf_counter() - t0
+    print(f"device_sorted_winners 16M e2e first: {dt:.3f}s "
+          f"({n / dt / 1e6:.2f}M rows/s)", flush=True)
+    t0 = time.perf_counter()
+    perm, winner, prev = device_sorted_winners(lanes_np, seq_np, "last")
+    dt = time.perf_counter() - t0
+    print(f"device_sorted_winners 16M e2e warm: {dt:.3f}s "
+          f"({n / dt / 1e6:.2f}M rows/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
