@@ -181,17 +181,29 @@ def main():
 
         baseline = heap_merge_baseline(tmp, min(rows, 2_000_000), runs)
 
+        from paimon_tpu.ops import merge as _merge
+        _merge.PATH_COUNTS.update(host=0, device=0)
         t0 = time.perf_counter()
         sid = table.compact(full=True)
         dt = time.perf_counter() - t0
         assert sid is not None
         ours = rows / dt
+
+    # link-adaptive observability: which sort path ran, and why
+    path_note = ""
+    if not platform.startswith("cpu"):
+        pc = dict(_merge.PATH_COUNTS)
+        bw = _merge._LINK_BW
+        link = (f", link h2d={bw[0] / 1e6:.0f}MB/s "
+                f"d2h={bw[1] / 1e6:.0f}MB/s" if bw else "")
+        path_note = (f"; adaptive merge paths host={pc['host']} "
+                     f"device={pc['device']}{link}")
     print(json.dumps({
         "metric": "full_compaction_rows_per_sec",
         "value": round(ours, 1),
         "unit": (f"rows/s ({rows} rows, {runs} runs, dedup, parquet, "
                  f"platform={platform}; baseline=heapq k-way merge "
-                 f"{round(baseline, 1)} rows/s)"),
+                 f"{round(baseline, 1)} rows/s{path_note})"),
         "vs_baseline": round(ours / baseline, 3),
     }))
 

@@ -124,6 +124,88 @@ def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int,
     return fn
 
 
+@lru_cache(maxsize=64)
+def _merge_fn_packed(num_lanes: int, keep: str, num_key_lanes: int,
+                     use_pallas: bool):
+    """Winners-only variant: ONE uint32[N] output, perm in the low 31
+    bits and the winner flag in bit 31.  Callers that never read `prev`
+    or intra-segment order pull 4 bytes/row off the device instead of
+    13 — the dominant cost on PCIe-attached and (especially) tunneled
+    chips where device->host is the narrow direction."""
+
+    @jax.jit
+    def fn(lanes, seq_hi, seq_lo, invalid):
+        perm, winner, _ = segmented_merge_body(
+            [lanes[i] for i in range(num_lanes)], seq_hi, seq_lo, invalid,
+            keep, num_key_lanes=num_key_lanes, use_pallas=use_pallas)
+        return perm.astype(jnp.uint32) | (
+            winner.astype(jnp.uint32) << 31)
+
+    return fn
+
+
+# (host->device bytes/s, device->host bytes/s), measured once per
+# process on the live accelerator link: over a network-tunneled chip
+# d2h collapses to ~8MB/s (TPU_PROFILE.log) while a PCIe-attached chip
+# does GB/s, and the merge path choice hinges on exactly this number
+_LINK_BW: Optional[Tuple[float, float]] = None
+
+# merges taken per path this process (observability: bench + metrics)
+PATH_COUNTS = {"host": 0, "device": 0}
+
+# cost-model constants (rows/s), calibrated from TPU_PROFILE.log and
+# the CPU-fallback bench: the device measured ~80M sorted rows/s with
+# data resident — 50e6 is a deliberate ~1.6x derate covering dispatch
+# and padding overhead; the host packed-key argsort path does ~1.5M
+# rows/s and the general lexsort ~0.7M
+_DEVICE_SORT_ROWS_PER_SEC = 50e6
+_HOST_FAST_ROWS_PER_SEC = 1.5e6
+_HOST_GENERAL_ROWS_PER_SEC = 0.7e6
+
+
+def _measure_link_bandwidth() -> Tuple[float, float]:
+    global _LINK_BW
+    if _LINK_BW is not None:
+        return _LINK_BW
+    import time as _time
+    size = 8 << 20
+    # one unmeasured warm-up round: the very first transfers absorb
+    # buffer-pool/backend warm-up and would read far below the true
+    # bandwidth, permanently misrouting merges to the host path
+    warm = jax.device_put(np.zeros(size, np.uint8))
+    warm.block_until_ready()
+    np.asarray(warm)
+    h2d_best = d2h_best = 0.0
+    for _ in range(2):                         # best-of-2 measured
+        buf = np.zeros(size, np.uint8)
+        t0 = _time.perf_counter()
+        d = jax.device_put(buf)
+        d.block_until_ready()
+        h2d_best = max(h2d_best,
+                       size / max(_time.perf_counter() - t0, 1e-9))
+        t0 = _time.perf_counter()
+        np.asarray(d)
+        d2h_best = max(d2h_best,
+                       size / max(_time.perf_counter() - t0, 1e-9))
+    _LINK_BW = (h2d_best, d2h_best)
+    return _LINK_BW
+
+
+def _device_path_pays(n: int, num_lanes: int, winners_only: bool,
+                      host_fast: bool) -> bool:
+    """Cost model: offload the sort only when transfer+compute beats
+    the host sort.  The accelerator wins on wide links; a tunneled chip
+    loses on device->host alone and the merge stays host-side."""
+    m = _pad_size(n)
+    h2d, d2h = _measure_link_bandwidth()
+    bytes_in = m * (4 * num_lanes + 12)          # lanes + seq hi/lo + inv
+    bytes_out = m * (4 if winners_only else 9)   # packed vs perm+win+prev
+    t_dev = bytes_in / h2d + bytes_out / d2h + m / _DEVICE_SORT_ROWS_PER_SEC
+    host_rate = _HOST_FAST_ROWS_PER_SEC if host_fast \
+        else _HOST_GENERAL_ROWS_PER_SEC
+    return t_dev < n / host_rate
+
+
 def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
                               keep: str
                               ) -> Tuple[np.ndarray, np.ndarray,
@@ -203,20 +285,42 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     (never full perm ordering within segments nor prev), unlocking the
     packed-key fast path for fixed-width two-lane keys.
     Returns (perm, winner_mask, prev_in_segment) as numpy arrays — of
-    the power-of-two padded size on an accelerator backend, UNPADDED
-    (length N, all rows valid) on the cpu backend's lexsort fallback.
-    Callers must select via the winner mask / `perm < n`, never assume
-    a padded length.  Set PAIMON_FORCE_DEVICE_SORT=1 to exercise the
-    kernel path on cpu (tests of the padding/validity logic).
+    the power-of-two padded size on the accelerator path, UNPADDED
+    (length N, all rows valid) on the host lexsort path.  Callers must
+    select via the winner mask / `perm < n`, never assume a padded
+    length.
+
+    Path selection is LINK-ADAPTIVE on accelerator backends: the first
+    call measures h2d/d2h bandwidth and each merge offloads only when
+    the modeled transfer+sort time beats the host sort
+    (_device_path_pays) — a PCIe chip takes the device path, a slow
+    tunnel keeps data-heavy merges host-side.  Overrides:
+    PAIMON_FORCE_DEVICE_SORT=1 pins the device kernel (also on cpu,
+    for padding/validity tests); PAIMON_FORCE_HOST_SORT=1 pins the
+    host path.
     """
     import os as _os
     n, num_key_lanes = lanes.shape
-    if jax.default_backend() == "cpu" and n > 0 and \
-            _os.environ.get("PAIMON_FORCE_DEVICE_SORT") != "1":
+    force_device = _os.environ.get("PAIMON_FORCE_DEVICE_SORT") == "1"
+    force_host = _os.environ.get("PAIMON_FORCE_HOST_SORT") == "1"
+    host_fast = (num_key_lanes == 2 and winners_only
+                 and (order_lanes is None or order_lanes.shape[1] == 0))
+    use_host = force_host
+    if not use_host and not force_device and n > 0:
+        if jax.default_backend() == "cpu":
+            use_host = True
+        else:
+            nl = lanes.shape[1] + (order_lanes.shape[1]
+                                   if order_lanes is not None else 0)
+            use_host = not _device_path_pays(n, nl, winners_only,
+                                             host_fast)
+    if use_host:
+        PATH_COUNTS["host"] += 1
         full = lanes if order_lanes is None or order_lanes.shape[1] == 0 \
             else np.concatenate([lanes, order_lanes], axis=1)
         return _host_sorted_winners(full, seq, keep, num_key_lanes,
                                     need_prev=not winners_only)
+    PATH_COUNTS["device"] += 1
     if order_lanes is not None and order_lanes.shape[1] > 0:
         lanes = np.concatenate([lanes, order_lanes], axis=1)
     num_lanes = lanes.shape[1]
@@ -235,19 +339,28 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                                                pallas_enabled)
     lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
     use_pallas = pallas_enabled()
+    builder = _merge_fn_packed if winners_only else _merge_fn
     try:
-        fn = _merge_fn(num_lanes, keep, num_key_lanes, use_pallas)
-        perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
-                                jnp.asarray(seq_lo), jnp.asarray(invalid))
+        fn = builder(num_lanes, keep, num_key_lanes, use_pallas)
+        out = fn(lane_list, jnp.asarray(seq_hi),
+                 jnp.asarray(seq_lo), jnp.asarray(invalid))
     except jax.errors.JaxRuntimeError:
         # a Mosaic compile rejection on the real backend must not fail
         # the merge: drop to the pure-XLA kernel for the whole process
         if not use_pallas:
             raise
         disable_pallas_runtime("Mosaic compile failed")
-        fn = _merge_fn(num_lanes, keep, num_key_lanes, False)
-        perm, winner, prev = fn(lane_list, jnp.asarray(seq_hi),
-                                jnp.asarray(seq_lo), jnp.asarray(invalid))
+        fn = builder(num_lanes, keep, num_key_lanes, False)
+        out = fn(lane_list, jnp.asarray(seq_hi),
+                 jnp.asarray(seq_lo), jnp.asarray(invalid))
+    if winners_only:
+        # one 4-byte word/row off the device: perm | (winner << 31)
+        packed = np.asarray(out)
+        perm = (packed & np.uint32(0x7FFFFFFF)).astype(np.int32)
+        winner = (packed >> np.uint32(31)).astype(bool)
+        prev = np.broadcast_to(np.int64(-1), m)
+        return perm, winner, prev
+    perm, winner, prev = out
     return (np.asarray(perm), np.asarray(winner), np.asarray(prev))
 
 
