@@ -1,0 +1,142 @@
+"""Native (C) runtime components, loaded through ctypes.
+
+The hot host-side sort of the merge plane compiles from
+`radix_sort.c` on first use (gcc/cc, -O3) into a cached shared object
+next to this file; everything degrades gracefully to the numpy path
+when no compiler is available or PAIMON_DISABLE_NATIVE=1.
+
+This is the framework's native-runtime layer in the sense of the
+reference's C/JVM-intrinsic sort machinery (paimon-core
+sort/BinaryInMemorySortBuffer, codegen'd comparators): Python stays
+the control plane, the per-row inner loops live in C.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "radix_sort.c")
+_LIB_NAME = "_paimon_native.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compiler():
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if cc and shutil.which(cc):
+            return cc
+    return None
+
+
+def _build(cc: str, use_cache: bool = True) -> Optional[str]:
+    """Compile the shared object; prefer caching it next to the source,
+    fall back to a temp dir when the package dir is not writable.  The
+    last failure's stderr is reported only if every location fails."""
+    errors = []
+    for make_dir in (lambda: _DIR,
+                     lambda: tempfile.mkdtemp(prefix="paimon_native_")):
+        out_dir = make_dir()
+        out = os.path.join(out_dir, _LIB_NAME)
+        if use_cache and os.path.exists(out) and \
+                os.path.getmtime(out) >= os.path.getmtime(_SRC):
+            return out
+        tmp = out + f".build-{os.getpid()}"
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+            if proc.returncode != 0:
+                errors.append(proc.stderr[-1000:])
+                continue             # e.g. read-only dir: try the next
+            os.replace(tmp, out)     # atomic vs concurrent builders
+            return out
+        except (OSError, subprocess.TimeoutExpired) as e:
+            errors.append(str(e))
+            continue
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    if errors:
+        sys.stderr.write(f"paimon_tpu.native: build failed:\n"
+                         f"{errors[-1]}\n")
+    return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None when
+    unavailable (no compiler / disabled / build failure)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("PAIMON_DISABLE_NATIVE") == "1":
+        return None
+    cc = _compiler()
+    if cc is None:
+        return None
+    lib = None
+    for use_cache in (True, False):
+        path = _build(cc, use_cache=use_cache)
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            break
+        except OSError:
+            # a cached .so from another platform/arch (or stale): drop
+            # the cache and compile fresh for this machine
+            lib = None
+            continue
+    if lib is None:
+        return None
+    i64 = ctypes.c_int64
+    p_u64 = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.radix_argsort_u64.argtypes = [p_u64, i64, p_i32]
+    lib.radix_argsort_u64.restype = ctypes.c_int
+    lib.merge_winners_u64.argtypes = [p_u64, p_i64, i64, ctypes.c_int,
+                                      p_i32, p_u8]
+    lib.merge_winners_u64.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def radix_argsort(keys: np.ndarray) -> Optional[np.ndarray]:
+    """Stable ascending argsort of uint64 keys via the C radix sort;
+    None when the native library is unavailable (caller falls back)."""
+    lib = load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    perm = np.empty(len(keys), dtype=np.int32)
+    if lib.radix_argsort_u64(keys, len(keys), perm) != 0:
+        return None
+    return perm
+
+
+def merge_winners(keys: np.ndarray, seq: np.ndarray, keep_last: bool
+                  ) -> Optional[tuple]:
+    """(perm, winner_mask_in_sorted_order) via the fused C path, or
+    None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    seq = np.ascontiguousarray(seq, dtype=np.int64)
+    n = len(keys)
+    perm = np.empty(n, dtype=np.int32)
+    winner = np.empty(n, dtype=np.uint8)
+    if lib.merge_winners_u64(keys, seq, n, int(keep_last), perm,
+                             winner) != 0:
+        return None
+    return perm, winner.view(bool)

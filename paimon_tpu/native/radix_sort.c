@@ -1,0 +1,158 @@
+/* Stable LSD radix argsort for the merge plane's packed 64-bit keys.
+ *
+ * The host sort path (ops/merge.py _host_sorted_winners_fast) spends
+ * most of its time in np.argsort's comparison sort; an LSD radix sort
+ * is O(n * passes) with sequential memory traffic and no comparisons —
+ * ~3-4x faster at compaction scale on one core.  The native runtime
+ * counterpart of the reference's JVM sorters (paimon-core
+ * sort/BinaryInMemorySortBuffer + Arrays.sort loops), built as a plain
+ * C ABI shared object loaded via ctypes (no CPython API).
+ *
+ * Byte passes whose value is constant across all keys are skipped
+ * (normalized keys share sign/prefix bytes), so 8-byte keys usually
+ * take 3-5 scatter passes instead of 8.
+ *
+ * radix_argsort_u64(keys, n, perm):
+ *   keys : uint64_t[n]  input, unmodified
+ *   n    : rows
+ *   perm : int32_t[n]   output: stable ascending argsort of keys
+ * returns 0 on success, -1 on allocation failure (caller falls back).
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+int radix_argsort_u64(const uint64_t *keys, int64_t n, int32_t *perm) {
+    if (n <= 0) return 0;
+
+    /* one histogram pass for all 8 byte positions */
+    static const int P = 8;
+    int64_t (*hist)[256] = calloc(P, sizeof(*hist));
+    if (!hist) return -1;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t k = keys[i];
+        for (int p = 0; p < P; p++)
+            hist[p][(k >> (p * 8)) & 0xFF]++;
+    }
+
+    int active[8], n_active = 0;
+    for (int p = 0; p < P; p++) {
+        int constant = 0;
+        for (int b = 0; b < 256; b++)
+            if (hist[p][b] == n) { constant = 1; break; }
+        if (!constant) active[n_active++] = p;
+    }
+    if (n_active == 0) {                    /* all keys identical */
+        for (int64_t i = 0; i < n; i++) perm[i] = (int32_t)i;
+        free(hist);
+        return 0;
+    }
+
+    uint64_t *ka = malloc((size_t)n * sizeof(uint64_t));
+    uint64_t *kb = malloc((size_t)n * sizeof(uint64_t));
+    int32_t *pa = malloc((size_t)n * sizeof(int32_t));
+    int32_t *pb = malloc((size_t)n * sizeof(int32_t));
+    if (!ka || !kb || !pa || !pb) {
+        free(ka); free(kb); free(pa); free(pb); free(hist);
+        return -1;
+    }
+
+    const uint64_t *src_k = keys;           /* pass 1 reads the input */
+    const int32_t *src_p = NULL;            /* implicit iota */
+    uint64_t *dst_k = ka;
+    int32_t *dst_p = pa;
+
+    for (int a = 0; a < n_active; a++) {
+        int p = active[a];
+        int shift = p * 8;
+        int64_t offs[256], acc = 0;
+        for (int b = 0; b < 256; b++) { offs[b] = acc; acc += hist[p][b]; }
+
+        if (src_p == NULL) {
+            for (int64_t i = 0; i < n; i++) {
+                uint64_t k = src_k[i];
+                int64_t o = offs[(k >> shift) & 0xFF]++;
+                dst_k[o] = k;
+                dst_p[o] = (int32_t)i;
+            }
+        } else {
+            for (int64_t i = 0; i < n; i++) {
+                uint64_t k = src_k[i];
+                int64_t o = offs[(k >> shift) & 0xFF]++;
+                dst_k[o] = k;
+                dst_p[o] = src_p[i];
+            }
+        }
+        src_k = dst_k;
+        src_p = dst_p;
+        dst_k = (dst_k == ka) ? kb : ka;
+        dst_p = (dst_p == pa) ? pb : pa;
+    }
+
+    memcpy(perm, src_p, (size_t)n * sizeof(int32_t));
+    free(ka); free(kb); free(pa); free(pb); free(hist);
+    return 0;
+}
+
+/* Fused entry: radix argsort + segmented winner selection without the
+ * intermediate keys[perm] gather bouncing through Python.
+ *   keys/seq : uint64_t[n] / int64_t[n] input
+ *   perm     : int32_t[n] out — stable ascending key order
+ *   winner   : uint8_t[n] out — winner[i]=1 iff sorted position i wins
+ * returns 0, or -1 on allocation failure. */
+int merge_winners_u64(const uint64_t *keys, const int64_t *seq,
+                      int64_t n, int keep_last,
+                      int32_t *perm, uint8_t *winner);
+
+/* Segmented winners in one pass over radix-sorted keys: for each run of
+ * equal keys pick the entry with max (seq, perm) [keep_last=1] or min
+ * (seq, perm) [keep_last=0], writing a winner bitmask.  Fuses what the
+ * Python path does with reduceat + three temporaries.
+ *
+ * sorted_keys/sorted_perm: the radix output order; seq indexed by perm.
+ * winner: uint8_t[n] out (1 = winner of its segment, in sorted order).
+ */
+void segment_winners_i64(const uint64_t *sorted_keys,
+                         const int32_t *sorted_perm,
+                         const int64_t *seq, int64_t n, int keep_last,
+                         uint8_t *winner) {
+    if (n <= 0) return;
+    memset(winner, 0, (size_t)n);
+    int64_t best_i = 0;
+    int64_t best_seq = seq[sorted_perm[0]];
+    int32_t best_arr = sorted_perm[0];
+    for (int64_t i = 1; i <= n; i++) {
+        if (i == n || sorted_keys[i] != sorted_keys[i - 1]) {
+            winner[best_i] = 1;
+            if (i < n) {
+                best_i = i;
+                best_seq = seq[sorted_perm[i]];
+                best_arr = sorted_perm[i];
+            }
+            continue;
+        }
+        int64_t s = seq[sorted_perm[i]];
+        int32_t arr = sorted_perm[i];
+        int better;
+        if (keep_last)
+            better = (s > best_seq) || (s == best_seq && arr > best_arr);
+        else
+            better = (s < best_seq) || (s == best_seq && arr < best_arr);
+        if (better) { best_i = i; best_seq = s; best_arr = arr; }
+    }
+}
+
+int merge_winners_u64(const uint64_t *keys, const int64_t *seq,
+                      int64_t n, int keep_last,
+                      int32_t *perm, uint8_t *winner) {
+    if (n <= 0) return 0;
+    int rc = radix_argsort_u64(keys, n, perm);
+    if (rc != 0) return rc;
+    uint64_t *sorted_keys = malloc((size_t)n * sizeof(uint64_t));
+    if (!sorted_keys) return -1;
+    for (int64_t i = 0; i < n; i++) sorted_keys[i] = keys[perm[i]];
+    segment_winners_i64(sorted_keys, perm, seq, n, keep_last, winner);
+    free(sorted_keys);
+    return 0;
+}
