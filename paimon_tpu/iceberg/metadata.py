@@ -10,11 +10,12 @@ reference: iceberg/IcebergCommitCallback.java + iceberg/metadata/*
     <table>/metadata/manifest-<uuid>.avro        (manifest entries)
 
 Only data files the CURRENT paimon snapshot references are exported
-(each sync is a full replacement snapshot — operation 'overwrite'),
-matching the reference's primary-key-table strategy where Iceberg
-readers see merged top-level data only when possible; here every live
-file is exported and Iceberg readers see the raw (unmerged) rows of
-append tables and the full file set of pk tables.
+(each sync is a full replacement snapshot — operation 'overwrite').
+Append tables export every live file; primary-key tables export the
+READ-OPTIMIZED view — top-level (fully compacted) files only, since an
+Iceberg reader cannot run the merge — so upserts become visible to
+Iceberg readers after a full compaction, matching the reference's pk
+contract (docs/iceberg).
 """
 
 from __future__ import annotations
@@ -162,10 +163,20 @@ def sync_iceberg(table) -> Optional[str]:
     fio = table.file_io
 
     entry_schema, part_keys = _partition_entry_schema(schema)
+    # primary-key tables expose the READ-OPTIMIZED view: only top-level
+    # (fully compacted, non-overlapping, deletes folded) files are
+    # consumable by an engine that cannot run the merge — matching the
+    # reference's Iceberg compat contract for pk tables ("visible after
+    # full compaction", docs/iceberg + IcebergCommitCallback)
+    max_level = None
+    if table.primary_keys:
+        max_level = table.options.max_level
     records = []
     total_rows = 0
     for e in entries:
         if e.bucket == -2:
+            continue
+        if max_level is not None and e.file.level != max_level:
             continue
         partition = scan._partition_codec.from_bytes(e.partition)
         path = scan.path_factory.data_file_path(partition, e.bucket,

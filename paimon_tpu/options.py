@@ -516,6 +516,13 @@ class CoreOptions:
         return v
 
     @property
+    def max_level(self) -> int:
+        """The LSM's top level — the single definition shared by the
+        read-optimized view (system.py, iceberg/metadata.py) and the
+        sharded compaction/rescale output level."""
+        return self.num_levels - 1
+
+    @property
     def max_size_amplification_percent(self) -> int:
         return self.options.get(
             CoreOptions.COMPACTION_MAX_SIZE_AMPLIFICATION_PERCENT)

@@ -190,7 +190,7 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None
         index_spec=table.options.file_index_spec,
         bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
         format_per_level=table.options.file_format_per_level)
-    max_level = table.options.num_levels - 1
+    max_level = table.options.max_level
 
     messages: List[CommitMessage] = []
     for b, gids in sorted(routing.items()):

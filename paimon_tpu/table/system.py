@@ -192,7 +192,7 @@ def _read_optimized(table) -> pa.Table:
         return table.to_arrow().slice(0, 0)
     if not table.primary_keys:
         return table.to_arrow()
-    max_level = table.options.num_levels - 1
+    max_level = table.options.max_level
     scan = table.new_scan().with_level_filter(
         lambda level: level == max_level)
     plan = scan.plan(snapshot)

@@ -35,12 +35,15 @@ def test_iceberg_metadata_export(tmp_warehouse):
                                   schema)
     _commit(table, [{"id": 1, "dt": "d1", "v": 1.0},
                     {"id": 2, "dt": "d2", "v": 2.0}])
+    # pk tables export the read-optimized view: only fully-compacted
+    # top-level files are visible to Iceberg readers
+    table.compact(full=True)
     meta_path = table.sync_iceberg()
     assert meta_path.endswith("v1.metadata.json")
 
     meta = json.loads(open(meta_path).read())
     assert meta["format-version"] == 2
-    assert meta["current-snapshot-id"] == 1
+    assert meta["current-snapshot-id"] == 2   # write + compact
     sch = meta["schemas"][0]
     assert [f["name"] for f in sch["fields"]] == ["id", "dt", "v"]
     assert sch["fields"][0]["required"] is True
@@ -69,4 +72,75 @@ def test_iceberg_metadata_export(tmp_warehouse):
                              "version-hint.text")).read()
     assert hint == "2"
     meta2d = json.loads(open(meta2).read())
-    assert meta2d["current-snapshot-id"] == 2
+    assert meta2d["current-snapshot-id"] == 3
+
+
+# ---------------------------------------------------------------------------
+# independent reader round-trip (the external-consumer check)
+# ---------------------------------------------------------------------------
+
+def test_reader_roundtrip_append(tmp_warehouse):
+    from paimon_tpu.iceberg.reader import IcebergTable
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .options({"bucket": "-1"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "a"),
+                                  schema)
+    _commit(table, [{"id": i, "v": i * 0.5} for i in range(100)])
+    _commit(table, [{"id": i, "v": i * 0.5} for i in range(100, 150)])
+    table.sync_iceberg()
+
+    ice = IcebergTable.load(table.path)
+    assert ice.column_names == ["id", "v"]
+    files = ice.plan_files()
+    assert len(files) == 2
+    got = ice.to_arrow()
+    expect = table.to_arrow()
+    assert sorted(got.column("id").to_pylist()) == \
+        sorted(expect.column("id").to_pylist())
+    assert got.num_rows == 150
+
+
+def test_reader_roundtrip_pk_read_optimized(tmp_warehouse):
+    from paimon_tpu.iceberg.reader import IcebergTable
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1", "write-only": "true"})
+              .build())
+    table = FileStoreTable.create(os.path.join(tmp_warehouse, "p"),
+                                  schema)
+    _commit(table, [{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}])
+    _commit(table, [{"id": 1, "v": 10.0}])            # upsert
+    table.sync_iceberg()
+    # nothing compacted yet: the read-optimized view is empty
+    ice = IcebergTable.load(table.path)
+    assert ice.plan_files() == []
+
+    table.compact(full=True)
+    table.sync_iceberg()
+    ice = IcebergTable.load(table.path)
+    got = ice.to_arrow().sort_by("id")
+    assert got.to_pylist() == [{"id": 1, "v": 10.0},
+                               {"id": 2, "v": 2.0}]
+    # and the merged read agrees
+    assert got.to_pylist() == \
+        table.to_arrow().sort_by("id").to_pylist()
+
+
+def test_reader_rejects_bad_metadata(tmp_path):
+    from paimon_tpu.iceberg.reader import IcebergTable
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="missing"):
+        IcebergTable({"format-version": 2}, None)
+    meta = {k: None for k in (
+        "format-version", "table-uuid", "location",
+        "last-sequence-number", "last-updated-ms", "last-column-id",
+        "current-schema-id", "schemas", "default-spec-id",
+        "partition-specs", "current-snapshot-id", "snapshots")}
+    meta.update({"format-version": 1})
+    with _pytest.raises(ValueError, match="format-version 2"):
+        IcebergTable(meta, None)
