@@ -96,6 +96,13 @@ class Catalog:
                      ignore_if_not_exists: bool = False):
         raise NotImplementedError
 
+    def system_table(self, name: str):
+        """Catalog-level `sys` database tables (all_tables,
+        all_partitions, all_table_options, catalog_options — reference
+        SystemTableLoader.loadGlobal)."""
+        from paimon_tpu.catalog.system import load_global_system_table
+        return load_global_system_table(self, name)
+
     def close(self):
         pass
 

@@ -170,6 +170,9 @@ class Compiler:
 
     def _func(self, e: ast.Func):
         name, args = e.name, e.args
+        if e.over is not None:
+            raise SQLError(f"window function {name}() OVER is only "
+                           f"allowed in SELECT items / ORDER BY")
         if name in _AGG_FUNCS:
             raise SQLError(f"aggregate {name}() not allowed here")
         a = [self.compile(x) for x in args]
@@ -399,6 +402,15 @@ class SQLContext:
         if ref.name in self._views:
             return self._views[ref.name], alias
         name = ref.name
+        if name.startswith("sys."):
+            # catalog-level system tables (reference `sys` database);
+            # they have no history — a time-travel clause would be
+            # silently wrong, so reject it
+            if ref.snapshot_id is not None or ref.tag is not None or \
+                    ref.timestamp_ms is not None:
+                raise SQLError("sys.* tables do not support time "
+                               "travel")
+            return self.catalog.system_table(name[4:]), alias
         system = None
         if "$" in name.split(".")[-1]:
             base, system = name.rsplit("$", 1)
@@ -508,8 +520,22 @@ class SQLContext:
         if s.having is not None and not has_agg:
             raise SQLError("HAVING requires GROUP BY or an aggregate; "
                            "use WHERE for row filters")
+        windows: Dict[str, ast.Func] = {}
+        for item in s.items:
+            for f in _find_windows(item.expr):
+                windows.setdefault(repr(f), f)
+        for e, _, _ in s.order_by:
+            for f in _find_windows(e):
+                windows.setdefault(repr(f), f)
+        if windows and has_agg:
+            raise SQLError("window functions cannot be mixed with "
+                           "GROUP BY / aggregates in one SELECT; use a "
+                           "subquery")
         if has_agg:
             out = self._aggregate(scope, s)
+        elif windows:
+            scope, win_subst = self._apply_windows(scope, windows)
+            out = self._project(scope, s, subst=win_subst)
         else:
             out = self._project(scope, s, subst=None)
         if s.distinct:
@@ -734,6 +760,145 @@ class SQLContext:
             gtable = gtable.filter(pc.fill_null(mask, False))
             gscope = Scope(gtable, order)
         return self._project(gscope, s, subst=agg_subst)
+
+    # -- window functions ----------------------------------------------------
+    def _apply_windows(self, scope: Scope,
+                       wfuncs: Dict[str, ast.Func]
+                       ) -> Tuple[Scope, Dict[str, str]]:
+        """Evaluate each window expression into a temp column of the
+        scope; returns (augmented scope, repr->column substitution).
+
+        Frames follow the engines' defaults: with ORDER BY, aggregates
+        use the running RANGE frame (UNBOUNDED PRECEDING..CURRENT ROW,
+        peers included) and last_value means "last peer"; without
+        ORDER BY the frame is the whole partition.  Functions sharing
+        an identical OVER spec share one sort."""
+        import numpy as np
+
+        table = scope.table
+        n = table.num_rows
+        comp = Compiler(scope)
+        subst: Dict[str, str] = {}
+        order_names = list(scope.order)
+
+        by_spec: Dict[str, List[Tuple[str, ast.Func]]] = {}
+        for key, f in wfuncs.items():
+            by_spec.setdefault(repr(f.over), []).append((key, f))
+
+        k = 0
+        for group in by_spec.values():
+            w = group[0][1].over
+            seg = _WindowSegments(comp, w, n)
+            for key, f in group:
+                col = self._window_column(comp, f, seg, n)
+                cname = f"__w{k}"
+                k += 1
+                table = table.append_column(cname, col)
+                order_names.append(cname)
+                subst[key] = cname
+        return Scope(table, order_names), subst
+
+    def _window_column(self, comp, f: ast.Func, seg: "_WindowSegments",
+                       n: int):
+        """One window function's values, in ORIGINAL row order."""
+        import numpy as np
+
+        name = f.name
+        order = seg.order
+        pos = np.arange(n)
+        if name == "row_number":
+            return seg.scatter(pos - seg.seg_first + 1)
+        if name in ("rank", "dense_rank"):
+            kc = seg.key_change
+            if name == "rank":
+                return seg.scatter(np.maximum.accumulate(
+                    np.where(kc, pos, 0)) - seg.seg_first + 1)
+            c = np.cumsum(kc)
+            return seg.scatter(c - c[seg.seg_first] + 1)
+        if name in ("lag", "lead"):
+            off = 1
+            if len(f.args) > 1:
+                off = int(comp._literal(f.args[1]))
+            default = comp._literal(f.args[2]) if len(f.args) > 2 \
+                else None
+            shift = -off if name == "lag" else off
+            cand = pos + shift
+            valid = (cand >= 0) & (cand < n)
+            cand_c = np.clip(cand, 0, max(n - 1, 0))
+            valid &= seg.seg_id[cand_c] == seg.seg_id
+            src_sorted = np.where(valid, order[cand_c], -1)
+            return seg.gather_arg(comp, f, src_sorted, default)
+        if name == "first_value":
+            return seg.gather_arg(comp, f, order[seg.seg_first], None)
+        if name == "last_value":
+            # with ORDER BY: last PEER of the current row; without:
+            # partition last
+            last = seg.peer_last if seg.has_order else seg.seg_last
+            return seg.gather_arg(comp, f, order[last], None)
+        if name in _AGG_FUNCS:
+            return self._window_aggregate(comp, f, seg, n)
+        raise SQLError(f"unsupported window function {name}()")
+
+    def _window_aggregate(self, comp, f: ast.Func,
+                          seg: "_WindowSegments", n: int):
+        import numpy as np
+
+        name = f.name
+        order = seg.order
+        star = name == "count" and (not f.args or
+                                    isinstance(f.args[0], ast.Star))
+        if star:
+            nn = np.ones(n, dtype=np.float64)
+            vals = nn
+            int_result = True
+        else:
+            v = comp.as_array(f.args[0])
+            if isinstance(v, pa.ChunkedArray):
+                v = v.combine_chunks()
+            nn = (~np.asarray(pc.is_null(v)))[order].astype(np.float64)
+            if name == "count":
+                vals = nn
+            else:
+                if not (pa.types.is_integer(v.type) or
+                        pa.types.is_floating(v.type) or
+                        pa.types.is_boolean(v.type)):
+                    raise SQLError(
+                        f"window {name}() needs a numeric argument")
+                int_result = pa.types.is_integer(v.type)
+                vals = np.asarray(pc.fill_null(
+                    pc.cast(v, pa.float64()), 0.0))[order]
+        if name == "count":
+            if seg.has_order:
+                cum = np.cumsum(nn)
+                res = seg.running(cum)
+            else:
+                res = np.add.reduceat(nn, seg.starts_idx)[seg.seg_id]
+            return seg.scatter(res.astype(np.int64))
+        if name in ("sum", "avg"):
+            if seg.has_order:
+                tot = seg.running(np.cumsum(vals * nn))
+                cnt = seg.running(np.cumsum(nn))
+            else:
+                tot = np.add.reduceat(vals * nn,
+                                      seg.starts_idx)[seg.seg_id]
+                cnt = np.add.reduceat(nn, seg.starts_idx)[seg.seg_id]
+            res = tot if name == "sum" else tot / np.maximum(cnt, 1)
+            if name == "sum" and not star and int_result:
+                res = res.astype(np.int64)
+            return seg.scatter(res, null_mask=cnt == 0)
+        # min / max
+        if seg.has_order:
+            raise SQLError(f"window {name}() with ORDER BY (running "
+                           f"frame) is not supported; omit ORDER BY "
+                           f"for the whole-partition value")
+        fillv = np.inf if name == "min" else -np.inf
+        vv = np.where(nn > 0, vals, fillv)
+        red = np.minimum if name == "min" else np.maximum
+        cnt = np.add.reduceat(nn, seg.starts_idx)[seg.seg_id]
+        res = red.reduceat(vv, seg.starts_idx)[seg.seg_id]
+        if int_result:
+            res = np.where(cnt == 0, 0, res).astype(np.int64)
+        return seg.scatter(res, null_mask=cnt == 0)
 
     # -- EXPLAIN ------------------------------------------------------------
     def _exec_explain(self, e: ast.Explain) -> pa.Table:
@@ -1023,6 +1188,119 @@ class SQLContext:
         raise SQLError(f"unknown procedure {c.procedure!r}")
 
 
+class _WindowSegments:
+    """Shared per-OVER-spec machinery: the partition/order sort, the
+    segment (partition) and peer (tie-group) structure in sorted order,
+    and scatter/gather back to original row order."""
+
+    def __init__(self, comp: Compiler, w, n: int):
+        import numpy as np
+
+        self.n = n
+        self.has_order = bool(w.order_by)
+        cols: Dict[str, Any] = {}
+        sort_keys = []
+        for i, pe in enumerate(w.partition_by):
+            cols[f"__wp{i}"] = comp.as_array(pe)
+            sort_keys.append((f"__wp{i}", "ascending", "at_end"))
+        for j, (oe, asc) in enumerate(w.order_by):
+            cols[f"__wo{j}"] = comp.as_array(oe)
+            sort_keys.append(
+                (f"__wo{j}", "ascending" if asc else "descending",
+                 "at_end"))
+        cols["__wi"] = pa.array(np.arange(n))
+        sort_keys.append(("__wi", "ascending", "at_end"))   # stable
+        self._st = pa.table(cols)
+        self.order = np.asarray(pc.sort_indices(self._st,
+                                                sort_keys=sort_keys))
+
+        seg_start = np.zeros(n, dtype=bool)
+        if n:
+            seg_start[0] = True
+        if w.partition_by and n > 1:
+            seg_start[1:] |= self._changed(
+                [f"__wp{i}" for i in range(len(w.partition_by))])
+        self.seg_start = seg_start
+        pos = np.arange(n)
+        self.seg_first = np.maximum.accumulate(
+            np.where(seg_start, pos, 0))
+        self.starts_idx = np.flatnonzero(seg_start)
+        self.seg_id = np.cumsum(seg_start) - 1
+        ends = np.append(self.starts_idx[1:] - 1, n - 1) if n else \
+            np.zeros(0, dtype=np.int64)
+        self.seg_last = ends[self.seg_id] if n else ends
+
+        # peer groups: rows equal on (partition, order) keys; without
+        # ORDER BY the whole partition is one peer group
+        kc = seg_start.copy()
+        if self.has_order and n > 1:
+            kc[1:] |= self._changed(
+                [f"__wo{j}" for j in range(len(w.order_by))])
+        self.key_change = kc
+        gstarts = np.flatnonzero(kc)
+        gid = np.cumsum(kc) - 1
+        gends = np.append(gstarts[1:] - 1, n - 1) if n else gstarts
+        self.peer_last = gends[gid] if n else gends
+
+    def _changed(self, names) -> "Any":
+        """bool[n-1]: sorted row i+1 differs from i on any named column
+        (nulls compare equal to nulls)."""
+        import numpy as np
+
+        n = self.n
+        out = np.zeros(max(n - 1, 0), dtype=bool)
+        for name in names:
+            colv = self._st.column(name).take(pa.array(self.order))
+            a, b = colv.slice(0, n - 1), colv.slice(1)
+            eq = np.asarray(pc.fill_null(pc.equal(a, b), False))
+            nulls = np.asarray(pc.is_null(colv))
+            eq |= nulls[:-1] & nulls[1:]
+            out |= ~eq
+        return out
+
+    def running(self, cum):
+        """RANGE-frame running value from a global cumsum over sorted
+        rows: the cumulative through the row's LAST PEER, minus
+        everything before its partition."""
+        import numpy as np
+
+        prev = np.where(self.seg_first > 0,
+                        cum[np.maximum(self.seg_first - 1, 0)], 0.0)
+        return cum[self.peer_last] - prev
+
+    def scatter(self, sorted_res, null_mask=None):
+        """sorted-order values -> arrow array in original row order."""
+        import numpy as np
+
+        out = np.empty(self.n, dtype=np.asarray(sorted_res).dtype)
+        out[self.order] = sorted_res
+        if null_mask is None:
+            return pa.array(out)
+        m = np.empty(self.n, dtype=bool)
+        m[self.order] = null_mask
+        return pa.array(out, mask=m)
+
+    def gather_arg(self, comp: Compiler, f, src_sorted, default):
+        """Type-preserving gather of f's first argument by
+        original-table row index (sorted-order indices; -1 = out of
+        frame -> `default` or null)."""
+        import numpy as np
+
+        if not f.args:
+            raise SQLError(f"{f.name}() needs an argument")
+        base = comp.as_array(f.args[0])
+        if isinstance(base, pa.ChunkedArray):
+            base = base.combine_chunks()
+        src = np.empty(self.n, dtype=np.int64)
+        src[self.order] = src_sorted
+        taken = base.take(pa.array(np.where(src < 0, 0, src)))
+        missing = pa.array(src < 0)
+        if default is not None:
+            return pc.if_else(missing, pa.scalar(default, base.type),
+                              taken)
+        return pc.if_else(missing, pa.nulls(self.n, base.type), taken)
+
+
 # ---------------------------------------------------------------------------
 # small AST utilities
 # ---------------------------------------------------------------------------
@@ -1067,14 +1345,16 @@ def _equi_pair(e, probe: Scope, left: Scope, right: Scope
     return None
 
 
-def _find_aggs(e) -> List[ast.Func]:
+def _find_funcs(e, pred) -> List[ast.Func]:
+    """Func nodes matching `pred`, top-down; a matched node's arguments
+    are not descended into (no nested aggregates/windows)."""
     out: List[ast.Func] = []
 
     def walk(x):
         if isinstance(x, ast.Func):
-            if x.name in _AGG_FUNCS:
+            if pred(x):
                 out.append(x)
-                return                      # no nested aggregates
+                return
             for a in x.args:
                 walk(a)
         elif isinstance(x, ast.Binary):
@@ -1088,11 +1368,8 @@ def _find_aggs(e) -> List[ast.Func]:
                 walk(v)
             if x.default is not None:
                 walk(x.default)
-        elif isinstance(x, ast.Cast):
-            walk(x.expr)
-        elif isinstance(x, (ast.IsNull, ast.LikeExpr)):
-            walk(x.expr)
-        elif isinstance(x, ast.InList):
+        elif isinstance(x, (ast.Cast, ast.IsNull, ast.LikeExpr,
+                            ast.InList)):
             walk(x.expr)
         elif isinstance(x, ast.BetweenExpr):
             walk(x.expr)
@@ -1100,6 +1377,17 @@ def _find_aggs(e) -> List[ast.Func]:
             walk(x.hi)
     walk(e)
     return out
+
+
+def _find_windows(e) -> List[ast.Func]:
+    """Window-function nodes (any func with an OVER clause)."""
+    return _find_funcs(e, lambda f: f.over is not None)
+
+
+def _find_aggs(e) -> List[ast.Func]:
+    """Plain aggregate calls (windowed aggregates are NOT aggregates)."""
+    return _find_funcs(e, lambda f: f.name in _AGG_FUNCS and
+                       f.over is None)
 
 
 def _display_name(e) -> str:

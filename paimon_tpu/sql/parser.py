@@ -181,10 +181,17 @@ class Binary:
 
 
 @dataclass
+class Window:
+    partition_by: List[Any] = field(default_factory=list)
+    order_by: List[Tuple[Any, bool]] = field(default_factory=list)
+
+
+@dataclass
 class Func:
     name: str
     args: List[Any]
     distinct: bool = False
+    over: Optional[Window] = None       # window function when set
 
 
 @dataclass
@@ -763,7 +770,33 @@ class Parser:
             while self.accept_op(","):
                 args.append(self.expr())
         self.expect_op(")")
-        return Func(name.lower(), args, distinct)
+        over = None
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.upper() == "OVER":
+            self.next()
+            self.expect_op("(")
+            over = Window()
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.upper() == "PARTITION":
+                self.next()
+                self.expect_kw("BY")
+                over.partition_by.append(self.expr())
+                while self.accept_op(","):
+                    over.partition_by.append(self.expr())
+            if self.accept_kw("ORDER"):
+                self.expect_kw("BY")
+                while True:
+                    e = self.expr()
+                    asc = True
+                    if self.accept_kw("DESC"):
+                        asc = False
+                    else:
+                        self.accept_kw("ASC")
+                    over.order_by.append((e, asc))
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+        return Func(name.lower(), args, distinct, over)
 
     def case_expr(self):
         whens = []

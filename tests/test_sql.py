@@ -477,3 +477,171 @@ class TestProceduresAndTravel:
         ctx.sql("INSERT INTO orders VALUES (11, 'y', 2.0, 1)")
         r = ctx.sql("CALL sys.expire_snapshots('orders', 1)")
         assert "expired" in r.column("result")[0].as_py()
+
+
+class TestGlobalSystemTables:
+    def test_sys_database_tables(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE DATABASE db2")
+        ctx.sql("CREATE TABLE db2.t2 (a INT) WITH ('bucket' = '-1')")
+        out = ctx.sql("SELECT * FROM sys.all_tables ORDER BY "
+                      "database_name, table_name")
+        rows = out.to_pylist()
+        assert [(r["database_name"], r["table_name"]) for r in rows] == \
+            [("db2", "t2"), ("default", "orders")]
+        assert rows[1]["record_count"] == 5
+
+        opts = ctx.sql("SELECT value FROM sys.all_table_options "
+                       "WHERE table_name = 'orders' AND key = 'bucket'")
+        assert opts.column("value").to_pylist() == ["2"]
+
+        cat = ctx.sql("SELECT * FROM sys.catalog_options")
+        keys = cat.column("key").to_pylist()
+        assert "warehouse" in keys
+
+    def test_sys_all_partitions(self, ctx):
+        ctx.sql("CREATE TABLE pt (p STRING NOT NULL, v INT) "
+                "PARTITIONED BY (p) WITH ('bucket' = '-1')")
+        ctx.sql("INSERT INTO pt VALUES ('x', 1), ('y', 2), ('x', 3)")
+        out = ctx.sql("SELECT * FROM sys.all_partitions "
+                      "WHERE table_name = 'pt' ORDER BY partition")
+        assert out.num_rows == 2
+        assert out.column("record_count").to_pylist() == [2, 1]
+
+
+class TestWindowFunctions:
+    def test_row_number(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql(
+            "SELECT id, row_number() OVER (PARTITION BY customer "
+            "ORDER BY amount DESC) AS rn FROM orders ORDER BY id")
+        assert out.to_pylist() == [
+            {"id": 1, "rn": 1},   # alice: 10.0 > 5.25
+            {"id": 2, "rn": 1},   # bob: 20.5 > 15.0
+            {"id": 3, "rn": 2},
+            {"id": 4, "rn": 1},   # carol alone
+            {"id": 5, "rn": 2}]
+
+    def test_rank_dense_rank_ties(self, ctx):
+        ctx.sql("CREATE TABLE r (g STRING, v INT)")
+        ctx.sql("INSERT INTO r VALUES ('a',1),('a',1),('a',2),('a',3),"
+                "('b',5)")
+        out = ctx.sql(
+            "SELECT g, v, rank() OVER (PARTITION BY g ORDER BY v) AS r,"
+            " dense_rank() OVER (PARTITION BY g ORDER BY v) AS dr "
+            "FROM r ORDER BY g, v")
+        rows = out.to_pylist()
+        assert [(x["r"], x["dr"]) for x in rows] == \
+            [(1, 1), (1, 1), (3, 2), (4, 3), (1, 1)]
+
+    def test_partition_aggregates(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql(
+            "SELECT id, sum(amount) OVER (PARTITION BY customer) AS s, "
+            "count(*) OVER (PARTITION BY customer) AS n, "
+            "max(amount) OVER (PARTITION BY customer) AS m "
+            "FROM orders ORDER BY id")
+        rows = out.to_pylist()
+        assert rows[0] == {"id": 1, "s": 15.25, "n": 2, "m": 10.0}
+        assert rows[3] == {"id": 4, "s": 40.0, "n": 1, "m": 40.0}
+
+    def test_lag_lead(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql(
+            "SELECT id, lag(amount) OVER (PARTITION BY customer "
+            "ORDER BY id) AS prev, lead(amount) OVER (PARTITION BY "
+            "customer ORDER BY id) AS nxt FROM orders ORDER BY id")
+        rows = out.to_pylist()
+        assert rows[0] == {"id": 1, "prev": None, "nxt": 5.25}
+        assert rows[2] == {"id": 3, "prev": 10.0, "nxt": None}
+        assert rows[4] == {"id": 5, "prev": 20.5, "nxt": None}
+
+    def test_first_last_value_strings(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql(
+            "SELECT id, first_value(customer) OVER (ORDER BY amount) "
+            "AS cheapest FROM orders ORDER BY id")
+        # global window (no partition): first by amount = alice (5.25)
+        assert set(out.column("cheapest").to_pylist()) == {"alice"}
+
+    def test_window_without_partition(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT id, row_number() OVER (ORDER BY amount) "
+                      "AS rn FROM orders ORDER BY rn")
+        assert out.column("id").to_pylist() == [3, 1, 5, 2, 4]
+
+    def test_window_over_subquery_and_mix_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        out = ctx.sql(
+            "SELECT cust, rank() OVER (ORDER BY total DESC) AS r FROM "
+            "(SELECT customer AS cust, sum(amount) AS total FROM orders"
+            " GROUP BY customer) t ORDER BY r")
+        assert out.to_pylist()[0] == {"cust": "carol", "r": 1}
+        with pytest.raises(SQLError, match="window"):
+            ctx.sql("SELECT customer, sum(amount), row_number() OVER "
+                    "(ORDER BY customer) FROM orders GROUP BY customer")
+
+
+class TestWindowEdgeCases:
+    def test_rank_without_order_all_peers(self, ctx):
+        ctx.sql("CREATE TABLE wr (g STRING, v INT)")
+        ctx.sql("INSERT INTO wr VALUES ('a',1),('a',1),('b',2),('b',3)")
+        out = ctx.sql("SELECT g, rank() OVER (PARTITION BY g) AS r, "
+                      "dense_rank() OVER (PARTITION BY g) AS dr "
+                      "FROM wr ORDER BY g, v")
+        assert out.column("r").to_pylist() == [1, 1, 1, 1]
+        assert out.column("dr").to_pylist() == [1, 1, 1, 1]
+
+    def test_count_strings_and_int_types(self, ctx):
+        ctx.sql("CREATE TABLE wc (g STRING, s STRING, v BIGINT)")
+        ctx.sql("INSERT INTO wc VALUES ('a','x',1),('a',NULL,2),"
+                "('b','y',3)")
+        out = ctx.sql("SELECT g, count(s) OVER (PARTITION BY g) AS c, "
+                      "sum(v) OVER (PARTITION BY g) AS sv "
+                      "FROM wc ORDER BY g, v")
+        assert out.column("c").to_pylist() == [1, 1, 1]
+        assert out.column("sv").to_pylist() == [3, 3, 3]
+        import pyarrow as pa
+        assert out.schema.field("sv").type == pa.int64()
+
+    def test_all_null_partition_aggregates(self, ctx):
+        ctx.sql("CREATE TABLE wn (g STRING, v DOUBLE)")
+        ctx.sql("INSERT INTO wn VALUES ('a',NULL),('a',NULL),('b',1.5)")
+        out = ctx.sql(
+            "SELECT g, min(v) OVER (PARTITION BY g) AS mn, "
+            "sum(v) OVER (PARTITION BY g) AS sm, "
+            "avg(v) OVER (PARTITION BY g) AS av FROM wn ORDER BY g")
+        rows = out.to_pylist()
+        assert rows[0] == {"g": "a", "mn": None, "sm": None, "av": None}
+        assert rows[2] == {"g": "b", "mn": 1.5, "sm": 1.5, "av": 1.5}
+
+    def test_lag_default_value(self, ctx):
+        ctx.sql("CREATE TABLE wl (v INT)")
+        ctx.sql("INSERT INTO wl VALUES (1),(2),(3)")
+        out = ctx.sql("SELECT v, lag(v, 1, 0) OVER (ORDER BY v) AS p "
+                      "FROM wl ORDER BY v")
+        assert out.column("p").to_pylist() == [0, 1, 2]
+
+    def test_running_sum_with_order(self, ctx):
+        ctx.sql("CREATE TABLE ws (g STRING, v INT)")
+        ctx.sql("INSERT INTO ws VALUES ('a',1),('a',2),('a',2),('a',4),"
+                "('b',10)")
+        out = ctx.sql("SELECT g, v, sum(v) OVER (PARTITION BY g "
+                      "ORDER BY v) AS rs, count(*) OVER (PARTITION BY "
+                      "g ORDER BY v) AS rc FROM ws ORDER BY g, v")
+        # RANGE frame: peers (the two v=2 rows) share the value
+        assert out.column("rs").to_pylist() == [1, 5, 5, 9, 10]
+        assert out.column("rc").to_pylist() == [1, 3, 3, 4, 1]
+
+    def test_min_with_order_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        with pytest.raises(SQLError, match="running"):
+            ctx.sql("SELECT min(amount) OVER (ORDER BY id) FROM orders")
+
+    def test_sys_time_travel_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        with pytest.raises(SQLError, match="time"):
+            ctx.sql("SELECT * FROM sys.all_tables VERSION AS OF 9")
