@@ -596,7 +596,11 @@ def data_type_to_arrow(t: DataType) -> pa.DataType:
         return pa.float64()
     if isinstance(t, (CharType, VarCharType)):
         return pa.string()
-    if isinstance(t, (BinaryType, VarBinaryType, BlobType, VariantType)):
+    if isinstance(t, VariantType):
+        # single source of truth for the on-disk variant shape
+        from paimon_tpu.data.variant import variant_arrow_type
+        return variant_arrow_type()
+    if isinstance(t, (BinaryType, VarBinaryType, BlobType)):
         return pa.binary()
     if isinstance(t, DecimalType):
         return pa.decimal128(t.precision, t.scale)

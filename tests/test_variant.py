@@ -1,0 +1,168 @@
+"""Variant binary format, path access, shredding (reference
+paimon-common data/variant/ + GenericVariantUtil tests)."""
+
+import json
+
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.data.variant import (
+    ShreddingPlan, Variant, column_from_objects, column_to_variants,
+    shred_column, typed_path_column, unshred_column, variant_get,
+)
+
+
+SAMPLE = {
+    "id": 12345678901,
+    "name": "widget",
+    "price": 9.99,
+    "active": True,
+    "tags": ["a", "b", None],
+    "dims": {"w": 3, "h": 250, "note": "x" * 100},
+    "nothing": None,
+}
+
+
+class TestCodec:
+    def test_roundtrip_object(self):
+        v = Variant.from_object(SAMPLE)
+        assert v.to_object() == SAMPLE
+
+    def test_roundtrip_json(self):
+        v = Variant.from_json(json.dumps(SAMPLE))
+        assert json.loads(v.to_json()) == SAMPLE
+
+    @pytest.mark.parametrize("obj", [
+        None, True, False, 0, -1, 127, -128, 32767, 2**31 - 1,
+        -2**63, 2**63 - 1, 1.5, "", "short", "x" * 1000, b"\x00\xff",
+        [], {}, [1, [2, [3]]], {"a": {"b": {"c": "deep"}}},
+        [{"k": i} for i in range(300)],           # large array
+    ])
+    def test_roundtrip_values(self, obj):
+        assert Variant.from_object(obj).to_object() == obj
+
+    def test_large_object(self):
+        obj = {f"key{i}": i for i in range(300)}
+        assert Variant.from_object(obj).to_object() == obj
+
+    def test_shared_key_dictionary(self):
+        # repeated keys across nested objects encode once
+        v1 = Variant.from_object([{"k": 1}, {"k": 2}, {"k": 3}])
+        v2 = Variant.from_object([{"k": 1}])
+        assert v1._dict_keys() == v2._dict_keys() == ["k"]
+
+    def test_int_out_of_range(self):
+        with pytest.raises(ValueError):
+            Variant.from_object(2**63)
+
+
+class TestPaths:
+    def test_path_access(self):
+        v = Variant.from_object(SAMPLE)
+        assert v.get("$.name") == "widget"
+        assert v.get("$.dims.w") == 3
+        assert v.get("$['dims']['h']") == 250
+        assert v.get("$.tags[1]") == "b"
+        assert v.get("$.tags[9]") is None
+        assert v.get("$.missing") is None
+        assert v.get("$.dims.missing.deeper") is None
+        assert variant_get(None, "$.x") is None
+
+    def test_bad_paths(self):
+        v = Variant.from_object({})
+        with pytest.raises(ValueError):
+            v.get("a.b")
+        with pytest.raises(ValueError):
+            v.get("$!!")
+
+
+class TestShredding:
+    def _col(self):
+        rows = [
+            {"a": 1, "b": "x", "extra": [1, 2]},
+            {"a": 2, "b": "y"},
+            {"a": "not-an-int", "b": "z"},     # type mismatch
+            None,
+            {"b": "w"},                        # missing path
+        ]
+        return column_from_objects(rows), rows
+
+    def test_shred_and_typed_read(self):
+        col, rows = self._col()
+        plan = ShreddingPlan({"$.a": pa.int64(), "$.b": pa.string()})
+        shredded = shred_column(col, plan)
+        a = typed_path_column(shredded, plan, "$.a")
+        b = typed_path_column(shredded, plan, "$.b")
+        assert a.to_pylist() == [1, 2, None, None, None]
+        assert b.to_pylist() == ["x", "y", "z", None, "w"]
+
+    def test_residual_roundtrip(self):
+        col, rows = self._col()
+        plan = ShreddingPlan({"$.a": pa.int64()})
+        shredded = shred_column(col, plan)
+        back = unshred_column(shredded)
+        vs = column_to_variants(back)
+        assert vs[3] is None
+        assert vs[0].to_object() == rows[0]
+        assert vs[2].to_object() == rows[2]    # mismatch kept in full
+
+    def test_arrow_column_roundtrip(self):
+        col, rows = self._col()
+        vs = column_to_variants(col)
+        assert [None if v is None else v.to_object() for v in vs] == rows
+
+
+class TestTableIntegration:
+    def test_variant_column_through_table(self, tmp_path):
+        """Variant columns persist through a real table write/read as
+        struct<metadata,value> and decode back."""
+        from paimon_tpu.schema import Schema
+        from paimon_tpu.table import FileStoreTable
+        from paimon_tpu.types import BigIntType, VariantType
+        import pyarrow as _pa
+
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("payload", VariantType())
+                  .options({"bucket": "-1"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        payloads = [SAMPLE, {"k": [1, 2, 3]}, None]
+        data = _pa.table({
+            "id": _pa.array([1, 2, 3], _pa.int64()),
+            "payload": column_from_objects(payloads),
+        })
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_arrow(data)
+        wb.new_commit().commit(w.prepare_commit())
+        out = t.to_arrow().sort_by("id")
+        vs = column_to_variants(out.column("payload"))
+        assert vs[0].to_object() == SAMPLE
+        assert vs[1].get("$.k[2]") == 3
+        assert vs[2] is None
+
+
+class TestSpecConformance:
+    def test_object_fields_sorted_by_key_name(self):
+        # open-variant readers binary-search fields by name: encode
+        # order must be lexicographic regardless of insertion order
+        v = Variant.from_object({"b": 1, "a": 2, "c": 0})
+        assert list(v.to_object().keys()) == ["a", "b", "c"]
+
+    def test_shredding_is_lossless_only(self):
+        # 9.99 must NOT truncate into an int64 typed column
+        col = column_from_objects([{"price": 9.99}, {"price": 10}])
+        plan = ShreddingPlan({"$.price": pa.int64()})
+        sh = shred_column(col, plan)
+        assert typed_path_column(sh, plan, "$.price").to_pylist() == \
+            [None, 10]
+        # residual still has the exact value
+        vs = column_to_variants(unshred_column(sh))
+        assert vs[0].get("$.price") == 9.99
+
+    def test_bool_not_coerced_to_int(self):
+        col = column_from_objects([{"x": True}])
+        plan = ShreddingPlan({"$.x": pa.int64()})
+        sh = shred_column(col, plan)
+        assert typed_path_column(sh, plan, "$.x").to_pylist() == [None]
