@@ -103,6 +103,28 @@ class Catalog:
         from paimon_tpu.catalog.system import load_global_system_table
         return load_global_system_table(self, name)
 
+    # -- views (reference Catalog.java:502 createView et al) -----------------
+    def create_view(self, identifier, view,
+                    ignore_if_exists: bool = False):
+        raise NotImplementedError("this catalog does not support views")
+
+    def get_view(self, identifier):
+        raise NotImplementedError("this catalog does not support views")
+
+    def list_views(self, database: str) -> List[str]:
+        return []
+
+    def drop_view(self, identifier, ignore_if_not_exists: bool = False):
+        raise NotImplementedError("this catalog does not support views")
+
+    def view_exists(self, identifier) -> bool:
+        try:
+            self.get_view(identifier)
+            return True
+        except (NotImplementedError, FileNotFoundError, KeyError,
+                ValueError):
+            return False
+
     def close(self):
         pass
 
@@ -214,6 +236,10 @@ class FileSystemCatalog(Catalog):
             if ignore_if_exists:
                 return self.get_table(i)
             raise TableAlreadyExistsError(i.full_name)
+        if self.view_exists(i):
+            # views and tables share the name space; a table behind a
+            # view would be unreachable (view resolution wins)
+            raise ValueError(f"A view named {i.full_name} exists")
         return FileStoreTable.create(path, schema, file_io=self.file_io)
 
     def get_table(self, identifier) -> FileStoreTable:
@@ -250,6 +276,58 @@ class FileSystemCatalog(Catalog):
         table = self.get_table(identifier)
         table.schema_manager.commit_changes(changes)
         return self.get_table(identifier)
+
+    # -- views ---------------------------------------------------------------
+    def _view_path(self, ident: Identifier) -> str:
+        return f"{self.database_path(ident.database)}/" \
+               f"{ident.table}.view/view.json"
+
+    def create_view(self, identifier, view,
+                    ignore_if_exists: bool = False):
+        ident = self._ident(identifier)
+        if not self.database_exists(ident.database):
+            raise DatabaseNotFoundError(ident.database)
+        path = self._view_path(ident)
+        if self.file_io.exists(path):
+            if ignore_if_exists:
+                return
+            raise ValueError(f"View already exists: {ident.full_name}")
+        if self.table_exists(ident):
+            raise ValueError(f"A table named {ident.full_name} exists")
+        self.file_io.write_bytes(path, view.to_json().encode(),
+                                 overwrite=False)
+
+    def get_view(self, identifier):
+        from paimon_tpu.catalog.view import View
+        ident = self._ident(identifier)
+        path = self._view_path(ident)
+        if not self.file_io.exists(path):
+            raise FileNotFoundError(f"View not found: {ident.full_name}")
+        return View.from_json(self.file_io.read_utf8(path))
+
+    def list_views(self, database: str) -> List[str]:
+        if not self.database_exists(database):
+            raise DatabaseNotFoundError(database)
+        out = []
+        for st in self.file_io.list_status(self.database_path(database)):
+            base = st.path.rstrip("/").split("/")[-1]
+            if st.is_dir and base.endswith(".view"):
+                out.append(base[:-len(".view")])
+        return sorted(out)
+
+    def view_exists(self, identifier) -> bool:
+        # cheap probe: one exists() call, no read/parse
+        return self.file_io.exists(self._view_path(self._ident(identifier)))
+
+    def drop_view(self, identifier, ignore_if_not_exists: bool = False):
+        ident = self._ident(identifier)
+        dir_path = f"{self.database_path(ident.database)}/" \
+                   f"{ident.table}.view"
+        if not self.file_io.exists(dir_path):
+            if ignore_if_not_exists:
+                return
+            raise FileNotFoundError(f"View not found: {ident.full_name}")
+        self.file_io.delete(dir_path, recursive=True)
 
 
 def create_catalog(options=None, **kwargs) -> Catalog:

@@ -222,6 +222,29 @@ class Compiler:
         if name == "if":
             return pc.if_else(pc.fill_null(self.broadcast(a[0]), False),
                               self.broadcast(a[1]), self.broadcast(a[2]))
+        if name == "variant_get":
+            # variant_get(col, '$.path'): decode + path walk per row
+            # (typed shredded columns are the fast path; this is the
+            # general one — reference GenericVariantUtil.variantGet)
+            from paimon_tpu.data.variant import (_parse_path, _walk,
+                                                 column_to_variants)
+            path = self._literal(args[1])
+            segs = _parse_path(path)
+            col = self.broadcast(a[0])
+            vs = column_to_variants(col)
+            vals = [None if v is None else _walk(v.to_object(), segs)
+                    for v in vs]
+            # mixed types fall back to JSON strings
+            try:
+                return pa.array(vals)
+            except (pa.ArrowInvalid, pa.ArrowTypeError):
+                import json as _json
+                from paimon_tpu.data.variant import _json_default
+                return pa.array([
+                    None if x is None else
+                    (x if isinstance(x, str)
+                     else _json.dumps(x, default=_json_default))
+                    for x in vals])
         raise SQLError(f"unknown function {name}()")
 
 
@@ -359,6 +382,7 @@ class SQLContext:
         self.catalog = catalog
         self.database = database
         self._views: Dict[str, pa.Table] = {}
+        self._view_stack: List[str] = []      # cycle detection
 
     # -- public -------------------------------------------------------------
     def register(self, name: str, table: pa.Table):
@@ -373,6 +397,9 @@ class SQLContext:
             ast.Insert: self._exec_insert,
             ast.CreateTable: self._exec_create_table,
             ast.CreateDatabase: self._exec_create_database,
+            ast.CreateView: self._exec_create_view,
+            ast.DropView: self._exec_drop_view,
+            ast.ShowViews: self._exec_show_views,
             ast.DropTable: self._exec_drop_table,
             ast.DropDatabase: self._exec_drop_database,
             ast.ShowTables: self._exec_show_tables,
@@ -416,7 +443,13 @@ class SQLContext:
             base, system = name.rsplit("$", 1)
             name = base
             alias = ref.alias or f"{base.split('.')[-1]}${system}"
-        table = self.catalog.get_table(self._ident(name))
+        try:
+            table = self.catalog.get_table(self._ident(name))
+        except Exception as table_err:        # noqa: BLE001
+            expanded = self._try_expand_view(ref, name)
+            if expanded is None:
+                raise table_err
+            return expanded, alias
         dyn: Dict[str, str] = {}
         if ref.snapshot_id is not None:
             dyn["scan.snapshot-id"] = str(ref.snapshot_id)
@@ -429,6 +462,34 @@ class SQLContext:
         if system is not None:
             return table.system_table(system), alias
         return table, alias
+
+    def _try_expand_view(self, ref: ast.TableRef,
+                         name: str) -> Optional[pa.Table]:
+        """Expand a catalog view (None when no such view): executed in
+        the view's DEFINING database, with cycle detection."""
+        ident = self._ident(name)
+        try:
+            view = self.catalog.get_view(ident)
+        except (NotImplementedError, FileNotFoundError, KeyError,
+                ValueError):
+            return None
+        if ref.snapshot_id is not None or ref.tag is not None or \
+                ref.timestamp_ms is not None:
+            raise SQLError("views do not support time travel")
+        key = ident.full_name
+        if key in self._view_stack:
+            raise SQLError(
+                f"cyclic view reference: "
+                f"{' -> '.join(self._view_stack + [key])}")
+        prev_db = self.database
+        self._view_stack.append(key)
+        try:
+            self.database = view.options.get("default-database",
+                                             prev_db)
+            return self.sql(view.query)
+        finally:
+            self.database = prev_db
+            self._view_stack.pop()
 
     def _pushed_predicate(self, table, alias: str, select: ast.Select):
         """WHERE -> pruning predicate, resolution-only (no I/O)."""
@@ -1043,6 +1104,27 @@ class SQLContext:
         self.catalog.create_database(c.name,
                                      ignore_if_exists=c.if_not_exists)
         return _result(["OK"])
+
+    def _exec_create_view(self, c: ast.CreateView) -> pa.Table:
+        from paimon_tpu.catalog.view import View
+        ident = self._ident(c.name)
+        if c.or_replace:
+            self.catalog.drop_view(ident, ignore_if_not_exists=True)
+        self.catalog.create_view(
+            ident, View(query=c.query_text, comment=c.comment,
+                        options={"default-database": ident.database}))
+        return _result(["OK"])
+
+    def _exec_drop_view(self, d: ast.DropView) -> pa.Table:
+        self.catalog.drop_view(self._ident(d.name),
+                               ignore_if_not_exists=d.if_exists)
+        return _result(["OK"])
+
+    def _exec_show_views(self, s: ast.ShowViews) -> pa.Table:
+        db = s.database or self.database
+        return pa.table({"view_name":
+                         pa.array(sorted(self.catalog.list_views(db)),
+                                  pa.string())})
 
     def _exec_drop_table(self, d: ast.DropTable) -> pa.Table:
         self.catalog.drop_table(self._ident(d.table),

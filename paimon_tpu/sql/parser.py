@@ -311,6 +311,26 @@ class CreateDatabase:
 
 
 @dataclass
+class CreateView:
+    name: str
+    query_text: str
+    select: "Select"
+    or_replace: bool = False
+    comment: Optional[str] = None
+
+
+@dataclass
+class DropView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowViews:
+    database: Optional[str] = None
+
+
+@dataclass
 class DropTable:
     table: str
     if_exists: bool = False
@@ -384,6 +404,7 @@ class Explain:
 
 class Parser:
     def __init__(self, text: str):
+        self.text = text
         self.toks = tokenize(text)
         self.i = 0
 
@@ -421,6 +442,19 @@ class Parser:
     def expect_op(self, op: str):
         if not self.accept_op(op):
             raise SQLError(f"expected {op!r}, got {self.peek().value!r}")
+
+    def at_word(self, word: str) -> bool:
+        """Contextual (non-reserved) keyword: an IDENT matching `word`
+        case-insensitively (VIEW/VIEWS/REPLACE/OVER/PARTITION stay
+        usable as identifiers and function names)."""
+        t = self.peek()
+        return t.kind == "IDENT" and t.value.upper() == word
+
+    def accept_word(self, word: str) -> bool:
+        if self.at_word(word):
+            self.next()
+            return True
+        return False
 
     def ident(self) -> str:
         t = self.next()
@@ -890,6 +924,24 @@ class Parser:
                 self.expect_kw("EXISTS")
                 ine = True
             return CreateDatabase(self.ident(), ine)
+        or_replace = False
+        if self.accept_kw("OR"):
+            if not self.accept_word("REPLACE"):
+                raise SQLError("expected REPLACE after CREATE OR")
+            or_replace = True
+        if self.accept_word("VIEW"):
+            name = self.qualified_name()
+            comment = None
+            if self.accept_kw("COMMENT"):
+                t = self.next()
+                comment = t.value
+            self.expect_kw("AS")
+            start = self.peek().pos
+            sel = self.select()
+            return CreateView(name, self.text[start:].rstrip().rstrip(";"),
+                              sel, or_replace, comment)
+        if or_replace:
+            raise SQLError("OR REPLACE is only valid for CREATE VIEW")
         self.expect_kw("TABLE")
         ine = False
         if self.accept_kw("IF"):
@@ -955,6 +1007,9 @@ class Parser:
         if self.accept_kw("DATABASE"):
             ie = self._if_exists()
             return DropDatabase(self.ident(), ie)
+        if self.accept_word("VIEW"):
+            ie = self._if_exists()
+            return DropView(self.qualified_name(), ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         return DropTable(self.qualified_name(), ie)
@@ -973,10 +1028,16 @@ class Parser:
             if self.accept_kw("FROM") or self.accept_kw("IN"):
                 db = self.ident()
             return ShowTables(db)
+        if self.accept_word("VIEWS"):
+            db = None
+            if self.accept_kw("FROM") or self.accept_kw("IN"):
+                db = self.ident()
+            return ShowViews(db)
         if self.accept_kw("CREATE"):
             self.expect_kw("TABLE")
             return ShowCreateTable(self.qualified_name())
-        raise SQLError("SHOW expects DATABASES | TABLES | CREATE TABLE")
+        raise SQLError("SHOW expects DATABASES | TABLES | VIEWS | "
+                       "CREATE TABLE")
 
     def update(self) -> Update:
         table = self.qualified_name()

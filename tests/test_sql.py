@@ -645,3 +645,103 @@ class TestWindowEdgeCases:
         _setup_orders(ctx)
         with pytest.raises(SQLError, match="time"):
             ctx.sql("SELECT * FROM sys.all_tables VERSION AS OF 9")
+
+
+class TestViews:
+    def test_create_select_drop(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW big_orders AS SELECT id, amount FROM "
+                "orders WHERE amount > 12")
+        out = ctx.sql("SELECT * FROM big_orders ORDER BY id")
+        assert out.column("id").to_pylist() == [2, 4, 5]
+        # views compose: query a view with aggregation
+        agg = ctx.sql("SELECT count(*) AS n, sum(amount) AS s "
+                      "FROM big_orders")
+        assert agg.to_pylist() == [{"n": 3, "s": 75.5}]
+        assert ctx.sql("SHOW VIEWS").column("view_name").to_pylist() \
+            == ["big_orders"]
+        ctx.sql("DROP VIEW big_orders")
+        assert ctx.sql("SHOW VIEWS").num_rows == 0
+        ctx.sql("DROP VIEW IF EXISTS big_orders")     # no error
+
+    def test_or_replace_and_persistence(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW v1 AS SELECT id FROM orders WHERE id = 1")
+        ctx.sql("CREATE OR REPLACE VIEW v1 AS "
+                "SELECT id FROM orders WHERE id >= 4")
+        assert ctx.sql("SELECT * FROM v1 ORDER BY id") \
+            .column("id").to_pylist() == [4, 5]
+        # a NEW context over the same catalog sees the view (persisted)
+        from paimon_tpu.sql import SQLContext
+        ctx2 = SQLContext(ctx.catalog)
+        assert ctx2.sql("SELECT count(*) AS n FROM v1") \
+            .to_pylist() == [{"n": 2}]
+
+    def test_view_follows_base_table_updates(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW all_o AS SELECT id FROM orders")
+        assert ctx.sql("SELECT count(*) AS n FROM all_o") \
+            .to_pylist() == [{"n": 5}]
+        ctx.sql("INSERT INTO orders VALUES (9, 'z', 1.0, 1)")
+        assert ctx.sql("SELECT count(*) AS n FROM all_o") \
+            .to_pylist() == [{"n": 6}]
+
+    def test_view_time_travel_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW v2 AS SELECT id FROM orders")
+        with pytest.raises(SQLError, match="time travel"):
+            ctx.sql("SELECT * FROM v2 VERSION AS OF 1")
+
+    def test_view_name_conflicts_with_table(self, ctx):
+        _setup_orders(ctx)
+        with pytest.raises(Exception, match="table named"):
+            ctx.sql("CREATE VIEW orders AS SELECT 1")
+
+
+class TestVariantSql:
+    def test_variant_get_in_sql(self, ctx, tmp_path):
+        from paimon_tpu.data.variant import column_from_objects
+        import pyarrow as _pa
+        ctx.register("ev", _pa.table({
+            "id": _pa.array([1, 2], _pa.int64()),
+            "payload": column_from_objects(
+                [{"user": {"name": "ann"}, "n": 3},
+                 {"user": {"name": "bo"}, "n": 7}]),
+        }))
+        out = ctx.sql("SELECT id, variant_get(payload, '$.user.name') "
+                      "AS name, variant_get(payload, '$.n') AS n "
+                      "FROM ev ORDER BY id")
+        assert out.to_pylist() == [
+            {"id": 1, "name": "ann", "n": 3},
+            {"id": 2, "name": "bo", "n": 7}]
+
+
+class TestViewEdgeCases:
+    def test_replace_function_still_works(self, ctx):
+        _setup_orders(ctx)
+        out = ctx.sql("SELECT replace(customer, 'a', 'o') AS c "
+                      "FROM orders WHERE id = 1")
+        assert out.to_pylist() == [{"c": "olice"}]
+
+    def test_cyclic_view_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW va AS SELECT id FROM orders")
+        ctx.sql("CREATE OR REPLACE VIEW va AS SELECT id FROM va")
+        with pytest.raises(SQLError, match="cyclic"):
+            ctx.sql("SELECT * FROM va")
+
+    def test_view_resolves_in_defining_database(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW dv AS SELECT id FROM orders")
+        ctx.sql("CREATE DATABASE other")
+        ctx.sql("USE other")
+        out = ctx.sql("SELECT count(*) AS n FROM default.dv")
+        assert out.to_pylist() == [{"n": 5}]
+
+    def test_table_cannot_shadow_view(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE VIEW sv AS SELECT id FROM orders")
+        with pytest.raises(Exception, match="view named"):
+            ctx.sql("CREATE TABLE sv (x BIGINT)")
