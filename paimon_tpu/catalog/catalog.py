@@ -125,6 +125,32 @@ class Catalog:
                 ValueError):
             return False
 
+    # -- functions (reference Catalog.java:1230 createFunction et al) --------
+    def create_function(self, identifier, function,
+                        ignore_if_exists: bool = False):
+        raise NotImplementedError(
+            "this catalog does not support functions")
+
+    def get_function(self, identifier):
+        raise NotImplementedError(
+            "this catalog does not support functions")
+
+    def list_functions(self, database: str) -> List[str]:
+        return []
+
+    def drop_function(self, identifier,
+                      ignore_if_not_exists: bool = False):
+        raise NotImplementedError(
+            "this catalog does not support functions")
+
+    def function_exists(self, identifier) -> bool:
+        try:
+            self.get_function(identifier)
+            return True
+        except (NotImplementedError, FileNotFoundError, KeyError,
+                ValueError):
+            return False
+
     def close(self):
         pass
 
@@ -318,6 +344,60 @@ class FileSystemCatalog(Catalog):
     def view_exists(self, identifier) -> bool:
         # cheap probe: one exists() call, no read/parse
         return self.file_io.exists(self._view_path(self._ident(identifier)))
+
+    # -- functions -----------------------------------------------------------
+    def _function_path(self, ident: Identifier) -> str:
+        return f"{self.database_path(ident.database)}/" \
+               f"{ident.table}.function/function.json"
+
+    def create_function(self, identifier, function,
+                        ignore_if_exists: bool = False):
+        ident = self._ident(identifier)
+        if not self.database_exists(ident.database):
+            raise DatabaseNotFoundError(ident.database)
+        path = self._function_path(ident)
+        if self.file_io.exists(path):
+            if ignore_if_exists:
+                return
+            raise ValueError(f"Function already exists: "
+                             f"{ident.full_name}")
+        self.file_io.write_bytes(path, function.to_json().encode(),
+                                 overwrite=False)
+
+    def get_function(self, identifier):
+        from paimon_tpu.catalog.function import Function
+        ident = self._ident(identifier)
+        path = self._function_path(ident)
+        if not self.file_io.exists(path):
+            raise FileNotFoundError(
+                f"Function not found: {ident.full_name}")
+        return Function.from_json(self.file_io.read_utf8(path))
+
+    def list_functions(self, database: str) -> List[str]:
+        if not self.database_exists(database):
+            raise DatabaseNotFoundError(database)
+        out = []
+        for st in self.file_io.list_status(self.database_path(database)):
+            base = st.path.rstrip("/").split("/")[-1]
+            if st.is_dir and base.endswith(".function"):
+                out.append(base[:-len(".function")])
+        return sorted(out)
+
+    def drop_function(self, identifier,
+                      ignore_if_not_exists: bool = False):
+        ident = self._ident(identifier)
+        dir_path = f"{self.database_path(ident.database)}/" \
+                   f"{ident.table}.function"
+        if not self.file_io.exists(dir_path):
+            if ignore_if_not_exists:
+                return
+            raise FileNotFoundError(
+                f"Function not found: {ident.full_name}")
+        self.file_io.delete(dir_path, recursive=True)
+
+    def function_exists(self, identifier) -> bool:
+        return self.file_io.exists(
+            self._function_path(self._ident(identifier)))
 
     def drop_view(self, identifier, ignore_if_not_exists: bool = False):
         ident = self._ident(identifier)

@@ -25,6 +25,16 @@ from paimon_tpu.types import parse_data_type
 
 _AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
 
+# scalar builtins (Compiler._func) + window names: catalog UDFs never
+# shadow these
+_BUILTIN_FUNCS = _AGG_FUNCS | {
+    "abs", "upper", "lower", "length", "char_length", "trim", "concat",
+    "coalesce", "nullif", "round", "floor", "ceil", "sqrt", "power",
+    "substr", "substring", "replace", "year", "month", "day", "hour",
+    "minute", "second", "if", "variant_get", "row_number", "rank",
+    "dense_rank", "lag", "lead", "first_value", "last_value",
+}
+
 
 def _result(rows: List[str], name: str = "result") -> pa.Table:
     return pa.table({name: pa.array(rows, pa.string())})
@@ -391,6 +401,7 @@ class SQLContext:
 
     def sql(self, query: str) -> pa.Table:
         stmt = parse(query)
+        self._expand_udfs(stmt)
         handler = {
             ast.Select: self._exec_select_stmt,
             ast.Explain: self._exec_explain,
@@ -400,6 +411,9 @@ class SQLContext:
             ast.CreateView: self._exec_create_view,
             ast.DropView: self._exec_drop_view,
             ast.ShowViews: self._exec_show_views,
+            ast.CreateFunction: self._exec_create_function,
+            ast.DropFunction: self._exec_drop_function,
+            ast.ShowFunctions: self._exec_show_functions,
             ast.DropTable: self._exec_drop_table,
             ast.DropDatabase: self._exec_drop_database,
             ast.ShowTables: self._exec_show_tables,
@@ -520,6 +534,70 @@ class SQLContext:
                 [f"{alias}.{c}" for c in out.column_names])
             return Scope(q, list(q.column_names))
         raise SQLError(f"unsupported FROM item {ref!r}")
+
+    # -- catalog UDF expansion ----------------------------------------------
+    def _expand_udfs(self, stmt) -> None:
+        """Rewrite calls to catalog functions (sql dialect) into their
+        bound definition expressions; nested/composed definitions
+        resolve through the fixed-point loop below."""
+        cache: Dict[str, Any] = {}        # name -> Function | None
+
+        def lookup(name: str):
+            if name not in cache:
+                try:
+                    cache[name] = self.catalog.get_function(
+                        self._ident(name))
+                except (NotImplementedError, FileNotFoundError):
+                    cache[name] = None    # genuinely absent; a corrupt
+                    # definition file raises out of get_function instead
+            return cache[name]
+
+        def expand(node):
+            if not isinstance(node, ast.Func) or node.over is not None \
+                    or node.name in _BUILTIN_FUNCS:
+                return node
+            fn = lookup(node.name)
+            if fn is None:
+                return node
+            d = fn.definition("sql")
+            if d is None or not d.definition:
+                raise SQLError(f"function {node.name}() has no sql-"
+                               f"dialect definition this engine can run")
+            if len(node.args) != len(fn.input_params):
+                raise SQLError(
+                    f"{node.name}() takes {len(fn.input_params)} "
+                    f"argument(s), got {len(node.args)}")
+            body = _parse_expr_full(d.definition)
+            bound = _substitute_params(
+                body, {p: a for (p, _), a in
+                       zip(fn.input_params, node.args)})
+            self._changed = True
+            return bound
+
+        for _ in range(9):
+            self._changed = False
+            if isinstance(stmt, ast.Select):
+                _rewrite_select_exprs(stmt, expand)
+            elif isinstance(stmt, ast.Insert) and stmt.select is not None:
+                _rewrite_select_exprs(stmt.select, expand)
+            elif isinstance(stmt, ast.Insert) and stmt.rows is not None:
+                stmt.rows = [[_transform(c, expand) for c in row]
+                             for row in stmt.rows]
+            elif isinstance(stmt, ast.Update):
+                stmt.assignments = [(c, _transform(e, expand))
+                                    for c, e in stmt.assignments]
+                if stmt.where is not None:
+                    stmt.where = _transform(stmt.where, expand)
+            elif isinstance(stmt, ast.Delete) and stmt.where is not None:
+                stmt.where = _transform(stmt.where, expand)
+            elif isinstance(stmt, ast.Explain):
+                _rewrite_select_exprs(stmt.select, expand)
+            else:
+                return
+            if not self._changed:
+                return
+        raise SQLError("catalog function expansion did not converge "
+                       "(cyclic definitions?)")
 
     # -- SELECT -------------------------------------------------------------
     def _exec_select_stmt(self, s: ast.Select) -> pa.Table:
@@ -1126,6 +1204,40 @@ class SQLContext:
                          pa.array(sorted(self.catalog.list_views(db)),
                                   pa.string())})
 
+    def _exec_create_function(self, c: ast.CreateFunction) -> pa.Table:
+        from paimon_tpu.catalog.function import (Function,
+                                                 FunctionDefinition)
+        ident_name = c.name.split(".")[-1].lower()
+        if ident_name in _BUILTIN_FUNCS:
+            raise SQLError(f"cannot create function {ident_name!r}: "
+                           f"built-in functions cannot be shadowed")
+        # validate the body parses as an expression now, not at call
+        _parse_expr_full(c.body)
+        for _, tstr in c.params:
+            parse_data_type(tstr)
+        if c.return_type:
+            parse_data_type(c.return_type)
+        ident = self._ident(c.name)
+        if c.or_replace:
+            self.catalog.drop_function(ident, ignore_if_not_exists=True)
+        fn = Function(
+            input_params=list(c.params), return_type=c.return_type,
+            definitions={"sql": FunctionDefinition(
+                "sql", definition=c.body)},
+            comment=c.comment)
+        self.catalog.create_function(ident, fn)
+        return _result(["OK"])
+
+    def _exec_drop_function(self, d: ast.DropFunction) -> pa.Table:
+        self.catalog.drop_function(self._ident(d.name),
+                                   ignore_if_not_exists=d.if_exists)
+        return _result(["OK"])
+
+    def _exec_show_functions(self, s: ast.ShowFunctions) -> pa.Table:
+        db = s.database or self.database
+        return pa.table({"function_name": pa.array(
+            sorted(self.catalog.list_functions(db)), pa.string())})
+
     def _exec_drop_table(self, d: ast.DropTable) -> pa.Table:
         self.catalog.drop_table(self._ident(d.table),
                                 ignore_if_not_exists=d.if_exists)
@@ -1425,6 +1537,92 @@ def _equi_pair(e, probe: Scope, left: Scope, right: Scope
     if rq in left.table.column_names and lq in right.table.column_names:
         return (rq, lq)
     return None
+
+
+def _parse_expr_full(text: str):
+    """Parse a COMPLETE expression (trailing garbage is an error —
+    Parser.expr() alone would silently stop early)."""
+    from paimon_tpu.sql.parser import Parser
+    p = Parser(text)
+    e = p.expr()
+    if p.peek().kind != "EOF":
+        raise SQLError(f"trailing input in expression at "
+                       f"{p.peek().pos}: {text!r}")
+    return e
+
+
+def _transform(e, fn):
+    """Bottom-up AST rewrite: fn(node) returns a replacement (or the
+    node); children are rebuilt first."""
+    import copy as _copy
+
+    if isinstance(e, ast.Func):
+        e = ast.Func(e.name, [_transform(a, fn) for a in e.args],
+                     e.distinct,
+                     None if e.over is None else ast.Window(
+                         [_transform(p, fn)
+                          for p in e.over.partition_by],
+                         [(_transform(o, fn), asc)
+                          for o, asc in e.over.order_by]))
+    elif isinstance(e, ast.Binary):
+        e = ast.Binary(e.op, _transform(e.left, fn),
+                       _transform(e.right, fn))
+    elif isinstance(e, ast.Unary):
+        e = ast.Unary(e.op, _transform(e.operand, fn))
+    elif isinstance(e, ast.Case):
+        e = ast.Case([(_transform(c, fn), _transform(v, fn))
+                      for c, v in e.whens],
+                     None if e.default is None
+                     else _transform(e.default, fn))
+    elif isinstance(e, ast.Cast):
+        e = ast.Cast(_transform(e.expr, fn), e.type_str)
+    elif isinstance(e, ast.IsNull):
+        e = ast.IsNull(_transform(e.expr, fn), e.negated)
+    elif isinstance(e, ast.LikeExpr):
+        e = ast.LikeExpr(_transform(e.expr, fn), e.pattern, e.negated)
+    elif isinstance(e, ast.InList):
+        e = ast.InList(_transform(e.expr, fn),
+                       [_transform(v, fn) for v in e.values], e.negated)
+    elif isinstance(e, ast.BetweenExpr):
+        e = ast.BetweenExpr(_transform(e.expr, fn),
+                            _transform(e.lo, fn), _transform(e.hi, fn),
+                            e.negated)
+    else:
+        e = _copy.copy(e) if isinstance(e, (ast.Column, ast.Literal,
+                                            ast.Star)) else e
+    return fn(e)
+
+
+def _substitute_params(body, bindings: Dict[str, Any]):
+    def rep(node):
+        if isinstance(node, ast.Column) and node.qualifier is None and \
+                node.name in bindings:
+            return bindings[node.name]
+        return node
+    return _transform(body, rep)
+
+
+def _rewrite_select_exprs(sel: "ast.Select", fn) -> None:
+    """Apply an expression rewrite to every expression position of a
+    Select tree, in place (recursing into subqueries/unions)."""
+    sel.items = [ast.SelectItem(_transform(i.expr, fn), i.alias)
+                 for i in sel.items]
+    if sel.where is not None:
+        sel.where = _transform(sel.where, fn)
+    sel.group_by = [_transform(g, fn) for g in sel.group_by]
+    if sel.having is not None:
+        sel.having = _transform(sel.having, fn)
+    sel.order_by = [(_transform(e, fn), asc, pl)
+                    for e, asc, pl in sel.order_by]
+    for j in sel.joins:
+        if j.condition is not None:
+            j.condition = _transform(j.condition, fn)
+        if isinstance(j.right, ast.SubqueryRef):
+            _rewrite_select_exprs(j.right.select, fn)
+    if isinstance(sel.from_, ast.SubqueryRef):
+        _rewrite_select_exprs(sel.from_.select, fn)
+    if sel.union_all is not None:
+        _rewrite_select_exprs(sel.union_all, fn)
 
 
 def _find_funcs(e, pred) -> List[ast.Func]:

@@ -326,6 +326,27 @@ class DropView:
 
 
 @dataclass
+class CreateFunction:
+    name: str
+    params: List[Tuple[str, str]]          # (name, type string)
+    return_type: Optional[str]
+    body: str                              # sql-dialect expression
+    or_replace: bool = False
+    comment: Optional[str] = None
+
+
+@dataclass
+class DropFunction:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowFunctions:
+    database: Optional[str] = None
+
+
+@dataclass
 class ShowViews:
     database: Optional[str] = None
 
@@ -940,8 +961,34 @@ class Parser:
             sel = self.select()
             return CreateView(name, self.text[start:].rstrip().rstrip(";"),
                               sel, or_replace, comment)
+        if self.accept_word("FUNCTION"):
+            name = self.qualified_name()
+            params = []
+            self.expect_op("(")
+            if not (self.peek().kind == "OP" and
+                    self.peek().value == ")"):
+                while True:
+                    pname = self.ident()
+                    params.append((pname, self.type_string()))
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            rtype = None
+            if self.accept_word("RETURNS"):
+                rtype = self.type_string()
+            comment = None
+            if self.accept_kw("COMMENT"):
+                comment = self.next().value
+            self.expect_kw("AS")
+            t = self.next()
+            if t.kind != "STRING":
+                raise SQLError("CREATE FUNCTION body must be a string "
+                               "expression: AS 'expr over params'")
+            return CreateFunction(name, params, rtype, t.value,
+                                  or_replace, comment)
         if or_replace:
-            raise SQLError("OR REPLACE is only valid for CREATE VIEW")
+            raise SQLError("OR REPLACE is only valid for CREATE "
+                           "VIEW/FUNCTION")
         self.expect_kw("TABLE")
         ine = False
         if self.accept_kw("IF"):
@@ -1010,6 +1057,9 @@ class Parser:
         if self.accept_word("VIEW"):
             ie = self._if_exists()
             return DropView(self.qualified_name(), ie)
+        if self.accept_word("FUNCTION"):
+            ie = self._if_exists()
+            return DropFunction(self.qualified_name(), ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         return DropTable(self.qualified_name(), ie)
@@ -1033,6 +1083,11 @@ class Parser:
             if self.accept_kw("FROM") or self.accept_kw("IN"):
                 db = self.ident()
             return ShowViews(db)
+        if self.accept_word("FUNCTIONS"):
+            db = None
+            if self.accept_kw("FROM") or self.accept_kw("IN"):
+                db = self.ident()
+            return ShowFunctions(db)
         if self.accept_kw("CREATE"):
             self.expect_kw("TABLE")
             return ShowCreateTable(self.qualified_name())

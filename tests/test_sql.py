@@ -745,3 +745,79 @@ class TestViewEdgeCases:
         ctx.sql("CREATE VIEW sv AS SELECT id FROM orders")
         with pytest.raises(Exception, match="view named"):
             ctx.sql("CREATE TABLE sv (x BIGINT)")
+
+
+class TestCatalogFunctions:
+    def test_create_call_drop(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE FUNCTION total (price DOUBLE, n INT) "
+                "RETURNS DOUBLE AS 'price * n'")
+        out = ctx.sql("SELECT id, total(amount, qty) AS t FROM orders "
+                      "WHERE id <= 2 ORDER BY id")
+        assert out.to_pylist() == [{"id": 1, "t": 20.0},
+                                   {"id": 2, "t": 20.5}]
+        assert ctx.sql("SHOW FUNCTIONS") \
+            .column("function_name").to_pylist() == ["total"]
+        ctx.sql("DROP FUNCTION total")
+        assert ctx.sql("SHOW FUNCTIONS").num_rows == 0
+
+    def test_udf_in_where_gets_pushdown_semantics(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE FUNCTION at_least (v DOUBLE, bound DOUBLE) "
+                "RETURNS BOOLEAN AS 'v >= bound'")
+        out = ctx.sql("SELECT id FROM orders "
+                      "WHERE at_least(amount, 15.0) ORDER BY id")
+        assert out.column("id").to_pylist() == [2, 4, 5]
+
+    def test_udf_composition_and_nesting(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE FUNCTION twice (x DOUBLE) RETURNS DOUBLE "
+                "AS 'x * 2'")
+        ctx.sql("CREATE FUNCTION quad (x DOUBLE) RETURNS DOUBLE "
+                "AS 'twice(twice(x))'")
+        out = ctx.sql("SELECT quad(amount) AS q FROM orders "
+                      "WHERE id = 1")
+        assert out.to_pylist() == [{"q": 40.0}]
+
+    def test_arity_and_or_replace(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        _setup_orders(ctx)
+        ctx.sql("CREATE FUNCTION one (x INT) AS 'x'")
+        with pytest.raises(SQLError, match="argument"):
+            ctx.sql("SELECT one(1, 2) FROM orders")
+        ctx.sql("CREATE OR REPLACE FUNCTION one (x INT, y INT) "
+                "AS 'x + y'")
+        assert ctx.sql("SELECT one(1, 2) AS v").to_pylist() == \
+            [{"v": 3}]
+
+    def test_builtins_not_shadowed(self, ctx):
+        _setup_orders(ctx)
+        # a catalog function named like a builtin never shadows it
+        from paimon_tpu.catalog.function import (Function,
+                                                 FunctionDefinition)
+        ctx.catalog.create_function(
+            ctx._ident("upper"),
+            Function([("x", "STRING")],
+                     definitions={"sql": FunctionDefinition(
+                         "sql", definition="'shadowed'")}))
+        out = ctx.sql("SELECT upper(customer) AS c FROM orders "
+                      "WHERE id = 1")
+        assert out.to_pylist() == [{"c": "ALICE"}]
+
+    def test_persistence_across_contexts(self, ctx):
+        _setup_orders(ctx)
+        ctx.sql("CREATE FUNCTION t2x (x INT) AS 'x * 2'")
+        from paimon_tpu.sql import SQLContext
+        ctx2 = SQLContext(ctx.catalog)
+        assert ctx2.sql("SELECT t2x(21) AS v").to_pylist() == \
+            [{"v": 42}]
+
+    def test_trailing_garbage_in_body_rejected(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        with pytest.raises(SQLError, match="trailing"):
+            ctx.sql("CREATE FUNCTION bad (x INT) AS 'x + 1 zzz 42'")
+
+    def test_builtin_name_rejected_at_create(self, ctx):
+        from paimon_tpu.sql.parser import SQLError
+        with pytest.raises(SQLError, match="shadow"):
+            ctx.sql("CREATE FUNCTION upper (x STRING) AS 'x'")
