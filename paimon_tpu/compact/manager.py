@@ -264,6 +264,8 @@ class MergeTreeCompactManager:
         has_blobs = bool(blob_column_names(self.schema))
 
         def run_iter(run_files):
+            # yields (table, lanes, truncated): the lane encode runs
+            # HERE, inside the prefetch thread, overlapping the merge
             for f in run_files:
                 if has_blobs:
                     # blob descriptors must resolve against the whole
@@ -273,10 +275,12 @@ class MergeTreeCompactManager:
                                      self.partition, self.bucket, f,
                                      schema=self.schema,
                                      schema_manager=self.schema_manager)
-                    yield evolve_table(t, f.schema_id, self.schema,
-                                       self.schema_manager,
-                                       self._schema_cache,
-                                       keep_sys_cols=True)
+                    t = evolve_table(t, f.schema_id, self.schema,
+                                     self.schema_manager,
+                                     self._schema_cache,
+                                     keep_sys_cols=True)
+                    yield (t, *self.key_encoder.encode_table_ex(
+                        t, self.key_cols))
                     continue
                 ext = f.file_name.rsplit(".", 1)[-1]
                 fmt = get_format(ext)
@@ -284,41 +288,66 @@ class MergeTreeCompactManager:
                     self.partition, self.bucket, f.file_name)
                 for batch in fmt.create_reader().read_batches(
                         self.file_io, path, batch_rows=chunk_rows):
-                    yield evolve_table(batch, f.schema_id, self.schema,
-                                       self.schema_manager,
-                                       self._schema_cache,
-                                       keep_sys_cols=True)
+                    t = evolve_table(batch, f.schema_id, self.schema,
+                                     self.schema_manager,
+                                     self._schema_cache,
+                                     keep_sys_cols=True)
+                    yield (t, *self.key_encoder.encode_table_ex(
+                        t, self.key_cols))
 
-        def merge_window(tables: List[pa.Table]) -> pa.Table:
-            return self._merge_tables(tables, drop_delete)
+        def merge_window(items) -> pa.Table:
+            tables = [item[0] for item in items]
+            encoded = [item[1:] for item in items]
+            return self._merge_tables(tables, drop_delete,
+                                      encoded=encoded)
 
-        out: List[DataFileMeta] = []
+        # rolling flushes go to a small thread pool (parquet encode
+        # releases the GIL) so file writes overlap the next window's
+        # merge; futures are collected in submission order, so the
+        # returned metas stay in key order regardless of completion
+        from concurrent.futures import ThreadPoolExecutor
+        futures = []
         acc: List[pa.Table] = []
         acc_bytes = 0
 
-        def flush():
-            nonlocal acc, acc_bytes
-            if not acc:
-                return
-            merged = pa.concat_tables(acc, promote_options="none")
-            out.extend(self.kv_writer.write(
+        def _write_one(merged: pa.Table) -> List[DataFileMeta]:
+            return self.kv_writer.write(
                 self.partition, self.bucket, merged, level=output_level,
-                file_source=FileSource.COMPACT))
-            acc, acc_bytes = [], 0
+                file_source=FileSource.COMPACT)
 
-        def emit(window: pa.Table):
-            nonlocal acc_bytes
-            if window.num_rows == 0:
-                return
-            acc.append(window)
-            acc_bytes += window.nbytes
-            if acc_bytes >= self.kv_writer.target_file_size:
-                flush()
+        with ThreadPoolExecutor(max_workers=2) as pool:
 
-        merge_runs_streamed([_prefetch(run_iter(rf)) for rf in runs_meta],
-                            self.key_cols, self.key_encoder, emit,
-                            merge_window)
-        flush()
+            def flush():
+                nonlocal acc, acc_bytes
+                if not acc:
+                    return
+                # backpressure: at most 3 file-sized tables in flight so
+                # a slow disk can't unbound the streamed path's memory;
+                # waiting on the oldest also surfaces writer errors early
+                pending = [f for f in futures if not f.done()]
+                if len(pending) >= 3:
+                    pending[0].result()
+                merged = pa.concat_tables(acc, promote_options="none")
+                futures.append(pool.submit(_write_one, merged))
+                acc, acc_bytes = [], 0
+
+            def emit(window: pa.Table):
+                nonlocal acc_bytes
+                if window.num_rows == 0:
+                    return
+                acc.append(window)
+                acc_bytes += window.nbytes
+                if acc_bytes >= self.kv_writer.target_file_size:
+                    flush()
+
+            merge_runs_streamed(
+                [_prefetch(run_iter(rf)) for rf in runs_meta],
+                self.key_cols, self.key_encoder, emit, merge_window,
+                pass_encoded=True)
+            flush()
+            out: List[DataFileMeta] = []
+            for f in futures:
+                out.extend(f.result())
         return out
 
     # -- changelog producers -------------------------------------------------
@@ -446,9 +475,12 @@ class MergeTreeCompactManager:
         return record_level_expire_filter(self.options, merged)
 
     def _merge_tables(self, run_tables: List[pa.Table],
-                      drop_deletes: bool) -> pa.Table:
+                      drop_deletes: bool,
+                      encoded=None) -> pa.Table:
         """Merge run-ordered tables under the table's merge engine —
-        the single dispatch shared by the one-shot and streamed paths."""
+        the single dispatch shared by the one-shot and streamed paths.
+        `encoded`: optional pre-computed (lanes, truncated) per table
+        (the streamed path encodes once for the window cut)."""
         engine = self.options.merge_engine
         seq_fields = self.options.sequence_field or None
         if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
@@ -459,7 +491,8 @@ class MergeTreeCompactManager:
                 drop_deletes=drop_deletes,
                 key_encoder=self.key_encoder,
                 seq_fields=seq_fields,
-                seq_desc=self.options.sequence_field_descending)
+                seq_desc=self.options.sequence_field_descending,
+                encoded=encoded)
             return self._record_level_expire(res.take())
         from paimon_tpu.ops.agg import merge_runs_agg
         merged = merge_runs_agg(run_tables, self.key_cols, self.schema,

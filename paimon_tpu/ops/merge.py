@@ -207,7 +207,8 @@ def _device_path_pays(n: int, num_lanes: int, winners_only: bool,
 
 
 def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
-                              keep: str
+                              keep: str,
+                              packed: Optional[np.ndarray] = None
                               ) -> Tuple[np.ndarray, np.ndarray,
                                          np.ndarray]:
     """Packed-key fast path for the hottest shape (exactly two key
@@ -223,8 +224,14 @@ def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
     fused radix sort + segment scan (paimon_tpu/native/radix_sort.c):
     ~3.5x faster again than the numpy pipeline at 8M rows."""
     n = lanes.shape[0]
-    key = (lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
-        | lanes[:, 1].astype(np.uint64)
+    # the encoder hands back its pre-packed u64 for single fixed-width
+    # keys; repack from the lanes only when it couldn't
+    if packed is not None:
+        key = packed
+    else:
+        lanes = np.asarray(lanes)    # materialize if lazily concatenated
+        key = (lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+            | lanes[:, 1].astype(np.uint64)
     from paimon_tpu import native
     fused = native.merge_winners(key, seq, keep == "last")
     if fused is not None:
@@ -256,7 +263,8 @@ def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
 
 def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
                          num_key_lanes: int,
-                         need_prev: bool = True
+                         need_prev: bool = True,
+                         packed: Optional[np.ndarray] = None
                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """CPU-backend fallback with EXACTLY the kernel's semantics: when no
     accelerator is attached, np.lexsort beats a single-threaded XLA
@@ -265,8 +273,9 @@ def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
     n, num_lanes = lanes.shape
     if num_lanes == 2 and num_key_lanes == 2 and not need_prev \
             and n > 0:
-        return _host_sorted_winners_fast(lanes, seq, keep)
-    useq = seq.astype(np.int64).view(np.uint64)
+        return _host_sorted_winners_fast(lanes, seq, keep, packed=packed)
+    lanes = np.asarray(lanes)        # materialize if lazily concatenated
+    useq = seq.astype(np.int64, copy=False).view(np.uint64)
     keys = ((useq & np.uint64(0xFFFFFFFF)).astype(np.uint32),
             (useq >> np.uint64(32)).astype(np.uint32),
             *(lanes[:, i] for i in range(num_lanes - 1, -1, -1)))
@@ -283,7 +292,8 @@ def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
 def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                           keep: str = "last",
                           order_lanes: Optional[np.ndarray] = None,
-                          winners_only: bool = False
+                          winners_only: bool = False,
+                          packed: Optional[np.ndarray] = None
                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the device kernel.
 
@@ -328,15 +338,17 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
         full = lanes if order_lanes is None or order_lanes.shape[1] == 0 \
             else np.concatenate([lanes, order_lanes], axis=1)
         return _host_sorted_winners(full, seq, keep, num_key_lanes,
-                                    need_prev=not winners_only)
+                                    need_prev=not winners_only,
+                                    packed=packed)
     PATH_COUNTS["device"] += 1
+    lanes = np.asarray(lanes)        # materialize if lazily concatenated
     if order_lanes is not None and order_lanes.shape[1] > 0:
         lanes = np.concatenate([lanes, order_lanes], axis=1)
     num_lanes = lanes.shape[1]
     m = _pad_size(n)
     lanes_p = np.full((m, num_lanes), 0, dtype=np.uint32)
     lanes_p[:n] = lanes
-    useq = seq.astype(np.int64).view(np.uint64)
+    useq = seq.astype(np.int64, copy=False).view(np.uint64)
     seq_hi = np.zeros(m, dtype=np.uint32)
     seq_lo = np.zeros(m, dtype=np.uint32)
     seq_hi[:n] = (useq >> np.uint64(32)).astype(np.uint32)
@@ -436,13 +448,34 @@ def sort_table(table: pa.Table, key_names: Sequence[str],
     return order
 
 
+class _LazyLanes:
+    """Deferred np.concatenate of per-run lane matrices.  The packed-key
+    host fast path sorts the pre-packed u64 and never reads the lane
+    matrix; this defers (and usually skips) an 8N-byte copy per window.
+    Exposes .shape; np.asarray(...) materializes with a one-shot cache."""
+
+    def __init__(self, parts: List[np.ndarray]):
+        self._parts = parts
+        n = sum(p.shape[0] for p in parts)
+        self.shape = (n, parts[0].shape[1] if parts else 0)
+        self._mat: Optional[np.ndarray] = None
+
+    def __array__(self, dtype=None, copy=None):
+        if self._mat is None:
+            self._mat = (np.concatenate(self._parts)
+                         if len(self._parts) > 1 else self._parts[0])
+        return self._mat if dtype is None else self._mat.astype(dtype)
+
+
 def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
                merge_engine: str = "deduplicate",
                drop_deletes: bool = True,
                key_encoder: Optional[NormalizedKeyEncoder] = None,
                with_prev: bool = False,
                seq_fields: Optional[Sequence[str]] = None,
-               seq_desc: bool = False) -> MergeResult:
+               seq_desc: bool = False,
+               encoded: Optional[Sequence[Tuple[np.ndarray, np.ndarray]]]
+               = None) -> MergeResult:
     """Merge k sorted runs (oldest first) into the latest row per key.
 
     Equivalent reference path: MergeTreeReaders.readerForMergeTree
@@ -460,7 +493,29 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
         key_encoder = NormalizedKeyEncoder(
             [table.schema.field(k).type for k in key_names],
             nullable=[table.schema.field(k).nullable for k in key_names])
-    lanes, truncated = key_encoder.encode_table(table, key_names)
+    packed = None
+    if encoded is not None:
+        # caller already lane-encoded each run (streamed windows encode
+        # once for the window cut — don't pay the encode twice); items
+        # are (lanes, truncated[, packed-u64])
+        truncated = (np.concatenate([e[1] for e in encoded])
+                     if len(encoded) > 1 else np.asarray(encoded[0][1]))
+        packs = [e[2] if len(e) > 2 else None for e in encoded]
+        if all(p is not None for p in packs):
+            packed = (np.concatenate(packs) if len(packs) > 1
+                      else np.asarray(packs[0]))
+        if packed is not None:
+            # the packed-key host fast path never reads the lane matrix:
+            # concatenating it up front would copy 8N bytes per window
+            # for nothing, so defer until a path actually wants it
+            lane_parts = [e[0] for e in encoded]
+            lanes = _LazyLanes(lane_parts)
+        else:
+            lanes = (np.concatenate([e[0] for e in encoded])
+                     if len(encoded) > 1 else np.asarray(encoded[0][0]))
+    else:
+        lanes, truncated, packed = key_encoder.encode_table_ex(
+            table, key_names)
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
 
     keep = "first" if merge_engine == "first-row" else "last"
@@ -477,7 +532,8 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
     # seq-ordered segments with winners at segment boundaries
     perm, winner, prev = device_sorted_winners(
         lanes, seq, keep, order_lanes,
-        winners_only=not with_prev and not truncated.any())
+        winners_only=not with_prev and not truncated.any(),
+        packed=packed)
 
     win_pos = np.flatnonzero(winner)
     indices = perm[win_pos].astype(np.int64)

@@ -35,95 +35,128 @@ from paimon_tpu.ops.normkey import NormalizedKeyEncoder
 __all__ = ["merge_runs_streamed"]
 
 
-def _lanes_lt(lanes: np.ndarray, bound: Tuple) -> np.ndarray:
-    """Lexicographic lanes < bound, vectorized per lane column."""
-    n, num_lanes = lanes.shape
-    lt = np.zeros(n, dtype=bool)
-    eq = np.ones(n, dtype=bool)
-    for i in range(num_lanes):
-        col = lanes[:, i]
-        b = np.uint32(bound[i])
-        lt |= eq & (col < b)
-        eq &= col == b
-    return lt
+def _cut_point(lanes: np.ndarray, bound: Tuple) -> int:
+    """Rows with key lanes lexicographically < bound form a PREFIX of a
+    key-sorted buffer, so the cut is a binary search (O(L log n)), not a
+    full vectorized compare over the chunk."""
+    lo, hi = 0, lanes.shape[0]
+    num_lanes = lanes.shape[1]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        row = lanes[mid]
+        lt = False
+        for i in range(num_lanes):
+            ri = int(row[i])
+            bi = int(bound[i])
+            if ri != bi:
+                lt = ri < bi
+                break
+        if lt:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
 
 
 class _RunState:
-    def __init__(self, chunks: Iterator[pa.Table], key_cols: Sequence[str],
+    def __init__(self, chunks: Iterator, key_cols: Sequence[str],
                  encoder: NormalizedKeyEncoder):
         self._chunks = chunks
         self.key_cols = list(key_cols)
         self.encoder = encoder
-        self.buffer: List[Tuple[pa.Table, np.ndarray]] = []  # (table, lanes)
+        # (table, lanes, truncated, packed-u64-or-None) quads
+        self.buffer: List[Tuple] = []
         self.exhausted = False
 
     @property
     def buffered_rows(self) -> int:
-        return sum(t.num_rows for t, _ in self.buffer)
+        return sum(item[0].num_rows for item in self.buffer)
 
     def fill_one(self) -> bool:
         if self.exhausted:
             return False
         try:
-            t = next(self._chunks)
+            item = next(self._chunks)
         except StopIteration:
             self.exhausted = True
             return False
+        if isinstance(item, tuple):
+            # pre-encoded upstream (e.g. inside a prefetch thread, so
+            # the lane encode overlaps the merge):
+            # (table, lanes, trunc[, packed])
+            t, lanes, trunc = item[:3]
+            packed = item[3] if len(item) > 3 else None
+        else:
+            t, lanes, trunc, packed = item, None, None, None
         if t.num_rows == 0:
             return self.fill_one()
-        lanes, _ = self.encoder.encode_table(t, self.key_cols)
-        self.buffer.append((t, lanes))
+        if lanes is None:
+            lanes, trunc, packed = self.encoder.encode_table_ex(
+                t, self.key_cols)
+        self.buffer.append((t, lanes, trunc, packed))
         return True
 
     def last_key(self) -> Optional[Tuple]:
         if not self.buffer:
             return None
-        _, lanes = self.buffer[-1]
+        lanes = self.buffer[-1][1]
         return tuple(lanes[-1])
 
-    def cut_lt(self, bound: Tuple) -> List[pa.Table]:
+    def cut_lt(self, bound: Tuple) -> List[Tuple]:
         """Remove and return rows with key lanes < bound (a prefix of the
         buffer, since runs are key-sorted)."""
-        head: List[pa.Table] = []
-        new_buffer: List[Tuple[pa.Table, np.ndarray]] = []
-        for t, lanes in self.buffer:
+        head: List[Tuple] = []
+        new_buffer: List[Tuple] = []
+        for t, lanes, trunc, packed in self.buffer:
             if new_buffer:
-                new_buffer.append((t, lanes))   # already past the bound
+                new_buffer.append((t, lanes, trunc, packed))  # past bound
                 continue
-            lt = _lanes_lt(lanes, bound)
-            k = int(lt.sum())
+            k = _cut_point(lanes, bound)
             if k == t.num_rows:
-                head.append(t)
+                head.append((t, lanes, trunc, packed))
             else:
                 if k:
-                    head.append(t.slice(0, k))
-                new_buffer.append((t.slice(k), lanes[k:]))
+                    head.append((t.slice(0, k), lanes[:k], trunc[:k],
+                                 packed[:k] if packed is not None
+                                 else None))
+                new_buffer.append((t.slice(k), lanes[k:], trunc[k:],
+                                   packed[k:] if packed is not None
+                                   else None))
         self.buffer = new_buffer
         return head
 
-    def take_all(self) -> List[pa.Table]:
-        out = [t for t, _ in self.buffer]
+    def take_all(self) -> List[Tuple]:
+        out = self.buffer
         self.buffer = []
         return out
 
 
 def merge_runs_streamed(
-    run_chunk_iters: Sequence[Iterator[pa.Table]],
+    run_chunk_iters: Sequence[Iterator],
     key_cols: Sequence[str],
     key_encoder: NormalizedKeyEncoder,
     emit: Callable[[pa.Table], None],
-    merge_window: Callable[[List[pa.Table]], pa.Table],
+    merge_window: Callable[[List], pa.Table],
+    pass_encoded: bool = False,
 ) -> None:
     """Stream-merge k runs (oldest first) and emit merged key windows in
     ascending key order.
 
-    run_chunk_iters: one iterator of key-sorted KV chunks per run.
-    merge_window: merges a window's run-ordered chunk list into the final
-    rows (e.g. a merge_runs(...).take() or merge_runs_agg closure)."""
+    run_chunk_iters: one iterator of key-sorted KV chunks per run; each
+    item is a pa.Table or a pre-encoded (table, lanes, truncated[,
+    packed]) tuple.  merge_window: merges a window's run-ordered chunk
+    list into the final rows (e.g. a merge_runs(...).take() or
+    merge_runs_agg closure).  With pass_encoded=True it receives the
+    (table, lanes, truncated, packed) tuples so the kernel can skip
+    re-encoding (and re-packing) the window's keys."""
     runs = [_RunState(it, key_cols, key_encoder)
             for it in run_chunk_iters]
     for r in runs:
         r.fill_one()
+
+    def _window(items):
+        return merge_window(items if pass_encoded
+                            else [item[0] for item in items])
 
     while True:
         for r in runs:
@@ -135,14 +168,14 @@ def merge_runs_streamed(
             for r in runs:
                 tail.extend(r.take_all())
             if tail:
-                emit(merge_window(tail))
+                emit(_window(tail))
             return
         bound = min(r.last_key() for r in non_exhausted)
-        heads: List[pa.Table] = []
+        heads: List = []
         for r in runs:                      # run order = merge stability
             heads.extend(r.cut_lt(bound))
         if heads:
-            emit(merge_window(heads))
+            emit(_window(heads))
         else:
             # every buffered row >= bound: a key group spans entire
             # buffers; extend the runs sitting exactly at the bound
@@ -157,5 +190,5 @@ def merge_runs_streamed(
                 for r in runs:
                     tail.extend(r.take_all())
                 if tail:
-                    emit(merge_window(tail))
+                    emit(_window(tail))
                 return

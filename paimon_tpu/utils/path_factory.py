@@ -9,6 +9,7 @@ on-disk layout in SURVEY.md §2.9 / docs spec:
 
 from __future__ import annotations
 
+import itertools
 import uuid
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -28,7 +29,10 @@ class FileStorePathFactory:
         self.data_file_prefix = data_file_prefix
         self.changelog_file_prefix = changelog_file_prefix
         self._write_uuid = str(uuid.uuid4())
-        self._counter = 0
+        # itertools.count.__next__ is atomic under the GIL:
+        # file-name allocation is shared by concurrent writer
+        # threads (streamed compaction's flush pool)
+        self._counter = itertools.count()
 
     # -- dirs ----------------------------------------------------------------
 
@@ -86,14 +90,12 @@ class FileStorePathFactory:
     # -- file names ----------------------------------------------------------
 
     def new_data_file_name(self, extension: str = "parquet") -> str:
-        n = self._counter
-        self._counter += 1
+        n = next(self._counter)
         return f"{self.data_file_prefix}{self._write_uuid}-{n}.{extension}"
 
     def new_changelog_file_name(self, extension: str = "parquet",
                                 prefix: str = None) -> str:
-        n = self._counter
-        self._counter += 1
+        n = next(self._counter)
         return (f"{prefix or self.changelog_file_prefix}"
                 f"{self._write_uuid}-{n}.{extension}")
 
