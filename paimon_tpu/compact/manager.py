@@ -321,9 +321,13 @@ class MergeTreeCompactManager:
                 nonlocal acc, acc_bytes
                 if not acc:
                     return
+                # surface an already-failed write now instead of merging
+                # every remaining window first
+                for f in futures:
+                    if f.done() and f.exception() is not None:
+                        f.result()
                 # backpressure: at most 3 file-sized tables in flight so
-                # a slow disk can't unbound the streamed path's memory;
-                # waiting on the oldest also surfaces writer errors early
+                # a slow disk can't unbound the streamed path's memory
                 pending = [f for f in futures if not f.done()]
                 if len(pending) >= 3:
                     pending[0].result()

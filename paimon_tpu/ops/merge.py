@@ -464,7 +464,11 @@ class _LazyLanes:
         if self._mat is None:
             self._mat = (np.concatenate(self._parts)
                          if len(self._parts) > 1 else self._parts[0])
-        return self._mat if dtype is None else self._mat.astype(dtype)
+        out = self._mat if dtype is None else self._mat.astype(dtype)
+        if copy and out is self._mat:
+            out = out.copy()         # honor the NumPy 2 copy request —
+            # the cache (and parts[0]) stay owned by the streamed buffer
+        return out
 
 
 def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
