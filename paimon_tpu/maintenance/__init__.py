@@ -7,6 +7,9 @@ operation/OrphanFilesClean.java, operation/PartitionExpire.java.
 from paimon_tpu.maintenance.expire import (  # noqa: F401
     ExpireResult, expire_changelogs, expire_snapshots,
 )
+from paimon_tpu.maintenance.mark_done import (  # noqa: F401
+    PartitionMarkDoneTrigger, mark_partitions_done,
+)
 from paimon_tpu.maintenance.orphan import remove_orphan_files  # noqa: F401
 from paimon_tpu.maintenance.partition_expire import (  # noqa: F401
     expire_partitions,

@@ -17,7 +17,7 @@ from paimon_tpu.manifest import FileKind, ManifestEntry
 from paimon_tpu.options import CoreOptions
 from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
 
-__all__ = ["expire_partitions"]
+__all__ = ["expire_partitions", "partition_time_ms"]
 
 _JAVA_TO_STRPTIME = [
     ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
@@ -29,6 +29,29 @@ def _to_strptime(fmt: str) -> str:
     for java, py in _JAVA_TO_STRPTIME:
         fmt = fmt.replace(java, py)
     return fmt
+
+
+def partition_time_ms(options, values: "dict") -> Optional[int]:
+    """Partition time via partition.timestamp-formatter/pattern — the
+    single timestamp-extraction used by expiry AND mark-done (reference
+    partition/PartitionTimeExtractor.java). `values`: key -> value.
+    None when the partition does not parse."""
+    fmt = _to_strptime(options.get(
+        CoreOptions.PARTITION_TIMESTAMP_FORMATTER) or "yyyy-MM-dd")
+    pattern = options.get(CoreOptions.PARTITION_TIMESTAMP_PATTERN)
+    if pattern:
+        text = pattern
+        for k, v in values.items():
+            text = text.replace(f"${k}", str(v))
+    else:
+        if not values:
+            return None
+        text = str(next(iter(values.values())))
+    try:
+        ts = _dt.datetime.strptime(text, fmt)
+    except ValueError:
+        return None
+    return int(ts.timestamp() * 1000)
 
 
 def expire_partitions(table, expiration_ms: Optional[int] = None,
@@ -43,9 +66,6 @@ def expire_partitions(table, expiration_ms: Optional[int] = None,
         raise ValueError("partition.expiration-time is not set")
     if not table.partition_keys:
         raise ValueError("table is not partitioned")
-    fmt = _to_strptime(options.get(
-        CoreOptions.PARTITION_TIMESTAMP_FORMATTER) or "yyyy-MM-dd")
-    pattern = options.get(CoreOptions.PARTITION_TIMESTAMP_PATTERN)
     now = now_ms if now_ms is not None else int(_time.time() * 1000)
     cutoff = now - expiration_ms
 
@@ -62,18 +82,11 @@ def expire_partitions(table, expiration_ms: Optional[int] = None,
         values = scan._partition_codec.from_bytes(e.partition)
         by_part.setdefault(e.partition, (values, []))[1].append(e)
     for pbytes, (values, _) in by_part.items():
-        if pattern:
-            text = pattern
-            for k, v in zip(pkeys, values):
-                text = text.replace(f"${k}", str(v))
-        else:
-            text = str(values[0])
-        try:
-            ts = _dt.datetime.strptime(text, fmt)
-        except ValueError:
+        ms = partition_time_ms(options, dict(zip(pkeys, values)))
+        if ms is None:
             continue        # unparseable partitions never expire
-        if ts.timestamp() * 1000 < cutoff:
-            expired_parts.add((ts.timestamp(), pbytes))
+        if ms < cutoff:
+            expired_parts.add((ms / 1000.0, pbytes))
 
     if not expired_parts:
         return []

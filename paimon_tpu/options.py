@@ -294,6 +294,22 @@ class CoreOptions:
         "partition.timestamp-formatter", str, None, "")
     PARTITION_TIMESTAMP_PATTERN = ConfigOption(
         "partition.timestamp-pattern", str, None, "")
+    PARTITION_MARK_DONE_ACTION = ConfigOption(
+        "partition.mark-done-action", str, "success-file",
+        "csv of success-file|done-partition|mark-event|http-report|custom")
+    PARTITION_MARK_DONE_CUSTOM_CLASS = ConfigOption(
+        "partition.mark-done-action.custom.class", str, None,
+        "module:Class implementing PartitionMarkDoneAction")
+    PARTITION_MARK_DONE_HTTP_URL = ConfigOption(
+        "partition.mark-done-action.http.url", str, None, "")
+    PARTITION_MARK_DONE_HTTP_PARAMS = ConfigOption(
+        "partition.mark-done-action.http.params", str, None, "")
+    PARTITION_MARK_DONE_WHEN_END_INPUT = ConfigOption(
+        "partition.mark-done-when-end-input", _parse_bool, False, "")
+    PARTITION_IDLE_TIME_TO_DONE = ConfigOption(
+        "partition.idle-time-to-done", _parse_duration_ms, None, "")
+    PARTITION_TIME_INTERVAL = ConfigOption(
+        "partition.time-interval", _parse_duration_ms, None, "")
     TAG_AUTOMATIC_CREATION = ConfigOption("tag.automatic-creation", str,
                                           "none", "")
     FILE_INDEX_BLOOM_COLUMNS = ConfigOption(

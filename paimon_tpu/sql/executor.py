@@ -1379,6 +1379,13 @@ class SQLContext:
         if proc == "rewrite_file_index" or proc == "analyze":
             n = table.analyze()
             return _result([f"{n or 0} rows analyzed"])
+        if proc == "mark_partition_done":
+            # reference flink/procedure/MarkPartitionDoneProcedure.java:
+            # CALL sys.mark_partition_done('db.t', 'dt=2026-07-29', ...)
+            if not rest:
+                raise SQLError("mark_partition_done needs partitions")
+            marked = table.mark_partitions_done([str(p) for p in rest])
+            return _result([f"{len(marked)} partitions marked done"])
         raise SQLError(f"unknown procedure {c.procedure!r}")
 
 

@@ -247,6 +247,13 @@ class FileStoreTable:
         return expire_partitions(self, expiration_ms=expiration_ms,
                                  now_ms=now_ms, dry_run=dry_run)
 
+    def mark_partitions_done(self, partitions):
+        """Run the configured partition.mark-done-action(s) — write
+        `_SUCCESS` markers etc. (reference
+        flink/procedure/MarkPartitionDoneProcedure.java)."""
+        from paimon_tpu.maintenance import mark_partitions_done
+        return mark_partitions_done(self, partitions)
+
     def create_tag(self, name: str, snapshot_id: Optional[int] = None):
         snap = (self.snapshot_manager.snapshot(snapshot_id)
                 if snapshot_id is not None

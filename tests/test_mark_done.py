@@ -1,0 +1,154 @@
+"""Partition mark-done actions + streaming trigger.
+
+reference: partition/actions/* (SuccessFileMarkDoneAction writes a
+key-compatible `_SUCCESS` JSON, AddDonePartitionAction registers
+'<partition>.done'), flink/sink/listener/PartitionMarkDoneTrigger.java
+(idle-time + partition-time-interval + end-input semantics),
+flink/procedure/MarkPartitionDoneProcedure.java.
+"""
+
+import json
+import os
+
+import pytest
+
+from paimon_tpu.maintenance.mark_done import (
+    AddDonePartitionAction, PartitionMarkDoneTrigger, SuccessFile,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, VarCharType
+
+
+def _make(tmp_warehouse, opts=None):
+    options = {"bucket": "1", "write-only": "true"}
+    options.update(opts or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .column("dt", VarCharType(nullable=False))
+              .partition_keys("dt")
+              .primary_key("id", "dt")
+              .options(options).build())
+    return FileStoreTable.create(os.path.join(tmp_warehouse, "t"), schema)
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def test_success_file_marker(tmp_warehouse):
+    t = _make(tmp_warehouse)
+    _commit(t, [{"id": 1, "v": 1.0, "dt": "2026-07-01"}])
+    marked = t.mark_partitions_done(["dt=2026-07-01"])
+    assert marked == ["dt=2026-07-01"]
+    path = os.path.join(t.path, "dt=2026-07-01", "_SUCCESS")
+    assert os.path.exists(path)
+    sf = SuccessFile.from_json(open(path).read())
+    assert sf.creation_time == sf.modification_time > 0
+    # re-mark: creationTime survives, modificationTime advances
+    first_created = sf.creation_time
+    t.mark_partitions_done([("2026-07-01",)])   # tuple form
+    sf2 = SuccessFile.from_json(open(path).read())
+    assert sf2.creation_time == first_created
+    assert sf2.modification_time >= sf.modification_time
+    # wire shape: reference SuccessFile.java JSON keys
+    d = json.loads(open(path).read())
+    assert set(d) == {"creationTime", "modificationTime"}
+
+
+def test_done_partition_and_event_actions(tmp_warehouse):
+    t = _make(tmp_warehouse, {
+        "partition.mark-done-action":
+            "success-file,done-partition,mark-event"})
+    _commit(t, [{"id": 1, "v": 1.0, "dt": "2026-07-01"},
+                {"id": 2, "v": 2.0, "dt": "2026-07-02"}])
+    t.mark_partitions_done([{"dt": "2026-07-01"}, "dt=2026-07-02"])
+    reg = AddDonePartitionAction(t.file_io, t.path)
+    assert reg.done_partitions() == ["dt=2026-07-01.done",
+                                     "dt=2026-07-02.done"]
+    # idempotent registration
+    t.mark_partitions_done(["dt=2026-07-01"])
+    assert reg.done_partitions().count("dt=2026-07-01.done") == 1
+    from paimon_tpu.maintenance.mark_done import MarkPartitionDoneEventAction
+    events = MarkPartitionDoneEventAction(t.file_io, t.path).events()
+    assert sorted(e["partition"] for e in events) == [
+        "dt=2026-07-01", "dt=2026-07-01", "dt=2026-07-02"]
+    assert all(e["event"] == "partition.done" for e in events)
+
+
+def test_unpartitioned_rejected(tmp_warehouse):
+    schema = (Schema.builder().column("id", BigIntType(False))
+              .column("v", DoubleType()).primary_key("id")
+              .options({"bucket": "1"}).build())
+    t = FileStoreTable.create(os.path.join(tmp_warehouse, "u"), schema)
+    with pytest.raises(ValueError, match="not partitioned"):
+        t.mark_partitions_done(["dt=x"])
+
+
+def test_trigger_idle_time_semantics(tmp_warehouse):
+    t = _make(tmp_warehouse, {
+        "partition.idle-time-to-done": "15 min",
+        "partition.time-interval": "1 d"})
+    trig = PartitionMarkDoneTrigger(t)
+    day = 24 * 3600 * 1000
+    import datetime
+    start = int(datetime.datetime(2026, 7, 1).timestamp() * 1000)
+    trig.notify("dt=2026-07-01", now_ms=start + day // 2)
+    # partition day not over: effective time = start + interval
+    assert trig.done_partitions(now_ms=start + day) == []
+    # 10 min past the day boundary: still inside idle window
+    assert trig.done_partitions(now_ms=start + day + 10 * 60000) == []
+    # 16 min past: done, and removed from pending
+    assert trig.done_partitions(
+        now_ms=start + day + 16 * 60000) == ["dt=2026-07-01"]
+    assert trig.done_partitions(now_ms=start + 2 * day) == []
+    # late write AFTER the day: idle clock runs from last update
+    trig.notify("dt=2026-07-01", now_ms=start + 2 * day)
+    assert trig.done_partitions(now_ms=start + 2 * day + 14 * 60000) == []
+    assert trig.done_partitions(
+        now_ms=start + 2 * day + 16 * 60000) == ["dt=2026-07-01"]
+
+
+def test_trigger_end_input_and_state(tmp_warehouse):
+    t = _make(tmp_warehouse, {
+        "partition.mark-done-when-end-input": "true"})
+    trig = PartitionMarkDoneTrigger(t)
+    trig.notify(("2026-07-01",))
+    trig.notify("dt=2026-07-02")
+    # checkpoint/restore round-trip
+    state = trig.snapshot()
+    trig2 = PartitionMarkDoneTrigger(t)
+    trig2.restore(state)
+    done = trig2.mark(end_input=True)
+    assert sorted(done) == ["dt=2026-07-01", "dt=2026-07-02"]
+    assert os.path.exists(os.path.join(t.path, "dt=2026-07-01", "_SUCCESS"))
+    assert trig2.done_partitions(end_input=True) == []
+
+
+def test_traversal_rejected(tmp_warehouse):
+    """SQL-reachable partition strings must not escape the table dir."""
+    t = _make(tmp_warehouse)
+    with pytest.raises(ValueError, match="escapes"):
+        t.mark_partitions_done(["../../evil"])
+
+
+def test_trigger_misconfig_rejected(tmp_warehouse):
+    """idle-time without time-interval would silently never mark."""
+    t = _make(tmp_warehouse, {"partition.idle-time-to-done": "15 min"})
+    with pytest.raises(ValueError, match="must be set together"):
+        PartitionMarkDoneTrigger(t)
+
+
+def test_trigger_skips_unparseable_partition(tmp_warehouse):
+    t = _make(tmp_warehouse, {
+        "partition.idle-time-to-done": "1 s",
+        "partition.time-interval": "1 s"})
+    trig = PartitionMarkDoneTrigger(t)
+    trig.notify("dt=not-a-date", now_ms=0)
+    assert trig.done_partitions(now_ms=10 ** 12) == []
+    assert trig.snapshot() == []

@@ -478,6 +478,19 @@ class TestProceduresAndTravel:
         r = ctx.sql("CALL sys.expire_snapshots('orders', 1)")
         assert "expired" in r.column("result")[0].as_py()
 
+    def test_call_mark_partition_done(self, ctx):
+        import os
+        ctx.sql("CREATE TABLE pt (id BIGINT NOT NULL, v DOUBLE, "
+                "dt STRING NOT NULL, PRIMARY KEY (id, dt)) "
+                "PARTITIONED BY (dt) WITH ('bucket' = '1')")
+        ctx.sql("INSERT INTO pt VALUES (1, 1.0, '2026-07-01')")
+        r = ctx.sql(
+            "CALL sys.mark_partition_done('pt', 'dt=2026-07-01')")
+        assert "1 partitions marked done" in r.column("result")[0].as_py()
+        t = ctx.catalog.get_table(ctx._ident("pt"))
+        assert os.path.exists(
+            os.path.join(t.path, "dt=2026-07-01", "_SUCCESS"))
+
 
 class TestGlobalSystemTables:
     def test_sys_database_tables(self, ctx):
