@@ -72,7 +72,11 @@ def build_table(path, rows, runs):
               .column("v2", DoubleType())
               .column("v3", IntType())
               .primary_key("id")
-              .options({"bucket": "1", "write-only": "true"})
+              # dictionary encoding is pure overhead on this benchmark's
+              # high-cardinality columns (documented table option, same
+              # knob the reference's parquet writer exposes)
+              .options({"bucket": "1", "write-only": "true",
+                        "parquet.enable.dictionary": "false"})
               .build())
     table = FileStoreTable.create(path, schema)
     rng = np.random.default_rng(7)

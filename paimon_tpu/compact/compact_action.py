@@ -42,7 +42,8 @@ def _make_append_writer(table, path_factory):
         index_spec=table.options.file_index_spec,
         bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
         index_in_manifest_threshold=table.options.get(
-            CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
+            CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
+        format_options=table.options.format_options)
 
 
 def _read_bucket(table, path_factory, partition, bucket, files,

@@ -132,7 +132,8 @@ class MergeTreeCompactManager:
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
-            format_per_level=options.file_format_per_level)
+            format_per_level=options.file_format_per_level,
+            format_options=options.format_options)
         rt = schema.logical_row_type()
         self.trimmed_pk = schema.trimmed_primary_keys()
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]
@@ -418,7 +419,8 @@ class MergeTreeCompactManager:
             self.options.changelog_file_format,
             self.options.changelog_file_compression,
             self.partition, self.bucket, cl,
-            prefix=self.options.changelog_file_prefix)
+            prefix=self.options.changelog_file_prefix,
+            format_options=self.options.format_options)
 
     # -- merged-state helpers ------------------------------------------------
 

@@ -47,11 +47,13 @@ class AppendFileWriter:
                  compression: str, target_file_size: int,
                  index_spec: Optional[Dict[str, List[str]]] = None,
                  bloom_fpp: float = 0.01,
-                 index_in_manifest_threshold: int = 500):
+                 index_in_manifest_threshold: int = 500,
+                 format_options: Optional[Dict[str, str]] = None):
         self.file_io = file_io
         self.path_factory = path_factory
         self.schema = table_schema
         self.file_format = file_format
+        self.format_options = format_options or {}
         self.compression = compression
         self.target_file_size = target_file_size
         self.index_spec = index_spec or {}
@@ -88,7 +90,8 @@ class AppendFileWriter:
             chunk, blob_extras = externalize_blobs(
                 self.file_io, self.path_factory, partition, bucket, name,
                 chunk, blob_cols)
-        size = fmt.create_writer(self.compression).write(
+        size = fmt.create_writer(self.compression,
+                                 self.format_options).write(
             self.file_io, path, chunk)
         value_cols = [f.name for f in self.schema.fields]
         vmins, vmaxs, vnulls = extract_simple_stats(chunk, value_cols)
@@ -184,7 +187,8 @@ class AppendOnlyFileStoreWrite:
             index_spec=options.file_index_spec,
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
-                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD))
+                CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
+            format_options=options.format_options)
         self.total_buckets = options.bucket
         self._unaware = options.bucket < 1
         if not self._unaware:

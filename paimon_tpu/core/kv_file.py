@@ -37,12 +37,14 @@ class KeyValueFileWriter:
                  index_spec: Optional[Dict[str, List[str]]] = None,
                  bloom_fpp: float = 0.01,
                  index_in_manifest_threshold: int = 500,
-                 format_per_level: Optional[Dict[int, str]] = None):
+                 format_per_level: Optional[Dict[int, str]] = None,
+                 format_options: Optional[Dict[str, str]] = None):
         self.file_io = file_io
         self.path_factory = path_factory
         self.schema = table_schema
         self.file_format = file_format
         self.format_per_level = format_per_level or {}
+        self.format_options = format_options or {}
         self.compression = compression
         self.target_file_size = target_file_size
         self.index_spec = index_spec or {}
@@ -87,7 +89,8 @@ class KeyValueFileWriter:
             chunk, blob_extras = externalize_blobs(
                 self.file_io, self.path_factory, partition, bucket, name,
                 chunk, blob_cols)
-        size = fmt.create_writer(self.compression).write(
+        size = fmt.create_writer(self.compression,
+                                 self.format_options).write(
             self.file_io, path, chunk)
 
         # key stats + min/max key (first/last row: chunk is key-sorted)
@@ -163,7 +166,8 @@ def write_changelog_file(file_io: FileIO,
                          schema: TableSchema, file_format: str,
                          compression: str, partition: Tuple, bucket: int,
                          table: pa.Table,
-                         prefix: Optional[str] = None
+                         prefix: Optional[str] = None,
+                         format_options: Optional[Dict[str, str]] = None
                          ) -> List[DataFileMeta]:
     """Write a changelog file (KV layout with _VALUE_KIND kinds kept).
     Shared by changelog-producer=input (write path) and the compaction
@@ -173,7 +177,8 @@ def write_changelog_file(file_io: FileIO,
     fmt = get_format(file_format)
     name = path_factory.new_changelog_file_name(fmt.extension, prefix)
     path = path_factory.data_file_path(partition, bucket, name)
-    size = fmt.create_writer(compression).write(file_io, path, table)
+    size = fmt.create_writer(compression, format_options).write(
+        file_io, path, table)
     return [DataFileMeta(
         file_name=name, file_size=size, row_count=table.num_rows,
         min_key=b"", max_key=b"",

@@ -187,7 +187,8 @@ def _write_tracked_file(table, fs_scan, split, chunk, *, row_count,
     name = fs_scan.path_factory.new_data_file_name(fmt.extension)
     path = fs_scan.path_factory.data_file_path(
         split.partition, split.bucket, name)
-    size = fmt.create_writer(table.options.file_compression).write(
+    size = fmt.create_writer(table.options.file_compression,
+                             table.options.format_options).write(
         table.file_io, path, chunk)
     mins, maxs, nulls = extract_simple_stats(chunk, cols)
     by_name = {f.name: f.type for f in table.schema.fields}

@@ -283,7 +283,8 @@ class KeyValueFileStoreWrite:
             bloom_fpp=options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
-            format_per_level=options.file_format_per_level)
+            format_per_level=options.file_format_per_level,
+            format_options=options.format_options)
         rt = table_schema.logical_row_type()
         self.total_buckets = options.bucket
         bucket_keys = table_schema.bucket_keys()
@@ -353,7 +354,8 @@ class KeyValueFileStoreWrite:
             self.options.changelog_file_format,
             self.options.changelog_file_compression,
             partition, bucket, table,
-            prefix=self.options.changelog_file_prefix)
+            prefix=self.options.changelog_file_prefix,
+            format_options=self.options.format_options)
 
     # -- writes --------------------------------------------------------------
 

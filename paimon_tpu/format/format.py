@@ -45,6 +45,10 @@ class FormatReader:
 
 
 class FormatWriter:
+    """Writer contract: constructors take (compression, format_options)
+    — format_options is the raw option map (e.g. parquet.*) and writers
+    ignore keys that aren't theirs."""
+
     def write(self, file_io: FileIO, path: str, table: pa.Table) -> int:
         """Write table, return file size in bytes."""
         raise NotImplementedError
@@ -79,16 +83,24 @@ def split_compression(spec: str):
 
 class _ParquetWriter(FormatWriter):
     def __init__(self, compression: str = "zstd",
-                 row_group_rows: int = 1 << 20):
+                 row_group_rows: int = 1 << 20,
+                 format_options: Optional[Dict[str, str]] = None):
         self.compression, self.level = split_compression(compression)
-        self.row_group_rows = row_group_rows
+        fo = format_options or {}
+        self.row_group_rows = int(fo.get("parquet.row-group.rows",
+                                         row_group_rows))
+        # parquet.enable.dictionary (reference parquet writer option):
+        # dictionary encoding is pure overhead on high-cardinality data
+        self.use_dictionary = fo.get(
+            "parquet.enable.dictionary", "true").lower() != "false"
 
     def write(self, file_io, path, table):
         buf = io.BytesIO()
         pq.write_table(table, buf, compression=self.compression,
                        compression_level=self.level,
                        row_group_size=self.row_group_rows,
-                       use_dictionary=True, write_statistics=True)
+                       use_dictionary=self.use_dictionary,
+                       write_statistics=True)
         data = buf.getvalue()
         file_io.write_bytes(path, data, overwrite=False)
         return len(data)
@@ -104,7 +116,8 @@ class _OrcReader(FormatReader):
 
 
 class _OrcWriter(FormatWriter):
-    def __init__(self, compression: str = "zstd"):
+    def __init__(self, compression: str = "zstd",
+                 format_options: Optional[Dict[str, str]] = None):
         self.compression, _ = split_compression(compression)
 
     def write(self, file_io, path, table):
@@ -129,7 +142,8 @@ class _AvroRowReader(FormatReader):
 
 
 class _AvroRowWriter(FormatWriter):
-    def __init__(self, compression: str = "zstd"):
+    def __init__(self, compression: str = "zstd",
+                 format_options: Optional[Dict[str, str]] = None):
         compression, _ = split_compression(compression)
         self.codec = {"zstd": "zstandard", "none": "null",
                       "gzip": "deflate"}.get(compression, compression)
@@ -183,8 +197,11 @@ class FileFormatFactory:
     def create_reader(self) -> FormatReader:
         return self.reader
 
-    def create_writer(self, compression: str = "zstd") -> FormatWriter:
-        return self._writer_cls(compression)
+    def create_writer(self, compression: str = "zstd",
+                      format_options: Optional[Dict[str, str]] = None
+                      ) -> FormatWriter:
+        return self._writer_cls(compression,
+                                 format_options=format_options)
 
 
 class _CsvReader(FormatReader):
@@ -198,7 +215,8 @@ class _CsvReader(FormatReader):
 
 
 class _CsvWriter(FormatWriter):
-    def __init__(self, compression: str = "none"):
+    def __init__(self, compression: str = "none",
+                 format_options: Optional[Dict[str, str]] = None):
         pass
 
     def write(self, file_io, path, table):
@@ -221,7 +239,8 @@ class _JsonReader(FormatReader):
 
 
 class _JsonWriter(FormatWriter):
-    def __init__(self, compression: str = "none"):
+    def __init__(self, compression: str = "none",
+                 format_options: Optional[Dict[str, str]] = None):
         pass
 
     def write(self, file_io, path, table):

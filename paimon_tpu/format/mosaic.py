@@ -71,7 +71,8 @@ class MosaicWriter(FormatWriter):
     def __init__(self, compression: str = "zstd",
                  row_group_rows: int = DEFAULT_ROW_GROUP_ROWS,
                  num_buckets: Optional[int] = None,
-                 stats_columns: Optional[Sequence[str]] = None):
+                 stats_columns: Optional[Sequence[str]] = None,
+                 format_options: Optional[Dict[str, str]] = None):
         from paimon_tpu.format.format import split_compression
         codec, level = split_compression(compression or "none")
         if codec in ("none", None):

@@ -100,25 +100,24 @@ class _FileMetastoreMarkDone(PartitionMarkDoneAction):
 
 class AddDonePartitionAction(_FileMetastoreMarkDone):
     """reference AddDonePartitionAction: registers a '<partition>.done'
-    partition in the metastore."""
+    partition in the metastore.  One marker file per partition — a
+    single rewritten JSON list would lose registrations under
+    concurrent markers (read-modify-write race)."""
 
     def mark_done(self, partition: str) -> None:
-        path = f"{self.dir}/done-partitions.json"
-        done: List[str] = []
-        if self.file_io.exists(path):
-            done = json.loads(self.file_io.read_bytes(path))
-        entry = partition.rstrip("/") + ".done"
-        if entry not in done:
-            done.append(entry)
-            self.file_io.write_bytes(
-                path, json.dumps(done, indent=2).encode("utf-8"),
-                overwrite=True)
+        rel = partition.rstrip("/")
+        path = safe_join(f"{self.dir}/done-partitions", rel + ".done")
+        self.file_io.write_bytes(path, b"", overwrite=True)
 
     def done_partitions(self) -> List[str]:
-        path = f"{self.dir}/done-partitions.json"
-        if not self.file_io.exists(path):
+        d = f"{self.dir}/done-partitions"
+        if not self.file_io.exists(d):
             return []
-        return json.loads(self.file_io.read_bytes(path))
+        prefix = d.rstrip("/") + "/"
+        return sorted(
+            st.path[len(prefix):]
+            for st in self.file_io.list_status_recursive(d)
+            if st.path.endswith(".done"))
 
 
 class MarkPartitionDoneEventAction(_FileMetastoreMarkDone):
@@ -221,9 +220,16 @@ def _partition_rel_path(table, partition) -> str:
     else:
         keys = table.partition_keys
         if isinstance(partition, dict):
+            missing = [k for k in keys if k not in partition]
+            if missing:
+                raise ValueError(f"partition value missing keys {missing}")
             values = [partition[k] for k in keys]
         else:
             values = list(partition)
+            if len(values) != len(keys):
+                raise ValueError(
+                    f"partition {values!r} does not match partition keys "
+                    f"{keys} (got {len(values)} values, need {len(keys)})")
         rel = "/".join(f"{k}={v}" for k, v in zip(keys, values))
     safe_join(table.path, rel)       # raises on '..' / absolute / empty
     return rel
@@ -288,15 +294,21 @@ class PartitionMarkDoneTrigger:
                               else int(_time.time() * 1000))
 
     def done_partitions(self, end_input: bool = False,
-                        now_ms: Optional[int] = None) -> List[str]:
+                        now_ms: Optional[int] = None,
+                        remove: bool = True) -> List[str]:
+        due = self._due(end_input, now_ms)
+        if remove:
+            for rel in due:
+                self._pending.pop(rel, None)
+        return due
+
+    def _due(self, end_input: bool, now_ms: Optional[int]) -> List[str]:
         if end_input and self.end_input_marks:
-            done = list(self._pending)
-            self._pending.clear()
-            return done
+            return list(self._pending)
         if self.time_interval is None or self.idle_time is None:
             return []
         now = now_ms if now_ms is not None else int(_time.time() * 1000)
-        done = []
+        due = []
         for rel, last_update in list(self._pending.items()):
             start = self._partition_start_ms(rel)
             if start is None:               # unparseable: drop (reference
@@ -304,15 +316,23 @@ class PartitionMarkDoneTrigger:
                 continue
             effective = max(last_update, start + self.time_interval)
             if now - effective > self.idle_time:
-                done.append(rel)
-                del self._pending[rel]
-        return done
+                due.append(rel)
+        return due
 
     def mark(self, end_input: bool = False,
              now_ms: Optional[int] = None) -> List[str]:
-        done = self.done_partitions(end_input, now_ms)
-        if done:
-            mark_partitions_done(self.table, done)
+        """Run the actions for every due partition; a partition leaves
+        the pending set only AFTER its actions succeeded, so a failing
+        action (e.g. http endpoint down) retries on the next mark()."""
+        due = self.done_partitions(end_input, now_ms, remove=False)
+        done = []
+        try:
+            for rel in due:
+                mark_partitions_done(self.table, [rel])
+                done.append(rel)
+        finally:
+            for rel in done:
+                self._pending.pop(rel, None)
         return done
 
     # -- checkpoint state ---------------------------------------------------

@@ -188,7 +188,8 @@ def compact_table_sharded(table, mesh=None,
         target_file_size=table.options.target_file_size,
         index_spec=table.options.file_index_spec,
         bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
-        format_per_level=table.options.file_format_per_level)
+        format_per_level=table.options.file_format_per_level,
+        format_options=table.options.format_options)
     max_level = table.options.max_level
     messages = []
     out_rows = 0

@@ -288,3 +288,28 @@ class TestPartitionExpireCap:
         remaining = set(
             np.asarray(t.to_arrow().column("dt")).tolist())
         assert remaining == {"2000-01-03", "2000-01-04"}
+
+
+class TestParquetFormatOptions:
+    def test_enable_dictionary_off(self, tmp_path):
+        """parquet.enable.dictionary=false reaches the parquet writer
+        (reference: format options forwarded to FileFormat factories)."""
+        import pyarrow.parquet as pq
+
+        t = _pk_table(tmp_path / "t", {
+            "parquet.enable.dictionary": "false"})
+        _write(t, [{"id": i, "seq": 1, "v": 1.0} for i in range(10)])
+        t2 = _pk_table(tmp_path / "t2")
+        _write(t2, [{"id": i, "seq": 1, "v": 1.0} for i in range(10)])
+
+        def dict_encoded(table):
+            split = table.new_read_builder().new_scan().plan().splits[0]
+            f = split.data_files[0]
+            path = (f"{table.path}/bucket-0/{f.file_name}")
+            md = pq.ParquetFile(path).metadata
+            col = md.row_group(0).column(0)
+            return "PLAIN_DICTIONARY" in str(col.encodings) or \
+                "RLE_DICTIONARY" in str(col.encodings)
+
+        assert not dict_encoded(t)
+        assert dict_encoded(t2)       # default stays dictionary-on
