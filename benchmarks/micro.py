@@ -1,0 +1,204 @@
+"""Micro-benchmarks mirroring the reference's JUnit micro-bench suite
+(paimon-micro-benchmarks: TableReadBenchmark.java:43 — 1M-row scans per
+format ± projection, TableWriterBenchmark, LookupReaderBenchmark /
+LookupWriterBenchmark, bitmap index benchmarks).
+
+Usage:
+    python -m benchmarks.micro [name ...]       # default: all
+Prints ONE JSON line per benchmark:
+    {"benchmark": ..., "value": ..., "unit": "rows/s", ...}
+
+Forces the CPU backend both ways (env + jax config) — micro-benches
+must never touch the single-client TPU tunnel (see tests/conftest.py);
+bench.py owns the TPU.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+ROWS = int(os.environ.get("MICRO_ROWS", str(1_000_000)))
+RUNS = int(os.environ.get("MICRO_RUNS", "3"))
+
+
+def _schema(file_format: str):
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.types import BigIntType, DoubleType, IntType, VarCharType
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v1", BigIntType())
+            .column("v2", DoubleType())
+            .column("v3", IntType())
+            .column("s", VarCharType())
+            .primary_key("id")
+            .options({"bucket": "1", "write-only": "true",
+                      "file.format": file_format})
+            .build())
+
+
+def _data(rows: int, seed: int = 7) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    ids = rng.permutation(rows)
+    return pa.table({
+        "id": pa.array(ids, pa.int64()),
+        "v1": pa.array(rng.integers(0, 1 << 40, rows), pa.int64()),
+        "v2": pa.array(rng.random(rows), pa.float64()),
+        "v3": pa.array(rng.integers(0, 100, rows).astype(np.int32),
+                       pa.int32()),
+        "s": pa.array(np.char.add("val-", (ids % 1000).astype(str))),
+    })
+
+
+def _build_table(tmp: str, file_format: str, rows: int):
+    from paimon_tpu.table import FileStoreTable
+    table = FileStoreTable.create(os.path.join(tmp, f"t_{file_format}"),
+                                  _schema(file_format))
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_arrow(_data(rows))
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return table
+
+
+def _best(fn, runs: int = RUNS) -> float:
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _emit(name: str, rows: int, seconds: float, **extra):
+    out = {"benchmark": name, "value": round(rows / seconds, 1),
+           "unit": "rows/s", "rows": rows,
+           "best_seconds": round(seconds, 4)}
+    out.update(extra)                    # extra may override unit
+    print(json.dumps(out), flush=True)
+
+
+# -- benchmarks (reference TableReadBenchmark.java:43) ---------------------
+
+def bench_read(fmt: str):
+    with tempfile.TemporaryDirectory() as tmp:
+        table = _build_table(tmp, fmt, ROWS)
+        _emit(f"table_read_{fmt}", ROWS,
+              _best(lambda: table.to_arrow()))
+        _emit(f"table_read_{fmt}_projection", ROWS,
+              _best(lambda: table.to_arrow(projection=["id"])),
+              projection=["id"])
+
+
+def bench_write(fmt: str = "parquet"):
+    """reference TableWriterBenchmark.java (write + commit loop)."""
+    data = _data(ROWS)
+    from paimon_tpu.table import FileStoreTable
+
+    def run():
+        with tempfile.TemporaryDirectory() as tmp:
+            table = FileStoreTable.create(os.path.join(tmp, "t"),
+                                          _schema(fmt))
+            wb = table.new_batch_write_builder()
+            w = wb.new_write()
+            w.write_arrow(data)
+            wb.new_commit().commit(w.prepare_commit())
+            w.close()
+
+    _emit(f"table_write_{fmt}", ROWS, _best(run))
+
+
+def bench_lookup():
+    """reference LookupReaderBenchmark/LookupWriterBenchmark: build the
+    SST-backed point-lookup state, then random point probes."""
+    from paimon_tpu.lookup import LocalTableQuery
+    rows = min(ROWS, 1_000_000)
+    with tempfile.TemporaryDirectory() as tmp:
+        table = _build_table(tmp, "parquet", rows)
+        q = LocalTableQuery(table, cache_dir=os.path.join(tmp, "cache"))
+        t0 = time.perf_counter()
+        q.lookup([{"id": 0}])                    # build spilled state
+        _emit("lookup_build_sst", rows, time.perf_counter() - t0)
+        rng = np.random.default_rng(3)
+        keys = [{"id": int(k)} for k in rng.integers(0, rows, 10_000)]
+        probes = _best(lambda: q.lookup(keys))
+        _emit("lookup_probe", len(keys), probes, unit="probes/s")
+
+
+def bench_bitmap():
+    """reference bitmap index benchmarks: build + predicate filter."""
+    from paimon_tpu.index.bitmap import BitmapIndex
+    rows = ROWS
+    rng = np.random.default_rng(5)
+    col = pa.chunked_array([pa.array(rng.integers(0, 64, rows),
+                                     pa.int64())])
+    t0 = time.perf_counter()
+    built = BitmapIndex.build(col)
+    _emit("bitmap_index_build", rows, time.perf_counter() - t0)
+    blob = built.serialize()
+    idx = BitmapIndex.deserialize(blob)
+    _emit("bitmap_index_probe", rows,
+          _best(lambda: idx.eval("eq", 7)),
+          blob_bytes=len(blob))
+
+
+def bench_merge():
+    """the flagship segmented merge on host (ops/merge.py), isolated
+    from file IO — the CPU analog of the kernel the TPU runs."""
+    from paimon_tpu.ops.merge import merge_runs
+    from paimon_tpu.ops.normkey import NormalizedKeyEncoder
+    rows = ROWS
+    rng = np.random.default_rng(11)
+    runs = []
+    per = rows // 10
+    for r in range(10):
+        ids = np.sort(rng.integers(0, rows // 2, per))
+        runs.append(pa.table({
+            "_KEY_id": pa.array(ids, pa.int64()),
+            "_SEQUENCE_NUMBER": pa.array(
+                np.arange(r * per, (r + 1) * per), pa.int64()),
+            "_VALUE_KIND": pa.array(np.zeros(per, np.int8), pa.int8()),
+            "v": pa.array(rng.random(per), pa.float64()),
+        }))
+    enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
+    _emit("merge_dedup_10runs", rows,
+          _best(lambda: merge_runs(runs, ["_KEY_id"],
+                                   key_encoder=enc).take()))
+
+
+BENCHES = {
+    "read_parquet": lambda: bench_read("parquet"),
+    "read_orc": lambda: bench_read("orc"),
+    "read_avro": lambda: bench_read("avro"),
+    "write": bench_write,
+    "lookup": bench_lookup,
+    "bitmap": bench_bitmap,
+    "merge": bench_merge,
+}
+
+
+def main(argv):
+    names = argv or list(BENCHES)
+    unknown = [n for n in names if n not in BENCHES]
+    if unknown:
+        sys.stderr.write(f"unknown benchmarks {unknown}; "
+                         f"available: {sorted(BENCHES)}\n")
+        return 1
+    for n in names:
+        BENCHES[n]()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

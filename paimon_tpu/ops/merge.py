@@ -156,11 +156,30 @@ PATH_COUNTS = {"host": 0, "device": 0}
 # cost-model constants (rows/s), calibrated from TPU_PROFILE.log and
 # the CPU-fallback bench: the device measured ~80M sorted rows/s with
 # data resident — 50e6 is a deliberate ~1.6x derate covering dispatch
-# and padding overhead; the host packed-key argsort path does ~1.5M
-# rows/s and the general lexsort ~0.7M
+# and padding overhead; the host packed-key path does ~1.5M rows/s via
+# numpy argsort but ~10M via the native C radix sort (measured 25M/s
+# isolated at 2M-row windows; derated for pipeline contention), and
+# the general lexsort ~0.7M
 _DEVICE_SORT_ROWS_PER_SEC = 50e6
-_HOST_FAST_ROWS_PER_SEC = 1.5e6
+_HOST_FAST_NUMPY_ROWS_PER_SEC = 1.5e6
+_HOST_FAST_NATIVE_ROWS_PER_SEC = 10e6
 _HOST_GENERAL_ROWS_PER_SEC = 0.7e6
+
+
+def _host_fast_rate() -> float:
+    # predict WITHOUT triggering the native build: forcing a gcc
+    # compile inside the routing decision would stall first merges on
+    # processes that always route to the device.  A compiler on PATH
+    # means the C sort will be built lazily if the host path is ever
+    # chosen, so its rate is the right prediction.
+    import os as _os
+    from paimon_tpu import native
+    if native._lib is not None or (not native._tried
+                                   and native._compiler() is not None
+                                   and _os.environ.get(
+                                       "PAIMON_DISABLE_NATIVE") != "1"):
+        return _HOST_FAST_NATIVE_ROWS_PER_SEC
+    return _HOST_FAST_NUMPY_ROWS_PER_SEC
 
 
 def _measure_link_bandwidth() -> Tuple[float, float]:
@@ -201,7 +220,7 @@ def _device_path_pays(n: int, num_lanes: int, winners_only: bool,
     bytes_in = m * (4 * num_lanes + 12)          # lanes + seq hi/lo + inv
     bytes_out = m * (4 if winners_only else 9)   # packed vs perm+win+prev
     t_dev = bytes_in / h2d + bytes_out / d2h + m / _DEVICE_SORT_ROWS_PER_SEC
-    host_rate = _HOST_FAST_ROWS_PER_SEC if host_fast \
+    host_rate = _host_fast_rate() if host_fast \
         else _HOST_GENERAL_ROWS_PER_SEC
     return t_dev < n / host_rate
 
@@ -549,13 +568,23 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
             with_prev, prev)
 
     if drop_deletes and KIND_COL in table.column_names:
-        kinds = np.asarray(table.column(KIND_COL).combine_chunks()
-                           .cast(pa.int8()))
-        keep_mask = (kinds[indices] == RowKind.INSERT) | \
-                    (kinds[indices] == RowKind.UPDATE_AFTER)
-        indices = indices[keep_mask]
-        if prev_idx is not None:
-            prev_idx = prev_idx[keep_mask]
+        # cheap min/max scan beats materializing the kinds array when
+        # the batch is uniformly +I or uniformly +U (the common
+        # compaction window has only +I): RowKind is +I=0 < -U=1 <
+        # +U=2 < -D=3, and only lo==hi in {0,2} proves no -U/-D hides
+        # in between
+        import pyarrow.compute as pc
+        mm = pc.min_max(table.column(KIND_COL))
+        lo, hi = mm["min"].as_py(), mm["max"].as_py()
+        if not (lo == hi and lo in (RowKind.INSERT,
+                                    RowKind.UPDATE_AFTER)):
+            kinds = np.asarray(table.column(KIND_COL).combine_chunks()
+                               .cast(pa.int8()))
+            keep_mask = (kinds[indices] == RowKind.INSERT) | \
+                        (kinds[indices] == RowKind.UPDATE_AFTER)
+            indices = indices[keep_mask]
+            if prev_idx is not None:
+                prev_idx = prev_idx[keep_mask]
 
     return MergeResult(table, indices, prev_idx)
 

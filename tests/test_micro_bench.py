@@ -1,0 +1,25 @@
+"""Smoke test: the micro-benchmark harness runs and emits valid JSON
+(reference paimon-micro-benchmarks is JUnit-driven; this suite is
+driven the same way so CI catches API drift)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_micro_bench_smoke():
+    env = dict(os.environ, MICRO_ROWS="20000", MICRO_RUNS="1",
+               JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.micro", "read_parquet",
+         "merge", "bitmap"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(line) for line in proc.stdout.splitlines()]
+    names = {d["benchmark"] for d in lines}
+    assert {"table_read_parquet", "merge_dedup_10runs",
+            "bitmap_index_build"} <= names
+    assert all(d["value"] > 0 for d in lines)
