@@ -172,7 +172,7 @@ def bench_merge():
             "v": pa.array(rng.random(per), pa.float64()),
         }))
     enc = NormalizedKeyEncoder([pa.int64()], nullable=[False])
-    _emit("merge_dedup_10runs", rows,
+    _emit("merge_dedup_10runs", per * 10,
           _best(lambda: merge_runs(runs, ["_KEY_id"],
                                    key_encoder=enc).take()))
 

@@ -111,6 +111,26 @@ def load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+_predicted: Optional[bool] = None
+
+
+def predicted_available() -> bool:
+    """Will the native sort (eventually) be available in this process?
+    Cheap memoized predicate for cost models that must not trigger the
+    build: loaded lib -> True; PAIMON_DISABLE_NATIVE/no compiler ->
+    False; otherwise a compiler on PATH means the lazy build will
+    succeed with overwhelming likelihood."""
+    global _predicted
+    if _lib is not None:
+        return True
+    if _tried:
+        return False                 # load attempted and failed
+    if _predicted is None:
+        _predicted = (os.environ.get("PAIMON_DISABLE_NATIVE") != "1"
+                      and _compiler() is not None)
+    return _predicted
+
+
 def radix_argsort(keys: np.ndarray) -> Optional[np.ndarray]:
     """Stable ascending argsort of uint64 keys via the C radix sort;
     None when the native library is unavailable (caller falls back)."""

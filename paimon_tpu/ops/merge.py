@@ -169,17 +169,11 @@ _HOST_GENERAL_ROWS_PER_SEC = 0.7e6
 def _host_fast_rate() -> float:
     # predict WITHOUT triggering the native build: forcing a gcc
     # compile inside the routing decision would stall first merges on
-    # processes that always route to the device.  A compiler on PATH
-    # means the C sort will be built lazily if the host path is ever
-    # chosen, so its rate is the right prediction.
-    import os as _os
+    # processes that always route to the device
     from paimon_tpu import native
-    if native._lib is not None or (not native._tried
-                                   and native._compiler() is not None
-                                   and _os.environ.get(
-                                       "PAIMON_DISABLE_NATIVE") != "1"):
-        return _HOST_FAST_NATIVE_ROWS_PER_SEC
-    return _HOST_FAST_NUMPY_ROWS_PER_SEC
+    return (_HOST_FAST_NATIVE_ROWS_PER_SEC
+            if native.predicted_available()
+            else _HOST_FAST_NUMPY_ROWS_PER_SEC)
 
 
 def _measure_link_bandwidth() -> Tuple[float, float]:

@@ -313,3 +313,24 @@ class TestParquetFormatOptions:
 
         assert not dict_encoded(t)
         assert dict_encoded(t2)       # default stays dictionary-on
+
+
+class TestCompressionCodecs:
+    @pytest.mark.parametrize("fmt,codec", [
+        ("parquet", "lz4"), ("parquet", "snappy"), ("parquet", "zstd"),
+        ("orc", "lz4"), ("orc", "snappy")])
+    def test_file_compression_codecs(self, tmp_path, fmt, codec):
+        """file.compression codecs beyond zstd round-trip per format
+        (reference compression/: lz4, zstd, aircompressor snappy)."""
+        t = _pk_table(tmp_path / f"{fmt}_{codec}", {
+            "file.format": fmt, "file.compression": codec})
+        _write(t, [{"id": i, "seq": 1, "v": float(i)} for i in range(50)])
+        out = t.to_arrow()
+        assert out.num_rows == 50
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            f = (t.new_read_builder().new_scan().plan()
+                 .splits[0].data_files[0])
+            md = pq.ParquetFile(
+                f"{t.path}/bucket-0/{f.file_name}").metadata
+            assert md.row_group(0).column(0).compression == codec.upper()
