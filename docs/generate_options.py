@@ -1,0 +1,93 @@
+"""Options reference generator — the analog of the reference's
+paimon-docs plane (auto-generated HTML tables under
+`docs/layouts/.../generated/core_configuration.html`, built by
+`paimon-docs/.../ConfigOptionsDocGenerator.java`).
+
+Usage:
+    python docs/generate_options.py          # rewrites docs/options.md
+    python docs/generate_options.py --check  # exit 1 if out of date
+"""
+
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paimon_tpu.options import ConfigOption, CoreOptions  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "options.md")
+
+
+def _type_name(opt: ConfigOption) -> str:
+    t = opt.typ
+    name = getattr(t, "__name__", str(t))
+    return {
+        "_parse_bool": "boolean",
+        "_parse_duration_ms": "duration (ms)",
+        "parse_memory_size": "memory size (bytes)",
+        "str": "string", "int": "int", "float": "float",
+    }.get(name, name)
+
+
+def _default_repr(opt: ConfigOption) -> str:
+    d = opt.default
+    if d is None:
+        return "(none)"
+    if isinstance(d, bool):
+        return "true" if d else "false"
+    return str(d)
+
+
+def collect():
+    """All ConfigOptions declared on CoreOptions, in declaration order."""
+    src = inspect.getsource(CoreOptions)
+    order = {}
+    for name, val in vars(CoreOptions).items():
+        if isinstance(val, ConfigOption):
+            order[name] = src.index(f"{name} ")
+    return [vars(CoreOptions)[n]
+            for n in sorted(order, key=order.get)]
+
+
+def render() -> str:
+    opts = collect()
+    lines = [
+        "# Configuration options",
+        "",
+        "Auto-generated from `paimon_tpu/options.py` by "
+        "`docs/generate_options.py` — do not edit by hand.",
+        "",
+        f"{len(opts)} options. Keys match the reference's "
+        "`CoreOptions.java` where the option exists there; keys under "
+        "`tpu.*` are this framework's own.",
+        "",
+        "| Key | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for o in opts:
+        desc = (o.description or "").replace("|", "\\|").replace("\n", " ")
+        lines.append(f"| `{o.key}` | {_type_name(o)} "
+                     f"| {_default_repr(o)} | {desc} |")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    text = render()
+    if "--check" in sys.argv:
+        current = open(OUT).read() if os.path.exists(OUT) else ""
+        if current != text:
+            sys.stderr.write("docs/options.md is out of date; run "
+                             "python docs/generate_options.py\n")
+            return 1
+        return 0
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT} ({text.count(chr(10))} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,6 +172,18 @@ class FileStoreScan:
         return [e for e in entries if e.kind == FileKind.ADD]
 
     def _read_manifests(self, metas) -> List[ManifestEntry]:
+        # scan.manifest.parallelism (reference
+        # AbstractFileStoreScan#parallelism): manifest decode overlaps
+        # file reads; order is preserved by mapping in meta order
+        par = self.options.get(CoreOptions.SCAN_MANIFEST_PARALLELISM) \
+            if self.options is not None else None
+        if par and par > 1 and len(metas) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=par) as pool:
+                per = list(pool.map(
+                    lambda m: self.manifest_file.read(m.file_name),
+                    metas))
+            return [e for chunk in per for e in chunk]
         entries: List[ManifestEntry] = []
         for m in metas:
             entries.extend(self.manifest_file.read(m.file_name))

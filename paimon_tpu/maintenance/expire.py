@@ -206,6 +206,28 @@ def expire_changelogs(table, retain_max: Optional[int] = None,
     return result
 
 
+def _clean_empty_dirs(table, bucket_dirs) -> None:
+    """snapshot.clean-empty-directories: drop bucket dirs emptied by
+    expiration, then any partition dirs emptied in turn (reference
+    SnapshotDeletion#cleanEmptyDirectories). Best-effort — a concurrent
+    writer recreating the dir just makes the rmdir a no-op."""
+    fio = table.file_io
+    parents = set()
+    for d in bucket_dirs:
+        if fio.exists(d) and not fio.list_status(d):
+            fio.delete_quietly(d)
+            parents.add(d.rsplit("/", 1)[0])
+    table_root = table.path.rstrip("/")
+    for d in parents:
+        # partition dirs can nest (k1=v1/k2=v2): walk up to the table root
+        while d != table_root and d.startswith(table_root):
+            if fio.exists(d) and not fio.list_status(d):
+                fio.delete_quietly(d)
+                d = d.rsplit("/", 1)[0]
+            else:
+                break
+
+
 def expire_snapshots(table, retain_max: Optional[int] = None,
                      retain_min: Optional[int] = None,
                      older_than_ms: Optional[int] = None,
@@ -312,17 +334,31 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
     if dry_run:
         return result
 
+    dead_paths = []
+    touched_dirs = set()
     for (pbytes, bucket, fname) in dead_data:
         partition = scan._partition_codec.from_bytes(pbytes)
         if fname.startswith("index-"):
-            path = scan.path_factory.index_file_path(fname)
+            dead_paths.append(scan.path_factory.index_file_path(fname))
         else:
-            path = scan.path_factory.data_file_path(partition, bucket,
-                                                    fname)
-        table.file_io.delete_quietly(path)
-    for fname in dead_manifests:
-        table.file_io.delete_quietly(f"{scan.path_factory.manifest_dir}/"
-                                     f"{fname}")
+            dead_paths.append(scan.path_factory.data_file_path(
+                partition, bucket, fname))
+            touched_dirs.add(scan.path_factory.bucket_dir(partition,
+                                                          bucket))
+    dead_paths.extend(f"{scan.path_factory.manifest_dir}/{fname}"
+                      for fname in dead_manifests)
+    threads = table.options.get(CoreOptions.DELETE_FILE_THREAD_NUM)
+    if threads and threads > 1 and len(dead_paths) > 1:
+        # delete-file.thread-num (reference SnapshotDeletion's
+        # deleteFiles executor): deletes are independent and IO-bound
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            list(pool.map(table.file_io.delete_quietly, dead_paths))
+    else:
+        for path in dead_paths:
+            table.file_io.delete_quietly(path)
+    if table.options.get(CoreOptions.SNAPSHOT_CLEAN_EMPTY_DIRECTORIES):
+        _clean_empty_dirs(table, touched_dirs)
     keep_stats = {s.statistics for s in survivors if s.statistics}
     for s in expiring:
         if s.statistics and s.statistics not in keep_stats:

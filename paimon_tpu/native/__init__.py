@@ -125,9 +125,10 @@ def predicted_available() -> bool:
         return True
     if _tried:
         return False                 # load attempted and failed
+    if os.environ.get("PAIMON_DISABLE_NATIVE") == "1":
+        return False                 # env read fresh — tests toggle it
     if _predicted is None:
-        _predicted = (os.environ.get("PAIMON_DISABLE_NATIVE") != "1"
-                      and _compiler() is not None)
+        _predicted = _compiler() is not None   # PATH probe only
     return _predicted
 
 

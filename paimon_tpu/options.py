@@ -409,6 +409,18 @@ class CoreOptions:
     # -- manifests (reference CoreOptions.java:560-600) ----------------------
     MANIFEST_TARGET_FILE_SIZE = ConfigOption(
         "manifest.target-file-size", parse_memory_size, 8 << 20, "")
+    SCAN_MANIFEST_PARALLELISM = ConfigOption(
+        "scan.manifest.parallelism", int, None,
+        "Threads for reading manifest files during scan planning "
+        "(None = serial)")
+    SNAPSHOT_CLEAN_EMPTY_DIRECTORIES = ConfigOption(
+        "snapshot.clean-empty-directories", _parse_bool, False,
+        "Remove emptied partition/bucket directories after snapshot "
+        "expiration")
+    DELETE_FILE_THREAD_NUM = ConfigOption(
+        "delete-file.thread-num", int, None,
+        "Threads for deleting dead files during snapshot expiration "
+        "(None = serial)")
 
     # -- source splits (reference CoreOptions.java:2230-2250) ----------------
     SOURCE_SPLIT_TARGET_SIZE = ConfigOption(

@@ -1,0 +1,16 @@
+"""Docs generator drift check (paimon-docs analog)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_options_doc_up_to_date():
+    """docs/options.md regenerates cleanly from paimon_tpu/options.py."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "docs",
+                                      "generate_options.py"), "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stderr

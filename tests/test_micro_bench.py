@@ -23,3 +23,4 @@ def test_micro_bench_smoke():
     assert {"table_read_parquet", "merge_dedup_10runs",
             "bitmap_index_build"} <= names
     assert all(d["value"] > 0 for d in lines)
+

@@ -334,3 +334,48 @@ class TestCompressionCodecs:
             md = pq.ParquetFile(
                 f"{t.path}/bucket-0/{f.file_name}").metadata
             assert md.row_group(0).column(0).compression == codec.upper()
+
+
+class TestMaintenanceOptions:
+    def test_clean_empty_directories(self, tmp_path):
+        """snapshot.clean-empty-directories removes emptied partition
+        dirs after expire (reference SnapshotDeletion)."""
+        from paimon_tpu.schema import Schema
+        schema = (Schema.builder()
+                  .column("dt", VarCharType(nullable=False))
+                  .column("v", IntType())
+                  .partition_keys("dt")
+                  .options({"bucket": "1", "bucket-key": "v",
+                            "snapshot.num-retained.min": "1",
+                            "snapshot.num-retained.max": "1",
+                            "snapshot.clean-empty-directories": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        _write(t, [{"dt": "a", "v": 1}])
+        # overwrite the partition away, then expire the old snapshot
+        wb = t.new_batch_write_builder().with_overwrite({"dt": "a"})
+        w = wb.new_write()
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        _write(t, [{"dt": "b", "v": 2}])
+        t.expire_snapshots()
+        import os
+        assert not os.path.exists(os.path.join(str(t.path), "dt=a"))
+        assert os.path.exists(os.path.join(str(t.path), "dt=b"))
+
+    def test_delete_file_threads_and_manifest_parallelism(self, tmp_path):
+        """delete-file.thread-num + scan.manifest.parallelism produce
+        the same results as the serial paths."""
+        t = _pk_table(tmp_path / "t", {
+            "delete-file.thread-num": "4",
+            "scan.manifest.parallelism": "4",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "1"})
+        for i in range(4):
+            _write(t, [{"id": j, "seq": i, "v": float(i)}
+                       for j in range(20)])
+        t.compact(full=True)
+        res = t.expire_snapshots()
+        assert res.deleted_data_files > 0
+        rows = {r["id"]: r["v"] for r in t.to_arrow().to_pylist()}
+        assert len(rows) == 20 and rows[0] == 3.0
