@@ -13,6 +13,7 @@ in one shot and written columnar.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field as dc_field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -113,13 +114,18 @@ class _BucketWriter:
         self.next_seq: Optional[int] = None   # lazily restored
         self.new_files: List[DataFileMeta] = []
         self.changelog_files: List[DataFileMeta] = []
+        self.spills: List[str] = []           # key-sorted local runs
+        self._spill_dir: Optional[str] = None
 
     def write(self, table: pa.Table, kinds: np.ndarray):
         self.buffers.append(table)
         self.kind_buffers.append(kinds)
         self.buffered_bytes += table.nbytes
         if self.buffered_bytes >= self.parent.options.write_buffer_size:
-            self.flush()
+            if self.parent.spillable:
+                self._spill()
+            else:
+                self.flush()
 
     def _restore_seq(self) -> int:
         if self.next_seq is None:
@@ -127,9 +133,12 @@ class _BucketWriter:
                 self.partition, self.bucket) + 1
         return self.next_seq
 
-    def flush(self):
+    def _sorted_chunk(self) -> Optional[pa.Table]:
+        """Drain the in-RAM buffer into one key-sorted KV chunk (the
+        changelog-producer=input file for the chunk is written here, in
+        arrival order)."""
         if not self.buffers:
-            return
+            return None
         raw = pa.concat_tables(self.buffers, promote_options="none")
         kinds = np.concatenate(self.kind_buffers)
         self.buffers, self.kind_buffers = [], []
@@ -157,18 +166,119 @@ class _BucketWriter:
                                key_encoder=self.parent.key_encoder)
             sorted_kv = kv.take(pa.array(order))
 
-        metas = self.parent.kv_writer.write(self.partition, self.bucket,
-                                            sorted_kv, level=0)
-        self.new_files.extend(metas)
-
         if self.parent.changelog_input:
             # changelog-producer=input: raw rows in arrival order
             cl = build_kv_table(raw, schema, seq, kinds)
             self.changelog_files.extend(
                 self.parent.write_changelog(self.partition, self.bucket, cl))
+        return sorted_kv
+
+    def flush(self):
+        sorted_kv = self._sorted_chunk()
+        if sorted_kv is None:
+            return
+        metas = self.parent.kv_writer.write(self.partition, self.bucket,
+                                            sorted_kv, level=0)
+        self.new_files.extend(metas)
+
+    # -- spillable buffer (reference SortBufferWriteBuffer:59 spill via
+    # MergeSorter/BinaryExternalSortBuffer: full buffers become local
+    # sorted runs, merged into L0 once at prepareCommit — fewer, larger
+    # L0 files than one flush file per buffer-full) ----------------------
+
+    def _spill(self):
+        sorted_kv = self._sorted_chunk()
+        if sorted_kv is None:
+            return
+        import tempfile
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="paimon-spill-")
+        path = os.path.join(self._spill_dir,
+                            f"spill-{len(self.spills)}.arrow")
+        opts = pa.ipc.IpcWriteOptions(compression="zstd")
+        with pa.OSFile(path, "wb") as f, \
+                pa.ipc.new_file(f, sorted_kv.schema, options=opts) as wr:
+            wr.write_table(sorted_kv, max_chunksize=1 << 20)
+        self.spills.append(path)
+
+    def _merge_spills(self):
+        """Streamed k-way merge of the spilled runs (+ the live buffer)
+        into rolling L0 files — the same bounded-memory machinery the
+        compaction rewrite uses (ops/merge_stream.py)."""
+        from paimon_tpu.ops.merge_stream import merge_runs_streamed
+
+        tail = self._sorted_chunk()
+        schema = self.parent.schema
+        key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
+        engine = self.parent.options.merge_engine
+        encoder = self.parent.key_encoder
+
+        def ipc_iter(path):
+            with pa.OSFile(path, "rb") as f:
+                rd = pa.ipc.open_file(f)
+                for i in range(rd.num_record_batches):
+                    yield pa.Table.from_batches([rd.get_batch(i)])
+
+        iters = [ipc_iter(p) for p in self.spills]
+        if tail is not None:
+            iters.append(iter([tail]))
+
+        def merge_window(tables: List[pa.Table]) -> pa.Table:
+            if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
+                return merge_runs(
+                    tables, key_cols, merge_engine=engine,
+                    drop_deletes=False, key_encoder=encoder,
+                    seq_fields=self.parent.options.sequence_field or None,
+                    seq_desc=self.parent.options
+                    .sequence_field_descending).take()
+            # deferred-merge engines keep every row: windows partition
+            # the keyspace, so a per-window stable (key, seq) sort
+            # yields a globally key-sorted run
+            kv = pa.concat_tables(tables, promote_options="none")
+            order = sort_table(kv, key_cols, key_encoder=encoder)
+            return kv.take(pa.array(order))
+
+        acc: List[pa.Table] = []
+        acc_bytes = 0
+        target = self.parent.kv_writer.target_file_size
+
+        def write_acc():
+            nonlocal acc, acc_bytes
+            if not acc:
+                return
+            merged = pa.concat_tables(acc, promote_options="none")
+            self.new_files.extend(self.parent.kv_writer.write(
+                self.partition, self.bucket, merged, level=0))
+            acc, acc_bytes = [], 0
+
+        def emit(window: pa.Table):
+            nonlocal acc_bytes
+            if window.num_rows == 0:
+                return
+            acc.append(window)
+            acc_bytes += window.nbytes
+            if acc_bytes >= target:
+                write_acc()
+
+        try:
+            merge_runs_streamed(iters, key_cols, encoder, emit,
+                                merge_window)
+            write_acc()
+        finally:
+            self._drop_spills()
+
+    def _drop_spills(self):
+        import shutil
+        self.spills = []
+        if self._spill_dir is not None:
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            self._spill_dir = None
 
     def prepare_commit(self) -> Optional[CommitMessage]:
-        self.flush()
+        if self.spills:
+            self._merge_spills()
+        else:
+            self.flush()
         msg = CommitMessage(self.partition, self.bucket,
                             self.parent.total_buckets,
                             new_files=list(self.new_files),
@@ -322,6 +432,7 @@ class KeyValueFileStoreWrite:
         self._restore_max_seq = restore_max_seq
         self.changelog_input = (
             options.changelog_producer == "input")
+        self.spillable = options.get(CoreOptions.WRITE_BUFFER_SPILLABLE)
         self._changelog_counter = 0
         self._local_merger: Optional[LocalMerger] = None
         lm_size = options.get(CoreOptions.LOCAL_MERGE_BUFFER_SIZE)
@@ -459,4 +570,6 @@ class KeyValueFileStoreWrite:
         msg.compact_changelog = result.changelog
 
     def close(self):
+        for w in self._writers.values():
+            w._drop_spills()         # aborted writes must not leak /tmp
         self._writers.clear()

@@ -203,6 +203,11 @@ class CoreOptions:
                                           "__DEFAULT_PARTITION__", "")
     TARGET_FILE_SIZE = ConfigOption("target-file-size", parse_memory_size,
                                     128 << 20, "Target data file size")
+    WRITE_BUFFER_SPILLABLE = ConfigOption(
+        "write-buffer-spillable", _parse_bool, False,
+        "Spill full write buffers to local sorted runs (zstd Arrow IPC) "
+        "and merge them into L0 at prepare-commit — fewer, larger L0 "
+        "files than flushing one file per buffer-full")
     WRITE_BUFFER_SIZE = ConfigOption("write-buffer-size", parse_memory_size,
                                      256 << 20, "Sort buffer memory")
     WRITE_ONLY = ConfigOption("write-only", _parse_bool, False,
