@@ -196,9 +196,15 @@ class _BucketWriter:
         path = os.path.join(self._spill_dir,
                             f"spill-{len(self.spills)}.arrow")
         opts = pa.ipc.IpcWriteOptions(compression="zstd")
+        # batches are BYTE-capped (~24MB): the k-way merge buffers at
+        # least one batch per run, so row-capped batches the size of a
+        # whole write buffer would recreate the memory cliff spilling
+        # exists to avoid
+        per_row = max(1, sorted_kv.nbytes // max(1, sorted_kv.num_rows))
+        chunk_rows = max(1024, (24 << 20) // per_row)
         with pa.OSFile(path, "wb") as f, \
                 pa.ipc.new_file(f, sorted_kv.schema, options=opts) as wr:
-            wr.write_table(sorted_kv, max_chunksize=1 << 20)
+            wr.write_table(sorted_kv, max_chunksize=chunk_rows)
         self.spills.append(path)
 
     def _merge_spills(self):
@@ -255,6 +261,10 @@ class _BucketWriter:
             nonlocal acc_bytes
             if window.num_rows == 0:
                 return
+            if acc and acc_bytes + window.nbytes > target:
+                # flush BEFORE overshooting so the rolling writer
+                # doesn't split every accumulation into full + sliver
+                write_acc()
             acc.append(window)
             acc_bytes += window.nbytes
             if acc_bytes >= target:

@@ -205,9 +205,10 @@ class CoreOptions:
                                     128 << 20, "Target data file size")
     WRITE_BUFFER_SPILLABLE = ConfigOption(
         "write-buffer-spillable", _parse_bool, False,
-        "Spill full write buffers to local sorted runs (zstd Arrow IPC) "
-        "and merge them into L0 at prepare-commit — fewer, larger L0 "
-        "files than flushing one file per buffer-full")
+        "Primary-key writers only: spill full write buffers to local "
+        "sorted runs (zstd Arrow IPC) and merge them into L0 at "
+        "prepare-commit — fewer, larger L0 files than flushing one "
+        "file per buffer-full")
     WRITE_BUFFER_SIZE = ConfigOption("write-buffer-size", parse_memory_size,
                                      256 << 20, "Sort buffer memory")
     WRITE_ONLY = ConfigOption("write-only", _parse_bool, False,
