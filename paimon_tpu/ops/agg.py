@@ -76,7 +76,8 @@ def sequence_groups(schema: TableSchema,
 
 def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
                            truncated: Optional[np.ndarray] = None,
-                           full_key=None, order_lanes=None):
+                           full_key=None, order_lanes=None,
+                           packed: Optional[np.ndarray] = None):
     """Shared device sort -> (order over real rows, segment ids).
 
     If some rows' string keys exceeded the lane prefix (`truncated`),
@@ -85,7 +86,7 @@ def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
     row index -> comparable tuple) and splitting sub-segments."""
     n = lanes.shape[0]
     perm, winner, _ = device_sorted_winners(lanes, seq, "last",
-                                            order_lanes)
+                                            order_lanes, packed=packed)
     real = perm < n
     order = perm[real].astype(np.int64)
     win_sorted = winner[real]
@@ -153,14 +154,26 @@ def _seg_prod_jit(vals, seg_ids, num_seg):
 
 
 def _padded_seg(fn_jit):
-    """num_segments must be jit-static; padding it to the next power of
-    two keeps XLA compiles O(log n) across merges instead of one per
-    distinct key count. Padding segments produce the op identity and are
-    sliced off."""
+    """BOTH the row count and num_segments pad to powers of two, so XLA
+    compiles O(log^2) distinct shapes across a whole compaction instead
+    of one per window (a streamed merge emits hundreds of distinct
+    (rows, segments) pairs; each used to recompile).  Padding rows
+    point at a dedicated dummy segment past num_seg, which the final
+    slice drops — their values never touch a real segment."""
     def call(vals, seg_ids, num_seg):
-        padded = 1 << max(4, int(num_seg - 1).bit_length()) \
-            if num_seg > 0 else 1
-        out = fn_jit(jnp.asarray(vals), jnp.asarray(seg_ids), padded)
+        vals = np.asarray(vals)
+        seg_ids = np.asarray(seg_ids)
+        n = len(vals)
+        # strictly greater than num_seg so the dummy segment exists
+        padded_seg = 1 << max(4, int(num_seg).bit_length())
+        m = 1 << max(10, int(n - 1).bit_length()) if n > 1 else 1024
+        if m > n:
+            vals = np.concatenate(
+                [vals, np.zeros(m - n, dtype=vals.dtype)])
+            seg_ids = np.concatenate(
+                [seg_ids, np.full(m - n, padded_seg - 1,
+                                  dtype=seg_ids.dtype)])
+        out = fn_jit(jnp.asarray(vals), jnp.asarray(seg_ids), padded_seg)
         return jnp.asarray(out)[:num_seg]
     return call
 
@@ -177,8 +190,7 @@ def _last_index_where(mask: np.ndarray, seg_id: np.ndarray,
     -1 if none. Vectorized with segment_max over masked positions."""
     pos = np.arange(len(mask), dtype=np.int64)
     masked = np.where(mask, pos, -1)
-    out = np.asarray(_seg_max(jnp.asarray(masked), jnp.asarray(seg_id),
-                              num_seg))
+    out = np.asarray(_seg_max(masked, seg_id, num_seg))
     return out
 
 
@@ -187,9 +199,18 @@ def _first_index_where(mask: np.ndarray, seg_id: np.ndarray,
     n = len(mask)
     pos = np.arange(n, dtype=np.int64)
     masked = np.where(mask, pos, n + 1)
-    out = np.asarray(_seg_min(jnp.asarray(masked), jnp.asarray(seg_id),
-                              num_seg))
+    out = np.asarray(_seg_min(masked, seg_id, num_seg))
     return np.where(out > n, -1, out)
+
+
+def _masked_numeric(result: np.ndarray, any_valid: np.ndarray,
+                    out_type: pa.DataType) -> pa.Array:
+    """Vectorized (values, null-mask) -> typed Arrow array; a per-row
+    `.item()` comprehension here was the agg plane's hottest line."""
+    arr = pa.array(result, mask=~any_valid)
+    if arr.type != out_type:
+        arr = arr.cast(out_type)
+    return arr
 
 
 _JAX_NUMERIC = {
@@ -215,7 +236,8 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         key_encoder = NormalizedKeyEncoder(
             [table.schema.field(k).type for k in key_cols],
             nullable=[table.schema.field(k).nullable for k in key_cols])
-    lanes, truncated = key_encoder.encode_table(table, key_cols)
+    lanes, truncated, packed = key_encoder.encode_table_ex(table,
+                                                           key_cols)
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
     full_key = None
     if truncated.any():
@@ -229,7 +251,7 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         table, seq_fields, options.sequence_field_descending) \
         if seq_fields else None
     order, seg_id, win_sorted = _segment_ids_from_sort(
-        lanes, seq, truncated, full_key, order_lanes)
+        lanes, seq, truncated, full_key, order_lanes, packed=packed)
     num_seg = int(seg_id[-1]) + 1 if len(seg_id) else 0
     win_pos = np.flatnonzero(win_sorted)           # last row of each segment
 
@@ -293,8 +315,8 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
                               .fill_null(0)).astype(np_dtype)
             contrib_mask = valid & add_mask
             if func == "count":
-                dev = _seg_sum(jnp.asarray(contrib_mask.astype(np.int64)),
-                               jnp.asarray(seg_id), num_seg)
+                dev = _seg_sum(contrib_mask.astype(np.int64), seg_id,
+                               num_seg)
                 result = np.asarray(dev)
                 out_cols[name] = pa.array(result, pa.int64())
                 continue
@@ -311,15 +333,12 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
                     signed = np.where(retract, -vals, vals)
                     contributed = valid
                 signed = np.where(valid, signed, 0)
-                dev = _seg_sum(jnp.asarray(signed), jnp.asarray(seg_id),
-                               num_seg)
+                dev = _seg_sum(signed, seg_id, num_seg)
                 result = np.asarray(dev)
                 any_valid = np.asarray(_seg_max(
-                    jnp.asarray(contributed.astype(np.int32)),
-                    jnp.asarray(seg_id), num_seg)) > 0
-                out_cols[name] = pa.array(
-                    [result[i].item() if any_valid[i] else None
-                     for i in range(num_seg)], col_sorted.type)
+                    contributed.astype(np.int32), seg_id, num_seg)) > 0
+                out_cols[name] = _masked_numeric(result, any_valid,
+                                                 col_sorted.type)
                 continue
             if func in ("max", "min", "product"):
                 ident = {"max": _np_min_ident(np_dtype),
@@ -327,15 +346,14 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
                          "product": np_dtype(1)}[func]
                 masked = np.where(valid & add_mask, vals, ident)
                 dev = {"max": _seg_max, "min": _seg_min,
-                       "product": _seg_prod}[func](
-                    jnp.asarray(masked), jnp.asarray(seg_id), num_seg)
+                       "product": _seg_prod}[func](masked, seg_id,
+                                                   num_seg)
                 result = np.asarray(dev)
                 any_valid = np.asarray(_seg_max(
-                    jnp.asarray((valid & add_mask).astype(np.int32)),
-                    jnp.asarray(seg_id), num_seg)) > 0
-                out_cols[name] = pa.array(
-                    [result[i].item() if any_valid[i] else None
-                     for i in range(num_seg)], col_sorted.type)
+                    (valid & add_mask).astype(np.int32), seg_id,
+                    num_seg)) > 0
+                out_cols[name] = _masked_numeric(result, any_valid,
+                                                 col_sorted.type)
                 continue
         # order-based aggregates: pick an index per segment, host gather
         if func == "last_non_null_value":
@@ -387,8 +405,7 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
             if func == "bool_or":
                 masked = vals & (valid & add_mask)
             dev = (_seg_max if func == "bool_or" else _seg_min)(
-                jnp.asarray(masked.astype(np.int32)), jnp.asarray(seg_id),
-                num_seg)
+                masked.astype(np.int32), seg_id, num_seg)
             out_cols[name] = pa.array(np.asarray(dev).astype(bool),
                                       pa.bool_())
             continue
@@ -451,8 +468,7 @@ def _seq_group_winner_index(sorted_tbl: pa.Table, seq_fields: List[str],
     _, rank = np.unique(stacked, axis=0, return_inverse=True)
     mask = valid & add_mask
     masked = np.where(mask, rank.astype(np.int64), -1)
-    mx = np.asarray(_seg_max(jnp.asarray(masked), jnp.asarray(seg_id),
-                             num_seg))
+    mx = np.asarray(_seg_max(masked, seg_id, num_seg))
     is_max = mask & (masked == mx[seg_id]) & (mx[seg_id] >= 0)
     return _last_index_where(is_max, seg_id, num_seg)
 
