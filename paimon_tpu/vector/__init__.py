@@ -10,3 +10,6 @@ exactly the systolic array's shape.
 from paimon_tpu.vector.ann import (  # noqa: F401
     BruteForceIndex, IVFFlatIndex, vector_search,
 )
+from paimon_tpu.vector.hybrid import (  # noqa: F401
+    hybrid_search, rank_hybrid,
+)

@@ -1,0 +1,142 @@
+"""Hybrid search: rrf / weighted_score / mrr fusion semantics
+(reference globalindex/HybridSearchRanker.java + HybridSearchRankerTest,
+table/source/HybridSearchBuilder.java)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import ArrayType, BigIntType, FloatType, VarCharType
+from paimon_tpu.vector.hybrid import RRF_K, hybrid_search, rank_hybrid
+
+
+class TestRankHybrid:
+    def test_rrf_contributions(self):
+        # route A ranks [10, 20]; route B ranks [20, 30]
+        a = (np.array([10, 20]), np.array([0.9, 0.5], np.float32), 1.0)
+        b = (np.array([20, 30]), np.array([0.8, 0.2], np.float32), 1.0)
+        ids, scores = rank_hybrid([a, b], ranker="rrf", limit=10)
+        expect = {
+            10: 1 / (RRF_K + 1),
+            20: 1 / (RRF_K + 2) + 1 / (RRF_K + 1),
+            30: 1 / (RRF_K + 2),
+        }
+        assert list(ids) == [20, 10, 30]
+        for rid, sc in zip(ids, scores):
+            assert sc == pytest.approx(expect[int(rid)], rel=1e-6)
+
+    def test_mrr(self):
+        a = (np.array([1, 2]), np.array([0.9, 0.5], np.float32), 2.0)
+        ids, scores = rank_hybrid([a], ranker="mrr", limit=10)
+        assert list(ids) == [1, 2]
+        assert scores[0] == pytest.approx(2.0 / 1.0)
+        assert scores[1] == pytest.approx(2.0 / 2.0)
+
+    def test_weighted_score_minmax_and_flat_route(self):
+        # spread route normalizes to [0,1]; flat route maps to 1.0
+        a = (np.array([1, 2, 3]),
+             np.array([10.0, 5.0, 0.0], np.float32), 1.0)
+        b = (np.array([3]), np.array([42.0], np.float32), 0.5)
+        ids, scores = rank_hybrid([a, b], ranker="weighted_score",
+                                  limit=10)
+        got = dict(zip(ids.tolist(), scores.tolist()))
+        assert got[1] == pytest.approx(1.0)
+        assert got[2] == pytest.approx(0.5)
+        assert got[3] == pytest.approx(0.0 + 0.5)
+
+    def test_rank_ties_broken_by_row_id(self):
+        # equal scores: smaller row id ranks first (reference
+        # rankedRowIds comparator)
+        a = (np.array([7, 3]), np.array([0.5, 0.5], np.float32), 1.0)
+        ids, scores = rank_hybrid([a], ranker="rrf", limit=2)
+        assert list(ids) == [3, 7]
+
+    def test_unknown_ranker_and_default(self):
+        a = (np.array([1]), np.array([1.0], np.float32), 1.0)
+        with pytest.raises(ValueError, match="Unsupported"):
+            rank_hybrid([a], ranker="bogus")
+        ids, _ = rank_hybrid([a], ranker="  ")    # blank -> rrf
+        assert list(ids) == [1]
+
+    def test_limit_and_empty(self):
+        a = (np.array([1, 2, 3]),
+             np.array([3.0, 2.0, 1.0], np.float32), 1.0)
+        ids, _ = rank_hybrid([a], limit=2)
+        assert list(ids) == [1, 2]
+        ids, scores = rank_hybrid([], limit=5)
+        assert len(ids) == 0 and len(scores) == 0
+
+
+def test_hybrid_search_end_to_end(tmp_path):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("text", VarCharType())
+              .column("emb", ArrayType(FloatType()))
+              .primary_key("id")
+              .options({"bucket": "1"}).build())
+    t = FileStoreTable.create(os.path.join(str(tmp_path), "t"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([
+        {"id": 0, "text": "tpu systolic matmul",
+         "emb": [1.0, 0.0, 0.0]},
+        {"id": 1, "text": "lakehouse table format",
+         "emb": [0.0, 1.0, 0.0]},
+        {"id": 2, "text": "tpu lakehouse engine",
+         "emb": [0.7, 0.7, 0.0]},
+        {"id": 3, "text": "unrelated document",
+         "emb": [0.0, 0.0, 1.0]},
+    ])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+    out = hybrid_search(
+        t,
+        routes=[
+            {"type": "vector", "column": "emb",
+             "query": [0.7, 0.7, 0.0], "limit": 3, "weight": 1.0},
+            {"type": "text", "column": "text", "query": "lakehouse tpu",
+             "limit": 3, "weight": 1.0},
+        ],
+        k=3, ranker="rrf")
+    ids = out.column("id").to_pylist()
+    # row 2 matches BOTH routes strongly -> fused winner
+    assert ids[0] == 2
+    assert len(ids) == 3 and 3 not in ids[:2]
+    scores = out.column("_score").to_pylist()
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_hybrid_search_prebuilt_indexes(tmp_path):
+    """Routes accept prebuilt indexes so repeated queries amortize."""
+    from paimon_tpu.index.fulltext import FullTextIndex
+    from paimon_tpu.vector.ann import BruteForceIndex
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("text", VarCharType())
+              .column("emb", ArrayType(FloatType()))
+              .primary_key("id")
+              .options({"bucket": "1"}).build())
+    t = FileStoreTable.create(os.path.join(str(tmp_path), "t"), schema)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": i, "text": f"doc {i}",
+                    "emb": [float(i), 1.0]} for i in range(4)])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    data = t.to_arrow()
+    from paimon_tpu.vector.ann import _as_matrix
+    vidx = BruteForceIndex(_as_matrix(data.column("emb")), "cosine")
+    tidx = FullTextIndex(data.column("text").to_pylist())
+    out = hybrid_search(t, routes=[
+        {"type": "vector", "column": "emb", "query": [3.0, 1.0],
+         "index": vidx},
+        {"type": "text", "column": "text", "query": "doc 3",
+         "index": tidx}], k=2)
+    assert out.column("id").to_pylist()[0] == 3
+    with pytest.raises(ValueError, match="Unsupported"):
+        hybrid_search(t, routes=[], ranker="bogus")
