@@ -1379,6 +1379,34 @@ class SQLContext:
         if proc == "rewrite_file_index" or proc == "analyze":
             n = table.analyze()
             return _result([f"{n or 0} rows analyzed"])
+        if proc == "full_text_search":
+            # CALL sys.full_text_search('db.t', 'col', 'query'[, k])
+            # (reference flink/procedure/FullTextSearchProcedure.java)
+            from paimon_tpu.index.fulltext import full_text_search
+            return full_text_search(table, str(rest[0]), str(rest[1]),
+                                    k=int(rest[2]) if len(rest) > 2
+                                    else 10)
+        if proc == "vector_search":
+            # CALL sys.vector_search('db.t', 'col', '0.1,0.2,...'[, k])
+            # (reference flink/procedure/VectorSearchProcedure.java)
+            from paimon_tpu.vector import vector_search
+            vec = [float(x) for x in str(rest[1]).split(",")]
+            return vector_search(table, str(rest[0]), vec,
+                                 k=int(rest[2]) if len(rest) > 2 else 10)
+        if proc == "hybrid_search":
+            # CALL sys.hybrid_search('db.t', 'vcol', '0.1,...', 'tcol',
+            #                        'terms'[, k[, ranker]])
+            from paimon_tpu.vector import hybrid_search
+            vec = [float(x) for x in str(rest[1]).split(",")]
+            kk = int(rest[4]) if len(rest) > 4 else 10
+            return hybrid_search(
+                table,
+                routes=[{"type": "vector", "column": str(rest[0]),
+                         "query": vec, "limit": kk},
+                        {"type": "text", "column": str(rest[2]),
+                         "query": str(rest[3]), "limit": kk}],
+                k=kk,
+                ranker=str(rest[5]) if len(rest) > 5 else "rrf")
         if proc == "mark_partition_done":
             # reference flink/procedure/MarkPartitionDoneProcedure.java:
             # CALL sys.mark_partition_done('db.t', 'dt=2026-07-29', ...)

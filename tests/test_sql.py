@@ -834,3 +834,24 @@ class TestCatalogFunctions:
         from paimon_tpu.sql.parser import SQLError
         with pytest.raises(SQLError, match="shadow"):
             ctx.sql("CREATE FUNCTION upper (x STRING) AS 'x'")
+
+
+class TestSearchProcedures:
+    def test_call_search_procedures(self, ctx):
+        ctx.sql("CREATE TABLE docs (id BIGINT NOT NULL, title STRING, "
+                "emb ARRAY<FLOAT>, PRIMARY KEY (id)) "
+                "WITH ('bucket' = '1')")
+        ctx.sql("INSERT INTO docs VALUES "
+                "(1, 'tpu lakehouse guide', ARRAY[1.0, 0.0]), "
+                "(2, 'cooking pasta', ARRAY[0.0, 1.0]), "
+                "(3, 'tpu kernels', ARRAY[0.9, 0.1])")
+        r = ctx.sql("CALL sys.full_text_search('docs', 'title', "
+                    "'tpu', 2)")
+        assert set(r.column("id").to_pylist()) == {1, 3}
+        assert "_score" in r.column_names
+        r = ctx.sql("CALL sys.vector_search('docs', 'emb', "
+                    "'1.0,0.05', 1)")
+        assert r.column("id").to_pylist() == [1]
+        r = ctx.sql("CALL sys.hybrid_search('docs', 'emb', '0.9,0.1', "
+                    "'title', 'tpu kernels', 2)")
+        assert r.column("id").to_pylist()[0] == 3
