@@ -33,6 +33,7 @@ _BUILTIN_FUNCS = _AGG_FUNCS | {
     "substr", "substring", "replace", "year", "month", "day", "hour",
     "minute", "second", "if", "variant_get", "row_number", "rank",
     "dense_rank", "lag", "lead", "first_value", "last_value",
+    "array", "map",
 }
 
 
@@ -255,6 +256,23 @@ class Compiler:
                     (x if isinstance(x, str)
                      else _json.dumps(x, default=_json_default))
                     for x in vals])
+        if name == "array":
+            # ARRAY[e1, e2, ...] constructor — per-row list assembly
+            cols = [self.broadcast(x).to_pylist() for x in a]
+            return pa.array([list(vs) for vs in zip(*cols)]) if cols \
+                else pa.array([[]] * self._rows())
+        if name == "map":
+            # MAP[k1, v1, k2, v2, ...] constructor
+            if len(a) % 2:
+                raise SQLError("MAP[...] needs an even number of items")
+            cols = [self.broadcast(x).to_pylist() for x in a]
+            rows = []
+            for vs in zip(*cols):
+                rows.append(list(zip(vs[0::2], vs[1::2])))
+            return pa.array(rows, pa.map_(pa.array(cols[0]).type if cols
+                                          else pa.string(),
+                                          pa.array(cols[1]).type if cols
+                                          else pa.string()))
         raise SQLError(f"unknown function {name}()")
 
 
@@ -1088,9 +1106,25 @@ class SQLContext:
                     raise SQLError("VALUES rows have inconsistent arity")
                 for i, cell in enumerate(row):
                     v = comp.compile(cell)
-                    arrays[i].append(v.as_py() if isinstance(v, pa.Scalar)
-                                     else v)
-            data = pa.table({c: pa.array(vals)
+                    if isinstance(v, pa.Scalar):
+                        v = v.as_py()
+                    elif isinstance(v, (pa.Array, pa.ChunkedArray)):
+                        # 1-row dual scope: unwrap the single cell
+                        v = v[0].as_py()
+                    arrays[i].append(v)
+            # build with the target field type when known — inference
+            # cannot reconstruct map<> / nested types from python cells
+            ftypes = {f.name: f.type for f in schema}
+
+            def _build(c, vals):
+                if c in ftypes:
+                    try:
+                        return pa.array(vals, ftypes[c])
+                    except (pa.ArrowInvalid, pa.ArrowTypeError):
+                        pass        # fall back to inference + later cast
+                return pa.array(vals)
+
+            data = pa.table({c: _build(c, vals)
                              for c, vals in zip(cols, arrays)})
         batch: Dict[str, pa.ChunkedArray] = {}
         for field in schema:

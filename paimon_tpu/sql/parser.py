@@ -134,7 +134,7 @@ def tokenize(text: str) -> List[Token]:
                 i += 2
                 break
         else:
-            if c in "+-*/%(),.=<>;":
+            if c in "+-*/%(),.=<>;[]":
                 toks.append(Token("OP", c, i))
                 i += 1
             else:
@@ -803,6 +803,18 @@ class Parser:
         if t.kind == "IDENT" or (t.kind == "KEYWORD" and t.value in (
                 "COMMENT", "KEY", "VERSION", "FIRST", "LAST")):
             name = self.ident()
+            if name.upper() in ("ARRAY", "MAP") and \
+                    self.peek().kind == "OP" and self.peek().value == "[":
+                # ARRAY[e1, ...] / MAP[k1, v1, ...] constructors
+                self.next()
+                args = []
+                if not (self.peek().kind == "OP" and
+                        self.peek().value == "]"):
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                self.expect_op("]")
+                return Func(name.lower(), args)
             if self.accept_op("("):
                 return self.func_call(name)
             if self.peek().kind == "OP" and self.peek().value == "." and \
@@ -877,6 +889,37 @@ class Parser:
         if t.kind not in ("IDENT", "KEYWORD"):
             raise SQLError(f"expected type name, got {t.value!r}")
         parts.append(str(t.value).upper())
+        name = parts[0]
+        # parameterized complex types: ARRAY<T>, MAP<K, V>, MULTISET<T>,
+        # ROW<name T, ...>, VECTOR<T, n> (reference DataTypeJsonParser grammar)
+        if name in ("ARRAY", "MULTISET", "MAP", "ROW", "VECTOR") and \
+                self.peek().kind == "OP" and self.peek().value in ("<", "("):
+            open_op = self.next().value
+            close_op = ">" if open_op == "<" else ")"
+            inner = []
+            if name == "ROW":
+                while True:
+                    fname = self.ident()
+                    ftype = self.type_string()
+                    inner.append(f"{fname} {ftype}")
+                    if not self.accept_op(","):
+                        break
+            elif name == "MAP":
+                inner.append(self.type_string())
+                self.expect_op(",")
+                inner.append(self.type_string())
+            elif name == "VECTOR":
+                inner.append(self.type_string())
+                self.expect_op(",")
+                inner.append(str(int(self._number())))
+            else:
+                inner.append(self.type_string())
+            self.expect_op(close_op)
+            out = f"{name}<{', '.join(inner)}>"
+            if self.accept_kw("NOT"):
+                self.expect_kw("NULL")
+                out += " NOT NULL"
+            return out
         # multi-word types: DOUBLE PRECISION, TIMESTAMP WITH LOCAL TIME ZONE
         while self.peek().kind == "IDENT" and \
                 self.peek().value.upper() in ("PRECISION", "WITH", "LOCAL",

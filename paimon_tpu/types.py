@@ -523,6 +523,8 @@ _SIMPLE_TYPES = {
 
 def parse_data_type(j) -> DataType:
     """Parse JSON (string or dict) into a DataType."""
+    if isinstance(j, str) and ("<" in j or j.lstrip().upper().startswith("ROW(")):
+        return parse_type_string(j)
     if isinstance(j, dict):
         type_str = j["type"]
         nullable = not type_str.endswith(" NOT NULL")
@@ -543,6 +545,127 @@ def parse_data_type(j) -> DataType:
                               nullable)
         raise ValueError(f"Unknown complex type: {type_str}")
     return _parse_atomic(j)
+
+
+def parse_type_string(s: str) -> DataType:
+    """Parse the SQL string form of a (possibly nested) data type.
+
+    Accepts `ARRAY<T>`, `MULTISET<T>`, `MAP<K, V>`, `ROW<name T, ...>`
+    (also `ROW(name T, ...)`), `VECTOR<T, n>`, and every atomic form
+    `_parse_atomic` accepts, with `NOT NULL` at any nesting level.
+    Mirrors reference types/DataTypeJsonParser.java's string grammar.
+    """
+    t, pos = _parse_type_str(s, 0)
+    if s[pos:].strip():
+        raise ValueError(f"Trailing input in data type: {s!r}")
+    return t
+
+
+def _skip_ws(s: str, i: int) -> int:
+    while i < len(s) and s[i].isspace():
+        i += 1
+    return i
+
+
+_TYPE_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_ ]*")
+
+
+def _parse_not_null(s: str, i: int):
+    j = _skip_ws(s, i)
+    if s[j:j + 8].upper() == "NOT NULL":
+        return False, j + 8
+    return True, i
+
+
+def _parse_type_str(s: str, i: int):
+    i = _skip_ws(s, i)
+    m = _TYPE_WORD_RE.match(s, i)
+    if not m:
+        raise ValueError(f"Cannot parse data type: {s!r} at {i}")
+    # the word regex is greedy over spaces (multi-word atomics like
+    # "DOUBLE PRECISION"); trim trailing keywords that belong to the parent
+    word = m.group(0)
+    head = word.split()[0].upper()
+    if head in ("ARRAY", "MULTISET", "MAP", "ROW", "VECTOR"):
+        i += len(head)
+        i = _skip_ws(s, i)
+        if head == "ROW" and i < len(s) and s[i] in "(<":
+            close = ")" if s[i] == "(" else ">"
+            i += 1
+            fields = []
+            while True:
+                i = _skip_ws(s, i)
+                fm = re.match(r"[A-Za-z_][A-Za-z0-9_]*|`[^`]+`", s[i:])
+                if not fm:
+                    raise ValueError(f"Expected field name at {i} in {s!r}")
+                fname = fm.group(0).strip("`")
+                i += fm.end()
+                ftype, i = _parse_type_str(s, i)
+                fields.append(DataField(len(fields), fname, ftype))
+                i = _skip_ws(s, i)
+                if i < len(s) and s[i] == ",":
+                    i += 1
+                    continue
+                break
+            if i >= len(s) or s[i] != close:
+                raise ValueError(f"Expected {close!r} at {i} in {s!r}")
+            i += 1
+            nullable, i = _parse_not_null(s, i)
+            return RowType(fields, nullable), i
+        if i >= len(s) or s[i] != "<":
+            raise ValueError(f"Expected '<' after {head} in {s!r}")
+        i += 1
+        if head == "MAP":
+            k, i = _parse_type_str(s, i)
+            i = _skip_ws(s, i)
+            if i >= len(s) or s[i] != ",":
+                raise ValueError(f"Expected ',' in MAP type: {s!r}")
+            v, i = _parse_type_str(s, i + 1)
+            out_cls = lambda nullable: MapType(k, v, nullable)  # noqa: E731
+        elif head == "VECTOR":
+            el, i = _parse_type_str(s, i)
+            i = _skip_ws(s, i)
+            if i >= len(s) or s[i] != ",":
+                raise ValueError(f"Expected ',' in VECTOR type: {s!r}")
+            i = _skip_ws(s, i + 1)
+            nm = re.match(r"\d+", s[i:])
+            if not nm:
+                raise ValueError(f"Expected length in VECTOR type: {s!r}")
+            length = int(nm.group(0))
+            i += nm.end()
+            out_cls = lambda nullable: VectorType(el, length, nullable)  # noqa: E731,E501
+        else:
+            el, i = _parse_type_str(s, i)
+            cls = ArrayType if head == "ARRAY" else MultisetType
+            out_cls = lambda nullable: cls(el, nullable)  # noqa: E731
+        i = _skip_ws(s, i)
+        if i >= len(s) or s[i] != ">":
+            raise ValueError(f"Expected '>' at {i} in {s!r}")
+        i += 1
+        nullable, i = _parse_not_null(s, i)
+        return out_cls(nullable), i
+    # atomic: consume word + optional (p[,s]) + optional WITH LOCAL TIME
+    # ZONE + optional NOT NULL, then delegate to the atomic matcher
+    j = i + len(word)
+    if j < len(s) and s[j] == "(":
+        k = s.find(")", j)
+        if k < 0:
+            raise ValueError(f"Unterminated '(' in data type: {s!r}")
+        j = k + 1
+        k = _skip_ws(s, j)
+        if s[k:k + 20].upper() == "WITH LOCAL TIME ZONE":
+            j = k + 20
+    atom = s[i:j]
+    # word regex may have greedily eaten into ", name TYPE" of a parent ROW
+    # — it can't, since ROW fields are split on ','. But it CAN eat a
+    # trailing "NOT NULL" or "WITH LOCAL TIME ZONE"; _ATOMIC_RE handles
+    # both, so pass them through.
+    nullable = True
+    rest = _skip_ws(s, j)
+    if s[rest:rest + 8].upper() == "NOT NULL":
+        atom = atom.rstrip() + " NOT NULL"
+        j = rest + 8
+    return _parse_atomic(atom.strip()), j
 
 
 def _parse_atomic(s: str) -> DataType:

@@ -855,3 +855,58 @@ class TestSearchProcedures:
         r = ctx.sql("CALL sys.hybrid_search('docs', 'emb', '0.9,0.1', "
                     "'title', 'tpu kernels', 2)")
         assert r.column("id").to_pylist()[0] == 3
+
+
+class TestDdlTypeMatrix:
+    """Parameterized / nested types in DDL — reference
+    paimon-api types/DataTypes.java surface."""
+
+    def test_create_with_nested_types(self, ctx):
+        ctx.sql(
+            "CREATE TABLE typed ("
+            " id BIGINT NOT NULL,"
+            " tags ARRAY<STRING>,"
+            " nested ARRAY<ARRAY<INT>>,"
+            " attrs MAP<STRING, INT>,"
+            " price DECIMAL(10, 2),"
+            " pt ROW<x DOUBLE, y DOUBLE>,"
+            " ms MULTISET<STRING>,"
+            " ts3 TIMESTAMP(3),"
+            " PRIMARY KEY (id)) WITH ('bucket' = '1')")
+        out = ctx.sql("DESCRIBE typed")
+        types = dict(zip(out.column("name").to_pylist(),
+                         out.column("type").to_pylist()))
+        assert types["tags"].startswith("ARRAY<")
+        assert types["attrs"].startswith("MAP<")
+        assert "DECIMAL(10, 2)" in types["price"]
+        assert types["pt"].startswith("ROW<")
+
+    def test_array_literal_roundtrip(self, ctx):
+        ctx.sql("CREATE TABLE arr_t (id BIGINT NOT NULL, v ARRAY<DOUBLE>, "
+                "PRIMARY KEY (id)) WITH ('bucket' = '1')")
+        ctx.sql("INSERT INTO arr_t VALUES (1, ARRAY[1.5, 2.5]), "
+                "(2, ARRAY[]), (3, NULL)")
+        rows = {r["id"]: r["v"]
+                for r in ctx.sql("SELECT id, v FROM arr_t").to_pylist()}
+        assert rows[1] == [1.5, 2.5]
+        assert rows[2] == []
+        assert rows[3] is None
+
+    def test_map_literal_roundtrip(self, ctx):
+        ctx.sql("CREATE TABLE map_t (id BIGINT NOT NULL, "
+                "m MAP<STRING, BIGINT>, PRIMARY KEY (id)) "
+                "WITH ('bucket' = '1')")
+        ctx.sql("INSERT INTO map_t VALUES (1, MAP['a', 1, 'b', 2])")
+        got = ctx.sql("SELECT m FROM map_t").to_pylist()[0]["m"]
+        assert dict(got) == {"a": 1, "b": 2}
+
+    def test_cast_to_parameterized_type(self, ctx):
+        out = ctx.sql("SELECT CAST(1.5 AS DECIMAL(8, 3)) AS d")
+        import decimal
+        assert out.to_pylist()[0]["d"] == decimal.Decimal("1.500")
+
+    def test_bad_generic_rejected(self, ctx):
+        with pytest.raises(SQLError):
+            ctx.sql("CREATE TABLE b1 (id INT, v ARRAY<)")
+        with pytest.raises((SQLError, ValueError)):
+            ctx.sql("CREATE TABLE b2 (id INT, v MAP<INT>)")
