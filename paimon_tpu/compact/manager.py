@@ -296,16 +296,12 @@ class MergeTreeCompactManager:
                     yield (t, *self.key_encoder.encode_table_ex(
                         t, self.key_cols))
 
-        def merge_window(items) -> pa.Table:
-            tables = [item[0] for item in items]
-            encoded = [item[1:] for item in items]
-            return self._merge_tables(tables, drop_delete,
-                                      encoded=encoded)
-
-        # rolling flushes go to a small thread pool (parquet encode
-        # releases the GIL) so file writes overlap the next window's
-        # merge; futures are collected in submission order, so the
-        # returned metas stay in key order regardless of completion
+        # three-stage pipeline: prefetch threads decode+lane-encode,
+        # ONE merge worker sorts/dedups windows (so device upload/sort/
+        # download — or the host radix — overlaps the next window's
+        # decode and cut), and a write pool encodes output files.
+        # Futures are consumed in submission order at every stage, so
+        # output files stay in key order regardless of completion.
         from concurrent.futures import ThreadPoolExecutor
         futures = []
         acc: List[pa.Table] = []
@@ -316,7 +312,15 @@ class MergeTreeCompactManager:
                 self.partition, self.bucket, merged, level=output_level,
                 file_source=FileSource.COMPACT)
 
-        with ThreadPoolExecutor(max_workers=2) as pool:
+        with ThreadPoolExecutor(max_workers=2) as pool, \
+                ThreadPoolExecutor(max_workers=1) as merge_pool:
+
+            def merge_window(items):
+                tables = [item[0] for item in items]
+                encoded = [item[1:] for item in items]
+                return merge_pool.submit(
+                    self._merge_tables, tables, drop_delete,
+                    encoded=encoded, overlapped=True)
 
             def flush():
                 nonlocal acc, acc_bytes
@@ -336,8 +340,11 @@ class MergeTreeCompactManager:
                 futures.append(pool.submit(_write_one, merged))
                 acc, acc_bytes = [], 0
 
-            def emit(window: pa.Table):
+            merge_futs: List = []
+
+            def _collect(fut) -> None:
                 nonlocal acc_bytes
+                window = fut.result()
                 if window.num_rows == 0:
                     return
                 acc.append(window)
@@ -345,10 +352,20 @@ class MergeTreeCompactManager:
                 if acc_bytes >= self.kv_writer.target_file_size:
                     flush()
 
+            def emit(fut):
+                merge_futs.append(fut)
+                # collect any already-finished merges in order, and cap
+                # the lookahead at 2 windows so memory stays bounded
+                while merge_futs and (merge_futs[0].done()
+                                      or len(merge_futs) > 2):
+                    _collect(merge_futs.pop(0))
+
             merge_runs_streamed(
                 [_prefetch(run_iter(rf)) for rf in runs_meta],
                 self.key_cols, self.key_encoder, emit, merge_window,
                 pass_encoded=True)
+            while merge_futs:
+                _collect(merge_futs.pop(0))
             flush()
             out: List[DataFileMeta] = []
             for f in futures:
@@ -482,11 +499,14 @@ class MergeTreeCompactManager:
 
     def _merge_tables(self, run_tables: List[pa.Table],
                       drop_deletes: bool,
-                      encoded=None) -> pa.Table:
+                      encoded=None, overlapped: bool = False) -> pa.Table:
         """Merge run-ordered tables under the table's merge engine —
         the single dispatch shared by the one-shot and streamed paths.
         `encoded`: optional pre-computed (lanes, truncated) per table
-        (the streamed path encodes once for the window cut)."""
+        (the streamed path encodes once for the window cut).
+        `overlapped`: the caller runs merges on a pipeline worker, so
+        device transfer/sort time hides under decode+cut of the next
+        window (unlocks the bitmask device path's cost model)."""
         engine = self.options.merge_engine
         seq_fields = self.options.sequence_field or None
         if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
@@ -498,7 +518,8 @@ class MergeTreeCompactManager:
                 key_encoder=self.key_encoder,
                 seq_fields=seq_fields,
                 seq_desc=self.options.sequence_field_descending,
-                encoded=encoded)
+                encoded=encoded,
+                overlapped=overlapped)
             return self._record_level_expire(res.take())
         from paimon_tpu.ops.agg import merge_runs_agg
         merged = merge_runs_agg(run_tables, self.key_cols, self.schema,

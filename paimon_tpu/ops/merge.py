@@ -125,6 +125,35 @@ def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int,
 
 
 @lru_cache(maxsize=64)
+def _merge_fn_bitmask(num_lanes: int, keep: str, num_key_lanes: int,
+                      use_pallas: bool):
+    """Winner BITMASK variant: uint32[M/32] output — one BIT per row
+    (winner flag scattered back to original row order), 1/32nd of the
+    packed-u32 return.  On a tunneled chip where device->host collapses
+    to ~8MB/s this is the only return size that keeps the device path
+    competitive (TPU_PROFILE.log: d2h 256MB = 31.5s).  The host
+    recovers key order by radix-sorting just the winners' packed keys
+    (~half the rows), which it can do while the device already works on
+    the next window."""
+
+    @jax.jit
+    def fn(lanes, seq_hi, seq_lo, invalid):
+        perm, winner, _ = segmented_merge_body(
+            [lanes[i] for i in range(num_lanes)], seq_hi, seq_lo, invalid,
+            keep, num_key_lanes=num_key_lanes, use_pallas=use_pallas)
+        m = invalid.shape[0]
+        # scatter winner flags from sorted order to original positions
+        w_orig = jnp.zeros(m, jnp.bool_).at[perm].set(winner)
+        # pack 32 flags per word, little-endian bit order (matches
+        # np.unpackbits(..., bitorder="little") on the u8 view)
+        w = w_orig.reshape(-1, 32).astype(jnp.uint32)
+        return (w << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+            axis=1, dtype=jnp.uint32)
+
+    return fn
+
+
+@lru_cache(maxsize=64)
 def _merge_fn_packed(num_lanes: int, keep: str, num_key_lanes: int,
                      use_pallas: bool):
     """Winners-only variant: ONE uint32[N] output, perm in the low 31
@@ -219,6 +248,41 @@ def _device_path_pays(n: int, num_lanes: int, winners_only: bool,
     return t_dev < n / host_rate
 
 
+# measured winner fraction of recent merges (adaptive duplicate-ratio
+# estimate for the bitmask cost model); starts at the conservative 1.0
+# (no dedup benefit assumed until observed)
+_WINNER_FRAC = {"num": 0.0, "den": 0.0}
+
+
+def _observed_winner_frac() -> float:
+    if _WINNER_FRAC["den"] < 1.0:
+        return 1.0
+    return max(0.05, _WINNER_FRAC["num"] / _WINNER_FRAC["den"])
+
+
+def _bitmask_device_pays(n: int, num_lanes: int,
+                         overlapped: bool) -> bool:
+    """Cost model for the bitmask return: device sorts + dedups, host
+    re-sorts only the winners.  With `overlapped=True` the caller runs
+    merges on a pipeline worker so upload/sort/download hide under the
+    next window's decode+cut — only the host epilogue stays on the
+    merge critical path."""
+    m = _pad_size(n)
+    h2d, d2h = _measure_link_bandwidth()
+    host_rate = _host_fast_rate()
+    frac = _observed_winner_frac()
+    t_link = (m * (4 * num_lanes + 12)) / h2d \
+        + m / _DEVICE_SORT_ROWS_PER_SEC + (m / 8) / d2h
+    t_epilogue = frac * n / host_rate      # radix of winners only
+    t_dev = t_epilogue + (0.0 if overlapped else t_link)
+    # even overlapped, the link must keep up with the pipeline or the
+    # worker stalls: charge any link time beyond the host-path budget
+    if overlapped:
+        budget = n / host_rate
+        t_dev += max(0.0, t_link - budget)
+    return t_dev < n / host_rate
+
+
 def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
                               keep: str,
                               packed: Optional[np.ndarray] = None
@@ -249,6 +313,8 @@ def _host_sorted_winners_fast(lanes: np.ndarray, seq: np.ndarray,
     fused = native.merge_winners(key, seq, keep == "last")
     if fused is not None:
         perm, winner = fused
+        _WINNER_FRAC["num"] += float(np.count_nonzero(winner))
+        _WINNER_FRAC["den"] += float(n)
         return perm, winner, np.broadcast_to(np.int64(-1), n)
     perm = np.argsort(key, kind="stable").astype(np.int32)
     k_sorted = key[perm]
@@ -329,11 +395,71 @@ def _host_sorted_winners(lanes: np.ndarray, seq: np.ndarray, keep: str,
     return _winner_epilogue(perm, eq, keep)
 
 
+def _bitmask_sorted_winners(lanes, seq: np.ndarray, keep: str,
+                            order_lanes: Optional[np.ndarray],
+                            packed: np.ndarray
+                            ) -> Tuple[np.ndarray, np.ndarray,
+                                       np.ndarray]:
+    """Device path with the N/8-byte return: upload lanes+seq, device
+    sorts and computes the winner mask in ORIGINAL row order, host
+    radix-sorts only the winners' packed keys to recover key order.
+    Returns (winner_indices_in_key_order, all-true, -1) — valid under
+    the winners_only contract (callers select via the mask and never
+    read intra-segment order or prev)."""
+    PATH_COUNTS["device"] += 1
+    n = packed.shape[0]
+    lanes = np.asarray(lanes)
+    if order_lanes is not None and order_lanes.shape[1] > 0:
+        lanes = np.concatenate([lanes, order_lanes], axis=1)
+    num_lanes = lanes.shape[1]
+    num_key_lanes = 2                     # bitmask requires packed u64
+    m = _pad_size(n)
+    lanes_p = np.zeros((m, num_lanes), dtype=np.uint32)
+    lanes_p[:n] = lanes
+    useq = seq.astype(np.int64, copy=False).view(np.uint64)
+    seq_hi = np.zeros(m, dtype=np.uint32)
+    seq_lo = np.zeros(m, dtype=np.uint32)
+    seq_hi[:n] = (useq >> np.uint64(32)).astype(np.uint32)
+    seq_lo[:n] = (useq & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    invalid = np.ones(m, dtype=np.uint32)
+    invalid[:n] = 0
+
+    from paimon_tpu.ops.pallas_kernels import (disable_pallas_runtime,
+                                               pallas_enabled)
+    lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
+    use_pallas = pallas_enabled()
+    try:
+        fn = _merge_fn_bitmask(num_lanes, keep, num_key_lanes, use_pallas)
+        words = fn(lane_list, jnp.asarray(seq_hi),
+                   jnp.asarray(seq_lo), jnp.asarray(invalid))
+    except jax.errors.JaxRuntimeError:
+        if not use_pallas:
+            raise
+        disable_pallas_runtime("Mosaic compile failed")
+        fn = _merge_fn_bitmask(num_lanes, keep, num_key_lanes, False)
+        words = fn(lane_list, jnp.asarray(seq_hi),
+                   jnp.asarray(seq_lo), jnp.asarray(invalid))
+    mask = np.unpackbits(np.asarray(words).view(np.uint8),
+                         bitorder="little")[:n].astype(bool)
+    widx = np.flatnonzero(mask)           # winners, original row order
+    _WINNER_FRAC["num"] += float(len(widx))
+    _WINNER_FRAC["den"] += float(n)
+    wkeys = np.ascontiguousarray(packed[widx])
+    from paimon_tpu import native
+    perm_w = native.radix_argsort(wkeys)
+    if perm_w is None:
+        perm_w = np.argsort(wkeys, kind="stable")
+    indices = widx[perm_w].astype(np.int32)
+    return (indices, np.ones(len(indices), dtype=bool),
+            np.broadcast_to(np.int64(-1), len(indices)))
+
+
 def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                           keep: str = "last",
                           order_lanes: Optional[np.ndarray] = None,
                           winners_only: bool = False,
-                          packed: Optional[np.ndarray] = None
+                          packed: Optional[np.ndarray] = None,
+                          overlapped: bool = False
                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the device kernel.
 
@@ -361,18 +487,29 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     import os as _os
     n, num_key_lanes = lanes.shape
     force_device = _os.environ.get("PAIMON_FORCE_DEVICE_SORT") == "1"
+    force_bitmask = _os.environ.get("PAIMON_FORCE_BITMASK_SORT") == "1"
     force_host = _os.environ.get("PAIMON_FORCE_HOST_SORT") == "1"
     host_fast = (num_key_lanes == 2 and winners_only
                  and (order_lanes is None or order_lanes.shape[1] == 0))
+    # bitmask return: winners-only callers with a pre-packed u64 key
+    # (the host epilogue recovers key order by radix-sorting winners)
+    bitmask_ok = winners_only and packed is not None and n > 0
+    nl_total = lanes.shape[1] + (order_lanes.shape[1]
+                                 if order_lanes is not None else 0)
+    use_bitmask = force_bitmask and bitmask_ok
     use_host = force_host
-    if not use_host and not force_device and n > 0:
+    if not use_host and not force_device and not force_bitmask and n > 0:
         if jax.default_backend() == "cpu":
             use_host = True
         else:
-            nl = lanes.shape[1] + (order_lanes.shape[1]
-                                   if order_lanes is not None else 0)
-            use_host = not _device_path_pays(n, nl, winners_only,
-                                             host_fast)
+            use_bitmask = bitmask_ok and _bitmask_device_pays(
+                n, nl_total, overlapped)
+            if not use_bitmask:
+                use_host = not _device_path_pays(n, nl_total,
+                                                 winners_only, host_fast)
+    if use_bitmask:
+        return _bitmask_sorted_winners(lanes, seq, keep, order_lanes,
+                                       np.asarray(packed))
     if use_host:
         PATH_COUNTS["host"] += 1
         no_user_order = order_lanes is None or order_lanes.shape[1] == 0
@@ -521,7 +658,8 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
                seq_fields: Optional[Sequence[str]] = None,
                seq_desc: bool = False,
                encoded: Optional[Sequence[Tuple[np.ndarray, np.ndarray]]]
-               = None) -> MergeResult:
+               = None,
+               overlapped: bool = False) -> MergeResult:
     """Merge k sorted runs (oldest first) into the latest row per key.
 
     Equivalent reference path: MergeTreeReaders.readerForMergeTree
@@ -579,7 +717,7 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
     perm, winner, prev = device_sorted_winners(
         lanes, seq, keep, order_lanes,
         winners_only=not with_prev and not truncated.any(),
-        packed=packed)
+        packed=packed, overlapped=overlapped)
 
     win_pos = np.flatnonzero(winner)
     indices = perm[win_pos].astype(np.int64)

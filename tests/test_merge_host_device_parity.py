@@ -101,3 +101,56 @@ def test_winners_only_fast_path_matches_full_sort(keep, seed):
     w_fast = set(_winners(fast[0], fast[1], n).tolist())
     w_full = set(_winners(full[0], full[1], n).tolist())
     assert w_fast == w_full
+
+
+@pytest.mark.parametrize("keep", ["last", "first"])
+@pytest.mark.parametrize("seed", [2, 11, 77])
+def test_bitmask_path_matches_host(keep, seed):
+    """The N/8-byte bitmask device return + host winner radix must pick
+    the SAME winners in the SAME key order as the host fast path."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(40, 9000))
+    lanes = rng.integers(0, 50, (n, 2), dtype=np.uint64) \
+        .astype(np.uint32)
+    packed = (lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | lanes[:, 1].astype(np.uint64)
+    seq = rng.integers(0, 15, n).astype(np.int64)
+
+    host = device_sorted_winners(lanes, seq, keep, winners_only=True,
+                                 packed=packed)
+    os.environ["PAIMON_FORCE_BITMASK_SORT"] = "1"
+    try:
+        bm = device_sorted_winners(lanes, seq, keep, winners_only=True,
+                                   packed=packed)
+    finally:
+        os.environ.pop("PAIMON_FORCE_BITMASK_SORT", None)
+    h_idx = np.asarray(host[0])[np.asarray(host[1], bool)
+                                & (np.asarray(host[0]) < n)]
+    b_idx = np.asarray(bm[0])[np.asarray(bm[1], bool)]
+    # identical winners, identical (key-sorted) order
+    assert np.array_equal(h_idx, b_idx)
+
+
+def test_bitmask_path_with_order_lanes():
+    rng = np.random.default_rng(5)
+    n = 3000
+    lanes = rng.integers(0, 20, (n, 2), dtype=np.uint64) \
+        .astype(np.uint32)
+    packed = (lanes[:, 0].astype(np.uint64) << np.uint64(32)) \
+        | lanes[:, 1].astype(np.uint64)
+    order = rng.integers(0, 4, (n, 1), dtype=np.uint64).astype(np.uint32)
+    seq = np.arange(n, dtype=np.int64)
+    host = device_sorted_winners(lanes, seq, "last", order_lanes=order,
+                                 winners_only=True, packed=None)
+    os.environ["PAIMON_FORCE_BITMASK_SORT"] = "1"
+    try:
+        bm = device_sorted_winners(lanes, seq, "last", order_lanes=order,
+                                   winners_only=True, packed=packed)
+    finally:
+        os.environ.pop("PAIMON_FORCE_BITMASK_SORT", None)
+    h_idx = np.asarray(host[0])[np.asarray(host[1], bool)
+                                & (np.asarray(host[0]) < n)]
+    b_idx = np.asarray(bm[0])[np.asarray(bm[1], bool)]
+    assert set(h_idx.tolist()) == set(b_idx.tolist())
+    # bitmask output is key-ordered
+    assert np.all(np.diff(packed[b_idx].astype(np.int64)) >= 0)
