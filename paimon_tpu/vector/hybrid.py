@@ -100,7 +100,34 @@ def hybrid_search(table, routes: Sequence[dict], k: int = 10,
     from paimon_tpu.vector.ann import BruteForceIndex, _as_matrix
 
     ranker = _normalize_ranker(ranker)   # fail fast, before any index
-    data = table.to_arrow()
+
+    # persisted text indexes score by _ROW_ID, not position: when any
+    # text route will read one, fetch row ids in the SAME table read so
+    # positions and ids stay aligned
+    persisted: dict = {}
+    for r in routes:
+        if r.get("type") == "text" and r.get("index") is None:
+            col = r["column"]
+            if col not in persisted:
+                from paimon_tpu.index.fulltext import \
+                    PersistedFullTextIndex as _P
+                p = _P.open(table, col)
+                persisted[col] = p if p.meta is not None else None
+    want_ids = any(v is not None for v in persisted.values())
+    data = table.to_arrow(with_row_ids=True) if want_ids \
+        else table.to_arrow()
+    rowid_pos: Optional[dict] = None
+
+    def _positions_of(row_ids: np.ndarray) -> np.ndarray:
+        nonlocal rowid_pos
+        if rowid_pos is None:
+            from paimon_tpu.core.row_tracking import ROW_ID_COL
+            rids = np.asarray(data.column(ROW_ID_COL).combine_chunks()
+                              .cast(pa.int64()))
+            rowid_pos = {int(r): i for i, r in enumerate(rids)}
+        return np.array([rowid_pos.get(int(r), -1) for r in row_ids],
+                        dtype=np.int64)
+
     fused_routes = []
     for r in routes:
         kind = r.get("type")
@@ -116,8 +143,18 @@ def hybrid_search(table, routes: Sequence[dict], k: int = 10,
             fused_routes.append((ids[0][valid].astype(np.int64),
                                  scores[0][valid], weight))
         elif kind == "text":
-            idx = r.get("index") or FullTextIndex(
-                data.column(col).to_pylist())
+            idx = r.get("index")
+            if idx is None and persisted.get(col) is not None:
+                # the persisted BM25 index: O(matched postings)
+                # instead of re-tokenizing the whole corpus per query
+                rids, scores = persisted[col].search(r["query"],
+                                                     route_limit)
+                pos = _positions_of(rids)
+                live = pos >= 0          # deleted rows drop out here
+                fused_routes.append((pos[live], scores[live], weight))
+                continue
+            if idx is None:
+                idx = FullTextIndex(data.column(col).to_pylist())
             ids, scores = idx.search(r["query"], route_limit)
             fused_routes.append((ids, scores, weight))
         else:
@@ -125,4 +162,8 @@ def hybrid_search(table, routes: Sequence[dict], k: int = 10,
 
     row_ids, fused = rank_hybrid(fused_routes, ranker=ranker, limit=k)
     out = data.take(pa.array(row_ids))
+    if want_ids:
+        from paimon_tpu.core.row_tracking import ROW_ID_COL
+        if ROW_ID_COL in out.column_names:
+            out = out.drop_columns([ROW_ID_COL])
     return out.append_column("_score", pa.array(fused, pa.float32()))

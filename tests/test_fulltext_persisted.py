@@ -1,0 +1,220 @@
+"""Persisted, incremental full-text index: BM25, segments, analyzers.
+
+reference: paimon-full-text NativeFullTextGlobalIndexer +
+paimon-eslib ESIndexGlobalIndexerFactory.java:32 / ESIndexOptions.java.
+"""
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.index.fulltext import (Analyzer, FullTextIndex,
+                                       PersistedFullTextIndex,
+                                       full_text_search)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, VarCharType
+
+
+def docs_table(tmp_path):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("body", VarCharType.string_type())
+              .options({"bucket": "-1", "row-tracking.enabled": "true"})
+              .build())
+    return FileStoreTable.create(str(tmp_path / "docs"), schema)
+
+
+def write(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "a fast brown fox outpaces a slow hound",
+    "lorem ipsum dolor sit amet",
+    "the dog sleeps all day long",
+    "quick thinking saves the day",
+]
+
+
+class TestAnalyzer:
+    def test_lowercase_and_tokens(self):
+        a = Analyzer()
+        assert a.tokens("Hello, World! 42") == ["hello", "world", "42"]
+
+    def test_stemming(self):
+        a = Analyzer(stem=True)
+        assert a.tokens("jumping jumped jumps") == ["jump", "jump",
+                                                    "jump"]
+
+    def test_stopwords(self):
+        a = Analyzer(stopwords=["the", "a"])
+        assert a.tokens("the quick a fox") == ["quick", "fox"]
+
+    def test_cjk_bigrams(self):
+        a = Analyzer()
+        toks = a.tokens("日本語テキスト")
+        assert all(len(t) == 2 for t in toks)
+        assert "日本" in toks and "本語" in toks
+
+    def test_mixed_cjk_latin(self):
+        a = Analyzer()
+        toks = a.tokens("jax高速化library")
+        assert "jax" in toks and "library" in toks and "高速" in toks
+
+    def test_roundtrip_config(self):
+        a = Analyzer(stem=True, stopwords=["x"], min_token_len=2)
+        b = Analyzer.from_json(a.to_json())
+        assert b.stem and b.stopwords == frozenset(["x"])
+        assert b.min_token_len == 2
+
+
+class TestInMemoryBM25:
+    def test_bm25_prefers_rarer_terms(self):
+        idx = FullTextIndex(CORPUS)
+        ids, scores = idx.search("fox", 10)
+        assert set(ids.tolist()) == {0, 1}
+        assert np.all(np.diff(scores) <= 0)
+
+    def test_and_mode(self):
+        idx = FullTextIndex(CORPUS)
+        ids, _ = idx.search("quick AND fox", 10)
+        assert ids.tolist() == [0]
+        ids, _ = idx.search("+quick +day", 10)
+        assert ids.tolist() == [4]
+
+    def test_phrase_mode(self):
+        idx = FullTextIndex(CORPUS)
+        ids, _ = idx.search('"brown fox"', 10)
+        assert set(ids.tolist()) == {0, 1}
+        ids, _ = idx.search('"fox brown"', 10)
+        assert ids.tolist() == []
+
+    def test_or_still_ranks(self):
+        idx = FullTextIndex(CORPUS)
+        ids, scores = idx.search("quick dog", 10)
+        # doc 0 has both terms: must rank first
+        assert ids[0] == 0
+
+
+class TestPersisted:
+    def test_build_and_search(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        idx = PersistedFullTextIndex.open(t, "body")
+        added = idx.refresh()
+        assert added == len(CORPUS)
+        ids, scores = idx.search("fox", 10)
+        assert set(ids.tolist()) == {0, 1}
+
+    def test_survives_restart(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        PersistedFullTextIndex.open(t, "body").refresh()
+        # fresh object = fresh process: no rebuild required
+        idx2 = PersistedFullTextIndex.open(t, "body")
+        assert idx2.meta is not None
+        assert idx2.refresh() == 0           # already current
+        ids, _ = idx2.search("lorem", 5)
+        assert ids.tolist() == [2]
+
+    def test_incremental_refresh_new_segment(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        idx = PersistedFullTextIndex.open(t, "body")
+        idx.refresh()
+        assert len(idx.meta["segments"]) == 1
+        write(t, [{"id": 100, "body": "an arctic fox in the snow"}])
+        added = idx.refresh()
+        assert added == 1
+        assert len(idx.meta["segments"]) == 2
+        ids, _ = idx.search("fox", 10)
+        assert set(ids.tolist()) == {0, 1, 5}
+        ids, _ = idx.search("arctic", 10)
+        assert ids.tolist() == [5]
+
+    def test_optimize_merges_segments(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        idx = PersistedFullTextIndex.open(t, "body")
+        idx.refresh()
+        write(t, [{"id": 100, "body": "an arctic fox in the snow"}])
+        idx.refresh()
+        before_ids, before_sc = idx.search("fox", 10)
+        idx.optimize()
+        assert len(idx.meta["segments"]) == 1
+        after_ids, after_sc = idx.search("fox", 10)
+        assert before_ids.tolist() == after_ids.tolist()
+        np.testing.assert_allclose(before_sc, after_sc, rtol=1e-6)
+
+    def test_phrase_across_persisted(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        idx = PersistedFullTextIndex.open(t, "body")
+        idx.refresh()
+        ids, _ = idx.search('"lazy dog"', 10)
+        assert ids.tolist() == [0]
+
+    def test_query_reads_only_matching_row_groups(self, tmp_path):
+        """The postings read must prune row groups by term stats."""
+        t = docs_table(tmp_path)
+        rows = [{"id": i, "body": f"word{i:05d} common"}
+                for i in range(5000)]
+        write(t, rows)
+        idx = PersistedFullTextIndex.open(t, "body")
+        idx.refresh()
+        seg = idx.meta["segments"][0]
+        import io
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(io.BytesIO(idx._read(seg["file"])))
+        assert pf.num_row_groups > 1     # pruning is meaningful
+        ids, _ = idx.search("word00007", 5)
+        assert ids.tolist() == [7]
+
+    def test_custom_analyzer_persisted(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": 0, "body": "Jumping foxes"},
+                  {"id": 1, "body": "sleeping dogs"}])
+        idx = PersistedFullTextIndex.open(
+            t, "body", analyzer=Analyzer(stem=True))
+        idx.refresh()
+        # a new process re-reads the analyzer config from meta.json
+        idx2 = PersistedFullTextIndex.open(t, "body")
+        assert idx2.analyzer.stem
+        ids, _ = idx2.search("jumps", 5)      # stems to 'jump'
+        assert ids.tolist() == [0]
+
+
+class TestTableHelper:
+    def test_full_text_search_scores(self, tmp_path):
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        out = full_text_search(t, "body", "brown fox", 3)
+        assert "_score" in out.column_names
+        assert set(out.column("id").to_pylist()) <= {0, 1}
+
+
+class TestHybridUsesPersisted:
+    def test_hybrid_text_route_reads_persisted_index(self, tmp_path):
+        from paimon_tpu.vector.hybrid import hybrid_search
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        idx = PersistedFullTextIndex.open(t, "body")
+        idx.refresh()
+        out = hybrid_search(t, [{"type": "text", "column": "body",
+                                 "query": "fox", "limit": 5}], k=5)
+        assert set(out.column("id").to_pylist()) == {0, 1}
+        assert "_ROW_ID" not in out.column_names
+        assert "_score" in out.column_names
+
+    def test_hybrid_falls_back_without_index(self, tmp_path):
+        from paimon_tpu.vector.hybrid import hybrid_search
+        t = docs_table(tmp_path)
+        write(t, [{"id": i, "body": b} for i, b in enumerate(CORPUS)])
+        out = hybrid_search(t, [{"type": "text", "column": "body",
+                                 "query": "fox", "limit": 5}], k=5)
+        assert set(out.column("id").to_pylist()) == {0, 1}

@@ -1,0 +1,62 @@
+"""Round-4 link re-profile: is d2h really 8MB/s, and can chunked/async
+device->host copies do better? Run ONLY on the real chip (single-client
+tunnel)."""
+import time
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+print("backend:", jax.default_backend(), jax.devices())
+
+
+def bw(nbytes, secs):
+    return f"{nbytes / max(secs, 1e-9) / 1e6:.1f} MB/s"
+
+
+# warm-up
+w = jax.device_put(np.zeros(1 << 20, np.uint8)); w.block_until_ready()
+np.asarray(w)
+
+for mb in (1, 8, 64):
+    size = mb << 20
+    buf = np.zeros(size, np.uint8)
+    t0 = time.perf_counter(); d = jax.device_put(buf); d.block_until_ready()
+    h2d = time.perf_counter() - t0
+    t0 = time.perf_counter(); np.asarray(d)
+    d2h = time.perf_counter() - t0
+    print(f"monolithic {mb}MB: h2d {bw(size, h2d)}  d2h {bw(size, d2h)}")
+
+# chunked async d2h: start all copies, then gather
+size = 64 << 20
+d = jax.device_put(np.zeros(size, np.uint8)); d.block_until_ready()
+for nchunks in (4, 16, 64):
+    chunks = [d[i * (size // nchunks):(i + 1) * (size // nchunks)]
+              for i in range(nchunks)]
+    for c in chunks:
+        c.block_until_ready()
+    t0 = time.perf_counter()
+    for c in chunks:
+        c.copy_to_host_async()
+    outs = [np.asarray(c) for c in chunks]
+    dt = time.perf_counter() - t0
+    print(f"async-chunked d2h 64MB x{nchunks}: {bw(size, dt)}")
+
+# small-return profile: the bitmask shape (1MB per 8M-row window)
+for kb in (128, 1024):
+    size = kb << 10
+    arr = jnp.zeros(size // 4, jnp.uint32)
+    arr.block_until_ready()
+    t0 = time.perf_counter(); np.asarray(arr)
+    dt = time.perf_counter() - t0
+    print(f"d2h {kb}KB: {bw(size, dt)} ({dt*1e3:.1f}ms)")
+
+# device sort rate at window scale (the kernel's dominant op)
+for n in (1 << 22, 1 << 23):
+    x = jnp.asarray(np.random.default_rng(0).integers(
+        0, 1 << 60, n, dtype=np.int64))
+    s = jax.jit(jnp.sort)
+    s(x).block_until_ready()
+    t0 = time.perf_counter(); s(x).block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"lax.sort {n} rows: {dt:.3f}s = {n/dt/1e6:.1f}M rows/s")
