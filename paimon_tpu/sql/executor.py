@@ -1441,6 +1441,17 @@ class SQLContext:
                          "query": str(rest[3]), "limit": kk}],
                 k=kk,
                 ranker=str(rest[5]) if len(rest) > 5 else "rrf")
+        if proc == "create_vector_index":
+            # CALL sys.create_vector_index('db.t', 'col'[, m[, metric]])
+            # builds + persists an IVF-PQ index in the table layout
+            # (reference NativeVectorIndexLoader.java:28 factory)
+            from paimon_tpu.vector.ann import PersistedVectorIndex
+            p = PersistedVectorIndex(table, str(rest[0]))
+            idx = p.build(m=int(rest[1]) if len(rest) > 1 else 8,
+                          metric=str(rest[2]) if len(rest) > 2
+                          else "l2")
+            return _result([f"ivfpq index built: {len(idx)} vectors, "
+                            f"{idx.memory_bytes()} bytes resident"])
         if proc == "mark_partition_done":
             # reference flink/procedure/MarkPartitionDoneProcedure.java:
             # CALL sys.mark_partition_done('db.t', 'dt=2026-07-29', ...)

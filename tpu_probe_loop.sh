@@ -1,0 +1,12 @@
+#!/bin/bash
+# patient probe: the axon tunnel wedges and un-wedges on its own;
+# retry the link profile until it succeeds, then stop.
+for i in $(seq 1 12); do
+  echo "=== attempt $i $(date +%H:%M:%S) ===" >> /tmp/tpu_probe.log
+  timeout 600 python -u /root/repo/tpu_link_probe.py >> /tmp/tpu_probe.log 2>&1
+  rc=$?
+  echo "=== rc=$rc ===" >> /tmp/tpu_probe.log
+  if [ $rc -eq 0 ]; then exit 0; fi
+  sleep 120
+done
+exit 1
