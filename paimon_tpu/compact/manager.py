@@ -120,9 +120,8 @@ class MergeTreeCompactManager:
                 CoreOptions.COMPACTION_TOTAL_SIZE_THRESHOLD),
             file_num_limit=options.get(
                 CoreOptions.COMPACTION_FILE_NUM_LIMIT))
-        self.path_factory = FileStorePathFactory(
-            table_path, schema.partition_keys,
-            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, schema.partition_keys, options)
         self.kv_writer = KeyValueFileWriter(
             file_io, self.path_factory, schema,
             file_format=options.file_format,
@@ -133,7 +132,8 @@ class MergeTreeCompactManager:
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
             format_per_level=options.file_format_per_level,
-            format_options=options.format_options)
+            format_options=options.format_options,
+            **options.kv_writer_kwargs())
         rt = schema.logical_row_type()
         self.trimmed_pk = schema.trimmed_primary_keys()
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]

@@ -176,9 +176,8 @@ class AppendOnlyFileStoreWrite:
         self.schema = table_schema
         self.options = options
         self.partition_keys = table_schema.partition_keys
-        self.path_factory = FileStorePathFactory(
-            table_path, self.partition_keys,
-            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, self.partition_keys, options)
         self.file_writer = AppendFileWriter(
             file_io, self.path_factory, table_schema,
             file_format=options.file_format,
@@ -255,9 +254,8 @@ class AppendSplitRead:
         self.schema = schema
         self.options = options
         self.schema_manager = schema_manager
-        self.path_factory = FileStorePathFactory(
-            table_path, schema.partition_keys,
-            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, schema.partition_keys, options)
         self._schema_cache: Dict[int, TableSchema] = {schema.id: schema}
         self._projection: Optional[List[str]] = None
         self._predicate: Optional[Predicate] = None

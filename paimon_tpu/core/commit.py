@@ -43,8 +43,8 @@ class FileStoreCommit:
         self.options = options
         self.commit_user = commit_user or str(uuid.uuid4())
         self.snapshot_manager = SnapshotManager(file_io, table_path, branch)
-        self.path_factory = FileStorePathFactory(
-            table_path, table_schema.partition_keys)
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, table_schema.partition_keys, options)
         rt = table_schema.logical_row_type()
         self.partition_types = [rt.get_field(k).type
                                 for k in table_schema.partition_keys]

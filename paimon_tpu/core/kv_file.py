@@ -38,7 +38,11 @@ class KeyValueFileWriter:
                  bloom_fpp: float = 0.01,
                  index_in_manifest_threshold: int = 500,
                  format_per_level: Optional[Dict[int, str]] = None,
-                 format_options: Optional[Dict[str, str]] = None):
+                 format_options: Optional[Dict[str, str]] = None,
+                 compression_per_level: Optional[Dict[int, str]] = None,
+                 target_file_row_num: Optional[int] = None,
+                 stats_mode_per_level: Optional[Dict[int, str]] = None,
+                 stats_keep_first_n: Optional[int] = None):
         self.file_io = file_io
         self.path_factory = path_factory
         self.schema = table_schema
@@ -46,7 +50,11 @@ class KeyValueFileWriter:
         self.format_per_level = format_per_level or {}
         self.format_options = format_options or {}
         self.compression = compression
+        self.compression_per_level = compression_per_level or {}
         self.target_file_size = target_file_size
+        self.target_file_row_num = target_file_row_num
+        self.stats_mode_per_level = stats_mode_per_level or {}
+        self.stats_keep_first_n = stats_keep_first_n
         self.index_spec = index_spec or {}
         self.bloom_fpp = bloom_fpp
         self.index_in_manifest_threshold = index_in_manifest_threshold
@@ -68,6 +76,9 @@ class KeyValueFileWriter:
         n = kv_table.num_rows
         bytes_per_row = max(1, kv_table.nbytes // n)
         rows_per_file = max(1024, self.target_file_size // bytes_per_row)
+        if self.target_file_row_num:
+            # target-file-row-num: roll by rows too
+            rows_per_file = min(rows_per_file, self.target_file_row_num)
         metas = []
         for start in range(0, n, rows_per_file):
             chunk = kv_table.slice(start, min(rows_per_file, n - start))
@@ -79,6 +90,8 @@ class KeyValueFileWriter:
                    level: int, file_source: int) -> DataFileMeta:
         fmt = get_format(self.format_per_level.get(level,
                                                    self.file_format))
+        compression = self.compression_per_level.get(level,
+                                                     self.compression)
         name = self.path_factory.new_data_file_name(fmt.extension)
         path = self.path_factory.data_file_path(partition, bucket, name)
         from paimon_tpu.format.blob import blob_column_names
@@ -89,7 +102,7 @@ class KeyValueFileWriter:
             chunk, blob_extras = externalize_blobs(
                 self.file_io, self.path_factory, partition, bucket, name,
                 chunk, blob_cols)
-        size = fmt.create_writer(self.compression,
+        size = fmt.create_writer(compression,
                                  self.format_options).write(
             self.file_io, path, chunk)
 
@@ -101,9 +114,23 @@ class KeyValueFileWriter:
         last = [chunk.column(c)[-1].as_py() for c in self.key_cols]
 
         value_cols = [f.name for f in self.schema.fields]
-        vmins, vmaxs, vnulls = extract_simple_stats(chunk, value_cols)
         value_types = [f.type for f in self.schema.fields]
-        value_stats = _safe_stats(value_types, vmins, vmaxs, vnulls)
+        stats_mode = self.stats_mode_per_level.get(level)
+        if stats_mode == "none":
+            # metadata.stats-mode.per.level 'N:none': skip stats work
+            # for short-lived files (planning treats absent stats as
+            # unknown and never prunes on them)
+            nil = [None] * len(value_cols)
+            value_stats = _safe_stats(value_types, nil, nil,
+                                      [None] * len(value_cols))
+        else:
+            vmins, vmaxs, vnulls = extract_simple_stats(chunk, value_cols)
+            if self.stats_keep_first_n is not None:
+                # metadata.stats-keep-first-n-columns: null out the rest
+                k = self.stats_keep_first_n
+                vmins = list(vmins[:k]) + [None] * (len(value_cols) - k)
+                vmaxs = list(vmaxs[:k]) + [None] * (len(value_cols) - k)
+            value_stats = _safe_stats(value_types, vmins, vmaxs, vnulls)
 
         seq = chunk.column(SEQ_COL)
         import pyarrow.compute as pc

@@ -164,9 +164,8 @@ class MergeFileSplitRead:
         self.schema = schema
         self.options = options
         self.schema_manager = schema_manager
-        self.path_factory = FileStorePathFactory(
-            table_path, schema.partition_keys,
-            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, schema.partition_keys, options)
         self.trimmed_pk = schema.trimmed_primary_keys()
         self.key_cols = [KEY_PREFIX + k for k in self.trimmed_pk]
         rt = schema.logical_row_type()

@@ -69,8 +69,8 @@ class FileStoreScan:
         self.schema = schema
         self.options = options
         self.snapshot_manager = SnapshotManager(file_io, table_path, branch)
-        self.path_factory = FileStorePathFactory(table_path,
-                                                 schema.partition_keys)
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, schema.partition_keys, options)
         rt = schema.logical_row_type()
         self.partition_types = [rt.get_field(k).type
                                 for k in schema.partition_keys]

@@ -116,16 +116,28 @@ class _BucketWriter:
         self.changelog_files: List[DataFileMeta] = []
         self.spills: List[str] = []           # key-sorted local runs
         self._spill_dir: Optional[str] = None
+        self._spill_bytes = 0                 # on-disk spill footprint
 
     def write(self, table: pa.Table, kinds: np.ndarray):
         self.buffers.append(table)
         self.kind_buffers.append(kinds)
         self.buffered_bytes += table.nbytes
-        if self.buffered_bytes >= self.parent.options.write_buffer_size:
-            if self.parent.spillable:
-                self._spill()
-            else:
-                self.flush()
+        opts = self.parent.options
+        if self.parent.spillable:
+            # sorted runs spill at sort-spill-buffer-size cadence,
+            # bounded overall by write-buffer-size
+            threshold = min(opts.write_buffer_size,
+                            opts.get(CoreOptions.SORT_SPILL_BUFFER_SIZE))
+            if self.buffered_bytes >= threshold:
+                if self._spill_bytes >= opts.get(
+                        CoreOptions.WRITE_BUFFER_SPILL_MAX_DISK_SIZE):
+                    # disk budget exhausted: flush to L0 instead of
+                    # spilling further (reference MaxDiskSize cap)
+                    self.flush()
+                else:
+                    self._spill()
+        elif self.buffered_bytes >= opts.write_buffer_size:
+            self.flush()
 
     def _restore_seq(self) -> int:
         if self.next_seq is None:
@@ -186,16 +198,25 @@ class _BucketWriter:
     # sorted runs, merged into L0 once at prepareCommit — fewer, larger
     # L0 files than one flush file per buffer-full) ----------------------
 
-    def _spill(self):
-        sorted_kv = self._sorted_chunk()
-        if sorted_kv is None:
-            return
+    def _spill_codec(self):
+        """IPC compression per spill-compression(+zstd-level)."""
+        codec = self.parent.options.get(CoreOptions.SPILL_COMPRESSION)
+        if codec in (None, "none"):
+            return None
+        if codec == "zstd":
+            level = self.parent.options.get(
+                CoreOptions.SPILL_COMPRESSION_ZSTD_LEVEL)
+            return pa.Codec("zstd", compression_level=level)
+        return pa.Codec(codec)
+
+    def _write_spill_file(self, sorted_kv: pa.Table) -> str:
         import tempfile
         if self._spill_dir is None:
             self._spill_dir = tempfile.mkdtemp(prefix="paimon-spill-")
         path = os.path.join(self._spill_dir,
-                            f"spill-{len(self.spills)}.arrow")
-        opts = pa.ipc.IpcWriteOptions(compression="zstd")
+                            f"spill-{len(self.spills)}"
+                            f"-{len(os.listdir(self._spill_dir))}.arrow")
+        opts = pa.ipc.IpcWriteOptions(compression=self._spill_codec())
         # batches are BYTE-capped (~24MB): the k-way merge buffers at
         # least one batch per run, so row-capped batches the size of a
         # whole write buffer would recreate the memory cliff spilling
@@ -205,7 +226,92 @@ class _BucketWriter:
         with pa.OSFile(path, "wb") as f, \
                 pa.ipc.new_file(f, sorted_kv.schema, options=opts) as wr:
             wr.write_table(sorted_kv, max_chunksize=chunk_rows)
-        self.spills.append(path)
+        self._spill_bytes += os.path.getsize(path)
+        return path
+
+    def _spill(self):
+        sorted_kv = self._sorted_chunk()
+        if sorted_kv is None:
+            return
+        self.spills.append(self._write_spill_file(sorted_kv))
+        max_handles = self.parent.options.get(
+            CoreOptions.LOCAL_SORT_MAX_NUM_FILE_HANDLES)
+        if len(self.spills) > max_handles:
+            self._fold_spills(max_handles)
+
+    def _fold_spills(self, max_handles: int):
+        """Merge the oldest runs into one so at most `max_handles`
+        stay open at once (local-sort.max-num-file-handles; reference
+        BinaryExternalSortBuffer's external-merge fan-in bound)."""
+        from paimon_tpu.ops.merge_stream import merge_runs_streamed
+        fold, rest = self.spills[:max_handles], self.spills[max_handles:]
+        schema = self.parent.schema
+        key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
+        encoder = self.parent.key_encoder
+
+        out_path: List[str] = []
+        writer_box: List = [None, None]       # (OSFile, ipc writer)
+
+        def emit(window: pa.Table):
+            if window.num_rows == 0:
+                return
+            if writer_box[0] is None:
+                import tempfile
+                path = os.path.join(self._spill_dir,
+                                    f"spill-fold-"
+                                    f"{len(os.listdir(self._spill_dir))}"
+                                    f".arrow")
+                out_path.append(path)
+                writer_box[0] = pa.OSFile(path, "wb")
+                writer_box[1] = pa.ipc.new_file(
+                    writer_box[0], window.schema,
+                    options=pa.ipc.IpcWriteOptions(
+                        compression=self._spill_codec()))
+            writer_box[1].write_table(window)
+
+        merge_runs_streamed([self._ipc_iter(p) for p in fold],
+                            key_cols, encoder, emit,
+                            self._window_merge_fn())
+        if writer_box[1] is not None:
+            writer_box[1].close()
+            writer_box[0].close()
+        for p in fold:
+            self._spill_bytes -= os.path.getsize(p)
+            os.unlink(p)
+        if out_path:
+            self._spill_bytes += os.path.getsize(out_path[0])
+        self.spills = out_path + rest
+
+    @staticmethod
+    def _ipc_iter(path):
+        def gen():
+            with pa.OSFile(path, "rb") as f:
+                rd = pa.ipc.open_file(f)
+                for i in range(rd.num_record_batches):
+                    yield pa.Table.from_batches([rd.get_batch(i)])
+        return gen()
+
+    def _window_merge_fn(self):
+        """Window merger shared by spill folding and the final L0
+        merge: dedup engines keep winners, deferred engines keep every
+        row in (key, seq) order."""
+        schema = self.parent.schema
+        key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
+        engine = self.parent.options.merge_engine
+        encoder = self.parent.key_encoder
+
+        def merge_window(tables: List[pa.Table]) -> pa.Table:
+            if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
+                return merge_runs(
+                    tables, key_cols, merge_engine=engine,
+                    drop_deletes=False, key_encoder=encoder,
+                    seq_fields=self.parent.options.sequence_field or None,
+                    seq_desc=self.parent.options
+                    .sequence_field_descending).take()
+            kv = pa.concat_tables(tables, promote_options="none")
+            order = sort_table(kv, key_cols, key_encoder=encoder)
+            return kv.take(pa.array(order))
+        return merge_window
 
     def _merge_spills(self):
         """Streamed k-way merge of the spilled runs (+ the live buffer)
@@ -216,33 +322,12 @@ class _BucketWriter:
         tail = self._sorted_chunk()
         schema = self.parent.schema
         key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
-        engine = self.parent.options.merge_engine
         encoder = self.parent.key_encoder
 
-        def ipc_iter(path):
-            with pa.OSFile(path, "rb") as f:
-                rd = pa.ipc.open_file(f)
-                for i in range(rd.num_record_batches):
-                    yield pa.Table.from_batches([rd.get_batch(i)])
-
-        iters = [ipc_iter(p) for p in self.spills]
+        iters = [self._ipc_iter(p) for p in self.spills]
         if tail is not None:
             iters.append(iter([tail]))
-
-        def merge_window(tables: List[pa.Table]) -> pa.Table:
-            if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
-                return merge_runs(
-                    tables, key_cols, merge_engine=engine,
-                    drop_deletes=False, key_encoder=encoder,
-                    seq_fields=self.parent.options.sequence_field or None,
-                    seq_desc=self.parent.options
-                    .sequence_field_descending).take()
-            # deferred-merge engines keep every row: windows partition
-            # the keyspace, so a per-window stable (key, seq) sort
-            # yields a globally key-sorted run
-            kv = pa.concat_tables(tables, promote_options="none")
-            order = sort_table(kv, key_cols, key_encoder=encoder)
-            return kv.take(pa.array(order))
+        merge_window = self._window_merge_fn()
 
         acc: List[pa.Table] = []
         acc_bytes = 0
@@ -280,6 +365,7 @@ class _BucketWriter:
     def _drop_spills(self):
         import shutil
         self.spills = []
+        self._spill_bytes = 0
         if self._spill_dir is not None:
             shutil.rmtree(self._spill_dir, ignore_errors=True)
             self._spill_dir = None
@@ -391,9 +477,8 @@ class KeyValueFileStoreWrite:
         self._bucket_files_map = bucket_files_map
         self._schema_manager = schema_manager
         self.partition_keys = table_schema.partition_keys
-        self.path_factory = FileStorePathFactory(
-            table_path, self.partition_keys,
-            options.get(CoreOptions.PARTITION_DEFAULT_NAME))
+        self.path_factory = FileStorePathFactory.from_options(
+            table_path, self.partition_keys, options)
         self.kv_writer = KeyValueFileWriter(
             file_io, self.path_factory, table_schema,
             file_format=options.file_format,
@@ -404,7 +489,8 @@ class KeyValueFileStoreWrite:
             index_in_manifest_threshold=options.get(
                 CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD),
             format_per_level=options.file_format_per_level,
-            format_options=options.format_options)
+            format_options=options.format_options,
+            **options.kv_writer_kwargs())
         rt = table_schema.logical_row_type()
         self.total_buckets = options.bucket
         bucket_keys = table_schema.bucket_keys()

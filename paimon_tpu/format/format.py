@@ -89,6 +89,11 @@ class _ParquetWriter(FormatWriter):
         fo = format_options or {}
         self.row_group_rows = int(fo.get("parquet.row-group.rows",
                                          row_group_rows))
+        # file.block-size (reference CoreOptions FILE_BLOCK_SIZE):
+        # parquet row-group granularity in BYTES; converted to rows per
+        # table at write time
+        self.block_bytes = int(fo["file.block-size"]) \
+            if "file.block-size" in fo else None
         # parquet.enable.dictionary (reference parquet writer option):
         # dictionary encoding is pure overhead on high-cardinality data
         self.use_dictionary = fo.get(
@@ -96,9 +101,13 @@ class _ParquetWriter(FormatWriter):
 
     def write(self, file_io, path, table):
         buf = io.BytesIO()
+        rg = self.row_group_rows
+        if self.block_bytes and table.num_rows:
+            per_row = max(1, table.nbytes // table.num_rows)
+            rg = max(1024, self.block_bytes // per_row)
         pq.write_table(table, buf, compression=self.compression,
                        compression_level=self.level,
-                       row_group_size=self.row_group_rows,
+                       row_group_size=rg,
                        use_dictionary=self.use_dictionary,
                        write_statistics=True)
         data = buf.getvalue()
@@ -119,13 +128,19 @@ class _OrcWriter(FormatWriter):
     def __init__(self, compression: str = "zstd",
                  format_options: Optional[Dict[str, str]] = None):
         self.compression, _ = split_compression(compression)
+        fo = format_options or {}
+        # file.block-size -> orc stripe bytes
+        self.stripe_bytes = int(fo["file.block-size"]) \
+            if "file.block-size" in fo else None
 
     def write(self, file_io, path, table):
         if pa_orc is None:
             raise RuntimeError("pyarrow.orc unavailable")
         buf = io.BytesIO()
+        kw = {"stripe_size": self.stripe_bytes} if self.stripe_bytes \
+            else {}
         pa_orc.write_table(table, buf,
-                           compression=self.compression.upper())
+                           compression=self.compression.upper(), **kw)
         data = buf.getvalue()
         file_io.write_bytes(path, data, overwrite=False)
         return len(data)

@@ -5,16 +5,18 @@ TagAutoManager.java + TagAutoCreation.java — with
 `tag.automatic-creation` enabled, each commit checks whether a tag
 period (daily/hourly, or a custom duration) has completed; the first
 snapshot past `period end + tag.creation-delay` is tagged with the
-period's formatted name, and `tag.num-retained-max` expires the oldest
-auto tags.  `process-time` uses the snapshot's commit time,
-`watermark` the snapshot's watermark.
+period's formatted name, `tag.automatic-completion` backfills any
+missed periods, and `tag.num-retained-max` expires the oldest auto
+tags.  `process-time` uses the snapshot's commit time, `watermark` the
+snapshot's watermark.  `tag.default-time-retained` stamps an expiry on
+every auto tag, `tag.create-success-file` drops a _SUCCESS marker.
 """
 
 from __future__ import annotations
 
 import datetime
 import re
-from typing import List, Optional
+from typing import List
 
 from paimon_tpu.options import CoreOptions
 
@@ -41,11 +43,10 @@ def _list_tag_names(table) -> List[str]:
 
 
 def _period_millis(options: CoreOptions) -> int:
-    dur = options.options.get_or("tag.creation-period-duration", None)
+    dur = options.get(CoreOptions.TAG_CREATION_PERIOD_DURATION)
     if dur:
-        from paimon_tpu.options import _parse_duration_ms
-        return _parse_duration_ms(dur)
-    period = options.options.get_or("tag.creation-period", "daily")
+        return dur
+    period = options.get(CoreOptions.TAG_CREATION_PERIOD)
     return {"daily": 86_400_000, "hourly": 3_600_000,
             "two-hours": 7_200_000}.get(period, 86_400_000)
 
@@ -58,7 +59,7 @@ def _format_period(start_ms: int, period_ms: int,
         out = dt.strftime("%Y-%m-%d")
     else:
         out = dt.strftime("%Y-%m-%d %H")
-    if formatter == "without_dashes":
+    if formatter.startswith("without_dashes"):
         out = out.replace("-", "").replace(" ", "")
     return out
 
@@ -81,26 +82,46 @@ def maybe_create_tags(table) -> List[str]:
     else:                                 # process-time
         now_ms = snapshot.time_millis
     period_ms = _period_millis(options)
-    from paimon_tpu.options import _parse_duration_ms
-    delay_raw = options.options.get_or("tag.creation-delay", None)
-    delay_ms = _parse_duration_ms(delay_raw) if delay_raw else 0
-    formatter = options.options.get_or("tag.period-formatter",
-                                       "with_dashes")
+    delay_ms = options.get(CoreOptions.TAG_CREATION_DELAY)
+    formatter = options.get(CoreOptions.TAG_PERIOD_FORMATTER)
 
     # the latest fully-elapsed period whose (end + delay) has passed
     last_complete = ((now_ms - delay_ms) // period_ms) * period_ms \
         - period_ms
     if last_complete < 0:
         return []
-    name = _format_period(last_complete, period_ms, formatter)
+    periods = [last_complete]
+    if options.get(CoreOptions.TAG_AUTOMATIC_COMPLETION):
+        # backfill every missed period since the newest existing auto
+        # tag (reference TagAutoCreation automatic-completion)
+        existing = {n for n in _list_tag_names(table)
+                    if _AUTO_TAG_RE.match(n)}
+        p = last_complete - period_ms
+        while p >= 0 and \
+                _format_period(p, period_ms, formatter) not in existing:
+            periods.append(p)
+            p -= period_ms
+        periods.reverse()
     created: List[str] = []
-    if not table.tag_manager.tag_exists(name):
+    for start in periods:
+        name = _format_period(start, period_ms, formatter)
+        if table.tag_manager.tag_exists(name):
+            continue
         # ignore_if_exists: two committers racing the same period must
         # both see their DATA commit succeed
-        table.tag_manager.create_tag(snapshot, name,
-                                     ignore_if_exists=True)
+        table.tag_manager.create_tag(
+            snapshot, name, ignore_if_exists=True,
+            time_retained_ms=options.get(
+                CoreOptions.TAG_DEFAULT_TIME_RETAINED))
         created.append(name)
+        if options.get(CoreOptions.TAG_CREATE_SUCCESS_FILE):
+            table.file_io.write_bytes(
+                f"{table.tag_manager.tag_dir}/{name}._SUCCESS", b"",
+                overwrite=True)
+    if created:
         _expire_auto_tags(table, options)
+    if options.get(CoreOptions.TAG_TIME_EXPIRE_ENABLED):
+        table.tag_manager.expire_tags()
     return created
 
 
@@ -108,10 +129,9 @@ def _expire_auto_tags(table, options: CoreOptions):
     """Only tags MATCHING the auto-naming pattern count toward (and are
     removed by) tag.num-retained-max — manual tags are never touched
     (reference TagAutoCreation expires its own tags only)."""
-    retain = options.options.get_or("tag.num-retained-max", None)
+    retain = options.get(CoreOptions.TAG_NUM_RETAINED_MAX)
     if not retain:
         return
-    retain = int(retain)
     auto = [n for n in _list_tag_names(table) if _AUTO_TAG_RE.match(n)]
     while len(auto) > retain:
         table.delete_tag(auto.pop(0))

@@ -434,6 +434,372 @@ class CoreOptions:
         "Append-table buckets bin into splits of about this size")
     SOURCE_SPLIT_OPEN_FILE_COST = ConfigOption(
         "source.split.open-file-cost", parse_memory_size, 4 << 20, "")
+    SCAN_MAX_SPLITS_PER_TASK = ConfigOption(
+        "scan.max-splits-per-task", int, 10,
+        "Cap on files binned into one append-table split")
+
+    # -- data file layout (reference CoreOptions.java:300-420) ---------------
+    DATA_FILE_PREFIX = ConfigOption(
+        "data-file.prefix", str, "data-",
+        "File-name prefix of data files")
+    DATA_FILE_PATH_DIRECTORY = ConfigOption(
+        "data-file.path-directory", str, None,
+        "Subdirectory (under the table path) holding data files; "
+        "None = partition/bucket directories at the table root")
+    FILE_BLOCK_SIZE = ConfigOption(
+        "file.block-size", parse_memory_size, None,
+        "Format block granularity: parquet row-group bytes / orc "
+        "stripe bytes; None = format default")
+    TARGET_FILE_ROW_NUM = ConfigOption(
+        "target-file-row-num", int, None,
+        "Roll data files at this many rows, in addition to "
+        "target-file-size")
+    FILE_COMPRESSION_PER_LEVEL = ConfigOption(
+        "file.compression.per.level", str, None,
+        "Per-LSM-level compression overrides, e.g. '0:lz4,5:zstd' — "
+        "cheap codec for hot L0, dense for settled levels")
+    FILE_SUFFIX_INCLUDE_COMPRESSION = ConfigOption(
+        "file.suffix.include.compression", _parse_bool, False,
+        "Data file extension carries the codec, e.g. '.zstd.parquet'")
+    ASYNC_FILE_WRITE = ConfigOption(
+        "async-file-write", _parse_bool, True,
+        "Encode output files on background threads so file writes "
+        "overlap the next window's merge (streamed compaction)")
+    FILE_READER_ASYNC_THRESHOLD = ConfigOption(
+        "file-reader-async-threshold", parse_memory_size, 10 << 20,
+        "Files above this size decode with readahead prefetch")
+    FILE_OPERATION_THREAD_NUM = ConfigOption(
+        "file-operation.thread-num", int, None,
+        "Threads for bulk file copy/delete maintenance operations")
+    READ_BATCH_SIZE = ConfigOption(
+        "read.batch-size", int, 1024,
+        "Record-batch rows for format readers")
+    WRITE_BATCH_SIZE = ConfigOption(
+        "write.batch-size", int, 1024,
+        "Record-batch rows for format writers")
+    PAGE_SIZE = ConfigOption(
+        "page-size", parse_memory_size, 64 << 10,
+        "Memory page granularity for spill/lookup buffers")
+    CACHE_PAGE_SIZE = ConfigOption(
+        "cache-page-size", parse_memory_size, 64 << 10,
+        "Page granularity of the lookup block cache")
+
+    # -- stats (reference CoreOptions.java:520-560) --------------------------
+    METADATA_STATS_MODE_PER_LEVEL = ConfigOption(
+        "metadata.stats-mode.per.level", str, None,
+        "Per-level stats-mode overrides, e.g. '0:none,5:full' — skip "
+        "stats work for short-lived L0 files")
+    METADATA_STATS_KEEP_FIRST_N_COLUMNS = ConfigOption(
+        "metadata.stats-keep-first-n-columns", int, None,
+        "Collect file stats only for the first N value columns")
+    METADATA_STATS_DENSE_STORE = ConfigOption(
+        "metadata.stats-dense-store", _parse_bool, True,
+        "Store manifest stats densely (skip all-null stats columns)")
+    MANIFEST_DELETE_FILE_DROP_STATS = ConfigOption(
+        "manifest.delete-file-drop-stats", _parse_bool, False,
+        "DELETE manifest entries drop value stats (smaller manifests)")
+    MANIFEST_FULL_COMPACTION_THRESHOLD_SIZE = ConfigOption(
+        "manifest.full-compaction-threshold-size", parse_memory_size,
+        16 << 20,
+        "Full-rewrite manifests once total delta size passes this")
+
+    # -- spill (reference CoreOptions.java:860-930) --------------------------
+    SPILL_COMPRESSION = ConfigOption(
+        "spill-compression", str, "zstd",
+        "Codec for spilled sorted runs (zstd | lz4 | none)")
+    SPILL_COMPRESSION_ZSTD_LEVEL = ConfigOption(
+        "spill-compression.zstd-level", int, 1,
+        "zstd level for spill files (speed matters more than ratio)")
+    SORT_SPILL_BUFFER_SIZE = ConfigOption(
+        "sort-spill-buffer-size", parse_memory_size, 64 << 20,
+        "In-memory rows buffered before a sorted run spills")
+    WRITE_BUFFER_SPILL_MAX_DISK_SIZE = ConfigOption(
+        "write-buffer-spill.max-disk-size", parse_memory_size,
+        9223372036854775807,
+        "Disk budget for spilled write-buffer runs; reaching it forces "
+        "an early flush to L0 instead of more spill")
+    LOCAL_SORT_MAX_NUM_FILE_HANDLES = ConfigOption(
+        "local-sort.max-num-file-handles", int, 128,
+        "Max spilled runs merged at once; more runs first fold into "
+        "one (the reference's external-merge fan-in bound)")
+    WRITE_MAX_WRITERS_TO_SPILL = ConfigOption(
+        "write-max-writers-to-spill", int, 10,
+        "Batch writers beyond this count turn on spill to bound RAM")
+
+    # -- lookup store (reference CoreOptions.java:1740-1860) -----------------
+    LOOKUP_CACHE_MAX_MEMORY_SIZE = ConfigOption(
+        "lookup.cache-max-memory-size", parse_memory_size, 256 << 20,
+        "Block-cache memory bound of the SST lookup store")
+    LOOKUP_CACHE_FILE_RETENTION = ConfigOption(
+        "lookup.cache-file-retention", _parse_duration_ms, 3600000,
+        "Cached lookup SST files expire after this idle time")
+    LOOKUP_CACHE_SPILL_COMPRESSION = ConfigOption(
+        "lookup.cache-spill-compression", str, "zstd",
+        "Codec for lookup SST block files")
+    LOOKUP_CACHE_BLOOM_FILTER_ENABLED = ConfigOption(
+        "lookup.cache.bloom.filter.enabled", _parse_bool, True,
+        "Per-SST bloom filter to skip files on point lookups")
+    LOOKUP_CACHE_BLOOM_FILTER_FPP = ConfigOption(
+        "lookup.cache.bloom.filter.fpp", float, 0.05,
+        "False-positive rate of the lookup SST bloom filter")
+    LOOKUP_CACHE_HIGH_PRIORITY_POOL_RATIO = ConfigOption(
+        "lookup.cache.high-priority-pool-ratio", float, 0.25,
+        "Share of the block cache reserved for index/filter blocks")
+    LOOKUP_HASH_LOAD_FACTOR = ConfigOption(
+        "lookup.hash-load-factor", float, 0.75,
+        "Fill factor of in-memory lookup hash overlays")
+    LOOKUP_MERGE_RECORDS_THRESHOLD = ConfigOption(
+        "lookup.merge-records-threshold", int, 10_000_000,
+        "Row bound per lookup-changelog merge batch")
+    LOOKUP_MERGE_BUFFER_SIZE = ConfigOption(
+        "lookup.merge-buffer-size", parse_memory_size, 256 << 20,
+        "Byte bound per lookup-changelog merge batch")
+    LOOKUP_WAIT = ConfigOption(
+        "lookup-wait", _parse_bool, True,
+        "Commit waits for lookup compaction; False defers it to the "
+        "next compaction cycle")
+
+    # -- scan variants (reference CoreOptions.java:1380-1600) ----------------
+    SCAN_TIMESTAMP = ConfigOption(
+        "scan.timestamp", str, None,
+        "ISO-8601 travel point, e.g. '2026-07-29T12:00:00' "
+        "(scan.timestamp-millis takes precedence)")
+    SCAN_WATERMARK = ConfigOption(
+        "scan.watermark", int, None,
+        "Travel to the first snapshot whose watermark >= this")
+    SCAN_CREATION_TIME_MILLIS = ConfigOption(
+        "scan.creation-time-millis", int, None,
+        "Alias of scan.file-creation-time-millis")
+    SCAN_FILE_CREATION_TIME_MILLIS = ConfigOption(
+        "scan.file-creation-time-millis", int, None,
+        "from-file-creation-time startup: only files created after "
+        "this instant")
+    SCAN_BUCKET = ConfigOption(
+        "scan.bucket", int, None,
+        "Restrict the scan to one bucket (debug / targeted replay)")
+    SCAN_VERSION = ConfigOption(
+        "scan.version", str, None,
+        "Unified travel point: a tag name or a snapshot id")
+    FILE_INDEX_READ_ENABLED = ConfigOption(
+        "file-index.read.enabled", _parse_bool, True,
+        "Evaluate per-file indexes (bloom/bitmap/bsi) during planning; "
+        "False scans every file (index debugging)")
+    BATCH_SCAN_MODE = ConfigOption(
+        "batch-scan-mode", str, "none",
+        "none | postpone: batch reads of postpone-bucket tables")
+    STREAM_SCAN_MODE = ConfigOption(
+        "stream-scan-mode", str, "none",
+        "none | compacted-changes | file-monitor: follow-up source")
+    STREAMING_READ_APPEND_OVERWRITE = ConfigOption(
+        "streaming-read-append-overwrite", _parse_bool, False,
+        "Streaming reads treat OVERWRITE snapshots as appends")
+    CONTINUOUS_DISCOVERY_INTERVAL = ConfigOption(
+        "continuous.discovery-interval", _parse_duration_ms, 10_000,
+        "Streaming source poll interval for new snapshots")
+    SCAN_IGNORE_LOST_FILES = ConfigOption(
+        "scan.ignore-lost-files", _parse_bool, False,
+        "Skip (not fail on) data files missing from storage")
+    INCREMENTAL_BETWEEN_SCAN_MODE = ConfigOption(
+        "incremental-between-scan-mode", str, "auto",
+        "auto | delta | changelog | diff: how incremental-between "
+        "computes the row set")
+    INCREMENTAL_BETWEEN_TIMESTAMP = ConfigOption(
+        "incremental-between-timestamp", str, None,
+        "Incremental read between two commit timestamps 't1,t2'")
+    INCREMENTAL_TO_AUTO_TAG = ConfigOption(
+        "incremental-to-auto-tag", str, None,
+        "Incremental read from the previous auto-tag to this one")
+
+    # -- consumers (reference CoreOptions.java:2060-2100) --------------------
+    CONSUMER_MODE = ConfigOption(
+        "consumer.mode", str, "exactly-once",
+        "exactly-once | at-least-once consumer progress semantics")
+    CONSUMER_CHANGELOG_ONLY = ConfigOption(
+        "consumer.changelog-only", _parse_bool, False,
+        "Consumer protects only changelogs, not snapshots, from expiry")
+
+    # -- commit (reference CoreOptions.java:919-1010) ------------------------
+    COMMIT_TIMEOUT = ConfigOption(
+        "commit.timeout", _parse_duration_ms, None,
+        "Give up CAS retries after this long (None = retries only)")
+    COMMIT_DISCARD_DUPLICATE_FILES = ConfigOption(
+        "commit.discard-duplicate-files", _parse_bool, False,
+        "Filter files already committed by a retried message")
+    DYNAMIC_PARTITION_OVERWRITE = ConfigOption(
+        "dynamic-partition-overwrite", _parse_bool, True,
+        "INSERT OVERWRITE replaces only partitions present in the new "
+        "data; False truncates the whole table")
+
+    # -- changelog (reference CoreOptions.java:640-760) ----------------------
+    CHANGELOG_TIME_RETAINED = ConfigOption(
+        "changelog.time-retained", _parse_duration_ms, None,
+        "Age bound for decoupled changelogs (expire_changelogs)")
+    CHANGELOG_FILE_STATS_MODE = ConfigOption(
+        "changelog-file.stats-mode", str, "none",
+        "Stats collection for changelog files (they are never planned "
+        "against, so 'none' skips the work)")
+    CHANGELOG_ROW_DEDUPLICATE = ConfigOption(
+        "changelog-producer.row-deduplicate", _parse_bool, False,
+        "Suppress -U/+U changelog pairs whose values are identical")
+    CHANGELOG_ROW_DEDUPLICATE_IGNORE_FIELDS = ConfigOption(
+        "changelog-producer.row-deduplicate-ignore-fields", str, None,
+        "Columns ignored by the -U/+U equality check (csv)")
+    DELETE_FORCE_PRODUCE_CHANGELOG = ConfigOption(
+        "delete.force-produce-changelog", _parse_bool, False,
+        "DELETE emits changelog rows even with changelog-producer=none")
+    IGNORE_UPDATE_BEFORE = ConfigOption(
+        "ignore-update-before", _parse_bool, False,
+        "Drop incoming -U rows at write time (they are redundant for "
+        "last-wins merge engines)")
+
+    # -- merge engines (reference CoreOptions.java:1090-1200) ----------------
+    AGGREGATION_REMOVE_RECORD_ON_DELETE = ConfigOption(
+        "aggregation.remove-record-on-delete", _parse_bool, False,
+        "-D on an aggregation table drops the accumulated row")
+    PARTIAL_UPDATE_REMOVE_RECORD_ON_SEQUENCE_GROUP = ConfigOption(
+        "partial-update.remove-record-on-sequence-group", str, None,
+        "-D carrying these sequence-group columns (csv) drops the row")
+
+    # -- dynamic bucket (reference CoreOptions.java:1650-1700) ---------------
+    DYNAMIC_BUCKET_MAX_BUCKETS = ConfigOption(
+        "dynamic-bucket.max-buckets", int, -1,
+        "Upper bound on auto-created buckets (-1 = unbounded)")
+    BUCKET_FUNCTION_TYPE = ConfigOption(
+        "bucket-function.type", str, "default",
+        "default (murmur-style hash) | mod (int key modulo — keeps "
+        "numeric locality, reference BucketFunctionType.MOD)")
+    BUCKET_APPEND_ORDERED = ConfigOption(
+        "bucket-append-ordered", _parse_bool, True,
+        "Fixed-bucket append tables keep per-bucket write order")
+
+    # -- cross-partition upsert (reference CoreOptions.java:1930) ------------
+    CROSS_PARTITION_UPSERT_INDEX_TTL = ConfigOption(
+        "cross-partition-upsert.index-ttl", _parse_duration_ms, None,
+        "Drop global-index entries idle past this (bounds index size; "
+        "late rows for dropped keys create new partitions)")
+    CROSS_PARTITION_UPSERT_BOOTSTRAP_PARALLELISM = ConfigOption(
+        "cross-partition-upsert.bootstrap-parallelism", int, 10,
+        "Parallel readers bootstrapping the cross-partition index")
+
+    # -- deletion vectors (reference CoreOptions.java:2330-2380) -------------
+    DELETION_VECTORS_BITMAP64 = ConfigOption(
+        "deletion-vectors.bitmap64", _parse_bool, False,
+        "64-bit roaring containers for DVs over files >2^32 rows")
+    DELETION_VECTOR_INDEX_FILE_TARGET_SIZE = ConfigOption(
+        "deletion-vector.index-file.target-size", parse_memory_size,
+        2 << 20, "Roll DV index files at this size")
+
+    # -- tags (reference CoreOptions.java:2400-2520) -------------------------
+    TAG_CREATION_PERIOD = ConfigOption(
+        "tag.creation-period", str, "daily",
+        "daily | hourly | two-hours: auto-tag period")
+    TAG_CREATION_DELAY = ConfigOption(
+        "tag.creation-delay", _parse_duration_ms, 0,
+        "Wait this long past the period end before tagging")
+    TAG_CREATION_PERIOD_DURATION = ConfigOption(
+        "tag.creation-period-duration", _parse_duration_ms, None,
+        "Custom period length (overrides tag.creation-period)")
+    TAG_PERIOD_FORMATTER = ConfigOption(
+        "tag.period-formatter", str, "with_dashes",
+        "with_dashes | without_dashes[_colons]: auto-tag name format")
+    TAG_NUM_RETAINED_MAX = ConfigOption(
+        "tag.num-retained-max", int, None,
+        "Oldest auto-tags beyond this count are deleted")
+    TAG_DEFAULT_TIME_RETAINED = ConfigOption(
+        "tag.default-time-retained", _parse_duration_ms, None,
+        "Auto/SQL tags expire after this age")
+    TAG_AUTOMATIC_COMPLETION = ConfigOption(
+        "tag.automatic-completion", _parse_bool, False,
+        "Backfill missed periodic tags, not just the newest period")
+    TAG_CREATE_SUCCESS_FILE = ConfigOption(
+        "tag.create-success-file", _parse_bool, False,
+        "Write a _SUCCESS marker next to each auto-tag")
+    TAG_TIME_EXPIRE_ENABLED = ConfigOption(
+        "tag.time-expire-enabled", _parse_bool, False,
+        "Sweep time-retained tags past expiry at auto-tag time")
+
+    # -- snapshot expiry (reference CoreOptions.java:470-520) ----------------
+    SNAPSHOT_EXPIRE_EXECUTION_MODE = ConfigOption(
+        "snapshot.expire.execution-mode", str, "sync",
+        "sync | async: expire inline at commit or on a worker thread")
+    PARTITION_EXPIRATION_STRATEGY = ConfigOption(
+        "partition.expiration-strategy", str, "values-time",
+        "values-time (partition value as timestamp) | update-time "
+        "(last data update)")
+    PARTITION_EXPIRATION_BATCH_SIZE = ConfigOption(
+        "partition.expiration-batch-size", int, 1000,
+        "Partitions dropped per expire commit")
+    END_INPUT_CHECK_PARTITION_EXPIRE = ConfigOption(
+        "end-input.check-partition-expire", _parse_bool, False,
+        "Run partition expiry when a batch/bounded-stream job ends")
+
+    # -- sort compaction (reference CoreOptions.java:2560-2600) --------------
+    SORT_COMPACTION_RANGE_STRATEGY = ConfigOption(
+        "sort-compaction.range-strategy", str, "quantity",
+        "quantity | size: how sort-compaction partitions key ranges")
+    SORT_COMPACTION_LOCAL_SAMPLE_MAGNIFICATION = ConfigOption(
+        "sort-compaction.local-sample.magnification", int, 1000,
+        "Sample count multiplier for range boundary estimation")
+    CLUSTERING_COLUMNS = ConfigOption(
+        "clustering.columns", str, None,
+        "Columns for clustered (z-order/hilbert/order) layout (csv)")
+    CLUSTERING_STRATEGY = ConfigOption(
+        "clustering.strategy", str, "auto",
+        "auto | zorder | hilbert | order: curve for clustering.columns "
+        "(auto: zorder <= 4 columns, hilbert <= 8, else order)")
+    ZORDER_VAR_LENGTH_CONTRIBUTION = ConfigOption(
+        "zorder.var-length-contribution", int, 8,
+        "Prefix bytes a var-length column contributes to the z-curve")
+
+    # -- variant shredding (reference CoreOptions.java:3210-3280) ------------
+    VARIANT_SHREDDING_SCHEMA = ConfigOption(
+        "variant.shreddingSchema", str, None,
+        "Explicit shredding paths per variant column, "
+        "'col:$.a,$.b;col2:$.x'")
+    VARIANT_INFER_SHREDDING_SCHEMA = ConfigOption(
+        "variant.inferShreddingSchema", _parse_bool, False,
+        "Infer shredded columns from a buffered row sample")
+    VARIANT_SHREDDING_MAX_INFER_BUFFER_ROW = ConfigOption(
+        "variant.shredding.maxInferBufferRow", int, 1000,
+        "Rows sampled for shredding-schema inference")
+    VARIANT_SHREDDING_MAX_SCHEMA_DEPTH = ConfigOption(
+        "variant.shredding.maxSchemaDepth", int, 5,
+        "Max nesting depth of inferred shredded paths")
+    VARIANT_SHREDDING_MAX_SCHEMA_WIDTH = ConfigOption(
+        "variant.shredding.maxSchemaWidth", int, 50,
+        "Max inferred shredded paths per variant column")
+    VARIANT_SHREDDING_MIN_FIELD_CARDINALITY_RATIO = ConfigOption(
+        "variant.shredding.minFieldCardinalityRatio", float, 0.5,
+        "A path must appear in at least this share of sampled rows")
+
+    # -- global index (reference CoreOptions.java:3010-3120) -----------------
+    GLOBAL_INDEX_ENABLED = ConfigOption(
+        "global-index.enabled", _parse_bool, False,
+        "Maintain the persisted sorted key->row-id global index at "
+        "commit time (else built lazily on first use)")
+    GLOBAL_INDEX_ROW_COUNT_PER_SHARD = ConfigOption(
+        "global-index.row-count-per-shard", int, 10_000_000,
+        "Shard bound of a global index build")
+    GLOBAL_INDEX_BUILD_MAX_PARALLELISM = ConfigOption(
+        "global-index.build.max-parallelism", int, 8,
+        "Parallel shard builders for a global index build")
+    GLOBAL_INDEX_SEARCH_MODE = ConfigOption(
+        "global-index.search-mode", str, "auto",
+        "auto | memory | sst: where point lookups probe the index")
+
+    # -- blobs (reference CoreOptions.java:3300-3400) ------------------------
+    BLOB_FIELD = ConfigOption(
+        "blob-field", str, None,
+        "Column stored as .blob sidecar files (auto-detected from the "
+        "BLOB type when unset)")
+    BLOB_TARGET_FILE_SIZE = ConfigOption(
+        "blob.target-file-size", parse_memory_size, None,
+        "Roll blob sidecar files at this size (default: "
+        "target-file-size)")
+    BLOB_AS_DESCRIPTOR = ConfigOption(
+        "blob-as-descriptor", _parse_bool, False,
+        "Reads return blob descriptors (uri, offset, length) instead "
+        "of materialized bytes")
 
     def __init__(self, options):
         if isinstance(options, dict):
@@ -484,9 +850,59 @@ class CoreOptions:
     def format_options(self):
         """Raw format-writer tuning options, forwarded to the format SPI
         (reference FileFormat factories receive the full options and
-        read their own prefix, e.g. parquet.enable.dictionary)."""
-        return {k: v for k, v in self.options._map.items()
-                if k.startswith(("parquet.", "orc.", "avro."))}
+        read their own prefix, e.g. parquet.enable.dictionary).
+        file.block-size rides along as the cross-format block/stripe
+        granularity."""
+        out = {k: v for k, v in self.options._map.items()
+               if k.startswith(("parquet.", "orc.", "avro."))}
+        bs = self.options.get(CoreOptions.FILE_BLOCK_SIZE)
+        if bs is not None:
+            out["file.block-size"] = str(bs)
+        return out
+
+    @property
+    def file_compression_per_level(self):
+        """{level: codec} overrides (reference
+        CoreOptions.fileCompressionPerLevel)."""
+        v = self.options.get(CoreOptions.FILE_COMPRESSION_PER_LEVEL)
+        out = {}
+        if v:
+            for part in v.split(","):
+                lvl, sep, codec = part.partition(":")
+                if not sep or not codec.strip() or not lvl.strip():
+                    raise ValueError(
+                        f"file.compression.per.level entry {part!r} "
+                        f"must be '<level>:<codec>'")
+                out[int(lvl.strip())] = codec.strip().lower()
+        return out
+
+    @property
+    def stats_mode_per_level(self):
+        """{level: stats-mode} overrides (reference
+        CoreOptions.statsModePerLevel)."""
+        v = self.options.get(CoreOptions.METADATA_STATS_MODE_PER_LEVEL)
+        out = {}
+        if v:
+            for part in v.split(","):
+                lvl, sep, mode = part.partition(":")
+                if not sep or not mode.strip() or not lvl.strip():
+                    raise ValueError(
+                        f"metadata.stats-mode.per.level entry {part!r} "
+                        f"must be '<level>:<mode>'")
+                out[int(lvl.strip())] = mode.strip().lower()
+        return out
+
+    def kv_writer_kwargs(self) -> Dict[str, Any]:
+        """The per-level / stats / rolling tuning shared by every
+        KeyValueFileWriter construction site."""
+        return {
+            "compression_per_level": self.file_compression_per_level,
+            "target_file_row_num": self.options.get(
+                CoreOptions.TARGET_FILE_ROW_NUM),
+            "stats_mode_per_level": self.stats_mode_per_level,
+            "stats_keep_first_n": self.options.get(
+                CoreOptions.METADATA_STATS_KEEP_FIRST_N_COLUMNS),
+        }
 
     @property
     def file_compression(self) -> str:

@@ -189,7 +189,8 @@ def compact_table_sharded(table, mesh=None,
         index_spec=table.options.file_index_spec,
         bloom_fpp=table.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
         format_per_level=table.options.file_format_per_level,
-        format_options=table.options.format_options)
+        format_options=table.options.format_options,
+        **table.options.kv_writer_kwargs())
     max_level = table.options.max_level
     messages = []
     out_rows = 0

@@ -29,15 +29,54 @@ class TagManager:
         return f"{self.tag_dir}/{TAG_PREFIX}{name}"
 
     def create_tag(self, snapshot: Snapshot, name: str,
-                   ignore_if_exists: bool = False):
+                   ignore_if_exists: bool = False,
+                   time_retained_ms=None):
+        """`time_retained_ms`: the tag self-expires after this age
+        (reference tag/Tag.java tagCreateTime + tagTimeRetained; the
+        expiry sweep is `expire_tags`)."""
         if self.tag_exists(name):
             if ignore_if_exists:
                 return
             raise ValueError(f"Tag {name!r} already exists")
+        payload = snapshot.to_json()
+        if time_retained_ms is not None:
+            import json as _json
+            import time as _time
+            d = _json.loads(payload)
+            d["tagCreateTime"] = int(_time.time() * 1000)
+            d["tagTimeRetained"] = int(time_retained_ms)
+            payload = _json.dumps(d)
         ok = self.file_io.try_to_write_atomic(
-            self.tag_path(name), snapshot.to_json().encode("utf-8"))
+            self.tag_path(name), payload.encode("utf-8"))
         if not ok:
             raise ValueError(f"Tag {name!r} already exists")
+
+    def expire_tags(self, now_ms=None) -> list:
+        """Delete tags whose tagCreateTime + tagTimeRetained has
+        passed; returns the names removed (reference
+        TagTimeExpire.java)."""
+        import json as _json
+        import time as _time
+        now_ms = now_ms if now_ms is not None else int(_time.time()
+                                                       * 1000)
+        removed = []
+        for st in self.file_io.list_status(self.tag_dir):
+            fname = st.path.rstrip("/").split("/")[-1]
+            if not fname.startswith(TAG_PREFIX):
+                continue
+            name = fname[len(TAG_PREFIX):]
+            try:
+                d = _json.loads(self.file_io.read_utf8(
+                    self.tag_path(name)))
+            except (FileNotFoundError, OSError, ValueError):
+                continue
+            created = d.get("tagCreateTime")
+            retained = d.get("tagTimeRetained")
+            if created is not None and retained is not None and \
+                    created + retained <= now_ms:
+                self.delete_tag(name)
+                removed.append(name)
+        return removed
 
     def delete_tag(self, name: str):
         self.file_io.delete_quietly(self.tag_path(name))

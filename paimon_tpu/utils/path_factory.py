@@ -22,17 +22,39 @@ class FileStorePathFactory:
     def __init__(self, table_path: str, partition_keys: Sequence[str],
                  default_partition_name: str = DEFAULT_PARTITION_NAME,
                  data_file_prefix: str = "data-",
-                 changelog_file_prefix: str = "changelog-"):
+                 changelog_file_prefix: str = "changelog-",
+                 data_file_dir: str = None):
         self.table_path = table_path.rstrip("/")
         self.partition_keys = list(partition_keys)
         self.default_partition_name = default_partition_name
         self.data_file_prefix = data_file_prefix
         self.changelog_file_prefix = changelog_file_prefix
+        # data-file.path-directory: data files live under this subdir
+        # of the table path (metadata stays at the root)
+        self.data_file_dir = (data_file_dir or "").strip("/") or None
         self._write_uuid = str(uuid.uuid4())
         # itertools.count.__next__ is atomic under the GIL:
         # file-name allocation is shared by concurrent writer
         # threads (streamed compaction's flush pool)
         self._counter = itertools.count()
+
+    @classmethod
+    def from_options(cls, table_path: str, partition_keys: Sequence[str],
+                     options) -> "FileStorePathFactory":
+        """Construct honoring partition.default-name, data-file.prefix,
+        changelog-file.prefix and data-file.path-directory — the single
+        builder every store plane uses so the layout options apply
+        consistently (reference FileStorePathFactory construction in
+        AbstractFileStore)."""
+        from paimon_tpu.options import CoreOptions
+        return cls(
+            table_path, partition_keys,
+            options.get(CoreOptions.PARTITION_DEFAULT_NAME),
+            data_file_prefix=options.get(CoreOptions.DATA_FILE_PREFIX),
+            changelog_file_prefix=options.get(
+                CoreOptions.CHANGELOG_FILE_PREFIX),
+            data_file_dir=options.get(
+                CoreOptions.DATA_FILE_PATH_DIRECTORY))
 
     # -- dirs ----------------------------------------------------------------
 
@@ -76,7 +98,9 @@ class FileStorePathFactory:
 
     def bucket_dir(self, partition: Sequence[Any], bucket: int) -> str:
         pp = self.partition_path(partition)
-        base = f"{self.table_path}/{pp}" if pp else self.table_path
+        root = f"{self.table_path}/{self.data_file_dir}" \
+            if self.data_file_dir else self.table_path
+        base = f"{root}/{pp}" if pp else root
         if bucket == -2:
             # postpone mode (reference BucketMode.POSTPONE_MODE):
             # un-hashed staging dir, rescaled into real buckets later

@@ -1,0 +1,244 @@
+"""Behavior tests for round-4 wired options: spill tuning, tag
+lifecycle, data-file layout, lookup cache, scan variants.
+
+reference: paimon-api/.../CoreOptions.java families.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, IntType, VarCharType
+
+
+def pk_table(tmp_path, name="t", **opts):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", IntType())
+              .primary_key("id")
+              .options({"bucket": "1", **opts})
+              .build())
+    return FileStoreTable.create(str(tmp_path / name), schema)
+
+
+def write_rows(table, ids, vs=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_arrow(pa.table({
+        "id": pa.array(ids, pa.int64()),
+        "v": pa.array(vs if vs is not None else [0] * len(ids),
+                      pa.int32())}))
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+class TestSpillTuning:
+    def _spilling_table(self, tmp_path, **extra):
+        return pk_table(tmp_path, **{
+            "write-buffer-spillable": "true",
+            "write-only": "true",
+            "sort-spill-buffer-size": "64 kb", **extra})
+
+    def _write_wide(self, table, n=8000):
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        rng = np.random.default_rng(0)
+        for lo in range(0, n, 1000):
+            ids = np.arange(lo, lo + 1000, dtype=np.int64)
+            w.write_arrow(pa.table({
+                "id": pa.array(ids),
+                "v": pa.array(rng.integers(0, 99, 1000)
+                              .astype(np.int32))}))
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+
+    def test_small_spill_buffer_still_correct(self, tmp_path):
+        t = self._spilling_table(tmp_path)
+        self._write_wide(t)
+        out = t.to_arrow().sort_by("id")
+        assert out.column("id").to_pylist() == list(range(8000))
+
+    def test_max_file_handles_folds_runs(self, tmp_path):
+        t = self._spilling_table(tmp_path,
+                                 **{"local-sort.max-num-file-handles":
+                                    "2"})
+        self._write_wide(t)
+        out = t.to_arrow().sort_by("id")
+        assert out.column("id").to_pylist() == list(range(8000))
+
+    def test_disk_budget_forces_flush(self, tmp_path):
+        t = self._spilling_table(
+            tmp_path, **{"write-buffer-spill.max-disk-size": "1 kb"})
+        self._write_wide(t)
+        out = t.to_arrow().sort_by("id")
+        assert out.column("id").to_pylist() == list(range(8000))
+
+    def test_spill_compression_none_roundtrips(self, tmp_path):
+        t = self._spilling_table(tmp_path,
+                                 **{"spill-compression": "none"})
+        self._write_wide(t, 3000)
+        assert t.to_arrow().num_rows == 3000
+
+    def test_spill_zstd_level_applies(self, tmp_path):
+        t = self._spilling_table(tmp_path,
+                                 **{"spill-compression.zstd-level": "9"})
+        self._write_wide(t, 3000)
+        assert t.to_arrow().num_rows == 3000
+
+
+class TestTagLifecycle:
+    def _write_at(self, table, ts_ms):
+        """Commit with a forced snapshot time (monkeypatched clock)."""
+        import paimon_tpu.snapshot.snapshot as snap_mod
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_arrow(pa.table({"id": pa.array([ts_ms], pa.int64()),
+                                "v": pa.array([1], pa.int32())}))
+        import unittest.mock as mock
+        with mock.patch("time.time", return_value=ts_ms / 1000):
+            wb.new_commit().commit(w.prepare_commit())
+        w.close()
+
+    DAY = 86_400_000
+
+    def test_automatic_completion_backfills(self, tmp_path):
+        t = pk_table(tmp_path, **{
+            "tag.automatic-creation": "process-time",
+            "tag.automatic-completion": "true"})
+        self._write_at(t, 10 * self.DAY + 3600_000)
+        names = sorted(t.tag_manager.tags())
+        # every elapsed daily period is backfilled, not just the newest
+        assert len(names) >= 5
+        assert "1970-01-10" in names
+
+    def test_num_retained_max_sweeps_oldest(self, tmp_path):
+        t = pk_table(tmp_path, **{
+            "tag.automatic-creation": "process-time",
+            "tag.automatic-completion": "true",
+            "tag.num-retained-max": "3"})
+        self._write_at(t, 10 * self.DAY + 3600_000)
+        auto = sorted(t.tag_manager.tags())
+        assert len(auto) == 3
+        assert auto[-1] == "1970-01-10"
+
+    def test_success_file_written(self, tmp_path):
+        t = pk_table(tmp_path, **{
+            "tag.automatic-creation": "process-time",
+            "tag.create-success-file": "true"})
+        self._write_at(t, 3 * self.DAY + 3600_000)
+        names = sorted(t.tag_manager.tags())
+        assert names
+        marker = f"{t.tag_manager.tag_dir}/{names[-1]}._SUCCESS"
+        assert t.file_io.exists(marker)
+
+    def test_period_formatter_without_dashes(self, tmp_path):
+        t = pk_table(tmp_path, **{
+            "tag.automatic-creation": "process-time",
+            "tag.period-formatter": "without_dashes"})
+        self._write_at(t, 3 * self.DAY + 3600_000)
+        names = sorted(t.tag_manager.tags())
+        assert names and names[-1] == "19700103"
+
+    def test_time_retained_tags_expire(self, tmp_path):
+        t = pk_table(tmp_path)
+        write_rows(t, [1])
+        snap = t.latest_snapshot()
+        t.tag_manager.create_tag(snap, "short", time_retained_ms=1)
+        t.tag_manager.create_tag(snap, "forever")
+        import time
+        time.sleep(0.01)
+        removed = t.tag_manager.expire_tags()
+        assert removed == ["short"]
+        assert "forever" in t.tag_manager.tags()
+
+    def test_default_time_retained_on_auto_tags(self, tmp_path):
+        t = pk_table(tmp_path, **{
+            "tag.automatic-creation": "process-time",
+            "tag.default-time-retained": "1 ms",
+            "tag.time-expire-enabled": "true"})
+        self._write_at(t, 3 * self.DAY + 3600_000)
+        # the auto tag carried a 1ms retention; the next commit's
+        # expire sweep (time-expire-enabled) removes it
+        import time
+        time.sleep(0.01)
+        self._write_at(t, 3 * self.DAY + 7200_000)
+        assert "1970-01-03" not in t.tag_manager.tags()
+
+
+class TestDataFileLayout:
+    def test_data_file_prefix(self, tmp_path):
+        t = pk_table(tmp_path, **{"data-file.prefix": "part-"})
+        write_rows(t, [1, 2, 3])
+        files = [f.file_name for s in
+                 t.new_read_builder().new_scan().plan().splits
+                 for f in s.data_files]
+        assert files and all(f.startswith("part-") for f in files)
+
+    def test_data_file_path_directory(self, tmp_path):
+        t = pk_table(tmp_path, **{"data-file.path-directory": "data"})
+        write_rows(t, [1, 2, 3])
+        base = str(tmp_path / "t" / "data")
+        assert os.path.isdir(base)
+        assert any("bucket-" in d for d in os.listdir(base))
+        assert t.to_arrow().num_rows == 3
+
+    def test_target_file_row_num_rolls(self, tmp_path):
+        t = pk_table(tmp_path, **{"target-file-row-num": "100",
+                                  "write-only": "true"})
+        write_rows(t, list(range(350)))
+        files = [f for s in
+                 t.new_read_builder().new_scan().plan().splits
+                 for f in s.data_files]
+        assert len(files) == 4           # 100+100+100+50
+        assert t.to_arrow().num_rows == 350
+
+    def test_file_block_size_makes_small_row_groups(self, tmp_path):
+        import pyarrow.parquet as pq
+        t = pk_table(tmp_path, **{"file.block-size": "4 kb"})
+        write_rows(t, list(range(5000)),
+                   vs=list(range(5000)))
+        split = t.new_read_builder().new_scan().plan().splits[0]
+        f = split.data_files[0]
+        path = (f"{tmp_path}/t/bucket-1/{f.file_name}"
+                if os.path.exists(f"{tmp_path}/t/bucket-1/{f.file_name}")
+                else f"{tmp_path}/t/bucket-0/{f.file_name}")
+        pf = pq.ParquetFile(path)
+        assert pf.num_row_groups > 1
+
+    def test_compression_per_level(self, tmp_path):
+        import pyarrow.parquet as pq
+        t = pk_table(tmp_path, **{"file.compression.per.level": "0:lz4"})
+        write_rows(t, list(range(100)))
+        split = t.new_read_builder().new_scan().plan().splits[0]
+        f = split.data_files[0]
+        assert f.level == 0
+        for b in ("bucket-0", "bucket-1"):
+            p = f"{tmp_path}/t/{b}/{f.file_name}"
+            if os.path.exists(p):
+                meta = pq.ParquetFile(p).metadata
+                assert meta.row_group(0).column(0).compression \
+                    .lower() == "lz4"
+                return
+        raise AssertionError("data file not found")
+
+    def test_stats_mode_none_per_level(self, tmp_path):
+        t = pk_table(tmp_path, **{"metadata.stats-mode.per.level":
+                                  "0:none"})
+        write_rows(t, [5, 6, 7], vs=[50, 60, 70])
+        f = [f for s in t.new_read_builder().new_scan().plan().splits
+             for f in s.data_files][0]
+        # value stats nulled; reads still work
+        from paimon_tpu.data.binary_row import BinaryRowCodec
+        assert t.to_arrow().num_rows == 3
+
+    def test_stats_keep_first_n(self, tmp_path):
+        t = pk_table(tmp_path, **{"metadata.stats-keep-first-n-columns":
+                                  "1"})
+        write_rows(t, [5, 6], vs=[50, 60])
+        assert t.to_arrow().num_rows == 2
