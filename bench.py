@@ -23,6 +23,7 @@ is always produced.
 import heapq
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -36,31 +37,40 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # cache it across processes so the driver's end-of-round run reuses ours
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
+# Hard wall-clock budget for the WHOLE bench (round-4 lesson: probe
+# retries alone consumed the driver's timeout and the official record
+# became rc=124/null). Every subprocess timeout below is derived from
+# the remaining budget, and a signal watchdog force-emits the banked
+# result shortly before the budget expires.
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+_T_START = time.monotonic()
 
-def probe_platform(retries: int = 3, timeout: int = 600):
-    """Check (in a throwaway subprocess) that the default jax backend
-    initializes and runs one op. Returns its platform name or None.
 
-    Round-3 lesson: ONE flaky probe must never downgrade the round's
-    official number to CPU — retry with backoff, and the caller retries
-    again after the baseline measurement (the tunnel often un-wedges
-    within minutes)."""
+def _remaining() -> float:
+    return _BUDGET_S - (time.monotonic() - _T_START)
+
+
+def probe_platform(timeout: float = 90.0):
+    """Check (in a throwaway, killable subprocess) that the default jax
+    backend initializes and runs one op. Returns its platform name or
+    None. A healthy tunnel answers in ~30s (import + first tiny
+    compile); a wedged one hangs forever — hence the short timeout and
+    NO in-place retries (the orchestrator re-probes later if the first
+    probe fails, after CPU work has banked a result)."""
+    timeout = max(10.0, min(timeout, _remaining() - 10))
     code = ("import jax, jax.numpy as jnp;"
             "jnp.zeros(8).block_until_ready();"
             "print(jax.devices()[0].platform)")
-    for attempt in range(retries):
-        try:
-            proc = subprocess.run([sys.executable, "-c", code],
-                                  capture_output=True, text=True,
-                                  timeout=timeout)
-            if proc.returncode == 0 and proc.stdout.strip():
-                return proc.stdout.strip().splitlines()[-1]
-            sys.stderr.write(f"bench probe attempt {attempt + 1}: rc="
-                             f"{proc.returncode}\n{proc.stderr[-2000:]}\n")
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(f"bench probe attempt {attempt + 1}: timeout\n")
-        if attempt < retries - 1:
-            time.sleep(15 * (attempt + 1))
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1]
+        sys.stderr.write(f"bench probe: rc={proc.returncode}\n"
+                         f"{proc.stderr[-2000:]}\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench probe: timeout after {timeout:.0f}s\n")
     return None
 
 
@@ -277,59 +287,46 @@ def baselines_main():
     print(json.dumps({"heapq": heap, "vectorized": vec}))
 
 
-def measure_baselines(sample_rows, runs):
+def measure_baselines(sample_rows, runs, timeout=480.0):
     """Run baselines_main in a clean CPU subprocess; returns
-    (heapq_rows_per_sec, vectorized_rows_per_sec)."""
+    (heapq_rows_per_sec, vectorized_rows_per_sec) or None on failure."""
     env = dict(os.environ)
     env.update(BENCH_BASELINE_ONLY="1", JAX_PLATFORMS="cpu",
                BENCH_SAMPLE_ROWS=str(sample_rows), BENCH_RUNS=str(runs))
-    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                          env=env, cwd=_REPO, text=True,
-                          capture_output=True, timeout=3600)
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=_REPO, text=True,
+                              capture_output=True,
+                              timeout=max(30.0, timeout))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench baselines ({sample_rows} rows): timeout\n")
+        return None
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr[-4000:])
-        raise RuntimeError("baseline subprocess failed")
+        return None
     j = json.loads(proc.stdout.strip().splitlines()[-1])
     return j["heapq"], j["vectorized"]
 
 
-def main():
-    rows = int(os.environ.get("BENCH_ROWS", "20000000"))
+def child_main():
+    """BENCH_CHILD=1 mode: build the table, warm the kernels, run ONE
+    timed full compaction, and print a child-JSON line. The parent
+    orchestrator decides platform (via JAX_PLATFORMS in our env), scale
+    and timeout, and can kill us without losing its banked result."""
+    rows = int(os.environ["BENCH_CHILD_ROWS"])
     runs = int(os.environ.get("BENCH_RUNS", "10"))
-
-    forced_cpu = os.environ.get("BENCH_FORCED_CPU") == "1"
-    platform = None if forced_cpu else probe_platform()
-
-    # measure the CPU baselines FIRST, in a clean subprocess — by the
-    # time they finish (minutes), a wedged tunnel has often recovered,
-    # so a failed probe gets a second chance before we downgrade
-    sample = min(rows, 2_000_000)
-    heap_base, vec_base = measure_baselines(sample, runs)
-
-    if platform is None and not forced_cpu:
-        sys.stderr.write("bench: first probe failed; retrying after "
-                         "baseline measurement\n")
-        platform = probe_platform(retries=2)
-    if platform is None:
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon plugin's register() forces jax_platforms="axon,cpu"
+        # AFTER the env var is read — reset before any backend init
         import jax
         jax.config.update("jax_platforms", "cpu")
-        platform = "cpu(fallback)" if not forced_cpu else "cpu(forced)"
-    if platform.startswith("cpu") and "BENCH_ROWS" not in os.environ:
-        # CPU fallback: cap the default scale so the run stays inside a
-        # driver timeout; scale is recorded in the JSON unit either way
-        rows = min(rows, 8_000_000)
-    elif not platform.startswith("cpu") and "BENCH_ROWS" not in os.environ:
-        # real accelerator: run the north-star scale (BASELINE.md config 4:
-        # 100M rows / 10 sorted runs); the streamed key-window merge keeps
-        # device memory bounded independent of bucket size
-        rows = 100_000_000
+    import jax
+    platform = jax.devices()[0].platform
 
     with tempfile.TemporaryDirectory() as tmp:
         table = build_table(os.path.join(tmp, "t"), rows, runs)
 
-        # warm up the kernel compile on a tiny merge so compile time does
-        # not pollute the measurement (first XLA compile is one-time)
+        # warm up kernel compiles so the timed run measures steady state
         import pyarrow as pa
 
         from paimon_tpu.ops.merge import merge_runs
@@ -340,8 +337,6 @@ def main():
         })
         merge_runs([warm], ["_KEY_id"])
         if bench_shape() == "config4":
-            # warm the aggregation merge kernels too — the timed
-            # compaction must not absorb their first XLA compile
             wtab = build_table(os.path.join(tmp, "warm_t"), 4096, 2)
             wtab.compact(full=True)
 
@@ -351,52 +346,200 @@ def main():
         sid = table.compact(full=True)
         dt = time.perf_counter() - t0
         assert sid is not None
-        ours = rows / dt
-
-    # link-adaptive observability: which sort path ran, and why
-    path_note = ""
-    if not platform.startswith("cpu"):
         pc = dict(_merge.PATH_COUNTS)
         bw = _merge._LINK_BW
+    print(json.dumps({
+        "rows": rows, "runs": runs, "dt": dt, "platform": platform,
+        "paths": pc, "link": list(bw) if bw else None,
+    }))
+
+
+def run_child(rows, runs, platform_cpu, timeout):
+    """Run child_main in a subprocess; returns its parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(BENCH_CHILD="1", BENCH_CHILD_ROWS=str(rows),
+               BENCH_RUNS=str(runs))
+    if platform_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=_REPO, text=True,
+                              capture_output=True,
+                              timeout=max(30.0, timeout))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench child ({rows} rows, "
+                         f"cpu={platform_cpu}): timeout\n")
+        return None
+    if proc.returncode != 0:
+        sys.stderr.write(f"bench child ({rows} rows, cpu={platform_cpu}) "
+                         f"rc={proc.returncode}:\n{proc.stderr[-4000:]}\n")
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sys.stderr.write(f"bench child: unparseable output\n"
+                         f"{proc.stdout[-2000:]}\n")
+        return None
+
+
+def compose(result, baselines, fallback_note=""):
+    """Build the ONE official JSON line from a child result (or a
+    failure note) + baseline measurements."""
+    if baselines is not None:
+        heap_base, vec_base = baselines
+    else:
+        heap_base = vec_base = None
+    if result is None:
+        note = fallback_note or "no result within budget"
+        return {
+            "metric": "full_compaction_rows_per_sec",
+            "value": 0.0,
+            "unit": f"rows/s (bench failed: {note})",
+            "vs_baseline": 0.0,
+        }
+    ours = result["rows"] / result["dt"]
+    platform = result["platform"]
+    path_note = ""
+    if not platform.startswith("cpu"):
+        pc = result.get("paths") or {}
+        bw = result.get("link")
         link = (f", link h2d={bw[0] / 1e6:.0f}MB/s "
                 f"d2h={bw[1] / 1e6:.0f}MB/s" if bw else "")
-        path_note = (f"; adaptive merge paths host={pc['host']} "
-                     f"device={pc['device']}{link}")
+        path_note = (f"; adaptive merge paths host={pc.get('host', 0)} "
+                     f"device={pc.get('device', 0)}{link}")
     shape_note = ("agg-sum/max, orc-in/parquet-out"
                   if bench_shape() == "config4" else "dedup, parquet")
-    print(json.dumps({
+    base_note = (f"; baseline=vectorized-1T {round(vec_base, 1)} rows/s, "
+                 f"heapq {round(heap_base, 1)} rows/s, "
+                 f"vs_heapq={round(ours / heap_base, 2)}"
+                 if vec_base else "; baseline unavailable")
+    return {
         "metric": "full_compaction_rows_per_sec",
         "value": round(ours, 1),
-        "unit": (f"rows/s ({rows} rows, {runs} runs, {shape_note}, "
-                 f"platform={platform}; baseline=vectorized-1T "
-                 f"{round(vec_base, 1)} rows/s, heapq "
-                 f"{round(heap_base, 1)} rows/s, "
-                 f"vs_heapq={round(ours / heap_base, 2)}{path_note})"),
+        "unit": (f"rows/s ({result['rows']} rows, {result['runs']} runs, "
+                 f"{shape_note}, platform={platform}{base_note}"
+                 f"{path_note})"),
         # honest denominator: the vectorized single-thread CPU program,
         # not the pylist heap merge (VERDICT r3 missing #1 / weak #4)
-        "vs_baseline": round(ours / vec_base, 3),
-    }))
+        "vs_baseline": round(ours / vec_base, 3) if vec_base else 0.0,
+    }
+
+
+# end-to-end wall-clock throughput estimates (build + warm + compact +
+# cleanup), measured in-env, used ONLY to fit the benchmark scale to the
+# remaining budget; the recorded number is always measured, never these
+_CPU_E2E_ROWS_PER_S = 250_000.0   # conservative local CPU measurement
+_TPU_E2E_ROWS_PER_S = 220_000.0   # r03: 100M runs ~ 8-12 min wall
+
+
+def fit_rows(remaining, est_rows_per_s, cap):
+    """Largest benchmark scale whose estimated wall time fits in the
+    remaining budget (with 20% head-room), in clean powers of scale."""
+    budget = remaining * 0.8
+    for rows in (100_000_000, 50_000_000, 30_000_000, 16_000_000,
+                 8_000_000, 4_000_000, 2_000_000, 1_000_000):
+        if rows <= cap and rows / est_rows_per_s <= budget:
+            return rows
+    return 500_000
+
+
+_BANKED = {"json": None}
+
+
+def _emit_and_exit(signum=None, frame=None):
+    j = _BANKED["json"]
+    if j is None:
+        j = compose(None, None, "watchdog fired before any result banked")
+    print(json.dumps(j), flush=True)
+    os._exit(0)
+
+
+def main():
+    """Orchestrator. Invariants (round-4 postmortem):
+    1. ONE JSON line is printed before BENCH_BUDGET_S elapses, period —
+       a signal watchdog force-emits the best banked result.
+    2. The parent process NEVER initializes a jax backend; all tunnel
+       contact happens in killable subprocesses.
+    3. CPU work banks a result before any long TPU attempt unless the
+       first probe already proved the tunnel healthy."""
+    signal.signal(signal.SIGALRM, _emit_and_exit)
+    signal.alarm(max(30, int(_BUDGET_S - 25)))
+
+    runs = int(os.environ.get("BENCH_RUNS", "10"))
+    rows_cap = int(os.environ.get("BENCH_ROWS", "100000000"))
+    forced_cpu = os.environ.get("BENCH_FORCED_CPU") == "1"
+
+    platform = None if forced_cpu else probe_platform(timeout=90)
+    sys.stderr.write(f"bench: probe -> {platform}, "
+                     f"remaining {_remaining():.0f}s\n")
+
+    # baselines: bounded, with a small-sample retry; never fatal
+    sample = min(rows_cap, 2_000_000)
+    baselines = measure_baselines(
+        sample, runs, timeout=min(480.0, _remaining() - 300))
+    if baselines is None:
+        sample = 250_000
+        baselines = measure_baselines(
+            sample, runs, timeout=min(120.0, _remaining() - 180))
+    sys.stderr.write(f"bench: baselines={baselines}, "
+                     f"remaining {_remaining():.0f}s\n")
+
+    result = None
+    if platform and not platform.startswith("cpu"):
+        # healthy tunnel: go straight for the largest fitting TPU run,
+        # reserving 150s for a CPU fallback bank + emit
+        rows = fit_rows(_remaining() - 150, _TPU_E2E_ROWS_PER_S, rows_cap)
+        result = run_child(rows, runs, platform_cpu=False,
+                           timeout=_remaining() - 120)
+        if result is None and rows > 4_000_000 and _remaining() > 360:
+            # one smaller retry — a partial-budget TPU number still
+            # beats a CPU fallback for the round's record
+            result = run_child(4_000_000, runs, platform_cpu=False,
+                               timeout=_remaining() - 120)
+    if result is None:
+        # bank a CPU number (always fits: scale fitted to remaining)
+        rows = fit_rows(_remaining() - 90, _CPU_E2E_ROWS_PER_S,
+                        min(rows_cap, 30_000_000))
+        result = run_child(rows, runs, platform_cpu=True,
+                           timeout=_remaining() - 60)
+        if result is None and _remaining() > 60:
+            # last-ditch small run so the record is never empty
+            result = run_child(1_000_000, runs, platform_cpu=True,
+                               timeout=_remaining() - 20)
+        if result is not None and not forced_cpu:
+            result["platform"] = "cpu(fallback)"
+        elif result is not None:
+            result["platform"] = "cpu(forced)"
+        _BANKED["json"] = compose(result, baselines)
+        # tunnel may have recovered while the CPU bench ran: one more
+        # probe, then a fitted TPU attempt that can only upgrade the bank
+        if (not forced_cpu and platform is None and _remaining() > 420):
+            platform = probe_platform(timeout=min(90, _remaining() - 300))
+            sys.stderr.write(f"bench: re-probe -> {platform}, "
+                             f"remaining {_remaining():.0f}s\n")
+            if platform and not platform.startswith("cpu"):
+                rows = fit_rows(_remaining() - 90, _TPU_E2E_ROWS_PER_S,
+                                rows_cap)
+                tpu_result = run_child(rows, runs, platform_cpu=False,
+                                       timeout=_remaining() - 45)
+                if tpu_result is not None:
+                    result = tpu_result
+
+    _BANKED["json"] = compose(result, baselines,
+                              "all bench children failed")
+    _emit_and_exit()
 
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_BASELINE_ONLY") == "1":
         baselines_main()
         sys.exit(0)
+    if os.environ.get("BENCH_CHILD") == "1":
+        child_main()
+        sys.exit(0)
     try:
         main()
     except Exception:
         import traceback
         traceback.print_exc()
-        if os.environ.get("BENCH_FORCED_CPU") != "1":
-            # whatever went wrong on the accelerator path, still produce a
-            # measured number on CPU in a clean subprocess
-            env = dict(os.environ)
-            env["BENCH_FORCED_CPU"] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                cwd=_REPO, text=True, capture_output=True)
-            sys.stdout.write(proc.stdout)
-            sys.stderr.write(proc.stderr)
-            sys.exit(proc.returncode)
-        sys.exit(1)
+        _emit_and_exit()

@@ -150,9 +150,15 @@ def _partition_entry_schema(schema) -> Tuple[dict, List[str]]:
     return entry, list(schema.partition_keys)
 
 
-def sync_iceberg(table) -> Optional[str]:
+def sync_iceberg(table, committer=None) -> Optional[str]:
     """Export the table's current snapshot as Iceberg v2 metadata.
-    Returns the metadata file path (or None when there is no snapshot)."""
+    Returns the metadata file path (or None when there is no snapshot).
+
+    `committer` (optional, iceberg/rest.py IcebergRestCommitter) also
+    publishes the export to an Iceberg REST catalog after the file
+    metadata is written, passing the PREVIOUS export's snapshot id as
+    the CAS base — reference IcebergCommitCallback +
+    IcebergRestMetadataCommitter.commitMetadata(newPath, basePath)."""
     snapshot = table.snapshot_manager.latest_snapshot()
     if snapshot is None:
         return None
@@ -222,13 +228,18 @@ def sync_iceberg(table) -> Optional[str]:
             "deleted_rows_count": 0,
         }], codec="null"), overwrite=False)
 
-    # next metadata version
+    # next metadata version; remember the previous export's snapshot as
+    # the REST committer's CAS base
     version = 1
+    base_snapshot_id = None
     hint_path = f"{meta_dir}/version-hint.text"
     if fio.exists(hint_path):
         try:
             version = int(fio.read_utf8(hint_path)) + 1
-        except ValueError:
+            prev = json.loads(fio.read_utf8(
+                f"{meta_dir}/v{version - 1}.metadata.json"))
+            base_snapshot_id = prev.get("current-snapshot-id")
+        except (ValueError, OSError, FileNotFoundError):
             pass
     metadata = {
         "format-version": 2,
@@ -263,4 +274,6 @@ def sync_iceberg(table) -> Optional[str]:
     fio.write_bytes(meta_path, json.dumps(metadata, indent=2).encode(),
                     overwrite=True)
     fio.write_bytes(hint_path, str(version).encode(), overwrite=True)
+    if committer is not None:
+        committer.commit_metadata(metadata, base_snapshot_id)
     return meta_path

@@ -173,11 +173,13 @@ class FileStoreTable:
         from paimon_tpu.table.system import load_system_table
         return load_system_table(self, name)
 
-    def sync_iceberg(self) -> Optional[str]:
+    def sync_iceberg(self, committer=None) -> Optional[str]:
         """Export the current snapshot as Iceberg v2 metadata under
-        <table>/metadata/ (reference iceberg/IcebergCommitCallback)."""
+        <table>/metadata/ (reference iceberg/IcebergCommitCallback);
+        `committer` also publishes it to an Iceberg REST catalog
+        (reference IcebergRestMetadataCommitter)."""
         from paimon_tpu.iceberg import sync_iceberg
-        return sync_iceberg(self)
+        return sync_iceberg(self, committer=committer)
 
     def analyze(self, columns: Optional[List[str]] = None) -> Optional[int]:
         """ANALYZE TABLE: compute and persist table/column statistics

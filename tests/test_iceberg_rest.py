@@ -1,0 +1,239 @@
+"""Iceberg REST catalog committer + DLF-style HMAC signed auth.
+
+reference: paimon-iceberg/.../IcebergRestMetadataCommitter.java (+ its
+Test), paimon-api/.../rest/auth/DLFAuthProvider.java +
+DLFDefaultSigner.java + DLFAuthSignatureTest.java.
+"""
+
+import json
+import os
+
+import pytest
+
+from paimon_tpu.catalog.auth import (
+    BearerAuthProvider, DLFAuthProvider, verify_dlf_request,
+)
+from paimon_tpu.iceberg.reader import IcebergTable
+from paimon_tpu.iceberg.rest import (
+    IcebergCommitConflictError, IcebergRESTCatalogServer,
+    IcebergRestClient, IcebergRestCommitter,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+def _make_table(root):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options({"bucket": "1"})
+              .build())
+    return FileStoreTable.create(os.path.join(root, "t"), schema)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    s = IcebergRESTCatalogServer(str(tmp_path / "rest-wh")).start()
+    yield s
+    s.stop()
+
+
+class TestRestCommitter:
+    def test_round_trip_create_then_read(self, tmp_path, server):
+        """export -> REST commit -> independent reader consumes the
+        metadata the REST response points at."""
+        table = _make_table(str(tmp_path))
+        _commit(table, [{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}])
+        table.compact(full=True)
+
+        client = IcebergRestClient(server.uri)
+        committer = IcebergRestCommitter(client, "db", "t")
+        table.sync_iceberg(committer=committer)
+
+        loaded = client.load_table("db", "t")
+        assert loaded is not None
+        # the response's metadata-location is durable JSON on disk
+        meta = json.loads(open(loaded["metadata-location"]).read())
+        assert meta["current-snapshot-id"] == \
+            loaded["metadata"]["current-snapshot-id"]
+        # independent spec-walking reader consumes it
+        it = IcebergTable(meta, table.file_io)
+        got = it.to_arrow().sort_by("id")
+        assert got.column("id").to_pylist() == [1, 2]
+        assert got.column("v").to_pylist() == [1.0, 2.0]
+
+    def test_incremental_commit_cas(self, tmp_path, server):
+        """Second sync commits with assert-ref-snapshot-id on the first
+        export's snapshot — the happy CAS path."""
+        table = _make_table(str(tmp_path))
+        _commit(table, [{"id": 1, "v": 1.0}])
+        table.compact(full=True)
+        client = IcebergRestClient(server.uri)
+        committer = IcebergRestCommitter(client, "db", "t")
+        table.sync_iceberg(committer=committer)
+        first = client.load_table("db", "t")
+
+        _commit(table, [{"id": 2, "v": 2.0}])
+        table.compact(full=True)
+        table.sync_iceberg(committer=committer)
+        second = client.load_table("db", "t")
+        assert second["metadata"]["current-snapshot-id"] > \
+            first["metadata"]["current-snapshot-id"]
+        it = IcebergTable(
+            json.loads(open(second["metadata-location"]).read()),
+            table.file_io)
+        assert sorted(it.to_arrow().column("id").to_pylist()) == [1, 2]
+
+    def test_cas_conflict_raises(self, server):
+        """A commit whose required base no longer matches is refused
+        with 409 -> IcebergCommitConflictError (reference
+        CommitFailedException path)."""
+        client = IcebergRestClient(server.uri)
+        client.create_namespace("db")
+        meta = {"format-version": 2, "table-uuid": "u-1",
+                "location": "/x", "current-snapshot-id": 10,
+                "schemas": [{"schema-id": 0, "fields": []}],
+                "last-column-id": 0, "snapshots": [
+                    {"snapshot-id": 10, "sequence-number": 1}]}
+        client.create_table("db", "t", meta)
+        with pytest.raises(IcebergCommitConflictError):
+            client.commit_table("db", "t", [
+                {"type": "assert-ref-snapshot-id", "ref": "main",
+                 "snapshot-id": 999},
+            ], [{"action": "add-snapshot",
+                 "snapshot": {"snapshot-id": 11,
+                              "sequence-number": 2}}])
+
+    def test_diverged_base_recreates(self, tmp_path, server):
+        """If the catalog diverged from our last export (reference's
+        'incorrect base' branch), the committer drops and recreates."""
+        table = _make_table(str(tmp_path))
+        _commit(table, [{"id": 1, "v": 1.0}])
+        table.compact(full=True)
+        client = IcebergRestClient(server.uri)
+        committer = IcebergRestCommitter(client, "db", "t")
+        table.sync_iceberg(committer=committer)
+
+        # a foreign writer moves main somewhere else
+        client.commit_table("db", "t", [], [
+            {"action": "add-snapshot",
+             "snapshot": {"snapshot-id": 777, "sequence-number": 50}},
+            {"action": "set-snapshot-ref", "ref-name": "main",
+             "type": "branch", "snapshot-id": 777}])
+
+        _commit(table, [{"id": 2, "v": 2.0}])
+        table.compact(full=True)
+        table.sync_iceberg(committer=committer)
+        cur = client.load_table("db", "t")["metadata"]
+        assert cur["current-snapshot-id"] != 777
+        snap_ids = {s["snapshot-id"] for s in cur["snapshots"]}
+        assert 777 not in snap_ids
+
+
+class TestDLFAuth:
+    KEYS = {"akid-1": "secret-1"}
+
+    def test_signature_stable_and_verifies(self):
+        prov = DLFAuthProvider("akid-1", "secret-1", region="r-1",
+                               now_fn=lambda: 1_700_000_000.0)
+        h = prov.auth_headers("POST", "/v1/ns/tables", {"a": "1"},
+                              '{"x":1}')
+        assert h["Authorization"].startswith("DLF4-HMAC-SHA256 ")
+        assert h["x-dlf-content-sha256"] == "UNSIGNED-PAYLOAD"
+        assert "content-md5" in h
+        # deterministic for fixed time + inputs
+        h2 = prov.auth_headers("POST", "/v1/ns/tables", {"a": "1"},
+                               '{"x":1}')
+        assert h == h2
+        assert verify_dlf_request(
+            h, "POST", "/v1/ns/tables", {"a": "1"}, '{"x":1}',
+            self.KEYS, region="r-1",
+            now_fn=lambda: 1_700_000_000.0)
+
+    def test_verify_rejects_tampering(self):
+        now = lambda: 1_700_000_000.0    # noqa: E731
+        prov = DLFAuthProvider("akid-1", "secret-1", region="r-1",
+                               now_fn=now)
+        h = prov.auth_headers("GET", "/v1/t", None, None)
+        ok = dict(kw=1)
+        assert verify_dlf_request(h, "GET", "/v1/t", None, None,
+                                  self.KEYS, region="r-1", now_fn=now)
+        # wrong path
+        assert not verify_dlf_request(h, "GET", "/v1/other", None, None,
+                                      self.KEYS, region="r-1",
+                                      now_fn=now)
+        # wrong method
+        assert not verify_dlf_request(h, "POST", "/v1/t", None, None,
+                                      self.KEYS, region="r-1",
+                                      now_fn=now)
+        # unknown key
+        assert not verify_dlf_request(h, "GET", "/v1/t", None, None,
+                                      {"other": "s"}, region="r-1",
+                                      now_fn=now)
+        # wrong secret
+        assert not verify_dlf_request(h, "GET", "/v1/t", None, None,
+                                      {"akid-1": "bad"}, region="r-1",
+                                      now_fn=now)
+        # stale timestamp (> 15 min skew)
+        assert not verify_dlf_request(h, "GET", "/v1/t", None, None,
+                                      self.KEYS, region="r-1",
+                                      now_fn=lambda: now() + 3600)
+
+    def test_token_loader_rotation(self):
+        tokens = [("akid-1", "secret-1", None),
+                  ("akid-2", "secret-2", "sts-token")]
+        prov = DLFAuthProvider(token_loader=lambda: tokens[0],
+                               region="r-1",
+                               now_fn=lambda: 1_700_000_000.0)
+        h1 = prov.auth_headers("GET", "/v1/t", None, None)
+        assert "Credential=akid-1/" in h1["Authorization"]
+        tokens[0] = tokens[1]
+        h2 = prov.auth_headers("GET", "/v1/t", None, None)
+        assert "Credential=akid-2/" in h2["Authorization"]
+        assert h2["x-dlf-security-token"] == "sts-token"
+        assert verify_dlf_request(
+            h2, "GET", "/v1/t", None, None, {"akid-2": "secret-2"},
+            region="r-1", now_fn=lambda: 1_700_000_000.0)
+
+    def test_signed_rest_server_round_trip(self, tmp_path):
+        """The loopback Iceberg REST server enforces DLF signatures:
+        signed requests pass, unsigned/bearer are 401."""
+        keys = {"akid-1": "secret-1"}
+
+        def check(headers, method, path, body):
+            return verify_dlf_request(headers, method, path, None, body,
+                                      keys, region="r-1")
+
+        s = IcebergRESTCatalogServer(str(tmp_path / "wh"),
+                                     auth_check=check).start()
+        try:
+            signed = IcebergRestClient(
+                s.uri, auth_provider=DLFAuthProvider(
+                    "akid-1", "secret-1", region="r-1"))
+            signed.create_namespace("db")
+            meta = {"format-version": 2, "location": "/x",
+                    "schemas": [{"schema-id": 0, "fields": []}],
+                    "last-column-id": 0, "snapshots": [],
+                    "current-snapshot-id": None}
+            signed.create_table("db", "t", meta)
+            assert signed.load_table("db", "t") is not None
+
+            unsigned = IcebergRestClient(s.uri)
+            with pytest.raises(RuntimeError, match="401"):
+                unsigned.load_table("db", "t")
+            bearer = IcebergRestClient(
+                s.uri, auth_provider=BearerAuthProvider("tok"))
+            with pytest.raises(RuntimeError, match="401"):
+                bearer.load_table("db", "t")
+        finally:
+            s.stop()
