@@ -333,3 +333,206 @@ class StoreOracle:
             self.check_now(f"step {i}: {ctx}")
         self.check_time_travel()
         self.check_changelog_replay()
+
+
+class ConcurrentOracle:
+    """Randomized MULTI-WRITER oracle: N writer threads + a racing
+    compactor, interleaved by the OS scheduler, checked after
+    quiescence (reference ConflictDetection.java +
+    FileStoreCommitImpl.java:756 retry loop; the reference's
+    TestFileStore oracle is single-writer — concurrency there is
+    covered by example tests, here by a seeded random harness).
+
+    Modes, chosen by what the store's semantics actually guarantee
+    under concurrency (sequence numbers are writer-local, restored
+    from the latest snapshot, so overlapping-key dedup interleavings
+    are NOT linearizable by commit order — same as the reference):
+
+    - ``disjoint-dedup``: each writer owns a partition; exact model
+      equality must hold regardless of interleaving.
+    - ``overlap-agg``: all writers hit one shared key space with a
+      commutative aggregation engine (sum/max); the final state is
+      interleaving-independent, so exact equality must hold.
+    - ``overlap-dedup``: shared key space, deduplicate; exact winners
+      are timing-dependent, so the checks are corruption invariants:
+      every surviving row must be bit-identical to SOME batch's write
+      of that key (no torn/mixed rows), no key appears that was never
+      written, and a final full compaction must not change the state.
+
+    In every mode: all successful commits produced distinct contiguous
+    snapshot ids, and any commit failure must be the typed
+    CommitConflictError — anything else is a bug.
+    """
+
+    def __init__(self, path: str, seed: int, mode: str = "disjoint-dedup",
+                 writers: int = 3, bucket: str = "2",
+                 key_space: int = 30):
+        assert mode in ("disjoint-dedup", "overlap-agg", "overlap-dedup")
+        self.path = path
+        self.seed = seed
+        self.mode = mode
+        self.writers = writers
+        self.key_space = key_space
+        engine = "aggregation" if mode == "overlap-agg" else "deduplicate"
+        self.engine = engine
+        opts = {"bucket": bucket, "write-only": "true",
+                "merge-engine": engine}
+        if engine == "aggregation":
+            opts["fields.v1.aggregate-function"] = "sum"
+            opts["fields.v2.aggregate-function"] = "max"
+        b = (Schema.builder()
+             .column("pt", IntType(False))
+             .column("id", BigIntType(False))
+             .column("v1", IntType())
+             .column("v2", DoubleType())
+             .column("name", VarCharType.string_type())
+             .partition_keys("pt"))
+        self.table = FileStoreTable.create(
+            path, b.primary_key("pt", "id").options(opts).build())
+        # (sid, writer_idx, batch) for every SUCCESSFUL write commit;
+        # batch = [(key, vals, kind)]
+        self.commits: List[Tuple[int, int, list]] = []
+        self.conflicts: List[str] = []
+        self.errors: List[BaseException] = []
+
+    # -- writer / compactor bodies -------------------------------------------
+
+    def _writer_body(self, idx: int, ops: int, barrier):
+        rng = random.Random(self.seed * 1000 + idx)
+        table = FileStoreTable.load(self.path)
+        import threading
+        barrier.wait()
+        for _ in range(ops):
+            n = rng.randint(1, 25)
+            batch = []
+            rows, kinds = [], []
+            for _ in range(n):
+                if self.mode == "disjoint-dedup":
+                    pt = idx                        # owned partition
+                else:
+                    pt = rng.randrange(2)           # shared partitions
+                kid = rng.randrange(self.key_space)
+                vals = {
+                    "v1": rng.randrange(1000)
+                    if rng.random() > 0.1 else None,
+                    "v2": round(rng.uniform(0, 100), 6)
+                    if rng.random() > 0.1 else None,
+                    # aggregation's name column uses last_non_null —
+                    # order-dependent — so keep it None in agg mode
+                    "name": None if self.engine == "aggregation"
+                    else rng.choice(["a", "b", "c", None]),
+                }
+                kind = RowKind.DELETE \
+                    if self.engine == "deduplicate" and \
+                    rng.random() < 0.12 else RowKind.INSERT
+                batch.append(((pt, kid), dict(vals), kind))
+                row = {"pt": pt, "id": kid}
+                row.update(vals)
+                rows.append(row)
+                kinds.append(kind)
+            try:
+                wb = table.new_batch_write_builder()
+                w = wb.new_write()
+                w.write_dicts(rows, row_kinds=kinds)
+                sid = wb.new_commit().commit(w.prepare_commit())
+                w.close()
+            except Exception as e:      # noqa: BLE001
+                from paimon_tpu.core.commit import CommitConflictError
+                if isinstance(e, CommitConflictError):
+                    self.conflicts.append(f"writer{idx}: {e}")
+                    continue            # typed abort is acceptable
+                self.errors.append(e)
+                raise
+            if sid is not None:
+                self.commits.append((sid, idx, batch))
+            if rng.random() < 0.2:
+                self._compact_once(table, full=rng.random() < 0.5,
+                                   who=f"writer{idx}")
+
+    def _compact_once(self, table, full: bool, who: str):
+        from paimon_tpu.core.commit import CommitConflictError
+        try:
+            table.compact(full=full)
+        except CommitConflictError as e:
+            self.conflicts.append(f"{who} compact: {e}")
+
+    def _compactor_body(self, rounds: int, barrier):
+        rng = random.Random(self.seed * 7777)
+        table = FileStoreTable.load(self.path)
+        barrier.wait()
+        for _ in range(rounds):
+            self._compact_once(table, full=rng.random() < 0.5,
+                               who="compactor")
+
+    # -- driver + checks -----------------------------------------------------
+
+    def run(self, ops_per_writer: int = 6, compactor_rounds: int = 4):
+        import threading
+        barrier = threading.Barrier(self.writers + 1)
+        threads = [threading.Thread(
+            target=self._writer_body, args=(i, ops_per_writer, barrier))
+            for i in range(self.writers)]
+        threads.append(threading.Thread(
+            target=self._compactor_body, args=(compactor_rounds, barrier)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads), "deadlocked thread"
+        assert not self.errors, f"non-conflict failures: {self.errors!r}"
+        self.check_commit_chain()
+        table = FileStoreTable.load(self.path)
+        if self.mode in ("disjoint-dedup", "overlap-agg"):
+            self.check_exact(table)
+        else:
+            self.check_invariants(table)
+        # quiescent full compaction must preserve the merged state
+        before = sorted(table.to_arrow().to_pylist(),
+                        key=lambda r: (r["pt"], r["id"]))
+        table.compact(full=True)
+        after = sorted(FileStoreTable.load(self.path).to_arrow()
+                       .to_pylist(), key=lambda r: (r["pt"], r["id"]))
+        diff = _rows_equal(after, before)
+        assert diff is None, f"full compaction changed state: {diff}"
+
+    def check_commit_chain(self):
+        sids = [sid for sid, _, _ in self.commits]
+        assert len(sids) == len(set(sids)), "duplicate snapshot ids"
+        sm = self.table.snapshot_manager
+        latest = sm.latest_snapshot()
+        assert latest is not None
+        # every snapshot from 1..latest exists (CAS left no gaps)
+        for sid in range(1, latest.id + 1):
+            assert sm.snapshot(sid) is not None, f"gap at snapshot {sid}"
+
+    def check_exact(self, table):
+        model = OracleModel(self.engine)
+        for sid, _, batch in sorted(self.commits):
+            for key, vals, kind in batch:
+                model.apply(key, vals, kind)
+        actual = sorted(table.to_arrow().to_pylist(),
+                        key=lambda r: (r["pt"], r["id"]))
+        diff = _rows_equal(actual, model.rows())
+        assert diff is None, \
+            f"{self.mode} seed={self.seed}: {diff} " \
+            f"({len(self.commits)} commits, {len(self.conflicts)} " \
+            f"conflicts)"
+
+    def check_invariants(self, table):
+        written: Dict[Tuple, list] = {}
+        deleted: set = set()
+        for _, _, batch in self.commits:
+            for key, vals, kind in batch:
+                if kind == RowKind.DELETE:
+                    deleted.add(key)
+                else:
+                    full = {"v1": vals.get("v1"), "v2": vals.get("v2"),
+                            "name": vals.get("name")}
+                    written.setdefault(key, []).append(full)
+        for row in table.to_arrow().to_pylist():
+            key = (row["pt"], row["id"])
+            got = {"v1": row["v1"], "v2": row["v2"], "name": row["name"]}
+            assert key in written, f"phantom key {key}"
+            assert got in written[key], \
+                f"torn row for {key}: {got} not among " \
+                f"{len(written[key])} written versions"

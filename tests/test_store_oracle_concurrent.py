@@ -1,0 +1,39 @@
+"""Seeded multi-writer randomized sweeps (VERDICT r3 next #7).
+
+reference: operation/commit/ConflictDetection.java,
+FileStoreCommitImpl.java:756 retry loop; TestFileStore.java (the
+single-writer oracle this extends with real thread interleavings).
+
+Env knobs for long mode: ORACLE_CONCURRENT_SEEDS=20 runs more seeds.
+"""
+
+import os
+
+import pytest
+
+from tests.store_oracle import ConcurrentOracle
+
+_SEEDS = int(os.environ.get("ORACLE_CONCURRENT_SEEDS", "3"))
+
+
+@pytest.mark.parametrize("seed", range(_SEEDS))
+class TestConcurrentOracle:
+    def test_disjoint_writers_exact(self, tmp_path, seed):
+        """3 writers on disjoint partitions + racing compactor: exact
+        model equality regardless of interleaving."""
+        ConcurrentOracle(str(tmp_path / "t"), seed=seed,
+                         mode="disjoint-dedup", writers=3).run()
+
+    def test_overlapping_aggregation_exact(self, tmp_path, seed):
+        """3 writers on ONE shared key space with commutative
+        aggregates (sum/max): final state is interleaving-independent,
+        exact equality must hold."""
+        ConcurrentOracle(str(tmp_path / "t"), seed=seed + 100,
+                         mode="overlap-agg", writers=3).run()
+
+    def test_overlapping_dedup_invariants(self, tmp_path, seed):
+        """2 writers + compactor racing on shared keys: winners are
+        timing-dependent, but no torn rows, no phantom keys, and a
+        quiescent full compaction is a no-op on state."""
+        ConcurrentOracle(str(tmp_path / "t"), seed=seed + 200,
+                         mode="overlap-dedup", writers=2).run()
