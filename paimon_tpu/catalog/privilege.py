@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import uuid
 from typing import Dict, List, Optional
 
 from paimon_tpu.catalog.catalog import Catalog, Identifier
@@ -78,7 +79,12 @@ class PrivilegeManager:
                         self.file_io.delete_quietly(lock)
                 except Exception:
                     pass
-            if self.file_io.try_to_write_atomic(lock, b"1"):
+            # the token must be writer-unique: on object stores, an
+            # ambiguous conditional PUT (503 after effect) is resolved
+            # by read-back content equality (RetryingObjectStoreBackend)
+            # — a constant payload would let a loser claim the lock
+            token = uuid.uuid4().hex.encode()
+            if self.file_io.try_to_write_atomic(lock, token):
                 try:
                     state = self._require()
                     fn(state)

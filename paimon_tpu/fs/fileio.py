@@ -103,7 +103,16 @@ class FileIO:
 
     def try_to_write_atomic(self, path: str, data: bytes) -> bool:
         """Atomically publish `data` at `path`; False if target exists.
-        This is the commit CAS primitive (reference FileIO.tryToWriteAtomic)."""
+        This is the commit CAS primitive (reference
+        FileIO.tryToWriteAtomic).
+
+        Contract: `data` must be writer-unique (snapshot JSON embeds
+        commitUser uuid; lock files write a random token). On object
+        stores, an ambiguous conditional PUT — server error after the
+        write landed — is resolved by read-back content equality
+        (RetryingObjectStoreBackend), which requires that byte-equal
+        means same-writer (or that the operation is idempotent so a
+        false positive is harmless)."""
         raise NotImplementedError
 
     def mkdirs(self, path: str) -> bool:

@@ -157,3 +157,114 @@ class TestContractEdges:
         finally:
             stop.set()
             t.join()
+
+
+class TestFaultInjection:
+    """503 storms + ambiguous writes + eventually-consistent LIST
+    (VERDICT r3 weak #8). reference: hadoop-aws-style retry layers
+    under the object-store FileIOs."""
+
+    def _flaky_fio(self, tmp_path, seed, fail_rate=0.15,
+                   ambiguous_rate=0.1, list_lag=2):
+        from paimon_tpu.fs.object_store import (
+            FlakyObjectStoreBackend, RetryingObjectStoreBackend,
+        )
+        inner = LocalObjectStoreBackend(str(tmp_path / f"bkt{seed}"))
+        flaky = FlakyObjectStoreBackend(
+            inner, seed=seed, fail_rate=fail_rate,
+            ambiguous_rate=ambiguous_rate, list_lag=list_lag)
+        return ObjectStoreFileIO(
+            RetryingObjectStoreBackend(flaky)), flaky
+
+    def test_ambiguous_conditional_put_recovered(self, tmp_path):
+        """503 AFTER the conditional PUT landed: a naive retry sees
+        PreconditionFailed from its own write; the retry layer must
+        read back, recognize its bytes, and report success."""
+        from paimon_tpu.fs.object_store import (
+            FlakyObjectStoreBackend, PreconditionFailed,
+            RetryingObjectStoreBackend,
+        )
+        inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+        flaky = FlakyObjectStoreBackend(inner, seed=1,
+                                        ambiguous_rate=1.0)
+        retry = RetryingObjectStoreBackend(flaky)
+        retry.put("snap/1", b"mine", if_none_match=True)   # recovered
+        assert inner.get("snap/1") == b"mine"
+        # a genuine loser (different bytes already there) still fails
+        flaky.ambiguous_rate = 0.0
+        with pytest.raises(PreconditionFailed):
+            retry.put("snap/1", b"other", if_none_match=True)
+
+    def test_503_storm_exhaustion_raises(self, tmp_path):
+        from paimon_tpu.fs.object_store import (
+            FlakyObjectStoreBackend, RetryingObjectStoreBackend,
+            TransientStoreError,
+        )
+        inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+        flaky = FlakyObjectStoreBackend(inner, seed=2, fail_rate=1.0)
+        retry = RetryingObjectStoreBackend(flaky, max_attempts=3)
+        with pytest.raises(TransientStoreError):
+            retry.get("nope")
+
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_lifecycle_survives_storms(self, tmp_path, seed):
+        """Full table lifecycle (writes, delete, compaction, reload)
+        under injected 503s, ambiguous mutations, and lagging LIST:
+        every commit lands exactly once, state stays correct."""
+        fio, flaky = self._flaky_fio(tmp_path, seed)
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .primary_key("id")
+                  .options({"bucket": "2", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create("objfs://wh/db/t", schema,
+                                  file_io=fio)
+
+        def commit(rows, kinds=None):
+            wb = t.new_batch_write_builder()
+            w = wb.new_write()
+            w.write_dicts(rows, row_kinds=kinds)
+            sid = wb.new_commit().commit(w.prepare_commit())
+            w.close()
+            return sid
+
+        commit([{"id": i, "v": float(i)} for i in range(40)])
+        commit([{"id": 7, "v": 77.0}])
+        commit([{"id": 9, "v": 9.0}], kinds=[RowKind.DELETE])
+        assert t.compact(full=True) is not None
+
+        rows = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+        assert len(rows) == 39
+        assert rows[7]["v"] == 77.0
+        assert all(r["id"] != 9 for r in rows)
+        # snapshot chain is gapless despite retried CAS
+        sm = t.snapshot_manager
+        latest = sm.latest_snapshot()
+        for sid in range(1, latest.id + 1):
+            assert sm.snapshot(sid) is not None
+        # faults actually fired (the schedule exercised the machinery)
+        assert flaky.stats["injected"] > 0
+        # reload fresh from the bucket
+        t2 = FileStoreTable.load("objfs://wh/db/t", file_io=fio)
+        assert sorted(t2.to_arrow().to_pylist(),
+                      key=lambda r: r["id"]) == rows
+
+    def test_distinct_payload_racers_single_winner(self, tmp_path):
+        """Two contenders with writer-unique payloads and full
+        ambiguity injection: exactly one owns the key (the code-review
+        regression for the constant-payload lock bug — lock tokens are
+        now uuids, so read-back cannot misattribute ownership)."""
+        from paimon_tpu.fs.object_store import (
+            FlakyObjectStoreBackend, PreconditionFailed,
+            RetryingObjectStoreBackend,
+        )
+        inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+        a = RetryingObjectStoreBackend(
+            FlakyObjectStoreBackend(inner, seed=5, ambiguous_rate=1.0))
+        b = RetryingObjectStoreBackend(
+            FlakyObjectStoreBackend(inner, seed=6, ambiguous_rate=1.0))
+        a.put("lock", b"token-A", if_none_match=True)   # A lands
+        with pytest.raises(PreconditionFailed):
+            b.put("lock", b"token-B", if_none_match=True)
+        assert inner.get("lock") == b"token-A"
