@@ -1442,16 +1442,21 @@ class SQLContext:
                 k=kk,
                 ranker=str(rest[5]) if len(rest) > 5 else "rrf")
         if proc == "create_vector_index":
-            # CALL sys.create_vector_index('db.t', 'col'[, m[, metric]])
-            # builds + persists an IVF-PQ index in the table layout
-            # (reference NativeVectorIndexLoader.java:28 factory)
+            # CALL sys.create_vector_index('db.t', 'col'[, m[, metric
+            #   [, kind]]]) — kind in ivfpq|ivfsq|hnsw — builds +
+            # persists the index in the table layout (reference
+            # NativeVectorIndexLoader.java:28 + IvfHnswSq/Flat
+            # factories)
             from paimon_tpu.vector.ann import PersistedVectorIndex
             p = PersistedVectorIndex(table, str(rest[0]))
+            kind = str(rest[3]) if len(rest) > 3 else "ivfpq"
             idx = p.build(m=int(rest[1]) if len(rest) > 1 else 8,
                           metric=str(rest[2]) if len(rest) > 2
-                          else "l2")
-            return _result([f"ivfpq index built: {len(idx)} vectors, "
-                            f"{idx.memory_bytes()} bytes resident"])
+                          else "l2", kind=kind)
+            mem = (f", {idx.memory_bytes()} bytes resident"
+                   if hasattr(idx, "memory_bytes") else "")
+            return _result([f"{kind} index built: {len(idx)} vectors"
+                            f"{mem}"])
         if proc == "mark_partition_done":
             # reference flink/procedure/MarkPartitionDoneProcedure.java:
             # CALL sys.mark_partition_done('db.t', 'dt=2026-07-29', ...)

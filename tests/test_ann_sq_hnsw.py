@@ -1,0 +1,129 @@
+"""IVF-SQ8 scalar quantization + host HNSW graph.
+
+reference: paimon-vector IvfHnswSqVectorGlobalIndexerFactory.java /
+IvfHnswFlatVectorGlobalIndexerFactory.java (the SQ + HNSW halves of
+the native vector index plane).
+"""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.vector.ann import (
+    BruteForceIndex, HNSWIndex, IVFSQIndex, PersistedVectorIndex,
+)
+from tests.test_ivfpq import clustered, recall_at_k
+
+
+class TestIVFSQ:
+    def test_recall(self):
+        v, rng = clustered(20_000, 64)
+        q = v[rng.integers(0, len(v), 32)] \
+            + 0.01 * rng.normal(size=(32, 64)).astype(np.float32)
+        exact = BruteForceIndex(v, metric="l2").search(q, 10)[1]
+        idx = IVFSQIndex(v, metric="l2", keep_vectors=False)
+        got = idx.search(q, 10, nprobe=12)[1]
+        r = recall_at_k(got, exact, 10)
+        # SQ8 residuals lose far less than PQ: high recall without
+        # refine
+        assert r >= 0.9, f"recall@10 = {r}"
+
+    def test_compression_4x(self):
+        v, _ = clustered(8_000, 64)
+        idx = IVFSQIndex(v, keep_vectors=False)
+        assert idx.memory_bytes() < v.nbytes / 3.5
+
+    def test_refine_rerank(self):
+        v, rng = clustered(10_000, 32)
+        q = v[rng.integers(0, len(v), 16)]
+        exact = BruteForceIndex(v, metric="l2").search(q, 5)[1]
+        idx = IVFSQIndex(v, metric="l2")
+        got = idx.search(q, 5, nprobe=10, refine=50)[1]
+        assert recall_at_k(got, exact, 5) >= 0.95
+
+    def test_cosine(self):
+        v, rng = clustered(5_000, 32)
+        q = v[rng.integers(0, len(v), 8)]
+        exact = BruteForceIndex(v, metric="cosine").search(q, 5)[1]
+        idx = IVFSQIndex(v, metric="cosine")
+        got = idx.search(q, 5, nprobe=10)[1]
+        assert recall_at_k(got, exact, 5) >= 0.85
+
+    def test_state_round_trip(self):
+        v, rng = clustered(3_000, 32)
+        idx = IVFSQIndex(v, keep_vectors=False)
+        meta, arrays = idx.state()
+        assert meta["kind"] == "ivfsq"
+        back = IVFSQIndex.from_state(meta, arrays)
+        q = v[:4]
+        a = idx.search(q, 5, nprobe=6)
+        b = back.search(q, 5, nprobe=6)
+        assert np.array_equal(a[1], b[1])
+        assert np.allclose(a[0], b[0])
+
+
+class TestHNSW:
+    def test_recall(self):
+        v, rng = clustered(5_000, 32)
+        q = v[rng.integers(0, len(v), 20)] \
+            + 0.01 * rng.normal(size=(20, 32)).astype(np.float32)
+        exact = BruteForceIndex(v, metric="l2").search(q, 10)[1]
+        idx = HNSWIndex(v, m=16, ef_construction=80, metric="l2")
+        got = idx.search(q, 10, ef=80)[1]
+        r = recall_at_k(got, exact, 10)
+        assert r >= 0.9, f"recall@10 = {r}"
+
+    def test_exact_hit_on_members(self):
+        # well-separated corpus (clustered() can contain near-duplicate
+        # points where the top-1 is a legitimate tie)
+        rng = np.random.default_rng(3)
+        v = rng.normal(size=(2_000, 16)).astype(np.float32)
+        idx = HNSWIndex(v, metric="l2")
+        scores, ids = idx.search(v[:8], 1, ef=40)
+        assert (ids[:, 0] == np.arange(8)).all(), (ids[:, 0], scores)
+
+    def test_state_round_trip(self):
+        v, rng = clustered(1_500, 16)
+        idx = HNSWIndex(v, metric="l2")
+        meta, arrays = idx.state()
+        back = HNSWIndex.from_state(meta, arrays)
+        q = v[rng.integers(0, len(v), 8)]
+        a = idx.search(q, 5, ef=50)
+        b = back.search(q, 5, ef=50)
+        assert np.array_equal(a[1], b[1])
+
+
+class TestPersistedKinds:
+    @pytest.mark.parametrize("kind", ["ivfsq", "hnsw"])
+    def test_build_persist_load(self, tmp_path, kind):
+        from tests.test_ivfpq import TestPersistedVectorIndex
+        t, v = TestPersistedVectorIndex()._table(tmp_path, n=1_500,
+                                                 d=16)
+        p = PersistedVectorIndex(t, "emb")
+        built = p.build(kind=kind, metric="l2")
+        loaded = p.load()
+        assert loaded is not None
+        assert type(loaded) is type(built)
+        q = v[:4]
+        kw = {"nprobe": 8} if kind == "ivfsq" else {"ef": 50}
+        a = built.search(q, 5, **kw)
+        b = loaded.search(q, 5, **kw)
+        assert np.array_equal(a[1], b[1])
+
+
+class TestMetricEdges:
+    def test_hnsw_rejects_dot(self):
+        v, _ = clustered(100, 8)
+        with pytest.raises(ValueError, match="l2/cosine"):
+            HNSWIndex(v, metric="dot")
+
+    def test_ivfsq_dot_refine_ranks_by_dot(self):
+        rng = np.random.default_rng(9)
+        # varying norms make dot != l2 ordering
+        v = (rng.normal(size=(4_000, 16))
+             * rng.uniform(0.1, 5.0, size=(4_000, 1))) \
+            .astype(np.float32)
+        q = rng.normal(size=(8, 16)).astype(np.float32)
+        exact = BruteForceIndex(v, metric="dot").search(q, 5)[1]
+        idx = IVFSQIndex(v, metric="dot")
+        got = idx.search(q, 5, nprobe=20, refine=400)[1]
+        assert recall_at_k(got, exact, 5) >= 0.8
