@@ -144,8 +144,23 @@ def rescale_postpone(table) -> Optional[int]:
     if not entries:
         return None
 
-    # route rows through a dynamic-bucket writer
-    write_table = table.copy({"bucket": "-1"})
+    # route rows through a dynamic-bucket writer. Sizing precedence
+    # (reference postpone.default-bucket-num /
+    # postpone.target-row-num-per-bucket): explicit postpone.* knobs
+    # win; an explicitly-set dynamic-bucket.* is respected next; else
+    # the postpone defaults (5M rows/bucket, 4 initial) apply
+    from paimon_tpu.options import CoreOptions as _CO
+    overrides = {"bucket": "-1"}
+    raw = table.options.options
+    if raw.contains(_CO.POSTPONE_TARGET_ROW_NUM_PER_BUCKET) or \
+            not raw.contains(_CO.DYNAMIC_BUCKET_TARGET_ROW_NUM):
+        overrides["dynamic-bucket.target-row-num"] = str(
+            table.options.get(_CO.POSTPONE_TARGET_ROW_NUM_PER_BUCKET))
+    if raw.contains(_CO.POSTPONE_DEFAULT_BUCKET_NUM) or \
+            not raw.contains(_CO.DYNAMIC_BUCKET_INITIAL_BUCKETS):
+        overrides["dynamic-bucket.initial-buckets"] = str(
+            table.options.get(_CO.POSTPONE_DEFAULT_BUCKET_NUM))
+    write_table = table.copy(overrides)
     wb = write_table.new_batch_write_builder()
     writer = wb.new_write()
     cache = {table.schema.id: table.schema}
@@ -280,7 +295,8 @@ def _append_compact(table, scan, partition, bucket, files, full,
     )
     from paimon_tpu.manifest import FileSource
 
-    picked = append_compact_plan(files, table.options, full=full)
+    picked = append_compact_plan(files, table.options, full=full,
+                                 dvs=bucket_dvs)
     if not picked:
         return None
     writer = _make_append_writer(table, scan.path_factory)

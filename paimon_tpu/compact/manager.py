@@ -119,7 +119,12 @@ class MergeTreeCompactManager:
             total_size_threshold=options.get(
                 CoreOptions.COMPACTION_TOTAL_SIZE_THRESHOLD),
             file_num_limit=options.get(
-                CoreOptions.COMPACTION_FILE_NUM_LIMIT))
+                CoreOptions.COMPACTION_FILE_NUM_LIMIT),
+            offpeak_hours=(
+                options.get(CoreOptions.COMPACTION_OFFPEAK_START_HOUR),
+                options.get(CoreOptions.COMPACTION_OFFPEAK_END_HOUR)),
+            offpeak_ratio=options.get(
+                CoreOptions.COMPACTION_OFFPEAK_RATIO))
         self.path_factory = FileStorePathFactory.from_options(
             table_path, schema.partition_keys, options)
         self.kv_writer = KeyValueFileWriter(
@@ -148,7 +153,10 @@ class MergeTreeCompactManager:
     def pick(self, full: bool = False) -> Optional[CompactUnit]:
         runs = self.levels.level_sorted_runs()
         if full:
-            return pick_full_compaction(self.options.num_levels, runs)
+            return pick_full_compaction(
+                self.options.num_levels, runs,
+                force_rewrite_all=self.options.get(
+                    CoreOptions.COMPACTION_FORCE_REWRITE_ALL_FILES))
         return self.strategy.pick(self.options.num_levels, runs)
 
     def should_wait_for_compaction(self) -> bool:
@@ -198,7 +206,9 @@ class MergeTreeCompactManager:
         # lookup for any L0 promotion (its keys were never changelog'd),
         # full-compaction when promoting INTO the top level (reference
         # FullChangelogMergeTreeCompactRewriter.upgradeChangelog)
-        if len(files) == 1:
+        force_rewrite = self.options.get(
+            CoreOptions.COMPACTION_FORCE_REWRITE_ALL_FILES)
+        if len(files) == 1 and not force_rewrite:
             f = files[0]
             if f.level == unit.output_level:
                 return CompactResult([], [])

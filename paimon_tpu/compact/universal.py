@@ -37,12 +37,41 @@ class UniversalCompaction:
     def __init__(self, max_size_amp: int = 200, size_ratio: int = 1,
                  num_run_trigger: int = 5,
                  total_size_threshold: Optional[int] = None,
-                 file_num_limit: Optional[int] = None):
+                 file_num_limit: Optional[int] = None,
+                 offpeak_hours: Optional[tuple] = None,
+                 offpeak_ratio: int = 0,
+                 now_hour_fn=None):
         self.max_size_amp = max_size_amp
-        self.size_ratio = size_ratio
+        self._size_ratio = size_ratio
         self.num_run_trigger = num_run_trigger
         self.total_size_threshold = total_size_threshold
         self.file_num_limit = file_num_limit
+        # (start, end) local hours; during the window size_ratio is
+        # replaced by offpeak_ratio (reference UniversalCompaction's
+        # off-peak handling of compaction.offpeak-ratio)
+        self.offpeak_hours = offpeak_hours
+        self.offpeak_ratio = offpeak_ratio
+        self._now_hour_fn = now_hour_fn
+
+    @property
+    def size_ratio(self) -> int:
+        if self.offpeak_hours is not None:
+            start, end = self.offpeak_hours
+            if start >= 0 and end >= 0:
+                if self._now_hour_fn is not None:
+                    hour = self._now_hour_fn()
+                else:
+                    import time
+                    hour = time.localtime().tm_hour
+                in_window = (start <= hour < end) if start <= end else \
+                    (hour >= start or hour < end)   # wraps midnight
+                if in_window:
+                    return max(self.offpeak_ratio, self._size_ratio)
+        return self._size_ratio
+
+    @size_ratio.setter
+    def size_ratio(self, v: int):
+        self._size_ratio = v
 
     def pick(self, num_levels: int,
              runs: List[LevelSortedRun]) -> Optional[CompactUnit]:
@@ -141,13 +170,17 @@ class UniversalCompaction:
 
 
 def pick_full_compaction(num_levels: int,
-                         runs: List[LevelSortedRun]
+                         runs: List[LevelSortedRun],
+                         force_rewrite_all: bool = False
                          ) -> Optional[CompactUnit]:
     """reference CompactStrategy.pickFullCompaction:53: everything to max
-    level; skip if already fully compacted there."""
+    level; skip if already fully compacted there — unless
+    compaction.force-rewrite-all-files demands the rewrite anyway
+    (DV folding / format migration / external-path moves)."""
     max_level = num_levels - 1
     if not runs:
         return None
-    if len(runs) == 1 and runs[0].level == max_level:
+    if len(runs) == 1 and runs[0].level == max_level and \
+            not force_rewrite_all:
         return None
     return CompactUnit.from_runs(max_level, runs)

@@ -364,33 +364,59 @@ class AppendSplitRead:
                                    else -1,
                                    anchor_of(g).min_sequence_number)):
                 anchor = anchor_of(group)
-                if len(group) == 1 and anchor.first_row_id is None:
-                    t = self.read_file(
-                        split, anchor,
-                        wanted=self._value_columns()) \
-                        .select(self._value_columns())
-                    if want_rid:
-                        t = t.append_column(
-                            ROW_ID_COL, pa.nulls(t.num_rows, pa.int64()))
-                else:
-                    t = read_evolution_group(self, split, group, cols)
+                try:
+                    if len(group) == 1 and anchor.first_row_id is None:
+                        t = self.read_file(
+                            split, anchor,
+                            wanted=self._value_columns()) \
+                            .select(self._value_columns())
+                        if want_rid:
+                            t = t.append_column(
+                                ROW_ID_COL,
+                                pa.nulls(t.num_rows, pa.int64()))
+                    else:
+                        t = read_evolution_group(self, split, group, cols)
+                except Exception:
+                    if self.options.get(
+                            CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
+                        # skip the WHOLE group: row positions inside a
+                        # group must stay aligned, partial reads cannot
+                        import warnings
+                        warnings.warn(
+                            f"skipping corrupt evolution group at "
+                            f"{anchor.file_name}", RuntimeWarning)
+                        continue
+                    raise
                 if split.deletion_vectors and \
-                        anchor.file_name in split.deletion_vectors:
+                        anchor.file_name in split.deletion_vectors and \
+                        self.options.get(
+                            CoreOptions.DELETION_VECTORS_MERGE_ON_READ):
                     dv = split.deletion_vectors[anchor.file_name]
                     t = t.filter(pa.array(dv.keep_mask(t.num_rows)))
                 tables.append(t)
         else:
             for meta in sorted(split.data_files,
                                key=lambda f: f.min_sequence_number):
-                t = read_kv_file(self.file_io, self.path_factory,
-                                 split.partition, split.bucket, meta, None,
-                                 None, schema=self.schema,
-                                 schema_manager=self.schema_manager,
-                                 wanted=wanted)
+                try:
+                    t = read_kv_file(self.file_io, self.path_factory,
+                                     split.partition, split.bucket, meta,
+                                     None, None, schema=self.schema,
+                                     schema_manager=self.schema_manager,
+                                     wanted=wanted)
+                except Exception:
+                    if self.options.get(
+                            CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
+                        import warnings
+                        warnings.warn(f"skipping corrupt data file "
+                                      f"{meta.file_name}", RuntimeWarning)
+                        continue
+                    raise
                 t = self._evolve(t, meta.schema_id)
                 keep = self._index_selection(split, meta, t.num_rows)
                 if split.deletion_vectors and \
-                        meta.file_name in split.deletion_vectors:
+                        meta.file_name in split.deletion_vectors and \
+                        self.options.get(
+                            CoreOptions.DELETION_VECTORS_MERGE_ON_READ):
                     dv = split.deletion_vectors[meta.file_name]
                     dv_keep = np.asarray(dv.keep_mask(t.num_rows))
                     keep = dv_keep if keep is None else (keep & dv_keep)
@@ -447,22 +473,42 @@ class AppendCompactResult:
 
 
 def append_compact_plan(files: List[DataFileMeta], options: CoreOptions,
-                        full: bool = False) -> Optional[List[DataFileMeta]]:
+                        full: bool = False,
+                        dvs: Optional[dict] = None
+                        ) -> Optional[List[DataFileMeta]]:
     """Pick the files to rewrite (reference
     BucketedAppendCompactManager.pickCompactBefore: contiguous run of
     small files, oldest first, at least compaction.min.file-num, stopping
-    once the accumulated size reaches the target)."""
-    if len(files) < 2:
+    once the accumulated size reaches the target).
+
+    'Small' = below target-file-size * compaction.small-file-ratio, so
+    outputs that compressed slightly under target are not re-compacted
+    forever; files whose deletion vectors exceed
+    compaction.delete-ratio-threshold count as compactable regardless
+    of size, and are force-picked even alone (reference
+    CoreOptions.COMPACTION_DELETE_RATIO_THRESHOLD)."""
+    if not files or (len(files) < 2 and not dvs):
         return None
     ordered = sorted(files, key=lambda f: f.min_sequence_number)
     if full:
-        return ordered
+        return ordered if len(ordered) > 1 or dvs else None
     target = options.target_file_size
+    small_limit = target * options.get(
+        CoreOptions.COMPACTION_SMALL_FILE_RATIO)
+    del_threshold = options.get(
+        CoreOptions.COMPACTION_DELETE_RATIO_THRESHOLD)
+
+    def delete_heavy(f: DataFileMeta) -> bool:
+        if not dvs or f.file_name not in dvs:
+            return False
+        return dvs[f.file_name].cardinality() > \
+            del_threshold * max(f.row_count, 1)
+
     min_num = options.get(CoreOptions.COMPACTION_MIN_FILE_NUM)
     picked: List[DataFileMeta] = []
     size = 0
     for f in ordered:
-        if f.file_size < target:
+        if f.file_size < small_limit or delete_heavy(f):
             picked.append(f)
             size += f.file_size
             if size >= target and len(picked) >= min_num:
@@ -473,4 +519,16 @@ def append_compact_plan(files: List[DataFileMeta], options: CoreOptions,
             picked, size = [], 0
     if len(picked) >= min_num:
         return picked
+    # delete-heavy files are force-compacted even below min-file-num:
+    # reclaiming dead rows beats file-count heuristics. The pick MUST
+    # stay a contiguous slice of the sequence order — rewriting a
+    # non-adjacent set would emit a file whose sequence range overlaps
+    # the files in between — so take the first maximal run of
+    # consecutive delete-heavy files only.
+    for i, f in enumerate(ordered):
+        if delete_heavy(f):
+            j = i + 1
+            while j < len(ordered) and delete_heavy(ordered[j]):
+                j += 1
+            return ordered[i:j]
     return None

@@ -152,6 +152,8 @@ class KeyHasher:
 
     def _murmur_rows(self, mat: np.ndarray) -> np.ndarray:
         n, row_len = mat.shape
+        if n == 0:
+            return np.empty(0, dtype=np.uint64)
         words = mat[:, :row_len - (row_len % 4)] \
             .reshape(n, -1, 4).view("<u4")[:, :, 0].astype(np.uint64)
         h1 = np.full(n, _SEED, dtype=np.uint64)

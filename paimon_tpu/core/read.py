@@ -192,7 +192,12 @@ class MergeFileSplitRead:
 
     def read_split(self, split: DataSplit) -> pa.Table:
         value_cols = self._value_columns()
+        if self.options.get(CoreOptions.TABLE_READ_SEQUENCE_NUMBER):
+            # expose _SEQUENCE_NUMBER as a metadata column (reference
+            # table-read.sequence-number.enabled)
+            value_cols = value_cols + [SEQ_COL]
         read_cols = self.key_cols + [SEQ_COL, KIND_COL] + value_cols
+        read_cols = list(dict.fromkeys(read_cols))
         if split.raw_convertible:
             out = self._read_raw(split, read_cols, value_cols)
         else:
@@ -218,6 +223,8 @@ class MergeFileSplitRead:
         by_name = {f.name: f for f in self.schema.fields}
         cols = {c: pa.array([], data_type_to_arrow(by_name[c].type))
                 for c in self._value_columns()}
+        if self.options.get(CoreOptions.TABLE_READ_SEQUENCE_NUMBER):
+            cols[SEQ_COL] = pa.array([], pa.int64())
         if streaming:
             cols[ROW_KIND_COL] = pa.array([], pa.int8())
         return pa.table(cols)
@@ -235,14 +242,25 @@ class MergeFileSplitRead:
         return names
 
     def _read_file(self, split: DataSplit, meta: DataFileMeta,
-                   read_cols: List[str]) -> pa.Table:
-        table = read_kv_file(
-            self.file_io, self.path_factory, split.partition, split.bucket,
-            meta, file_format=None, projection=None, schema=self.schema,
-            schema_manager=self.schema_manager, wanted=set(read_cols))
+                   read_cols: List[str]) -> Optional[pa.Table]:
+        try:
+            table = read_kv_file(
+                self.file_io, self.path_factory, split.partition,
+                split.bucket, meta, file_format=None, projection=None,
+                schema=self.schema, schema_manager=self.schema_manager,
+                wanted=set(read_cols))
+        except Exception:
+            if self.options.get(CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
+                # reference scan.ignore-corrupt-files: warn + skip
+                import warnings
+                warnings.warn(f"skipping corrupt data file "
+                              f"{meta.file_name}", RuntimeWarning)
+                return None
+            raise
         table = self._evolve(table, meta.schema_id)
         if split.deletion_vectors and \
-                meta.file_name in split.deletion_vectors:
+                meta.file_name in split.deletion_vectors and \
+                self.options.get(CoreOptions.DELETION_VECTORS_MERGE_ON_READ):
             dv = split.deletion_vectors[meta.file_name]
             mask = dv.keep_mask(table.num_rows)
             table = table.filter(pa.array(mask))
@@ -250,9 +268,12 @@ class MergeFileSplitRead:
 
     def _read_raw(self, split: DataSplit, read_cols: List[str],
                   value_cols: List[str]) -> pa.Table:
-        tables = [self._read_file(split, f, read_cols)
-                  for f in sorted(split.data_files,
-                                  key=lambda f: f.min_key)]
+        tables = [t for t in (self._read_file(split, f, read_cols)
+                              for f in sorted(split.data_files,
+                                              key=lambda f: f.min_key))
+                  if t is not None]
+        if not tables:
+            return self._empty_table(bool(split.for_streaming))
         merged = pa.concat_tables(tables, promote_options="none")
         if split.for_streaming and split.is_delta:
             # changelog consumers observe every row with its kind
@@ -279,10 +300,14 @@ class MergeFileSplitRead:
         runs_meta = assemble_runs(split.data_files)
         runs = []
         for run_files in runs_meta:
-            tables = [self._read_file(split, f, read_cols)
-                      for f in run_files]
+            tables = [t for t in (self._read_file(split, f, read_cols)
+                                  for f in run_files) if t is not None]
+            if not tables:
+                continue                  # whole run corrupt + ignored
             runs.append(pa.concat_tables(tables, promote_options="none")
                         if len(tables) > 1 else tables[0])
+        if not runs:
+            return self._empty_table(bool(split.for_streaming))
         engine = self.options.merge_engine
         seq_fields = self.options.sequence_field or None
         seq_desc = self.options.sequence_field_descending

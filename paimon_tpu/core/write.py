@@ -141,9 +141,24 @@ class _BucketWriter:
 
     def _restore_seq(self) -> int:
         if self.next_seq is None:
+            if not self.parent.options.get(
+                    CoreOptions.KV_SEQUENCE_NUMBER_ENABLED):
+                # key-value.sequence_number.enabled=false: no per-record
+                # sequence restore — all rows carry seq 0 and merge
+                # order falls back to run (commit) order
+                self.next_seq = 0
+                return 0
             self.next_seq = self.parent.restore_max_seq(
                 self.partition, self.bucket) + 1
         return self.next_seq
+
+    def _assign_seq(self, n: int) -> np.ndarray:
+        start = self._restore_seq()
+        if not self.parent.options.get(
+                CoreOptions.KV_SEQUENCE_NUMBER_ENABLED):
+            return np.zeros(n, dtype=np.int64)
+        self.next_seq = start + n
+        return np.arange(start, start + n, dtype=np.int64)
 
     def _sorted_chunk(self) -> Optional[pa.Table]:
         """Drain the in-RAM buffer into one key-sorted KV chunk (the
@@ -156,9 +171,7 @@ class _BucketWriter:
         self.buffers, self.kind_buffers = [], []
         self.buffered_bytes = 0
         n = raw.num_rows
-        start = self._restore_seq()
-        seq = np.arange(start, start + n, dtype=np.int64)
-        self.next_seq = start + n
+        seq = self._assign_seq(n)
 
         schema = self.parent.schema
         kv = build_kv_table(raw, schema, seq, kinds)

@@ -113,6 +113,7 @@ def maybe_create_tags(table) -> List[str]:
             snapshot, name, ignore_if_exists=True,
             time_retained_ms=options.get(
                 CoreOptions.TAG_DEFAULT_TIME_RETAINED))
+        table.fire_tag_callbacks(name, snapshot.id)
         created.append(name)
         if options.get(CoreOptions.TAG_CREATE_SUCCESS_FILE):
             table.file_io.write_bytes(

@@ -40,6 +40,21 @@ def _parse_bool(v) -> bool:
     return str(v).lower() in ("true", "1", "yes")
 
 
+def _validate_enum(v, allowed):
+    s = str(v).upper()
+    if s not in allowed:
+        raise ValueError(f"{v!r} not in {allowed}")
+    return s
+
+
+def _enum(*allowed):
+    """Named enum validator (the name renders in generated docs)."""
+    def validate(v):
+        return _validate_enum(v, allowed)
+    validate.__name__ = "enum[" + "|".join(allowed) + "]"
+    return validate
+
+
 def _parse_duration_ms(v) -> int:
     """'1 s' / '5 min' / '100ms' -> milliseconds."""
     if isinstance(v, (int, float)):
@@ -800,6 +815,129 @@ class CoreOptions:
         "blob-as-descriptor", _parse_bool, False,
         "Reads return blob descriptors (uri, offset, length) instead "
         "of materialized bytes")
+
+    # -- callbacks (reference CoreOptions commit.callbacks /
+    # tag.callbacks + CommitCallback/TagCallback SPIs) -----------------------
+    COMMIT_CALLBACKS = ConfigOption(
+        "commit.callbacks", str, None,
+        "Comma-separated import paths ('pkg.mod:Class') instantiated "
+        "and invoked after every successful commit")
+    COMMIT_CALLBACK_PARAM = ConfigOption(
+        "commit.callback.#.param", str, None,
+        "Constructor parameter for the callback class named '#' "
+        "(template key: substitute the class path)")
+    TAG_CALLBACKS = ConfigOption(
+        "tag.callbacks", str, None,
+        "Comma-separated import paths invoked after tag creation")
+    TAG_CALLBACK_PARAM = ConfigOption(
+        "tag.callback.#.param", str, None,
+        "Constructor parameter for the tag callback named '#'")
+
+    # -- read-side toggles ---------------------------------------------------
+    TABLE_READ_SEQUENCE_NUMBER = ConfigOption(
+        "table-read.sequence-number.enabled", _parse_bool, False,
+        "Expose _SEQUENCE_NUMBER as a metadata column in merge-on-read "
+        "scans")
+    KV_SEQUENCE_NUMBER_ENABLED = ConfigOption(
+        "key-value.sequence_number.enabled", _parse_bool, True,
+        "Maintain per-record sequence numbers in the KV plane (false: "
+        "arrival order within a commit is the only order)")
+    SCAN_IGNORE_CORRUPT_FILES = ConfigOption(
+        "scan.ignore-corrupt-files", _parse_bool, False,
+        "Skip unreadable data files during scans (warn) instead of "
+        "failing the query")
+    DELETION_VECTORS_MERGE_ON_READ = ConfigOption(
+        "deletion-vectors.merge-on-read", _parse_bool, True,
+        "Apply deletion vectors during reads (false: raw rows visible, "
+        "for debugging/audit scans)")
+    PARQUET_ENABLE_DICTIONARY = ConfigOption(
+        "parquet.enable.dictionary", _parse_bool, True,
+        "Dictionary-encode parquet columns (disable for "
+        "high-cardinality data)")
+
+    # -- compaction picking knobs (reference CoreOptions.java
+    # compaction.* family) ---------------------------------------------------
+    COMPACTION_FORCE_REWRITE_ALL_FILES = ConfigOption(
+        "compaction.force-rewrite-all-files", _parse_bool, False,
+        "Full compaction rewrites every file even when the bucket is "
+        "already a single top-level run (forces DV folding / format "
+        "upgrades)")
+    COMPACTION_DELETE_RATIO_THRESHOLD = ConfigOption(
+        "compaction.delete-ratio-threshold", float, 0.2,
+        "Append tables: force-compact a data file once deletion "
+        "vectors mark more than this share of its rows deleted")
+    COMPACTION_SMALL_FILE_RATIO = ConfigOption(
+        "compaction.small-file-ratio", float, 0.7,
+        "Files below target-file-size * this ratio are picked for "
+        "compaction rewriting (avoids re-compacting outputs that "
+        "compressed slightly under target)")
+    COMPACTION_OFFPEAK_START_HOUR = ConfigOption(
+        "compaction.offpeak.start.hour", int, -1,
+        "Start hour (0-23) of the off-peak window; -1 disables")
+    COMPACTION_OFFPEAK_END_HOUR = ConfigOption(
+        "compaction.offpeak.end.hour", int, -1,
+        "End hour (0-23, exclusive) of the off-peak window; -1 "
+        "disables")
+    COMPACTION_OFFPEAK_RATIO = ConfigOption(
+        "compaction.offpeak-ratio", int, 0,
+        "compaction.size-ratio used during off-peak hours (larger = "
+        "more aggressive merges while the cluster is idle)")
+
+    # -- postpone bucket mode (reference postpone.* family) ------------------
+    POSTPONE_DEFAULT_BUCKET_NUM = ConfigOption(
+        "postpone.default-bucket-num", int, 4,
+        "Bucket count chosen when rescale_postpone runs without an "
+        "explicit target")
+    POSTPONE_TARGET_ROW_NUM_PER_BUCKET = ConfigOption(
+        "postpone.target-row-num-per-bucket", int, 5_000_000,
+        "Rows per bucket targeted when sizing the rescale of postponed "
+        "data")
+
+    # -- schema evolution toggles --------------------------------------------
+    ALTER_NULL_TO_NOT_NULL_DISABLED = ConfigOption(
+        "alter-column-null-to-not-null.disabled", _parse_bool, True,
+        "Refuse ALTER that tightens a nullable column to NOT NULL "
+        "(existing nulls would break readers)")
+    DISABLE_EXPLICIT_TYPE_CASTING = ConfigOption(
+        "disable-explicit-type-casting", _parse_bool, False,
+        "Refuse ALTER column-type changes that require a value cast "
+        "(only metadata-compatible widenings allowed)")
+    ADD_COLUMN_BEFORE_PARTITION = ConfigOption(
+        "add-column-before-partition", _parse_bool, False,
+        "New columns are inserted before the partition columns instead "
+        "of appended at the end")
+
+    # -- materialized table metadata (reference CoreOptions.java
+    # materialized-table.* — engine-facing refresh contract carried in
+    # table options; validated here, consumed by engines) --------------------
+    MATERIALIZED_TABLE_DEFINITION_QUERY = ConfigOption(
+        "materialized-table.definition-query", str, None,
+        "The SELECT defining the materialized table's content")
+    MATERIALIZED_TABLE_INTERVAL_FRESHNESS = ConfigOption(
+        "materialized-table.interval-freshness", str, None,
+        "Freshness interval value, e.g. '5'")
+    MATERIALIZED_TABLE_INTERVAL_FRESHNESS_TIME_UNIT = ConfigOption(
+        "materialized-table.interval-freshness.time-unit",
+        _enum("SECOND", "MINUTE", "HOUR", "DAY"),
+        None, "Unit of interval-freshness")
+    MATERIALIZED_TABLE_LOGICAL_REFRESH_MODE = ConfigOption(
+        "materialized-table.logical-refresh-mode",
+        _enum("CONTINUOUS", "FULL", "AUTOMATIC"),
+        None, "Declared refresh mode")
+    MATERIALIZED_TABLE_REFRESH_MODE = ConfigOption(
+        "materialized-table.refresh-mode",
+        _enum("CONTINUOUS", "FULL"),
+        None, "Resolved physical refresh mode")
+    MATERIALIZED_TABLE_REFRESH_STATUS = ConfigOption(
+        "materialized-table.refresh-status",
+        _enum("INITIALIZING", "ACTIVATED", "SUSPENDED"),
+        None, "Refresh pipeline status")
+    MATERIALIZED_TABLE_REFRESH_HANDLER_DESCRIPTION = ConfigOption(
+        "materialized-table.refresh-handler-description", str, None,
+        "Human-readable locator of the refresh job")
+    MATERIALIZED_TABLE_REFRESH_HANDLER_BYTES = ConfigOption(
+        "materialized-table.refresh-handler-bytes", str, None,
+        "Serialized refresh handler (base64)")
 
     def __init__(self, options):
         if isinstance(options, dict):

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from paimon_tpu.fs import FileIO
+from paimon_tpu.options import CoreOptions
 from paimon_tpu.schema.schema import Schema
 from paimon_tpu.schema.table_schema import TableSchema
 from paimon_tpu.types import DataField, DataType
@@ -165,8 +166,15 @@ class SchemaManager:
                     raise ValueError(
                         "Cannot add NOT NULL column to existing table")
                 highest += 1
-                fields.append(DataField(highest, k["name"], k["type"],
-                                        k.get("description")))
+                new_field = DataField(highest, k["name"], k["type"],
+                                      k.get("description"))
+                if _opt(options, CoreOptions.ADD_COLUMN_BEFORE_PARTITION) \
+                        and base.partition_keys:
+                    pos = min(i for i, f in enumerate(fields)
+                              if f.name in base.partition_keys)
+                    fields.insert(pos, new_field)
+                else:
+                    fields.append(new_field)
             elif ch.kind == "drop-column":
                 if k["name"] in base.primary_keys:
                     raise ValueError("Cannot drop primary-key column")
@@ -189,7 +197,10 @@ class SchemaManager:
             elif ch.kind == "update-column-type":
                 i = idx_of(k["name"])
                 f = fields[i]
-                _check_type_evolution(f.type, k["type"])
+                _check_type_evolution(
+                    f.type, k["type"],
+                    allow_explicit=not _opt(
+                        options, CoreOptions.DISABLE_EXPLICIT_TYPE_CASTING))
                 fields[i] = DataField(f.id, f.name, k["type"], f.description,
                                       f.default_value)
             elif ch.kind == "update-column-nullability":
@@ -197,6 +208,14 @@ class SchemaManager:
                 f = fields[i]
                 if k["nullable"] and f.name in base.primary_keys:
                     raise ValueError("Primary-key column must be NOT NULL")
+                if not k["nullable"] and f.type.nullable and _opt(
+                        options, CoreOptions.ALTER_NULL_TO_NOT_NULL_DISABLED):
+                    # existing nulls would break readers (reference
+                    # alter-column-null-to-not-null.disabled, default on)
+                    raise ValueError(
+                        "Tightening a nullable column to NOT NULL is "
+                        "disabled (alter-column-null-to-not-null."
+                        "disabled)")
                 fields[i] = DataField(f.id, f.name,
                                       f.type.copy(k["nullable"]),
                                       f.description, f.default_value)
@@ -207,6 +226,11 @@ class SchemaManager:
 
         return TableSchema(base.id + 1, fields, highest, base.partition_keys,
                            base.primary_keys, options, comment)
+
+
+def _opt(options: dict, option) -> bool:
+    """Typed read of a table option from a raw options dict."""
+    return option.parse(options.get(option.key))
 
 
 _IMMUTABLE_OPTIONS = {"bucket-key", "merge-engine", "sequence.field",
@@ -224,7 +248,8 @@ _NUMERIC_WIDENING = ["TINYINT", "SMALLINT", "INT", "BIGINT", "FLOAT",
                      "DOUBLE"]
 
 
-def _check_type_evolution(old: DataType, new: DataType):
+def _check_type_evolution(old: DataType, new: DataType,
+                          allow_explicit: bool = True):
     if old == new:
         return
     o, n = old.root, new.root
@@ -243,8 +268,11 @@ def _check_type_evolution(old: DataType, new: DataType):
     # beyond implicit widening: the reference permits any update whose
     # explicit cast rule resolves (SchemaManager.java:525
     # DataTypeCasts.supportsCast(..., allowExplicit) +
-    # CastExecutors.resolve != null); our rule matrix is that resolver
-    from paimon_tpu.data.casting import can_cast
-    if can_cast(old, new):
-        return
+    # CastExecutors.resolve != null); our rule matrix is that resolver.
+    # disable-explicit-type-casting restricts evolution to the implicit
+    # widenings above.
+    if allow_explicit:
+        from paimon_tpu.data.casting import can_cast
+        if can_cast(old, new):
+            return
     raise ValueError(f"Unsupported type evolution {old} -> {new}")

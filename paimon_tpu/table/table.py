@@ -263,6 +263,20 @@ class FileStoreTable:
         if snap is None:
             raise ValueError("Table has no snapshot to tag")
         self.tag_manager.create_tag(snap, name)
+        self.fire_tag_callbacks(name, snap.id)
+
+    def fire_tag_callbacks(self, name: str, snapshot_id: int):
+        """Invoke tag.callbacks (also called by auto-tag creation —
+        reference wires TagCallbacks into TagAutoManager too)."""
+        for cb in self._loaded_tag_callbacks():
+            cb.call(self, name, snapshot_id)
+
+    def _loaded_tag_callbacks(self):
+        if not hasattr(self, "_tag_callbacks_cache"):
+            from paimon_tpu.utils.callbacks import load_callbacks
+            self._tag_callbacks_cache = load_callbacks(
+                self.options, "tag.callbacks", "tag.callback.#.param")
+        return self._tag_callbacks_cache
 
     def delete_tag(self, name: str):
         self.tag_manager.delete_tag(name)
@@ -413,6 +427,7 @@ class TableCommit:
             table.file_io, table.path, table.schema, table.options,
             commit_user=commit_user, branch=table.branch)
         self._overwrite = overwrite
+        self._callbacks = None        # loaded lazily, once
 
     def commit(self, messages: Sequence[CommitMessage],
                commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
@@ -454,6 +469,17 @@ class TableCommit:
             # reference TagAutoManager rides the commit callback
             from paimon_tpu.maintenance.tag_auto import maybe_create_tags
             maybe_create_tags(self.table)
+        if sid is not None:
+            # user commit callbacks run post-CAS (reference
+            # CommitCallback via commit.callbacks); loaded once per
+            # TableCommit, not per commit
+            if self._callbacks is None:
+                from paimon_tpu.utils.callbacks import load_callbacks
+                self._callbacks = load_callbacks(
+                    self.table.options, "commit.callbacks",
+                    "commit.callback.#.param")
+            for cb in self._callbacks:
+                cb.call(self.table, sid, messages)
         return sid
 
     def filter_committed(self, identifiers: Sequence[int]) -> List[int]:

@@ -1,0 +1,282 @@
+"""Round-5 options sweep: every option here is tested by BEHAVIOR.
+
+reference: paimon-api/.../CoreOptions.java (317 options) — callbacks,
+read-side toggles, compaction picking knobs, postpone sizing, schema
+evolution toggles, materialized-table metadata.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from paimon_tpu.options import CoreOptions, Options
+from paimon_tpu.schema import Schema, SchemaChange, SchemaManager
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType, VarCharType
+
+class RecordingCallback:
+    """Loaded via commit.callbacks / tag.callbacks import paths. The
+    param is a file path; each call appends a line (file-based because
+    pytest and importlib may import this module under different names,
+    so module globals are not shared with the loaded instance)."""
+
+    def __init__(self, param=None):
+        self.param = param
+
+    def call(self, table, *args):
+        with open(self.param, "a") as f:
+            f.write(repr(args[:2]) + "\n")
+
+
+def _make(tmp, opts=None, pk=True):
+    b = (Schema.builder()
+         .column("id", BigIntType(False))
+         .column("v", DoubleType()))
+    if pk:
+        b = b.primary_key("id")
+    o = {"bucket": "1", "write-only": "true"}
+    o.update(opts or {})
+    return FileStoreTable.create(os.path.join(tmp, "t"),
+                                 b.options(o).build())
+
+
+def _commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows, row_kinds=kinds)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+class TestCallbacks:
+    def test_commit_callback_invoked_with_param(self, tmp_path):
+        log = str(tmp_path / "calls.log")
+        path = "tests.test_wired_options_r5:RecordingCallback"
+        t = _make(str(tmp_path), {
+            "commit.callbacks": path,
+            f"commit.callback.{path}.param": log})
+        sid = _commit(t, [{"id": 1, "v": 1.0}])
+        lines = open(log).read().splitlines()
+        assert len(lines) == 1 and f"({sid}," in lines[0]
+        # empty commit -> no snapshot -> no callback
+        assert _commit(t, []) is None
+        assert len(open(log).read().splitlines()) == 1
+
+    def test_tag_callback(self, tmp_path):
+        log = str(tmp_path / "tags.log")
+        path = "tests.test_wired_options_r5:RecordingCallback"
+        t = _make(str(tmp_path), {
+            "tag.callbacks": path,
+            f"tag.callback.{path}.param": log})
+        _commit(t, [{"id": 1, "v": 1.0}])
+        t.create_tag("rel-1")
+        lines = open(log).read().splitlines()
+        assert len(lines) == 1 and "'rel-1'" in lines[0]
+
+
+class TestReadToggles:
+    def test_sequence_number_column(self, tmp_path):
+        t = _make(str(tmp_path),
+                  {"table-read.sequence-number.enabled": "true"})
+        _commit(t, [{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}])
+        _commit(t, [{"id": 1, "v": 10.0}])
+        got = t.to_arrow().sort_by("id")
+        assert "_SEQUENCE_NUMBER" in got.column_names
+        seqs = dict(zip(got.column("id").to_pylist(),
+                        got.column("_SEQUENCE_NUMBER").to_pylist()))
+        # id=1's surviving row came from the second commit: higher seq
+        assert seqs[1] > seqs[2]
+        # default: no metadata column
+        t2 = t.copy({"table-read.sequence-number.enabled": "false"})
+        assert "_SEQUENCE_NUMBER" not in t2.to_arrow().column_names
+
+    def test_kv_sequence_disabled_uses_run_order(self, tmp_path):
+        t = _make(str(tmp_path), {
+            "key-value.sequence_number.enabled": "false",
+            "table-read.sequence-number.enabled": "true"})
+        _commit(t, [{"id": 1, "v": 1.0}])
+        _commit(t, [{"id": 1, "v": 2.0}])
+        got = t.to_arrow()
+        # all sequences are 0; the LATER run still wins the merge
+        assert got.column("_SEQUENCE_NUMBER").to_pylist() == [0]
+        assert got.column("v").to_pylist() == [2.0]
+
+    def test_ignore_corrupt_files(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": 1.0}])
+        _commit(t, [{"id": 2, "v": 2.0}])
+        # corrupt the newest data file on disk
+        split = t.new_read_builder().new_scan().plan().splits[0]
+        meta = max(split.data_files, key=lambda f: f.min_sequence_number)
+        scan = t.new_scan()
+        fpath = scan.path_factory.data_file_path(
+            (), split.bucket, meta.file_name)
+        with open(fpath, "wb") as f:
+            f.write(b"not a parquet file")
+        with pytest.raises(Exception):
+            t.to_arrow()
+        t2 = t.copy({"scan.ignore-corrupt-files": "true"})
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            got = t2.to_arrow()
+        assert got.column("id").to_pylist() == [1]
+        assert any("corrupt" in str(w.message) for w in caught)
+
+    def test_dv_merge_on_read_toggle(self, tmp_path):
+        from paimon_tpu import predicate as P
+        t = _make(str(tmp_path), {"bucket": "-1",
+                                  "row-tracking.enabled": "true"},
+                  pk=False)
+        _commit(t, [{"id": i, "v": float(i)} for i in range(6)])
+        t.delete_where(P.less_than("id", 2))
+        assert sorted(t.to_arrow().column("id").to_pylist()) == \
+            [2, 3, 4, 5]
+        raw = t.copy({"deletion-vectors.merge-on-read": "false"})
+        assert sorted(raw.to_arrow().column("id").to_pylist()) == \
+            [0, 1, 2, 3, 4, 5]
+
+
+class TestCompactionKnobs:
+    def test_force_rewrite_all_files(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": 1.0}])
+        assert t.compact(full=True) is not None
+        # already one top-level run: default full compact is a no-op
+        assert t.compact(full=True) is None
+        t2 = t.copy({"compaction.force-rewrite-all-files": "true"})
+        assert t2.compact(full=True) is not None
+
+    def test_offpeak_ratio_switches_by_hour(self):
+        from paimon_tpu.compact.universal import UniversalCompaction
+        clock = {"hour": 3}
+        u = UniversalCompaction(size_ratio=1, offpeak_hours=(2, 6),
+                                offpeak_ratio=25,
+                                now_hour_fn=lambda: clock["hour"])
+        assert u.size_ratio == 25
+        clock["hour"] = 12
+        assert u.size_ratio == 1
+        # window wrapping midnight
+        u2 = UniversalCompaction(size_ratio=1, offpeak_hours=(22, 4),
+                                 offpeak_ratio=9,
+                                 now_hour_fn=lambda: 23)
+        assert u2.size_ratio == 9
+
+    def test_small_file_ratio_and_delete_ratio(self):
+        from paimon_tpu.core.append import append_compact_plan
+        from paimon_tpu.manifest import DataFileMeta, SimpleStats
+
+        def meta(name, size, rows, seq):
+            return DataFileMeta(
+                file_name=name, file_size=size, row_count=rows,
+                min_key=b"", max_key=b"", key_stats=SimpleStats.EMPTY,
+                value_stats=SimpleStats.EMPTY,
+                min_sequence_number=seq, max_sequence_number=seq + rows,
+                schema_id=0, level=0)
+
+        target = 128 << 20
+        opts = CoreOptions({"target-file-size": str(target),
+                            "compaction.min.file-num": "2"})
+        # 0.8 * target files are NOT small at ratio 0.7 -> no pick
+        big = [meta(f"f{i}", int(target * 0.8), 100, i * 1000)
+               for i in range(4)]
+        assert append_compact_plan(big, opts) is None
+        # but a 0.5 * target pair IS picked
+        small = [meta(f"s{i}", int(target * 0.5), 100, i * 1000)
+                 for i in range(4)]
+        assert append_compact_plan(small, opts) is not None
+
+        class FakeDV:
+            def __init__(self, n):
+                self.n = n
+
+            def cardinality(self):
+                return self.n
+
+        # one large file with 30% deleted rows: force-picked alone
+        dvs = {"f1": FakeDV(30)}
+        picked = append_compact_plan(big, opts, dvs=dvs)
+        assert picked is not None and \
+            [f.file_name for f in picked] == ["f1"]
+
+
+class TestSchemaToggles:
+    def _table(self, tmp, opts=None):
+        b = (Schema.builder()
+             .column("pt", IntType(False))
+             .column("id", BigIntType(False))
+             .column("v", VarCharType.string_type())
+             .partition_keys("pt")
+             .primary_key("pt", "id"))
+        o = {"bucket": "1"}
+        o.update(opts or {})
+        return FileStoreTable.create(os.path.join(tmp, "s"),
+                                     b.options(o).build())
+
+    def test_null_to_not_null_refused_by_default(self, tmp_path):
+        t = self._table(str(tmp_path))
+        sm = SchemaManager(t.file_io, t.path)
+        with pytest.raises(ValueError, match="NOT NULL"):
+            sm.commit_changes(
+                SchemaChange.update_column_nullability("v", False))
+        t2 = self._table(str(tmp_path / "b"),
+                         {"alter-column-null-to-not-null.disabled":
+                          "false"})
+        sm2 = SchemaManager(t2.file_io, t2.path)
+        ts = sm2.commit_changes(
+            SchemaChange.update_column_nullability("v", False))
+        assert not next(f for f in ts.fields
+                        if f.name == "v").type.nullable
+
+    def test_disable_explicit_casting(self, tmp_path):
+        t = self._table(str(tmp_path))
+        sm = SchemaManager(t.file_io, t.path)
+        # explicit (narrowing) cast allowed by default
+        sm.commit_changes(SchemaChange.update_column_type("v", IntType()))
+        t2 = self._table(str(tmp_path / "b"),
+                         {"disable-explicit-type-casting": "true"})
+        sm2 = SchemaManager(t2.file_io, t2.path)
+        with pytest.raises(ValueError, match="evolution"):
+            sm2.commit_changes(
+                SchemaChange.update_column_type("v", IntType()))
+
+    def test_add_column_before_partition(self, tmp_path):
+        t = self._table(str(tmp_path),
+                        {"add-column-before-partition": "true"})
+        sm = SchemaManager(t.file_io, t.path)
+        ts = sm.commit_changes(SchemaChange.add_column("extra", IntType()))
+        names = [f.name for f in ts.fields]
+        assert names.index("extra") < names.index("pt")
+
+
+class TestMaterializedTableOptions:
+    def test_enum_validation(self):
+        o = Options({"materialized-table.refresh-mode": "continuous"})
+        assert o.get(CoreOptions.MATERIALIZED_TABLE_REFRESH_MODE) == \
+            "CONTINUOUS"
+        bad = Options({"materialized-table.refresh-mode": "sometimes"})
+        with pytest.raises(ValueError):
+            bad.get(CoreOptions.MATERIALIZED_TABLE_REFRESH_MODE)
+        s = Options({"materialized-table.refresh-status": "ACTIVATED"})
+        assert s.get(
+            CoreOptions.MATERIALIZED_TABLE_REFRESH_STATUS) == "ACTIVATED"
+
+
+class TestPostponeKnobs:
+    def test_target_row_num_per_bucket(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .primary_key("id")
+                  .options({"bucket": "-2", "write-only": "true",
+                            "postpone.target-row-num-per-bucket": "100"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "pp"), schema)
+        _commit(t, [{"id": i, "v": float(i)} for i in range(250)])
+        assert t.rescale_postpone() is not None
+        buckets = {s.bucket for s in
+                   t.new_read_builder().new_scan().plan().splits}
+        assert len(buckets) >= 2       # ~100 rows per bucket
+        assert t.to_arrow().num_rows == 250
