@@ -81,7 +81,8 @@ class AppendFileWriter:
                    first_seq: int, file_source: int) -> DataFileMeta:
         fmt = get_format(self.file_format)
         name = self.path_factory.new_data_file_name(fmt.extension)
-        path = self.path_factory.data_file_path(partition, bucket, name)
+        path, external = self.path_factory.new_data_file_location(
+            partition, bucket, name)
         from paimon_tpu.format.blob import blob_column_names
         blob_cols = blob_column_names(self.schema)
         blob_extras: List[str] = []
@@ -121,6 +122,7 @@ class AppendFileWriter:
             file_source=file_source,
             embedded_index=embedded_index,
             extra_files=extra_files + blob_extras,
+            external_path=external,
         )
 
 

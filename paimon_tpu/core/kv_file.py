@@ -93,7 +93,8 @@ class KeyValueFileWriter:
         compression = self.compression_per_level.get(level,
                                                      self.compression)
         name = self.path_factory.new_data_file_name(fmt.extension)
-        path = self.path_factory.data_file_path(partition, bucket, name)
+        path, external = self.path_factory.new_data_file_location(
+            partition, bucket, name)
         from paimon_tpu.format.blob import blob_column_names
         blob_cols = blob_column_names(self.schema)
         blob_extras: List[str] = []
@@ -166,6 +167,7 @@ class KeyValueFileWriter:
             file_source=file_source,
             embedded_index=embedded_index,
             extra_files=extra_files + blob_extras,
+            external_path=external,
         )
 
 
@@ -203,7 +205,8 @@ def write_changelog_file(file_io: FileIO,
 
     fmt = get_format(file_format)
     name = path_factory.new_changelog_file_name(fmt.extension, prefix)
-    path = path_factory.data_file_path(partition, bucket, name)
+    path, external = path_factory.new_data_file_location(
+        partition, bucket, name)
     size = fmt.create_writer(compression, format_options).write(
         file_io, path, table)
     return [DataFileMeta(
@@ -213,7 +216,7 @@ def write_changelog_file(file_io: FileIO,
         value_stats=SimpleStats.EMPTY,
         min_sequence_number=pc.min(table.column(SEQ_COL)).as_py(),
         max_sequence_number=pc.max(table.column(SEQ_COL)).as_py(),
-        schema_id=schema.id, level=0)]
+        schema_id=schema.id, level=0, external_path=external)]
 
 
 def read_kv_file(file_io: FileIO, path_factory: FileStorePathFactory,

@@ -185,7 +185,7 @@ def _write_tracked_file(table, fs_scan, split, chunk, *, row_count,
     cols = stats_cols or [f.name for f in table.schema.fields]
     fmt = get_format(table.options.file_format)
     name = fs_scan.path_factory.new_data_file_name(fmt.extension)
-    path = fs_scan.path_factory.data_file_path(
+    path, external = fs_scan.path_factory.new_data_file_location(
         split.partition, split.bucket, name)
     size = fmt.create_writer(table.options.file_compression,
                              table.options.format_options).write(
@@ -202,7 +202,8 @@ def _write_tracked_file(table, fs_scan, split, chunk, *, row_count,
         file_source=FileSource.APPEND if file_source is None
         else file_source,
         value_stats_cols=stats_cols,
-        first_row_id=first_row_id, write_cols=write_cols)
+        first_row_id=first_row_id, write_cols=write_cols,
+        external_path=external)
     return meta, path
 
 

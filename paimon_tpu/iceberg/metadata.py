@@ -185,8 +185,8 @@ def sync_iceberg(table, committer=None) -> Optional[str]:
         if max_level is not None and e.file.level != max_level:
             continue
         partition = scan._partition_codec.from_bytes(e.partition)
-        path = scan.path_factory.data_file_path(partition, e.bucket,
-                                                e.file.file_name)
+        path = e.file.external_path or scan.path_factory.data_file_path(
+            partition, e.bucket, e.file.file_name)
         fmt = e.file.file_name.rsplit(".", 1)[-1].upper()
         records.append({
             "status": 1,                     # ADDED

@@ -36,7 +36,7 @@ class ExpireResult:
 
 def _snapshot_refs(table, snapshot: Snapshot
                    ) -> Tuple[Set[Tuple], Set[str]]:
-    """(data file refs {(partition_bytes, bucket, file_name)},
+    """(data file refs {(partition_bytes, bucket, file_name, external_path)},
     manifest-plane file names {str}) referenced by one snapshot."""
     from paimon_tpu.manifest import merge_manifest_entries
 
@@ -45,9 +45,10 @@ def _snapshot_refs(table, snapshot: Snapshot
     manifests: Set[str] = set()
 
     def _add_file(e):
-        data.add((e.partition, e.bucket, e.file.file_name))
+        data.add((e.partition, e.bucket, e.file.file_name,
+                  e.file.external_path))
         for extra in e.file.extra_files:
-            data.add((e.partition, e.bucket, extra))
+            data.add((e.partition, e.bucket, extra, None))
 
     def _read_list(list_name):
         entries = []
@@ -83,7 +84,8 @@ def _snapshot_refs(table, snapshot: Snapshot
         manifests.add(snapshot.index_manifest)
         try:
             for e in scan.index_manifest_file.read(snapshot.index_manifest):
-                data.add((e.partition, e.bucket, e.index_file.file_name))
+                data.add((e.partition, e.bucket,
+                          e.index_file.file_name, None))
         except FileNotFoundError:
             pass
     return data, manifests
@@ -110,9 +112,10 @@ def _walk_manifest_list(scan, list_name: str, data: Set[Tuple],
             continue
     for e in entries:
         if e.kind == FileKind.ADD:
-            data.add((e.partition, e.bucket, e.file.file_name))
+            data.add((e.partition, e.bucket, e.file.file_name,
+                      e.file.external_path))
             for extra in e.file.extra_files:
-                data.add((e.partition, e.bucket, extra))
+                data.add((e.partition, e.bucket, extra, None))
     return entries
 
 
@@ -194,11 +197,11 @@ def expire_changelogs(table, retain_max: Optional[int] = None,
         result.deleted_manifest_files += len(manifests)
         if dry_run:
             continue
-        for (pbytes, bucket, fname) in data:
+        for (pbytes, bucket, fname, ext) in data:
             partition = scan._partition_codec.from_bytes(pbytes)
             table.file_io.delete_quietly(
-                scan.path_factory.data_file_path(partition, bucket,
-                                                 fname))
+                ext or scan.path_factory.data_file_path(partition,
+                                                        bucket, fname))
         for fname in manifests:
             table.file_io.delete_quietly(
                 f"{scan.path_factory.manifest_dir}/{fname}")
@@ -336,10 +339,12 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
 
     dead_paths = []
     touched_dirs = set()
-    for (pbytes, bucket, fname) in dead_data:
+    for (pbytes, bucket, fname, ext) in dead_data:
         partition = scan._partition_codec.from_bytes(pbytes)
         if fname.startswith("index-"):
             dead_paths.append(scan.path_factory.index_file_path(fname))
+        elif ext:
+            dead_paths.append(ext)
         else:
             dead_paths.append(scan.path_factory.data_file_path(
                 partition, bucket, fname))

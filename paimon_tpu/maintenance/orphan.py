@@ -45,7 +45,7 @@ def remove_orphan_files(table, older_than_ms: Optional[int] = None,
     referenced: Set[str] = set()
     for snap in _all_snapshots(table):
         data, manifests = _snapshot_refs(table, snap)
-        referenced |= {fname for (_, _, fname) in data}
+        referenced |= {fname for (_, _, fname, _ext) in data}
         referenced |= manifests
 
     candidates = []
@@ -57,6 +57,21 @@ def remove_orphan_files(table, older_than_ms: Optional[int] = None,
             if base != "manifest":
                 continue
         _walk_files(table.file_io, st.path, candidates)
+    # external data roots are part of the table's storage footprint:
+    # un-committed writer leftovers there must be reclaimed too
+    # (reference OrphanFilesClean walks dataFileExternalPaths)
+    from paimon_tpu.options import CoreOptions
+    ext = table.options.get(CoreOptions.DATA_FILE_EXTERNAL_PATHS)
+    strategy = table.options.get(
+        CoreOptions.DATA_FILE_EXTERNAL_PATHS_STRATEGY)
+    if ext and strategy and strategy != "NONE":
+        for root in ext.split(","):
+            root = root.strip().rstrip("/")
+            if root:
+                try:
+                    _walk_files(table.file_io, root, candidates)
+                except FileNotFoundError:
+                    pass
 
     deleted = []
     for st in candidates:

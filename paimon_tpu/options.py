@@ -816,6 +816,21 @@ class CoreOptions:
         "Reads return blob descriptors (uri, offset, length) instead "
         "of materialized bytes")
 
+    # -- external data paths (reference CoreOptions.java:210-236) ------------
+    DATA_FILE_EXTERNAL_PATHS = ConfigOption(
+        "data-file.external-paths", str, None,
+        "Comma-separated storage roots for NEW data files; readers "
+        "follow the per-file external path recorded in the manifest")
+    DATA_FILE_EXTERNAL_PATHS_STRATEGY = ConfigOption(
+        "data-file.external-paths.strategy",
+        _enum("NONE", "ROUND-ROBIN", "SPECIFIC-FS"), "NONE",
+        "none: ignore external paths; round-robin: rotate across "
+        "them; specific-fs: only roots whose scheme matches "
+        "data-file.external-paths.specific-fs")
+    DATA_FILE_EXTERNAL_PATHS_SPECIFIC_FS = ConfigOption(
+        "data-file.external-paths.specific-fs", str, None,
+        "Scheme filter (e.g. 'oss', 's3') for strategy=specific-fs")
+
     # -- callbacks (reference CoreOptions commit.callbacks /
     # tag.callbacks + CommitCallback/TagCallback SPIs) -----------------------
     COMMIT_CALLBACKS = ConfigOption(

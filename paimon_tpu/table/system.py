@@ -71,8 +71,9 @@ def _files(table) -> pa.Table:
         rows.append({
             "partition": str(list(partition)),
             "bucket": e.bucket,
-            "file_path": scan.path_factory.data_file_path(
-                partition, e.bucket, f.file_name),
+            "file_path": f.external_path or
+                scan.path_factory.data_file_path(
+                    partition, e.bucket, f.file_name),
             "file_name": f.file_name,
             "file_format": f.file_name.rsplit(".", 1)[-1],
             "schema_id": f.schema_id,

@@ -47,7 +47,7 @@ class FileStorePathFactory:
         consistently (reference FileStorePathFactory construction in
         AbstractFileStore)."""
         from paimon_tpu.options import CoreOptions
-        return cls(
+        pf = cls(
             table_path, partition_keys,
             options.get(CoreOptions.PARTITION_DEFAULT_NAME),
             data_file_prefix=options.get(CoreOptions.DATA_FILE_PREFIX),
@@ -55,6 +55,11 @@ class FileStorePathFactory:
                 CoreOptions.CHANGELOG_FILE_PREFIX),
             data_file_dir=options.get(
                 CoreOptions.DATA_FILE_PATH_DIRECTORY))
+        pf.set_external_paths(
+            options.get(CoreOptions.DATA_FILE_EXTERNAL_PATHS),
+            options.get(CoreOptions.DATA_FILE_EXTERNAL_PATHS_STRATEGY),
+            options.get(CoreOptions.DATA_FILE_EXTERNAL_PATHS_SPECIFIC_FS))
+        return pf
 
     # -- dirs ----------------------------------------------------------------
 
@@ -110,6 +115,58 @@ class FileStorePathFactory:
     def data_file_path(self, partition: Sequence[Any], bucket: int,
                        file_name: str) -> str:
         return f"{self.bucket_dir(partition, bucket)}/{file_name}"
+
+    # -- external data paths (reference data-file.external-paths +
+    # .strategy + .specific-fs: new data files rotate across external
+    # storage roots; readers follow DataFileMeta.external_path) --------------
+
+    def set_external_paths(self, paths: Optional[str],
+                           strategy: str = "none",
+                           specific_fs: Optional[str] = None):
+        roots = [p.strip().rstrip("/") for p in (paths or "").split(",")
+                 if p.strip()]
+        strategy = (strategy or "none").lower()
+        if strategy == "specific-fs":
+            if not specific_fs:
+                raise ValueError(
+                    "strategy=specific-fs requires "
+                    "data-file.external-paths.specific-fs")
+            want = specific_fs.lower().rstrip(":/")
+            roots = [r for r in roots
+                     if r.split("://", 1)[0].lower() == want]
+            if not roots:
+                raise ValueError(
+                    f"no external path matches fs {specific_fs!r}")
+        self._external_roots = roots if strategy != "none" else []
+        # start each writer at a uuid-derived offset so independent
+        # writers spread across roots instead of all hammering root[0]
+        self._external_rr = hash(self._write_uuid) % max(1, len(roots))
+
+    def new_data_file_location(self, partition: Sequence[Any],
+                               bucket: int, file_name: str):
+        """-> (write_path, external_path_or_None): THE way every data
+        file writer resolves its destination, so external-path rotation
+        applies uniformly (data, changelog, row-tracking overlays)."""
+        external = self.external_data_file_path(partition, bucket,
+                                                file_name)
+        return (external or self.data_file_path(partition, bucket,
+                                                file_name), external)
+
+    def external_data_file_path(self, partition: Sequence[Any],
+                                bucket: int, file_name: str
+                                ) -> Optional[str]:
+        """Next external location for a new data file (round-robin over
+        the configured roots, same table-relative layout), or None when
+        external paths are not configured."""
+        roots = getattr(self, "_external_roots", None)
+        if not roots:
+            return None
+        root = roots[self._external_rr % len(roots)]
+        self._external_rr += 1
+        rel = self.data_file_path(partition, bucket, file_name)
+        if rel.startswith(self.table_path):
+            rel = rel[len(self.table_path):].lstrip("/")
+        return f"{root}/{rel}"
 
     # -- file names ----------------------------------------------------------
 
