@@ -278,7 +278,10 @@ class PartitionMarkDoneTrigger:
                               CoreOptions.PARTITION_IDLE_TIME_TO_DONE))
         self.end_input_marks = (
             mark_done_when_end_input if mark_done_when_end_input is not None
-            else options.get(CoreOptions.PARTITION_MARK_DONE_WHEN_END_INPUT))
+            else options.get(CoreOptions.PARTITION_MARK_DONE_WHEN_END_INPUT)
+            # partition.end-input-to-done is the reference's name for
+            # the same end-of-input semantics: either knob enables it
+            or options.get(CoreOptions.PARTITION_END_INPUT_TO_DONE))
         if (self.idle_time is None) != (self.time_interval is None):
             # silently never marking anything would be indistinguishable
             # from "nothing is idle yet"

@@ -816,6 +816,20 @@ class CoreOptions:
         "Reads return blob descriptors (uri, offset, length) instead "
         "of materialized bytes")
 
+    # -- streaming / incremental variants ------------------------------------
+    STREAMING_READ_SNAPSHOT_DELAY = ConfigOption(
+        "streaming.read.snapshot.delay", _parse_duration_ms, None,
+        "Incremental snapshots become visible to streaming reads only "
+        "after aging this long (absorbs small out-of-order commits)")
+    INCREMENTAL_BETWEEN_TAG_TO_SNAPSHOT = ConfigOption(
+        "incremental-between-tag-to-snapshot", str, None,
+        "'tagName,snapshotId': batch-read the deltas from a tag's "
+        "snapshot (exclusive) to a snapshot id (inclusive)")
+    PARTITION_END_INPUT_TO_DONE = ConfigOption(
+        "partition.end-input-to-done", _parse_bool, False,
+        "Mark the partitions a batch write touched as done when its "
+        "commit lands")
+
     # -- external data paths (reference CoreOptions.java:210-236) ------------
     DATA_FILE_EXTERNAL_PATHS = ConfigOption(
         "data-file.external-paths", str, None,

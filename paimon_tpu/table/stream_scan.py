@@ -199,6 +199,8 @@ class DataTableStreamScan:
         latest = sm.latest_snapshot_id()
         if latest is None or self._next is None or self._next > latest:
             return None
+        delay = self.options.get(
+            CoreOptions.STREAMING_READ_SNAPSHOT_DELAY)
         try:
             snapshot = sm.snapshot(self._next)
         except FileNotFoundError:
@@ -214,6 +216,13 @@ class DataTableStreamScan:
             snapshot = cm.try_changelog(self._next)
             if snapshot is None:
                 raise
+        if delay is not None:
+            # streaming.read.snapshot.delay: an incremental snapshot
+            # only becomes visible once it has aged past the delay
+            # (reference ContinuousDataFileSnapshotEnumerator delay)
+            import time as _time
+            if snapshot.time_millis > _time.time() * 1000 - delay:
+                return None
         bound = self.options.get(CoreOptions.SCAN_BOUNDED_WATERMARK)
         if bound is not None and snapshot.watermark is not None and \
                 snapshot.watermark > bound:

@@ -480,6 +480,18 @@ class TableCommit:
                     "commit.callback.#.param")
             for cb in self._callbacks:
                 cb.call(self.table, sid, messages)
+            if commit_identifier == BATCH_COMMIT_IDENTIFIER and \
+                    self.table.schema.partition_keys and \
+                    self.table.options.get(
+                        CoreOptions.PARTITION_END_INPUT_TO_DONE):
+                # reference partition.end-input-to-done: a finished
+                # batch input marks its partitions done. Pass raw
+                # partition TUPLES — mark_partitions_done applies
+                # partition.default-name handling for null/blank values
+                parts = {tuple(m.partition) for m in messages
+                         if m.partition}
+                if parts:
+                    self.table.mark_partitions_done(sorted(parts))
         return sid
 
     def filter_committed(self, identifiers: Sequence[int]) -> List[int]:
@@ -596,6 +608,10 @@ class TableScan:
         between = opts.get(CoreOptions.INCREMENTAL_BETWEEN)
         if between is not None:
             return self._plan_incremental(between)
+        tag_to_snap = opts.get(
+            CoreOptions.INCREMENTAL_BETWEEN_TAG_TO_SNAPSHOT)
+        if tag_to_snap is not None:
+            return self._plan_incremental_tag_diff(tag_to_snap)
         if tag_name is None:
             tag_name = opts.get(CoreOptions.SCAN_TAG_NAME)
         if snapshot_id is None:
@@ -674,6 +690,35 @@ class TableScan:
                 snap.delta_manifest_list)
             entries.extend(e for e in self._scan._read_manifests(metas)
                            if e.kind == FileKind.ADD)
+        return ScanPlan(end, self._scan.generate_splits(end, entries))
+
+    def _plan_incremental_tag_diff(self, spec: str) -> ScanPlan:
+        """'tagName,endSnapshotId': the DATA-FILE DIFF between the
+        tag's pinned snapshot and the end snapshot. Unlike the
+        range walk in _plan_incremental, this survives expiry of every
+        intermediate snapshot — the tag pins its snapshot and the end
+        snapshot exists, which is the whole point of a tag-based start
+        (reference IncrementalTagStartingScanner; option
+        incremental-between-tag-to-snapshot). The first token is ALWAYS
+        a tag name, never a snapshot id."""
+        table = self.builder.table
+        parts = spec.split(",")
+        if len(parts) != 2:
+            raise ValueError(
+                "incremental-between-tag-to-snapshot must be "
+                "'tagName,snapshotId'")
+        tag_snap = table.tag_manager.get_tag(parts[0].strip())
+        end = int(parts[1].strip())
+        if end < tag_snap.id:
+            raise ValueError(
+                f"end snapshot {end} predates tag "
+                f"{parts[0].strip()!r} (snapshot {tag_snap.id})")
+        end_snap = table.snapshot_manager.snapshot(end)
+        base = {(e.partition, e.bucket, e.file.file_name)
+                for e in self._scan.read_entries(tag_snap)}
+        entries = [e for e in self._scan.read_entries(end_snap)
+                   if (e.partition, e.bucket, e.file.file_name)
+                   not in base]
         return ScanPlan(end, self._scan.generate_splits(end, entries))
 
 

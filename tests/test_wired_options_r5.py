@@ -363,3 +363,65 @@ class TestExternalPaths:
         pf = FileStorePathFactory(str(tmp_path / "t"), [])
         with pytest.raises(ValueError, match="requires"):
             pf.set_external_paths("oss://b/a", "specific-fs", None)
+
+
+class TestStreamingIncrementalKnobs:
+    def test_snapshot_delay_hides_fresh_commits(self, tmp_path):
+        t = _make(str(tmp_path), {"changelog-producer": "input",
+                                  "streaming.read.snapshot.delay": "1h"})
+        _commit(t, [{"id": 1, "v": 1.0}])
+        scan = t.new_read_builder().new_stream_scan()
+        first = scan.plan()            # initial full load: visible
+        assert first is not None
+        _commit(t, [{"id": 2, "v": 2.0}])
+        # the fresh incremental snapshot is younger than the delay
+        assert scan.plan() is None
+        nodelay = t.copy({"streaming.read.snapshot.delay": "0s"})
+        s2 = nodelay.new_read_builder().new_stream_scan()
+        s2.plan()
+        _commit(t, [{"id": 3, "v": 3.0}])
+        assert s2.plan() is not None
+
+    def test_incremental_between_tag_to_snapshot(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": 1.0}])
+        t.create_tag("base")
+        _commit(t, [{"id": 2, "v": 2.0}])
+        sid3 = _commit(t, [{"id": 3, "v": 3.0}])
+        inc = t.copy({
+            "incremental-between-tag-to-snapshot": f"base,{sid3}"})
+        got = sorted(inc.to_arrow().column("id").to_pylist())
+        assert got == [2, 3]
+
+    def test_end_input_to_done(self, tmp_path):
+        from paimon_tpu.types import IntType as IT
+        schema = (Schema.builder()
+                  .column("pt", IT(False))
+                  .column("id", BigIntType(False))
+                  .primary_key("pt", "id")
+                  .partition_keys("pt")
+                  .options({"bucket": "1",
+                            "partition.end-input-to-done": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "pd"), schema)
+        _commit(t, [{"pt": 1, "id": 1}, {"pt": 2, "id": 2}])
+        import glob
+        assert glob.glob(os.path.join(t.path, "pt=1", "_SUCCESS")) or \
+            glob.glob(os.path.join(t.path, "**", "pt=1", "*SUCCESS*"),
+                      recursive=True)
+
+    def test_tag_to_snapshot_survives_expiry(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": 1.0}])
+        t.create_tag("base")
+        for i in range(2, 8):
+            _commit(t, [{"id": i, "v": float(i)}])
+        latest = t.latest_snapshot().id
+        # expire everything but the newest snapshots; the tag pins its
+        # own snapshot, the intermediate range is GONE
+        t.expire_snapshots(retain_max=2, retain_min=1)
+        assert t.snapshot_manager.earliest_snapshot_id() > 2
+        inc = t.copy({
+            "incremental-between-tag-to-snapshot": f"base,{latest}"})
+        got = sorted(inc.to_arrow().column("id").to_pylist())
+        assert got == list(range(2, 8))
