@@ -287,8 +287,11 @@ class CoreOptions:
         "chunk-rows-sized, large enough to amortize device transfers "
         "when the link-adaptive model offloads (ours)")
     MERGE_CHUNK_ROWS = ConfigOption(
-        "tpu.merge.chunk-rows", int, 2 << 20,
-        "Decoded chunk rows per run for the streamed merge (ours)")
+        "tpu.merge.chunk-rows", int, 4 << 20,
+        "Decoded chunk rows per run for the streamed merge (ours); "
+        "larger windows amortize per-window sync/flush overhead "
+        "(~20% at 30M rows/10 runs measured in-env) at ~runs x rows "
+        "x row-bytes peak memory")
     BRANCH = ConfigOption("branch", str, "main", "")
     METASTORE_PARTITIONED_TABLE = ConfigOption("metastore.partitioned-table",
                                                _parse_bool, False, "")
