@@ -16,7 +16,8 @@ import numpy as np
 import pyarrow as pa
 
 from paimon_tpu.cdc.formats import (
-    parse_canal, parse_debezium, parse_maxwell,
+    parse_aliyun, parse_canal, parse_debezium, parse_dms, parse_maxwell,
+    parse_ogg,
 )
 from paimon_tpu.schema.schema_manager import SchemaChange
 from paimon_tpu.table.table import FileStoreTable
@@ -31,6 +32,9 @@ _PARSERS: Dict[str, Callable] = {
     "debezium": parse_debezium,
     "canal": parse_canal,
     "maxwell": parse_maxwell,
+    "ogg": parse_ogg,
+    "dms": parse_dms,
+    "aliyun": parse_aliyun,
 }
 
 
