@@ -19,7 +19,7 @@ Time travel on a table reference: `t VERSION AS OF 3`,
 """
 
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # Tokenizer
@@ -260,6 +260,31 @@ class JoinClause:
     kind: str                      # inner | left outer | right outer |
     right: Any                     # full outer | cross
     condition: Optional[Any]
+
+
+def _apply_ctes(sel: "Select", ctes: Dict[str, "Select"]) -> "Select":
+    """Replace FROM/JOIN references to CTE names with subqueries, in
+    place, recursing through nested subqueries and UNION branches. A
+    time-traveled reference (VERSION AS OF ...) is never a CTE."""
+    import copy as _copy
+
+    def rewrite(ref):
+        if isinstance(ref, TableRef) and ref.name in ctes and \
+                ref.snapshot_id is None and ref.tag is None and \
+                ref.timestamp_ms is None:
+            return SubqueryRef(select=_copy.deepcopy(ctes[ref.name]),
+                               alias=ref.alias or ref.name)
+        if isinstance(ref, SubqueryRef):
+            _apply_ctes(ref.select, ctes)
+        return ref
+
+    if sel.from_ is not None:
+        sel.from_ = rewrite(sel.from_)
+    for j in sel.joins:
+        j.right = rewrite(j.right)
+    if sel.union_all is not None:
+        _apply_ctes(sel.union_all, ctes)
+    return sel
 
 
 @dataclass
@@ -504,10 +529,10 @@ class Parser:
         return stmt
 
     def statement(self):
-        if self.at_kw("SELECT"):
-            return self.select()
+        if self.at_kw("SELECT") or self.at_kw("WITH"):
+            return self.select_or_with()
         if self.accept_kw("EXPLAIN"):
-            return Explain(self.select())
+            return Explain(self.select_or_with())
         if self.accept_kw("INSERT"):
             return self.insert()
         if self.accept_kw("CREATE"):
@@ -534,6 +559,37 @@ class Parser:
         if self.accept_kw("CALL"):
             return self.call()
         raise SQLError(f"unsupported statement start: {self.peek().value!r}")
+
+    # -- WITH (common table expressions) ------------------------------------
+    def with_select(self) -> Select:
+        """WITH name AS (select) [, name2 AS (select)] select —
+        desugared at parse time: references to a CTE name in FROM/JOIN
+        positions become subqueries (reference SQL front-ends treat
+        non-recursive CTEs exactly as named subqueries)."""
+        self.expect_kw("WITH")
+        ctes: Dict[str, Select] = {}
+        while True:
+            name = self.ident()
+            if name in ctes:
+                raise SQLError(
+                    f"WITH query name {name!r} specified more than once")
+            self.expect_kw("AS")
+            self.expect_op("(")
+            sub = self.select()
+            self.expect_op(")")
+            # earlier CTEs are visible inside later bodies; the dict
+            # only grows after this call returns
+            _apply_ctes(sub, ctes)
+            ctes[name] = sub
+            if not self.accept_op(","):
+                break
+        return _apply_ctes(self.select(), ctes)
+
+    def select_or_with(self) -> Select:
+        """A query body anywhere a SELECT is accepted (INSERT ...
+        SELECT, CREATE VIEW ... AS, EXPLAIN): WITH is valid there in
+        every reference front-end."""
+        return self.with_select() if self.at_kw("WITH") else self.select()
 
     # -- SELECT -------------------------------------------------------------
     def select(self) -> Select:
@@ -967,10 +1023,11 @@ class Parser:
         if at_paren_select():
             # INSERT INTO t [(cols)] (SELECT ...)
             self.next()
-            sel = self.select()
+            sel = self.select_or_with()
             self.expect_op(")")
             return Insert(table, columns, None, sel, overwrite)
-        return Insert(table, columns, None, self.select(), overwrite)
+        return Insert(table, columns, None, self.select_or_with(),
+                      overwrite)
 
     def value_row(self) -> List[Any]:
         self.expect_op("(")
@@ -1001,7 +1058,7 @@ class Parser:
                 comment = t.value
             self.expect_kw("AS")
             start = self.peek().pos
-            sel = self.select()
+            sel = self.select_or_with()
             return CreateView(name, self.text[start:].rstrip().rstrip(";"),
                               sel, or_replace, comment)
         if self.accept_word("FUNCTION"):

@@ -910,3 +910,67 @@ class TestDdlTypeMatrix:
             ctx.sql("CREATE TABLE b1 (id INT, v ARRAY<)")
         with pytest.raises((SQLError, ValueError)):
             ctx.sql("CREATE TABLE b2 (id INT, v MAP<INT>)")
+
+
+class TestCTE:
+    """WITH common table expressions (desugared to named subqueries at
+    parse time — the reference gets CTEs from its DataFusion SQL
+    layer)."""
+
+    def _ctx(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, v DOUBLE, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t VALUES (1, 1.5), (2, 2.5), (3, 3.5)")
+        return ctx
+
+    def test_basic(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        r = ctx.sql("WITH big AS (SELECT * FROM db.t WHERE v > 2) "
+                    "SELECT count(*) AS n FROM big")
+        assert r.to_pylist() == [{"n": 2}]
+
+    def test_chained_ctes_and_join(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        r = ctx.sql(
+            "WITH big AS (SELECT * FROM db.t WHERE v > 2), "
+            "tiny AS (SELECT * FROM big WHERE id = 3) "
+            "SELECT t.id, tiny.v FROM db.t t "
+            "JOIN tiny ON t.id = tiny.id")
+        assert r.to_pylist() == [{"id": 3, "v": 3.5}]
+
+    def test_cte_with_alias_and_union(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        r = ctx.sql(
+            "WITH w AS (SELECT id FROM db.t WHERE id = 1) "
+            "SELECT a.id FROM w a UNION ALL SELECT id FROM w")
+        assert sorted(x["id"] for x in r.to_pylist()) == [1, 1]
+
+    def test_explain_with(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("EXPLAIN WITH b AS (SELECT * FROM db.t) "
+                "SELECT * FROM b")   # no error
+
+    def test_duplicate_cte_name_rejected(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="more than once"):
+            ctx.sql("WITH a AS (SELECT 1 AS x), a AS (SELECT 2 AS x) "
+                    "SELECT * FROM a")
+
+    def test_cte_in_insert_and_view(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.t2 (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t2 WITH big AS "
+                "(SELECT id FROM db.t WHERE v > 2) SELECT id FROM big")
+        assert sorted(r["id"] for r in
+                      ctx.sql("SELECT id FROM db.t2").to_pylist()) ==             [2, 3]
+        ctx.sql("CREATE VIEW db.v AS WITH big AS "
+                "(SELECT id FROM db.t WHERE v > 2) "
+                "SELECT count(*) AS n FROM big")
+        assert ctx.sql("SELECT n FROM db.v").to_pylist() == [{"n": 2}]
