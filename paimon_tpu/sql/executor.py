@@ -621,8 +621,41 @@ class SQLContext:
     def _exec_select_stmt(self, s: ast.Select) -> pa.Table:
         return self._exec_select(s)
 
+    def _in_subquery_rewriter(self):
+        """fn for _transform: evaluate an uncorrelated
+        `x [NOT] IN (SELECT ...)` into literal comparisons (a
+        correlated subquery fails inside its own execution with an
+        unknown-column error). SQL three-valued logic is preserved
+        when the result set contains NULL — `x IN (.., NULL)` is TRUE
+        on a match else NULL (never FALSE), `x NOT IN (.., NULL)` is
+        FALSE on a match else NULL (never TRUE) — via a CASE over the
+        non-null match set."""
+        def fn(e):
+            if not isinstance(e, ast.InSubquery):
+                return e
+            sub = self._exec_select(e.select)
+            if sub.num_columns != 1:
+                raise SQLError(
+                    "IN subquery must return exactly one column, "
+                    f"got {sub.num_columns}")
+            raw = sub.column(0).to_pylist()
+            vals = [ast.Literal(v) for v in raw if v is not None]
+            has_null = len(vals) != len(raw)
+            match = ast.InList(e.expr, vals, negated=False)
+            if not has_null:
+                return ast.InList(e.expr, vals, e.negated)
+            return ast.Case(
+                whens=[(match, ast.Literal(e.negated is False))],
+                default=ast.Literal(None))
+        return fn
+
+    def _materialize_in_subqueries(self, s: ast.Select) -> None:
+        """In place and idempotent — leaves no InSubquery behind."""
+        _rewrite_select_exprs(s, self._in_subquery_rewriter())
+
     def _exec_select(self, s: ast.Select,
                      collect_plan: Optional[dict] = None) -> pa.Table:
+        self._materialize_in_subqueries(s)
         if s.union_all is not None:
             left = self._exec_select(
                 ast.Select(s.items, s.from_, s.joins, s.where, s.group_by,
@@ -1151,7 +1184,10 @@ class SQLContext:
                            "DROP TABLE or overwrite instead")
         cols = [f.name for f in table.row_type().fields]
         alias = d.table.split(".")[-1]
-        pred = expr_to_predicate(d.where, _probe_scope(cols, alias),
+        # IN (SELECT ...) materializes to a literal list first (same
+        # rewrite the SELECT/UPDATE paths get)
+        where = _transform(d.where, self._in_subquery_rewriter())
+        pred = expr_to_predicate(where, _probe_scope(cols, alias),
                                  alias, exact=True)
         if pred is None:
             raise SQLError("DELETE WHERE must be expressible as column/"
@@ -1668,6 +1704,11 @@ def _transform(e, fn):
     elif isinstance(e, ast.InList):
         e = ast.InList(_transform(e.expr, fn),
                        [_transform(v, fn) for v in e.values], e.negated)
+    elif isinstance(e, ast.InSubquery):
+        # the rewrite (UDF expansion, parameter substitution) applies
+        # inside the subquery's expression positions too
+        _rewrite_select_exprs(e.select, fn)
+        e = ast.InSubquery(_transform(e.expr, fn), e.select, e.negated)
     elif isinstance(e, ast.BetweenExpr):
         e = ast.BetweenExpr(_transform(e.expr, fn),
                             _transform(e.lo, fn), _transform(e.hi, fn),

@@ -214,6 +214,15 @@ class InList:
 
 
 @dataclass
+class InSubquery:
+    """x [NOT] IN (SELECT ...) — uncorrelated; materialized to an
+    InList by the executor before evaluation."""
+    expr: Any
+    select: Any
+    negated: bool = False
+
+
+@dataclass
 class BetweenExpr:
     expr: Any
     lo: Any
@@ -263,10 +272,12 @@ class JoinClause:
 
 
 def _apply_ctes(sel: "Select", ctes: Dict[str, "Select"]) -> "Select":
-    """Replace FROM/JOIN references to CTE names with subqueries, in
-    place, recursing through nested subqueries and UNION branches. A
-    time-traveled reference (VERSION AS OF ...) is never a CTE."""
+    """Replace references to CTE names with subqueries, in place,
+    recursing through nested subqueries, UNION branches AND selects
+    embedded in expressions (IN (SELECT ...)). A time-traveled
+    reference (VERSION AS OF ...) is never a CTE."""
     import copy as _copy
+    import dataclasses as _dc
 
     def rewrite(ref):
         if isinstance(ref, TableRef) and ref.name in ctes and \
@@ -278,10 +289,27 @@ def _apply_ctes(sel: "Select", ctes: Dict[str, "Select"]) -> "Select":
             _apply_ctes(ref.select, ctes)
         return ref
 
+    def walk_expr(e):
+        if isinstance(e, Select):
+            _apply_ctes(e, ctes)
+        elif isinstance(e, (list, tuple)):
+            for x in e:
+                walk_expr(x)
+        elif _dc.is_dataclass(e) and not isinstance(e, type):
+            for f in _dc.fields(e):
+                walk_expr(getattr(e, f.name))
+
     if sel.from_ is not None:
         sel.from_ = rewrite(sel.from_)
     for j in sel.joins:
         j.right = rewrite(j.right)
+        walk_expr(j.condition)
+    for item in sel.items:
+        walk_expr(item.expr)
+    walk_expr(sel.where)
+    walk_expr(sel.group_by)
+    walk_expr(sel.having)
+    walk_expr([e for e, _, _ in sel.order_by])
     if sel.union_all is not None:
         _apply_ctes(sel.union_all, ctes)
     return sel
@@ -773,6 +801,10 @@ class Parser:
             return IsNull(left, negated=neg2 or negated)
         if self.accept_kw("IN"):
             self.expect_op("(")
+            if self.at_kw("SELECT") or self.at_kw("WITH"):
+                sub = self.select_or_with()
+                self.expect_op(")")
+                return InSubquery(left, sub, negated)
             vals = [self.expr()]
             while self.accept_op(","):
                 vals.append(self.expr())

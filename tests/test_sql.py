@@ -974,3 +974,46 @@ class TestCTE:
                 "(SELECT id FROM db.t WHERE v > 2) "
                 "SELECT count(*) AS n FROM big")
         assert ctx.sql("SELECT n FROM db.v").to_pylist() == [{"n": 2}]
+
+    def test_in_subquery(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.s (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.s VALUES (2), (3)")
+        got = ctx.sql("SELECT id FROM db.t WHERE id IN "
+                      "(SELECT id FROM db.s) ORDER BY id").to_pylist()
+        assert [r["id"] for r in got] == [2, 3]
+        got = ctx.sql("SELECT id FROM db.t WHERE id NOT IN "
+                      "(SELECT id FROM db.s)").to_pylist()
+        assert [r["id"] for r in got] == [1]
+        # CTE visible inside the IN subquery
+        got = ctx.sql(
+            "WITH w AS (SELECT id FROM db.s) SELECT id FROM db.t "
+            "WHERE id IN (SELECT id FROM w) ORDER BY id").to_pylist()
+        assert [r["id"] for r in got] == [2, 3]
+        from paimon_tpu.sql.executor import SQLError
+        with pytest.raises(SQLError, match="one column"):
+            ctx.sql("SELECT id FROM db.t WHERE id IN "
+                    "(SELECT id, id FROM db.s)")
+
+    def test_in_subquery_null_three_valued_logic(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.s (id BIGINT NOT NULL, r BIGINT, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.s VALUES (10, 2), (11, NULL)")
+        # IN against a set containing NULL: only the real match
+        got = ctx.sql("SELECT id FROM db.t WHERE id IN "
+                      "(SELECT r FROM db.s)").to_pylist()
+        assert [r["id"] for r in got] == [2]
+        # NOT IN against a set containing NULL: NEVER true
+        assert ctx.sql("SELECT id FROM db.t WHERE id NOT IN "
+                       "(SELECT r FROM db.s)").to_pylist() == []
+
+    def test_delete_with_in_subquery(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.s (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.s VALUES (2)")
+        ctx.sql("DELETE FROM db.t WHERE id IN (SELECT id FROM db.s)")
+        got = ctx.sql("SELECT id FROM db.t ORDER BY id").to_pylist()
+        assert [r["id"] for r in got] == [1, 3]
