@@ -662,9 +662,33 @@ class SQLContext:
                            s.having, [], None, None, s.distinct))
             right = self._exec_select(s.union_all)
             right = right.rename_columns(left.column_names)
-            out = pa.concat_tables(
-                [left, right.cast(left.schema)], promote_options="none")
-            # trailing ORDER BY / LIMIT bind to the whole union
+            right = right.cast(left.schema)
+            setop = s.setop
+            if setop == "union_all":
+                out = pa.concat_tables([left, right],
+                                       promote_options="none")
+            elif setop == "union":
+                out = pa.concat_tables(
+                    [left, right], promote_options="none").group_by(
+                    left.column_names, use_threads=False).aggregate([])
+            else:
+                # INTERSECT / EXCEPT: distinct set semantics with
+                # NULL = NULL (python tuples, exactly SQL's set-op
+                # grouping rules — arrow joins would drop null keys).
+                # Keys are built POSITIONALLY from columns (duplicate
+                # output names must not collapse) and made hashable
+                # (ARRAY/MAP values arrive as lists/dicts).
+                rset = set(_row_keys(right))
+                seen = set()
+                keep = []
+                for i, key in enumerate(_row_keys(left)):
+                    if key in seen:
+                        continue
+                    if (key in rset) == (setop == "intersect"):
+                        seen.add(key)
+                        keep.append(i)
+                out = left.take(pa.array(keep, pa.int64()))
+            # trailing ORDER BY / LIMIT bind to the whole set-op
             if s.order_by:
                 keys = []
                 for e, asc, pl in s.order_by:
@@ -1749,6 +1773,21 @@ def _rewrite_select_exprs(sel: "ast.Select", fn) -> None:
         _rewrite_select_exprs(sel.from_.select, fn)
     if sel.union_all is not None:
         _rewrite_select_exprs(sel.union_all, fn)
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def _row_keys(t: pa.Table):
+    """Positional, hashable row keys for set-op comparison."""
+    cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+    for row in zip(*cols):
+        yield tuple(_hashable(v) for v in row)
 
 
 def _find_funcs(e, pred) -> List[ast.Func]:

@@ -30,7 +30,8 @@ _KEYWORDS = {
     "LIMIT", "OFFSET", "AS", "AND", "OR", "NOT", "NULL", "IS", "IN",
     "BETWEEN", "LIKE", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE",
     "END", "CAST", "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER",
-    "CROSS", "ON", "UNION", "ALL", "ASC", "DESC", "NULLS", "FIRST", "LAST",
+    "CROSS", "ON", "UNION", "ALL", "INTERSECT", "EXCEPT", "ASC", "DESC",
+    "NULLS", "FIRST", "LAST",
     "INSERT", "INTO", "OVERWRITE", "VALUES", "CREATE", "TABLE", "DATABASE",
     "IF", "EXISTS", "PRIMARY", "KEY", "ENFORCED", "PARTITIONED", "WITH",
     "COMMENT", "DROP", "SHOW", "TABLES", "DATABASES", "DESCRIBE", "DESC",
@@ -327,7 +328,8 @@ class Select:
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
-    union_all: Optional["Select"] = None
+    union_all: Optional["Select"] = None   # right branch of a set-op
+    setop: str = "union_all"               # union_all|union|intersect|except
 
 
 @dataclass
@@ -646,13 +648,37 @@ class Parser:
                 s.group_by.append(self.expr())
         if self.accept_kw("HAVING"):
             s.having = self.expr()
+        setop = None
         if self.accept_kw("UNION"):
-            self.expect_kw("ALL")
+            if self.accept_kw("ALL"):
+                setop = "union_all"
+            else:
+                self.accept_kw("DISTINCT")
+                setop = "union"
+        elif self.accept_kw("INTERSECT"):
+            self.accept_kw("DISTINCT")
+            setop = "intersect"
+        elif self.accept_kw("EXCEPT"):
+            self.accept_kw("DISTINCT")
+            setop = "except"
+        if setop is not None:
             right = self.select()
+            # the recursive parse is right-associative; SQL set-ops are
+            # LEFT-associative with INTERSECT binding tighter. Chains of
+            # one associative op (union all / union / intersect) give
+            # identical results either way; anything else would return
+            # silently wrong rows — refuse with a workaround.
+            if right.union_all is not None and \
+                    (right.setop != setop or setop == "except"):
+                raise SQLError(
+                    "chained mixed or EXCEPT set operations are not "
+                    "supported directly; parenthesize via a subquery: "
+                    "SELECT * FROM (a <op> b) t <op> c")
             s.union_all = right
-            # a trailing ORDER BY / LIMIT binds to the WHOLE union; the
-            # recursive parse attached it to the right branch (which
-            # itself already hoisted from any deeper chain) — hoist it
+            s.setop = setop
+            # a trailing ORDER BY / LIMIT binds to the WHOLE set-op;
+            # the recursive parse attached it to the right branch
+            # (which itself already hoisted from any deeper chain)
             s.order_by, right.order_by = right.order_by, []
             s.limit, right.limit = right.limit, None
             s.offset, right.offset = right.offset, None

@@ -1017,3 +1017,84 @@ class TestCTE:
         ctx.sql("DELETE FROM db.t WHERE id IN (SELECT id FROM db.s)")
         got = ctx.sql("SELECT id FROM db.t ORDER BY id").to_pylist()
         assert [r["id"] for r in got] == [1, 3]
+
+
+class TestSetOps:
+    """UNION [DISTINCT] / INTERSECT / EXCEPT (UNION ALL predates)."""
+
+    def _ctx(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.a (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("CREATE TABLE db.b (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.a VALUES (1), (2), (3)")
+        ctx.sql("INSERT INTO db.b VALUES (2), (3), (4)")
+        return ctx
+
+    def test_union_distinct(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.a UNION SELECT id FROM db.b "
+                      "ORDER BY id").to_pylist()
+        assert [r["id"] for r in got] == [1, 2, 3, 4]
+
+    def test_intersect(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.a INTERSECT "
+                      "SELECT id FROM db.b ORDER BY id").to_pylist()
+        assert [r["id"] for r in got] == [2, 3]
+
+    def test_except(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.a EXCEPT "
+                      "SELECT id FROM db.b").to_pylist()
+        assert [r["id"] for r in got] == [1]
+
+    def test_union_all_unchanged(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.a UNION ALL "
+                      "SELECT id FROM db.b").to_pylist()
+        assert sorted(r["id"] for r in got) == [1, 2, 2, 3, 3, 4]
+
+    def test_same_op_chains_allowed(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.a UNION ALL SELECT id FROM "
+                      "db.b UNION ALL SELECT id FROM db.a").to_pylist()
+        assert len(got) == 9
+        got = ctx.sql("SELECT id FROM db.a UNION SELECT id FROM db.b "
+                      "UNION SELECT id FROM db.a ORDER BY id").to_pylist()
+        assert [r["id"] for r in got] == [1, 2, 3, 4]
+
+    def test_mixed_or_except_chain_rejected(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="parenthesize"):
+            ctx.sql("SELECT id FROM db.a EXCEPT SELECT id FROM db.b "
+                    "EXCEPT SELECT id FROM db.a")
+        with pytest.raises(SQLError, match="parenthesize"):
+            ctx.sql("SELECT id FROM db.a UNION ALL SELECT id FROM db.b "
+                    "UNION SELECT id FROM db.a")
+        # the documented workaround
+        got = ctx.sql(
+            "SELECT * FROM (SELECT id FROM db.a EXCEPT "
+            "SELECT id FROM db.b) t EXCEPT SELECT id FROM db.a")
+        assert got.to_pylist() == []
+
+    def test_intersect_duplicate_output_names(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        # both output columns named 'id': keys must stay positional
+        got = ctx.sql(
+            "SELECT a.id, b.id FROM db.a a JOIN db.b b ON a.id = b.id "
+            "INTERSECT SELECT a.id, b.id FROM db.a a "
+            "JOIN db.b b ON a.id = b.id ORDER BY 1").to_pylist()
+        assert len(got) == 2
+
+    def test_intersect_array_values(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT ARRAY[1, 2] AS arr FROM db.a INTERSECT "
+                      "SELECT ARRAY[1, 2] AS arr FROM db.b").to_pylist()
+        assert got == [{"arr": [1, 2]}]
