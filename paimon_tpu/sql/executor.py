@@ -621,16 +621,29 @@ class SQLContext:
     def _exec_select_stmt(self, s: ast.Select) -> pa.Table:
         return self._exec_select(s)
 
-    def _in_subquery_rewriter(self):
-        """fn for _transform: evaluate an uncorrelated
-        `x [NOT] IN (SELECT ...)` into literal comparisons (a
-        correlated subquery fails inside its own execution with an
-        unknown-column error). SQL three-valued logic is preserved
-        when the result set contains NULL — `x IN (.., NULL)` is TRUE
+    def _subquery_rewriter(self):
+        """fn for _transform: evaluate uncorrelated expression
+        subqueries — scalar `(SELECT ...)` to a Literal (one column,
+        at most one row, NULL when empty) and `x [NOT] IN (SELECT
+        ...)` to literal comparisons. A correlated subquery fails
+        inside its own execution with an unknown-column error. SQL
+        three-valued logic is preserved when an IN result set
+        contains NULL — `x IN (.., NULL)` is TRUE
         on a match else NULL (never FALSE), `x NOT IN (.., NULL)` is
         FALSE on a match else NULL (never TRUE) — via a CASE over the
         non-null match set."""
         def fn(e):
+            if isinstance(e, ast.ScalarSubquery):
+                sub = self._exec_select(e.select)
+                if sub.num_columns != 1:
+                    raise SQLError(
+                        "scalar subquery must return exactly one "
+                        f"column, got {sub.num_columns}")
+                if sub.num_rows > 1:
+                    raise SQLError(
+                        "scalar subquery returned more than one row")
+                return ast.Literal(
+                    sub.column(0)[0].as_py() if sub.num_rows else None)
             if not isinstance(e, ast.InSubquery):
                 return e
             sub = self._exec_select(e.select)
@@ -649,13 +662,14 @@ class SQLContext:
                 default=ast.Literal(None))
         return fn
 
-    def _materialize_in_subqueries(self, s: ast.Select) -> None:
-        """In place and idempotent — leaves no InSubquery behind."""
-        _rewrite_select_exprs(s, self._in_subquery_rewriter())
+    def _materialize_subqueries(self, s: ast.Select) -> None:
+        """In place and idempotent — leaves no InSubquery or
+        ScalarSubquery behind."""
+        _rewrite_select_exprs(s, self._subquery_rewriter())
 
     def _exec_select(self, s: ast.Select,
                      collect_plan: Optional[dict] = None) -> pa.Table:
-        self._materialize_in_subqueries(s)
+        self._materialize_subqueries(s)
         if s.union_all is not None:
             left = self._exec_select(
                 ast.Select(s.items, s.from_, s.joins, s.where, s.group_by,
@@ -1158,11 +1172,12 @@ class SQLContext:
             n_cols = len(ins.rows[0])
             cols = ins.columns or [f.name for f in schema][:n_cols]
             arrays: List[List[Any]] = [[] for _ in range(n_cols)]
+            rewrite = self._subquery_rewriter()
             for row in ins.rows:
                 if len(row) != n_cols:
                     raise SQLError("VALUES rows have inconsistent arity")
                 for i, cell in enumerate(row):
-                    v = comp.compile(cell)
+                    v = comp.compile(_transform(cell, rewrite))
                     if isinstance(v, pa.Scalar):
                         v = v.as_py()
                     elif isinstance(v, (pa.Array, pa.ChunkedArray)):
@@ -1210,7 +1225,7 @@ class SQLContext:
         alias = d.table.split(".")[-1]
         # IN (SELECT ...) materializes to a literal list first (same
         # rewrite the SELECT/UPDATE paths get)
-        where = _transform(d.where, self._in_subquery_rewriter())
+        where = _transform(d.where, self._subquery_rewriter())
         pred = expr_to_predicate(where, _probe_scope(cols, alias),
                                  alias, exact=True)
         if pred is None:
@@ -1241,11 +1256,13 @@ class SQLContext:
         comp = Compiler(scope)
         out = matched
         schema = table.arrow_schema()
+        rewrite = self._subquery_rewriter()
         for col, e in u.assignments:
             if col in (table.partition_keys or []) or \
                     col in table.primary_keys:
                 raise SQLError(f"cannot UPDATE key column {col!r}")
             idx = out.column_names.index(col)
+            e = _transform(e, rewrite)
             val = pc.cast(comp.as_array(e), schema.field(col).type)
             out = out.set_column(idx, col, val)
         wb = table.new_batch_write_builder()
@@ -1733,6 +1750,8 @@ def _transform(e, fn):
         # inside the subquery's expression positions too
         _rewrite_select_exprs(e.select, fn)
         e = ast.InSubquery(_transform(e.expr, fn), e.select, e.negated)
+    elif isinstance(e, ast.ScalarSubquery):
+        _rewrite_select_exprs(e.select, fn)
     elif isinstance(e, ast.BetweenExpr):
         e = ast.BetweenExpr(_transform(e.expr, fn),
                             _transform(e.lo, fn), _transform(e.hi, fn),

@@ -224,6 +224,13 @@ class InSubquery:
 
 
 @dataclass
+class ScalarSubquery:
+    """(SELECT expr FROM ...) in expression position — uncorrelated;
+    must return one column and at most one row (NULL when empty)."""
+    select: Any
+
+
+@dataclass
 class BetweenExpr:
     expr: Any
     lo: Any
@@ -911,6 +918,12 @@ class Parser:
                 import datetime as _dt
                 return Literal(_dt.datetime.fromisoformat(s.value))
         if self.accept_op("("):
+            if self.at_kw("SELECT") or self.at_kw("WITH"):
+                # scalar subquery: (SELECT max(x) FROM t) in expression
+                # position — materialized to a Literal by the executor
+                sub = self.select_or_with()
+                self.expect_op(")")
+                return ScalarSubquery(sub)
             e = self.expr()
             self.expect_op(")")
             return e

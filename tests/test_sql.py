@@ -1098,3 +1098,48 @@ class TestSetOps:
         got = ctx.sql("SELECT ARRAY[1, 2] AS arr FROM db.a INTERSECT "
                       "SELECT ARRAY[1, 2] AS arr FROM db.b").to_pylist()
         assert got == [{"arr": [1, 2]}]
+
+
+class TestScalarSubquery:
+    def _ctx(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, v DOUBLE, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t VALUES (1, 1.5), (2, 2.5), (3, 3.5)")
+        return ctx
+
+    def test_in_where(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.t WHERE v = "
+                      "(SELECT max(v) FROM db.t)").to_pylist()
+        assert got == [{"id": 3}]
+
+    def test_in_projection(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id, v - (SELECT avg(v) FROM db.t) AS d "
+                      "FROM db.t ORDER BY id").to_pylist()
+        assert [round(r["d"], 6) for r in got] == [-1.0, 0.0, 1.0]
+
+    def test_empty_is_null_and_multirow_errors(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.t WHERE v < "
+                      "(SELECT v FROM db.t WHERE id = 99)").to_pylist()
+        assert got == []          # NULL comparison filters all
+        with pytest.raises(SQLError, match="more than one row"):
+            ctx.sql("SELECT (SELECT v FROM db.t) FROM db.t")
+
+    def test_in_update_and_insert(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("UPDATE db.t SET v = (SELECT max(v) FROM db.t) "
+                "WHERE id = 1")
+        got = ctx.sql("SELECT v FROM db.t WHERE id = 1").to_pylist()
+        assert got == [{"v": 3.5}]
+        ctx.sql("INSERT INTO db.t VALUES "
+                "(4, (SELECT min(v) FROM db.t))")
+        got = ctx.sql("SELECT v FROM db.t WHERE id = 4").to_pylist()
+        assert got == [{"v": 2.5}]
