@@ -237,3 +237,22 @@ class TestDLFAuth:
                 bearer.load_table("db", "t")
         finally:
             s.stop()
+
+
+class TestDLFGoldenVector:
+    def test_signature_pinned(self):
+        """Golden vector freezing the DLF4-HMAC-SHA256 wire algorithm
+        (canonical request -> string-to-sign -> derived key chain);
+        any refactor changing these bytes breaks interop with servers
+        validating the same spec."""
+        p = DLFAuthProvider("AKID", "SECRET", security_token="STS",
+                            region="cn-hangzhou",
+                            now_fn=lambda: 1_700_000_000.0)
+        h = p.auth_headers("POST", "/v1/cat/databases",
+                           {"maxResults": "10"}, '{"name":"db"}')
+        assert h["Authorization"] == (
+            "DLF4-HMAC-SHA256 Credential=AKID/20231114/cn-hangzhou/"
+            "DlfNext/aliyun_v4_request,Signature=7787f3efff0f52eeab47"
+            "d1f65fa25fe7ff6b11060eaa7ab00d9901e1a14d5ee8")
+        assert h["content-md5"] == "6ZF45M/6TJ2FOC248EOPDg=="
+        assert h["x-dlf-date"] == "20231114T221320Z"
