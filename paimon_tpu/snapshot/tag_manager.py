@@ -78,6 +78,18 @@ class TagManager:
                 removed.append(name)
         return removed
 
+    def rename_tag(self, old: str, new: str):
+        """Byte-preserving rename (reference TagManager.renameTag) —
+        keeps tagCreateTime/tagTimeRetained, which a parse-and-rewrite
+        would drop."""
+        if not self.tag_exists(old):
+            raise FileNotFoundError(f"Tag {old!r} not found")
+        if self.tag_exists(new):
+            raise ValueError(f"Tag {new!r} already exists")
+        if not self.file_io.rename(self.tag_path(old),
+                                   self.tag_path(new)):
+            raise RuntimeError(f"renaming tag {old!r} failed")
+
     def delete_tag(self, name: str):
         self.file_io.delete_quietly(self.tag_path(name))
 

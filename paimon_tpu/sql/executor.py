@@ -1541,6 +1541,74 @@ class SQLContext:
                 raise SQLError("mark_partition_done needs partitions")
             marked = table.mark_partitions_done([str(p) for p in rest])
             return _result([f"{len(marked)} partitions marked done"])
+        if proc == "expire_changelogs":
+            # reference flink/procedure/ExpireChangelogsProcedure
+            from paimon_tpu.maintenance.expire import expire_changelogs
+            r = expire_changelogs(
+                table,
+                retain_max=int(rest[0]) if len(rest) > 0 else None,
+                retain_min=int(rest[1]) if len(rest) > 1 else None)
+            return _result([f"{len(r.expired_snapshots)} changelogs "
+                            f"expired"])
+        if proc == "expire_tags":
+            # reference flink/procedure/ExpireTagsProcedure: drop tags
+            # whose tag.time-retained elapsed
+            expired = table.tag_manager.expire_tags()
+            return _result([f"{len(expired)} tags expired"] +
+                           [str(t) for t in expired])
+        if proc == "rename_tag":
+            # reference flink/procedure/RenameTagProcedure
+            if len(rest) != 2:
+                raise SQLError("rename_tag needs (old, new)")
+            old, new = str(rest[0]), str(rest[1])
+            table.tag_manager.rename_tag(old, new)
+            return _result([f"tag {old} renamed to {new}"])
+        if proc == "clear_consumers":
+            # reference flink/procedure/ClearConsumersProcedure:
+            # optional regex filter over consumer ids
+            import re as _re
+            cm = table.consumer_manager
+            pattern = _re.compile(str(rest[0])) if rest else None
+            cleared = []
+            for cid in list(cm.consumers()):
+                if pattern is None or pattern.fullmatch(cid):
+                    cm.delete_consumer(cid)
+                    cleared.append(cid)
+            return _result([f"{len(cleared)} consumers cleared"])
+        if proc in ("rollback_to_timestamp", "create_tag_from_timestamp"):
+            # reference RollbackToTimestampProcedure /
+            # CreateTagFromTimestampProcedure: latest snapshot with
+            # time_millis <= ts
+            need = 1 if proc.startswith("rollback") else 2
+            if len(rest) < need:
+                raise SQLError(f"{proc} needs a timestamp (millis)"
+                               if need == 1
+                               else f"{proc} needs (tag, millis)")
+            ts = int(rest[-1])
+            sm = table.snapshot_manager
+            best = None
+            for sid in range(sm.earliest_snapshot_id() or 1,
+                             (sm.latest_snapshot_id() or 0) + 1):
+                try:
+                    s = sm.snapshot(sid)
+                except FileNotFoundError:
+                    continue
+                if s.time_millis <= ts:
+                    best = s
+                else:
+                    break          # commit times are non-decreasing
+            if best is None:
+                raise SQLError(f"no snapshot at or before {ts}")
+            if proc == "rollback_to_timestamp":
+                table.rollback_to(best.id)
+                return _result([f"rolled back to snapshot {best.id}"])
+            table.create_tag(str(rest[0]), snapshot_id=best.id)
+            return _result([f"tag {rest[0]} -> snapshot {best.id}"])
+        if proc == "trigger_tag_automatic_creation":
+            # reference TriggerTagAutomaticCreationProcedure
+            from paimon_tpu.maintenance.tag_auto import maybe_create_tags
+            created = maybe_create_tags(table)
+            return _result([f"{len(created)} tags created"] + created)
         raise SQLError(f"unknown procedure {c.procedure!r}")
 
 

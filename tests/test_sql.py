@@ -1143,3 +1143,86 @@ class TestScalarSubquery:
                 "(4, (SELECT min(v) FROM db.t))")
         got = ctx.sql("SELECT v FROM db.t WHERE id = 4").to_pylist()
         assert got == [{"v": 2.5}]
+
+
+class TestMaintenanceProcedures:
+    """CALL sys.* parity with the reference's procedure set
+    (flink/procedure/*Procedure.java)."""
+
+    def _ctx(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, v DOUBLE, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        for i in range(3):
+            ctx.sql(f"INSERT INTO db.t VALUES ({i}, {float(i)})")
+        return ctx
+
+    def test_rename_tag(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CALL sys.create_tag('db.t', 'old')")
+        ctx.sql("CALL sys.rename_tag('db.t', 'old', 'new')")
+        tags = ctx.sql("SELECT tag_name FROM db.`t$tags`").to_pylist()
+        assert [t["tag_name"] for t in tags] == ["new"]
+
+    def test_rollback_and_tag_from_timestamp(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        cat = ctx.catalog
+        t = cat.get_table("db.t")
+        snap2 = t.snapshot_manager.snapshot(2)
+        ctx.sql(f"CALL sys.create_tag_from_timestamp('db.t', 'at2', "
+                f"{snap2.time_millis})")
+        got = ctx.sql("SELECT count(*) AS n FROM db.t "
+                      "VERSION AS OF 'at2'").to_pylist()
+        assert got == [{"n": 2}]
+        ctx.sql(f"CALL sys.rollback_to_timestamp('db.t', "
+                f"{snap2.time_millis})")
+        assert ctx.sql("SELECT count(*) AS n FROM db.t").to_pylist() \
+            == [{"n": 2}]
+
+    def test_clear_consumers(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        t = ctx.catalog.get_table("db.t")
+        t.consumer_manager.record_consumer("job-a", 2)
+        t.consumer_manager.record_consumer("other", 2)
+        ctx.sql("CALL sys.clear_consumers('db.t', 'job-.*')")
+        assert list(t.consumer_manager.consumers()) == ["other"]
+        ctx.sql("CALL sys.clear_consumers('db.t')")
+        assert not t.consumer_manager.consumers()
+
+    def test_expire_tags_and_trigger_auto(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        out = ctx.sql("CALL sys.expire_tags('db.t')")
+        assert "0 tags expired" in str(out.to_pylist())
+        # the procedure rides the table options: set via ALTER
+        ctx.sql("ALTER TABLE db.t SET "
+                "('tag.automatic-creation'='process-time', "
+                "'tag.creation-period'='daily')")
+        out = ctx.sql("CALL sys.trigger_tag_automatic_creation('db.t')")
+        assert "tags created" in str(out.to_pylist())
+
+    def test_expire_changelogs_procedure(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        out = ctx.sql("CALL sys.expire_changelogs('db.t', 1)")
+        assert "expired" in str(out.to_pylist())
+
+    def test_rename_tag_preserves_retention(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        t = ctx.catalog.get_table("db.t")
+        t.tag_manager.create_tag(t.latest_snapshot(), "tmp",
+                                 time_retained_ms=60_000)
+        ctx.sql("CALL sys.rename_tag('db.t', 'tmp', 'kept')")
+        import json
+        raw = json.loads(t.file_io.read_utf8(
+            t.tag_manager.tag_path("kept")))
+        assert raw.get("tagTimeRetained") == 60_000
+
+    def test_tag_from_timestamp_arity(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="tag, millis"):
+            ctx.sql("CALL sys.create_tag_from_timestamp('db.t', "
+                    "1690000000000)")
