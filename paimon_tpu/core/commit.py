@@ -200,7 +200,8 @@ class FileStoreCommit:
                     entries_fn=None,
                     expected_latest_id: Optional[int] = ...,
                     statistics: Optional[str] = None,
-                    watermark: Optional[int] = None) -> int:
+                    watermark: Optional[int] = None,
+                    force_full_manifest_merge: bool = False) -> int:
         from paimon_tpu.metrics import global_registry
         import time as _time
 
@@ -289,7 +290,8 @@ class FileStoreCommit:
                 prev_index = latest.index_manifest
 
             base_metas, merged_manifests = \
-                self._maybe_merge_manifests(base_metas)
+                self._maybe_merge_manifests(
+                    base_metas, force=force_full_manifest_merge)
             base_name, base_size = self.manifest_list.write(base_metas)
             delta_metas = [new_manifest] if new_manifest else []
             delta_name, delta_size = self.manifest_list.write(delta_metas)
@@ -436,13 +438,37 @@ class FileStoreCommit:
                         f"{e.file.file_name}; a concurrent compaction "
                         f"wrote this level. Retry from the new snapshot.")
 
-    def _maybe_merge_manifests(self, metas: List[ManifestFileMeta]
+    def compact_manifests(self) -> Optional[int]:
+        """Force one full manifest rewrite: every base+delta manifest is
+        read, DELETE entries are folded away, and the merged entry set
+        is committed as a COMPACT snapshot with an empty delta
+        (reference flink/procedure/CompactManifestProcedure). Returns
+        the new snapshot id, or None when the table has no snapshot."""
+        if self.snapshot_manager.latest_snapshot() is None:
+            return None
+        return self._try_commit([], [], BATCH_COMMIT_IDENTIFIER,
+                                CommitKind.COMPACT,
+                                force_full_manifest_merge=True)
+
+    def _maybe_merge_manifests(self, metas: List[ManifestFileMeta],
+                               force: bool = False
                                ) -> Tuple[List[ManifestFileMeta],
                                           List[ManifestFileMeta]]:
         """Full-rewrite small manifests when there are too many
-        (reference manifest/ManifestFileMerger). Returns (metas,
-        newly_written) so the caller can delete fresh files if the commit
-        attempt loses the CAS."""
+        (reference manifest/ManifestFileMerger); `force` merges
+        EVERYTHING and folds DELETE entries (compact_manifests).
+        Returns (metas, newly_written) so the caller can delete fresh
+        files if the commit attempt loses the CAS."""
+        if force:
+            entries: List[ManifestEntry] = []
+            for m in metas:
+                entries.extend(self.manifest_file.read(m.file_name))
+            merged = merge_manifest_entries(entries)
+            if not merged:
+                return [], []
+            meta = self.manifest_file.write(merged,
+                                            schema_id=self.schema.id)
+            return [meta], [meta]
         if len(metas) < self.manifest_merge_min:
             return metas, []
         small = [m for m in metas if m.file_size < self.manifest_target_size]

@@ -1,0 +1,73 @@
+"""Repair actions: reconcile metadata with what storage actually holds.
+
+reference: flink/action/RemoveUnexistingFilesAction (+ its procedure)
+— manifests can reference data files a human or broken tool deleted;
+every scan then fails. The repair commits DELETE entries for the
+missing files so the table becomes readable again (data in those files
+is gone either way).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+__all__ = ["remove_unexisting_files", "compact_manifests"]
+
+
+def remove_unexisting_files(table, dry_run: bool = False) -> List[str]:
+    """Commit DELETE manifest entries for referenced data files that no
+    longer exist on storage. Returns the missing paths (dry_run only
+    reports). External-path files are checked at their recorded
+    location."""
+    from paimon_tpu.core.commit import FileStoreCommit
+    from paimon_tpu.core.write import CommitMessage
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paimon_tpu.options import CoreOptions
+
+    snapshot = table.snapshot_manager.latest_snapshot()
+    if snapshot is None:
+        return []
+    scan = table.new_scan()
+    entries = list(scan.read_entries(snapshot))
+    paths = []
+    for e in entries:
+        partition = scan._partition_codec.from_bytes(e.partition)
+        paths.append(
+            e.file.external_path or scan.path_factory.data_file_path(
+                partition, e.bucket, e.file.file_name))
+    # existence probes are HEADs on object storage: fan out (same
+    # pattern/knob as file deletion, delete-file.thread-num)
+    workers = max(1, table.options.get(
+        CoreOptions.DELETE_FILE_THREAD_NUM) or 4)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        exists = list(pool.map(table.file_io.exists, paths))
+    missing_paths: List[str] = []
+    msgs = {}
+    for e, path, ok in zip(entries, paths, exists):
+        if ok:
+            continue
+        partition = scan._partition_codec.from_bytes(e.partition)
+        m = msgs.setdefault(
+            (e.partition, e.bucket),
+            CommitMessage(partition, e.bucket, e.total_buckets))
+        m.compact_before.append(e.file)
+        missing_paths.append(path)
+    if dry_run or not msgs:
+        return missing_paths
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    commit.commit(list(msgs.values()))
+    return missing_paths
+
+
+def compact_manifests(table):
+    """Force a full manifest rewrite (fold DELETEs, one merged
+    manifest) committed as a COMPACT snapshot (reference
+    flink/procedure/CompactManifestProcedure)."""
+    from paimon_tpu.core.commit import FileStoreCommit
+
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    return commit.compact_manifests()

@@ -1604,6 +1604,24 @@ class SQLContext:
                 return _result([f"rolled back to snapshot {best.id}"])
             table.create_tag(str(rest[0]), snapshot_id=best.id)
             return _result([f"tag {rest[0]} -> snapshot {best.id}"])
+        if proc == "remove_unexisting_files":
+            # reference RemoveUnexistingFilesProcedure: reconcile
+            # manifests with storage after out-of-band deletions
+            from paimon_tpu.maintenance.repair import (
+                remove_unexisting_files,
+            )
+            dry = bool(rest) and str(rest[0]).lower() in ("true", "1")
+            gone = remove_unexisting_files(table, dry_run=dry)
+            verb = "missing" if dry else "removed"
+            return _result([f"{len(gone)} files {verb}"] + gone)
+        if proc == "compact_manifest":
+            # reference CompactManifestProcedure
+            from paimon_tpu.maintenance.repair import compact_manifests
+            sid = compact_manifests(table)
+            return _result(
+                ["table has no snapshots; nothing to compact"]
+                if sid is None
+                else [f"manifests compacted in snapshot {sid}"])
         if proc == "trigger_tag_automatic_creation":
             # reference TriggerTagAutomaticCreationProcedure
             from paimon_tpu.maintenance.tag_auto import maybe_create_tags

@@ -1,0 +1,100 @@
+"""Repair actions: remove_unexisting_files + compact_manifest.
+
+reference: flink/action/RemoveUnexistingFilesAction,
+flink/procedure/CompactManifestProcedure.
+"""
+
+import os
+
+import pytest
+
+from paimon_tpu.maintenance.repair import (
+    compact_manifests, remove_unexisting_files,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+
+def _make(tmp, opts=None):
+    o = {"bucket": "1", "write-only": "true"}
+    o.update(opts or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .primary_key("id")
+              .options(o)
+              .build())
+    return FileStoreTable.create(os.path.join(tmp, "t"), schema)
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+class TestRemoveUnexistingFiles:
+    def test_reconciles_after_manual_deletion(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": 1.0}])
+        _commit(t, [{"id": 2, "v": 2.0}])
+        # a human deletes a data file out of band
+        split = t.new_read_builder().new_scan().plan().splits[0]
+        victim = max(split.data_files,
+                     key=lambda f: f.min_sequence_number)
+        path = t.new_scan().path_factory.data_file_path(
+            (), 0, victim.file_name)
+        os.remove(path)
+        with pytest.raises(Exception):
+            t.to_arrow()
+        # dry run reports without committing
+        missing = remove_unexisting_files(t, dry_run=True)
+        assert missing == [path]
+        with pytest.raises(Exception):
+            t.to_arrow()
+        # repair commits DELETE entries; table is readable again
+        gone = remove_unexisting_files(t)
+        assert gone == [path]
+        t2 = FileStoreTable.load(t.path)
+        assert t2.to_arrow().column("id").to_pylist() == [1]
+
+    def test_noop_when_all_present(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": 1.0}])
+        before = t.latest_snapshot().id
+        assert remove_unexisting_files(t) == []
+        assert t.latest_snapshot().id == before
+
+
+class TestCompactManifests:
+    def test_merges_to_one_manifest(self, tmp_path):
+        # high merge-min so commits accumulate many small manifests
+        t = _make(str(tmp_path), {"manifest.merge-min-count": "1000"})
+        for i in range(6):
+            _commit(t, [{"id": i, "v": float(i)}])
+        snap = t.latest_snapshot()
+        scan = t.new_scan()
+        base = scan.manifest_list.read_all(snap.base_manifest_list,
+                                           snap.delta_manifest_list)
+        assert len(base) > 1
+        sid = compact_manifests(t)
+        assert sid == snap.id + 1
+        t2 = FileStoreTable.load(t.path)
+        snap2 = t2.latest_snapshot()
+        assert snap2.commit_kind == "COMPACT"
+        scan2 = t2.new_scan()
+        base2 = scan2.manifest_list.read_all(snap2.base_manifest_list,
+                                             snap2.delta_manifest_list)
+        assert len(base2) == 1
+        assert sorted(t2.to_arrow().column("id").to_pylist()) == \
+            list(range(6))
+        # row accounting survives the rewrite
+        assert snap2.total_record_count == snap.total_record_count
+
+    def test_empty_table_noop(self, tmp_path):
+        t = _make(str(tmp_path))
+        assert compact_manifests(t) is None

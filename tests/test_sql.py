@@ -1226,3 +1226,12 @@ class TestMaintenanceProcedures:
         with pytest.raises(SQLError, match="tag, millis"):
             ctx.sql("CALL sys.create_tag_from_timestamp('db.t', "
                     "1690000000000)")
+
+    def test_repair_procedures(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        out = ctx.sql("CALL sys.remove_unexisting_files('db.t')")
+        assert "0 files removed" in str(out.to_pylist())
+        out = ctx.sql("CALL sys.compact_manifest('db.t')")
+        assert "manifests compacted" in str(out.to_pylist())
+        assert ctx.sql("SELECT count(*) AS n FROM db.t").to_pylist() \
+            == [{"n": 3}]
