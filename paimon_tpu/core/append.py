@@ -370,7 +370,9 @@ class AppendSplitRead:
                     if len(group) == 1 and anchor.first_row_id is None:
                         t = self.read_file(
                             split, anchor,
-                            wanted=self._value_columns()) \
+                            wanted=self._value_columns())
+                        t = self._fill_partition_columns(
+                            t, set(t.column_names), split.partition) \
                             .select(self._value_columns())
                         if want_rid:
                             t = t.append_column(
@@ -378,6 +380,8 @@ class AppendSplitRead:
                                 pa.nulls(t.num_rows, pa.int64()))
                     else:
                         t = read_evolution_group(self, split, group, cols)
+                        t = self._fill_partition_columns(
+                            t, set(t.column_names), split.partition)
                 except Exception:
                     if self.options.get(
                             CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
@@ -413,7 +417,10 @@ class AppendSplitRead:
                                       f"{meta.file_name}", RuntimeWarning)
                         continue
                     raise
+                raw_cols = set(t.column_names)
                 t = self._evolve(t, meta.schema_id)
+                t = self._fill_partition_columns(t, raw_cols,
+                                                 split.partition)
                 keep = self._index_selection(split, meta, t.num_rows)
                 if split.deletion_vectors and \
                         meta.file_name in split.deletion_vectors and \
@@ -460,6 +467,28 @@ class AppendSplitRead:
         from paimon_tpu.core.read import evolve_table
         return evolve_table(table, file_schema_id, self.schema,
                             self.schema_manager, self._schema_cache)
+
+    def _fill_partition_columns(self, t: pa.Table, raw_cols: set,
+                                partition: Tuple) -> pa.Table:
+        """Partition columns ABSENT from the stored file are constants
+        derived from the partition path — fill them (reference
+        PartitionInfo patching in the data-file readers; this is what
+        makes migrated hive files, which never store partition values,
+        readable as paimon rows)."""
+        pkeys = self.schema.partition_keys
+        if not pkeys or not partition:
+            return t
+        by_name = {f.name: f for f in self.schema.fields}
+        for k, v in zip(pkeys, partition):
+            if k in raw_cols or k not in by_name:
+                continue
+            typ = data_type_to_arrow(by_name[k].type)
+            const = pa.repeat(pa.scalar(v).cast(typ), t.num_rows)
+            if k in t.column_names:
+                t = t.set_column(t.column_names.index(k), k, const)
+            else:
+                t = t.append_column(k, const)
+        return t
 
 
 @dataclass

@@ -1442,6 +1442,23 @@ class SQLContext:
         args = list(c.args)
         if not args:
             raise SQLError("CALL procedures take the table name first")
+        if proc == "migrate_table":
+            # CALL sys.migrate_table('/path/to/hive_dir', 'db.t'
+            #   [, 'parquet'[, move]]) — reference
+            # MigrateTableProcedure (ours takes the source DIRECTORY;
+            # no Hive metastore exists in this environment)
+            from paimon_tpu.maintenance.migrate import migrate_table
+            if len(args) < 2:
+                raise SQLError("migrate_table needs (source_dir, "
+                               "'db.table')")
+            fmt = str(args[2]) if len(args) > 2 else "parquet"
+            move = str(args[3]).lower() in ("true", "1") \
+                if len(args) > 3 else True
+            t = migrate_table(self.catalog, str(args[0]), str(args[1]),
+                              file_format=fmt, move=move)
+            snap = t.latest_snapshot()
+            return _result([f"migrated {snap.total_record_count} rows "
+                            f"into {args[1]}"])
         table = self.catalog.get_table(self._ident(str(args[0])))
         rest = args[1:]
         if proc == "compact":

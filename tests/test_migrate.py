@@ -1,0 +1,97 @@
+"""In-place migration of plain file tables into paimon append tables.
+
+reference: flink/procedure/MigrateTableProcedure +
+migrate/FileMigrationUtils (metadata-only: files are moved, never
+rewritten).
+"""
+
+import glob
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from paimon_tpu.catalog import create_catalog
+from paimon_tpu.maintenance.migrate import migrate_table
+
+
+def _hive_dir(root, partitioned=True):
+    """Build a hive-style parquet directory: dt=a/, dt=b/."""
+    n = 0
+    for dt in (["a", "b"] if partitioned else [None]):
+        d = os.path.join(root, f"dt={dt}") if dt else root
+        os.makedirs(d, exist_ok=True)
+        for i in range(2):
+            t = pa.table({
+                "id": pa.array(range(n, n + 5), pa.int64()),
+                "v": pa.array([float(x) for x in range(5)],
+                              pa.float64()),
+            })
+            pq.write_table(t, os.path.join(d, f"part-{i}.parquet"))
+            n += 5
+    return n
+
+
+class TestMigrate:
+    def test_partitioned_move(self, tmp_path):
+        src = str(tmp_path / "hive_t")
+        total = _hive_dir(src)
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        cat.create_database("db", ignore_if_exists=True)
+        t = migrate_table(cat, src, "db.m", move=True)
+        # all rows visible, partition column materialized
+        got = t.to_arrow()
+        assert got.num_rows == total
+        assert sorted(set(got.column("dt").to_pylist())) == ["a", "b"]
+        assert sorted(got.column("id").to_pylist()) == list(range(total))
+        # files were MOVED (source drained), never rewritten
+        assert not glob.glob(f"{src}/**/*.parquet", recursive=True)
+        # partition pruning works on the migrated layout
+        pruned = t.copy({}).new_read_builder() \
+            .with_partition_filter({"dt": "a"}).new_scan().plan()
+        assert {tuple(s.partition) for s in pruned.splits} == {("a",)}
+        # and the table behaves like any append table afterwards
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": 999, "v": 9.0, "dt": "a"}])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+        assert t.to_arrow().num_rows == total + 1
+
+    def test_unpartitioned_copy_keeps_source(self, tmp_path):
+        src = str(tmp_path / "flat_t")
+        total = _hive_dir(src, partitioned=False)
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        cat.create_database("db", ignore_if_exists=True)
+        t = migrate_table(cat, src, "db.m2", move=False)
+        assert t.to_arrow().num_rows == total
+        assert len(glob.glob(f"{src}/*.parquet")) == 2   # source intact
+
+    def test_sql_procedure(self, tmp_path):
+        from paimon_tpu.sql import SQLContext
+        src = str(tmp_path / "h")
+        total = _hive_dir(src)
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        out = ctx.sql(f"CALL sys.migrate_table('{src}', 'db.mt')")
+        assert f"migrated {total} rows" in str(out.to_pylist())
+        got = ctx.sql("SELECT count(*) AS n FROM db.mt "
+                      "WHERE dt = 'a'").to_pylist()
+        assert got == [{"n": total // 2}]
+
+    def test_row_id_read_path_fills_partitions(self, tmp_path):
+        """Row-range read branch (with_row_ids) must fill partition
+        columns absent from migrated files too."""
+        src = str(tmp_path / "h2")
+        total = _hive_dir(src)
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        cat.create_database("db", ignore_if_exists=True)
+        t = migrate_table(cat, src, "db.rr", move=True)
+        rb = t.new_read_builder()
+        if hasattr(rb, "with_row_ids"):
+            rb = rb.with_row_ids(True)
+        got = rb.new_read().to_arrow(rb.new_scan().plan().splits)
+        assert got.num_rows == total
+        assert sorted(set(got.column("dt").to_pylist())) == ["a", "b"]
