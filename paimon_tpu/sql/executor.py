@@ -1454,11 +1454,23 @@ class SQLContext:
             fmt = str(args[2]) if len(args) > 2 else "parquet"
             move = str(args[3]).lower() in ("true", "1") \
                 if len(args) > 3 else True
-            t = migrate_table(self.catalog, str(args[0]), str(args[1]),
+            t = migrate_table(self.catalog, str(args[0]),
+                              self._ident(str(args[1])),
                               file_format=fmt, move=move)
             snap = t.latest_snapshot()
             return _result([f"migrated {snap.total_record_count} rows "
                             f"into {args[1]}"])
+        if proc == "clone":
+            # CALL sys.clone('db.src', 'db.dst') — reference
+            # CloneProcedure: independent copy of the current state
+            from paimon_tpu.maintenance.clone import clone_table
+            if len(args) < 2:
+                raise SQLError("clone needs (source, target)")
+            t = clone_table(self.catalog, self._ident(str(args[0])),
+                            self._ident(str(args[1])))
+            snap = t.latest_snapshot()
+            rows = snap.total_record_count if snap else 0
+            return _result([f"cloned {rows} rows into {args[1]}"])
         table = self.catalog.get_table(self._ident(str(args[0])))
         rest = args[1:]
         if proc == "compact":

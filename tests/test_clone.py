@@ -1,0 +1,120 @@
+"""Clone: independent copy of a table's current state.
+
+reference: flink/procedure/CloneProcedure + clone/ actions.
+"""
+
+import os
+
+import pytest
+
+from paimon_tpu.catalog import create_catalog
+from paimon_tpu.maintenance.clone import clone_table
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, RowKind
+
+
+def _commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows, row_kinds=kinds)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+def _cat(tmp_path):
+    cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+    cat.create_database("db", ignore_if_exists=True)
+    return cat
+
+
+class TestClone:
+    def test_pk_table_levels_and_independence(self, tmp_path):
+        cat = _cat(tmp_path)
+        src = cat.create_table("db.src", (
+            Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .primary_key("id")
+            .options({"bucket": "1"})
+            .build()))
+        _commit(src, [{"id": i, "v": float(i)} for i in range(10)])
+        src.compact(full=True)
+        _commit(src, [{"id": 1, "v": 111.0}])     # L0 over compacted L5
+
+        dst = clone_table(cat, "db.src", "db.dst")
+        got = dst.to_arrow().sort_by("id")
+        assert got.num_rows == 10
+        assert got.column("v").to_pylist()[1] == 111.0   # merge preserved
+
+        # the clone is INDEPENDENT: writes diverge both ways
+        _commit(dst, [{"id": 99, "v": 9.0}])
+        _commit(src, [{"id": 50, "v": 5.0}])
+        assert dst.to_arrow().num_rows == 11
+        assert FileStoreTable.load(src.path).to_arrow().num_rows == 11
+        assert 99 not in FileStoreTable.load(src.path) \
+            .to_arrow().column("id").to_pylist()
+
+    def test_clone_carries_deletion_vectors(self, tmp_path):
+        from paimon_tpu import predicate as P
+        cat = _cat(tmp_path)
+        src = cat.create_table("db.s2", (
+            Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .options({"bucket": "-1"})
+            .build()))
+        _commit(src, [{"id": i, "v": float(i)} for i in range(8)])
+        src.delete_where(P.less_than("id", 3))
+        assert src.to_arrow().num_rows == 5
+        dst = clone_table(cat, "db.s2", "db.d2")
+        assert sorted(dst.to_arrow().column("id").to_pylist()) == \
+            [3, 4, 5, 6, 7]
+
+    def test_sql_procedure(self, tmp_path):
+        from paimon_tpu.sql import SQLContext
+        cat = _cat(tmp_path)
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE TABLE db.a (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.a VALUES (1), (2)")
+        out = ctx.sql("CALL sys.clone('db.a', 'db.b')")
+        assert "cloned" in str(out.to_pylist())
+        assert ctx.sql("SELECT count(*) AS n FROM db.b").to_pylist() \
+            == [{"n": 2}]
+
+    def test_clone_schema_evolved_table(self, tmp_path):
+        from paimon_tpu.schema import SchemaChange, SchemaManager
+        from paimon_tpu.types import IntType
+        cat = _cat(tmp_path)
+        src = cat.create_table("db.ev", (
+            Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .primary_key("id")
+            .options({"bucket": "1"})
+            .build()))
+        _commit(src, [{"id": 1, "v": 1.0}])
+        sm = SchemaManager(src.file_io, src.path)
+        sm.commit_changes(SchemaChange.add_column("extra", IntType()))
+        src = FileStoreTable.load(src.path)
+        _commit(src, [{"id": 2, "v": 2.0, "extra": 7}])
+
+        dst = clone_table(cat, "db.ev", "db.ev2")
+        got = dst.to_arrow().sort_by("id").to_pylist()
+        assert got == [{"id": 1, "v": 1.0, "extra": None},
+                       {"id": 2, "v": 2.0, "extra": 7}]
+
+    def test_clone_unqualified_names_via_use(self, tmp_path):
+        from paimon_tpu.sql import SQLContext
+        cat = _cat(tmp_path)
+        ctx = SQLContext(cat)
+        ctx.sql("USE db")
+        ctx.sql("CREATE TABLE s3 (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO s3 VALUES (1)")
+        out = ctx.sql("CALL sys.clone('s3', 'd3')")
+        assert "cloned 1 rows" in str(out.to_pylist())
+        assert ctx.sql("SELECT count(*) AS n FROM d3").to_pylist() == \
+            [{"n": 1}]
