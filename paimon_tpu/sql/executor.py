@@ -633,6 +633,8 @@ class SQLContext:
         FALSE on a match else NULL (never TRUE) — via a CASE over the
         non-null match set."""
         def fn(e):
+            if isinstance(e, ast.ExistsSubquery):
+                return self._rewrite_exists(e, fn)
             if isinstance(e, ast.ScalarSubquery):
                 sub = self._exec_select(e.select)
                 if sub.num_columns != 1:
@@ -662,9 +664,101 @@ class SQLContext:
                 default=ast.Literal(None))
         return fn
 
+    def _rewrite_exists(self, e: "ast.ExistsSubquery", fn):
+        """[NOT] EXISTS handling. Uncorrelated: evaluate once with
+        LIMIT 1 -> boolean literal. Correlated on ONE outer-column
+        equality over a single-table subquery: decorrelate to
+        `outer [NOT] IN (SELECT inner FROM ... WHERE rest AND inner IS
+        NOT NULL)` — the IS NOT NULL keeps NOT EXISTS semantics exact
+        (a NULL inner value can never satisfy the equality, and a
+        null-free set sidesteps NOT IN's three-valued trap)."""
+        sub = e.select
+
+        def conjuncts(x):
+            if isinstance(x, ast.Binary) and x.op == "AND":
+                return conjuncts(x.left) + conjuncts(x.right)
+            return [x] if x is not None else []
+
+        inner_cols = inner_alias = None
+        if isinstance(sub.from_, ast.TableRef) and not sub.joins:
+            try:
+                tbl = self.catalog.get_table(
+                    self._ident(sub.from_.name))
+                inner_cols = {f.name for f in tbl.row_type().fields}
+                inner_alias = sub.from_.alias or \
+                    sub.from_.name.split(".")[-1]
+            except Exception:
+                pass
+
+        def is_inner(col: "ast.Column") -> bool:
+            if col.qualifier:
+                return col.qualifier == inner_alias
+            return inner_cols is not None and col.name in inner_cols
+
+        outer_col = inner_col = None
+        rest = []
+        for c in conjuncts(sub.where):
+            if isinstance(c, ast.Binary) and c.op == "=" and \
+                    isinstance(c.left, ast.Column) and \
+                    isinstance(c.right, ast.Column) and \
+                    inner_cols is not None:
+                li, ri = is_inner(c.left), is_inner(c.right)
+                if li != ri:
+                    if outer_col is not None:
+                        raise SQLError(
+                            "EXISTS with multiple correlated "
+                            "equalities is not supported")
+                    inner_col = c.left if li else c.right
+                    outer_col = c.right if li else c.left
+                    continue
+            rest.append(c)
+
+        if outer_col is None:
+            # uncorrelated: one probe row decides the constant. Keep
+            # the WHOLE query shape (UNION branches, LIMIT/OFFSET
+            # semantics) — only add LIMIT 1 when none was given
+            import copy as _copy
+            probe = _copy.deepcopy(sub)
+            if probe.limit is None and probe.offset is None and \
+                    probe.union_all is None:
+                probe.limit = 1
+            t = self._exec_select(probe)
+            return ast.Literal((t.num_rows > 0) != e.negated)
+
+        def has_aggregate(x) -> bool:
+            return bool(_find_funcs(
+                x, lambda f: f.name in _AGG_FUNCS and f.over is None))
+
+        if sub.group_by or sub.having or sub.distinct or \
+                any(has_aggregate(i.expr) for i in sub.items):
+            # an ungrouped aggregate always yields one row, making
+            # EXISTS unconditionally true — decorrelation would
+            # silently change that, so refuse
+            raise SQLError("correlated EXISTS does not support "
+                           "GROUP BY/HAVING/DISTINCT/aggregates")
+        if sub.limit is not None or sub.offset:
+            raise SQLError("correlated EXISTS does not support "
+                           "LIMIT/OFFSET")
+        where = ast.IsNull(inner_col, negated=True)
+        for c in rest:
+            where = ast.Binary("AND", where, c)
+        inner_sel = ast.Select(
+            items=[ast.SelectItem(inner_col)], from_=sub.from_,
+            where=where)
+        # feed the result back through the rewriter so the IN subquery
+        # materializes in the same pass; then pin the OUTER-null case
+        # explicitly — NULL probe means the equality can never hold,
+        # so EXISTS is FALSE and NOT EXISTS is TRUE, independent of
+        # the engine's IN null propagation
+        materialized = fn(ast.InSubquery(outer_col, inner_sel,
+                                         e.negated))
+        return ast.Case(
+            whens=[(ast.IsNull(outer_col), ast.Literal(e.negated))],
+            default=materialized)
+
     def _materialize_subqueries(self, s: ast.Select) -> None:
-        """In place and idempotent — leaves no InSubquery or
-        ScalarSubquery behind."""
+        """In place and idempotent — leaves no InSubquery,
+        ScalarSubquery or ExistsSubquery behind."""
         _rewrite_select_exprs(s, self._subquery_rewriter())
 
     def _exec_select(self, s: ast.Select,
@@ -1866,6 +1960,8 @@ def _transform(e, fn):
         _rewrite_select_exprs(e.select, fn)
         e = ast.InSubquery(_transform(e.expr, fn), e.select, e.negated)
     elif isinstance(e, ast.ScalarSubquery):
+        _rewrite_select_exprs(e.select, fn)
+    elif isinstance(e, ast.ExistsSubquery):
         _rewrite_select_exprs(e.select, fn)
     elif isinstance(e, ast.BetweenExpr):
         e = ast.BetweenExpr(_transform(e.expr, fn),

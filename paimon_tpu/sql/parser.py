@@ -231,6 +231,15 @@ class ScalarSubquery:
 
 
 @dataclass
+class ExistsSubquery:
+    """[NOT] EXISTS (SELECT ...). Uncorrelated: evaluated once.
+    Correlated on a single outer-column equality: decorrelated to a
+    semi-join-shaped IN by the executor."""
+    select: Any
+    negated: bool = False
+
+
+@dataclass
 class BetweenExpr:
     expr: Any
     lo: Any
@@ -821,9 +830,23 @@ class Parser:
         return left
 
     def not_expr(self):
+        if self.at_kw("NOT") and self.peek(1).kind == "KEYWORD" and \
+                self.peek(1).value == "EXISTS":
+            self.next()
+            return self._exists(negated=True)
         if self.accept_kw("NOT"):
             return Unary("NOT", self.not_expr())
+        if self.at_kw("EXISTS") and self.peek(1).kind == "OP" and \
+                self.peek(1).value == "(":
+            return self._exists(negated=False)
         return self.comparison()
+
+    def _exists(self, negated: bool) -> "ExistsSubquery":
+        self.expect_kw("EXISTS")
+        self.expect_op("(")
+        sub = self.select_or_with()
+        self.expect_op(")")
+        return ExistsSubquery(sub, negated)
 
     def comparison(self):
         left = self.additive()

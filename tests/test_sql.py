@@ -1235,3 +1235,95 @@ class TestMaintenanceProcedures:
         assert "manifests compacted" in str(out.to_pylist())
         assert ctx.sql("SELECT count(*) AS n FROM db.t").to_pylist() \
             == [{"n": 3}]
+
+
+class TestExists:
+    def _ctx(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, v DOUBLE, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("CREATE TABLE db.s (sid BIGINT NOT NULL, r BIGINT, "
+                "PRIMARY KEY (sid)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t VALUES (1, 1.5), (2, 2.5), (3, 3.5)")
+        ctx.sql("INSERT INTO db.s VALUES (10, 2), (11, 3), (12, NULL)")
+        return ctx
+
+    def test_correlated_exists(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                      "(SELECT 1 FROM db.s WHERE r = id) "
+                      "ORDER BY id").to_pylist()
+        assert [x["id"] for x in got] == [2, 3]
+
+    def test_correlated_not_exists_with_inner_nulls(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        # inner NULL r must NOT poison NOT EXISTS (unlike raw NOT IN)
+        got = ctx.sql("SELECT id FROM db.t WHERE NOT EXISTS "
+                      "(SELECT 1 FROM db.s WHERE r = id)").to_pylist()
+        assert [x["id"] for x in got] == [1]
+
+    def test_correlated_with_extra_inner_filter(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                      "(SELECT 1 FROM db.s WHERE r = id AND sid > 10)"
+                      ).to_pylist()
+        assert [x["id"] for x in got] == [3]
+
+    def test_uncorrelated_exists(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        assert len(ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                           "(SELECT 1 FROM db.s WHERE sid > 11)")
+                   .to_pylist()) == 3
+        assert ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                       "(SELECT 1 FROM db.s WHERE sid > 99)") \
+            .to_pylist() == []
+        assert len(ctx.sql("SELECT id FROM db.t WHERE NOT EXISTS "
+                           "(SELECT 1 FROM db.s WHERE sid > 99)")
+                   .to_pylist()) == 3
+
+    def test_qualified_correlation(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        got = ctx.sql(
+            "SELECT t.id FROM db.t t WHERE EXISTS "
+            "(SELECT 1 FROM db.s x WHERE x.r = t.id) ORDER BY t.id"
+        ).to_pylist()
+        assert [x["id"] for x in got] == [2, 3]
+
+    def test_outer_null_correlation(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.u (id BIGINT NOT NULL, w BIGINT, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.u VALUES (1, 2), (2, NULL)")
+        # NULL w: r = w can never hold -> NOT EXISTS is TRUE
+        got = ctx.sql("SELECT id FROM db.u WHERE NOT EXISTS "
+                      "(SELECT 1 FROM db.s WHERE r = w)").to_pylist()
+        assert [x["id"] for x in got] == [2]
+        got = ctx.sql("SELECT id FROM db.u WHERE EXISTS "
+                      "(SELECT 1 FROM db.s WHERE r = w)").to_pylist()
+        assert [x["id"] for x in got] == [1]
+
+    def test_uncorrelated_union_and_limit_shapes(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        # non-empty second UNION branch must count
+        got = ctx.sql(
+            "SELECT id FROM db.t WHERE EXISTS (SELECT sid FROM db.s "
+            "WHERE sid > 99 UNION ALL SELECT id FROM db.t)")
+        assert len(got.to_pylist()) == 3
+        # OFFSET past the end -> empty -> EXISTS false
+        got = ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                      "(SELECT sid FROM db.s LIMIT 10 OFFSET 5)")
+        assert got.to_pylist() == []
+
+    def test_correlated_unsupported_shapes_raise(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="aggregates"):
+            ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                    "(SELECT count(*) FROM db.s WHERE r = id)")
+        with pytest.raises(SQLError, match="LIMIT"):
+            ctx.sql("SELECT id FROM db.t WHERE EXISTS "
+                    "(SELECT 1 FROM db.s WHERE r = id LIMIT 0)")
