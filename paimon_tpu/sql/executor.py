@@ -1737,6 +1737,13 @@ class SQLContext:
             gone = remove_unexisting_files(table, dry_run=dry)
             verb = "missing" if dry else "removed"
             return _result([f"{len(gone)} files {verb}"] + gone)
+        if proc == "rewrite_file_index":
+            # reference RewriteFileIndexProcedure: retrofit per-file
+            # indexes after enabling file-index.* on an existing table
+            from paimon_tpu.maintenance.repair import rewrite_file_index
+            force = bool(rest) and str(rest[0]).lower() in ("true", "1")
+            n = rewrite_file_index(table, force=force)
+            return _result([f"{n} files indexed"])
         if proc == "compact_manifest":
             # reference CompactManifestProcedure
             from paimon_tpu.maintenance.repair import compact_manifests
