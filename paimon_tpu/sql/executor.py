@@ -440,6 +440,7 @@ class SQLContext:
             ast.Describe: self._exec_describe,
             ast.Use: self._exec_use,
             ast.Delete: self._exec_delete,
+            ast.Truncate: self._exec_truncate,
             ast.Update: self._exec_update,
             ast.AlterTable: self._exec_alter,
             ast.Call: self._exec_call,
@@ -1310,6 +1311,12 @@ class SQLContext:
         w.close()
         return _result([f"{out.num_rows} rows inserted"])
 
+    def _exec_truncate(self, t: "ast.Truncate") -> pa.Table:
+        """TRUNCATE TABLE: one OVERWRITE snapshot that drops every live
+        file (reference TRUNCATE via INSERT OVERWRITE / purge)."""
+        _purge_all(self.catalog.get_table(self._ident(t.table)))
+        return _result(["OK"])
+
     def _exec_delete(self, d: ast.Delete) -> pa.Table:
         table = self.catalog.get_table(self._ident(d.table))
         if d.where is None:
@@ -1737,6 +1744,12 @@ class SQLContext:
             gone = remove_unexisting_files(table, dry_run=dry)
             verb = "missing" if dry else "removed"
             return _result([f"{len(gone)} files {verb}"] + gone)
+        if proc == "purge_files":
+            # reference PurgeFilesProcedure: drop all live data in one
+            # OVERWRITE snapshot (time travel to earlier snapshots
+            # keeps working until expiry)
+            _purge_all(table)
+            return _result(["table purged"])
         if proc == "rewrite_file_index":
             # reference RewriteFileIndexProcedure: retrofit per-file
             # indexes after enabling file-index.* on an existing table
@@ -2010,6 +2023,17 @@ def _rewrite_select_exprs(sel: "ast.Select", fn) -> None:
         _rewrite_select_exprs(sel.from_.select, fn)
     if sel.union_all is not None:
         _rewrite_select_exprs(sel.union_all, fn)
+
+
+def _purge_all(table) -> None:
+    """One empty OVERWRITE commit dropping every live file (TRUNCATE
+    TABLE and sys.purge_files share this)."""
+    wb = table.new_batch_write_builder().with_overwrite()
+    w = wb.new_write()
+    try:
+        wb.new_commit().commit(w.prepare_commit())
+    finally:
+        w.close()
 
 
 def _hashable(v):

@@ -37,7 +37,7 @@ _KEYWORDS = {
     "COMMENT", "DROP", "SHOW", "TABLES", "DATABASES", "DESCRIBE", "DESC",
     "USE", "DELETE", "UPDATE", "SET", "RESET", "ALTER", "COLUMN", "RENAME",
     "TO", "CALL", "EXPLAIN", "VERSION", "OF", "FOR", "SYSTEM_TIME",
-    "TIMESTAMP", "ADD",
+    "TIMESTAMP", "ADD", "TRUNCATE",
 }
 
 
@@ -273,6 +273,11 @@ class TableRef:
     snapshot_id: Optional[int] = None
     tag: Optional[str] = None
     timestamp_ms: Optional[int] = None
+
+
+@dataclass
+class Truncate:
+    table: str
 
 
 @dataclass
@@ -556,7 +561,7 @@ class Parser:
         # named "comment" or "key")
         if t.kind == "KEYWORD" and t.value in (
                 "COMMENT", "KEY", "TABLES", "DATABASES", "VERSION", "ALL",
-                "FIRST", "LAST", "TIMESTAMP", "SET"):
+                "FIRST", "LAST", "TIMESTAMP", "SET", "TRUNCATE"):
             return t.value.lower()
         raise SQLError(f"expected identifier, got {t.value!r}")
 
@@ -593,6 +598,9 @@ class Parser:
             return Describe(self.qualified_name())
         if self.accept_kw("USE"):
             return Use(self.ident())
+        if self.accept_kw("TRUNCATE"):
+            self.expect_kw("TABLE")
+            return Truncate(self.qualified_name())
         if self.accept_kw("DELETE"):
             self.expect_kw("FROM")
             tbl = self.qualified_name()
@@ -951,7 +959,8 @@ class Parser:
             self.expect_op(")")
             return e
         if t.kind == "IDENT" or (t.kind == "KEYWORD" and t.value in (
-                "COMMENT", "KEY", "VERSION", "FIRST", "LAST")):
+                "COMMENT", "KEY", "VERSION", "FIRST", "LAST",
+                "TRUNCATE")):
             name = self.ident()
             if name.upper() in ("ARRAY", "MAP") and \
                     self.peek().kind == "OP" and self.peek().value == "[":

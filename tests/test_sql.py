@@ -1327,3 +1327,38 @@ class TestExists:
         with pytest.raises(SQLError, match="LIMIT"):
             ctx.sql("SELECT id FROM db.t WHERE EXISTS "
                     "(SELECT 1 FROM db.s WHERE r = id LIMIT 0)")
+
+
+class TestTruncate:
+    def test_truncate_and_purge(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t VALUES (1), (2)")
+        ctx.sql("TRUNCATE TABLE db.t")
+        assert ctx.sql("SELECT count(*) AS n FROM db.t").to_pylist() \
+            == [{"n": 0}]
+        # time travel still sees the pre-truncate state
+        assert ctx.sql("SELECT count(*) AS n FROM db.t "
+                       "VERSION AS OF 1").to_pylist() == [{"n": 2}]
+        ctx.sql("INSERT INTO db.t VALUES (3)")
+        ctx.sql("CALL sys.purge_files('db.t')")
+        assert ctx.sql("SELECT count(*) AS n FROM db.t").to_pylist() \
+            == [{"n": 0}]
+
+    def test_truncate_not_reserved_as_identifier(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh2")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.k (id BIGINT NOT NULL, "
+                "truncate BIGINT, PRIMARY KEY (id)) "
+                "WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.k VALUES (1, 7)")
+        got = ctx.sql("SELECT truncate FROM db.k").to_pylist()
+        assert got == [{"truncate": 7}]
