@@ -201,7 +201,8 @@ class FileStoreCommit:
                     expected_latest_id: Optional[int] = ...,
                     statistics: Optional[str] = None,
                     watermark: Optional[int] = None,
-                    force_full_manifest_merge: bool = False) -> int:
+                    force_full_manifest_merge: bool = False,
+                    skip_missing_manifests: bool = False) -> int:
         from paimon_tpu.metrics import global_registry
         import time as _time
 
@@ -291,7 +292,8 @@ class FileStoreCommit:
 
             base_metas, merged_manifests = \
                 self._maybe_merge_manifests(
-                    base_metas, force=force_full_manifest_merge)
+                    base_metas, force=force_full_manifest_merge,
+                    skip_missing=skip_missing_manifests)
             base_name, base_size = self.manifest_list.write(base_metas)
             delta_metas = [new_manifest] if new_manifest else []
             delta_name, delta_size = self.manifest_list.write(delta_metas)
@@ -310,6 +312,12 @@ class FileStoreCommit:
                        (watermark, latest.watermark if latest else None)
                        if w is not None]
             new_watermark = max(wm_vals) if wm_vals else None
+            if force_full_manifest_merge and \
+                    getattr(self, "_force_merge_total", None) is not None:
+                # the full rewrite recounted every live entry — use the
+                # true total (skip_missing may have dropped manifests)
+                prev_total = self._force_merge_total
+                self._force_merge_total = None
             delta_rows = sum(
                 (e.file.row_count if e.kind == FileKind.ADD
                  else -e.file.row_count) for e in entries)
@@ -438,20 +446,26 @@ class FileStoreCommit:
                         f"{e.file.file_name}; a concurrent compaction "
                         f"wrote this level. Retry from the new snapshot.")
 
-    def compact_manifests(self) -> Optional[int]:
+    def compact_manifests(self, skip_missing: bool = False
+                          ) -> Optional[int]:
         """Force one full manifest rewrite: every base+delta manifest is
         read, DELETE entries are folded away, and the merged entry set
         is committed as a COMPACT snapshot with an empty delta
         (reference flink/procedure/CompactManifestProcedure). Returns
-        the new snapshot id, or None when the table has no snapshot."""
+        the new snapshot id, or None when the table has no snapshot.
+        `skip_missing` tolerates manifest FILES deleted out of band
+        (reference RemoveUnexistingManifestsProcedure) — entries they
+        held are lost, which is the point of that repair."""
         if self.snapshot_manager.latest_snapshot() is None:
             return None
         return self._try_commit([], [], BATCH_COMMIT_IDENTIFIER,
                                 CommitKind.COMPACT,
-                                force_full_manifest_merge=True)
+                                force_full_manifest_merge=True,
+                                skip_missing_manifests=skip_missing)
 
     def _maybe_merge_manifests(self, metas: List[ManifestFileMeta],
-                               force: bool = False
+                               force: bool = False,
+                               skip_missing: bool = False
                                ) -> Tuple[List[ManifestFileMeta],
                                           List[ManifestFileMeta]]:
         """Full-rewrite small manifests when there are too many
@@ -462,8 +476,19 @@ class FileStoreCommit:
         if force:
             entries: List[ManifestEntry] = []
             for m in metas:
-                entries.extend(self.manifest_file.read(m.file_name))
+                try:
+                    entries.extend(self.manifest_file.read(m.file_name))
+                except FileNotFoundError:
+                    if not skip_missing:
+                        raise
+                    # repair mode: the manifest is gone, its entries
+                    # are unrecoverable — drop it from the chain
             merged = merge_manifest_entries(entries)
+            # the rewrite KNOWS thetrue row total; expose it so the
+            # snapshot does not inherit counts from dropped manifests
+            self._force_merge_total = sum(
+                e.file.row_count for e in merged
+                if e.kind == FileKind.ADD)
             if not merged:
                 return [], []
             meta = self.manifest_file.write(merged,

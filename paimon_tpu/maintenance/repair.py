@@ -11,8 +11,8 @@ from __future__ import annotations
 
 from typing import List
 
-__all__ = ["remove_unexisting_files", "compact_manifests",
-           "rewrite_file_index"]
+__all__ = ["remove_unexisting_files", "remove_unexisting_manifests",
+           "compact_manifests", "rewrite_file_index"]
 
 
 def remove_unexisting_files(table, dry_run: bool = False) -> List[str]:
@@ -158,3 +158,16 @@ def compact_manifests(table):
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)
     return commit.compact_manifests()
+
+
+def remove_unexisting_manifests(table):
+    """Repair a table whose manifest FILES were deleted out of band:
+    rewrite the manifest chain from whatever manifests still exist
+    (their entries are unrecoverable and drop out) — reference
+    flink/procedure/RemoveUnexistingManifestsProcedure. Returns the
+    new snapshot id, or None when nothing was committed."""
+    from paimon_tpu.core.commit import FileStoreCommit
+
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, branch=table.branch)
+    return commit.compact_manifests(skip_missing=True)

@@ -79,6 +79,19 @@ class BranchManager:
     def drop_branch(self, name: str):
         self.file_io.delete(self.branch_path(name), recursive=True)
 
+    def rename_branch(self, old: str, new: str):
+        """Directory rename preserving every branch file verbatim
+        (reference RenameBranchProcedure)."""
+        if old == DEFAULT_MAIN_BRANCH:
+            raise ValueError("cannot rename the main branch")
+        if not self.branch_exists(old):
+            raise ValueError(f"Branch {old!r} not found")
+        if new == DEFAULT_MAIN_BRANCH or self.branch_exists(new):
+            raise ValueError(f"Branch {new!r} already exists")
+        if not self.file_io.rename(self.branch_path(old),
+                                   self.branch_path(new)):
+            raise RuntimeError(f"renaming branch {old!r} failed")
+
     def fast_forward(self, name: str):
         """Replace main's snapshots with the branch's (reference
         BranchManager.fastForward)."""

@@ -1561,6 +1561,21 @@ class SQLContext:
             snap = t.latest_snapshot()
             return _result([f"migrated {snap.total_record_count} rows "
                             f"into {args[1]}"])
+        if proc == "compact_database":
+            # reference CompactDatabaseProcedure: compact every table
+            # in the database (full when the second arg says so)
+            db = str(args[0])
+            full = len(args) > 1 and str(args[1]).lower() in ("true",
+                                                              "1",
+                                                              "full")
+            done = []
+            for name in self.catalog.list_tables(db):
+                t = self.catalog.get_table(f"{db}.{name}")
+                sid = t.compact(full=full)
+                if sid is not None:
+                    done.append(f"{name}@{sid}")
+            return _result(
+                [f"{len(done)} tables compacted"] + done)
         if proc == "clone":
             # CALL sys.clone('db.src', 'db.dst') — reference
             # CloneProcedure: independent copy of the current state
@@ -1617,7 +1632,7 @@ class SQLContext:
         if proc == "rescale":
             table.rescale_buckets(int(rest[0]))
             return _result(["OK"])
-        if proc == "rewrite_file_index" or proc == "analyze":
+        if proc == "analyze":
             n = table.analyze()
             return _result([f"{n or 0} rows analyzed"])
         if proc == "full_text_search":
@@ -1750,6 +1765,22 @@ class SQLContext:
             # keeps working until expiry)
             _purge_all(table)
             return _result(["table purged"])
+        if proc == "remove_unexisting_manifests":
+            # reference RemoveUnexistingManifestsProcedure
+            from paimon_tpu.maintenance.repair import (
+                remove_unexisting_manifests,
+            )
+            sid = remove_unexisting_manifests(table)
+            return _result(
+                ["table has no snapshots; nothing to repair"]
+                if sid is None
+                else [f"manifest chain repaired in snapshot {sid}"])
+        if proc == "rename_branch":
+            # reference RenameBranchProcedure
+            if len(rest) != 2:
+                raise SQLError("rename_branch needs (old, new)")
+            table.rename_branch(str(rest[0]), str(rest[1]))
+            return _result([f"branch {rest[0]} renamed to {rest[1]}"])
         if proc == "rewrite_file_index":
             # reference RewriteFileIndexProcedure: retrofit per-file
             # indexes after enabling file-index.* on an existing table

@@ -288,6 +288,9 @@ class FileStoreTable:
     def delete_branch(self, name: str):
         self.branch_manager.drop_branch(name)
 
+    def rename_branch(self, old: str, new: str):
+        self.branch_manager.rename_branch(old, new)
+
     def fast_forward(self, branch_name: str):
         self.branch_manager.fast_forward(branch_name)
 

@@ -142,3 +142,87 @@ class TestRewriteFileIndex:
         # force is re-runnable (sidecar name owned by the rewrite)
         assert rewrite_file_index(t2, force=True) == 1
         assert FileStoreTable.load(t.path).to_arrow().num_rows == 50
+
+
+class TestRemoveUnexistingManifests:
+    def test_repair_after_manifest_deletion(self, tmp_path):
+        import glob
+        from paimon_tpu.maintenance.repair import (
+            remove_unexisting_manifests,
+        )
+        t = _make(str(tmp_path), {"manifest.merge-min-count": "1000"})
+        for i in range(4):
+            _commit(t, [{"id": i, "v": float(i)}])
+        # a human deletes one manifest file out of band
+        manifests = sorted(glob.glob(
+            os.path.join(t.path, "manifest", "manifest-*")))
+        data_manifests = [m for m in manifests
+                          if "list" not in m.rsplit("/", 1)[-1]]
+        os.remove(data_manifests[1])
+        with pytest.raises(Exception):
+            t.to_arrow()
+        sid = remove_unexisting_manifests(t)
+        assert sid is not None
+        t2 = FileStoreTable.load(t.path)
+        got = sorted(t2.to_arrow().column("id").to_pylist())
+        # the deleted manifest's entries are gone; the rest survive
+        assert len(got) == 3 and set(got) <= {0, 1, 2, 3}
+
+
+class TestBranchAndDatabaseProcedures:
+    def test_rename_branch_and_compact_database(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        for name in ("x", "y"):
+            ctx.sql(f"CREATE TABLE db.{name} (id BIGINT NOT NULL, "
+                    "PRIMARY KEY (id)) WITH ('bucket'='1')")
+            ctx.sql(f"INSERT INTO db.{name} VALUES (1), (2)")
+        out = ctx.sql("CALL sys.compact_database('db', 'full')")
+        assert "2 tables compacted" in str(out.to_pylist())
+
+        ctx.sql("CALL sys.create_branch('db.x', 'dev')")
+        ctx.sql("CALL sys.rename_branch('db.x', 'dev', 'feat')")
+        t = cat.get_table("db.x")
+        assert t.branch_manager.branch_exists("feat")
+        assert not t.branch_manager.branch_exists("dev")
+
+    def test_sql_rewrite_file_index_actually_builds(self, tmp_path):
+        """Regression: the procedure must NOT be shadowed by the
+        analyze alias."""
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh3")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t VALUES (1), (2)")
+        ctx.sql("ALTER TABLE db.t SET "
+                "('file-index.bloom-filter.columns'='id')")
+        out = ctx.sql("CALL sys.rewrite_file_index('db.t')")
+        assert "files indexed" in str(out.to_pylist())
+        t = cat.get_table("db.t")
+        split = t.new_read_builder().new_scan().plan().splits[0]
+        assert any(f.embedded_index is not None or f.extra_files
+                   for f in split.data_files)
+
+    def test_repair_fixes_total_record_count(self, tmp_path):
+        import glob
+        from paimon_tpu.maintenance.repair import (
+            remove_unexisting_manifests,
+        )
+        t = _make(str(tmp_path), {"manifest.merge-min-count": "1000"})
+        for i in range(4):
+            _commit(t, [{"id": i, "v": float(i)}])
+        data_manifests = [m for m in sorted(glob.glob(
+            os.path.join(t.path, "manifest", "manifest-*")))
+            if "list" not in m.rsplit("/", 1)[-1]]
+        os.remove(data_manifests[1])
+        remove_unexisting_manifests(t)
+        t2 = FileStoreTable.load(t.path)
+        snap = t2.latest_snapshot()
+        # the snapshot's accounting matches what is actually readable
+        assert snap.total_record_count == t2.to_arrow().num_rows == 3
