@@ -21,7 +21,7 @@ from paimon_tpu.schema import Schema
 from paimon_tpu.schema.schema_manager import SchemaChange
 from paimon_tpu.sql import parser as ast
 from paimon_tpu.sql.parser import SQLError, parse
-from paimon_tpu.types import parse_data_type
+from paimon_tpu.types import RowKind, parse_data_type
 
 _AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
 
@@ -440,6 +440,7 @@ class SQLContext:
             ast.Describe: self._exec_describe,
             ast.Use: self._exec_use,
             ast.Delete: self._exec_delete,
+            ast.MergeInto: self._exec_merge,
             ast.Truncate: self._exec_truncate,
             ast.Update: self._exec_update,
             ast.AlterTable: self._exec_alter,
@@ -1310,6 +1311,130 @@ class SQLContext:
         wb.new_commit().commit(w.prepare_commit())
         w.close()
         return _result([f"{out.num_rows} rows inserted"])
+
+    def _exec_merge(self, m: "ast.MergeInto") -> pa.Table:
+        """MERGE INTO over one right-outer join of target x source:
+        pairs with a live target row feed the WHEN MATCHED clauses
+        (first match wins), source rows with no target match feed WHEN
+        NOT MATCHED; one upsert/delete batch commits atomically
+        (reference MergeIntoProcedure semantics on pk tables)."""
+        import numpy as np
+
+        table = self.catalog.get_table(self._ident(m.target))
+        if not table.primary_keys:
+            raise SQLError("MERGE INTO requires a primary-key table")
+        t_alias = m.target_alias or m.target.split(".")[-1]
+        sel = ast.Select(
+            items=[ast.SelectItem(ast.Star())],
+            from_=ast.TableRef(m.target, alias=t_alias),
+            joins=[ast.JoinClause("right outer", m.source, m.on)])
+        self._materialize_subqueries(sel)
+        scope = self._relation_scope(sel.from_, sel)
+        scope = self._join(scope, sel.joins[0], sel)
+        comp = Compiler(scope)
+        n = scope.table.num_rows
+        target_cols = [f.name for f in table.row_type().fields]
+        schema = table.arrow_schema()
+
+        # a pk column is NOT NULL in the target, so its null-ness in
+        # the outer join identifies unmatched source rows
+        pk_q = f"{t_alias}.{table.primary_keys[0]}"
+        matched = np.asarray(
+            pc.is_valid(scope.table.column(pk_q)).combine_chunks(),
+            dtype=bool) if n else np.zeros(0, bool)
+
+        def cond_mask(cond) -> np.ndarray:
+            if cond is None:
+                return np.ones(n, bool)
+            v = comp.as_array(cond)
+            return np.asarray(pc.fill_null(v, False).combine_chunks(),
+                              dtype=bool)
+
+        # statement-level validation runs regardless of what the data
+        # currently matches — an invalid MERGE must fail deterministically
+        for clause in m.clauses:
+            if clause.action == "update":
+                bad = set(dict(clause.assignments)) & (
+                    set(table.primary_keys) |
+                    set(table.partition_keys or []))
+                if bad:
+                    raise SQLError(
+                        f"cannot UPDATE key column(s) {sorted(bad)}")
+
+        out_tables, out_kinds = [], []
+        remaining_m = matched.copy()
+        remaining_nm = ~matched
+        for clause in m.clauses:
+            remaining = remaining_m if clause.matched else remaining_nm
+            mask = remaining & cond_mask(clause.condition)
+            if clause.matched:
+                remaining_m = remaining_m & ~mask
+            else:
+                remaining_nm = remaining_nm & ~mask
+            if not mask.any():
+                continue
+            sub = scope.table.filter(pa.array(mask))
+            sub_scope = Scope(sub, scope.order)
+            sub_comp = Compiler(sub_scope)
+            if clause.action == "update":
+                assigns = dict(clause.assignments)
+                cols = {}
+                for c in target_cols:
+                    if c in assigns:
+                        cols[c] = pc.cast(sub_comp.as_array(assigns[c]),
+                                          schema.field(c).type)
+                    else:
+                        cols[c] = sub.column(f"{t_alias}.{c}")
+                out_tables.append(pa.table(cols, schema=schema))
+                out_kinds.append(np.zeros(sub.num_rows, np.int8))
+            elif clause.action == "delete":
+                cols = {c: sub.column(f"{t_alias}.{c}")
+                        for c in target_cols}
+                out_tables.append(pa.table(cols, schema=schema))
+                out_kinds.append(np.full(sub.num_rows, RowKind.DELETE,
+                                         np.int8))
+            else:                       # insert
+                cols_order = clause.insert_columns or target_cols
+                if len(cols_order) != len(clause.insert_values):
+                    raise SQLError("INSERT arity mismatch in MERGE")
+                vals = dict(zip(cols_order, clause.insert_values))
+                unknown = set(vals) - set(target_cols)
+                if unknown:
+                    raise SQLError(f"unknown INSERT column(s) "
+                                   f"{sorted(unknown)}")
+                cols = {}
+                for c in target_cols:
+                    if c in vals:
+                        cols[c] = pc.cast(sub_comp.as_array(vals[c]),
+                                          schema.field(c).type)
+                    else:
+                        cols[c] = pa.nulls(sub.num_rows,
+                                           schema.field(c).type)
+                out_tables.append(pa.table(cols, schema=schema))
+                out_kinds.append(np.zeros(sub.num_rows, np.int8))
+        if not out_tables:
+            return _result(["0 rows merged"])
+        batch = pa.concat_tables(out_tables, promote_options="none")
+        kinds = np.concatenate(out_kinds)
+        # SQL MERGE forbids touching one target row twice (duplicate
+        # source join keys would make the outcome order-dependent)
+        pk_cols = [batch.column(k).to_pylist()
+                   for k in table.primary_keys]
+        seen_keys = set()
+        for key in zip(*pk_cols):
+            if key in seen_keys:
+                raise SQLError(
+                    f"MERGE INTO affected target row {key} more than "
+                    f"once (duplicate keys in the source?)")
+            seen_keys.add(key)
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        try:
+            w.write_arrow(batch, row_kinds=kinds)
+            wb.new_commit().commit(w.prepare_commit())
+        finally:
+            w.close()
+        return _result([f"{batch.num_rows} rows merged"])
 
     def _exec_truncate(self, t: "ast.Truncate") -> pa.Table:
         """TRUNCATE TABLE: one OVERWRITE snapshot that drops every live

@@ -37,7 +37,7 @@ _KEYWORDS = {
     "COMMENT", "DROP", "SHOW", "TABLES", "DATABASES", "DESCRIBE", "DESC",
     "USE", "DELETE", "UPDATE", "SET", "RESET", "ALTER", "COLUMN", "RENAME",
     "TO", "CALL", "EXPLAIN", "VERSION", "OF", "FOR", "SYSTEM_TIME",
-    "TIMESTAMP", "ADD", "TRUNCATE",
+    "TIMESTAMP", "ADD", "TRUNCATE", "MERGE", "USING", "MATCHED", "THEN",
 }
 
 
@@ -278,6 +278,26 @@ class TableRef:
 @dataclass
 class Truncate:
     table: str
+
+
+@dataclass
+class MergeClause:
+    """WHEN [NOT] MATCHED [AND cond] THEN action."""
+    matched: bool
+    condition: Optional[Any]
+    action: str                    # update | delete | insert
+    assignments: List[Tuple[str, Any]] = field(default_factory=list)
+    insert_columns: Optional[List[str]] = None
+    insert_values: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class MergeInto:
+    target: str
+    target_alias: Optional[str]
+    source: Any                    # TableRef | SubqueryRef
+    on: Any
+    clauses: List[MergeClause] = field(default_factory=list)
 
 
 @dataclass
@@ -561,7 +581,8 @@ class Parser:
         # named "comment" or "key")
         if t.kind == "KEYWORD" and t.value in (
                 "COMMENT", "KEY", "TABLES", "DATABASES", "VERSION", "ALL",
-                "FIRST", "LAST", "TIMESTAMP", "SET", "TRUNCATE"):
+                "FIRST", "LAST", "TIMESTAMP", "SET", "TRUNCATE",
+                "MERGE", "USING", "MATCHED"):
             return t.value.lower()
         raise SQLError(f"expected identifier, got {t.value!r}")
 
@@ -598,6 +619,8 @@ class Parser:
             return Describe(self.qualified_name())
         if self.accept_kw("USE"):
             return Use(self.ident())
+        if self.accept_kw("MERGE"):
+            return self.merge_into()
         if self.accept_kw("TRUNCATE"):
             self.expect_kw("TABLE")
             return Truncate(self.qualified_name())
@@ -613,6 +636,71 @@ class Parser:
         if self.accept_kw("CALL"):
             return self.call()
         raise SQLError(f"unsupported statement start: {self.peek().value!r}")
+
+    # -- MERGE INTO ---------------------------------------------------------
+    def merge_into(self) -> MergeInto:
+        """MERGE INTO target [AS] t USING source [AS] s ON cond
+        WHEN MATCHED [AND c] THEN UPDATE SET col=e,.. | DELETE
+        WHEN NOT MATCHED [AND c] THEN INSERT [(cols)] VALUES (e,..)
+        (reference MergeIntoProcedure / flink MERGE INTO)."""
+        self.expect_kw("INTO")
+        target = self.qualified_name()
+        target_alias = None
+        if self.accept_kw("AS") or self.peek().kind == "IDENT":
+            target_alias = self.ident()
+        self.expect_kw("USING")
+        if self.accept_op("("):
+            sub = self.select_or_with()
+            self.expect_op(")")
+            self.accept_kw("AS")
+            source = SubqueryRef(sub, self.ident())
+        else:
+            source = TableRef(self.qualified_name())
+            if self.accept_kw("AS") or self.peek().kind == "IDENT":
+                source.alias = self.ident()
+        self.expect_kw("ON")
+        on = self.expr()
+        clauses: List[MergeClause] = []
+        while self.accept_kw("WHEN"):
+            matched = not self.accept_kw("NOT")
+            self.expect_kw("MATCHED")
+            cond = self.expr() if self.accept_kw("AND") else None
+            self.expect_kw("THEN")
+            if matched and self.accept_kw("UPDATE"):
+                self.expect_kw("SET")
+                assigns = [(self.ident(),
+                            (self.expect_op("="), self.expr())[1])]
+                while self.accept_op(","):
+                    assigns.append((self.ident(),
+                                    (self.expect_op("="),
+                                     self.expr())[1]))
+                clauses.append(MergeClause(True, cond, "update",
+                                           assignments=assigns))
+            elif matched and self.accept_kw("DELETE"):
+                clauses.append(MergeClause(True, cond, "delete"))
+            elif not matched and self.accept_kw("INSERT"):
+                cols = None
+                if self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                self.expect_kw("VALUES")
+                self.expect_op("(")
+                vals = [self.expr()]
+                while self.accept_op(","):
+                    vals.append(self.expr())
+                self.expect_op(")")
+                clauses.append(MergeClause(False, cond, "insert",
+                                           insert_columns=cols,
+                                           insert_values=vals))
+            else:
+                raise SQLError(
+                    "WHEN MATCHED takes UPDATE SET or DELETE; "
+                    "WHEN NOT MATCHED takes INSERT")
+        if not clauses:
+            raise SQLError("MERGE INTO needs at least one WHEN clause")
+        return MergeInto(target, target_alias, source, on, clauses)
 
     # -- WITH (common table expressions) ------------------------------------
     def with_select(self) -> Select:
@@ -960,7 +1048,7 @@ class Parser:
             return e
         if t.kind == "IDENT" or (t.kind == "KEYWORD" and t.value in (
                 "COMMENT", "KEY", "VERSION", "FIRST", "LAST",
-                "TRUNCATE")):
+                "TRUNCATE", "MERGE", "USING", "MATCHED")):
             name = self.ident()
             if name.upper() in ("ARRAY", "MAP") and \
                     self.peek().kind == "OP" and self.peek().value == "[":

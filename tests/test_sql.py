@@ -1362,3 +1362,105 @@ class TestTruncate:
         ctx.sql("INSERT INTO db.k VALUES (1, 7)")
         got = ctx.sql("SELECT truncate FROM db.k").to_pylist()
         assert got == [{"truncate": 7}]
+
+
+class TestMergeInto:
+    def _ctx(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, v DOUBLE, "
+                "tag STRING, PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("CREATE TABLE db.s (id BIGINT NOT NULL, nv DOUBLE, "
+                "op STRING, PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.t VALUES (1, 1.0, 'old'), "
+                "(2, 2.0, 'old'), (3, 3.0, 'old')")
+        ctx.sql("INSERT INTO db.s VALUES (2, 20.0, 'upd'), "
+                "(3, 0.0, 'del'), (4, 40.0, 'new')")
+        return ctx
+
+    def test_update_delete_insert(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        out = ctx.sql(
+            "MERGE INTO db.t AS t USING db.s AS s ON t.id = s.id "
+            "WHEN MATCHED AND s.op = 'del' THEN DELETE "
+            "WHEN MATCHED THEN UPDATE SET v = s.nv, tag = 'merged' "
+            "WHEN NOT MATCHED THEN INSERT (id, v, tag) "
+            "VALUES (s.id, s.nv, 'inserted')")
+        assert "rows merged" in str(out.to_pylist())
+        rows = ctx.sql("SELECT * FROM db.t ORDER BY id").to_pylist()
+        assert rows == [
+            {"id": 1, "v": 1.0, "tag": "old"},
+            {"id": 2, "v": 20.0, "tag": "merged"},
+            {"id": 4, "v": 40.0, "tag": "inserted"},
+        ]
+
+    def test_first_matching_clause_wins(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql(
+            "MERGE INTO db.t AS t USING db.s AS s ON t.id = s.id "
+            "WHEN MATCHED THEN UPDATE SET tag = 'first' "
+            "WHEN MATCHED AND s.op = 'del' THEN DELETE")
+        rows = ctx.sql("SELECT id, tag FROM db.t ORDER BY id") \
+            .to_pylist()
+        # the unconditional first clause claimed ALL matches: no delete
+        assert rows == [{"id": 1, "tag": "old"},
+                        {"id": 2, "tag": "first"},
+                        {"id": 3, "tag": "first"}]
+
+    def test_subquery_source_and_missing_insert_cols(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql(
+            "MERGE INTO db.t t USING "
+            "(SELECT id, nv FROM db.s WHERE op <> 'del') s "
+            "ON t.id = s.id "
+            "WHEN NOT MATCHED THEN INSERT (id, v) VALUES (s.id, s.nv)")
+        rows = ctx.sql("SELECT id, v, tag FROM db.t WHERE id = 4") \
+            .to_pylist()
+        assert rows == [{"id": 4, "v": 40.0, "tag": None}]
+
+    def test_key_update_rejected(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="key column"):
+            ctx.sql("MERGE INTO db.t t USING db.s s ON t.id = s.id "
+                    "WHEN MATCHED THEN UPDATE SET id = s.id")
+
+    def test_append_target_rejected(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.ap (id BIGINT NOT NULL)")
+        with pytest.raises(SQLError, match="primary-key"):
+            ctx.sql("MERGE INTO db.ap a USING db.s s ON a.id = s.id "
+                    "WHEN MATCHED THEN DELETE")
+
+    def test_duplicate_source_keys_rejected(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="more than once"):
+            ctx.sql(
+                "MERGE INTO db.t t USING "
+                "(SELECT id, nv FROM db.s UNION ALL "
+                " SELECT id, nv FROM db.s) s ON t.id = s.id "
+                "WHEN MATCHED THEN UPDATE SET v = s.nv")
+
+    def test_key_update_rejected_even_with_no_matches(self, tmp_path):
+        from paimon_tpu.sql.executor import SQLError
+        ctx = self._ctx(tmp_path)
+        with pytest.raises(SQLError, match="key column"):
+            ctx.sql("MERGE INTO db.t t USING "
+                    "(SELECT id, nv FROM db.s WHERE id > 999) s "
+                    "ON t.id = s.id "
+                    "WHEN MATCHED THEN UPDATE SET id = s.id")
+
+    def test_merge_words_stay_identifiers(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.sql("CREATE TABLE db.w (id BIGINT NOT NULL, "
+                "matched BIGINT, merge BIGINT, using BIGINT, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        ctx.sql("INSERT INTO db.w VALUES (1, 2, 3, 4)")
+        got = ctx.sql("SELECT matched, merge, using FROM db.w") \
+            .to_pylist()
+        assert got == [{"matched": 2, "merge": 3, "using": 4}]
