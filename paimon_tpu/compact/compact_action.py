@@ -162,7 +162,7 @@ def rescale_postpone(table) -> Optional[int]:
             table.options.get(_CO.POSTPONE_DEFAULT_BUCKET_NUM))
     write_table = table.copy(overrides)
     wb = write_table.new_batch_write_builder()
-    writer = wb.new_write()
+    writer = wb.new_write(apply_defaults=False)
     cache = {table.schema.id: table.schema}
     value_cols = [f.name for f in table.schema.fields]
     by_part: Dict[bytes, list] = {}

@@ -45,7 +45,7 @@ def _delete_pk(table, predicate) -> Optional[int]:
     if rows.num_rows == 0:
         return None
     wb = table.new_batch_write_builder()
-    w = wb.new_write()
+    w = wb.new_write(apply_defaults=False)
     w.write_arrow(rows.select([f.name for f in table.schema.fields]),
                   row_kinds=np.full(rows.num_rows, RowKind.DELETE,
                                     np.int8))

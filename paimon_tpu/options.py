@@ -819,6 +819,21 @@ class CoreOptions:
         "Reads return blob descriptors (uri, offset, length) instead "
         "of materialized bytes")
 
+    FIELDS_DEFAULT_VALUE = ConfigOption(
+        "fields.#.default-value", str, None,
+        "Default for column '#': NULL incoming values are replaced at "
+        "write time (reference DefaultValueRow / fields.*.default-value)")
+
+    def field_default_values(self) -> Dict[str, str]:
+        """{column: raw default} from fields.<col>.default-value keys."""
+        out = {}
+        for k in self.options.keys():
+            if k.startswith("fields.") and k.endswith(".default-value"):
+                col = k[len("fields."):-len(".default-value")]
+                if col and col != "#":
+                    out[col] = self.options.get_or(k, None)
+        return out
+
     # -- streaming / incremental variants ------------------------------------
     STREAMING_READ_SNAPSHOT_DELAY = ConfigOption(
         "streaming.read.snapshot.delay", _parse_duration_ms, None,

@@ -319,8 +319,13 @@ class BatchWriteBuilder:
         self._overwrite = static_partition or {}
         return self
 
-    def new_write(self) -> "TableWrite":
-        return TableWrite(self.table, self.commit_user)
+    def new_write(self, apply_defaults: bool = True) -> "TableWrite":
+        """`apply_defaults=False` is for INTERNAL rewrite paths
+        (rescale compaction, DV retractions): those round-trip stored
+        rows and must be value-preserving — historical NULLs must not
+        pick up fields.*.default-value."""
+        return TableWrite(self.table, self.commit_user,
+                          apply_defaults=apply_defaults)
 
     def new_commit(self) -> "TableCommit":
         return TableCommit(self.table, self.commit_user, self._overwrite)
@@ -359,8 +364,20 @@ class StreamWriteBuilder:
 
 
 class TableWrite:
-    def __init__(self, table: FileStoreTable, commit_user: str):
+    def __init__(self, table: FileStoreTable, commit_user: str,
+                 apply_defaults: bool = True):
         self.table = table
+        self._apply_defaults = apply_defaults
+        if apply_defaults and table.options.field_default_values() and \
+                table.options.merge_engine in ("partial-update",
+                                               "aggregation"):
+            # NULL carries meaning for these engines (keep existing /
+            # skip aggregation); a write-time default fill would
+            # silently clobber stored values (reference rejects the
+            # combination too)
+            raise ValueError(
+                "fields.*.default-value is not supported with the "
+                f"{table.options.merge_engine} merge engine")
         scan = table.new_scan()
 
         def restore(partition: Tuple, bucket: int) -> int:
@@ -399,10 +416,36 @@ class TableWrite:
     def write_arrow(self, data: pa.Table,
                     row_kinds: Optional[np.ndarray] = None,
                     buckets=None):
+        data = self._apply_field_defaults(data)
         if buckets is not None:
             self._write.write_arrow(data, row_kinds, buckets=buckets)
         else:
             self._write.write_arrow(data, row_kinds)
+
+    def _apply_field_defaults(self, data: pa.Table) -> pa.Table:
+        """NULL incoming values become the column's configured default
+        (fields.<col>.default-value — reference DefaultValueRow applied
+        on the write path)."""
+        if not self._apply_defaults:
+            return data
+        defaults = getattr(self, "_field_defaults", None)
+        if defaults is None:
+            defaults = self.table.options.field_default_values()
+            self._field_defaults = defaults
+        if not defaults:
+            return data
+        import pyarrow.compute as pc
+        schema = self.table.arrow_schema()
+        for col, raw in defaults.items():
+            if col not in data.column_names:
+                continue
+            arr = data.column(col)
+            if arr.null_count == 0:
+                continue
+            scalar = pa.scalar(raw).cast(schema.field(col).type)
+            data = data.set_column(data.column_names.index(col), col,
+                                   pc.fill_null(arr, scalar))
+        return data
 
     def write_pandas(self, df):
         self.write_arrow(pa.Table.from_pandas(df, preserve_index=False))

@@ -425,3 +425,42 @@ class TestStreamingIncrementalKnobs:
             "incremental-between-tag-to-snapshot": f"base,{latest}"})
         got = sorted(inc.to_arrow().column("id").to_pylist())
         assert got == list(range(2, 8))
+
+
+class TestFieldDefaults:
+    def test_null_values_get_defaults_at_write(self, tmp_path):
+        t = _make(str(tmp_path), {
+            "fields.v.default-value": "42.5"})
+        _commit(t, [{"id": 1, "v": None}, {"id": 2, "v": 2.0},
+                    {"id": 3}])                 # missing == null
+        got = t.to_arrow().sort_by("id").to_pylist()
+        assert got == [{"id": 1, "v": 42.5}, {"id": 2, "v": 2.0},
+                       {"id": 3, "v": 42.5}]
+
+    def test_without_option_nulls_stay(self, tmp_path):
+        t = _make(str(tmp_path))
+        _commit(t, [{"id": 1, "v": None}])
+        assert t.to_arrow().to_pylist() == [{"id": 1, "v": None}]
+
+    def test_rejected_for_null_meaningful_engines(self, tmp_path):
+        t = _make(str(tmp_path), {
+            "merge-engine": "partial-update",
+            "fields.v.default-value": "42.5"})
+        with pytest.raises(ValueError, match="not supported"):
+            t.new_batch_write_builder().new_write()
+
+    def test_internal_rewrites_preserve_stored_nulls(self, tmp_path):
+        # write a genuine NULL, then enable the default and rescale:
+        # the round-trip must NOT rewrite history
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", DoubleType())
+                  .primary_key("id")
+                  .options({"bucket": "-2", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "pp2"), schema)
+        _commit(t, [{"id": 1, "v": None}])
+        t2 = t.copy({"fields.v.default-value": "42.5"})
+        assert t2.rescale_postpone() is not None
+        got = FileStoreTable.load(t.path).to_arrow().to_pylist()
+        assert got == [{"id": 1, "v": None}]
