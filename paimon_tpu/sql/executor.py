@@ -1845,6 +1845,34 @@ class SQLContext:
                     cm.delete_consumer(cid)
                     cleared.append(cid)
             return _result([f"{len(cleared)} consumers cleared"])
+        def _scan_snapshots():
+            """Yield existing snapshots, earliest to latest (expired
+            ids are skipped)."""
+            sm = table.snapshot_manager
+            for sid in range(sm.earliest_snapshot_id() or 1,
+                             (sm.latest_snapshot_id() or 0) + 1):
+                try:
+                    yield sm.snapshot(sid)
+                except FileNotFoundError:
+                    continue
+
+        if proc == "create_tag_from_watermark":
+            # reference CreateTagFromWatermarkProcedure: first snapshot
+            # whose watermark reached the bound
+            if len(rest) < 2:
+                raise SQLError(
+                    "create_tag_from_watermark needs (tag, watermark)")
+            bound = int(rest[1])
+            pick = None
+            for s_ in _scan_snapshots():
+                if s_.watermark is not None and s_.watermark >= bound:
+                    pick = s_
+                    break              # watermarks only advance
+            if pick is None:
+                raise SQLError(f"no snapshot with watermark >= {bound}")
+            table.create_tag(str(rest[0]), snapshot_id=pick.id)
+            return _result([f"tag {rest[0]} -> snapshot {pick.id} "
+                            f"(watermark {pick.watermark})"])
         if proc in ("rollback_to_timestamp", "create_tag_from_timestamp"):
             # reference RollbackToTimestampProcedure /
             # CreateTagFromTimestampProcedure: latest snapshot with
@@ -1855,14 +1883,8 @@ class SQLContext:
                                if need == 1
                                else f"{proc} needs (tag, millis)")
             ts = int(rest[-1])
-            sm = table.snapshot_manager
             best = None
-            for sid in range(sm.earliest_snapshot_id() or 1,
-                             (sm.latest_snapshot_id() or 0) + 1):
-                try:
-                    s = sm.snapshot(sid)
-                except FileNotFoundError:
-                    continue
+            for s in _scan_snapshots():
                 if s.time_millis <= ts:
                     best = s
                 else:

@@ -1464,3 +1464,32 @@ class TestMergeInto:
         got = ctx.sql("SELECT matched, merge, using FROM db.w") \
             .to_pylist()
         assert got == [{"matched": 2, "merge": 3, "using": 4}]
+
+
+class TestTagFromWatermark:
+    def test_create_tag_from_watermark(self, tmp_path):
+        from paimon_tpu.catalog import create_catalog
+        from paimon_tpu.sql import SQLContext
+        cat = create_catalog({"warehouse": str(tmp_path / "wh")})
+        ctx = SQLContext(cat)
+        ctx.sql("CREATE DATABASE db")
+        ctx.sql("CREATE TABLE db.t (id BIGINT NOT NULL, "
+                "PRIMARY KEY (id)) WITH ('bucket'='1')")
+        t = cat.get_table("db.t")
+        for i, wm in enumerate([100, 200, 300]):
+            wb = t.new_batch_write_builder()
+            w = wb.new_write()
+            w.write_dicts([{"id": i}])
+            wb.new_commit().commit(w.prepare_commit(), watermark=wm)
+            w.close()
+        out = ctx.sql(
+            "CALL sys.create_tag_from_watermark('db.t', 'wm', 150)")
+        assert "snapshot 2" in str(out.to_pylist())
+        got = ctx.sql("SELECT count(*) AS n FROM db.t "
+                      "VERSION AS OF 'wm'").to_pylist()
+        assert got == [{"n": 2}]
+        from paimon_tpu.sql.executor import SQLError
+        import pytest as _pt
+        with _pt.raises(SQLError, match="watermark"):
+            ctx.sql("CALL sys.create_tag_from_watermark('db.t', 'x', "
+                    "99999)")
