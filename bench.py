@@ -529,11 +529,12 @@ def main():
             result = run_child(4_000_000, runs, platform_cpu=False,
                                timeout=_remaining() - 120)
     if result is None:
-        # bank a CPU number (always fits: scale fitted to remaining;
-        # clean-machine measurement: 50M compacts in ~26s, whole child
-        # ~100s incl. build + same-scale vec baseline)
+        # bank a CPU number at up to the NORTH-STAR scale (clean
+        # measurement: the whole 100M child — build + same-scale vec
+        # baseline + compact — finishes in ~550s; fit_rows drops to
+        # 50M/30M when the remaining budget is tighter)
         rows = fit_rows(_remaining() - 90, _CPU_E2E_ROWS_PER_S,
-                        min(rows_cap, 50_000_000))
+                        min(rows_cap, 100_000_000))
         result = run_child(rows, runs, platform_cpu=True,
                            timeout=_remaining() - 60)
         if result is None and _remaining() > 60:
