@@ -41,9 +41,32 @@ def _default_repr(opt: ConfigOption) -> str:
     return str(d)
 
 
+def duplicate_option_keys(src: str):
+    """Option keys declared more than once in a CoreOptions source body.
+
+    Duplicates with the SAME attribute name collapse in the class dict
+    (the second silently wins), so only source-level scanning can catch
+    them — exactly the `manifest.target-file-size` double declaration
+    this guards against.  Returns the sorted list of offending keys."""
+    import re
+    keys = re.findall(
+        r"=\s*ConfigOption\(\s*[\r\n ]*[\"']([^\"']+)[\"']", src)
+    seen, dups = set(), set()
+    for k in keys:
+        (dups if k in seen else seen).add(k)
+    return sorted(dups)
+
+
 def collect():
-    """All ConfigOptions declared on CoreOptions, in declaration order."""
+    """All ConfigOptions declared on CoreOptions, in declaration order.
+
+    Refuses to run (and so fails the docs drift test) when any option
+    key is declared twice."""
     src = inspect.getsource(CoreOptions)
+    dups = duplicate_option_keys(src)
+    if dups:
+        raise SystemExit(
+            f"duplicated option key(s) in CoreOptions: {', '.join(dups)}")
     order = {}
     for name, val in vars(CoreOptions).items():
         if isinstance(val, ConfigOption):

@@ -71,7 +71,24 @@ def _read_bucket(table, path_factory, partition, bucket, files,
 def compact_table(table, full: bool = False,
                   partition_filter: Optional[dict] = None) -> Optional[int]:
     """Compact every (partition, bucket) that has work; commit one COMPACT
-    snapshot. Returns the snapshot id or None if nothing to do."""
+    snapshot. Returns the snapshot id or None if nothing to do.
+
+    With `tpu.mesh.compact` enabled, full compactions of primary-key
+    tables route per merge engine: engines the streaming mesh engine
+    implements (parallel/mesh_engine.py) compact multi-chip in one mesh
+    program; anything it cannot run — unsupported engines, changelog
+    producers, partition-filtered or non-full compactions — falls back
+    to the single-chip manager below."""
+    if (full and table.schema.primary_keys and partition_filter is None
+            and table.options.get(CoreOptions.MESH_COMPACT)):
+        from paimon_tpu.options import ChangelogProducer
+        from paimon_tpu.parallel.mesh_engine import (
+            SUPPORTED_MERGE_ENGINES, compact_table_mesh,
+        )
+        if (table.options.merge_engine in SUPPORTED_MERGE_ENGINES
+                and table.options.changelog_producer
+                == ChangelogProducer.NONE):
+            return compact_table_mesh(table).snapshot_id
     scan = table.new_scan()
     if partition_filter:
         scan.with_partition_filter(partition_filter)

@@ -233,9 +233,9 @@ def _compress(codec: str, data: bytes) -> bytes:
         c = zlib.compressobj(9, zlib.DEFLATED, -15)
         return c.compress(data) + c.flush()
     if codec == "zstandard":
-        if _zstd is None:
-            raise AvroSchemaError("zstandard module unavailable")
-        return _zstd.ZstdCompressor(level=3).compress(data)
+        if _zstd is not None:
+            return _zstd.ZstdCompressor(level=3).compress(data)
+        return _pa_zstd_compress(data)
     raise AvroSchemaError(f"Unknown avro codec {codec!r}")
 
 
@@ -245,11 +245,31 @@ def _decompress(codec: str, data: bytes) -> bytes:
     if codec == "deflate":
         return zlib.decompress(data, -15)
     if codec == "zstandard":
-        if _zstd is None:
-            raise AvroSchemaError("zstandard module unavailable")
-        return _zstd.ZstdDecompressor().decompress(data,
-                                                   max_output_size=1 << 31)
+        if _zstd is not None:
+            return _zstd.ZstdDecompressor().decompress(
+                data, max_output_size=1 << 31)
+        return _pa_zstd_decompress(data)
     raise AvroSchemaError(f"Unknown avro codec {codec!r}")
+
+
+def _pa_zstd_compress(data: bytes) -> bytes:
+    """zstd via pyarrow's bundled codec when the `zstandard` module is
+    absent.  The streaming writer emits standard zstd frames (magic
+    0x28B52FFD), byte-compatible with what any avro reader expects."""
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.CompressedOutputStream(sink, "zstd") as s:
+        s.write(data)
+    return sink.getvalue().to_pybytes()
+
+
+def _pa_zstd_decompress(data: bytes) -> bytes:
+    """Streaming decompress: avro blocks don't record the decompressed
+    size, and pyarrow's one-shot pa.decompress demands it — the
+    CompressedInputStream path does not."""
+    import pyarrow as pa
+    with pa.CompressedInputStream(pa.BufferReader(data), "zstd") as s:
+        return s.read()
 
 
 def write_container(schema, records: Iterable[dict],

@@ -31,7 +31,8 @@ from paimon_tpu.ops.normkey import NormalizedKeyEncoder
 from paimon_tpu.schema.table_schema import TableSchema
 from paimon_tpu.types import RowKind
 
-__all__ = ["merge_runs_agg", "field_aggregators"]
+__all__ = ["merge_runs_agg", "field_aggregators",
+           "aggregate_sorted_segments"]
 
 _NUMERIC_DEVICE_AGGS = {"sum", "max", "min", "product", "count"}
 
@@ -252,6 +253,25 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
         if seq_fields else None
     order, seg_id, win_sorted = _segment_ids_from_sort(
         lanes, seq, truncated, full_key, order_lanes, packed=packed)
+    return aggregate_sorted_segments(table, order, seg_id, win_sorted,
+                                     key_cols, schema, options)
+
+
+def aggregate_sorted_segments(table: pa.Table, order: np.ndarray,
+                              seg_id: np.ndarray, win_sorted: np.ndarray,
+                              key_cols: Sequence[str],
+                              schema: TableSchema,
+                              options: CoreOptions) -> pa.Table:
+    """Engine-parameterized aggregation epilogue shared by the
+    single-chip merge (``merge_runs_agg``, which computes the sort
+    itself) and the mesh window engine (parallel/mesh_engine.py, whose
+    [B, window] kernel hands back each lane's sorted order).
+
+    `order`: positions into `table` in (key, user-seq, seq, arrival)
+    order; `seg_id`: per-sorted-row key-segment id (ascending, dense);
+    `win_sorted`: True at the last row of each segment.  Folds every
+    segment per the table's merge engine and returns the KV-shaped
+    merged rows in key order."""
     num_seg = int(seg_id[-1]) + 1 if len(seg_id) else 0
     win_pos = np.flatnonzero(win_sorted)           # last row of each segment
 

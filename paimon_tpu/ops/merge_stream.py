@@ -24,7 +24,7 @@ This replaces the reference's record-at-a-time spillable MergeSorter
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -32,7 +32,7 @@ import pyarrow as pa
 from paimon_tpu.ops.merge import merge_runs
 from paimon_tpu.ops.normkey import NormalizedKeyEncoder
 
-__all__ = ["merge_runs_streamed"]
+__all__ = ["merge_runs_streamed", "iter_merge_windows"]
 
 
 def _cut_point(lanes: np.ndarray, bound: Tuple) -> int:
@@ -131,6 +131,72 @@ class _RunState:
         return out
 
 
+def iter_merge_windows(
+    run_chunk_iters: Sequence[Iterator],
+    key_cols: Sequence[str],
+    key_encoder: NormalizedKeyEncoder,
+    stats: Optional[Dict[str, int]] = None,
+) -> Iterator[List[Tuple]]:
+    """Pull-based window stream: yields one run-ordered item list per key
+    window, in ascending key order.  Each item is a (table, lanes,
+    truncated, packed-u64-or-None) quad; the concatenation of a window's
+    items holds every buffered row whose key is strictly below the
+    window bound, so per-key merge semantics applied window-by-window
+    equal the one-shot merge (keys never straddle windows).
+
+    This is the generator form of ``merge_runs_streamed`` — the mesh
+    compaction engine (parallel/mesh_engine.py) pulls one window per
+    bucket lane per mesh step to build its [B, window] device batches,
+    while the single-chip streamed rewrite keeps the push (emit) shape.
+
+    `stats`, when given, records "peak_buffered_rows": the max total
+    rows buffered across runs at any point — the observable that the
+    bounded-host-RAM contract is tested against."""
+    runs = [_RunState(it, key_cols, key_encoder)
+            for it in run_chunk_iters]
+    for r in runs:
+        r.fill_one()
+
+    while True:
+        for r in runs:
+            if not r.exhausted and not r.buffer:
+                r.fill_one()
+        if stats is not None:
+            buffered = sum(r.buffered_rows for r in runs)
+            if buffered > stats.get("peak_buffered_rows", 0):
+                stats["peak_buffered_rows"] = buffered
+        non_exhausted = [r for r in runs if not r.exhausted]
+        if not non_exhausted:
+            tail = []
+            for r in runs:
+                tail.extend(r.take_all())
+            if tail:
+                yield tail
+            return
+        bound = min(r.last_key() for r in non_exhausted)
+        heads: List = []
+        for r in runs:                      # run order = merge stability
+            heads.extend(r.cut_lt(bound))
+        if heads:
+            yield heads
+        else:
+            # every buffered row >= bound: a key group spans entire
+            # buffers; extend the runs sitting exactly at the bound
+            progressed = False
+            for r in non_exhausted:
+                if r.last_key() == bound:
+                    progressed |= r.fill_one()
+                    if r.exhausted:
+                        progressed = True
+            if not progressed:              # defensive: cannot happen
+                tail = []
+                for r in runs:
+                    tail.extend(r.take_all())
+                if tail:
+                    yield tail
+                return
+
+
 def merge_runs_streamed(
     run_chunk_iters: Sequence[Iterator],
     key_cols: Sequence[str],
@@ -149,46 +215,7 @@ def merge_runs_streamed(
     merge_runs_agg closure).  With pass_encoded=True it receives the
     (table, lanes, truncated, packed) tuples so the kernel can skip
     re-encoding (and re-packing) the window's keys."""
-    runs = [_RunState(it, key_cols, key_encoder)
-            for it in run_chunk_iters]
-    for r in runs:
-        r.fill_one()
-
-    def _window(items):
-        return merge_window(items if pass_encoded
-                            else [item[0] for item in items])
-
-    while True:
-        for r in runs:
-            if not r.exhausted and not r.buffer:
-                r.fill_one()
-        non_exhausted = [r for r in runs if not r.exhausted]
-        if not non_exhausted:
-            tail = []
-            for r in runs:
-                tail.extend(r.take_all())
-            if tail:
-                emit(_window(tail))
-            return
-        bound = min(r.last_key() for r in non_exhausted)
-        heads: List = []
-        for r in runs:                      # run order = merge stability
-            heads.extend(r.cut_lt(bound))
-        if heads:
-            emit(_window(heads))
-        else:
-            # every buffered row >= bound: a key group spans entire
-            # buffers; extend the runs sitting exactly at the bound
-            progressed = False
-            for r in non_exhausted:
-                if r.last_key() == bound:
-                    progressed |= r.fill_one()
-                    if r.exhausted:
-                        progressed = True
-            if not progressed:              # defensive: cannot happen
-                tail = []
-                for r in runs:
-                    tail.extend(r.take_all())
-                if tail:
-                    emit(_window(tail))
-                return
+    for items in iter_merge_windows(run_chunk_iters, key_cols,
+                                    key_encoder):
+        emit(merge_window(items if pass_encoded
+                          else [item[0] for item in items]))

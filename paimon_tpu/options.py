@@ -202,8 +202,6 @@ class CoreOptions:
                                     "Data file compression")
     MANIFEST_FORMAT = ConfigOption("manifest.format", str, "avro",
                                    "Manifest file format")
-    MANIFEST_TARGET_FILE_SIZE = ConfigOption("manifest.target-file-size",
-                                             parse_memory_size, 8 << 20, "")
     MANIFEST_MERGE_MIN_COUNT = ConfigOption("manifest.merge-min-count", int, 30,
                                             "Min manifests to trigger full rewrite")
     MERGE_ENGINE = ConfigOption("merge-engine", str, MergeEngine.DEDUPLICATE,
@@ -292,6 +290,18 @@ class CoreOptions:
         "larger windows amortize per-window sync/flush overhead "
         "(~20% at 30M rows/10 runs measured in-env) at ~runs x rows "
         "x row-bytes peak memory")
+    MESH_COMPACT = ConfigOption(
+        "tpu.mesh.compact", _parse_bool, False,
+        "Route full compactions of primary-key tables through the "
+        "streaming mesh engine (parallel/mesh_engine.py): all buckets "
+        "compact in one mesh program, streamed in bounded key windows "
+        "with skew-aware bucket->device packing (ours)")
+    MESH_WINDOW_ROWS = ConfigOption(
+        "tpu.mesh.window-rows", int, 1 << 20,
+        "Decoded chunk rows per sorted run for the mesh engine's "
+        "bounded key-window streaming; per-bucket peak host memory is "
+        "~ runs x window-rows x row-bytes, independent of bucket size "
+        "(ours)")
     BRANCH = ConfigOption("branch", str, "main", "")
     METASTORE_PARTITIONED_TABLE = ConfigOption("metastore.partitioned-table",
                                                _parse_bool, False, "")

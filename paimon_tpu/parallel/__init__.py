@@ -18,3 +18,10 @@ from paimon_tpu.parallel.sharded_compact import (  # noqa: F401
 from paimon_tpu.parallel.rescale import (  # noqa: F401
     rescale_dispatch_sharded, rescale_table_buckets,
 )
+from paimon_tpu.parallel.mesh_engine import (  # noqa: F401
+    MeshCompactStats, SUPPORTED_MERGE_ENGINES,
+    UnsupportedMergeEngineError, compact_table_mesh,
+)
+from paimon_tpu.parallel.packing import (  # noqa: F401
+    bucket_row_counts, pack_buckets, packing_skew,
+)

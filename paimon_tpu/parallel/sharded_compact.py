@@ -43,6 +43,7 @@ class _ShardedCompactKernel:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from paimon_tpu.ops.merge import segmented_merge_body
+        from paimon_tpu.parallel._compat import shard_map
 
         self.mesh = mesh
         self.axis = axis
@@ -60,7 +61,7 @@ class _ShardedCompactKernel:
             live = winner & ((s_kinds == 0) | (s_kinds == 2))
             return perm, winner, live
 
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
                  out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()))
         def step(lanes, seq_hi, seq_lo, invalid, kinds):
@@ -130,6 +131,18 @@ def compact_table_sharded(table, mesh=None,
     )
     from paimon_tpu.options import CoreOptions
 
+    # this legacy path hard-codes the deduplicate winner select; any
+    # other engine must fail loudly instead of silently deduping while
+    # callers migrate to parallel/mesh_engine.compact_table_mesh
+    from paimon_tpu.parallel.mesh_engine import UnsupportedMergeEngineError
+    from paimon_tpu.options import MergeEngine
+    engine = table.options.merge_engine
+    if engine != MergeEngine.DEDUPLICATE:
+        raise UnsupportedMergeEngineError(
+            f"compact_table_sharded only implements merge-engine "
+            f"'deduplicate', got {engine!r}; use "
+            f"parallel.mesh_engine.compact_table_mesh, which dispatches "
+            f"on the merge engine")
     if not table.primary_keys:
         raise ValueError("sharded compaction targets primary-key tables")
     if mesh is None:

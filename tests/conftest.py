@@ -24,6 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (multichip dryruns); excluded from tier-1 "
+        "via -m 'not slow'")
+
+
 @pytest.fixture
 def tmp_warehouse(tmp_path):
     return str(tmp_path / "warehouse")

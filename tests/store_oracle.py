@@ -36,6 +36,66 @@ from paimon_tpu.types import (
 VALUE_FIELDS = ["v1", "v2", "name"]
 
 
+def make_random_engine_table(path: str, seed: int, engine: str, *,
+                             buckets: int = 4, commits: int = 3,
+                             rows_per_commit: int = 250,
+                             key_space: int = 120,
+                             deletes: bool = True,
+                             sequence_group: bool = False,
+                             extra_options: Optional[Dict] = None
+                             ) -> FileStoreTable:
+    """Randomized multi-bucket, multi-L0-run table for one merge engine.
+
+    Written write-only, so every commit leaves an uncompacted overlapping
+    L0 run per touched bucket — the input shape the mesh/single-chip
+    compaction equivalence tests need.  Same (seed, engine, knobs) =>
+    bit-identical table, so two calls produce interchangeable twins.
+
+    `sequence_group`: partial-update only — members v2,name follow the
+    largest v1 (reference PartialUpdateMergeFunction sequence groups).
+    """
+    rng = random.Random(seed)
+    b = (Schema.builder()
+         .column("pt", IntType(False))
+         .column("id", BigIntType(False))
+         .column("v1", IntType())
+         .column("v2", DoubleType())
+         .column("name", VarCharType.string_type()))
+    opts = {"bucket": str(buckets), "write-only": "true",
+            "merge-engine": engine}
+    if engine == "aggregation":
+        opts["fields.v1.aggregate-function"] = "sum"
+        opts["fields.v2.aggregate-function"] = "max"
+    if sequence_group:
+        assert engine == "partial-update"
+        opts["fields.v1.sequence-group"] = "v2,name"
+    opts.update(extra_options or {})
+    table = FileStoreTable.create(
+        path, b.primary_key("pt", "id").options(opts).build())
+    for _ in range(commits):
+        rows, kinds = [], []
+        for _ in range(rows_per_commit):
+            rows.append({
+                "pt": rng.randrange(3),
+                "id": rng.randrange(key_space),
+                "v1": rng.randrange(1000)
+                if rng.random() > 0.1 else None,
+                "v2": round(rng.uniform(0, 100), 6)
+                if rng.random() > 0.1 else None,
+                "name": rng.choice(["a", "b", "c", "longer-value",
+                                    None]),
+            })
+            kinds.append(RowKind.DELETE
+                         if deletes and engine == "deduplicate"
+                         and rng.random() < 0.15 else RowKind.INSERT)
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts(rows, row_kinds=kinds)
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+    return table
+
+
 class OracleModel:
     """In-memory replay of per-engine merge semantics.
 

@@ -14,3 +14,32 @@ def test_options_doc_up_to_date():
                                       "generate_options.py"), "--check"],
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert proc.returncode == 0, proc.stderr
+
+
+def test_no_duplicated_option_keys():
+    """Every CoreOptions key is declared exactly once.  Duplicates with
+    the same attribute name collapse in the class dict (the second
+    silently wins), so this scans the source — the bug class behind the
+    doubled `manifest.target-file-size` declaration."""
+    import inspect
+
+    sys.path.insert(0, REPO)
+    from docs.generate_options import duplicate_option_keys
+    from paimon_tpu.options import CoreOptions
+
+    assert duplicate_option_keys(inspect.getsource(CoreOptions)) == []
+
+
+def test_duplicate_option_key_detection():
+    """The drift checker actually flags a duplicated key (and so
+    generate_options.py --check exits non-zero on one)."""
+    sys.path.insert(0, REPO)
+    from docs.generate_options import duplicate_option_keys
+
+    src = '''
+    A = ConfigOption("some.key", str, "x", "")
+    B = ConfigOption(
+        "other.key", int, 1, "")
+    A = ConfigOption("some.key", str, "y", "")
+    '''
+    assert duplicate_option_keys(src) == ["some.key"]

@@ -1,0 +1,246 @@
+"""Streaming mesh compaction engine (parallel/mesh_engine.py) on the
+virtual 8-device CPU mesh: per-engine equivalence against the
+single-chip compaction path, bounded-window streaming, skew-aware
+packing, and the hard UnsupportedMergeEngineError contract.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from paimon_tpu.parallel import (
+    UnsupportedMergeEngineError, bucket_mesh, compact_table_mesh,
+    compact_table_sharded, pack_buckets, packing_skew,
+)
+from paimon_tpu.table import FileStoreTable
+from tests.store_oracle import make_random_engine_table
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENGINES = ["deduplicate", "partial-update", "aggregation", "first-row"]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should give 8 CPU devices"
+    return bucket_mesh(8)
+
+
+def _rows(table):
+    return sorted(table.to_arrow().to_pylist(),
+                  key=lambda r: (r["pt"], r["id"]))
+
+
+def _bucket_kv(table):
+    """{bucket: KV rows in key order} of the stored files — the
+    file-level (not merge-on-read) contents, incl. seq + kind."""
+    from paimon_tpu.core.kv_file import read_kv_file
+    from paimon_tpu.core.read import MergeFileSplitRead, assemble_runs
+    import pyarrow as pa
+
+    reader = MergeFileSplitRead(table.file_io, table.path, table.schema,
+                                table.options)
+    out = {}
+    for s in table.new_read_builder().new_scan().plan().splits:
+        tables = []
+        for run in assemble_runs(s.data_files):
+            for f in run:
+                tables.append(read_kv_file(
+                    table.file_io, reader.path_factory, s.partition,
+                    s.bucket, f, schema=table.schema,
+                    schema_manager=table.schema_manager))
+        t = pa.concat_tables(tables, promote_options="none")
+        out[(tuple(s.partition), s.bucket)] = t.to_pylist()
+    return out
+
+
+def _twins(tmp_path, engine, seed=11, **kw):
+    a = make_random_engine_table(str(tmp_path / "single"), seed, engine,
+                                 **kw)
+    b = make_random_engine_table(str(tmp_path / "mesh"), seed, engine,
+                                 **kw)
+    return a, b
+
+
+def _assert_equivalent(single, meshed, stats):
+    assert stats.snapshot_id is not None
+    assert meshed.latest_snapshot().commit_kind == "COMPACT"
+    # merge-on-read state identical
+    assert _rows(meshed) == _rows(single)
+    # stored file contents identical per bucket (keys, seq, kind,
+    # values) — row-identical, not merely state-identical
+    assert _bucket_kv(meshed) == _bucket_kv(single)
+    # mesh output is fully compacted: single max-level run per bucket
+    max_level = meshed.options.num_levels - 1
+    for s in meshed.new_read_builder().new_scan().plan().splits:
+        assert all(f.level == max_level for f in s.data_files)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_mesh_matches_single_chip(tmp_path, mesh, engine):
+    single, meshed = _twins(tmp_path, engine, seed=7 + len(engine))
+    assert single.compact(full=True) is not None
+    stats = compact_table_mesh(meshed, mesh)
+    assert stats.buckets > 0 and stats.windows > 0
+    assert stats.output_rows == sum(
+        len(v) for v in _bucket_kv(meshed).values())
+    _assert_equivalent(single, meshed, stats)
+
+
+def test_mesh_partial_update_sequence_groups(tmp_path, mesh):
+    single, meshed = _twins(tmp_path, "partial-update", seed=23,
+                            sequence_group=True)
+    assert single.compact(full=True) is not None
+    stats = compact_table_mesh(meshed, mesh)
+    _assert_equivalent(single, meshed, stats)
+
+
+def test_mesh_dedup_user_sequence_field(tmp_path, mesh):
+    opts = {"sequence.field": "v1"}
+    single, meshed = _twins(tmp_path, "deduplicate", seed=31,
+                            deletes=False, extra_options=opts)
+    assert single.compact(full=True) is not None
+    stats = compact_table_mesh(meshed, mesh)
+    _assert_equivalent(single, meshed, stats)
+
+
+def test_mesh_idempotent(tmp_path, mesh):
+    _, meshed = _twins(tmp_path, "deduplicate", seed=3)
+    stats = compact_table_mesh(meshed, mesh)
+    assert stats.snapshot_id is not None
+    again = compact_table_mesh(meshed, mesh)
+    assert again.snapshot_id is None
+    assert again.buckets == 0
+
+
+def test_mesh_unsupported_engine_raises(tmp_path, mesh):
+    t = make_random_engine_table(str(tmp_path / "t"), 1, "deduplicate",
+                                 commits=1, rows_per_commit=20)
+    bogus = t.copy({"merge-engine": "shiny-new-engine"})
+    with pytest.raises(UnsupportedMergeEngineError):
+        compact_table_mesh(bogus, mesh)
+
+
+def test_legacy_sharded_guard_raises(tmp_path, mesh):
+    """The legacy pad-everything path silently deduplicated every
+    engine; now any non-deduplicate table gets the typed error."""
+    t = make_random_engine_table(str(tmp_path / "t"), 2, "aggregation",
+                                 commits=1, rows_per_commit=20)
+    with pytest.raises(UnsupportedMergeEngineError):
+        compact_table_sharded(t, mesh)
+
+
+def test_mesh_rejects_changelog_producers(tmp_path, mesh):
+    t = make_random_engine_table(str(tmp_path / "t"), 4, "deduplicate",
+                                 commits=1, rows_per_commit=20)
+    with pytest.raises(ValueError, match="changelog"):
+        compact_table_mesh(t.copy({"changelog-producer": "input"}), mesh)
+
+
+def test_mesh_streams_bounded_windows(tmp_path, mesh):
+    """A bucket far larger than the window budget streams through the
+    mesh without being materialized: the per-bucket run buffers stay
+    under runs x window-rows (+ refill slack), while the bucket itself
+    is ~30x the window."""
+    window = 4096
+    t = make_random_engine_table(
+        str(tmp_path / "t"), 42, "deduplicate", buckets=1, commits=3,
+        rows_per_commit=40_000, key_space=1_000_000, deletes=False,
+        extra_options={"tpu.mesh.window-rows": str(window)})
+    before = _rows(t)                      # merge-on-read ground truth
+    stats = compact_table_mesh(t, mesh)
+    assert stats.snapshot_id is not None
+    # slightly under 3 x 40k: the write buffer pre-merges duplicate
+    # keys within each commit batch
+    assert stats.input_rows > 110_000
+    assert stats.windows > 5               # genuinely windowed
+    budget = 4 * 3 * window                # runs x window + refill slack
+    assert 0 < stats.peak_buffered_rows <= budget
+    assert 0 < stats.peak_window_rows <= budget
+    assert budget < stats.input_rows // 2  # budget << bucket size
+    assert _rows(t) == before
+
+
+def test_compact_option_routes_through_mesh(tmp_path, mesh):
+    """tpu.mesh.compact=true routes table.compact(full=True) through
+    the mesh engine (compact/ manager routing); output matches the
+    single-chip twin."""
+    single, meshed = _twins(tmp_path, "aggregation", seed=13)
+    assert single.compact(full=True) is not None
+    routed = meshed.copy({"tpu.mesh.compact": "true"})
+    sid = routed.compact(full=True)
+    assert sid is not None
+    assert routed.latest_snapshot().commit_kind == "COMPACT"
+    assert _rows(routed) == _rows(single)
+    assert _bucket_kv(routed) == _bucket_kv(single)
+
+
+def test_compact_option_falls_back_single_chip(tmp_path):
+    """Engines / configs the mesh engine cannot run route back to the
+    single-chip manager instead of raising — per-engine routing, not a
+    hard switch."""
+    t = make_random_engine_table(
+        str(tmp_path / "t"), 5, "deduplicate", commits=2,
+        rows_per_commit=40,
+        extra_options={"tpu.mesh.compact": "true",
+                       "changelog-producer": "input"})
+    sid = t.compact(full=True)
+    assert sid is not None
+    assert t.latest_snapshot().commit_kind == "COMPACT"
+
+
+# -- packing -----------------------------------------------------------------
+
+
+def test_pack_buckets_skew_aware():
+    counts = [1000, 10, 10, 10, 10, 10, 10, 10]
+    lanes = pack_buckets(counts, 4)
+    loads = [sum(counts[i] for i in lane) for lane in lanes]
+    # the hot bucket owns a lane alone; every bucket assigned once
+    assert sorted(i for lane in lanes for i in lane) == list(range(8))
+    assert max(loads) == 1000
+    assert [0] in lanes
+    assert packing_skew(counts, lanes) == pytest.approx(
+        1000 / (sum(counts) / 4))
+
+
+def test_pack_buckets_balances_uniform():
+    counts = [100] * 16
+    lanes = pack_buckets(counts, 8)
+    assert all(len(lane) == 2 for lane in lanes)
+
+
+def test_pack_buckets_fewer_buckets_than_lanes():
+    lanes = pack_buckets([5, 7], 8)
+    assert sorted(i for lane in lanes for i in lane) == [0, 1]
+    assert sum(1 for lane in lanes if lane) == 2
+
+
+def test_pack_buckets_deterministic():
+    counts = [3, 9, 1, 9, 3, 7]
+    assert pack_buckets(counts, 3) == pack_buckets(list(counts), 3)
+
+
+# -- multichip dryrun (CI-recorded) ------------------------------------------
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_engines(mesh):
+    """Aggregation + deduplicate through the mesh engine at >= 10M
+    rows on the CPU mesh backend; rows/s recorded to MULTICHIP_r06.json
+    (the round-6 multichip artifact)."""
+    from paimon_tpu.parallel.dryrun import run_engines
+
+    rows = int(os.environ.get("DRYRUN_ROWS", "10000000"))
+    record = run_engines(8, rows=rows, mesh=mesh,
+                         out_path=os.path.join(REPO,
+                                               "MULTICHIP_r06.json"))
+    for engine in ("deduplicate", "aggregation"):
+        r = record["engines"][engine]
+        assert r["input_rows"] >= rows
+        assert r["output_rows"] > 0
+        assert r["rows_per_sec"] > 0
