@@ -215,6 +215,21 @@ def cmd_table(args):
         table = _table(catalog, args.table)
         n = table.expire_snapshots(retain_max=args.retain_max)
         print(f"{n or 0} snapshots expired")
+    elif cmd == "fsck":
+        table = _table(catalog, args.table)
+        report = table.fsck(snapshot_id=args.snapshot, deep=args.deep)
+        if args.fix and not report.ok:
+            from paimon_tpu.maintenance import fix_violations
+            actions = fix_violations(table, report)
+            report = table.fsck(snapshot_id=args.snapshot,
+                                deep=args.deep)
+            out = report.to_dict()
+            out["fix_actions"] = actions
+        else:
+            out = report.to_dict()
+        print(json.dumps(out, indent=2, default=str))
+        if not report.ok:
+            raise SystemExit(1)
 
 
 def cmd_tag(args):
@@ -346,6 +361,16 @@ def build_parser() -> argparse.ArgumentParser:
     c = tsub.add_parser("expire-snapshots")
     c.add_argument("table")
     c.add_argument("--retain-max", type=int)
+    c = tsub.add_parser(
+        "fsck", help="verify the snapshot/manifest/file graph")
+    c.add_argument("table")
+    c.add_argument("--snapshot", type=int,
+                   help="check one snapshot only")
+    c.add_argument("--deep", action="store_true",
+                   help="also read data files and verify stats")
+    c.add_argument("--fix", action="store_true",
+                   help="repair fixable violations "
+                        "(maintenance/repair.py), then re-check")
     t.set_defaults(func=cmd_table)
 
     tg = sub.add_parser("tag", help="tag operations")

@@ -206,17 +206,25 @@ class FileStoreCommit:
         from paimon_tpu.metrics import global_registry
         import time as _time
 
+        from paimon_tpu.utils.backoff import Backoff
+
         _metrics = global_registry().group("commit")
         _t0 = _time.perf_counter()
         _attempts = 0
         _max_retries = self.options.get(CoreOptions.COMMIT_MAX_RETRIES)
         _min_wait = self.options.get(CoreOptions.COMMIT_MIN_RETRY_WAIT)
         _max_wait = self.options.get(CoreOptions.COMMIT_MAX_RETRY_WAIT)
+        # decorrelated jitter between the retry-wait bounds, bounded in
+        # total time by commit.timeout (utils/backoff.py — shared with
+        # RetryingObjectStoreBackend and the mesh bucket-retry ladder)
+        _backoff = Backoff(_min_wait, _max_wait,
+                           self.options.get(CoreOptions.COMMIT_TIMEOUT))
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         entries_orig = list(entries)
         while True:
-            if _attempts > _max_retries:
+            if _attempts > _max_retries or \
+                    (_attempts > 0 and _backoff.budget_exhausted()):
                 # the per-attempt cleanup keeps the (reusable) delta and
                 # changelog manifest FILES; on giving up they would be
                 # orphaned with no snapshot referencing them
@@ -226,13 +234,10 @@ class FileStoreCommit:
                             self.manifest_file.path(m.file_name))
                 raise CommitConflictError(
                     f"Commit lost the snapshot CAS race "
-                    f"{_max_retries} times (commit.max-retries); "
-                    f"giving up")
+                    f"{_attempts - 1} times (commit.max-retries="
+                    f"{_max_retries}, commit.timeout); giving up")
             if _attempts > 0:
-                # exponential backoff between retry-wait bounds
-                # (reference CoreOptions commit.min/max-retry-wait)
-                wait = min(_min_wait * (2 ** (_attempts - 1)), _max_wait)
-                _time.sleep(wait / 1000.0)
+                _backoff.pause()
             _attempts += 1
             latest = self.snapshot_manager.latest_snapshot()
             if expected_latest_id is not ... and \

@@ -228,7 +228,13 @@ class LocalFileIO(FileIO):
         out = []
         for name in os.listdir(p):
             full = os.path.join(p, name)
-            st = os.stat(full)
+            try:
+                st = os.stat(full)
+            except FileNotFoundError:
+                # raced a concurrent writer/deleter: atomic-write .tmp
+                # files and expiring snapshots vanish between listdir
+                # and stat — a listing reflects SOME point in time
+                continue
             out.append(FileStatus(full, st.st_size, os.path.isdir(full),
                                   int(st.st_mtime * 1000)))
         return out

@@ -230,30 +230,51 @@ class RetryingObjectStoreBackend(ObjectStoreBackend):
     identifies the writer."""
 
     def __init__(self, inner: ObjectStoreBackend, max_attempts: int = 6,
-                 backoff_s: float = 0.0):
+                 backoff_s: float = 0.0,
+                 backoff_cap_s: Optional[float] = None,
+                 max_elapsed_s: Optional[float] = None,
+                 rng=None):
         self.inner = inner
         self.max_attempts = max_attempts
         self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.max_elapsed_s = max_elapsed_s
+        self._rng = rng
 
-    def _pause(self, attempt: int):
-        if self.backoff_s:
-            import time as _time
-            _time.sleep(self.backoff_s * (attempt + 1))
+    def _backoff(self):
+        """Fresh capped decorrelated-jitter schedule per operation
+        (utils/backoff.py — shared with FileStoreCommit's CAS retry
+        wait and the mesh engine's per-bucket ladder)."""
+        from paimon_tpu.utils.backoff import Backoff
+        return Backoff(
+            self.backoff_s * 1000.0,
+            None if self.backoff_cap_s is None
+            else self.backoff_cap_s * 1000.0,
+            None if self.max_elapsed_s is None
+            else self.max_elapsed_s * 1000.0,
+            rng=self._rng)
 
     def _retry(self, fn, op: str):
         last = None
+        backoff = self._backoff()
         for attempt in range(self.max_attempts):
             try:
                 return fn()
             except TransientStoreError as e:
                 last = e
-                self._pause(attempt)
+                if attempt + 1 >= self.max_attempts:
+                    break               # terminal: no wait nobody uses
+                if not backoff.pause():
+                    raise TransientStoreError(
+                        f"{op}: retry budget "
+                        f"({self.max_elapsed_s}s) exhausted") from last
         raise TransientStoreError(
             f"{op}: {self.max_attempts} attempts exhausted") from last
 
     def put(self, key: str, data: bytes, if_none_match: bool = False):
         ambiguous = False
         last = None
+        backoff = self._backoff()
         for attempt in range(self.max_attempts):
             try:
                 return self.inner.put(key, data,
@@ -261,7 +282,12 @@ class RetryingObjectStoreBackend(ObjectStoreBackend):
             except TransientStoreError as e:
                 last = e
                 ambiguous = True       # effect may or may not be applied
-                self._pause(attempt)
+                if attempt + 1 >= self.max_attempts:
+                    break              # terminal: no wait nobody uses
+                if not backoff.pause():
+                    raise TransientStoreError(
+                        f"put {key}: retry budget "
+                        f"({self.max_elapsed_s}s) exhausted") from last
             except PreconditionFailed:
                 if if_none_match and ambiguous:
                     # ambiguity resolution by read-back: valid ONLY

@@ -7,6 +7,10 @@ operation/OrphanFilesClean.java, operation/PartitionExpire.java.
 from paimon_tpu.maintenance.expire import (  # noqa: F401
     ExpireResult, expire_changelogs, expire_snapshots,
 )
+from paimon_tpu.maintenance.fsck import (  # noqa: F401
+    FsckReport, FsckViolation, ViolationKind, fsck,
+)
+from paimon_tpu.maintenance.repair import fix_violations  # noqa: F401
 from paimon_tpu.maintenance.mark_done import (  # noqa: F401
     PartitionMarkDoneTrigger, mark_partitions_done,
 )

@@ -255,6 +255,16 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
     if earliest is None or latest is None:
         return result
 
+    # hint writes are best-effort (swallowed OSError), so a torn prior
+    # expire can leave EARLIEST/LATEST pointing at deleted snapshots;
+    # a restart heals them even when nothing is left to expire
+    if not dry_run:
+        from paimon_tpu.snapshot.snapshot_manager import EARLIEST, LATEST
+        for name, sid in ((EARLIEST, earliest), (LATEST, latest)):
+            hint = sm._hint(name)
+            if hint is not None and not sm.snapshot_exists(hint):
+                sm._write_hint(name, sid)
+
     # upper bound of expiry (exclusive). Constraints, in order:
     #   keep at least retain_min snapshots
     #   expire anything beyond retain_max regardless of age

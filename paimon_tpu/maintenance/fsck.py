@@ -1,0 +1,429 @@
+"""Table fsck: verify the snapshot → manifest → file graph.
+
+After a crash (torn maintenance job, out-of-band deletion, a buggy
+tool) nothing in the store verifies that a table's metadata graph still
+holds together; every reader just fails at whatever broken edge it hits
+first.  `fsck(table)` walks the whole graph — snapshot chain + hints,
+base/delta/changelog manifest lists, manifest files, data files,
+index/DV manifests — and reports TYPED violations so operators (and the
+crash-point sweep tests) can tell corruption classes apart:
+
+    structure   snapshot-gap, bad-hint, corrupt-snapshot
+    metadata    missing-manifest-list, corrupt-manifest-list,
+                missing-manifest, corrupt-manifest
+    data        dangling-data-file, file-size-mismatch,
+                corrupt-data-file (deep), stats-mismatch (deep)
+    invariants  level-overlap, row-count-mismatch
+    index       missing-index-manifest, corrupt-index-manifest,
+                dangling-index-file
+    changelog   dangling-changelog-file
+
+Manifest kinds are split by object class on purpose: `fix_violations`
+drops + rewrites DATA manifests (missing-manifest/corrupt-manifest),
+which would be flat wrong for an index manifest or a snapshot file —
+those get their own kinds and are not fixable.
+
+`maintenance/repair.py::fix_violations` maps fixable classes onto the
+existing repair actions (remove_unexisting_files /
+remove_unexisting_manifests / compact_manifests) — the CLI surface is
+`paimon table fsck db.t [--deep] [--fix]`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from paimon_tpu.manifest import FileKind, merge_manifest_entries
+from paimon_tpu.snapshot import Snapshot
+from paimon_tpu.snapshot.snapshot_manager import (
+    EARLIEST, LATEST, SNAPSHOT_PREFIX,
+)
+
+__all__ = ["ViolationKind", "FsckViolation", "FsckReport", "fsck"]
+
+
+class ViolationKind:
+    SNAPSHOT_GAP = "snapshot-gap"
+    BAD_HINT = "bad-hint"
+    CORRUPT_SNAPSHOT = "corrupt-snapshot"
+    MISSING_MANIFEST_LIST = "missing-manifest-list"
+    CORRUPT_MANIFEST_LIST = "corrupt-manifest-list"
+    MISSING_MANIFEST = "missing-manifest"
+    CORRUPT_MANIFEST = "corrupt-manifest"
+    MISSING_INDEX_MANIFEST = "missing-index-manifest"
+    CORRUPT_INDEX_MANIFEST = "corrupt-index-manifest"
+    DANGLING_DATA_FILE = "dangling-data-file"
+    FILE_SIZE_MISMATCH = "file-size-mismatch"
+    CORRUPT_DATA_FILE = "corrupt-data-file"
+    STATS_MISMATCH = "stats-mismatch"
+    LEVEL_OVERLAP = "level-overlap"
+    ROW_COUNT_MISMATCH = "row-count-mismatch"
+    DANGLING_INDEX_FILE = "dangling-index-file"
+    DANGLING_CHANGELOG_FILE = "dangling-changelog-file"
+
+    # classes fix_violations can repair ON THE LATEST SNAPSHOT (older
+    # snapshots heal by expiring); the rest only heal by restore/expiry
+    FIXABLE = frozenset({
+        BAD_HINT, MISSING_MANIFEST, CORRUPT_MANIFEST,
+        DANGLING_DATA_FILE, ROW_COUNT_MISMATCH,
+    })
+
+
+@dataclass
+class FsckViolation:
+    kind: str
+    obj: str                       # the offending file/hint/bucket
+    detail: str
+    snapshot_id: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "object": self.obj,
+                "detail": self.detail, "snapshot": self.snapshot_id}
+
+
+@dataclass
+class FsckReport:
+    violations: List[FsckViolation] = field(default_factory=list)
+    snapshots_checked: int = 0
+    manifests_checked: int = 0
+    data_files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def kinds(self) -> Set[str]:
+        return {v.kind for v in self.violations}
+
+    def by_kind(self, kind: str) -> List[FsckViolation]:
+        return [v for v in self.violations if v.kind == kind]
+
+    def add(self, kind: str, obj: str, detail: str,
+            snapshot_id: Optional[int] = None):
+        self.violations.append(
+            FsckViolation(kind, obj, detail, snapshot_id))
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "snapshots_checked": self.snapshots_checked,
+            "manifests_checked": self.manifests_checked,
+            "data_files_checked": self.data_files_checked,
+            "violations": [v.to_dict() for v in self.violations],
+        }
+
+
+class _GraphWalker:
+    """Shared caches across snapshots: a manifest read/verified once is
+    not re-read for every snapshot referencing it."""
+
+    def __init__(self, table, report: FsckReport, deep: bool):
+        self.table = table
+        self.scan = table.new_scan()
+        self.report = report
+        self.deep = deep
+        # name -> entries, or None when the manifest is missing/corrupt
+        self._manifest_cache: Dict[str, Optional[list]] = {}
+        self._exists_cache: Dict[str, bool] = {}
+        key_types = [
+            table.schema.logical_row_type().get_field(k).type.copy(False)
+            for k in table.schema.trimmed_primary_keys()]
+        self._key_codec = None
+        if key_types:
+            from paimon_tpu.data.binary_row import BinaryRowCodec
+            self._key_codec = BinaryRowCodec(key_types)
+
+    # -- primitives ----------------------------------------------------------
+
+    def _exists(self, path: str) -> bool:
+        cached = self._exists_cache.get(path)
+        if cached is None:
+            cached = self._exists_cache[path] = \
+                self.table.file_io.exists(path)
+        return cached
+
+    def _decode_key(self, b: bytes):
+        if not b or self._key_codec is None:
+            return None
+        try:
+            return tuple(self._key_codec.from_bytes(b))
+        except Exception:                   # noqa: BLE001
+            return None                     # undecodable -> skip overlap
+
+    def read_manifest(self, name: str, sid: Optional[int]
+                      ) -> Optional[list]:
+        if name in self._manifest_cache:
+            return self._manifest_cache[name]
+        path = self.scan.manifest_file.path(name)
+        entries: Optional[list] = None
+        if not self._exists(path):
+            self.report.add(ViolationKind.MISSING_MANIFEST, name,
+                            "manifest file referenced by the manifest "
+                            "list does not exist on storage", sid)
+        else:
+            try:
+                entries = self.scan.manifest_file.read(name)
+            except Exception as e:          # noqa: BLE001
+                self.report.add(
+                    ViolationKind.CORRUPT_MANIFEST, name,
+                    f"manifest file exists but cannot be decoded "
+                    f"(truncated or corrupt): {e}", sid)
+        self.report.manifests_checked += 1
+        self._manifest_cache[name] = entries
+        return entries
+
+    def read_manifest_list(self, name: str, sid: Optional[int],
+                           plane: str) -> Optional[list]:
+        path = self.scan.manifest_list.path(name)
+        if not self._exists(path):
+            self.report.add(ViolationKind.MISSING_MANIFEST_LIST, name,
+                            f"{plane} manifest list missing", sid)
+            return None
+        try:
+            return self.scan.manifest_list.read(name)
+        except Exception as e:              # noqa: BLE001
+            self.report.add(ViolationKind.CORRUPT_MANIFEST_LIST, name,
+                            f"{plane} manifest list undecodable: {e}",
+                            sid)
+            return None
+
+    def data_file_path(self, entry) -> str:
+        partition = self.scan._partition_codec.from_bytes(
+            entry.partition)
+        return entry.file.external_path or \
+            self.scan.path_factory.data_file_path(
+                partition, entry.bucket, entry.file.file_name)
+
+    # -- per-snapshot checks -------------------------------------------------
+
+    def check_snapshot(self, snap: Snapshot):
+        report, sid = self.report, snap.id
+        report.snapshots_checked += 1
+        entries: list = []
+        for plane, list_name in (("base", snap.base_manifest_list),
+                                 ("delta", snap.delta_manifest_list)):
+            if not list_name:
+                continue
+            metas = self.read_manifest_list(list_name, sid, plane)
+            for m in metas or []:
+                got = self.read_manifest(m.file_name, sid)
+                if got is not None:
+                    entries.extend(got)
+        live = [e for e in merge_manifest_entries(entries)
+                if e.kind == FileKind.ADD]
+        self._check_data_files(live, sid)
+        self._check_level_overlap(live, sid)
+        self._check_row_counts(live, snap)
+        self._check_index_manifest(snap)
+        self._check_changelogs(snap)
+
+    def _check_data_files(self, live, sid: int):
+        report = self.report
+        for e in live:
+            report.data_files_checked += 1
+            path = self.data_file_path(e)
+            if not self._exists(path):
+                report.add(ViolationKind.DANGLING_DATA_FILE,
+                           e.file.file_name,
+                           f"data file referenced by bucket "
+                           f"{e.bucket} is missing: {path}", sid)
+                continue
+            if e.file.file_size:
+                try:
+                    actual = self.table.file_io.get_file_size(path)
+                except OSError:
+                    actual = None
+                if actual is not None and actual != e.file.file_size:
+                    report.add(
+                        ViolationKind.FILE_SIZE_MISMATCH,
+                        e.file.file_name,
+                        f"manifest records {e.file.file_size} bytes, "
+                        f"storage holds {actual}", sid)
+            partition = self.scan._partition_codec.from_bytes(
+                e.partition)
+            for extra in e.file.extra_files:
+                epath = self.scan.path_factory.data_file_path(
+                    partition, e.bucket, extra)
+                if not self._exists(epath):
+                    report.add(ViolationKind.DANGLING_DATA_FILE, extra,
+                               f"extra file of {e.file.file_name} "
+                               f"missing: {epath}", sid)
+            if self.deep:
+                self._deep_check_file(e, path, sid)
+
+    def _deep_check_file(self, e, path: str, sid: int):
+        """Read the file and compare actual row count against the
+        manifest meta (stats plane)."""
+        from paimon_tpu.format import get_format
+        try:
+            ext = e.file.file_name.rsplit(".", 1)[-1]
+            fmt = get_format(ext)
+            rows = 0
+            for batch in fmt.create_reader().read_batches(
+                    self.table.file_io, path):
+                rows += batch.num_rows
+        except Exception as exc:            # noqa: BLE001
+            self.report.add(ViolationKind.CORRUPT_DATA_FILE,
+                            e.file.file_name,
+                            f"data file unreadable: {exc}", sid)
+            return
+        if rows != e.file.row_count:
+            self.report.add(
+                ViolationKind.STATS_MISMATCH, e.file.file_name,
+                f"manifest stats record {e.file.row_count} rows, file "
+                f"holds {rows}", sid)
+
+    def _check_level_overlap(self, live, sid: int):
+        """Sorted runs at level >= 1 must not overlap in key range
+        within one (partition, bucket, level) — the invariant
+        ConflictDetection guards at commit time, re-checked at rest."""
+        if self._key_codec is None:
+            return
+        groups: Dict[Tuple, list] = {}
+        for e in live:
+            if e.file.level and e.file.level > 0:
+                groups.setdefault(
+                    (e.partition, e.bucket, e.file.level), []).append(e)
+        for (_, bucket, level), es in groups.items():
+            ranged = []
+            for e in es:
+                lo = self._decode_key(e.file.min_key)
+                hi = self._decode_key(e.file.max_key)
+                if lo is not None and hi is not None:
+                    ranged.append((lo, hi, e.file.file_name))
+            ranged.sort()
+            for (lo1, hi1, n1), (lo2, hi2, n2) in zip(ranged,
+                                                      ranged[1:]):
+                if lo2 <= hi1:
+                    self.report.add(
+                        ViolationKind.LEVEL_OVERLAP, n2,
+                        f"bucket {bucket} level {level}: key range of "
+                        f"{n2} overlaps {n1} "
+                        f"([{lo1}..{hi1}] vs [{lo2}..{hi2}])", sid)
+
+    def _check_row_counts(self, live, snap: Snapshot):
+        total = sum(e.file.row_count for e in live)
+        if total != snap.total_record_count:
+            self.report.add(
+                ViolationKind.ROW_COUNT_MISMATCH,
+                f"{SNAPSHOT_PREFIX}{snap.id}",
+                f"snapshot records totalRecordCount="
+                f"{snap.total_record_count}, live manifest entries sum "
+                f"to {total}", snap.id)
+
+    def _check_index_manifest(self, snap: Snapshot):
+        if not snap.index_manifest:
+            return
+        report, sid = self.report, snap.id
+        path = self.scan.index_manifest_file.path(snap.index_manifest)
+        if not self._exists(path):
+            report.add(ViolationKind.MISSING_INDEX_MANIFEST,
+                       snap.index_manifest,
+                       "index manifest missing", sid)
+            return
+        try:
+            ientries = self.scan.index_manifest_file.read(
+                snap.index_manifest)
+        except Exception as e:              # noqa: BLE001
+            report.add(ViolationKind.CORRUPT_INDEX_MANIFEST,
+                       snap.index_manifest,
+                       f"index manifest undecodable: {e}", sid)
+            return
+        for ie in ientries:
+            if ie.kind != FileKind.ADD:
+                continue
+            ipath = self.scan.path_factory.index_file_path(
+                ie.index_file.file_name)
+            if not self._exists(ipath):
+                report.add(ViolationKind.DANGLING_INDEX_FILE,
+                           ie.index_file.file_name,
+                           f"index/DV file missing: {ipath}", sid)
+
+    def _check_changelogs(self, snap: Snapshot):
+        if not snap.changelog_manifest_list:
+            return
+        sid = snap.id
+        metas = self.read_manifest_list(snap.changelog_manifest_list,
+                                        sid, "changelog")
+        for m in metas or []:
+            entries = self.read_manifest(m.file_name, sid)
+            for e in entries or []:
+                if e.kind != FileKind.ADD:
+                    continue
+                path = self.data_file_path(e)
+                if not self._exists(path):
+                    self.report.add(
+                        ViolationKind.DANGLING_CHANGELOG_FILE,
+                        e.file.file_name,
+                        f"changelog file missing: {path}", sid)
+
+
+def _check_chain(table, report: FsckReport) -> List[int]:
+    """Snapshot chain contiguity + EARLIEST/LATEST hint validity.
+    Returns the sorted existing snapshot ids."""
+    sm = table.snapshot_manager
+    ids = sm._all_ids()
+    if ids:
+        missing = sorted(set(range(ids[0], ids[-1] + 1)) - set(ids))
+        for sid in missing:
+            report.add(ViolationKind.SNAPSHOT_GAP,
+                       f"{SNAPSHOT_PREFIX}{sid}",
+                       f"snapshot {sid} missing from the chain "
+                       f"[{ids[0]}..{ids[-1]}]", sid)
+    for name in (EARLIEST, LATEST):
+        hint = sm._hint(name)
+        if hint is not None and not sm.snapshot_exists(hint):
+            report.add(ViolationKind.BAD_HINT, name,
+                       f"{name} hint points at missing snapshot "
+                       f"{hint}")
+    return ids
+
+
+def fsck(table, snapshot_id: Optional[int] = None,
+         all_snapshots: bool = True, deep: bool = False) -> FsckReport:
+    """Verify the table's snapshot→manifest→file graph; returns an
+    `FsckReport` of typed violations (empty = healthy).
+
+    `snapshot_id` restricts the graph walk to one snapshot;
+    `all_snapshots=False` checks only the latest.  `deep=True`
+    additionally reads every live data file and compares actual row
+    counts against manifest stats (IO-heavy).  The snapshot chain and
+    hint files are always checked."""
+    from paimon_tpu.metrics import FSCK_VIOLATIONS, global_registry
+
+    report = FsckReport()
+    ids = _check_chain(table, report)
+    if not ids:
+        return report
+
+    if snapshot_id is not None:
+        targets = [snapshot_id] if snapshot_id in ids else []
+        if not targets:
+            report.add(ViolationKind.SNAPSHOT_GAP,
+                       f"{SNAPSHOT_PREFIX}{snapshot_id}",
+                       f"requested snapshot {snapshot_id} does not "
+                       f"exist", snapshot_id)
+    elif all_snapshots:
+        targets = ids
+    else:
+        targets = [ids[-1]]
+
+    walker = _GraphWalker(table, report, deep)
+    sm = table.snapshot_manager
+    for sid in targets:
+        try:
+            snap = sm.snapshot(sid)
+        except FileNotFoundError:
+            continue                        # raced an expire; chain
+        except Exception as e:              # noqa: BLE001
+            report.add(ViolationKind.CORRUPT_SNAPSHOT,
+                       f"{SNAPSHOT_PREFIX}{sid}",
+                       f"snapshot file undecodable: {e}", sid)
+            continue
+        walker.check_snapshot(snap)
+
+    if report.violations:
+        global_registry().maintenance_metrics().counter(
+            FSCK_VIOLATIONS).inc(len(report.violations))
+    return report

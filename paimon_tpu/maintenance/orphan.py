@@ -35,10 +35,18 @@ def _walk_files(file_io, root: str, out: List):
 
 
 def remove_orphan_files(table, older_than_ms: Optional[int] = None,
-                        dry_run: bool = False) -> List[str]:
+                        dry_run: bool = False,
+                        now_ms: Optional[int] = None) -> List[str]:
     """Delete unreferenced data/manifest/index files older than the
-    grace period. Returns the deleted paths."""
-    cutoff = (int(_time.time() * 1000) - DEFAULT_OLDER_THAN_MS) \
+    grace period. Returns the deleted paths.
+
+    `older_than_ms` is the ABSOLUTE cutoff (files modified at or after
+    it survive); when omitted it derives from `now_ms` (injectable
+    clock, defaults to wall time) minus the one-day grace period that
+    protects in-flight writers."""
+    if now_ms is None:
+        now_ms = int(_time.time() * 1000)
+    cutoff = (now_ms - DEFAULT_OLDER_THAN_MS) \
         if older_than_ms is None else older_than_ms
 
     from paimon_tpu.maintenance.expire import _snapshot_refs

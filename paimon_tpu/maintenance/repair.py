@@ -12,7 +12,47 @@ from __future__ import annotations
 from typing import List
 
 __all__ = ["remove_unexisting_files", "remove_unexisting_manifests",
-           "compact_manifests", "rewrite_file_index"]
+           "compact_manifests", "rewrite_file_index", "fix_violations"]
+
+
+def fix_violations(table, report) -> List[str]:
+    """Map an FsckReport's FIXABLE violation classes onto the repair
+    actions below (the `fsck --fix` backend).  Repairs apply to the
+    LATEST snapshot — violations pinned in older snapshots heal by
+    snapshot expiration.  Returns the action names run, in order."""
+    from paimon_tpu.maintenance.fsck import ViolationKind
+
+    kinds = report.kinds()
+    actions: List[str] = []
+    # corrupt manifests must be dropped first: the chain rewrite can
+    # skip MISSING files but chokes on undecodable ones
+    corrupt = report.by_kind(ViolationKind.CORRUPT_MANIFEST)
+    if corrupt:
+        scan = table.new_scan()
+        for v in corrupt:
+            table.file_io.delete_quietly(
+                scan.manifest_file.path(v.obj))
+        actions.append("drop-corrupt-manifests")
+    if corrupt or ViolationKind.MISSING_MANIFEST in kinds:
+        remove_unexisting_manifests(table)
+        actions.append("remove-unexisting-manifests")
+    if ViolationKind.DANGLING_DATA_FILE in kinds:
+        remove_unexisting_files(table)
+        actions.append("remove-unexisting-files")
+    if ViolationKind.ROW_COUNT_MISMATCH in kinds and \
+            "remove-unexisting-manifests" not in actions:
+        # the full manifest rewrite recounts every live entry, fixing
+        # a drifted totalRecordCount (it also ran implicitly above)
+        compact_manifests(table)
+        actions.append("compact-manifests")
+    if ViolationKind.BAD_HINT in kinds:
+        sm = table.snapshot_manager
+        ids = sm._all_ids()
+        if ids:
+            sm.commit_earliest_hint(ids[0])
+            sm.commit_latest_hint(ids[-1])
+        actions.append("rewrite-hints")
+    return actions
 
 
 def remove_unexisting_files(table, dry_run: bool = False) -> List[str]:

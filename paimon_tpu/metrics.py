@@ -13,7 +13,19 @@ import time
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
-           "MetricRegistry", "global_registry"]
+           "MetricRegistry", "global_registry",
+           "COMPACTION_BUCKET_RETRIES", "COMPACTION_BUCKET_FALLBACKS",
+           "COMPACTION_BUCKET_FAILURES", "FSCK_VIOLATIONS"]
+
+# fault-tolerance counter names (one definition; producers in
+# parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
+#   bucket_retries   — transient per-bucket failures that were retried
+#   bucket_fallbacks — buckets degraded to the single-chip path
+#   bucket_failures  — buckets that exhausted the whole ladder (raised)
+COMPACTION_BUCKET_RETRIES = "bucket_retries"
+COMPACTION_BUCKET_FALLBACKS = "bucket_fallbacks"
+COMPACTION_BUCKET_FAILURES = "bucket_failures"
+FSCK_VIOLATIONS = "fsck_violations"
 
 
 class Counter:
@@ -131,6 +143,10 @@ class MetricRegistry:
 
     def compaction_metrics(self, table: str = "") -> MetricGroup:
         return self.group("compaction", table)
+
+    def maintenance_metrics(self, table: str = "") -> MetricGroup:
+        """Expire / orphan-clean / fsck plane (ours)."""
+        return self.group("maintenance", table)
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         """{group: {metric: value}} for reporting."""

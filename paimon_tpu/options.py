@@ -394,6 +394,22 @@ class CoreOptions:
         "on for batch writers, off for streaming exactly-once "
         "progress; reference CoreOptions.java:2497)")
 
+    # -- maintenance fault tolerance (ours) ----------------------------------
+    COMPACTION_RETRY_MAX_ATTEMPTS = ConfigOption(
+        "compaction.retry.max-attempts", int, 3,
+        "Per-bucket attempts a mesh compaction makes on a transient "
+        "failure (503 storms, injected IO faults, lane/device loss) "
+        "before degrading that bucket to the single-chip path")
+    COMPACTION_RETRY_BACKOFF = ConfigOption(
+        "compaction.retry.backoff", _parse_duration_ms, 10,
+        "Base wait between per-bucket compaction retries; actual "
+        "waits use capped decorrelated jitter (utils/backoff.py)")
+    COMPACTION_MESH_FALLBACK = ConfigOption(
+        "compaction.mesh.fallback", _parse_bool, True,
+        "After retries are exhausted, degrade the failing bucket to "
+        "the single-chip compact/manager.py path instead of failing "
+        "the whole mesh job; false = raise once retries run out")
+
     # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
     SCAN_PLAN_SORT_PARTITION = ConfigOption(
         "scan.plan-sort-partition", _parse_bool, False,

@@ -22,6 +22,9 @@ from paimon_tpu.parallel.mesh_engine import (  # noqa: F401
     MeshCompactStats, SUPPORTED_MERGE_ENGINES,
     UnsupportedMergeEngineError, compact_table_mesh,
 )
+from paimon_tpu.parallel.fault import (  # noqa: F401
+    BucketRetryPolicy, is_transient_error,
+)
 from paimon_tpu.parallel.packing import (  # noqa: F401
     bucket_row_counts, pack_buckets, packing_skew,
 )

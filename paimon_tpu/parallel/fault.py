@@ -1,0 +1,88 @@
+"""Fault classification + per-bucket retry policy for the maintenance
+plane.
+
+The mesh compaction engine (parallel/mesh_engine.py) treats a bucket as
+its failure domain: a transient error anywhere in one bucket's window
+stream — reading a sorted run, the device window kernel, writing or
+rolling an output file — aborts and retries THAT bucket with capped
+decorrelated-jitter backoff, and after `compaction.retry.max-attempts`
+degrades it to the single-chip compact/manager.py path instead of
+failing the whole job.  The degradation ladder is:
+
+    mesh window stream  ->  retry (x max-attempts, jittered backoff)
+                        ->  single-chip fallback (compaction.mesh.fallback)
+                        ->  raise (bucket unrecoverable; job fails)
+
+Only *transient* errors ride the ladder.  Programming errors
+(ValueError, KeyError, schema bugs) propagate immediately — retrying
+them would loop deterministically and degrade silently.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from paimon_tpu.options import CoreOptions
+
+__all__ = ["is_transient_error", "BucketRetryPolicy"]
+
+# error class NAMES treated as device/lane loss: jax surfaces device
+# failures as jaxlib XlaRuntimeError (a RuntimeError subclass we must
+# not import at module scope — jax loads lazily everywhere else)
+_DEVICE_ERROR_NAMES = frozenset({"XlaRuntimeError"})
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """True when `exc` is worth retrying: a store-side 503
+    (TransientStoreError), an IO fault (OSError covers InjectedIOError
+    and FileNotFoundError from racing maintenance), or a device/lane
+    loss (XlaRuntimeError)."""
+    from paimon_tpu.fs.object_store import TransientStoreError
+
+    if isinstance(exc, (TransientStoreError, OSError)):
+        return True
+    return any(t.__name__ in _DEVICE_ERROR_NAMES
+               for t in type(exc).__mro__)
+
+
+@dataclass
+class BucketRetryPolicy:
+    """`compaction.retry.*` + `compaction.mesh.fallback` in one bundle."""
+
+    max_attempts: int = 3
+    backoff_base_ms: float = 10.0
+    fallback: bool = True
+    rng: Optional[random.Random] = None
+
+    @classmethod
+    def from_options(cls, options: CoreOptions) -> "BucketRetryPolicy":
+        return cls(
+            max_attempts=options.get(
+                CoreOptions.COMPACTION_RETRY_MAX_ATTEMPTS),
+            backoff_base_ms=options.get(
+                CoreOptions.COMPACTION_RETRY_BACKOFF),
+            fallback=options.get(CoreOptions.COMPACTION_MESH_FALLBACK))
+
+    def new_backoff(self):
+        from paimon_tpu.utils.backoff import Backoff
+        return Backoff(self.backoff_base_ms, rng=self.rng)
+
+    def retry_call(self, fn, *, on_retry=None):
+        """Run `fn` under this policy: transient errors retry with
+        backoff up to max_attempts total attempts, then re-raise.
+        Non-transient errors propagate immediately."""
+        backoff = self.new_backoff()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn()
+            except BaseException as e:      # noqa: BLE001
+                if not is_transient_error(e) or \
+                        attempt >= max(1, self.max_attempts):
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                backoff.pause()

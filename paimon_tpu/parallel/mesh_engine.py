@@ -29,6 +29,18 @@ table-level mesh compaction.  Three deltas over that path:
    lane per device — so a hot bucket no longer pads every lane to its
    size; it occupies one lane while cold buckets share the rest.
 
+4. PER-BUCKET FAULT ISOLATION.  A bucket is the failure domain: a
+   transient error (object-store 503, injected IO fault, lane/device
+   loss) anywhere in one bucket's window stream aborts and retries
+   that bucket with capped decorrelated-jitter backoff
+   (compaction.retry.max-attempts / compaction.retry.backoff), then
+   degrades it to the single-chip compact/manager.py path
+   (compaction.mesh.fallback) instead of failing the whole job.
+   Partial output files of a failed attempt are deleted before the
+   retry, so the committed result is file-level identical to a
+   fault-free run.  Non-transient errors propagate immediately
+   (parallel/fault.py is the classification + policy).
+
 The device still only ever sees fixed-width u32 normkey lanes + u64
 sequence halves (Graefe et al.'s offset-value-coding lesson: keep the
 comparison loop on fixed-width prefixes); variable-length Arrow data
@@ -37,6 +49,7 @@ stays on host, and output files roll per bucket as windows emit.
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field as dc_field
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -75,6 +88,9 @@ class MeshCompactStats:
     skew: float = 1.0           # max/mean lane load after packing
     snapshot_id: Optional[int] = None
     lane_rows: List[int] = dc_field(default_factory=list)
+    retries: int = 0            # per-bucket transient-failure retries
+    fallbacks: int = 0          # buckets degraded to single-chip
+    cleanup_errors: int = 0     # best-effort partial-file deletes failed
 
 
 # ---------------------------------------------------------------------------
@@ -303,6 +319,10 @@ class _BucketJob:
         self.metas: List = []
         self.out_rows = 0
         self._windows = None
+        # backoff deadline (monotonic seconds): a retried bucket is
+        # requeued with a not-before instead of sleeping the whole
+        # mesh — other lanes keep streaming through the wait
+        self.ready_at = 0.0
 
     def _run_iter(self, run_files):
         """Decode one sorted run in bounded chunks, lane-encoding inside
@@ -384,13 +404,18 @@ class _LaneState:
 
     def next_window(self, finalize):
         """(job, window items) for this lane's next window; None when
-        the lane has fully drained.  Finished buckets flush + finalize
-        before the lane advances to its next bucket."""
+        the lane has drained OR every queued job is still inside its
+        retry-backoff window (ready_at in the future).  Finished
+        buckets flush + finalize before the lane advances."""
         while True:
             if self.current is None:
-                if not self.queue:
+                now = _time.monotonic()
+                ready = next((j for j in self.queue
+                              if j.ready_at <= now), None)
+                if ready is None:
                     return None
-                self.current = self.queue.pop(0)
+                self.queue.remove(ready)
+                self.current = ready
             w = self.current.next_window()
             if w is not None:
                 return (self.current, w)
@@ -411,16 +436,28 @@ def _needs_rewrite(split, max_level: int) -> bool:
                 and (fs[0].delete_row_count or 0) == 0)
 
 
-def compact_table_mesh(table, mesh=None,
-                       axis: str = "buckets") -> MeshCompactStats:
+def compact_table_mesh(table, mesh=None, axis: str = "buckets",
+                       retry_policy=None) -> MeshCompactStats:
     """Full compaction of every bucket of a primary-key table through
     the streaming mesh engine: engine-dispatched window kernels over a
     [B, window] lane stack, skew-aware bucket packing, one COMPACT
     snapshot.  Peak host memory per bucket ~ runs x window-rows,
-    independent of bucket size."""
+    independent of bucket size.
+
+    Transient failures are isolated per bucket: retry with jittered
+    backoff, then single-chip fallback (see module docstring §4 and
+    parallel/fault.py).  `retry_policy` overrides the table's
+    compaction.retry.* / compaction.mesh.fallback options."""
     from paimon_tpu.core.commit import FileStoreCommit
     from paimon_tpu.core.write import CommitMessage
+    from paimon_tpu.metrics import (
+        COMPACTION_BUCKET_FAILURES, COMPACTION_BUCKET_FALLBACKS,
+        COMPACTION_BUCKET_RETRIES, global_registry,
+    )
     from paimon_tpu.ops.merge import SEQ_COL, _pad_size
+    from paimon_tpu.parallel.fault import (
+        BucketRetryPolicy, is_transient_error,
+    )
     from paimon_tpu.parallel.sharded_merge import bucket_mesh
 
     engine = table.options.merge_engine
@@ -477,14 +514,128 @@ def compact_table_mesh(table, mesh=None,
             job.split.total_buckets,
             compact_before=job.files, compact_after=job.metas))
 
+    # -- per-bucket fault isolation (module docstring §4) -------------------
+    policy = retry_policy or BucketRetryPolicy.from_options(table.options)
+    fault_metrics = global_registry().compaction_metrics()
+    attempts: Dict[Tuple, int] = {}
+    backoffs: Dict[Tuple, object] = {}
+
+    def _job_key(split) -> Tuple:
+        return (tuple(split.partition), split.bucket)
+
+    def _cleanup_job(job: _BucketJob) -> None:
+        """Abort a failed attempt: drop buffered output, close the
+        window stream, delete any files the attempt already rolled —
+        the retry/fallback must start from the untouched inputs."""
+        job.acc, job.acc_bytes = [], 0
+        if job._windows is not None:
+            try:
+                job._windows.close()
+            except Exception:               # noqa: BLE001
+                stats.cleanup_errors += 1
+            job._windows = None
+        for m in job.metas:
+            names = [m.file_name, *m.extra_files]
+            for name in names:
+                path = m.external_path \
+                    if (name == m.file_name and m.external_path) \
+                    else ctx.path_factory.data_file_path(
+                        job.split.partition, job.split.bucket, name)
+                try:
+                    table.file_io.delete_quietly(path)
+                except Exception:           # noqa: BLE001
+                    stats.cleanup_errors += 1
+        job.metas = []
+
+    def _fallback_single_chip(split) -> Optional[CommitMessage]:
+        """Degrade one bucket to the exact single-chip full rewrite
+        (same merge semantics — the equivalence tests compare these
+        two paths row-for-row), itself retried under the policy."""
+        from paimon_tpu.compact.manager import MergeTreeCompactManager
+
+        def run():
+            mgr = MergeTreeCompactManager(
+                table.file_io, table.path, table.schema, table.options,
+                split.partition, split.bucket, list(split.data_files),
+                schema_manager=table.schema_manager)
+            return mgr.compact(full=True)
+
+        result = policy.retry_call(run)
+        if result is None or result.is_empty():
+            return None
+        return CommitMessage(
+            split.partition, split.bucket, split.total_buckets,
+            compact_before=result.before, compact_after=result.after,
+            compact_changelog=result.changelog)
+
+    def _handle_bucket_failure(lane_idx: int, job: _BucketJob,
+                               exc: BaseException) -> None:
+        """Ride the degradation ladder for one bucket; re-raises when
+        the error is not transient or the ladder is exhausted."""
+        if not is_transient_error(exc):
+            raise exc
+        lane = lanes_state[lane_idx]
+        if lane.current is job:
+            lane.current = None
+        _cleanup_job(job)
+        key = _job_key(job.split)
+        n = attempts.get(key, 0) + 1
+        attempts[key] = n
+        if n < max(1, policy.max_attempts):
+            stats.retries += 1
+            fault_metrics.counter(COMPACTION_BUCKET_RETRIES).inc()
+            if key not in backoffs:
+                backoffs[key] = policy.new_backoff()
+            # deadline, not a sleep: only THIS bucket waits out its
+            # jittered backoff; the other lanes keep streaming
+            retry_job = _BucketJob(ctx, job.split)
+            retry_job.ready_at = _time.monotonic() + \
+                backoffs[key].next_ms() / 1000.0
+            lane.queue.insert(0, retry_job)
+            return
+        if policy.fallback:
+            stats.fallbacks += 1
+            fault_metrics.counter(COMPACTION_BUCKET_FALLBACKS).inc()
+            try:
+                msg = _fallback_single_chip(job.split)
+            except Exception:
+                fault_metrics.counter(COMPACTION_BUCKET_FAILURES).inc()
+                raise
+            if msg is not None:
+                messages.append(msg)
+            return
+        fault_metrics.counter(COMPACTION_BUCKET_FAILURES).inc()
+        raise exc
+
     import pyarrow as pa
 
     kernel = _window_kernel(mesh, ctx.num_lanes, ctx.num_key_lanes,
                             ctx.keep, axis)
     while True:
-        step = [lane.next_window(finalize) for lane in lanes_state]
+        step: List[Optional[Tuple]] = []
+        for li, lane in enumerate(lanes_state):
+            try:
+                step.append(lane.next_window(finalize))
+            except Exception as e:          # noqa: BLE001
+                failed = lane.current
+                if failed is None:
+                    raise
+                _handle_bucket_failure(li, failed, e)
+                step.append(None)
         if all(w is None for w in step):
-            break
+            deadlines = [j.ready_at for lane in lanes_state
+                         for j in lane.queue]
+            if not deadlines and all(lane.current is None
+                                     for lane in lanes_state):
+                break
+            # nothing runnable anywhere: every remaining job is inside
+            # its backoff window — sleep to the earliest deadline
+            # instead of spinning (only here does the loop ever wait)
+            if deadlines:
+                wait = min(deadlines) - _time.monotonic()
+                if wait > 0:
+                    _time.sleep(wait)
+            continue
         # assemble each active lane's window; truncated-key windows take
         # the exact host merge instead of the device kernel
         device_rows: List[Optional[Tuple]] = [None] * n_dev
@@ -493,24 +644,28 @@ def compact_table_mesh(table, mesh=None,
             if item is None:
                 continue
             job, items = item
-            wtable = pa.concat_tables([it[0] for it in items],
-                                      promote_options="none") \
-                if len(items) > 1 else items[0][0]
-            trunc_any = any(np.asarray(it[2]).any() for it in items)
-            if trunc_any or wtable.num_rows == 0:
-                job.emit(ctx.merge_window_host(items))
+            try:
+                wtable = pa.concat_tables([it[0] for it in items],
+                                          promote_options="none") \
+                    if len(items) > 1 else items[0][0]
+                trunc_any = any(np.asarray(it[2]).any() for it in items)
+                if trunc_any or wtable.num_rows == 0:
+                    job.emit(ctx.merge_window_host(items))
+                    continue
+                lanes_mat = np.concatenate([np.asarray(it[1])
+                                            for it in items]) \
+                    if len(items) > 1 else np.asarray(items[0][1])
+                if ctx.seq_fields:
+                    from paimon_tpu.ops.merge import user_seq_order_lanes
+                    order_lanes = user_seq_order_lanes(
+                        wtable, ctx.seq_fields, ctx.seq_desc)
+                    lanes_mat = np.concatenate([lanes_mat, order_lanes],
+                                               axis=1)
+                seq = np.asarray(wtable.column(SEQ_COL).combine_chunks()
+                                 .cast("int64"))
+            except Exception as e:          # noqa: BLE001
+                _handle_bucket_failure(li, job, e)
                 continue
-            lanes_mat = np.concatenate([np.asarray(it[1])
-                                        for it in items]) \
-                if len(items) > 1 else np.asarray(items[0][1])
-            if ctx.seq_fields:
-                from paimon_tpu.ops.merge import user_seq_order_lanes
-                order_lanes = user_seq_order_lanes(
-                    wtable, ctx.seq_fields, ctx.seq_desc)
-                lanes_mat = np.concatenate([lanes_mat, order_lanes],
-                                           axis=1)
-            seq = np.asarray(wtable.column(SEQ_COL).combine_chunks()
-                             .cast("int64"))
             device_rows[li] = (job, wtable, lanes_mat, seq)
             n_max = max(n_max, wtable.num_rows)
         if n_max == 0:
@@ -531,13 +686,25 @@ def compact_table_mesh(table, mesh=None,
             seq_hi[li, :k] = (u >> np.uint64(32)).astype(np.uint32)
             seq_lo[li, :k] = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             invalid[li, :k] = 0
-        perm, winner, _ = kernel(lanes_arr, seq_hi, seq_lo, invalid)
+        try:
+            perm, winner, _ = kernel(lanes_arr, seq_hi, seq_lo, invalid)
+        except Exception as e:              # noqa: BLE001
+            # a kernel failure is a lane/device failure for every
+            # bucket in flight this step: each rides its own ladder
+            for li, entry in enumerate(device_rows):
+                if entry is not None:
+                    _handle_bucket_failure(li, entry[0], e)
+            continue
         for li, entry in enumerate(device_rows):
             if entry is None:
                 continue
             job, wtable, _, _ = entry
-            job.emit(ctx.merge_window_device(wtable, perm[li],
-                                             winner[li]))
+            try:
+                job.emit(ctx.merge_window_device(wtable, perm[li],
+                                                 winner[li]))
+            except Exception as e:          # noqa: BLE001
+                _handle_bucket_failure(li, job, e)
+                continue
             stats.windows += 1
             stats.peak_window_rows = max(stats.peak_window_rows,
                                          wtable.num_rows)

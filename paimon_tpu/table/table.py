@@ -235,11 +235,20 @@ class FileStoreTable:
                                 dry_run=dry_run)
 
     def remove_orphan_files(self, older_than_ms: Optional[int] = None,
-                            dry_run: bool = False):
+                            dry_run: bool = False,
+                            now_ms: Optional[int] = None):
         """reference operation/OrphanFilesClean.java."""
         from paimon_tpu.maintenance import remove_orphan_files
         return remove_orphan_files(self, older_than_ms=older_than_ms,
-                                   dry_run=dry_run)
+                                   dry_run=dry_run, now_ms=now_ms)
+
+    def fsck(self, snapshot_id: Optional[int] = None,
+             all_snapshots: bool = True, deep: bool = False):
+        """Verify the snapshot→manifest→file graph; returns an
+        FsckReport of typed violations (maintenance/fsck.py)."""
+        from paimon_tpu.maintenance import fsck
+        return fsck(self, snapshot_id=snapshot_id,
+                    all_snapshots=all_snapshots, deep=deep)
 
     def expire_partitions(self, expiration_ms: Optional[int] = None,
                           now_ms: Optional[int] = None,

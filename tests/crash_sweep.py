@@ -1,0 +1,121 @@
+"""Reusable crash-point sweep harness.
+
+Generalizes the commit atomicity sweep (test_fault_injection.py) to ANY
+maintenance operation: for every mutating-op index i, build a fresh
+table, arm FailingFileIO to kill the i-th mutating operation, run the
+operation, and after the injected crash assert
+
+  1. the table is still readable at its last snapshot (crashed state),
+  2. a restart of the operation on a clean FileIO converges,
+  3. fsck finds no violation in the converged table.
+
+The sweep ends at the first index where the operation completes with no
+injection — every mutating op of the operation has then been killed
+exactly once.  FailingFileIO's op trace names the op killed at each
+point, so failures report "crash point #7 (delete manifest/...)"
+instead of a bare index.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from paimon_tpu.table import FileStoreTable
+from tests.failing_fileio import FailingFileIO, InjectedIOError
+
+
+@dataclass
+class CrashPoint:
+    index: int
+    op: str
+    path: str
+
+    def __str__(self):
+        return f"crash point #{self.index} ({self.op} {self.path})"
+
+
+def crash_point_sweep(
+        make_table: Callable[[str], FileStoreTable],
+        operation: Callable[[FileStoreTable], object],
+        *,
+        name: str,
+        verify_after_crash: Optional[Callable] = None,
+        verify_converged: Optional[Callable] = None,
+        restart: Optional[Callable[[FileStoreTable], object]] = None,
+        fsck_converged: bool = True,
+        max_points: int = 400) -> List[CrashPoint]:
+    """Sweep an injected crash over every mutating-op index of
+    `operation`.
+
+    make_table(tag) -> a FRESH seeded table per crash point (unique
+    directory per tag).  operation(table) runs the op under test
+    against whatever file_io the given table carries.  restart
+    defaults to `operation` re-run on a reloaded clean table.
+    verify_after_crash(table, point) / verify_converged(table) hook
+    extra invariants; the readability + fsck checks always run.
+
+    Returns the list of crash points exercised (ops killed)."""
+    points: List[CrashPoint] = []
+    for idx in range(max_points):
+        tag = f"{name}-{idx}"
+        table = make_table(tag)
+        fio = FailingFileIO(table.file_io, name)
+        broken = FileStoreTable(fio, table.path,
+                                table.schema_manager.latest(),
+                                branch=table.branch)
+        FailingFileIO.reset(name, idx)
+        try:
+            operation(broken)
+            crashed = False
+        except InjectedIOError:
+            crashed = True
+        finally:
+            trace = FailingFileIO.ops(name)
+            FailingFileIO.disarm(name)
+        killed = [r for r in trace if r.killed]
+        if not killed:
+            # the operation completed with no injection fired: every
+            # mutating op has been killed once — sweep done
+            assert not crashed
+            return points
+        point = CrashPoint(idx, killed[0].op, killed[0].path)
+        points.append(point)
+        # an operation may legitimately SURVIVE a killed op (best-effort
+        # paths like hint writes swallow IO errors); convergence checks
+        # below still apply either way
+
+        # 1. crashed state: readable at the last snapshot
+        try:
+            if verify_after_crash is not None:
+                verify_after_crash(table, point)
+            else:
+                table.to_arrow()
+        except AssertionError:
+            raise
+        except Exception as e:              # noqa: BLE001
+            raise AssertionError(
+                f"{point}: table unreadable in crashed state: "
+                f"{type(e).__name__}: {e}") from e
+
+        # 2. restart on a clean FileIO converges
+        fresh = FileStoreTable.load(table.path,
+                                    file_io=table.file_io)
+        try:
+            (restart or operation)(fresh)
+        except Exception as e:              # noqa: BLE001
+            raise AssertionError(
+                f"{point}: restart did not converge: "
+                f"{type(e).__name__}: {e}") from e
+        if verify_converged is not None:
+            verify_converged(fresh)
+
+        # 3. the converged graph is internally consistent
+        if fsck_converged:
+            report = fresh.fsck()
+            assert report.ok, \
+                f"{point}: fsck after restart found violations: " \
+                f"{[v.to_dict() for v in report.violations]}"
+    raise AssertionError(
+        f"sweep {name!r} did not terminate within {max_points} crash "
+        f"points — operation never completed cleanly")

@@ -1,24 +1,49 @@
 """Fault-injection FileIO (reference test utility
 utils/FailingFileIO.java:44: throws on the Nth operation per named
-counter) + open-stream tracking in the spirit of TraceableFileIO."""
+counter) + open-stream tracking in the spirit of TraceableFileIO.
+
+Extensions over the reference:
+- every mutating op is recorded in a per-name OP TRACE
+  (`FailingFileIO.ops(name)` -> [OpRecord(op, path, index, killed)])
+  so crash-point sweeps can report exactly which operation was killed;
+- `copy`, `delete_quietly` and two-phase commit/discard are
+  intercepted too (they bypass write_bytes/delete in the base FileIO);
+- `reset(name, fail_after, fail_times=None)` can limit how many ops
+  fail before the counter auto-disarms (models a transient 503 storm
+  that passes, for retry/fallback testing) — the default None fails
+  every op until `disarm`, modeling a hard crash.
+"""
 
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
-from paimon_tpu.fs.fileio import FileIO
+from paimon_tpu.fs.fileio import (
+    FileIO, TwoPhaseCommitter, TwoPhaseOutputStream,
+)
 
 
 class InjectedIOError(IOError):
     pass
 
 
+@dataclass
+class OpRecord:
+    op: str
+    path: str
+    index: int
+    killed: bool = False
+
+
 class FailingFileIO(FileIO):
     """Delegates to an inner FileIO, failing the Nth write/delete/rename
-    per named counter."""
+    per named counter and tracing every mutating op."""
 
     _counters: Dict[str, int] = {}
+    _fail_left: Dict[str, Optional[int]] = {}
+    _traces: Dict[str, List[OpRecord]] = {}
     _lock = threading.Lock()
 
     def __init__(self, inner: FileIO, name: str):
@@ -26,43 +51,102 @@ class FailingFileIO(FileIO):
         self.name = name
 
     @classmethod
-    def reset(cls, name: str, fail_after: int):
-        """Fail every mutating op once `fail_after` of them succeeded."""
+    def reset(cls, name: str, fail_after: int,
+              fail_times: Optional[int] = None):
+        """Fail every mutating op once `fail_after` of them succeeded.
+        `fail_times` bounds how many ops fail before auto-disarm
+        (None = fail forever until `disarm`)."""
         with cls._lock:
             cls._counters[name] = fail_after
+            cls._fail_left[name] = fail_times
+            cls._traces[name] = []
 
     @classmethod
     def disarm(cls, name: str):
         with cls._lock:
             cls._counters.pop(name, None)
+            cls._fail_left.pop(name, None)
 
-    def _tick(self):
+    @classmethod
+    def ops(cls, name: str) -> List[OpRecord]:
+        """The mutating-op trace since the last reset()."""
+        with cls._lock:
+            return list(cls._traces.get(name, []))
+
+    def _tick(self, op: str, path: str):
         with self._lock:
+            trace = self._traces.setdefault(self.name, [])
             remaining = self._counters.get(self.name)
+            kill = remaining is not None and remaining <= 0
+            rec = OpRecord(op, path, len(trace), killed=kill)
+            trace.append(rec)
             if remaining is None:
                 return
-            if remaining <= 0:
+            if kill:
+                left = self._fail_left.get(self.name)
+                if left is not None:
+                    left -= 1
+                    if left <= 0:
+                        self._counters.pop(self.name, None)
+                        self._fail_left.pop(self.name, None)
+                    else:
+                        self._fail_left[self.name] = left
                 raise InjectedIOError(
-                    f"injected failure ({self.name})")
+                    f"injected failure ({self.name}) at op "
+                    f"#{rec.index}: {op} {path}")
             self._counters[self.name] = remaining - 1
 
     # -- mutating ops fail by counter ---------------------------------------
 
     def write_bytes(self, path, data, overwrite=True):
-        self._tick()
+        self._tick("write_bytes", path)
         return self.inner.write_bytes(path, data, overwrite=overwrite)
 
     def try_to_write_atomic(self, path, data):
-        self._tick()
+        self._tick("try_to_write_atomic", path)
         return self.inner.try_to_write_atomic(path, data)
 
     def delete(self, path, recursive=False):
-        self._tick()
+        self._tick("delete", path)
         return self.inner.delete(path, recursive=recursive)
 
+    def delete_quietly(self, path):
+        # NOT quiet under injection: a kill here models the process
+        # dying mid-delete, which swallowing would hide from the sweep
+        self._tick("delete_quietly", path)
+        return self.inner.delete_quietly(path)
+
     def rename(self, src, dst):
-        self._tick()
+        self._tick("rename", src)
         return self.inner.rename(src, dst)
+
+    def copy(self, src, dst, overwrite=True):
+        self._tick("copy", dst)
+        return self.inner.copy(src, dst, overwrite=overwrite)
+
+    def new_two_phase_stream(self, path) -> TwoPhaseOutputStream:
+        outer = self
+        stream = self.inner.new_two_phase_stream(path)
+
+        class S(TwoPhaseOutputStream):
+            def write(self, data):
+                stream.write(data)
+
+            def close_for_commit(self) -> TwoPhaseCommitter:
+                committer = stream.close_for_commit()
+
+                class C(TwoPhaseCommitter):
+                    def commit(self_c):
+                        outer._tick("two_phase.commit", path)
+                        committer.commit()
+
+                    def discard(self_c):
+                        outer._tick("two_phase.discard", path)
+                        committer.discard()
+
+                return C()
+
+        return S()
 
     def mkdirs(self, path):
         return self.inner.mkdirs(path)

@@ -1,0 +1,186 @@
+"""The shared capped decorrelated-jitter backoff (utils/backoff.py) and
+its three consumers: RetryingObjectStoreBackend's max-elapsed budget,
+FileStoreCommit's CAS retry wait, and the mesh bucket ladder (covered
+end-to-end in test_mesh_fault_tolerance.py).
+"""
+
+import random
+
+import pytest
+
+from paimon_tpu.utils.backoff import Backoff
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def test_decorrelated_jitter_bounds():
+    b = Backoff(10.0, cap_ms=10_000.0, rng=random.Random(42))
+    prev = b.next_ms()
+    assert prev == 10.0                       # first wait = base
+    for _ in range(50):
+        nxt = b.next_ms()
+        assert 10.0 <= nxt <= max(10.0, 3.0 * prev)
+        assert nxt <= 10_000.0
+        prev = nxt
+
+
+def test_jitter_spreads_waits():
+    """Two concurrent retriers draw different schedules — the whole
+    point of decorrelated jitter vs exponential lockstep."""
+    a = Backoff(10.0, rng=random.Random(1))
+    b = Backoff(10.0, rng=random.Random(2))
+    sched_a = [a.next_ms() for _ in range(8)]
+    sched_b = [b.next_ms() for _ in range(8)]
+    assert sched_a != sched_b
+
+
+def test_cap_bounds_tail():
+    b = Backoff(100.0, cap_ms=150.0, rng=random.Random(7))
+    waits = [b.next_ms() for _ in range(20)]
+    assert max(waits) <= 150.0
+    # default cap = 32x base
+    assert Backoff(10.0).cap_ms == 320.0
+    # cap below base is clamped up, not inverted
+    assert Backoff(100.0, cap_ms=1.0).cap_ms == 100.0
+
+
+def test_zero_base_never_sleeps():
+    fc = FakeClock()
+    b = Backoff(0.0, sleep=fc.sleep, clock=fc.clock)
+    for _ in range(5):
+        assert b.pause() is True
+    assert fc.sleeps == []
+    assert b.attempts == 5
+
+
+def test_max_elapsed_budget_stops():
+    fc = FakeClock()
+    b = Backoff(1000.0, cap_ms=1000.0, max_elapsed_ms=2500.0,
+                rng=random.Random(3), sleep=fc.sleep, clock=fc.clock)
+    pauses = 0
+    while b.pause():
+        pauses += 1
+        assert pauses < 100
+    assert b.budget_exhausted()
+    # never slept past the budget's end
+    assert fc.t * 1000.0 <= 2500.0 + 1e-6
+    assert pauses >= 2
+
+
+def test_budget_without_start_is_fresh():
+    b = Backoff(10.0, max_elapsed_ms=100.0)
+    assert b.elapsed_ms() == 0.0
+    assert not b.budget_exhausted()
+
+
+# -- RetryingObjectStoreBackend budget ---------------------------------------
+
+
+def _flaky_stack(tmp_path, fail_rate, seed=0, **retry_kw):
+    from paimon_tpu.fs.object_store import (
+        FlakyObjectStoreBackend, LocalObjectStoreBackend,
+        RetryingObjectStoreBackend,
+    )
+    inner = LocalObjectStoreBackend(str(tmp_path / "store"))
+    flaky = FlakyObjectStoreBackend(inner, seed=seed,
+                                    fail_rate=fail_rate)
+    return RetryingObjectStoreBackend(flaky, **retry_kw), flaky
+
+
+def test_object_store_retries_through_storm(tmp_path):
+    retry, flaky = _flaky_stack(tmp_path, fail_rate=0.5, seed=11,
+                                max_attempts=20, backoff_s=0.0)
+    for i in range(20):
+        retry.put(f"k{i}", b"v")
+        assert retry.get(f"k{i}") == b"v"
+    assert flaky.stats["injected"] > 0
+
+
+def test_object_store_max_elapsed_budget(tmp_path):
+    from paimon_tpu.fs.object_store import TransientStoreError
+    retry, _ = _flaky_stack(tmp_path, fail_rate=1.0, seed=5,
+                            max_attempts=10 ** 6, backoff_s=0.0,
+                            max_elapsed_s=0.0)
+    with pytest.raises(TransientStoreError, match="retry budget"):
+        retry.get("missing")
+    with pytest.raises(TransientStoreError, match="retry budget"):
+        retry.put("k", b"v")
+
+
+def test_object_store_attempts_cap_still_applies(tmp_path):
+    from paimon_tpu.fs.object_store import TransientStoreError
+    retry, flaky = _flaky_stack(tmp_path, fail_rate=1.0, seed=5,
+                                max_attempts=3, backoff_s=0.0)
+    with pytest.raises(TransientStoreError, match="attempts exhausted"):
+        retry.get("missing")
+    assert flaky.stats["injected"] == 3
+
+
+def test_object_store_jittered_backoff_deterministic_rng(tmp_path,
+                                                         monkeypatch):
+    import paimon_tpu.utils.backoff as bo
+
+    slept = []
+
+    class RecordingBackoff(Backoff):
+        def __init__(self, *a, **kw):
+            kw["sleep"] = slept.append
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(bo, "Backoff", RecordingBackoff)
+    retry, _ = _flaky_stack(tmp_path, fail_rate=1.0, seed=5,
+                            max_attempts=4, backoff_s=0.005,
+                            backoff_cap_s=0.01,
+                            rng=random.Random(9))
+    from paimon_tpu.fs.object_store import TransientStoreError
+    with pytest.raises(TransientStoreError):
+        retry.get("missing")
+    # 4 attempts -> 3 waits: the terminal failure raises immediately
+    # instead of sleeping a wait no retry will ever use
+    assert len(slept) == 3
+    assert all(0.005 <= s <= 0.01 + 1e-9 for s in slept)
+
+
+# -- FileStoreCommit's retry wait uses the shared budget ---------------------
+
+
+def test_commit_retry_bounded_by_timeout(tmp_path):
+    """commit.timeout caps total CAS-retry stall even when
+    commit.max-retries would allow (effectively) unbounded attempts."""
+    import time
+
+    from paimon_tpu.core.commit import (
+        CommitConflictError, FileStoreCommit,
+    )
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType
+
+    schema = (Schema.builder().column("id", BigIntType(False))
+              .primary_key("id")
+              .options({"bucket": "1",
+                        "commit.max-retries": "1000000",
+                        "commit.min-retry-wait": "5 ms",
+                        "commit.max-retry-wait": "10 ms",
+                        "commit.timeout": "80 ms"}).build())
+    table = FileStoreTable.create(str(tmp_path / "t"), schema)
+
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options)
+    # a racer that always wins: every CAS attempt loses
+    commit.snapshot_manager.try_commit = lambda snapshot: False
+    t0 = time.monotonic()
+    with pytest.raises(CommitConflictError, match="commit.timeout"):
+        commit._try_commit([], [], 0, "APPEND")
+    assert time.monotonic() - t0 < 5.0         # budget, not max-retries

@@ -1,0 +1,292 @@
+"""Table fsck (maintenance/fsck.py): every seeded corruption class is
+detected with a typed violation, fix_violations repairs the fixable
+classes, and the CLI surface (`paimon table fsck`) wires both.
+"""
+
+import json
+import os
+
+import pyarrow.parquet as pq
+import pytest
+
+from paimon_tpu.cli import main as cli_main
+from paimon_tpu.maintenance import (
+    ViolationKind, expire_snapshots, fix_violations, fsck,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+FAR_FUTURE_MS = 10 ** 18
+
+
+def _schema(opts=None):
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .primary_key("id")
+            .options({"bucket": "1", "write-only": "true",
+                      **(opts or {})})
+            .build())
+
+
+def _commit(table, rows):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts(rows)
+    sid = wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    return sid
+
+
+@pytest.fixture()
+def table(tmp_path):
+    t = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    for i in range(3):
+        _commit(t, [{"id": j, "v": float(i)} for j in range(i, i + 4)])
+    return t
+
+
+def _live_data_paths(table):
+    scan = table.new_scan()
+    out = []
+    for s in table.new_read_builder().new_scan().plan().splits:
+        for f in s.data_files:
+            out.append(scan.path_factory.data_file_path(
+                s.partition, s.bucket, f.file_name))
+    return out
+
+
+def _latest_manifest_paths(table):
+    """Paths of the manifest FILES referenced by the latest snapshot."""
+    scan = table.new_scan()
+    snap = table.latest_snapshot()
+    names = []
+    for list_name in (snap.base_manifest_list,
+                      snap.delta_manifest_list):
+        if list_name:
+            names.extend(m.file_name
+                         for m in scan.manifest_list.read(list_name))
+    return [scan.manifest_file.path(n) for n in names]
+
+
+def test_healthy_table_is_clean(table):
+    report = fsck(table)
+    assert report.ok
+    assert report.snapshots_checked == 3
+    assert report.manifests_checked > 0
+    assert report.data_files_checked > 0
+    assert table.fsck().ok                 # table-level convenience
+
+
+def test_detects_dangling_data_file(table):
+    os.remove(_live_data_paths(table)[0])
+    report = fsck(table)
+    assert ViolationKind.DANGLING_DATA_FILE in report.kinds()
+    v = report.by_kind(ViolationKind.DANGLING_DATA_FILE)[0]
+    assert v.snapshot_id is not None and v.obj
+
+
+def test_detects_truncated_manifest(table):
+    path = _latest_manifest_paths(table)[0]
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 2])
+    report = fsck(table)
+    assert ViolationKind.CORRUPT_MANIFEST in report.kinds()
+
+
+def test_detects_missing_manifest(table):
+    os.remove(_latest_manifest_paths(table)[0])
+    report = fsck(table)
+    assert ViolationKind.MISSING_MANIFEST in report.kinds()
+
+
+def test_detects_missing_manifest_list(table):
+    scan = table.new_scan()
+    os.remove(scan.manifest_list.path(
+        table.latest_snapshot().base_manifest_list))
+    report = fsck(table)
+    assert ViolationKind.MISSING_MANIFEST_LIST in report.kinds()
+
+
+def test_detects_snapshot_chain_gap(table):
+    os.remove(f"{table.path}/snapshot/snapshot-2")
+    report = fsck(table)
+    assert ViolationKind.SNAPSHOT_GAP in report.kinds()
+    gap = report.by_kind(ViolationKind.SNAPSHOT_GAP)[0]
+    assert gap.snapshot_id == 2
+
+
+def test_detects_bad_hints(table):
+    open(f"{table.path}/snapshot/EARLIEST", "w").write("99")
+    report = fsck(table)
+    assert ViolationKind.BAD_HINT in report.kinds()
+
+
+def test_detects_corrupt_snapshot(table):
+    open(f"{table.path}/snapshot/snapshot-2", "w").write("{not json")
+    report = fsck(table)
+    assert ViolationKind.CORRUPT_SNAPSHOT in report.kinds()
+    # a corrupt snapshot file is NOT a data manifest: --fix must not
+    # route it through the manifest-drop path (it is unfixable)
+    assert fix_violations(table, report) == []
+
+
+def test_corrupt_index_manifest_not_deleted_by_fix(table):
+    """Index manifests share manifest/ with data manifests but have
+    their own violation kinds — fix_violations must never drop one (it
+    cannot rewrite the index chain, so deleting would turn a corrupt-
+    but-present file into a permanently missing one)."""
+    from paimon_tpu.core.commit import FileStoreCommit
+    from paimon_tpu.manifest import FileKind
+    from paimon_tpu.manifest.index_manifest import (
+        IndexFileMeta, IndexManifestEntry,
+    )
+
+    # commit a snapshot carrying an index manifest
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options)
+    ix = IndexFileMeta("HASH", "index-test-0", 8, 2)
+    table.file_io.write_bytes(
+        table.new_scan().path_factory.index_file_path(ix.file_name),
+        b"\x00" * 8)
+    commit.commit([], index_entries=[
+        IndexManifestEntry(FileKind.ADD, b"", 0, ix)])
+    name = table.latest_snapshot().index_manifest
+    path = table.new_scan().index_manifest_file.path(name)
+    open(path, "wb").write(b"garbage")
+
+    report = fsck(table, all_snapshots=False)
+    assert ViolationKind.CORRUPT_INDEX_MANIFEST in report.kinds()
+    assert ViolationKind.CORRUPT_MANIFEST not in report.kinds()
+    assert fix_violations(table, report) == []
+    assert table.file_io.exists(path)      # never deleted
+
+    os.remove(path)
+    report = fsck(table, all_snapshots=False)
+    assert ViolationKind.MISSING_INDEX_MANIFEST in report.kinds()
+    assert ViolationKind.MISSING_MANIFEST not in report.kinds()
+    assert fix_violations(table, report) == []
+
+
+def test_detects_row_count_mismatch(table):
+    path = f"{table.path}/snapshot/snapshot-3"
+    snap = json.loads(open(path).read())
+    snap["totalRecordCount"] += 5
+    open(path, "w").write(json.dumps(snap))
+    report = fsck(table, snapshot_id=3)
+    assert ViolationKind.ROW_COUNT_MISMATCH in report.kinds()
+
+
+def test_deep_detects_stats_mismatch(table):
+    # rewrite one live data file with a row sliced off: still readable,
+    # but actual rows no longer match the manifest's recorded stats
+    path = _live_data_paths(table)[0]
+    t = pq.read_table(path)
+    pq.write_table(t.slice(0, t.num_rows - 1), path)
+    assert fsck(table, deep=False).kinds() <= \
+        {ViolationKind.FILE_SIZE_MISMATCH}   # shallow can't see rows
+    report = fsck(table, deep=True)
+    assert ViolationKind.STATS_MISMATCH in report.kinds()
+
+
+def test_deep_detects_corrupt_data_file(table):
+    path = _live_data_paths(table)[0]
+    size = os.path.getsize(path)
+    open(path, "wb").write(b"\x00" * size)   # same size, unreadable
+    report = fsck(table, deep=True)
+    assert ViolationKind.CORRUPT_DATA_FILE in report.kinds()
+
+
+def test_fsck_counts_violations_metric(table):
+    from paimon_tpu.metrics import FSCK_VIOLATIONS, global_registry
+    group = global_registry().maintenance_metrics()
+    before = group.counter(FSCK_VIOLATIONS).count
+    os.remove(_live_data_paths(table)[0])
+    report = fsck(table)
+    assert group.counter(FSCK_VIOLATIONS).count == \
+        before + len(report.violations)
+
+
+def test_fix_dangling_data_file(table):
+    os.remove(_live_data_paths(table)[0])
+    report = fsck(table)
+    actions = fix_violations(table, report)
+    assert "remove-unexisting-files" in actions
+    # the repaired LATEST snapshot is clean; older snapshots still pin
+    # the lost file and heal by expiry
+    assert fsck(table, all_snapshots=False).ok
+    expire_snapshots(table, retain_max=1, retain_min=1,
+                     older_than_ms=FAR_FUTURE_MS)
+    assert fsck(table).ok
+    table.to_arrow()                       # and the table still reads
+
+
+def test_fix_corrupt_manifest(table):
+    path = _latest_manifest_paths(table)[0]
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 2])
+    report = fsck(table, all_snapshots=False)
+    actions = fix_violations(table, report)
+    assert "drop-corrupt-manifests" in actions
+    assert "remove-unexisting-manifests" in actions
+    assert fsck(table, all_snapshots=False).ok
+
+
+def test_fix_bad_hints(table):
+    open(f"{table.path}/snapshot/EARLIEST", "w").write("99")
+    open(f"{table.path}/snapshot/LATEST", "w").write("77")
+    actions = fix_violations(table, fsck(table))
+    assert actions == ["rewrite-hints"]
+    assert fsck(table).ok
+    sm = table.snapshot_manager
+    assert sm.earliest_snapshot_id() == 1
+    assert sm.latest_snapshot_id() == 3
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def _cli(capsys, *argv):
+    rc = cli_main(list(argv))
+    out = capsys.readouterr()
+    return rc, out.out
+
+
+def _cli_table(capsys, wh):
+    assert _cli(capsys, "-w", wh, "db", "create", "d1")[0] == 0
+    rc, _ = _cli(capsys, "-w", wh, "table", "create", "d1.t",
+                 "--column", "id:BIGINT NOT NULL",
+                 "--column", "v:DOUBLE", "--primary-key", "id",
+                 "--option", "bucket=1")
+    assert rc == 0
+    rc, _ = _cli(capsys, "-w", wh, "sql",
+                 "INSERT INTO d1.t VALUES (1, 1.5), (2, 2.5)")
+    assert rc == 0
+    return os.path.join(wh, "d1.db", "t")
+
+
+def test_cli_fsck_clean_and_violations(capsys, tmp_path):
+    wh = str(tmp_path / "wh")
+    tpath = _cli_table(capsys, wh)
+    rc, out = _cli(capsys, "-w", wh, "table", "fsck", "d1.t")
+    assert rc == 0
+    assert json.loads(out)["ok"] is True
+
+    open(os.path.join(tpath, "snapshot", "EARLIEST"), "w").write("99")
+    rc, out = _cli(capsys, "-w", wh, "table", "fsck", "d1.t")
+    assert rc == 1
+    report = json.loads(out)
+    assert report["ok"] is False
+    assert report["violations"][0]["kind"] == ViolationKind.BAD_HINT
+
+
+def test_cli_fsck_fix(capsys, tmp_path):
+    wh = str(tmp_path / "wh")
+    tpath = _cli_table(capsys, wh)
+    open(os.path.join(tpath, "snapshot", "EARLIEST"), "w").write("99")
+    rc, out = _cli(capsys, "-w", wh, "table", "fsck", "d1.t", "--fix")
+    assert rc == 0
+    report = json.loads(out)
+    assert report["ok"] is True
+    assert report["fix_actions"] == ["rewrite-hints"]

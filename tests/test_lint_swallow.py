@@ -1,0 +1,90 @@
+"""Tier-1 lint: no NEW silent broad-exception swallowing in
+paimon_tpu/.  An `except Exception: pass` (or bare except / continue
+body) hides every error class — including the transient faults the
+maintenance plane must now retry or propagate (parallel/fault.py).
+
+Every handler that catches Exception/BaseException/bare and does
+nothing must appear in the reviewed allowlist below; the comparison is
+exact both ways, so removing one must also prune the list.  Narrow
+typed catches (OSError, ValueError, ...) are out of scope — they are
+deliberate, local decisions.
+"""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paimon_tpu")
+
+# reviewed silent broad handlers: "<relpath>::<function>" — each is a
+# genuine best-effort path whose failure must not fail the caller
+ALLOWED_SILENT_BROAD = {
+    # quiet delete is the two-phase-commit cleanup contract
+    "paimon_tpu/fs/fileio.py::delete_quietly",
+    # privilege mutation on a catalog without the privilege meta table
+    "paimon_tpu/catalog/privilege.py::_mutate",
+    # warehouse-wide iteration skips tables that fail to load
+    "paimon_tpu/catalog/system.py::_each_table",
+    # EXISTS rewrite falls back to the unoptimized plan
+    "paimon_tpu/sql/executor.py::_rewrite_exists",
+}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _broad_names(type_node):
+    """Exception class names in an except clause that are broad."""
+    if type_node is None:
+        return ["<bare>"]                      # bare except
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    out = []
+    for n in nodes:
+        name = n.id if isinstance(n, ast.Name) else \
+            n.attr if isinstance(n, ast.Attribute) else None
+        if name in _BROAD:
+            out.append(name)
+    return out
+
+
+def _silent_broad_handlers():
+    found = set()
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, REPO)
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), rel)
+            funcs = [n for n in ast.walk(tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if len(node.body) != 1 or not isinstance(
+                        node.body[0], (ast.Pass, ast.Continue)):
+                    continue
+                if not _broad_names(node.type):
+                    continue
+                enc = "<module>"
+                for fn in funcs:
+                    if fn.lineno <= node.lineno <= fn.end_lineno:
+                        enc = fn.name
+                found.add(f"{rel}::{enc}")
+    return found
+
+
+def test_no_unreviewed_silent_exception_swallowing():
+    found = _silent_broad_handlers()
+    new = found - ALLOWED_SILENT_BROAD
+    assert not new, (
+        f"new silent except-Exception swallowing (handle the error, "
+        f"propagate it, or add to the reviewed allowlist): "
+        f"{sorted(new)}")
+    stale = ALLOWED_SILENT_BROAD - found
+    assert not stale, (
+        f"allowlist entries no longer present — prune them: "
+        f"{sorted(stale)}")

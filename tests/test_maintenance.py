@@ -140,6 +140,31 @@ def test_orphan_grace_period(tmp_warehouse):
     assert os.path.exists(orphan)
 
 
+def test_orphan_grace_period_injectable_clock(tmp_warehouse):
+    """The in-flight-writer protection window is testable without
+    wall-clock games (utime/sleep): `now_ms` injects the clock the
+    one-day grace period is measured against."""
+    from paimon_tpu.maintenance.orphan import DEFAULT_OLDER_THAN_MS
+
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    orphan = os.path.join(table.path, "bucket-0", "data-wr-0.parquet")
+    open(orphan, "wb").write(b"junk")
+    mtime_ms = int(os.path.getmtime(orphan) * 1000)
+
+    # clock inside the grace period: the in-flight writer's file survives
+    assert table.remove_orphan_files(
+        now_ms=mtime_ms + DEFAULT_OLDER_THAN_MS - 10_000) == []
+    assert os.path.exists(orphan)
+
+    # clock past the grace period: the same file is reclaimed
+    deleted = table.remove_orphan_files(
+        now_ms=mtime_ms + DEFAULT_OLDER_THAN_MS + 60_000)
+    assert [os.path.basename(p) for p in deleted] == \
+        ["data-wr-0.parquet"]
+    assert not os.path.exists(orphan)
+
+
 def test_partition_expire(tmp_warehouse):
     table = _make(tmp_warehouse, partitioned=True,
                   opts={"partition.expiration-time": "7 d"})
