@@ -199,6 +199,15 @@ def bench_merge():
                                    key_encoder=enc).take()))
 
 
+def bench_scan():
+    """Pipelined vs serial merge-on-read scan + footer-cache re-scan
+    (full matrix in benchmarks/scan_bench.py; this entry keeps the
+    scan trajectory in every micro run)."""
+    from benchmarks.scan_bench import bench_engine, bench_footer_cache
+    bench_engine("deduplicate")
+    bench_footer_cache()
+
+
 BENCHES = {
     "read_parquet": lambda: bench_read("parquet"),
     "read_orc": lambda: bench_read("orc"),
@@ -207,6 +216,7 @@ BENCHES = {
     "lookup": bench_lookup,
     "bitmap": bench_bitmap,
     "merge": bench_merge,
+    "scan": bench_scan,
 }
 
 

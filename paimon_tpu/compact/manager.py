@@ -285,7 +285,8 @@ class MergeTreeCompactManager:
                     t = read_kv_file(self.file_io, self.path_factory,
                                      self.partition, self.bucket, f,
                                      schema=self.schema,
-                                     schema_manager=self.schema_manager)
+                                     schema_manager=self.schema_manager,
+                                     options=self.options)
                     t = evolve_table(t, f.schema_id, self.schema,
                                      self.schema_manager,
                                      self._schema_cache,
@@ -297,8 +298,16 @@ class MergeTreeCompactManager:
                 fmt = get_format(ext)
                 path = f.external_path or self.path_factory.data_file_path(
                     self.partition, self.bucket, f.file_name)
-                for batch in fmt.create_reader().read_batches(
-                        self.file_io, path, batch_rows=chunk_rows):
+                from paimon_tpu.fs.caching import scoped_batches
+                # scoped_batches holds the footer-cache gate only
+                # WHILE advancing the inner iterator, never across our
+                # own yields — a `with` around this loop would leak
+                # the thread-local flag to unrelated reads while this
+                # generator is suspended
+                for batch in scoped_batches(
+                        fmt.create_reader().read_batches(
+                            self.file_io, path, batch_rows=chunk_rows),
+                        self.options):
                     t = evolve_table(batch, f.schema_id, self.schema,
                                      self.schema_manager,
                                      self._schema_cache,
@@ -462,7 +471,8 @@ class MergeTreeCompactManager:
             return cached
         raw = read_kv_file(self.file_io, self.path_factory, self.partition,
                            self.bucket, f, schema=self.schema,
-                           schema_manager=self.schema_manager)
+                           schema_manager=self.schema_manager,
+                           options=self.options)
         t = evolve_table(raw, f.schema_id, self.schema,
                          self.schema_manager, self._schema_cache,
                          keep_sys_cols=True)

@@ -288,13 +288,18 @@ class AppendSplitRead:
                   wanted=None) -> pa.Table:
         """One file, schema-evolved, unfiltered (evolution groups need
         whole ranges so row positions stay aligned); `wanted` pushes
-        column projection into the format reader."""
+        column projection into the format reader.  Transient store
+        faults retry under read.retry.* (parallel/scan_pipeline.py)."""
         from paimon_tpu.core.kv_file import read_kv_file
-        t = read_kv_file(self.file_io, self.path_factory,
-                         split.partition, split.bucket, meta, None,
-                         None, schema=self.schema,
-                         schema_manager=self.schema_manager,
-                         wanted=set(wanted) if wanted else None)
+        from paimon_tpu.parallel.scan_pipeline import read_file_retrying
+        t = read_file_retrying(
+            lambda: read_kv_file(self.file_io, self.path_factory,
+                                 split.partition, split.bucket, meta,
+                                 None, None, schema=self.schema,
+                                 schema_manager=self.schema_manager,
+                                 wanted=set(wanted) if wanted else None,
+                                 options=self.options),
+            self.options, what=meta.file_name)
         return self._evolve(t, meta.schema_id)
 
     def _value_columns(self) -> List[str]:
@@ -346,6 +351,7 @@ class AppendSplitRead:
         from paimon_tpu.core.row_tracking import (
             ROW_ID_COL, anchor_of, group_row_ranges, read_evolution_group,
         )
+        from paimon_tpu.parallel.scan_pipeline import read_or_skip_corrupt
 
         wanted = set(self._value_columns())
         want_rid = getattr(self, "_with_row_ids", False)
@@ -366,7 +372,8 @@ class AppendSplitRead:
                                    else -1,
                                    anchor_of(g).min_sequence_number)):
                 anchor = anchor_of(group)
-                try:
+
+                def load(group=group, anchor=anchor):
                     if len(group) == 1 and anchor.first_row_id is None:
                         t = self.read_file(
                             split, anchor,
@@ -378,21 +385,20 @@ class AppendSplitRead:
                             t = t.append_column(
                                 ROW_ID_COL,
                                 pa.nulls(t.num_rows, pa.int64()))
-                    else:
-                        t = read_evolution_group(self, split, group, cols)
-                        t = self._fill_partition_columns(
-                            t, set(t.column_names), split.partition)
-                except Exception:
-                    if self.options.get(
-                            CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
-                        # skip the WHOLE group: row positions inside a
-                        # group must stay aligned, partial reads cannot
-                        import warnings
-                        warnings.warn(
-                            f"skipping corrupt evolution group at "
-                            f"{anchor.file_name}", RuntimeWarning)
-                        continue
-                    raise
+                        return t
+                    t = read_evolution_group(self, split, group, cols)
+                    return self._fill_partition_columns(
+                        t, set(t.column_names), split.partition)
+
+                # corrupt -> skip the WHOLE group (row positions inside
+                # a group must stay aligned, partial reads cannot);
+                # retry=False: read_file already retries transients
+                t = read_or_skip_corrupt(
+                    load, self.options,
+                    f"evolution group at {anchor.file_name}",
+                    retry=False)
+                if t is None:
+                    continue
                 if split.deletion_vectors and \
                         anchor.file_name in split.deletion_vectors and \
                         self.options.get(
@@ -403,20 +409,16 @@ class AppendSplitRead:
         else:
             for meta in sorted(split.data_files,
                                key=lambda f: f.min_sequence_number):
-                try:
-                    t = read_kv_file(self.file_io, self.path_factory,
-                                     split.partition, split.bucket, meta,
-                                     None, None, schema=self.schema,
-                                     schema_manager=self.schema_manager,
-                                     wanted=wanted)
-                except Exception:
-                    if self.options.get(
-                            CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
-                        import warnings
-                        warnings.warn(f"skipping corrupt data file "
-                                      f"{meta.file_name}", RuntimeWarning)
-                        continue
-                    raise
+                t = read_or_skip_corrupt(
+                    lambda meta=meta: read_kv_file(
+                        self.file_io, self.path_factory,
+                        split.partition, split.bucket, meta,
+                        None, None, schema=self.schema,
+                        schema_manager=self.schema_manager,
+                        wanted=wanted, options=self.options),
+                    self.options, f"data file {meta.file_name}")
+                if t is None:
+                    continue
                 raw_cols = set(t.column_names)
                 t = self._evolve(t, meta.schema_id)
                 t = self._fill_partition_columns(t, raw_cols,
@@ -445,10 +447,18 @@ class AppendSplitRead:
                 RK, pa.array(np.zeros(out.num_rows, np.int8), pa.int8()))
         return out
 
+    def iter_splits(self, splits: Sequence[DataSplit], *,
+                    ordered: bool = True):
+        """(index, split, table) through the bounded prefetch pipeline
+        (parallel/scan_pipeline.py)."""
+        from paimon_tpu.parallel.scan_pipeline import iter_split_tables
+        return iter_split_tables(self, splits, self.options,
+                                 ordered=ordered)
+
     def read_splits(self, splits: Sequence[DataSplit],
                     streaming: Optional[bool] = None) -> pa.Table:
-        tables = [self.read_split(s) for s in splits]
-        tables = [t for t in tables if t.num_rows > 0]
+        tables = [t for _, _, t in self.iter_splits(splits)
+                  if t.num_rows > 0]
         if not tables:
             from paimon_tpu.core.read import ROW_KIND_COL as RK
             if streaming is None:

@@ -224,16 +224,20 @@ def read_kv_file(file_io: FileIO, path_factory: FileStorePathFactory,
                  file_format: Optional[str] = None,
                  projection: Optional[List[str]] = None,
                  schema=None, schema_manager=None,
-                 wanted=None) -> pa.Table:
+                 wanted=None, options=None) -> pa.Table:
     """Read one KV data file into Arrow. When `schema` is given, blob
     descriptor columns resolve against their .blob sidecars here — every
-    reader is blob-safe by construction."""
+    reader is blob-safe by construction.  `options` gates the read-side
+    footer cache (read.cache.footer, on by default)."""
     ext = meta.file_name.rsplit(".", 1)[-1]
     fmt = get_format(file_format or ext)
     path = path_factory.data_file_path(partition, bucket, meta.file_name)
     if meta.external_path:
         path = meta.external_path
-    table = fmt.create_reader().read(file_io, path, projection=projection)
+    from paimon_tpu.fs.caching import footer_cache_scope
+    with footer_cache_scope(options):
+        table = fmt.create_reader().read(file_io, path,
+                                         projection=projection)
     if schema is not None:
         from paimon_tpu.format.blob import maybe_resolve_blobs
         table = maybe_resolve_blobs(file_io, path_factory, partition,

@@ -14,7 +14,8 @@ handles arbitrary overlap; non-overlapping byte ranges just sort cheaply.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -38,13 +39,13 @@ __all__ = ["MergeFileSplitRead", "assemble_runs", "ROW_KIND_COL",
 ROW_KIND_COL = "_ROW_KIND"
 
 
-def record_level_expire_filter(options: CoreOptions,
-                               table: pa.Table) -> pa.Table:
+def record_level_expire_filter(options: CoreOptions, table: pa.Table,
+                               now_ms: Optional[int] = None) -> pa.Table:
     """Hide rows whose time field passed record-level.expire-time
     (reference io/RecordLevelExpire wrapping every reader; physical
-    removal happens at compaction rewrite)."""
-    import time as _time
-
+    removal happens at compaction rewrite).  `now_ms` pins the wall
+    clock for deterministic tests (same injectable-clock contract as
+    remove_orphan_files)."""
     import pyarrow.compute as pc
 
     expire_ms = options.record_level_expire_time_ms
@@ -62,7 +63,9 @@ def record_level_expire_filter(options: CoreOptions,
         vals_ms = np.asarray(col.fill_null(0)).astype(np.int64) * 1000
     else:
         vals_ms = np.asarray(col.cast(pa.int64()).fill_null(0))
-    cutoff = int(_time.time() * 1000) - expire_ms
+    if now_ms is None:
+        now_ms = int(time.time() * 1000)
+    cutoff = now_ms - expire_ms
     keep = (vals_ms >= cutoff) | np.asarray(pc.is_null(col))
     if keep.all():
         return table
@@ -207,10 +210,20 @@ class MergeFileSplitRead:
             out = out.filter(self._predicate.to_arrow())
         return out
 
+    def iter_splits(self, splits: Sequence[DataSplit], *,
+                    ordered: bool = True
+                    ) -> Iterator[Tuple[int, DataSplit, pa.Table]]:
+        """(index, split, table) through the bounded prefetch pipeline
+        (parallel/scan_pipeline.py); ordered=False yields in completion
+        order."""
+        from paimon_tpu.parallel.scan_pipeline import iter_split_tables
+        return iter_split_tables(self, splits, self.options,
+                                 ordered=ordered)
+
     def read_splits(self, splits: Sequence[DataSplit],
                     streaming: Optional[bool] = None) -> pa.Table:
-        tables = [self.read_split(s) for s in splits]
-        tables = [t for t in tables if t.num_rows > 0]
+        tables = [t for _, _, t in self.iter_splits(splits)
+                  if t.num_rows > 0]
         if not tables:
             if streaming is None:
                 streaming = any(s.for_streaming for s in splits)
@@ -243,20 +256,16 @@ class MergeFileSplitRead:
 
     def _read_file(self, split: DataSplit, meta: DataFileMeta,
                    read_cols: List[str]) -> Optional[pa.Table]:
-        try:
-            table = read_kv_file(
+        from paimon_tpu.parallel.scan_pipeline import read_or_skip_corrupt
+        table = read_or_skip_corrupt(
+            lambda: read_kv_file(
                 self.file_io, self.path_factory, split.partition,
                 split.bucket, meta, file_format=None, projection=None,
                 schema=self.schema, schema_manager=self.schema_manager,
-                wanted=set(read_cols))
-        except Exception:
-            if self.options.get(CoreOptions.SCAN_IGNORE_CORRUPT_FILES):
-                # reference scan.ignore-corrupt-files: warn + skip
-                import warnings
-                warnings.warn(f"skipping corrupt data file "
-                              f"{meta.file_name}", RuntimeWarning)
-                return None
-            raise
+                wanted=set(read_cols), options=self.options),
+            self.options, f"data file {meta.file_name}")
+        if table is None:
+            return None
         table = self._evolve(table, meta.schema_id)
         if split.deletion_vectors and \
                 meta.file_name in split.deletion_vectors and \

@@ -10,6 +10,7 @@ through the pure-Python codec.
 from __future__ import annotations
 
 import io
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
@@ -24,7 +25,30 @@ from paimon_tpu.fs import FileIO
 from paimon_tpu.types import RowType, row_type_to_arrow_schema
 
 __all__ = ["FileFormatFactory", "get_format", "FormatReader",
-           "FormatWriter", "extract_simple_stats"]
+           "FormatWriter", "extract_simple_stats", "CorruptDataError"]
+
+
+class CorruptDataError(OSError):
+    """Decode-time corruption: the bytes were already fetched, so the
+    failure is deterministic — NOT a transient store fault, never worth
+    retrying (parallel/fault.py), but eligible for the
+    scan.ignore-corrupt-files skip.  Subclasses OSError because modern
+    pyarrow surfaces decode corruption (torn footers, corrupt
+    compressed pages) as plain OSError and existing handlers expect
+    that; the distinct type is what lets the fault taxonomy separate
+    'bad bytes' from 'bad store'."""
+
+
+@contextmanager
+def _decode_errors(path: str):
+    """Re-raise decode-phase OSErrors as CorruptDataError (fetch-phase
+    store faults never pass through here)."""
+    try:
+        yield
+    except CorruptDataError:
+        raise
+    except OSError as e:
+        raise CorruptDataError(f"corrupt data in {path}: {e}") from e
 
 
 class FormatReader:
@@ -55,18 +79,35 @@ class FormatWriter:
 
 
 class _ParquetReader(FormatReader):
+    @staticmethod
+    def _open(file_io, path) -> "pq.ParquetFile":
+        """ParquetFile over the (possibly byte-cached) file, reusing a
+        previously parsed footer from the process footer cache
+        (fs/caching.py) — repeated scans skip the thrift metadata
+        decode entirely."""
+        from paimon_tpu.fs.caching import global_footer_cache
+        data = file_io.read_bytes(path)      # store faults propagate
+        cache = global_footer_cache()
+        md = cache.get(path)
+        with _decode_errors(path):
+            pf = pq.ParquetFile(io.BytesIO(data), metadata=md)
+        if md is None:
+            cache.put(path, pf.metadata)
+        return pf
+
     def read(self, file_io, path, projection=None, batch_size=1 << 20):
-        data = file_io.read_bytes(path)
-        return pq.read_table(io.BytesIO(data), columns=projection)
+        pf = self._open(file_io, path)
+        with _decode_errors(path):
+            return pf.read(columns=projection)
 
     def read_batches(self, file_io, path, projection=None,
                      batch_rows: int = 1 << 20):
         # compressed bytes stay resident; decode is incremental per batch
-        data = file_io.read_bytes(path)
-        pf = pq.ParquetFile(io.BytesIO(data))
-        for rb in pf.iter_batches(batch_size=batch_rows,
-                                  columns=projection):
-            yield pa.Table.from_batches([rb])
+        pf = self._open(file_io, path)
+        with _decode_errors(path):
+            for rb in pf.iter_batches(batch_size=batch_rows,
+                                      columns=projection):
+                yield pa.Table.from_batches([rb])
 
 
 def split_compression(spec: str):
@@ -119,9 +160,10 @@ class _OrcReader(FormatReader):
     def read(self, file_io, path, projection=None, batch_size=1 << 20):
         if pa_orc is None:
             raise RuntimeError("pyarrow.orc unavailable")
-        data = file_io.read_bytes(path)
-        f = pa_orc.ORCFile(io.BytesIO(data))
-        return f.read(columns=projection)
+        data = file_io.read_bytes(path)      # store faults propagate
+        with _decode_errors(path):
+            f = pa_orc.ORCFile(io.BytesIO(data))
+            return f.read(columns=projection)
 
 
 class _OrcWriter(FormatWriter):

@@ -1,11 +1,20 @@
-"""Caching FileIO: LRU byte cache over immutable store files.
+"""Read-side caches: LRU byte cache, format-footer cache, block-range
+cache over immutable store files.
 
 reference: paimon-common/.../fs/cache/CachingFileIO (local page cache
-over remote object stores) + io/cache/CacheManager.java:34.
+over remote object stores) + io/cache/CacheManager.java:34; the footer
+cache mirrors FileReaderFactory's ParquetFileReader footer reuse (and
+"An Empirical Evaluation of Columnar Storage Formats": metadata decode
+is the cheapest large win on repeated scans).
 
 Only files whose names mark them immutable (uuid'd data/manifest/index
 files, snapshot-N, schema-N) are cached; mutable refs (LATEST/EARLIEST
 hints, consumers, tags, branches) always hit the inner FileIO.
+
+Cache observability: every cache reports hits/misses/bytes into the
+process metrics registry (metrics.py, scan group) so benchmarks and
+dashboards can watch hit rates (`benchmarks/scan_bench.py` records the
+footer-cache re-scan speedup).
 """
 
 from __future__ import annotations
@@ -13,11 +22,14 @@ from __future__ import annotations
 import re
 import threading
 from collections import OrderedDict
-from typing import Optional
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
 
 from paimon_tpu.fs.fileio import FileIO
 
-__all__ = ["CachingFileIO"]
+__all__ = ["CachingFileIO", "FooterCache", "global_footer_cache",
+           "footer_cache_disabled", "footer_cache_scope",
+           "scoped_batches"]
 
 # snapshot-N files are deliberately NOT cached: rollback_to /
 # fast_forward delete and later RECREATE the same snapshot ids with
@@ -32,8 +44,151 @@ def _cacheable(path: str) -> bool:
     return bool(_IMMUTABLE.search(name))
 
 
+_COUNTERS = None
+
+
+def _counters():
+    """Scan-group metric Counters resolved ONCE per process (the
+    registry group/dict lookups take locks — too heavy per file read)."""
+    global _COUNTERS
+    if _COUNTERS is None:
+        from paimon_tpu import metrics as m
+        group = m.global_registry().scan_metrics()
+        _COUNTERS = {
+            "file_hits": group.counter(m.SCAN_FILE_CACHE_HITS),
+            "file_misses": group.counter(m.SCAN_FILE_CACHE_MISSES),
+            "footer_hits": group.counter(m.SCAN_FOOTER_CACHE_HITS),
+            "footer_misses": group.counter(m.SCAN_FOOTER_CACHE_MISSES),
+            "range_hits": group.counter(m.SCAN_RANGE_CACHE_HITS),
+            "range_misses": group.counter(m.SCAN_RANGE_CACHE_MISSES),
+            "range_hit_bytes": group.counter(
+                m.SCAN_RANGE_CACHE_HIT_BYTES),
+        }
+    return _COUNTERS
+
+
+# -- format footer cache -----------------------------------------------------
+
+class FooterCache:
+    """Process-wide LRU of parsed file footers keyed by path.
+
+    Stores opaque parsed-metadata objects (pyarrow.parquet.FileMetaData
+    today; any format may join) for immutable-named files only.  Entry
+    count bounded, not bytes: a parquet footer is a few KB, so the
+    default 4096 entries is ~tens of MB worst case.
+    """
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = max_entries
+        self._cache: "OrderedDict[str, object]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, path: str):
+        """Cached footer for `path`, or None.  Mutable-named paths and
+        thread-locally disabled readers always miss, without touching
+        the hit/miss counters."""
+        if not _cacheable(path) or not _footer_cache_on():
+            return None
+        with self._lock:
+            md = self._cache.get(path)
+            if md is not None:
+                self._cache.move_to_end(path)
+                self.hits += 1
+            else:
+                self.misses += 1
+        _counters()["footer_hits" if md is not None
+                    else "footer_misses"].inc()
+        return md
+
+    def put(self, path: str, footer: object):
+        if not _cacheable(path) or not _footer_cache_on():
+            return
+        with self._lock:
+            if path not in self._cache:
+                self._cache[path] = footer
+                while len(self._cache) > self.max_entries:
+                    self._cache.popitem(last=False)
+
+    def evict(self, path: str):
+        with self._lock:
+            self._cache.pop(path, None)
+
+    def clear(self):
+        with self._lock:
+            self._cache.clear()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+_FOOTERS = FooterCache()
+# thread-local off-switch: read paths of tables with read.cache.footer
+# = false wrap their format reads in footer_cache_disabled() instead of
+# threading a flag through every FormatReader signature
+_TLS = threading.local()
+
+
+def global_footer_cache() -> FooterCache:
+    return _FOOTERS
+
+
+def _footer_cache_on() -> bool:
+    return not getattr(_TLS, "off", False)
+
+
+@contextmanager
+def footer_cache_disabled():
+    prev = getattr(_TLS, "off", False)
+    _TLS.off = True
+    try:
+        yield
+    finally:
+        _TLS.off = prev
+
+
+def scoped_batches(batches, options=None):
+    """Drive a read_batches iterator with the footer-cache gate held
+    only WHILE ADVANCING it (the footer parse happens on the first
+    next()).  Safe inside generators: a plain `with` around a
+    yield-containing loop would leak the thread-local flag to
+    unrelated reads while the outer generator is suspended, and
+    restore it out of order when interleaved generators exit."""
+    while True:
+        with footer_cache_scope(options):
+            try:
+                batch = next(batches)
+            except StopIteration:
+                return
+        yield batch
+
+
+def footer_cache_scope(options=None):
+    """Context manager honoring a table's read.cache.footer option —
+    the ONE gate every format-read call site wraps (read_kv_file, the
+    compaction/mesh rewriters' streamed decodes), so the option's
+    contract holds beyond the scan path.  fsck --deep uses
+    footer_cache_disabled() directly: verification must reparse the
+    on-disk footer regardless of table options."""
+    from contextlib import nullcontext
+
+    from paimon_tpu.options import CoreOptions
+    if options is not None and \
+            not options.get(CoreOptions.READ_CACHE_FOOTER):
+        return footer_cache_disabled()
+    return nullcontext()
+
+
 class CachingFileIO(FileIO):
-    def __init__(self, inner: FileIO, capacity_bytes: int = 256 << 20):
+    """LRU whole-file byte cache, plus an optional block-range cache
+    keyed by (path, offset, length) for formats that read footers/blobs
+    by range (mosaic) instead of whole files.  The range cache only
+    serves immutable files NOT already in the whole-file cache (a
+    whole-file hit slices for free)."""
+
+    def __init__(self, inner: FileIO, capacity_bytes: int = 256 << 20,
+                 range_cache_bytes: int = 0):
         self.inner = inner
         self.capacity = capacity_bytes
         self._cache: "OrderedDict[str, bytes]" = OrderedDict()
@@ -41,6 +196,13 @@ class CachingFileIO(FileIO):
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # block-range cache (read.cache.range)
+        self.range_capacity = range_cache_bytes
+        self._ranges: "OrderedDict[Tuple[str, int, int], bytes]" = \
+            OrderedDict()
+        self._range_size = 0
+        self.range_hits = 0
+        self.range_misses = 0
 
     # -- cached reads --------------------------------------------------------
 
@@ -52,9 +214,12 @@ class CachingFileIO(FileIO):
             if data is not None:
                 self._cache.move_to_end(path)
                 self.hits += 1
-                return data
+        if data is not None:
+            _counters()["file_hits"].inc()
+            return data
         data = self.inner.read_bytes(path)
         self.misses += 1
+        _counters()["file_misses"].inc()
         if len(data) <= self.capacity:
             with self._lock:
                 if path not in self._cache:
@@ -65,6 +230,30 @@ class CachingFileIO(FileIO):
                         self._size -= len(old)
         return data
 
+    def _range_get(self, path: str, offset: int,
+                   length: int) -> Optional[bytes]:
+        key = (path, offset, length)
+        with self._lock:
+            data = self._ranges.get(key)
+            if data is not None:
+                self._ranges.move_to_end(key)
+                self.range_hits += 1
+        return data
+
+    def _range_put(self, path: str, offset: int, length: int,
+                   data: bytes):
+        if len(data) > self.range_capacity:
+            return
+        key = (path, offset, length)
+        with self._lock:
+            if key not in self._ranges:
+                self._ranges[key] = data
+                self._range_size += len(data)
+                while self._range_size > self.range_capacity and \
+                        self._ranges:
+                    _, old = self._ranges.popitem(last=False)
+                    self._range_size -= len(old)
+
     def read_range(self, path: str, offset: int, length: int) -> bytes:
         if _cacheable(path):
             with self._lock:
@@ -73,9 +262,62 @@ class CachingFileIO(FileIO):
                     self._cache.move_to_end(path)
                     self.hits += 1
                     return data[offset:offset + length]
+            if self.range_capacity > 0:
+                data = self._range_get(path, offset, length)
+                if data is not None:
+                    c = _counters()
+                    c["range_hits"].inc()
+                    c["range_hit_bytes"].inc(len(data))
+                    return data
         # not cached: delegate the range — never force a full-object GET
         self.misses += 1
-        return self.inner.read_range(path, offset, length)
+        data = self.inner.read_range(path, offset, length)
+        if self.range_capacity > 0 and _cacheable(path):
+            self.range_misses += 1
+            _counters()["range_misses"].inc()
+            self._range_put(path, offset, length, data)
+        return data
+
+    def read_ranges(self, path: str,
+                    ranges: List[Tuple[int, int]]) -> List[bytes]:
+        """Vectored read through the caches: cached ranges are served
+        locally, the remaining ones go to the inner FileIO in ONE
+        vectored call (object stores coalesce them).  Counts into the
+        same hit/miss/byte counters as the scalar path."""
+        if not _cacheable(path) or \
+                (self.range_capacity <= 0 and path not in self._cache):
+            return self.inner.read_ranges(path, ranges)
+        out: List[Optional[bytes]] = [None] * len(ranges)
+        missing: List[int] = []
+        c = _counters()
+        with self._lock:
+            whole = self._cache.get(path)
+            if whole is not None:
+                self._cache.move_to_end(path)
+                self.hits += 1          # ONE hit per vectored call,
+        if whole is not None:           # like read_bytes would count
+            c["file_hits"].inc()
+            return [whole[o:o + ln] for o, ln in ranges]
+        for i, (offset, length) in enumerate(ranges):
+            got = self._range_get(path, offset, length) \
+                if self.range_capacity > 0 else None
+            if got is not None:
+                c["range_hits"].inc()
+                c["range_hit_bytes"].inc(len(got))
+                out[i] = got
+            else:
+                missing.append(i)
+        if missing:
+            fetched = self.inner.read_ranges(
+                path, [ranges[i] for i in missing])
+            for i, data in zip(missing, fetched):
+                out[i] = data
+                if self.range_capacity > 0:
+                    self.range_misses += 1
+                    c["range_misses"].inc()
+                    self._range_put(path, ranges[i][0], ranges[i][1],
+                                    data)
+        return out  # type: ignore[return-value]
 
     # -- invalidating mutations ---------------------------------------------
 
@@ -84,6 +326,9 @@ class CachingFileIO(FileIO):
             data = self._cache.pop(path, None)
             if data is not None:
                 self._size -= len(data)
+            for key in [k for k in self._ranges if k[0] == path]:
+                self._range_size -= len(self._ranges.pop(key))
+        _FOOTERS.evict(path)
 
     def write_bytes(self, path, data, overwrite=True):
         self._evict(path)

@@ -7,7 +7,7 @@ needs daft installed.
 
 from typing import List, Optional
 
-from paimon_tpu.integrations.ray_data import split_read_tasks
+from paimon_tpu.integrations.ray_data import scan_batches
 
 
 def _require_daft():
@@ -29,12 +29,13 @@ def to_daft_dataframe(table, projection: Optional[List[str]] = None,
     daft = _require_daft()
     import pyarrow as pa
 
-    tasks = split_read_tasks(table, projection, predicate)
-    if not tasks:
+    # pipelined split reads (parallel/scan_pipeline.py): splits decode
+    # concurrently instead of the previous serial per-task loop
+    batches = list(scan_batches(table, projection, predicate))
+    if not batches:
         schema = table.arrow_schema()
         if projection:
             schema = pa.schema([schema.field(c) for c in projection])
         return daft.from_arrow(pa.Table.from_pylist([], schema=schema))
-    batches = [t["fn"]() for t in tasks]
     return daft.from_arrow(pa.concat_tables(batches,
                                             promote_options="none"))

@@ -5,6 +5,13 @@ Ray/torch readers: fixed-shape batches (static shapes keep XLA from
 recompiling per step), numeric columns stacked as device arrays,
 optional sharding over a `jax.sharding.Mesh` axis so each device gets
 its slice without a host-side gather.
+
+Split reads route through the pipelined scan executor
+(parallel/scan_pipeline.py): worker threads download/decode/merge the
+next splits while the training loop consumes the current batch, and a
+device-put double buffer issues step N+1's (async) host-to-device
+transfer before step N's batch is handed out — the accelerator never
+waits on the object store for a warm pipeline.
 """
 
 from typing import Any, Dict, Iterator, List, Optional
@@ -29,7 +36,8 @@ def jax_batches(table, batch_size: int,
                 projection: Optional[List[str]] = None,
                 predicate=None,
                 drop_remainder: bool = True,
-                sharding=None) -> Iterator[Dict[str, Any]]:
+                sharding=None,
+                ordered: bool = True) -> Iterator[Dict[str, Any]]:
     """Yield dicts of jax arrays of EXACTLY batch_size rows (fixed
     shapes; a short tail is dropped unless drop_remainder=False, where
     it is zero-padded and yielded with a `_mask` bool array).
@@ -40,6 +48,10 @@ def jax_batches(table, batch_size: int,
     sharding: an optional `jax.sharding.Sharding` applied on device_put
     (e.g. NamedSharding(mesh, P("data")) to scatter the batch across a
     data-parallel mesh axis).
+
+    ordered=False lets splits arrive in completion order (faster under
+    skew); set it only when batch composition across epochs need not be
+    deterministic.
     """
     import jax
 
@@ -61,31 +73,48 @@ def jax_batches(table, batch_size: int,
                     for k, v in arrs.items()}
         return {k: jax.device_put(v) for k, v in arrs.items()}
 
-    pending: List[pa.Table] = []
-    buffered = 0
-    for split in plan.splits:
-        t = read.read_split(split).select(cols)
-        pending.append(t)
-        buffered += t.num_rows
-        while buffered >= batch_size:
+    def host_batches() -> Iterator[Dict[str, np.ndarray]]:
+        """Fixed-size numpy batches off the pipelined split reader."""
+        pending: List[pa.Table] = []
+        buffered = 0
+        for _, _, t in read.iter_splits(plan.splits, ordered=ordered):
+            t = t.select(cols)
+            pending.append(t)
+            buffered += t.num_rows
+            while buffered >= batch_size:
+                merged = pa.concat_tables(pending,
+                                          promote_options="none")
+                head = merged.slice(0, batch_size)
+                rest = merged.slice(batch_size)
+                pending = [rest] if rest.num_rows else []
+                buffered = rest.num_rows
+                yield {c: head.column(c).to_numpy(zero_copy_only=False)
+                       for c in cols}
+        if buffered and not drop_remainder:
             merged = pa.concat_tables(pending, promote_options="none")
-            head = merged.slice(0, batch_size)
-            rest = merged.slice(batch_size)
-            pending = [rest] if rest.num_rows else []
-            buffered = rest.num_rows
-            yield put({c: head.column(c).to_numpy(zero_copy_only=False)
-                       for c in cols})
-    if buffered and not drop_remainder:
-        merged = pa.concat_tables(pending, promote_options="none")
-        arrs = {}
-        mask = np.zeros(batch_size, dtype=bool)
-        mask[:merged.num_rows] = True
-        for c in cols:
-            v = merged.column(c).to_numpy(zero_copy_only=False)
-            padded = np.zeros(batch_size, dtype=v.dtype)
-            padded[: len(v)] = v
-            arrs[c] = padded
+            arrs = {}
+            mask = np.zeros(batch_size, dtype=bool)
+            mask[:merged.num_rows] = True
+            for c in cols:
+                v = merged.column(c).to_numpy(zero_copy_only=False)
+                padded = np.zeros(batch_size, dtype=v.dtype)
+                padded[: len(v)] = v
+                arrs[c] = padded
+            arrs["_mask"] = mask
+            yield arrs
+
+    # device-put double buffer: device_put is asynchronous, so issuing
+    # batch N+1's transfer before yielding batch N overlaps the H2D
+    # copy with the consumer's step on batch N
+    staged: Optional[Dict[str, Any]] = None
+    for arrs in host_batches():
+        mask = arrs.pop("_mask", None)
         batch = put(arrs)
-        batch["_mask"] = jax.device_put(mask) if sharding is None else \
-            jax.device_put(mask, sharding)
-        yield batch
+        if mask is not None:
+            batch["_mask"] = jax.device_put(mask) if sharding is None \
+                else jax.device_put(mask, sharding)
+        if staged is not None:
+            yield staged
+        staged = batch
+    if staged is not None:
+        yield staged

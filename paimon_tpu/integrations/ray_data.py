@@ -24,7 +24,8 @@ def split_read_tasks(table, projection: Optional[List[str]] = None,
                      predicate=None) -> List[Dict[str, Any]]:
     """One task descriptor per split: {'fn': zero-arg callable -> Arrow
     table, 'num_rows': hint}.  This is the engine-agnostic core the Ray
-    datasource maps over its workers."""
+    datasource maps over its workers (Ray owns cross-split parallelism
+    there, so each task is a single serial split read)."""
     rb = table.new_read_builder()
     if projection:
         rb = rb.with_projection(projection)
@@ -42,6 +43,23 @@ def split_read_tasks(table, projection: Optional[List[str]] = None,
             "num_rows": sum(f.row_count for f in split.data_files),
         })
     return tasks
+
+
+def scan_batches(table, projection: Optional[List[str]] = None,
+                 predicate=None, ordered: bool = True):
+    """Yield per-split Arrow tables through the pipelined scan executor
+    (parallel/scan_pipeline.py) — the in-process counterpart of
+    `split_read_tasks` for engines that don't bring their own scheduler
+    (daft handoff, plain python consumers)."""
+    rb = table.new_read_builder()
+    if projection:
+        rb = rb.with_projection(projection)
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    plan = rb.new_scan().plan()
+    read = rb.new_read()
+    for _, _, t in read.iter_splits(plan.splits, ordered=ordered):
+        yield t
 
 
 def to_ray_dataset(table, projection: Optional[List[str]] = None,

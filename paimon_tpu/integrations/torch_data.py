@@ -74,10 +74,10 @@ class PaimonIterableDataset(_tud.IterableDataset):
         rb = self._read_builder()
         splits = rb.new_scan().plan().splits
         read = rb.new_read()
-        for i, split in enumerate(splits):
-            if i % nshards != shard:
-                continue
-            t = read.read_split(split)
+        mine = [s for i, s in enumerate(splits) if i % nshards == shard]
+        # pipelined reader (parallel/scan_pipeline.py): the next split
+        # downloads/decodes while this worker converts batches
+        for _, _, t in read.iter_splits(mine):
             for start in range(0, t.num_rows, self.batch_size):
                 yield _to_torch_batch(t.slice(start, self.batch_size))
 

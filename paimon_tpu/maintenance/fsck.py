@@ -256,13 +256,17 @@ class _GraphWalker:
         """Read the file and compare actual row count against the
         manifest meta (stats plane)."""
         from paimon_tpu.format import get_format
+        from paimon_tpu.fs.caching import footer_cache_disabled
         try:
             ext = e.file.file_name.rsplit(".", 1)[-1]
             fmt = get_format(ext)
             rows = 0
-            for batch in fmt.create_reader().read_batches(
-                    self.table.file_io, path):
-                rows += batch.num_rows
+            # verification must reparse the ON-DISK footer — a warm
+            # process-wide footer cache would mask footer corruption
+            with footer_cache_disabled():
+                for batch in fmt.create_reader().read_batches(
+                        self.table.file_io, path):
+                    rows += batch.num_rows
         except Exception as exc:            # noqa: BLE001
             self.report.add(ViolationKind.CORRUPT_DATA_FILE,
                             e.file.file_name,

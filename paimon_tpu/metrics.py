@@ -15,7 +15,12 @@ from typing import Callable, Dict, List, Optional
 __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "MetricRegistry", "global_registry",
            "COMPACTION_BUCKET_RETRIES", "COMPACTION_BUCKET_FALLBACKS",
-           "COMPACTION_BUCKET_FAILURES", "FSCK_VIOLATIONS"]
+           "COMPACTION_BUCKET_FAILURES", "FSCK_VIOLATIONS",
+           "SCAN_FILE_CACHE_HITS", "SCAN_FILE_CACHE_MISSES",
+           "SCAN_FOOTER_CACHE_HITS", "SCAN_FOOTER_CACHE_MISSES",
+           "SCAN_RANGE_CACHE_HITS", "SCAN_RANGE_CACHE_MISSES",
+           "SCAN_RANGE_CACHE_HIT_BYTES", "SCAN_PIPELINE_SPLITS",
+           "SCAN_PIPELINE_BYTES", "SCAN_READ_RETRIES"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -26,6 +31,20 @@ COMPACTION_BUCKET_RETRIES = "bucket_retries"
 COMPACTION_BUCKET_FALLBACKS = "bucket_fallbacks"
 COMPACTION_BUCKET_FAILURES = "bucket_failures"
 FSCK_VIOLATIONS = "fsck_violations"
+
+# read-side cache + pipeline counter names (scan metric group;
+# producers in fs/caching.py + parallel/scan_pipeline.py + core read
+# paths, consumers in scan_bench.py / tests / dashboards)
+SCAN_FILE_CACHE_HITS = "file_cache_hits"
+SCAN_FILE_CACHE_MISSES = "file_cache_misses"
+SCAN_FOOTER_CACHE_HITS = "footer_cache_hits"
+SCAN_FOOTER_CACHE_MISSES = "footer_cache_misses"
+SCAN_RANGE_CACHE_HITS = "range_cache_hits"
+SCAN_RANGE_CACHE_MISSES = "range_cache_misses"
+SCAN_RANGE_CACHE_HIT_BYTES = "range_cache_hit_bytes"
+SCAN_PIPELINE_SPLITS = "pipeline_splits"          # splits prefetched
+SCAN_PIPELINE_BYTES = "pipeline_bytes"            # est. bytes admitted
+SCAN_READ_RETRIES = "read_retries"                # transient IO retries
 
 
 class Counter:

@@ -410,6 +410,44 @@ class CoreOptions:
         "the single-chip compact/manager.py path instead of failing "
         "the whole mesh job; false = raise once retries run out")
 
+    # -- pipelined merge-on-read scan (ours; parallel/scan_pipeline.py) ------
+    SCAN_SPLIT_PARALLELISM = ConfigOption(
+        "scan.split.parallelism", int, None,
+        "Worker threads reading/decoding splits concurrently in the "
+        "pipelined scan executor (Arrow C++ decode and file IO release "
+        "the GIL); None = min(8, cpu count), 1 = serial read path")
+    READ_PREFETCH_SPLITS = ConfigOption(
+        "read.prefetch.splits", int, 2,
+        "Extra splits submitted beyond the worker pool width so the "
+        "next split's files download while the current one merges")
+    READ_PREFETCH_MAX_BYTES = ConfigOption(
+        "read.prefetch.max-bytes", parse_memory_size, 1 << 30,
+        "Hard budget on the estimated bytes (sum of data-file sizes) "
+        "of splits in flight at once; at least one split is always "
+        "admitted so a budget below one split's size cannot stall")
+    READ_RETRY_MAX_ATTEMPTS = ConfigOption(
+        "read.retry.max-attempts", int, 3,
+        "Attempts per data-file read on a transient store fault (503 "
+        "storms, IO errors — parallel/fault.py taxonomy) before the "
+        "scan raises; non-transient errors never retry")
+    READ_RETRY_BACKOFF = ConfigOption(
+        "read.retry.backoff", _parse_duration_ms, 10,
+        "Base wait between data-file read retries; actual waits use "
+        "capped decorrelated jitter (utils/backoff.py)")
+    READ_CACHE_FOOTER = ConfigOption(
+        "read.cache.footer", _parse_bool, True,
+        "Cache parsed parquet footers of immutable data files in a "
+        "process-wide LRU so repeated scans and lookup joins skip "
+        "metadata decode (fs/caching.py)")
+    READ_CACHE_RANGE = ConfigOption(
+        "read.cache.range", _parse_bool, False,
+        "Wrap the table's FileIO in a block-range cache keyed by "
+        "(path, offset, length) for immutable files read by range "
+        "(mosaic footers/blobs); whole-file reads are unaffected")
+    READ_CACHE_RANGE_MAX_BYTES = ConfigOption(
+        "read.cache.range.max-bytes", parse_memory_size, 128 << 20,
+        "Capacity of the block-range cache enabled by read.cache.range")
+
     # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
     SCAN_PLAN_SORT_PARTITION = ConfigOption(
         "scan.plan-sort-partition", _parse_bool, False,

@@ -25,6 +25,9 @@ from paimon_tpu.parallel.mesh_engine import (  # noqa: F401
 from paimon_tpu.parallel.fault import (  # noqa: F401
     BucketRetryPolicy, is_transient_error,
 )
+from paimon_tpu.parallel.scan_pipeline import (  # noqa: F401
+    iter_split_tables, read_file_retrying, resolve_parallelism,
+)
 from paimon_tpu.parallel.packing import (  # noqa: F401
     bucket_row_counts, pack_buckets, packing_skew,
 )

@@ -38,9 +38,23 @@ def is_transient_error(exc: BaseException) -> bool:
     """True when `exc` is worth retrying: a store-side 503
     (TransientStoreError), an IO fault (OSError covers InjectedIOError
     and FileNotFoundError from racing maintenance), or a device/lane
-    loss (XlaRuntimeError)."""
+    loss (XlaRuntimeError).
+
+    DECODE errors are excluded even though they reach us as OSError
+    (modern pyarrow raises plain OSError for torn footers / corrupt
+    compressed pages): the format readers re-tag decode-phase failures
+    as CorruptDataError — deterministic bad bytes, pointless to retry,
+    and on the scan path they must stay eligible for the
+    scan.ignore-corrupt-files skip.  ArrowException covers the
+    ArrowInvalid flavors for completeness.
+    """
+    import pyarrow as pa
+
+    from paimon_tpu.format.format import CorruptDataError
     from paimon_tpu.fs.object_store import TransientStoreError
 
+    if isinstance(exc, (CorruptDataError, pa.ArrowException)):
+        return False
     if isinstance(exc, (TransientStoreError, OSError)):
         return True
     return any(t.__name__ in _DEVICE_ERROR_NAMES

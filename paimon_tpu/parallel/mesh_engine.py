@@ -332,13 +332,17 @@ class _BucketJob:
         from paimon_tpu.core.read import evolve_table
         from paimon_tpu.format import get_format
 
+        from paimon_tpu.fs.caching import scoped_batches
+
         ctx = self.ctx
+        options = ctx.table.options
         for f in run_files:
             if ctx.has_blobs:
                 t = read_kv_file(ctx.table.file_io, ctx.path_factory,
                                  self.split.partition, self.split.bucket,
                                  f, schema=ctx.schema,
-                                 schema_manager=ctx.schema_manager)
+                                 schema_manager=ctx.schema_manager,
+                                 options=options)
                 t = evolve_table(t, f.schema_id, ctx.schema,
                                  ctx.schema_manager, ctx.schema_cache,
                                  keep_sys_cols=True)
@@ -349,8 +353,12 @@ class _BucketJob:
             fmt = get_format(ext)
             path = f.external_path or ctx.path_factory.data_file_path(
                 self.split.partition, self.split.bucket, f.file_name)
-            for batch in fmt.create_reader().read_batches(
-                    ctx.table.file_io, path, batch_rows=ctx.chunk_rows):
+            # gate held only while advancing the inner iterator (see
+            # fs.caching.scoped_batches), never across our yields
+            for batch in scoped_batches(
+                    fmt.create_reader().read_batches(
+                        ctx.table.file_io, path,
+                        batch_rows=ctx.chunk_rows), options):
                 t = evolve_table(batch, f.schema_id, ctx.schema,
                                  ctx.schema_manager, ctx.schema_cache,
                                  keep_sys_cols=True)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -122,20 +123,17 @@ class KvQueryServer:
                 pass
 
             def do_POST(self):
-                if self.path != "/lookup":
+                if self.path == "/lookup":
+                    handle = self._lookup
+                elif self.path == "/scan":
+                    handle = self._scan
+                else:
                     self.send_error(404)
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
                 try:
-                    rows = server.query.lookup(
-                        req["keys"],
-                        partition=tuple(req.get("partition") or ()))
-                    body = json.dumps(
-                        {"rows": [None if r is None else
-                                  {k: _encode_value(x)
-                                   for k, x in r.items()}
-                                  for r in rows]}).encode()
+                    body = json.dumps(handle(req)).encode()
                     self.send_response(200)
                 except Exception as e:      # noqa: BLE001
                     body = json.dumps({"error": str(e)}).encode()
@@ -144,6 +142,33 @@ class KvQueryServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _lookup(self, req):
+                rows = server.query.lookup(
+                    req["keys"],
+                    partition=tuple(req.get("partition") or ()))
+                return {"rows": [None if r is None else
+                                 {k: _encode_value(x)
+                                  for k, x in r.items()}
+                                 for r in rows]}
+
+            def _scan(self, req):
+                """Bounded table scan through the pipelined split
+                reader (parallel/scan_pipeline.py): splits stream
+                through the prefetch pipeline and admission stops as
+                soon as `limit` rows are buffered."""
+                limit = req.get("limit")
+                limit = 10_000 if limit is None else int(limit)
+                rb = server.table.new_read_builder()
+                if req.get("projection"):
+                    rb = rb.with_projection(list(req["projection"]))
+                rb = rb.with_limit(limit)
+                plan = rb.new_scan().plan()
+                t = rb.new_read().to_arrow(plan.splits)
+                return {"rows": [{k: _encode_value(v)
+                                  for k, v in r.items()}
+                                 for r in t.to_pylist()],
+                        "snapshot_id": plan.snapshot_id}
 
         return Handler
 
@@ -165,16 +190,30 @@ class KvQueryClient:
             address = addrs[0]
         self.address = address.rstrip("/")
 
+    def _post(self, endpoint: str, body: dict, timeout: int) -> dict:
+        """POST json; server-side errors (HTTP 500 with an {"error"}
+        body) surface as RuntimeError with the server's message —
+        urlopen raises HTTPError before the body would be parsed."""
+        req = urllib.request.Request(
+            f"{self.address}/{endpoint}",
+            data=json.dumps(body).encode(), method="POST")
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except ValueError:
+                detail = str(e)
+            raise RuntimeError(
+                f"{endpoint} failed: {detail}") from e
+
     def lookup(self, keys: List[dict],
                partition: tuple = ()) -> List[Optional[dict]]:
-        req = urllib.request.Request(
-            f"{self.address}/lookup",
-            data=json.dumps({"keys": keys,
-                             "partition": list(partition)}).encode(),
-            method="POST")
-        req.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            payload = json.loads(resp.read())
+        payload = self._post("lookup",
+                             {"keys": keys,
+                              "partition": list(partition)}, timeout=30)
         return [None if r is None else
                 {k: _decode_value(v) for k, v in r.items()}
                 for r in payload["rows"]]
@@ -182,3 +221,11 @@ class KvQueryClient:
     def lookup_row(self, key: dict,
                    partition: tuple = ()) -> Optional[dict]:
         return self.lookup([key], partition)[0]
+
+    def scan(self, projection: Optional[List[str]] = None,
+             limit: int = 10_000) -> List[dict]:
+        """Bounded remote scan (served by the pipelined reader)."""
+        payload = self._post("scan", {"projection": projection,
+                                      "limit": limit}, timeout=60)
+        return [{k: _decode_value(v) for k, v in r.items()}
+                for r in payload["rows"]]

@@ -533,6 +533,25 @@ class SQLContext:
         return expr_to_predicate(select.where, _probe_scope(cols, alias),
                                  alias)
 
+    @staticmethod
+    def _pushed_limit(select: ast.Select):
+        """LIMIT safe to push into the scan: only a bare
+        `SELECT <row-exprs> FROM t LIMIT n` — any WHERE/ORDER/GROUP/
+        DISTINCT/OFFSET/set-op/aggregate/window consumes the full
+        relation first, so those shapes read everything.  A pushed
+        limit lets the pipelined reader (parallel/scan_pipeline.py)
+        stop admitting splits early; the executor's final slice still
+        applies and stays a no-op."""
+        if select.limit is None or select.offset or select.joins or \
+                select.where is not None or select.group_by or \
+                select.having or select.distinct or select.order_by or \
+                select.union_all is not None:
+            return None
+        for item in select.items:
+            if _find_aggs(item.expr) or _find_windows(item.expr):
+                return None
+        return select.limit
+
     def _relation_scope(self, ref, select: ast.Select,
                         collect_plan: Optional[dict] = None) -> Scope:
         if isinstance(ref, ast.SubqueryRef):
@@ -545,11 +564,19 @@ class SQLContext:
             if isinstance(rel, pa.Table):
                 out = rel
             else:
+                from paimon_tpu.table.table import FileStoreTable
                 pushed = self._pushed_predicate(rel, alias, select)
+                pushed_limit = self._pushed_limit(select) \
+                    if isinstance(rel, FileStoreTable) else None
                 if collect_plan is not None:
                     collect_plan["pushed"] = repr(pushed) \
                         if pushed is not None else None
-                out = rel.to_arrow(predicate=pushed)
+                    collect_plan["pushed_limit"] = pushed_limit
+                if pushed_limit is not None:
+                    out = rel.to_arrow(predicate=pushed,
+                                       limit=pushed_limit)
+                else:
+                    out = rel.to_arrow(predicate=pushed)
             q = out.rename_columns(
                 [f"{alias}.{c}" for c in out.column_names])
             return Scope(q, list(q.column_names))
@@ -1238,6 +1265,11 @@ class SQLContext:
                 lines.append(f"  pushed predicate: {pushed!r}")
             elif s.where is not None:
                 lines.append("  pushed predicate: none")
+            if not isinstance(rel, pa.Table):
+                from paimon_tpu.table.table import FileStoreTable
+                if isinstance(rel, FileStoreTable) and \
+                        self._pushed_limit(s) is not None:
+                    lines.append(f"  pushed limit: {s.limit}")
         if s.where is not None:
             lines.append(f"Filter: {s.where!r}")
         for j in s.joins:

@@ -41,7 +41,6 @@ class FileStoreTable:
                  table_schema: TableSchema,
                  dynamic_options: Optional[Dict[str, str]] = None,
                  branch: str = "main"):
-        self.file_io = file_io
         self.path = path.rstrip("/")
         opts = dict(table_schema.options)
         if dynamic_options:
@@ -49,6 +48,17 @@ class FileStoreTable:
         self.schema = table_schema.copy(opts) \
             if dynamic_options else table_schema
         self.options = CoreOptions(Options(opts))
+        if self.options.get(CoreOptions.READ_CACHE_RANGE):
+            from paimon_tpu.fs.caching import CachingFileIO
+            if not isinstance(file_io, CachingFileIO):
+                # range-only cache: whole-file capacity 0 keeps
+                # read_bytes pass-through, ranged reads (mosaic
+                # footers/blobs) hit the (path, offset, len) LRU
+                file_io = CachingFileIO(
+                    file_io, capacity_bytes=0,
+                    range_cache_bytes=self.options.get(
+                        CoreOptions.READ_CACHE_RANGE_MAX_BYTES))
+        self.file_io = file_io
         self.branch = branch if branch != "main" else self.options.branch
         self.snapshot_manager = SnapshotManager(file_io, self.path,
                                                 self.branch)
@@ -126,7 +136,8 @@ class FileStoreTable:
 
     def to_arrow(self, projection: Optional[List[str]] = None,
                  predicate: Optional[Predicate] = None,
-                 with_row_ids: bool = False) -> pa.Table:
+                 with_row_ids: bool = False,
+                 limit: Optional[int] = None) -> pa.Table:
         rb = self.new_read_builder()
         if projection:
             rb = rb.with_projection(projection)
@@ -134,6 +145,10 @@ class FileStoreTable:
             rb = rb.with_filter(predicate)
         if with_row_ids:
             rb = rb.with_row_ids()
+        if limit is not None:
+            # pushed LIMIT: the pipelined read stops admitting splits
+            # once enough rows are buffered
+            rb = rb.with_limit(limit)
         scan = rb.new_scan()
         return rb.new_read().to_arrow(scan.plan().splits)
 
@@ -801,15 +816,48 @@ class TableRead:
         t = self._read.read_split(split)
         return self._finalize(t)
 
+    def iter_splits(self, splits, *, ordered: bool = True):
+        """Yield `(index, split, finalized_table)` through the bounded
+        prefetch pipeline (parallel/scan_pipeline.py).  Accepts a
+        ScanPlan or a list of DataSplits; `ordered=False` yields splits
+        in completion order for throughput-only consumers."""
+        if isinstance(splits, ScanPlan):
+            splits = splits.splits
+        for i, s, t in self._read.iter_splits(splits, ordered=ordered):
+            # a with_limit() bound applies to the WHOLE read (to_arrow),
+            # not to each yielded split table
+            yield i, s, self._finalize(t, apply_limit=False)
+
     def to_arrow(self, splits) -> pa.Table:
         """Accepts a ScanPlan or a list of DataSplits."""
         if isinstance(splits, ScanPlan):
-            out = self._read.read_splits(splits.splits, splits.streaming)
+            split_list, streaming = splits.splits, splits.streaming
         else:
-            out = self._read.read_splits(splits)
+            split_list, streaming = list(splits), None
+        limit = self.builder._limit
+        if limit is not None and split_list:
+            # early exit: stop admitting splits once enough rows are
+            # buffered — closing the generator cancels pending prefetch
+            tables, n = [], 0
+            for _, _, t in self._read.iter_splits(split_list):
+                if t.num_rows:
+                    tables.append(t)
+                    n += t.num_rows
+                if n >= limit:
+                    break
+            if tables:
+                out = pa.concat_tables(tables,
+                                       promote_options="default")
+            else:
+                if streaming is None:
+                    streaming = any(s.for_streaming for s in split_list)
+                out = self._read.read_splits([], streaming)
+        else:
+            out = self._read.read_splits(split_list, streaming)
         return self._finalize(out)
 
-    def _finalize(self, t: pa.Table) -> pa.Table:
+    def _finalize(self, t: pa.Table,
+                  apply_limit: bool = True) -> pa.Table:
         if self.builder._projection:
             from paimon_tpu.core.read import ROW_KIND_COL
             from paimon_tpu.core.row_tracking import ROW_ID_COL
@@ -821,7 +869,7 @@ class TableRead:
                     getattr(self.builder, "_with_row_ids", False):
                 cols.append(ROW_ID_COL)
             t = t.select(cols)
-        if self.builder._limit is not None:
+        if apply_limit and self.builder._limit is not None:
             t = t.slice(0, self.builder._limit)
         return t
 

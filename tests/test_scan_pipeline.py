@@ -1,0 +1,451 @@
+"""Pipelined merge-on-read scan (parallel/scan_pipeline.py).
+
+Row-identity of the pipelined executor against the serial path across
+every merge engine, deletion vectors, schema evolution, projections and
+streaming reads; transient-fault retry semantics (503 storms retry and
+complete, exhausted storms RAISE instead of riding the corrupt-file
+skip); executor-thread hygiene + the prefetch byte budget (tier-1);
+footer/range cache behavior; the injectable expire clock.
+"""
+
+import collections
+import os
+import threading
+import time
+
+import pytest
+
+from paimon_tpu import predicate as P
+from paimon_tpu.fs import get_file_io
+from paimon_tpu.fs.object_store import TransientStoreError
+from paimon_tpu.schema import Schema, SchemaChange, SchemaManager
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType
+from tests.store_oracle import make_random_engine_table
+
+ENGINES = ["deduplicate", "first-row", "partial-update", "aggregation"]
+
+
+def _rows(table, **dyn):
+    t = table.copy(dyn) if dyn else table
+    return sorted(t.to_arrow().to_pylist(),
+                  key=lambda r: (r["pt"], r["id"]))
+
+
+def _scan_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("paimon-scan")]
+
+
+def _wait_no_scan_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while _scan_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return _scan_threads()
+
+
+class StormFileIO:
+    """Duck-typed FileIO: every data file's read_bytes 503s `per_path`
+    times before succeeding (a passing transient storm)."""
+
+    def __init__(self, inner, per_path=2):
+        self.inner = inner
+        self.per_path = per_path
+        self.counts = collections.Counter()
+        self.lock = threading.Lock()
+        self.faults = 0
+
+    def read_bytes(self, path):
+        if path.rsplit("/", 1)[-1].startswith("data-"):
+            with self.lock:
+                if self.counts[path] < self.per_path:
+                    self.counts[path] += 1
+                    self.faults += 1
+                    raise TransientStoreError(f"503 on {path}")
+        return self.inner.read_bytes(path)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+# -- row identity ------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_pipelined_equals_serial_all_engines(tmp_path, engine):
+    table = make_random_engine_table(
+        str(tmp_path / engine), seed=77, engine=engine)
+    serial = _rows(table, **{"scan.split.parallelism": "1"})
+    piped = _rows(table, **{"scan.split.parallelism": "4",
+                            "read.prefetch.splits": "3"})
+    assert piped == serial and len(serial) > 0
+
+
+def test_pipelined_equals_serial_projection_and_predicate(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=5,
+                                     engine="deduplicate")
+
+    def read(par):
+        rb = table.copy({"scan.split.parallelism": par}) \
+            .new_read_builder() \
+            .with_projection(["pt", "id", "name"]) \
+            .with_filter(P.greater_than("id", 30))
+        t = rb.new_read().to_arrow(rb.new_scan().plan())
+        assert t.column_names == ["pt", "id", "name"]
+        return sorted(t.to_pylist(), key=lambda r: (r["pt"], r["id"]))
+
+    assert read("4") == read("1")
+
+
+def test_pipelined_equals_serial_schema_evolution(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=9,
+                                     engine="deduplicate", commits=2)
+    sm = SchemaManager(table.file_io, table.path)
+    sm.commit_changes(SchemaChange.add_column("extra", IntType()))
+    table = FileStoreTable.load(table.path, table.file_io)
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"pt": 0, "id": i, "v1": i, "v2": 1.0,
+                    "name": "n", "extra": i * 2} for i in range(40)])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+    serial = _rows(table, **{"scan.split.parallelism": "1"})
+    piped = _rows(table, **{"scan.split.parallelism": "4"})
+    assert piped == serial
+    assert any(r["extra"] is not None for r in serial)
+    assert any(r["extra"] is None for r in serial)   # evolved old files
+
+
+def test_pipelined_equals_serial_deletion_vectors(tmp_path):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .options({"bucket": "-1"})
+              .build())
+    table = FileStoreTable.create(str(tmp_path / "t"), schema)
+    for c in range(4):
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write_dicts([{"id": c * 100 + i, "v": float(i)}
+                       for i in range(50)])
+        wb.new_commit().commit(w.prepare_commit())
+        w.close()
+    assert table.delete_where(P.less_than("id", 120)) is not None
+    serial = table.copy({"scan.split.parallelism": "1"}).to_arrow()
+    piped = table.copy({"scan.split.parallelism": "4"}).to_arrow()
+    assert piped.sort_by("id").equals(serial.sort_by("id"))
+    assert serial.num_rows == 200 - 70   # 50 + 20 rows DV-deleted
+
+
+def test_pipelined_equals_serial_streaming(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=21,
+                                     engine="deduplicate", commits=4)
+    serial_rb = table.copy({"scan.split.parallelism": "1",
+                            "scan.mode": "from-snapshot-full",
+                            "scan.snapshot-id": "1"}).new_read_builder()
+    piped_rb = table.copy({"scan.split.parallelism": "4",
+                           "scan.mode": "from-snapshot-full",
+                           "scan.snapshot-id": "1"}).new_read_builder()
+    scan = serial_rb.new_stream_scan()
+    plans = 0
+    while True:
+        plan = scan.plan()
+        if plan is None:
+            break
+        plans += 1
+        a = serial_rb.new_read().to_arrow(plan)
+        b = piped_rb.new_read().to_arrow(plan)
+        assert "_ROW_KIND" in a.column_names
+        assert b.sort_by([("pt", "ascending"), ("id", "ascending")]) \
+            .equals(a.sort_by([("pt", "ascending"), ("id", "ascending")]))
+    assert plans >= 2
+
+
+def test_iter_splits_unordered_covers_all_splits(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=31,
+                                     engine="deduplicate")
+    rb = table.copy({"scan.split.parallelism": "4"}).new_read_builder()
+    plan = rb.new_scan().plan()
+    seen = sorted(i for i, _, _ in
+                  rb.new_read().iter_splits(plan, ordered=False))
+    assert seen == list(range(len(plan.splits)))
+
+
+def test_limit_early_exit(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=41,
+                                     engine="deduplicate")
+    full = table.to_arrow()
+    limited = table.to_arrow(limit=7)
+    assert limited.num_rows == 7
+    assert limited.column_names == full.column_names
+
+
+# -- fault semantics ---------------------------------------------------------
+
+def test_mid_scan_503_storm_retries_and_completes(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=3,
+                                     engine="deduplicate")
+    expect = _rows(table)
+    storm = StormFileIO(get_file_io(table.path), per_path=2)
+    stormy = FileStoreTable.load(
+        table.path, file_io=storm,
+        dynamic_options={"read.retry.backoff": "0",
+                         "scan.split.parallelism": "4"})
+    assert _rows(stormy) == expect
+    assert storm.faults > 0
+
+
+def test_exhausted_transient_storm_raises_not_skipped(tmp_path):
+    """A transient fault that outlives read.retry.max-attempts must
+    RAISE even under scan.ignore-corrupt-files — mislabeling a 503 as
+    corruption would silently drop rows."""
+    table = make_random_engine_table(str(tmp_path / "t"), seed=3,
+                                     engine="deduplicate")
+    storm = StormFileIO(get_file_io(table.path), per_path=10 ** 9)
+    stormy = FileStoreTable.load(
+        table.path, file_io=storm,
+        dynamic_options={"read.retry.backoff": "0",
+                         "read.retry.max-attempts": "2",
+                         "scan.ignore-corrupt-files": "true",
+                         "scan.split.parallelism": "4"})
+    with pytest.raises(TransientStoreError):
+        stormy.to_arrow()
+    assert not _wait_no_scan_threads(), "leaked scan threads after raise"
+
+
+def test_decode_errors_are_not_transient():
+    """Modern pyarrow raises plain OSError for corrupt compressed
+    pages; the format readers re-tag decode-phase failures as
+    CorruptDataError so the taxonomy keeps them in the corrupt-file
+    class (skippable), never the retry class."""
+    import pyarrow as pa
+
+    from paimon_tpu.format.format import CorruptDataError
+    from paimon_tpu.parallel.fault import is_transient_error
+    assert not is_transient_error(CorruptDataError("corrupt page"))
+    assert not is_transient_error(pa.ArrowInvalid("bad magic"))
+    assert is_transient_error(OSError("io fault"))
+    assert is_transient_error(TransientStoreError("503"))
+
+
+def test_corrupt_page_with_valid_footer_skipped_when_opted_in(tmp_path):
+    """The OSError corruption flavor end-to-end: valid parquet footer,
+    garbled page bytes (zstd decode fails deterministically) — must
+    take the ignore-corrupt-files skip, not the transient retry."""
+    table = make_random_engine_table(str(tmp_path / "t"), seed=17,
+                                     engine="deduplicate", buckets=1)
+    split = table.new_read_builder().new_scan().plan().splits[0]
+    io_ = get_file_io(table.path)
+    path = f"{table.path}/bucket-0/{split.data_files[0].file_name}"
+    raw = bytearray(io_.read_bytes(path))
+    mid = len(raw) // 3
+    for i in range(mid, min(mid + 400, len(raw) - 100)):
+        raw[i] ^= 0xA5
+    io_.delete(path)
+    io_.write_bytes(path, bytes(raw))
+    from paimon_tpu.fs.caching import global_footer_cache
+    global_footer_cache().clear()    # footer was cached pre-corruption
+    with pytest.raises(Exception):
+        table.copy({"scan.split.parallelism": "4"}).to_arrow()
+    lenient = table.copy({"scan.split.parallelism": "4",
+                          "read.retry.backoff": "0",
+                          "scan.ignore-corrupt-files": "true"})
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        lenient.to_arrow()
+
+
+def test_corrupt_file_still_skipped_when_opted_in(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=13,
+                                     engine="deduplicate", buckets=1)
+    split = table.new_read_builder().new_scan().plan().splits[0]
+    path = f"{table.path}/bucket-0/{split.data_files[0].file_name}"
+    get_file_io(table.path).write_bytes(path, b"not parquet at all")
+    with pytest.raises(Exception):
+        table.copy({"scan.split.parallelism": "4"}).to_arrow()
+    lenient = table.copy({"scan.split.parallelism": "4",
+                          "scan.ignore-corrupt-files": "true"})
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        out = lenient.to_arrow()
+    assert out.num_rows > 0
+
+
+def test_missing_file_not_retried_and_skippable(tmp_path):
+    """A planned-then-deleted file (racing expiry/orphan clean) cannot
+    reappear: it must NOT burn retry backoff, and it stays in the
+    skip-eligible class like before the pipeline."""
+    from paimon_tpu.metrics import (
+        SCAN_READ_RETRIES, global_registry,
+    )
+    table = make_random_engine_table(str(tmp_path / "t"), seed=19,
+                                     engine="deduplicate", buckets=1)
+    split = table.new_read_builder().new_scan().plan().splits[0]
+    path = f"{table.path}/bucket-0/{split.data_files[0].file_name}"
+    get_file_io(table.path).delete(path)
+    retries0 = global_registry().scan_metrics() \
+        .counter(SCAN_READ_RETRIES).count
+    with pytest.raises(FileNotFoundError):
+        table.copy({"scan.split.parallelism": "4"}).to_arrow()
+    lenient = table.copy({"scan.split.parallelism": "4",
+                          "scan.ignore-corrupt-files": "true"})
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        lenient.to_arrow()
+    assert global_registry().scan_metrics() \
+        .counter(SCAN_READ_RETRIES).count == retries0
+
+
+def test_fsck_deep_bypasses_footer_cache(tmp_path):
+    """--deep verification must reparse the ON-DISK footer: a footer
+    torn after a scan warmed the process footer cache is still
+    reported corrupt."""
+    from paimon_tpu.maintenance.fsck import ViolationKind
+    table = make_random_engine_table(str(tmp_path / "t"), seed=23,
+                                     engine="deduplicate", buckets=1,
+                                     commits=1)
+    assert table.fsck(deep=True).ok
+    table.to_arrow()                          # warm the footer cache
+    split = table.new_read_builder().new_scan().plan().splits[0]
+    io_ = get_file_io(table.path)
+    path = f"{table.path}/bucket-0/{split.data_files[0].file_name}"
+    raw = io_.read_bytes(path)
+    io_.delete(path)
+    io_.write_bytes(path, raw[: len(raw) // 2] + raw[-4:])   # torn
+    report = table.fsck(deep=True)
+    assert ViolationKind.CORRUPT_DATA_FILE in report.kinds()
+
+
+# -- tier-1 hygiene: threads + byte budget -----------------------------------
+
+def test_no_leaked_threads_after_read_and_after_abandon(tmp_path):
+    table = make_random_engine_table(str(tmp_path / "t"), seed=1,
+                                     engine="deduplicate")
+    piped = table.copy({"scan.split.parallelism": "4"})
+    piped.to_arrow()
+    assert not _wait_no_scan_threads(), "leaked threads after read"
+    rb = piped.new_read_builder()
+    plan = rb.new_scan().plan()
+    gen = rb.new_read().iter_splits(plan)
+    next(gen)
+    gen.close()                       # consumer abandons mid-scan
+    assert not _wait_no_scan_threads(), "leaked threads after abandon"
+
+
+def test_prefetch_byte_budget_respected(tmp_path):
+    from paimon_tpu.parallel.scan_pipeline import iter_split_tables
+    table = make_random_engine_table(str(tmp_path / "t"), seed=7,
+                                     engine="deduplicate")
+    rb = table.new_read_builder()
+    splits = rb.new_scan().plan().splits
+    assert len(splits) >= 2
+    biggest = max(sum(f.file_size for f in s.data_files)
+                  for s in splits)
+    opts = table.copy({"scan.split.parallelism": "4",
+                       "read.prefetch.max-bytes": "1"}).options
+    stats = {}
+    read = rb.new_read()._read
+    out = list(iter_split_tables(read, splits, opts, stats=stats))
+    assert len(out) == len(splits)
+    # a 1-byte budget degenerates to exactly one split in flight
+    assert stats["max_inflight_splits"] == 1
+    assert stats["peak_inflight_bytes"] <= biggest
+    # an ample budget actually pipelines
+    stats2 = {}
+    ample = table.copy({"scan.split.parallelism": "4"}).options
+    list(iter_split_tables(read, splits, ample, stats=stats2))
+    assert stats2["max_inflight_splits"] > 1
+
+
+# -- caches ------------------------------------------------------------------
+
+def test_footer_cache_hits_on_rescan_and_option_gates(tmp_path):
+    from paimon_tpu.fs.caching import global_footer_cache
+    cache = global_footer_cache()
+    table = make_random_engine_table(str(tmp_path / "t"), seed=2,
+                                     engine="deduplicate", buckets=2)
+    cache.clear()
+    h0 = cache.hits
+    table.to_arrow()
+    assert cache.hits == h0          # cold scan: misses only
+    assert len(cache) > 0
+    table.to_arrow()
+    assert cache.hits > h0           # re-scan served from the cache
+    # read.cache.footer=false neither reads nor populates
+    cache.clear()
+    off = table.copy({"read.cache.footer": "false"})
+    h1, m1 = cache.hits, cache.misses
+    off.to_arrow()
+    assert len(cache) == 0 and (cache.hits, cache.misses) == (h1, m1)
+
+
+def test_range_cache_serves_repeats_and_evicts_on_write(tmp_path):
+    from paimon_tpu.fs.caching import CachingFileIO
+    inner = get_file_io(str(tmp_path))
+    path = str(tmp_path / "data-abc.bin")
+    inner.write_bytes(path, bytes(range(200)))
+    cached = CachingFileIO(inner, capacity_bytes=0,
+                           range_cache_bytes=1 << 20)
+    assert cached.read_range(path, 10, 5) == bytes(range(10, 15))
+    assert cached.range_hits == 0
+    assert cached.read_range(path, 10, 5) == bytes(range(10, 15))
+    assert cached.range_hits == 1
+    a, b = cached.read_ranges(path, [(10, 5), (50, 3)])
+    assert (a, b) == (bytes(range(10, 15)), bytes(range(50, 53)))
+    assert cached.range_hits == 2    # first range from cache
+    cached.write_bytes(path, b"xx")  # mutation evicts
+    assert cached.read_range(path, 0, 2) == b"xx"
+    assert cached.range_hits == 2
+
+
+def test_read_cache_range_option_wraps_table_fileio(tmp_path):
+    from paimon_tpu.fs.caching import CachingFileIO
+    table = make_random_engine_table(str(tmp_path / "t"), seed=2,
+                                     engine="deduplicate", commits=1)
+    wrapped = table.copy({"read.cache.range": "true"})
+    assert isinstance(wrapped.file_io, CachingFileIO)
+    assert wrapped.file_io.range_capacity > 0
+    assert _rows(wrapped) == _rows(table)
+    # already-wrapped FileIO is not double-wrapped
+    again = FileStoreTable(wrapped.file_io, wrapped.path, wrapped.schema,
+                           {"read.cache.range": "true"})
+    assert again.file_io is wrapped.file_io
+
+
+# -- query service /scan -----------------------------------------------------
+
+def test_query_service_scan_endpoint(tmp_path):
+    from paimon_tpu.service.query_service import (
+        KvQueryClient, KvQueryServer,
+    )
+    table = make_random_engine_table(str(tmp_path / "t"), seed=11,
+                                     engine="deduplicate", buckets=2)
+    server = KvQueryServer(table).start()
+    try:
+        client = KvQueryClient(table)
+        rows = client.scan(limit=9)
+        assert len(rows) == 9
+        rows = client.scan(projection=["pt", "id"], limit=5)
+        assert len(rows) == 5 and set(rows[0]) == {"pt", "id"}
+        assert client.scan(limit=0) == []
+        # server-side errors carry the server's message, not a bare 500
+        with pytest.raises(RuntimeError, match="scan failed"):
+            client.scan(projection=123)
+    finally:
+        server.stop()
+
+
+# -- injectable expire clock -------------------------------------------------
+
+def test_record_level_expire_filter_now_ms_injectable():
+    import pyarrow as pa
+
+    from paimon_tpu.core.read import record_level_expire_filter
+    from paimon_tpu.options import CoreOptions, Options
+    opts = CoreOptions(Options({"record-level.expire-time": "1 s",
+                                "record-level.time-field": "ts"}))
+    table = pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                      "ts": pa.array([100, 200, None], pa.int32())})
+    # ts is seconds; now=201s -> cutoff 200s: row 1 expired, row 2
+    # kept (>= cutoff), null always kept
+    out = record_level_expire_filter(opts, table, now_ms=201_000)
+    assert out.column("id").to_pylist() == [2, 3]
+    # same call, clock pinned earlier -> nothing expired yet
+    out2 = record_level_expire_filter(opts, table, now_ms=100_500)
+    assert out2.column("id").to_pylist() == [1, 2, 3]
