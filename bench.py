@@ -422,6 +422,95 @@ def scan_child_main():
     print(json.dumps(out))
 
 
+def write_child_main():
+    """BENCH_WRITE_CHILD=1 mode: the write/ingest benchmark (pipelined
+    flush pool vs serial single-thread baseline — ISSUE 4's hot path).
+    Generates a fixed-seed batch stream at BENCH_WRITE_ROWS, ingests it
+    into an 8-bucket pk table both ways (serial pins Arrow to 1
+    thread), verifies the two tables scan row-identically, and prints
+    one JSON line for the parent."""
+    import shutil
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks.scan_bench import _single_thread
+    from benchmarks.write_bench import build_batches, ingest
+
+    rows = int(os.environ["BENCH_WRITE_ROWS"])
+    pool = int(os.environ.get("BENCH_WRITE_POOL", "8"))
+    out = {"rows": rows, "pool": pool}
+    batches = build_batches(rows)
+
+    # fixed best-of timing like the scan child: _best's 10ms auto-scale
+    # is unbounded wall time at 10M rows under the parent's budget
+    def timed(tmp, par, reps=2, keep=False):
+        best = float("inf")
+        path = None
+        for i in range(reps):
+            if path is not None and not keep:
+                shutil.rmtree(path, ignore_errors=True)
+            path = os.path.join(tmp, f"t{par}_{i}")
+            t0 = time.perf_counter()
+            ingest(path, batches, par)
+            best = min(best, time.perf_counter() - t0)
+        return best, path
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with _single_thread():
+            out["dt_serial"], serial_path = timed(tmp, 1)
+        out["dt_pipelined"], piped_path = timed(tmp, pool)
+        from paimon_tpu.table import FileStoreTable
+        a = FileStoreTable.load(serial_path).to_arrow().sort_by("id")
+        b = FileStoreTable.load(piped_path).to_arrow().sort_by("id")
+        out["identical"] = bool(a.equals(b))
+    print(json.dumps(out))
+
+
+def run_write_child(rows, timeout):
+    """Run write_child_main in a CPU subprocess; parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(BENCH_WRITE_CHILD="1", BENCH_WRITE_ROWS=str(rows),
+               JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=_REPO, text=True,
+                              capture_output=True,
+                              timeout=max(30.0, timeout))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench write child ({rows} rows): timeout\n")
+        return None
+    if proc.returncode != 0:
+        sys.stderr.write(f"bench write child rc={proc.returncode}:\n"
+                         f"{proc.stderr[-4000:]}\n")
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sys.stderr.write(f"bench write child: unparseable output\n"
+                         f"{proc.stdout[-2000:]}\n")
+        return None
+
+
+def compose_write(result):
+    """The write-path metric block attached under "write_ingest" in the
+    one official JSON line (trajectory metric for the ingest path,
+    alongside the compaction headline and the scan block)."""
+    if result is None:
+        return None
+    ours = result["rows"] / result["dt_pipelined"]
+    serial = result["rows"] / result["dt_serial"]
+    return {
+        "metric": "write_ingest_rows_per_sec",
+        "value": round(ours, 1),
+        "unit": (f"rows/s ({result['rows']} rows, 8 buckets, dedup pk, "
+                 f"parquet, {result['pool']}-way pipelined flush vs "
+                 f"serial-1T {round(serial, 1)} rows/s, "
+                 f"identical={result['identical']})"),
+        "vs_serial": round(result["dt_serial"] / result["dt_pipelined"],
+                           3),
+    }
+
+
 def run_scan_child(rows, timeout):
     """Run scan_child_main in a CPU subprocess; parsed JSON or None."""
     env = dict(os.environ)
@@ -696,6 +785,25 @@ def main():
             _BANKED["json"] = final
         sys.stderr.write(f"bench: scan metric {scan}, "
                          f"remaining {_remaining():.0f}s\n")
+
+    # write-ingest metric (ISSUE 4's hot path): same incremental-bank
+    # discipline — measured in-env the whole 10M child (batch gen + 3
+    # serial + 3 pipelined ingests + identity scan) is ~100s wall
+    write_rows = None
+    if _remaining() > 200:
+        write_rows = 10_000_000
+    elif _remaining() > 100:
+        write_rows = 4_000_000
+    elif _remaining() > 50:
+        write_rows = 1_000_000
+    if write_rows:
+        wr = compose_write(
+            run_write_child(write_rows, timeout=_remaining() - 30))
+        if wr is not None:
+            final["write_ingest"] = wr
+            _BANKED["json"] = final
+        sys.stderr.write(f"bench: write metric {wr}, "
+                         f"remaining {_remaining():.0f}s\n")
     _emit_and_exit()
 
 
@@ -708,6 +816,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if os.environ.get("BENCH_SCAN_CHILD") == "1":
         scan_child_main()
+        sys.exit(0)
+    if os.environ.get("BENCH_WRITE_CHILD") == "1":
+        write_child_main()
         sys.exit(0)
     try:
         main()

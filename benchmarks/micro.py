@@ -124,7 +124,11 @@ def bench_read(fmt: str):
 
 
 def bench_write(fmt: str = "parquet"):
-    """reference TableWriterBenchmark.java (write + commit loop)."""
+    """reference TableWriterBenchmark.java (write + commit loop), plus
+    the pipelined-vs-serial ingest comparison (full matrix in
+    benchmarks/write_bench.py; this keeps the write trajectory in
+    every micro run, auto-scaled to >=10ms best-times like the scan
+    entry)."""
     data = _data(ROWS)
     from paimon_tpu.table import FileStoreTable
 
@@ -133,12 +137,13 @@ def bench_write(fmt: str = "parquet"):
             table = FileStoreTable.create(os.path.join(tmp, "t"),
                                           _schema(fmt))
             wb = table.new_batch_write_builder()
-            w = wb.new_write()
-            w.write_arrow(data)
-            wb.new_commit().commit(w.prepare_commit())
-            w.close()
+            with wb.new_write() as w:
+                w.write_arrow(data)
+                wb.new_commit().commit(w.prepare_commit())
 
     _emit(f"table_write_{fmt}", ROWS, _best(run))
+    from benchmarks.write_bench import measure_ingest
+    measure_ingest()
 
 
 def bench_lookup():

@@ -191,7 +191,19 @@ class CdcSinkWriter:
         msgs = list(self._pending_msgs)
         self._pending_msgs = []
         if self._writer is not None:
-            msgs.extend(self._writer.prepare_commit())
+            try:
+                msgs.extend(self._writer.prepare_commit())
+            except Exception:
+                # the pipelined flush pool latched a worker error: shut
+                # the writer down (joining its pool) before re-raising
+                # so a retried checkpoint starts from a clean writer —
+                # and RESTORE the staged pre-evolution messages, whose
+                # files are already uploaded and must not be lost when
+                # the retried checkpoint commits
+                self._pending_msgs = msgs
+                self._writer.close()
+                self._writer = None
+                raise
         if not commit.filter_committed([commit_identifier]):
             return None          # replayed checkpoint: exactly-once
         return commit.commit(msgs, commit_identifier=commit_identifier)

@@ -185,10 +185,9 @@ def cmd_table(args):
             pa.schema([schema.field(c) for c in data.column_names
                        if c in schema.names]))
         wb = table.new_batch_write_builder()
-        w = wb.new_write()
-        w.write_arrow(data)
-        wb.new_commit().commit(w.prepare_commit())
-        w.close()
+        with wb.new_write() as w:
+            w.write_arrow(data)
+            wb.new_commit().commit(w.prepare_commit())
         print(f"{data.num_rows} rows imported")
     elif cmd == "set-option":
         from paimon_tpu.catalog.catalog import Identifier

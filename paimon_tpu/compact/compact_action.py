@@ -146,13 +146,6 @@ def rescale_postpone(table) -> Optional[int]:
     """Redistribute bucket-postpone staging data into real (dynamic)
     buckets (reference postpone/PostponeBucketFileStoreWrite + the
     rescale job). Returns the snapshot id or None when nothing staged."""
-    import numpy as np
-    import pyarrow as pa
-
-    from paimon_tpu.core.kv_file import read_kv_file
-    from paimon_tpu.core.read import evolve_table
-    from paimon_tpu.ops.merge import KIND_COL, SEQ_COL
-
     scan = table.new_scan().with_buckets([-2])
     snapshot = table.snapshot_manager.latest_snapshot()
     if snapshot is None:
@@ -180,6 +173,23 @@ def rescale_postpone(table) -> Optional[int]:
     write_table = table.copy(overrides)
     wb = write_table.new_batch_write_builder()
     writer = wb.new_write(apply_defaults=False)
+    try:
+        return _rescale_with_writer(table, scan, writer, entries)
+    finally:
+        writer.close()
+
+
+def _rescale_with_writer(table, scan, writer, entries):
+    """The rescale body, writer-lifetime-managed by rescale_postpone's
+    try/finally: a prepare_commit() raise (pipelined flush barrier)
+    must still join the writer's pool."""
+    import numpy as np
+    import pyarrow as pa
+
+    from paimon_tpu.core.kv_file import read_kv_file
+    from paimon_tpu.core.read import evolve_table
+    from paimon_tpu.ops.merge import KIND_COL, SEQ_COL
+
     cache = {table.schema.id: table.schema}
     value_cols = [f.name for f in table.schema.fields]
     by_part: Dict[bytes, list] = {}
@@ -216,7 +226,6 @@ def rescale_postpone(table) -> Optional[int]:
         m.compact_after = m.new_files
         m.new_files = []
         messages.append(m)
-    writer.close()
     index_entries = [e for m in messages for e in m.index_entries]
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)

@@ -76,7 +76,8 @@ def _prefetch(it, depth: int = 2):
             if not cancelled.is_set():
                 q.put(("__prefetch_error__", e))
 
-    _threading.Thread(target=pump, daemon=True).start()
+    from paimon_tpu.parallel.executors import spawn_thread
+    spawn_thread(pump, name="paimon-prefetch-pump")
     try:
         while True:
             item = q.get()

@@ -127,6 +127,15 @@ class AppendFileWriter:
 
 
 class _AppendBucketWriter:
+    """Buffered state for one (partition, bucket) of an append table.
+
+    Same concurrency contract as the pk `_BucketWriter`
+    (parallel/write_pipeline.py): sequence ranges are reserved at
+    flush-*scheduling* time on the single-threaded caller, the
+    encode/upload body runs as a pooled task, and tasks for this bucket
+    execute in submission order so `new_files` publishes
+    deterministically."""
+
     def __init__(self, parent: "AppendOnlyFileStoreWrite", partition: Tuple,
                  bucket: int):
         self.parent = parent
@@ -136,6 +145,9 @@ class _AppendBucketWriter:
         self.buffered_bytes = 0
         self.next_seq: Optional[int] = None
         self.new_files: List[DataFileMeta] = []
+
+    def pending_bytes(self) -> int:
+        return self.buffered_bytes
 
     def write(self, table: pa.Table):
         self.buffers.append(table)
@@ -148,17 +160,27 @@ class _AppendBucketWriter:
             return
         raw = pa.concat_tables(self.buffers, promote_options="none")
         self.buffers = []
+        est = self.buffered_bytes
         self.buffered_bytes = 0
         if self.next_seq is None:
             self.next_seq = self.parent.restore_max_seq(
                 self.partition, self.bucket) + 1
-        metas = self.parent.file_writer.write(
-            self.partition, self.bucket, raw, self.next_seq)
+        # the sequence range is reserved HERE (caller thread), so
+        # pipelined flushes can never duplicate or reorder ranges
+        first_seq = self.next_seq
         self.next_seq += raw.num_rows
-        self.new_files.extend(metas)
 
-    def prepare_commit(self) -> Optional[CommitMessage]:
-        self.flush()
+        def task(raw=raw, first_seq=first_seq):
+            metas = self.parent.file_writer.write(
+                self.partition, self.bucket, raw, first_seq)
+            # publish after the upload succeeded (retry-safe: retried
+            # attempts pick fresh file names)
+            self.new_files.extend(metas)
+
+        self.parent.flush_pool().submit((self.partition, self.bucket),
+                                        est, task)
+
+    def take_commit_message(self) -> Optional[CommitMessage]:
         msg = CommitMessage(self.partition, self.bucket,
                             self.parent.total_buckets,
                             new_files=list(self.new_files))
@@ -203,7 +225,16 @@ class AppendOnlyFileStoreWrite:
                 bucket_keys, [rt.get_field(k).type for k in bucket_keys],
                 options.bucket)
         self._writers: Dict[Tuple, _AppendBucketWriter] = {}
+        self._flush_pool = None       # lazily built (write_pipeline)
         self._restore_max_seq = restore_max_seq
+
+    def flush_pool(self):
+        """The shared bucket-flush executor (parallel/write_pipeline.py);
+        write.flush.parallelism=1 degrades it to the inline serial path."""
+        if self._flush_pool is None:
+            from paimon_tpu.parallel.write_pipeline import FlushPool
+            self._flush_pool = FlushPool.from_options(self.options)
+        return self._flush_pool
 
     def restore_max_seq(self, partition: Tuple, bucket: int) -> int:
         if self._restore_max_seq is None:
@@ -225,8 +256,10 @@ class AppendOnlyFileStoreWrite:
             buckets = np.zeros(table.num_rows, dtype=np.int32)
         else:
             buckets = self.bucket_assigner.assign(table)
-        for (part, bucket), idx in group_by_partition_bucket(
-                table, buckets, self.partition_keys):
+        from paimon_tpu.parallel.write_pipeline import lpt_order
+        groups = group_by_partition_bucket(table, buckets,
+                                           self.partition_keys)
+        for (part, bucket), idx in lpt_order(groups):
             sub = table.take(pa.array(idx))
             key = (part, bucket)
             if key not in self._writers:
@@ -234,14 +267,23 @@ class AppendOnlyFileStoreWrite:
             self._writers[key].write(sub)
 
     def prepare_commit(self) -> List[CommitMessage]:
+        # barrier: schedule the final flushes largest-first, drain the
+        # pool (first worker error re-raises), then assemble messages
+        for w in sorted(self._writers.values(),
+                        key=lambda w: -w.pending_bytes()):
+            w.flush()
+        self.flush_pool().drain()
         out = []
         for w in self._writers.values():
-            msg = w.prepare_commit()
+            msg = w.take_commit_message()
             if msg is not None:
                 out.append(msg)
         return out
 
     def close(self):
+        if self._flush_pool is not None:
+            self._flush_pool.shutdown(wait=True)
+            self._flush_pool = None
         self._writers.clear()
 
 

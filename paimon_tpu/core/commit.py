@@ -276,6 +276,22 @@ class FileStoreCommit:
             if check_deleted_files and latest is not None:
                 self._assert_files_exist(latest, entries)
 
+            if new_manifest is None and entries and \
+                    changelog_manifest is None and changelog_entries:
+                # both manifests are needed and independent: encode +
+                # upload the delta manifest on a worker while the
+                # changelog manifest encodes here, so commit prep waits
+                # on completion, not initiation (write-pipeline PR)
+                from paimon_tpu.parallel.executors import new_thread_pool
+                pool = new_thread_pool(1, "paimon-commit")
+                try:
+                    fut = pool.submit(self.manifest_file.write,
+                                      entries, schema_id=self.schema.id)
+                    changelog_manifest = self.manifest_file.write(
+                        changelog_entries, schema_id=self.schema.id)
+                    new_manifest = fut.result()
+                finally:
+                    pool.shutdown(wait=True)
             if new_manifest is None and entries:
                 new_manifest = self.manifest_file.write(
                     entries, schema_id=self.schema.id)

@@ -14,6 +14,7 @@ in one shot and written columnar.
 from __future__ import annotations
 
 import os
+from collections import deque
 from dataclasses import dataclass, field as dc_field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -103,6 +104,17 @@ def build_kv_table(raw: pa.Table, schema: TableSchema,
 
 
 class _BucketWriter:
+    """One (partition, bucket)'s buffered state.
+
+    Concurrency contract (parallel/write_pipeline.py): `write`,
+    `_spill` and the flush *scheduling* run on the caller thread —
+    sequence ranges are reserved at write() time, single-threaded, so
+    pipelined flushes can never duplicate or reorder them.  The
+    sort/encode/upload bodies run as FlushPool tasks; tasks for this
+    bucket execute strictly in submission order (per-key actor), so
+    `new_files`/`changelog_files`/`spills` are only ever touched by one
+    task at a time and publish deterministically."""
+
     def __init__(self, parent: "KeyValueFileStoreWrite", partition: Tuple,
                  bucket: int):
         self.parent = parent
@@ -110,6 +122,7 @@ class _BucketWriter:
         self.bucket = bucket
         self.buffers: List[pa.Table] = []
         self.kind_buffers: List[np.ndarray] = []
+        self.seq_buffers: List[np.ndarray] = []   # reserved at write()
         self.buffered_bytes = 0
         self.next_seq: Optional[int] = None   # lazily restored
         self.new_files: List[DataFileMeta] = []
@@ -117,10 +130,29 @@ class _BucketWriter:
         self.spills: List[str] = []           # key-sorted local runs
         self._spill_dir: Optional[str] = None
         self._spill_bytes = 0                 # on-disk spill footprint
+        self._spills_scheduled = 0            # caller-side (see _spill)
+        self._spill_seq = 0                   # monotonic name counter:
+        # names derived from len(spills)/listdir counts can REPEAT
+        # after a fold shrinks both, truncating a live run (actor-
+        # serialized, so a plain int is safe)
+        self._spill_sched_bytes = 0           # scheduled-not-yet-written
+        # spill payload bytes: the disk-budget check must see queued
+        # spills too, or async workers let /tmp overshoot the cap
+
+    @property
+    def _key(self) -> Tuple:
+        return (self.partition, self.bucket)
+
+    def pending_bytes(self) -> int:
+        """Flush-cost estimate for LPT scheduling (buffered + spilled)."""
+        return self.buffered_bytes + self._spill_bytes
 
     def write(self, table: pa.Table, kinds: np.ndarray):
         self.buffers.append(table)
         self.kind_buffers.append(kinds)
+        # sequence numbers are reserved HERE, on the single-threaded
+        # caller, never inside a pooled flush task
+        self.seq_buffers.append(self._assign_seq(table.num_rows))
         self.buffered_bytes += table.nbytes
         opts = self.parent.options
         if self.parent.spillable:
@@ -129,8 +161,12 @@ class _BucketWriter:
             threshold = min(opts.write_buffer_size,
                             opts.get(CoreOptions.SORT_SPILL_BUFFER_SIZE))
             if self.buffered_bytes >= threshold:
-                if self._spill_bytes >= opts.get(
-                        CoreOptions.WRITE_BUFFER_SPILL_MAX_DISK_SIZE):
+                # queued-but-unwritten spill payloads count toward the
+                # disk budget (their on-disk size is at most the in-RAM
+                # estimate), else async workers let /tmp overshoot it
+                if self._spill_bytes + self._spill_sched_bytes >= \
+                        opts.get(
+                            CoreOptions.WRITE_BUFFER_SPILL_MAX_DISK_SIZE):
                     # disk budget exhausted: flush to L0 instead of
                     # spilling further (reference MaxDiskSize cap)
                     self.flush()
@@ -160,18 +196,30 @@ class _BucketWriter:
         self.next_seq = start + n
         return np.arange(start, start + n, dtype=np.int64)
 
-    def _sorted_chunk(self) -> Optional[pa.Table]:
-        """Drain the in-RAM buffer into one key-sorted KV chunk (the
-        changelog-producer=input file for the chunk is written here, in
-        arrival order)."""
+    def _snapshot(self):
+        """Detach the in-RAM buffer into an immutable flush payload
+        (caller thread): (raw, kinds, seq) or None.  `pa.concat_tables`
+        is zero-copy, so the snapshot is cheap; the expensive
+        sort/encode happens in the pooled task that receives it."""
         if not self.buffers:
             return None
         raw = pa.concat_tables(self.buffers, promote_options="none")
         kinds = np.concatenate(self.kind_buffers)
-        self.buffers, self.kind_buffers = [], []
+        seq = np.concatenate(self.seq_buffers)
+        self.buffers, self.kind_buffers, self.seq_buffers = [], [], []
         self.buffered_bytes = 0
-        n = raw.num_rows
-        seq = self._assign_seq(n)
+        return raw, kinds, seq
+
+    def _sorted_chunk(self, snap) -> Tuple[Optional[pa.Table],
+                                           List[DataFileMeta]]:
+        """Sort/merge one flush payload into a key-sorted KV chunk and
+        write its changelog-producer=input file (arrival order).
+        Worker-side and retry-safe: nothing on `self` is mutated —
+        returns (sorted_kv, changelog_metas) for the caller to publish
+        after the whole task succeeded."""
+        if snap is None:
+            return None, []
+        raw, kinds, seq = snap
 
         schema = self.parent.schema
         kv = build_kv_table(raw, schema, seq, kinds)
@@ -191,20 +239,32 @@ class _BucketWriter:
                                key_encoder=self.parent.key_encoder)
             sorted_kv = kv.take(pa.array(order))
 
+        changelog: List[DataFileMeta] = []
         if self.parent.changelog_input:
             # changelog-producer=input: raw rows in arrival order
             cl = build_kv_table(raw, schema, seq, kinds)
-            self.changelog_files.extend(
-                self.parent.write_changelog(self.partition, self.bucket, cl))
-        return sorted_kv
+            changelog = self.parent.write_changelog(
+                self.partition, self.bucket, cl)
+        return sorted_kv, changelog
 
     def flush(self):
-        sorted_kv = self._sorted_chunk()
-        if sorted_kv is None:
+        """Snapshot the buffer (caller thread) and hand the
+        sort/encode/upload to the flush pool; bucket k+1's hashing and
+        buffering proceed while this bucket encodes and uploads."""
+        snap = self._snapshot()
+        if snap is None:
             return
-        metas = self.parent.kv_writer.write(self.partition, self.bucket,
-                                            sorted_kv, level=0)
-        self.new_files.extend(metas)
+
+        def task(snap=snap):
+            sorted_kv, changelog = self._sorted_chunk(snap)
+            metas = self.parent.kv_writer.write(
+                self.partition, self.bucket, sorted_kv, level=0)
+            # publish only after the upload succeeded: a retried
+            # attempt rewrites under fresh names, never double-counts
+            self.new_files.extend(metas)
+            self.changelog_files.extend(changelog)
+
+        self.parent.flush_pool().submit(self._key, snap[0].nbytes, task)
 
     # -- spillable buffer (reference SortBufferWriteBuffer:59 spill via
     # MergeSorter/BinaryExternalSortBuffer: full buffers become local
@@ -227,8 +287,8 @@ class _BucketWriter:
         if self._spill_dir is None:
             self._spill_dir = tempfile.mkdtemp(prefix="paimon-spill-")
         path = os.path.join(self._spill_dir,
-                            f"spill-{len(self.spills)}"
-                            f"-{len(os.listdir(self._spill_dir))}.arrow")
+                            f"spill-{self._spill_seq}.arrow")
+        self._spill_seq += 1
         opts = pa.ipc.IpcWriteOptions(compression=self._spill_codec())
         # batches are BYTE-capped (~24MB): the k-way merge buffers at
         # least one batch per run, so row-capped batches the size of a
@@ -243,14 +303,36 @@ class _BucketWriter:
         return path
 
     def _spill(self):
-        sorted_kv = self._sorted_chunk()
-        if sorted_kv is None:
+        """Snapshot (caller thread) + pooled sort/IPC-write; spill
+        folding rides the same per-bucket actor so `spills` stays
+        append-ordered.  The spill write and the fold are SEPARATE
+        tasks (= separate retry domains): a transient fold failure must
+        not re-run the spill write after it already published — that
+        would duplicate the run (and its changelog events)."""
+        snap = self._snapshot()
+        if snap is None:
             return
-        self.spills.append(self._write_spill_file(sorted_kv))
-        max_handles = self.parent.options.get(
-            CoreOptions.LOCAL_SORT_MAX_NUM_FILE_HANDLES)
-        if len(self.spills) > max_handles:
-            self._fold_spills(max_handles)
+        self._spills_scheduled += 1
+        payload = snap[0].nbytes
+        self._spill_sched_bytes += payload
+
+        def spill_task(snap=snap):
+            sorted_kv, changelog = self._sorted_chunk(snap)
+            path = self._write_spill_file(sorted_kv)
+            # publish LAST: a retried attempt rewrote under fresh names
+            self.spills.append(path)
+            self.changelog_files.extend(changelog)
+            self._spill_sched_bytes -= payload
+
+        def fold_task():
+            max_handles = self.parent.options.get(
+                CoreOptions.LOCAL_SORT_MAX_NUM_FILE_HANDLES)
+            if len(self.spills) > max_handles:
+                self._fold_spills(max_handles)
+
+        pool = self.parent.flush_pool()
+        pool.submit(self._key, snap[0].nbytes, spill_task)
+        pool.submit(self._key, 1, fold_task)
 
     def _fold_spills(self, max_handles: int):
         """Merge the oldest runs into one so at most `max_handles`
@@ -269,11 +351,10 @@ class _BucketWriter:
             if window.num_rows == 0:
                 return
             if writer_box[0] is None:
-                import tempfile
                 path = os.path.join(self._spill_dir,
-                                    f"spill-fold-"
-                                    f"{len(os.listdir(self._spill_dir))}"
+                                    f"spill-fold-{self._spill_seq}"
                                     f".arrow")
+                self._spill_seq += 1
                 out_path.append(path)
                 writer_box[0] = pa.OSFile(path, "wb")
                 writer_box[1] = pa.ipc.new_file(
@@ -288,12 +369,19 @@ class _BucketWriter:
         if writer_box[1] is not None:
             writer_box[1].close()
             writer_box[0].close()
-        for p in fold:
-            self._spill_bytes -= os.path.getsize(p)
-            os.unlink(p)
+        # publish the new run list BEFORE unlinking the inputs: a
+        # retried fold (transient failure) must re-read a consistent
+        # `spills`, never paths it already deleted; an unlink that
+        # fails leaves a stray file for _drop_spills' rmtree
+        import contextlib
+        fold_sizes = sum(os.path.getsize(p) for p in fold)
+        self.spills = out_path + rest
+        self._spill_bytes -= fold_sizes
         if out_path:
             self._spill_bytes += os.path.getsize(out_path[0])
-        self.spills = out_path + rest
+        for p in fold:
+            with contextlib.suppress(OSError):
+                os.unlink(p)
 
     @staticmethod
     def _ipc_iter(path):
@@ -326,13 +414,17 @@ class _BucketWriter:
             return kv.take(pa.array(order))
         return merge_window
 
-    def _merge_spills(self):
-        """Streamed k-way merge of the spilled runs (+ the live buffer)
-        into rolling L0 files — the same bounded-memory machinery the
-        compaction rewrite uses (ops/merge_stream.py)."""
+    def _merge_spills(self, snap):
+        """Streamed k-way merge of the spilled runs (+ the live-buffer
+        tail `snap`) into rolling L0 files — the same bounded-memory
+        machinery the compaction rewrite uses (ops/merge_stream.py).
+        Worker-side and retry-safe: output metas accumulate locally and
+        publish at the end; spills are dropped only on success, so a
+        retried attempt still has its inputs (half-written L0 files of
+        the failed attempt are orphans for maintenance)."""
         from paimon_tpu.ops.merge_stream import merge_runs_streamed
 
-        tail = self._sorted_chunk()
+        tail, changelog = self._sorted_chunk(snap)
         schema = self.parent.schema
         key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
         encoder = self.parent.key_encoder
@@ -342,6 +434,7 @@ class _BucketWriter:
             iters.append(iter([tail]))
         merge_window = self._window_merge_fn()
 
+        out_metas: List[DataFileMeta] = []
         acc: List[pa.Table] = []
         acc_bytes = 0
         target = self.parent.kv_writer.target_file_size
@@ -351,7 +444,7 @@ class _BucketWriter:
             if not acc:
                 return
             merged = pa.concat_tables(acc, promote_options="none")
-            self.new_files.extend(self.parent.kv_writer.write(
+            out_metas.extend(self.parent.kv_writer.write(
                 self.partition, self.bucket, merged, level=0))
             acc, acc_bytes = [], 0
 
@@ -368,12 +461,12 @@ class _BucketWriter:
             if acc_bytes >= target:
                 write_acc()
 
-        try:
-            merge_runs_streamed(iters, key_cols, encoder, emit,
-                                merge_window)
-            write_acc()
-        finally:
-            self._drop_spills()
+        merge_runs_streamed(iters, key_cols, encoder, emit,
+                            merge_window)
+        write_acc()
+        self.new_files.extend(out_metas)
+        self.changelog_files.extend(changelog)
+        self._drop_spills()
 
     def _drop_spills(self):
         import shutil
@@ -383,11 +476,38 @@ class _BucketWriter:
             shutil.rmtree(self._spill_dir, ignore_errors=True)
             self._spill_dir = None
 
-    def prepare_commit(self) -> Optional[CommitMessage]:
-        if self.spills:
-            self._merge_spills()
-        else:
-            self.flush()
+    def schedule_final_flush(self):
+        """Queue the end-of-batch drain for this bucket: the tail
+        buffer is snapshotted NOW (caller thread, sequence numbers
+        already reserved), but the spill-vs-flush decision runs inside
+        the task — earlier spill tasks for this bucket may still be in
+        flight, and the per-key actor guarantees they land first."""
+        snap = self._snapshot()
+        if snap is None and self._spills_scheduled == 0:
+            # nothing buffered and no spill run queued since the last
+            # drain: don't churn a no-op task per bucket per checkpoint
+            # (it would also inflate the flushes/flushed_bytes metrics)
+            return
+        self._spills_scheduled = 0
+
+        def task(snap=snap):
+            if self.spills:
+                self._merge_spills(snap)
+            else:
+                sorted_kv, changelog = self._sorted_chunk(snap)
+                if sorted_kv is not None:
+                    metas = self.parent.kv_writer.write(
+                        self.partition, self.bucket, sorted_kv, level=0)
+                    self.new_files.extend(metas)
+                    self.changelog_files.extend(changelog)
+
+        est = (snap[0].nbytes if snap is not None else 0) + \
+            self._spill_bytes
+        self.parent.flush_pool().submit(self._key, est, task)
+
+    def take_commit_message(self) -> Optional[CommitMessage]:
+        """Assemble this bucket's message AFTER the pool drained (the
+        prepare-commit barrier); caller thread only."""
         msg = CommitMessage(self.partition, self.bucket,
                             self.parent.total_buckets,
                             new_files=list(self.new_files),
@@ -538,6 +658,12 @@ class KeyValueFileStoreWrite:
             nullable=[rt.get_field(k).type.nullable
                       for k in table_schema.trimmed_primary_keys()])
         self._writers: Dict[Tuple, _BucketWriter] = {}
+        self._flush_pool = None       # lazily built (write_pipeline)
+        # bounded dispatch lookahead: batch N+1's hash/group-by/take
+        # runs on a prep worker while batch N routes (seq reservation
+        # stays on the caller, strictly in batch order)
+        self._prep_pool = None
+        self._prep = deque()
         self._restore_max_seq = restore_max_seq
         self.changelog_input = (
             options.changelog_producer == "input")
@@ -558,6 +684,14 @@ class KeyValueFileStoreWrite:
                     "local-merge-buffer-size folds input rows, which "
                     "would drop changelog-producer=input events")
             self._local_merger = LocalMerger(self, lm_size)
+
+    def flush_pool(self):
+        """The shared bucket-flush executor (parallel/write_pipeline.py);
+        write.flush.parallelism=1 degrades it to the inline serial path."""
+        if self._flush_pool is None:
+            from paimon_tpu.parallel.write_pipeline import FlushPool
+            self._flush_pool = FlushPool.from_options(self.options)
+        return self._flush_pool
 
     # -- seam for restore (reference operation/WriteRestore.java) ------------
 
@@ -595,16 +729,20 @@ class KeyValueFileStoreWrite:
 
     def _dispatch(self, table: pa.Table, row_kinds: np.ndarray,
                   precomputed_buckets: Optional[np.ndarray] = None):
+        from paimon_tpu.parallel.write_pipeline import lpt_order
         if self._postpone:
+            self._drain_prep()
             buckets = np.full(table.num_rows, -2, dtype=np.int32)
-            for (part, bucket), idx in group_by_partition_bucket(
-                    table, buckets, self.partition_keys):
+            for (part, bucket), idx in lpt_order(
+                    group_by_partition_bucket(
+                        table, buckets, self.partition_keys)):
                 sub = table.take(pa.array(idx))
                 self._writer(part, bucket).write(sub, row_kinds[idx])
             return
         if self._dynamic is not None:
             # partition-first grouping: bucket assignment depends on the
-            # partition's hash index
+            # partition's hash index (stateful — no lookahead here)
+            self._drain_prep()
             zeros = np.zeros(table.num_rows, dtype=np.int32)
             for (part, _), idx in group_by_partition_bucket(
                     table, zeros, self.partition_keys):
@@ -612,18 +750,64 @@ class KeyValueFileStoreWrite:
                 sub_kinds = row_kinds[idx]
                 buckets = self._dynamic.assign(
                     part, self._key_hasher.hashes(sub))
-                for (_, bucket), idx2 in group_by_partition_bucket(
-                        sub, buckets, []):
+                for (_, bucket), idx2 in lpt_order(
+                        group_by_partition_bucket(sub, buckets, [])):
                     self._writer(part, bucket).write(
                         sub.take(pa.array(idx2)), sub_kinds[idx2])
             return
-        buckets = precomputed_buckets if precomputed_buckets is not None \
-            else self.bucket_assigner.assign(table)
-        for (part, bucket), idx in group_by_partition_bucket(
-                table, buckets, self.partition_keys):
-            sub = table.take(pa.array(idx))
-            kinds = row_kinds[idx]
+
+        # fixed-bucket hot path: the hash/group-by/take is a PURE
+        # function of the batch, so it runs on a prep worker while the
+        # previous batch routes — the "incoming batch's hash overlaps
+        # bucket flushes" leg of the pipeline.  Routing (and therefore
+        # sequence reservation) stays on this thread, in batch order.
+        def prep(table=table, kinds=row_kinds,
+                 pre=precomputed_buckets):
+            buckets = pre if pre is not None \
+                else self.bucket_assigner.assign(table)
+            out = []
+            for (part, bucket), idx in lpt_order(
+                    group_by_partition_bucket(
+                        table, buckets, self.partition_keys)):
+                out.append(((part, bucket), table.take(pa.array(idx)),
+                            kinds[idx]))
+            return out
+
+        pool = self._prep_executor()
+        if pool is None:
+            self._route(prep())
+            return
+        self._prep.append(pool.submit(prep))
+        # bounded lookahead: at most 4 batches prepped ahead (each holds
+        # a batch-sized copy), routed strictly in submission order
+        while len(self._prep) > 4:
+            self._route(self._prep.popleft().result())
+        while self._prep and self._prep[0].done():
+            self._route(self._prep.popleft().result())
+
+    def _route(self, groups):
+        for (part, bucket), sub, kinds in groups:
             self._writer(part, bucket).write(sub, kinds)
+
+    def _drain_prep(self):
+        while self._prep:
+            self._route(self._prep.popleft().result())
+
+    def _prep_executor(self):
+        """Lookahead pool (up to 4 workers, bounded by the flush
+        parallelism); None (inline) on the serial path so
+        write.flush.parallelism=1 stays byte-for-byte legacy."""
+        from paimon_tpu.parallel.write_pipeline import (
+            resolve_flush_parallelism,
+        )
+        par = resolve_flush_parallelism(self.options)
+        if par <= 1:
+            return None
+        if self._prep_pool is None:
+            from paimon_tpu.parallel.executors import new_thread_pool
+            self._prep_pool = new_thread_pool(min(4, par),
+                                              "paimon-write-prep")
+        return self._prep_pool
 
     def _writer(self, partition: Tuple, bucket: int) -> _BucketWriter:
         key = (partition, bucket)
@@ -632,8 +816,18 @@ class KeyValueFileStoreWrite:
         return self._writers[key]
 
     def prepare_commit(self) -> List[CommitMessage]:
+        """The pipeline barrier: schedule every bucket's final drain
+        (largest pending bytes first, LPT like parallel/packing.py),
+        wait for the pool, then assemble messages on the caller thread.
+        The first worker error re-raises here with the remaining queued
+        flushes cancelled — a failed prepare commits nothing."""
         if self._local_merger is not None:
             self._local_merger.flush()
+        self._drain_prep()
+        for w in sorted(self._writers.values(),
+                        key=lambda w: -w.pending_bytes()):
+            w.schedule_final_flush()
+        self.flush_pool().drain()
         out = []
         auto_compact = not self.options.write_only and not self._postpone
         existing_map = None
@@ -641,7 +835,7 @@ class KeyValueFileStoreWrite:
             # ONE manifest read for the whole commit, not one per bucket
             existing_map = self._bucket_files_map()
         for w in self._writers.values():
-            msg = w.prepare_commit()
+            msg = w.take_commit_message()
             if msg is not None:
                 if auto_compact:
                     self._maybe_compact(msg, existing_map or {})
@@ -679,6 +873,16 @@ class KeyValueFileStoreWrite:
         msg.compact_changelog = result.changelog
 
     def close(self):
+        if self._prep_pool is not None:
+            self._prep_pool.shutdown(wait=True, cancel_futures=True)
+            self._prep_pool = None
+        self._prep.clear()
+        if self._flush_pool is not None:
+            # join the workers FIRST so no task mutates spill state
+            # while we clean it; abandoned flushes are dropped (their
+            # uploads become orphans for maintenance)
+            self._flush_pool.shutdown(wait=True)
+            self._flush_pool = None
         for w in self._writers.values():
             w._drop_spills()         # aborted writes must not leak /tmp
         self._writers.clear()

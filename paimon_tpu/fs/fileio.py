@@ -139,8 +139,26 @@ class FileIO:
         return False
 
 
+def reraise_with_path(e: BaseException, path: str, phase: str):
+    """Re-raise `e` as the same exception type with the destination
+    path in the message.  A failed part upload inside a two-phase
+    stream otherwise surfaces a backend-generic error ("disk full",
+    bare errno) with no file context — the caller staging dozens of
+    files cannot tell WHICH upload died.  Exception types whose
+    constructor rejects a single message fall back to the original."""
+    try:
+        wrapped = type(e)(f"two-phase {phase} for {path} failed: {e}")
+    except Exception:
+        raise e
+    raise wrapped from e
+
+
 class TwoPhaseOutputStream:
-    """write() bytes, then close_for_commit() -> Committer."""
+    """write() bytes, then close_for_commit() -> Committer.
+
+    Contract: `close_for_commit()` performs (or completes) the staging
+    upload — any upload failure it raises names the destination path
+    in the exception message (see `reraise_with_path`)."""
 
     def write(self, data: bytes):
         raise NotImplementedError
@@ -175,7 +193,13 @@ class _BufferedTwoPhaseStream(TwoPhaseOutputStream):
 
         class C(TwoPhaseCommitter):
             def commit(self):
-                if not io_.try_to_write_atomic(path, blob):
+                try:
+                    ok = io_.try_to_write_atomic(path, blob)
+                except FileExistsError:
+                    raise
+                except Exception as e:      # noqa: BLE001 — re-typed
+                    reraise_with_path(e, path, "publish")
+                if not ok:
                     raise FileExistsError(path)
 
             def discard(self):
@@ -315,9 +339,14 @@ class _LocalTwoPhaseStream(TwoPhaseOutputStream):
         self._f.write(data)
 
     def close_for_commit(self) -> TwoPhaseCommitter:
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._f.close()
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+        except OSError as e:
+            # a torn staging write names the FINAL path it was for,
+            # not just the hidden .inprogress temp
+            reraise_with_path(e, self._final, "staging write")
         tmp, final = self._tmp, self._final
 
         class C(TwoPhaseCommitter):

@@ -404,17 +404,27 @@ class ObjectStoreFileIO(FileIO):
                 parts.append(bytes(data))
 
             def close_for_commit(self) -> TwoPhaseCommitter:
-                io_.backend.put(io_._key(stage), b"".join(parts))
+                from paimon_tpu.fs.fileio import reraise_with_path
+                try:
+                    # the part upload: close() is where the staged
+                    # bytes actually hit the store, so a failure here
+                    # must name the file it was for instead of the
+                    # backend's generic error
+                    io_.backend.put(io_._key(stage), b"".join(parts))
+                except Exception as e:      # noqa: BLE001 — re-typed
+                    reraise_with_path(e, final, "upload")
 
                 class C(TwoPhaseCommitter):
                     def commit(self):
-                        blob = io_.backend.get(io_._key(stage))
                         try:
+                            blob = io_.backend.get(io_._key(stage))
                             io_.backend.put(io_._key(final), blob,
                                             if_none_match=True)
                         except PreconditionFailed:
                             io_.backend.delete(io_._key(stage))
                             raise FileExistsError(final)
+                        except Exception as e:  # noqa: BLE001 — re-typed
+                            reraise_with_path(e, final, "publish")
                         io_.backend.delete(io_._key(stage))
 
                     def discard(self):

@@ -221,9 +221,9 @@ class IcebergRESTCatalogServer:
         return f"http://127.0.0.1:{self.port}"
 
     def start(self):
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        from paimon_tpu.parallel.executors import spawn_thread
+        self._thread = spawn_thread(self.httpd.serve_forever,
+                                    name="paimon-iceberg-rest")
         return self
 
     def stop(self):

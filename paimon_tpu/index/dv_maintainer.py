@@ -45,12 +45,11 @@ def _delete_pk(table, predicate) -> Optional[int]:
     if rows.num_rows == 0:
         return None
     wb = table.new_batch_write_builder()
-    w = wb.new_write(apply_defaults=False)
-    w.write_arrow(rows.select([f.name for f in table.schema.fields]),
-                  row_kinds=np.full(rows.num_rows, RowKind.DELETE,
-                                    np.int8))
-    sid = wb.new_commit().commit(w.prepare_commit())
-    w.close()
+    with wb.new_write(apply_defaults=False) as w:
+        w.write_arrow(rows.select([f.name for f in table.schema.fields]),
+                      row_kinds=np.full(rows.num_rows, RowKind.DELETE,
+                                        np.int8))
+        sid = wb.new_commit().commit(w.prepare_commit())
     return sid
 
 

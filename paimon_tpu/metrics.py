@@ -20,7 +20,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "SCAN_FOOTER_CACHE_HITS", "SCAN_FOOTER_CACHE_MISSES",
            "SCAN_RANGE_CACHE_HITS", "SCAN_RANGE_CACHE_MISSES",
            "SCAN_RANGE_CACHE_HIT_BYTES", "SCAN_PIPELINE_SPLITS",
-           "SCAN_PIPELINE_BYTES", "SCAN_READ_RETRIES"]
+           "SCAN_PIPELINE_BYTES", "SCAN_READ_RETRIES",
+           "WRITE_FLUSHES", "WRITE_FLUSHED_BYTES", "WRITE_FLUSH_WAIT_MS",
+           "WRITE_INFLIGHT_BYTES", "WRITE_RETRIES"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -45,6 +47,16 @@ SCAN_RANGE_CACHE_HIT_BYTES = "range_cache_hit_bytes"
 SCAN_PIPELINE_SPLITS = "pipeline_splits"          # splits prefetched
 SCAN_PIPELINE_BYTES = "pipeline_bytes"            # est. bytes admitted
 SCAN_READ_RETRIES = "read_retries"                # transient IO retries
+
+# write-pipeline counter names (write metric group; producers in
+# parallel/write_pipeline.py, consumers in write_bench.py / tests /
+# dashboards)
+WRITE_FLUSHES = "flushes"                   # flush tasks admitted
+WRITE_FLUSHED_BYTES = "flushed_bytes"       # est. buffered bytes flushed
+WRITE_FLUSH_WAIT_MS = "flush_wait_ms"       # producer ms blocked on the
+                                            # in-flight byte budget
+WRITE_INFLIGHT_BYTES = "inflight_bytes"     # gauge: bytes in flight now
+WRITE_RETRIES = "write_retries"             # transient flush retries
 
 
 class Counter:
@@ -162,6 +174,10 @@ class MetricRegistry:
 
     def compaction_metrics(self, table: str = "") -> MetricGroup:
         return self.group("compaction", table)
+
+    def write_metrics(self, table: str = "") -> MetricGroup:
+        """Pipelined write/ingest plane (ours)."""
+        return self.group("write", table)
 
     def maintenance_metrics(self, table: str = "") -> MetricGroup:
         """Expire / orphan-clean / fsck plane (ours)."""

@@ -448,6 +448,29 @@ class CoreOptions:
         "read.cache.range.max-bytes", parse_memory_size, 128 << 20,
         "Capacity of the block-range cache enabled by read.cache.range")
 
+    # -- pipelined write/ingest (ours; parallel/write_pipeline.py) -----------
+    WRITE_FLUSH_PARALLELISM = ConfigOption(
+        "write.flush.parallelism", int, None,
+        "Worker threads running per-(partition,bucket) flushes (sort + "
+        "encode + upload) concurrently in the pipelined write engine; "
+        "None = min(8, cpu count), 1 = the serial inline write path")
+    WRITE_FLUSH_MAX_BYTES = ConfigOption(
+        "write.flush.max-bytes", parse_memory_size, 1 << 30,
+        "Hard budget on the estimated buffered bytes of flushes in "
+        "flight at once; producers block at write() until the pool "
+        "drains below it, and at least one flush is always admitted so "
+        "a budget below one buffer's size cannot deadlock")
+    WRITE_RETRY_MAX_ATTEMPTS = ConfigOption(
+        "write.retry.max-attempts", int, 3,
+        "Attempts per bucket flush on a transient store fault (503 "
+        "storms, IO errors — parallel/fault.py taxonomy) before the "
+        "write raises; non-transient errors never retry, and an "
+        "exhausted flush always raises — never silently dropped")
+    WRITE_RETRY_BACKOFF = ConfigOption(
+        "write.retry.backoff", _parse_duration_ms, 10,
+        "Base wait between bucket-flush retries; actual waits use "
+        "capped decorrelated jitter (utils/backoff.py)")
+
     # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
     SCAN_PLAN_SORT_PARTITION = ConfigOption(
         "scan.plan-sort-partition", _parse_bool, False,

@@ -77,10 +77,9 @@ def run(n_devices: int) -> None:
                 "v": pa.array(rng.random(len(ids)), pa.float64()),
             })
             wb = table.new_batch_write_builder()
-            w = wb.new_write()
-            w.write_arrow(data)
-            wb.new_commit().commit(w.prepare_commit())
-            w.close()
+            with wb.new_write() as w:
+                w.write_arrow(data)
+                wb.new_commit().commit(w.prepare_commit())
 
         expected = table.to_arrow().num_rows   # merge-on-read truth
         n_input = sum(
@@ -164,13 +163,12 @@ def run_engines(n_devices: int = 8, rows: int = 10_000_000,
             while commits < 2 or scanned_rows() < rows:
                 ids = rng.integers(0, rows, rows // 2)
                 wb = table.new_batch_write_builder()
-                w = wb.new_write()
-                w.write_arrow(pa.table({
-                    "id": pa.array(ids, pa.int64()),
-                    "v": pa.array(rng.random(len(ids)), pa.float64()),
-                }))
-                wb.new_commit().commit(w.prepare_commit())
-                w.close()
+                with wb.new_write() as w:
+                    w.write_arrow(pa.table({
+                        "id": pa.array(ids, pa.int64()),
+                        "v": pa.array(rng.random(len(ids)), pa.float64()),
+                    }))
+                    wb.new_commit().commit(w.prepare_commit())
                 commits += 1
             t0 = time.perf_counter()
             stats = compact_table_mesh(table, mesh)

@@ -1,0 +1,41 @@
+"""Shared thread/executor construction helpers.
+
+Every pool and background thread in paimon_tpu goes through these two
+functions — the tier-1 lint (tests/test_lint_swallow.py) bans bare
+``threading.Thread(`` outside ``parallel/`` so thread creation stays
+reviewable in one place: names are mandatory (leak checks and stack
+dumps must be able to attribute a thread to its subsystem) and daemon
+defaults are explicit instead of scattered per call site.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+__all__ = ["spawn_thread", "new_thread_pool"]
+
+
+def spawn_thread(target: Callable, *, name: str,
+                 daemon: bool = True, start: bool = True,
+                 args: Sequence = ()) -> threading.Thread:
+    """Create (and by default start) a named background thread.
+
+    `daemon=True` is the deliberate default: paimon background threads
+    (HTTP servers, ingest workers, changelog pumps) must never block
+    interpreter shutdown — owners that need a clean join call
+    ``.join()`` themselves.
+    """
+    t = threading.Thread(target=target, name=name, daemon=daemon,
+                         args=tuple(args))
+    if start:
+        t.start()
+    return t
+
+
+def new_thread_pool(workers: int, prefix: str) -> ThreadPoolExecutor:
+    """A named ThreadPoolExecutor (`prefix` becomes the thread-name
+    prefix, which the no-leaked-threads tier-1 tests key on)."""
+    return ThreadPoolExecutor(max_workers=max(1, int(workers)),
+                              thread_name_prefix=prefix)

@@ -212,8 +212,8 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
     c_splits = group.counter(SCAN_PIPELINE_SPLITS)
     c_bytes = group.counter(SCAN_PIPELINE_BYTES)
 
-    pool = cf.ThreadPoolExecutor(max_workers=par,
-                                 thread_name_prefix="paimon-scan")
+    from paimon_tpu.parallel.executors import new_thread_pool
+    pool = new_thread_pool(par, "paimon-scan")
     inflight = deque()        # [index, split, est_bytes, future]
     inflight_bytes = 0
     next_i = 0

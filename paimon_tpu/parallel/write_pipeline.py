@@ -1,0 +1,309 @@
+"""Pipelined write/ingest flush executor.
+
+The serial write path runs every per-(partition,bucket) flush — sort
+the buffered batches, merge spills, encode parquet, upload — inline on
+the caller's thread: the object store sits idle while the sort/encode
+runs and the CPU sits idle during uploads.  This module is the write
+path's counterpart of `scan_pipeline.py`: a bounded producer-consumer
+pool that overlaps bucket k's encode+upload with bucket k+1's sort and
+with the incoming batch's hash/group-by on the caller thread.
+
+    write() ──► snapshot buffers (+ seq reserved HERE, single-threaded)
+       │              │ submit(bucket_key, est_bytes, task)
+       ▼              ▼
+    byte budget ◄── [ FlushPool: per-bucket actor queues over a
+                      shared worker pool (sort/encode/upload) ]
+       ▲              │
+       └─ prepare_commit() = drain() barrier, then assemble messages
+
+Design points:
+
+* **per-bucket ordering**: tasks for the same (partition, bucket) run
+  strictly in submission order through a per-key "actor" queue, so
+  file metas / spill runs / changelog files publish deterministically;
+  tasks for different keys run on up to `write.flush.parallelism`
+  workers (Arrow encode and file IO release the GIL);
+* **byte budget**: `submit` blocks the producer while the estimated
+  buffered bytes in flight exceed `write.flush.max-bytes` — hard
+  backpressure, with at least one task always admitted so a budget
+  below one buffer cannot deadlock;
+* **fault policy**: transient store faults inside a flush retry under
+  `write.retry.*` via the parallel/fault.py taxonomy +
+  utils/backoff.py (see `flush_retrying`); an exhausted or
+  non-transient error is latched and re-raised at the `drain()`
+  barrier with all still-queued tasks cancelled — a flush is NEVER
+  silently dropped;
+* **serial fast path**: parallelism 1 runs every task inline on the
+  caller thread, byte-for-byte the legacy write path.
+
+Everything that writes batches routes through here: the pk and append
+file-store writes (core/write.py, core/append.py) and therefore
+`TableWrite` (table/table.py), the SQL executor's INSERT/UPDATE/DELETE
+paths, the CDC sink, the ingest topology and the integrations.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from paimon_tpu.options import CoreOptions
+
+__all__ = ["FlushPool", "flush_retrying", "lpt_order",
+           "resolve_flush_parallelism"]
+
+
+def lpt_order(groups):
+    """Largest (partition,bucket) group first — row count stands in
+    for estimated bytes; the same longest-processing-time discipline
+    as parallel/packing.py, shared by the pk and append dispatchers so
+    the cost estimate cannot drift between them.  The flush pool
+    receives skewed buckets' work first, overlapping the hot bucket's
+    encode+upload with all the small ones instead of trailing it as
+    the long tail.  Stable sort: equal sizes keep grouping order."""
+    return sorted(groups, key=lambda g: -len(g[1]))
+
+
+def resolve_flush_parallelism(options: Optional[CoreOptions]) -> int:
+    """Worker threads for the pipelined write: write.flush.parallelism,
+    defaulting to min(8, cpu count).  1 means the serial inline path."""
+    par = None
+    if options is not None:
+        par = options.get(CoreOptions.WRITE_FLUSH_PARALLELISM)
+    if par is None:
+        par = min(8, os.cpu_count() or 1)
+    return max(1, int(par))
+
+
+def flush_retrying(fn: Callable[[], object],
+                   options: Optional[CoreOptions],
+                   what: str = "bucket flush"):
+    """Run one flush-granularity operation under write.retry.*.
+
+    Transient store faults (fault.py taxonomy: 503 TransientStoreError,
+    OSError IO faults) retry with capped decorrelated-jitter backoff up
+    to write.retry.max-attempts total attempts, then re-raise the
+    original error.  Non-transient errors propagate immediately.  The
+    retried `fn` must be restartable from the top: flush closures
+    publish their outputs (file metas, spill paths) only after the
+    write succeeded, and every attempt picks fresh file names, so a
+    half-written attempt leaves only orphan files for maintenance."""
+    from paimon_tpu.parallel.fault import is_transient_error
+    from paimon_tpu.utils.backoff import Backoff
+
+    if options is not None:
+        attempts = options.get(CoreOptions.WRITE_RETRY_MAX_ATTEMPTS)
+        base_ms = options.get(CoreOptions.WRITE_RETRY_BACKOFF)
+    else:
+        attempts = CoreOptions.WRITE_RETRY_MAX_ATTEMPTS.default
+        base_ms = CoreOptions.WRITE_RETRY_BACKOFF.default
+    attempts = max(1, attempts)
+    backoff = None
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as e:      # noqa: BLE001 — reclassified below
+            if not is_transient_error(e) or attempt >= attempts:
+                raise
+            from paimon_tpu.metrics import WRITE_RETRIES, global_registry
+            global_registry().write_metrics() \
+                .counter(WRITE_RETRIES).inc()
+            if backoff is None:
+                backoff = Backoff(base_ms)
+            backoff.pause()
+
+
+class FlushPool:
+    """Bounded flush executor with per-key FIFO ordering.
+
+    `submit(key, est_bytes, fn)` enqueues `fn` on the key's actor
+    queue (strict submission order per key) and wakes a shared worker;
+    it blocks the producer while the in-flight byte budget is
+    exceeded.  `drain()` is the prepare-commit barrier: it waits for
+    every admitted task and re-raises the first task error with the
+    remaining queued tasks cancelled AND the pool poisoned — the
+    cancelled payloads are unrecoverable, so the owning writer must be
+    closed and replaced rather than retried (see `drain`).
+    `shutdown()` joins the workers; no threads outlive the owner.
+    """
+
+    def __init__(self, parallelism: int, max_bytes: int,
+                 options: Optional[CoreOptions] = None):
+        self.parallelism = max(1, int(parallelism))
+        self.max_bytes = max(1, int(max_bytes))
+        self.options = options
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: Dict[object, deque] = {}
+        self._active: set = {*()}
+        self._inflight_bytes = 0
+        self._inflight_tasks = 0
+        self._error: Optional[BaseException] = None
+        self._poisoned: Optional[BaseException] = None
+        self._pool = None
+        self._shut = False
+        # observability for tests/benchmarks (mirrors scan stats)
+        self.peak_inflight_bytes = 0
+        self.max_inflight_tasks = 0
+        self.submitted = 0
+        from paimon_tpu.metrics import (
+            WRITE_FLUSHED_BYTES, WRITE_FLUSHES, WRITE_FLUSH_WAIT_MS,
+            WRITE_INFLIGHT_BYTES, global_registry,
+        )
+        group = global_registry().write_metrics()
+        self._c_flushes = group.counter(WRITE_FLUSHES)
+        self._c_bytes = group.counter(WRITE_FLUSHED_BYTES)
+        self._c_wait = group.counter(WRITE_FLUSH_WAIT_MS)
+        self._g_inflight = group.gauge(WRITE_INFLIGHT_BYTES)
+
+    @classmethod
+    def from_options(cls, options: Optional[CoreOptions]) -> "FlushPool":
+        par = resolve_flush_parallelism(options)
+        if options is not None:
+            max_bytes = options.get(CoreOptions.WRITE_FLUSH_MAX_BYTES)
+        else:
+            max_bytes = CoreOptions.WRITE_FLUSH_MAX_BYTES.default
+        return cls(par, max_bytes, options)
+
+    @property
+    def serial(self) -> bool:
+        return self.parallelism <= 1
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, key, est_bytes: int, fn: Callable[[], None]):
+        """Admit one flush task for `key`.  Serial pools run it inline
+        (errors propagate immediately, exactly like the legacy path)."""
+        est_bytes = max(1, int(est_bytes))
+        self._c_flushes.inc()
+        self._c_bytes.inc(est_bytes)
+        self.submitted += 1
+        if self.serial:
+            self.peak_inflight_bytes = max(self.peak_inflight_bytes,
+                                           est_bytes)
+            self.max_inflight_tasks = max(self.max_inflight_tasks, 1)
+            flush_retrying(fn, self.options)
+            return
+        with self._cond:
+            self._check_poisoned()
+            if self._error is not None:
+                raise self._first_error()
+            # backpressure: block while over budget, unless the pool is
+            # empty (always admit one so a small budget cannot stall)
+            waited = None
+            while self._inflight_tasks > 0 and \
+                    self._inflight_bytes + est_bytes > self.max_bytes:
+                if waited is None:
+                    waited = time.perf_counter()
+                self._cond.wait(timeout=0.5)
+                if self._error is not None:
+                    raise self._first_error()
+            if waited is not None:
+                self._c_wait.inc(
+                    int((time.perf_counter() - waited) * 1000))
+            self._inflight_bytes += est_bytes
+            self._inflight_tasks += 1
+            self.peak_inflight_bytes = max(self.peak_inflight_bytes,
+                                           self._inflight_bytes)
+            self.max_inflight_tasks = max(self.max_inflight_tasks,
+                                          self._inflight_tasks)
+            self._g_inflight.set(self._inflight_bytes)
+            self._queues.setdefault(key, deque()).append((est_bytes, fn))
+            if key not in self._active:
+                self._active.add(key)
+                self._ensure_pool().submit(self._drain_key, key)
+
+    def drain(self):
+        """Barrier: wait for every admitted task; re-raise the first
+        task error with the remaining queued tasks cancelled.  A drain
+        that raised POISONS the pool: the cancelled tasks' payloads
+        (snapshots already detached from their writers, sequence ranges
+        already reserved) are gone, so a retried prepare on the same
+        writer would commit with rows silently missing — every later
+        submit/drain raises instead; the caller must close this writer
+        and start a fresh one."""
+        if self.serial:
+            return
+        with self._cond:
+            self._check_poisoned()
+            while self._inflight_tasks > 0 and self._error is None:
+                self._cond.wait(timeout=0.5)
+            if self._error is not None:
+                # cancel everything still queued, then wait for the
+                # running tasks to finish so state stops mutating
+                for q in self._queues.values():
+                    while q:
+                        est, _ = q.popleft()
+                        self._inflight_bytes -= est
+                        self._inflight_tasks -= 1
+                while self._inflight_tasks > 0:
+                    self._cond.wait(timeout=0.5)
+                self._g_inflight.set(self._inflight_bytes)
+                err, self._error = self._error, None
+                self._poisoned = err
+                raise err
+
+    def _check_poisoned(self):
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "write pipeline failed earlier and in-flight flushes "
+                "were cancelled; close this writer and retry with a "
+                "fresh one") from self._poisoned
+
+    def shutdown(self, wait: bool = True):
+        with self._cond:
+            self._shut = True
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=wait, cancel_futures=True)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _first_error(self) -> BaseException:
+        return RuntimeError("write pipeline already failed; "
+                            "drain() reports the cause") \
+            if self._error is None else self._error
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            if self._shut:
+                raise RuntimeError("FlushPool is shut down")
+            from paimon_tpu.parallel.executors import new_thread_pool
+            self._pool = new_thread_pool(self.parallelism, "paimon-write")
+        return self._pool
+
+    def _drain_key(self, key):
+        """Run `key`'s queued tasks one at a time, in order (the
+        per-bucket actor: no two tasks of one bucket ever overlap)."""
+        while True:
+            with self._cond:
+                q = self._queues.get(key)
+                if not q or self._error is not None:
+                    if q:
+                        # pipeline failed: cancel this key's backlog
+                        while q:
+                            est, _ = q.popleft()
+                            self._inflight_bytes -= est
+                            self._inflight_tasks -= 1
+                        self._g_inflight.set(self._inflight_bytes)
+                    self._active.discard(key)
+                    self._cond.notify_all()
+                    return
+                est, fn = q.popleft()
+            try:
+                flush_retrying(fn, self.options)
+            except BaseException as e:      # noqa: BLE001 — latched
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._cond:
+                    self._inflight_bytes -= est
+                    self._inflight_tasks -= 1
+                    self._g_inflight.set(self._inflight_bytes)
+                    self._cond.notify_all()

@@ -104,9 +104,9 @@ class KvQueryServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "KvQueryServer":
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        from paimon_tpu.parallel.executors import spawn_thread
+        self._thread = spawn_thread(self.httpd.serve_forever,
+                                    name="paimon-query-server")
         self.services.register(PRIMARY_KEY_LOOKUP, self.address)
         return self
 

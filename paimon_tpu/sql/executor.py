@@ -1338,10 +1338,11 @@ class SQLContext:
         wb = table.new_batch_write_builder()
         if ins.overwrite:
             wb = wb.with_overwrite()
-        w = wb.new_write()
-        w.write_arrow(out)
-        wb.new_commit().commit(w.prepare_commit())
-        w.close()
+        # context-managed: a failed flush must still join the pipelined
+        # writer's pool (parallel/write_pipeline.py), not leak it
+        with wb.new_write() as w:
+            w.write_arrow(out)
+            wb.new_commit().commit(w.prepare_commit())
         return _result([f"{out.num_rows} rows inserted"])
 
     def _exec_merge(self, m: "ast.MergeInto") -> pa.Table:
@@ -1524,10 +1525,9 @@ class SQLContext:
             val = pc.cast(comp.as_array(e), schema.field(col).type)
             out = out.set_column(idx, col, val)
         wb = table.new_batch_write_builder()
-        w = wb.new_write()
-        w.write_arrow(out.cast(schema))
-        wb.new_commit().commit(w.prepare_commit())
-        w.close()
+        with wb.new_write() as w:
+            w.write_arrow(out.cast(schema))
+            wb.new_commit().commit(w.prepare_commit())
         return _result([f"{out.num_rows} rows updated"])
 
     # -- DDL ----------------------------------------------------------------

@@ -483,10 +483,25 @@ class TableWrite:
         self.write_arrow(table, kinds)
 
     def prepare_commit(self) -> List[CommitMessage]:
+        """Barrier over the pipelined flush pool
+        (parallel/write_pipeline.py): drains every in-flight bucket
+        flush, re-raising the first worker error, then returns the
+        accumulated commit messages."""
         return self._write.prepare_commit()
 
     def close(self):
+        """Shuts down the flush pool (joining its workers) and drops
+        buffered/spilled state.  Always call close — also on failure —
+        or the writer's pool threads outlive the write; prefer the
+        context-manager form: ``with wb.new_write() as w: ...``."""
         self._write.close()
+
+    def __enter__(self) -> "TableWrite":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class TableCommit:

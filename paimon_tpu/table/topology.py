@@ -36,8 +36,8 @@ class _Worker:
         self.write = write
         self.q: "queue.Queue" = queue.Queue()
         self.error: Optional[BaseException] = None
-        self.thread = threading.Thread(target=self._run, daemon=True)
-        self.thread.start()
+        from paimon_tpu.parallel.executors import spawn_thread
+        self.thread = spawn_thread(self._run, name="paimon-ingest-worker")
 
     def _run(self):
         while True:

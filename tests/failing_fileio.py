@@ -133,6 +133,10 @@ class FailingFileIO(FileIO):
                 stream.write(data)
 
             def close_for_commit(self) -> TwoPhaseCommitter:
+                # close() is where the staged bytes upload: killable so
+                # crash sweeps can die mid-upload, and the injected
+                # error carries the destination path like the fs layer
+                outer._tick("two_phase.close", path)
                 committer = stream.close_for_commit()
 
                 class C(TwoPhaseCommitter):

@@ -1,13 +1,20 @@
 """Tier-1 lint: no NEW silent broad-exception swallowing in
-paimon_tpu/.  An `except Exception: pass` (or bare except / continue
-body) hides every error class — including the transient faults the
-maintenance plane must now retry or propagate (parallel/fault.py).
+paimon_tpu/, and no bare thread construction outside parallel/.
+
+An `except Exception: pass` (or bare except / continue body) hides
+every error class — including the transient faults the maintenance
+plane must now retry or propagate (parallel/fault.py).
 
 Every handler that catches Exception/BaseException/bare and does
 nothing must appear in the reviewed allowlist below; the comparison is
 exact both ways, so removing one must also prune the list.  Narrow
 typed catches (OSError, ValueError, ...) are out of scope — they are
 deliberate, local decisions.
+
+`threading.Thread(` outside paimon_tpu/parallel/ is banned: all
+threads and pools go through parallel/executors.py (spawn_thread /
+new_thread_pool) so every worker carries an attributable name and the
+no-leaked-thread tier-1 tests can key on it.
 """
 
 import ast
@@ -75,6 +82,40 @@ def _silent_broad_handlers():
                         enc = fn.name
                 found.add(f"{rel}::{enc}")
     return found
+
+
+def _bare_thread_constructions():
+    """`threading.Thread(...)` / `Thread(...)` call sites outside
+    paimon_tpu/parallel/, as '<relpath>:<line>' strings."""
+    found = []
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if rel.startswith("paimon_tpu/parallel/"):
+                continue               # the one reviewed home of threads
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), rel)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else \
+                    fn.id if isinstance(fn, ast.Name) else None
+                if name == "Thread":
+                    found.append(f"{rel}:{node.lineno}")
+    return found
+
+
+def test_no_bare_threads_outside_parallel():
+    offenders = _bare_thread_constructions()
+    assert not offenders, (
+        f"bare threading.Thread( outside parallel/ — use "
+        f"parallel/executors.py spawn_thread/new_thread_pool so the "
+        f"thread is named and reviewable: {sorted(offenders)}")
 
 
 def test_no_unreviewed_silent_exception_swallowing():

@@ -24,3 +24,20 @@ def test_micro_bench_smoke():
             "bitmap_index_build"} <= names
     assert all(d["value"] > 0 for d in lines)
 
+
+def test_write_bench_smoke():
+    """benchmarks/write_bench emits the serial + pipelined ingest lines
+    and asserts row-identity itself (a diverged run exits nonzero)."""
+    env = dict(os.environ, WRITE_ROWS="20000", WRITE_CHUNKS="4",
+               MICRO_RUNS="1", JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.write_bench", "ingest"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(line) for line in proc.stdout.splitlines()]
+    by_name = {d["benchmark"]: d for d in lines}
+    assert {"write_ingest_serial", "write_ingest_pipelined"} \
+        <= set(by_name)
+    assert by_name["write_ingest_pipelined"]["identical"] is True
+    assert all(d["value"] > 0 for d in lines)
+

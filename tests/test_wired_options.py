@@ -469,10 +469,14 @@ class TestSpillableWriteBuffer:
         assert total == 3 * 400
 
     def test_spill_dirs_cleaned_on_abort(self, tmp_path):
-        """close() without prepare_commit removes spill temp dirs."""
+        """close() without prepare_commit removes spill temp dirs.
+        Serial flush path: the mid-write spill-exists precondition is
+        deterministic only inline — the pipelined abort-cleanup twin
+        lives in test_write_pipeline.py."""
         t = _pk_table(tmp_path / "abort", {
             "write-buffer-size": "10kb",
-            "write-buffer-spillable": "true"})
+            "write-buffer-spillable": "true",
+            "write.flush.parallelism": "1"})
         wb = t.new_batch_write_builder()
         w = wb.new_write()
         for b in range(4):
