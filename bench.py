@@ -419,6 +419,11 @@ def scan_child_main():
                       "identical": bool(
                           serial.to_arrow().sort_by("id")
                           .equals(piped.to_arrow().sort_by("id")))}
+    # stage-level timings ride along: the obs plane's registry snapshot
+    # (split/merge/io/decode latency histograms + pipeline counters) so
+    # BENCH_* files carry per-stage evidence, not just the aggregate
+    from paimon_tpu.metrics import global_registry
+    out["metrics_snapshot"] = global_registry().snapshot()
     print(json.dumps(out))
 
 
@@ -463,6 +468,10 @@ def write_child_main():
         a = FileStoreTable.load(serial_path).to_arrow().sort_by("id")
         b = FileStoreTable.load(piped_path).to_arrow().sort_by("id")
         out["identical"] = bool(a.equals(b))
+    # stage-level timings (sort/encode/upload histograms + flush
+    # counters) for the BENCH_* record — see scan_child_main
+    from paimon_tpu.metrics import global_registry
+    out["metrics_snapshot"] = global_registry().snapshot()
     print(json.dumps(out))
 
 
@@ -508,6 +517,7 @@ def compose_write(result):
                  f"identical={result['identical']})"),
         "vs_serial": round(result["dt_serial"] / result["dt_pipelined"],
                            3),
+        "metrics_snapshot": result.get("metrics_snapshot"),
     }
 
 
@@ -561,6 +571,7 @@ def compose_scan(result):
                  f"identical={result['identical']}{agg_note})"),
         "vs_serial": round(result["dt_serial"] / result["dt_pipelined"],
                            3),
+        "metrics_snapshot": result.get("metrics_snapshot"),
     }
 
 

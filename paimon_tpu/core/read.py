@@ -320,23 +320,31 @@ class MergeFileSplitRead:
         engine = self.options.merge_engine
         seq_fields = self.options.sequence_field or None
         seq_desc = self.options.sequence_field_descending
-        if engine == MergeEngine.FIRST_ROW:
-            res = merge_runs(runs, self.key_cols, merge_engine="first-row",
-                             key_encoder=self.key_encoder,
-                             seq_fields=seq_fields, seq_desc=seq_desc)
-            out = res.take(value_cols)
-        elif engine in (MergeEngine.DEDUPLICATE,):
-            res = merge_runs(runs, self.key_cols,
-                             key_encoder=self.key_encoder,
-                             seq_fields=seq_fields, seq_desc=seq_desc)
-            out = res.take(value_cols)
-        else:
-            from paimon_tpu.ops.agg import merge_runs_agg
-            out = merge_runs_agg(runs, self.key_cols, self.schema,
-                                 self.options,
+        from paimon_tpu.metrics import SCAN_MERGE_MS
+        from paimon_tpu.obs.trace import span
+        with span("scan.merge", cat="scan", group="scan",
+                  metric=SCAN_MERGE_MS, engine=engine,
+                  partition=split.partition, bucket=split.bucket,
+                  runs=len(runs),
+                  rows=sum(r.num_rows for r in runs)):
+            if engine == MergeEngine.FIRST_ROW:
+                res = merge_runs(runs, self.key_cols,
+                                 merge_engine="first-row",
                                  key_encoder=self.key_encoder,
-                                 seq_fields=seq_fields
-                                 ).select(value_cols)
+                                 seq_fields=seq_fields, seq_desc=seq_desc)
+                out = res.take(value_cols)
+            elif engine in (MergeEngine.DEDUPLICATE,):
+                res = merge_runs(runs, self.key_cols,
+                                 key_encoder=self.key_encoder,
+                                 seq_fields=seq_fields, seq_desc=seq_desc)
+                out = res.take(value_cols)
+            else:
+                from paimon_tpu.ops.agg import merge_runs_agg
+                out = merge_runs_agg(runs, self.key_cols, self.schema,
+                                     self.options,
+                                     key_encoder=self.key_encoder,
+                                     seq_fields=seq_fields
+                                     ).select(value_cols)
         if split.for_streaming:
             out = out.append_column(
                 ROW_KIND_COL,

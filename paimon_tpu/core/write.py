@@ -222,22 +222,28 @@ class _BucketWriter:
         raw, kinds, seq = snap
 
         schema = self.parent.schema
-        kv = build_kv_table(raw, schema, seq, kinds)
-        key_cols = [KEY_PREFIX + k for k in schema.trimmed_primary_keys()]
-        engine = self.parent.options.merge_engine
-        if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
-            res = merge_runs([kv], key_cols, merge_engine=engine,
-                             drop_deletes=False,
-                             key_encoder=self.parent.key_encoder,
-                             seq_fields=self.parent.options.sequence_field
-                             or None,
-                             seq_desc=self.parent.options
-                             .sequence_field_descending)
-            sorted_kv = res.take()
-        else:
-            order = sort_table(kv, key_cols,
-                               key_encoder=self.parent.key_encoder)
-            sorted_kv = kv.take(pa.array(order))
+        from paimon_tpu.metrics import WRITE_SORT_MS
+        from paimon_tpu.obs.trace import span
+        with span("write.sort", cat="write", group="write",
+                  metric=WRITE_SORT_MS, partition=self.partition,
+                  bucket=self.bucket, rows=raw.num_rows):
+            kv = build_kv_table(raw, schema, seq, kinds)
+            key_cols = [KEY_PREFIX + k
+                        for k in schema.trimmed_primary_keys()]
+            engine = self.parent.options.merge_engine
+            if engine in (MergeEngine.DEDUPLICATE, MergeEngine.FIRST_ROW):
+                res = merge_runs([kv], key_cols, merge_engine=engine,
+                                 drop_deletes=False,
+                                 key_encoder=self.parent.key_encoder,
+                                 seq_fields=self.parent.options
+                                 .sequence_field or None,
+                                 seq_desc=self.parent.options
+                                 .sequence_field_descending)
+                sorted_kv = res.take()
+            else:
+                order = sort_table(kv, key_cols,
+                                   key_encoder=self.parent.key_encoder)
+                sorted_kv = kv.take(pa.array(order))
 
         changelog: List[DataFileMeta] = []
         if self.parent.changelog_input:

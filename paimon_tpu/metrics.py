@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
@@ -22,7 +23,12 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "SCAN_RANGE_CACHE_HIT_BYTES", "SCAN_PIPELINE_SPLITS",
            "SCAN_PIPELINE_BYTES", "SCAN_READ_RETRIES",
            "WRITE_FLUSHES", "WRITE_FLUSHED_BYTES", "WRITE_FLUSH_WAIT_MS",
-           "WRITE_INFLIGHT_BYTES", "WRITE_RETRIES"]
+           "WRITE_INFLIGHT_BYTES", "WRITE_RETRIES",
+           "SCAN_SPLIT_MS", "SCAN_MERGE_MS",
+           "WRITE_SORT_MS", "WRITE_FLUSH_TASK_MS",
+           "IO_READ_MS", "IO_DECODE_MS", "IO_ENCODE_MS", "IO_UPLOAD_MS",
+           "COMPACTION_WINDOW_MS", "COMPACTION_FALLBACK_MS",
+           "COMMIT_CAS_MS", "COMMIT_MANIFEST_ENCODE_MS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -58,6 +64,24 @@ WRITE_FLUSH_WAIT_MS = "flush_wait_ms"       # producer ms blocked on the
 WRITE_INFLIGHT_BYTES = "inflight_bytes"     # gauge: bytes in flight now
 WRITE_RETRIES = "write_retries"             # transient flush retries
 
+# per-stage latency HISTOGRAM names (obs plane: every obs.trace span
+# that names a (group, metric) lands its duration here, so the trace
+# timeline and the registry snapshot can never disagree; producers are
+# the span call sites in parallel/{scan,write}_pipeline.py,
+# core/{read,write,commit}.py, parallel/mesh_engine.py, format/format.py)
+SCAN_SPLIT_MS = "split_ms"                  # scan: whole read_split
+SCAN_MERGE_MS = "merge_ms"                  # scan: merge kernel
+WRITE_SORT_MS = "sort_ms"                   # write: buffer sort/dedup
+WRITE_FLUSH_TASK_MS = "flush_task_ms"       # write: whole flush task
+IO_READ_MS = "read_ms"                      # io: store -> bytes
+IO_DECODE_MS = "decode_ms"                  # io: bytes -> Arrow
+IO_ENCODE_MS = "encode_ms"                  # io: Arrow -> bytes
+IO_UPLOAD_MS = "upload_ms"                  # io: bytes -> store
+COMPACTION_WINDOW_MS = "window_ms"          # compaction: device window
+COMPACTION_FALLBACK_MS = "fallback_ms"      # compaction: 1-chip rescue
+COMMIT_CAS_MS = "cas_ms"                    # commit: one CAS publish
+COMMIT_MANIFEST_ENCODE_MS = "manifest_encode_ms"
+
 
 class Counter:
     def __init__(self):
@@ -70,7 +94,8 @@ class Counter:
 
     @property
     def count(self) -> int:
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Gauge:
@@ -88,22 +113,49 @@ class Gauge:
 
 class Histogram:
     """Sliding-window histogram (reference DescriptiveStatisticsHistogram
-    with window size 100)."""
+    with window size 100).
+
+    Thread-safe on BOTH sides: the window is a deque(maxlen=window), so
+    `update` is O(1) (the old list.pop(0) was O(n)), and every read
+    takes the lock — `sum()`/`max()` over a deque that another thread
+    is appending to raises "deque mutated during iteration", and even
+    the old list version could return torn means.
+
+    Besides the window, a cumulative `total_count`/`total_sum` pair is
+    tracked: Prometheus summary `_count`/`_sum` must be MONOTONIC for
+    rate()/increase() to work — window-derived values would cap at the
+    window size and fluctuate as samples rotate out.
+    """
 
     def __init__(self, window: int = 100):
         self.window = window
-        self._values: List[float] = []
+        self._values: deque = deque(maxlen=max(1, int(window)))
+        self._total_count = 0
+        self._total_sum = 0.0
         self._lock = threading.Lock()
 
     def update(self, v: float):
         with self._lock:
             self._values.append(v)
-            if len(self._values) > self.window:
-                self._values.pop(0)
+            self._total_count += 1
+            self._total_sum += v
+
+    @property
+    def total_count(self) -> int:
+        """Cumulative updates ever (monotonic; window-independent)."""
+        with self._lock:
+            return self._total_count
+
+    @property
+    def total_sum(self) -> float:
+        """Cumulative sum of every update ever (monotonic)."""
+        with self._lock:
+            return self._total_sum
 
     @property
     def count(self) -> int:
-        return len(self._values)
+        with self._lock:
+            return len(self._values)
 
     def percentile(self, p: float) -> float:
         with self._lock:
@@ -115,27 +167,48 @@ class Histogram:
 
     @property
     def mean(self) -> float:
-        return sum(self._values) / len(self._values) if self._values else 0.0
+        with self._lock:
+            if not self._values:
+                return 0.0
+            return sum(self._values) / len(self._values)
 
     @property
     def max(self) -> float:
-        return max(self._values) if self._values else 0.0
+        with self._lock:
+            return max(self._values) if self._values else 0.0
 
 
 class MetricGroup:
     def __init__(self, name: str):
         self.name = name
         self.metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, kind: type, factory: Callable):
+        """Lazy allocation (the old `setdefault(name, Kind())` built a
+        throwaway metric on every hot-path call once the name existed)
+        + kind safety (reusing a name across kinds used to silently
+        return the wrong type; now it raises)."""
+        with self._lock:
+            m = self.metrics.get(name)
+            if m is None:
+                m = factory()
+                self.metrics[name] = m
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} in group {self.name!r} is a "
+                    f"{type(m).__name__}, not a {kind.__name__}")
+            return m
 
     def counter(self, name: str) -> Counter:
-        return self.metrics.setdefault(name, Counter())
+        return self._get(name, Counter, Counter)
 
     def gauge(self, name: str,
               fn: Optional[Callable[[], float]] = None) -> Gauge:
-        return self.metrics.setdefault(name, Gauge(fn))
+        return self._get(name, Gauge, lambda: Gauge(fn))
 
     def histogram(self, name: str, window: int = 100) -> Histogram:
-        return self.metrics.setdefault(name, Histogram(window))
+        return self._get(name, Histogram, lambda: Histogram(window))
 
     def timer(self, histogram_name: str):
         """Context manager recording elapsed millis into a histogram."""
@@ -183,20 +256,57 @@ class MetricRegistry:
         """Expire / orphan-clean / fsck plane (ours)."""
         return self.group("maintenance", table)
 
-    def snapshot(self) -> Dict[str, Dict[str, object]]:
-        """{group: {metric: value}} for reporting."""
-        out: Dict[str, Dict[str, object]] = {}
-        for gname, group in self._groups.items():
-            d = {}
-            for mname, m in group.metrics.items():
+    def snapshot_rows(self) -> List[Dict[str, object]]:
+        """Flat typed rows — THE single serialization point behind
+        every observability surface (`$metrics` system table,
+        Prometheus exposition, bench `metrics_snapshot` blocks, the
+        CLI, and `snapshot()` itself):
+
+            {"group", "table", "metric", "kind", "value",
+             + for histograms: "count", "mean", "p95", "max"}
+
+        `value` is the counter count, the gauge value, or the
+        histogram mean.
+        """
+        with self._lock:
+            groups = list(self._groups.items())
+        rows: List[Dict[str, object]] = []
+        for gkey, group in groups:
+            gtype, _, gtable = gkey.partition(":")
+            with group._lock:
+                metrics = list(group.metrics.items())
+            for mname, m in metrics:
+                base = {"group": gtype, "table": gtable, "metric": mname}
                 if isinstance(m, Counter):
-                    d[mname] = m.count
+                    rows.append({**base, "kind": "counter",
+                                 "value": m.count})
                 elif isinstance(m, Gauge):
-                    d[mname] = m.value
+                    rows.append({**base, "kind": "gauge",
+                                 "value": m.value})
                 elif isinstance(m, Histogram):
-                    d[mname] = {"count": m.count, "mean": m.mean,
-                                "p95": m.percentile(95), "max": m.max}
-            out[gname] = d
+                    mean = m.mean
+                    rows.append({**base, "kind": "histogram",
+                                 "value": mean, "count": m.count,
+                                 "mean": mean,
+                                 "p95": m.percentile(95), "max": m.max,
+                                 "total_count": m.total_count,
+                                 "total_sum": m.total_sum})
+        return rows
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """{group: {metric: value}} for reporting (histograms render as
+        {count, mean, p95, max} dicts).  Built from snapshot_rows so
+        every surface serializes identically."""
+        out: Dict[str, Dict[str, object]] = {}
+        for r in self.snapshot_rows():
+            gkey = f"{r['group']}:{r['table']}" if r["table"] \
+                else r["group"]
+            d = out.setdefault(gkey, {})
+            if r["kind"] == "histogram":
+                d[r["metric"]] = {"count": r["count"], "mean": r["mean"],
+                                  "p95": r["p95"], "max": r["max"]}
+            else:
+                d[r["metric"]] = r["value"]
         return out
 
 

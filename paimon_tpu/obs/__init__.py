@@ -1,0 +1,29 @@
+"""Unified observability plane.
+
+One span-tracing layer (`obs/trace.py`) instruments every concurrent
+plane — scan pipeline, write pipeline, mesh compaction, fault ladders,
+commit — and one serialization point (`MetricRegistry.snapshot_rows`)
+feeds every surface:
+
+* Chrome trace-event JSON export (`obs/export.py`, opens in Perfetto);
+* `$metrics` / `$traces` system tables (`table/system.py`);
+* Prometheus text exposition (`GET /metrics` on the query service);
+* CLI: `paimon table metrics <db.table>` and `--trace out.json`.
+"""
+
+from paimon_tpu.obs.trace import (  # noqa: F401
+    Span, TraceCollector, collector, disable_tracing, enable_tracing,
+    metrics_enabled, set_metrics_enabled, span, sync_from_options,
+    take_spans, tracing_enabled,
+)
+from paimon_tpu.obs.export import (  # noqa: F401
+    export_chrome_trace, render_prometheus, to_chrome_trace,
+)
+
+__all__ = [
+    "Span", "TraceCollector", "collector", "disable_tracing",
+    "enable_tracing", "export_chrome_trace", "metrics_enabled",
+    "render_prometheus", "set_metrics_enabled", "span",
+    "sync_from_options", "take_spans", "to_chrome_trace",
+    "tracing_enabled",
+]

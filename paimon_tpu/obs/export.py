@@ -1,0 +1,139 @@
+"""Trace + metrics serialization: Chrome trace-event JSON (opens in
+Perfetto / chrome://tracing) and Prometheus text exposition.
+
+Both surfaces render from the shared collection points — the span ring
+(obs/trace.py) and `MetricRegistry.snapshot_rows()` — so the timeline,
+the `$metrics`/`$traces` system tables, the `/metrics` endpoint and the
+bench snapshots can never disagree.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["to_chrome_trace", "export_chrome_trace",
+           "render_prometheus"]
+
+_PID = 1          # one process per trace; threads are the tracks
+
+
+def _jsonable(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
+
+
+def to_chrome_trace(spans: Sequence) -> Dict:
+    """Chrome trace-event JSON object for a span list.  Every span
+    becomes a complete ("X") event on its thread's track, so worker
+    threads render as parallel tracks and IO/decode/merge overlap is
+    visible (and machine-checkable) directly from the file.
+
+    Tracks are keyed by (thread name, ident): the OS reuses idents as
+    pools come and go, so ident alone would fold a scan worker onto a
+    dead write worker's track — while two concurrently-live pools can
+    both own a "paimon-scan_0", so name alone would merge two distinct
+    workers into bogus nesting.  The pair is unique per live thread
+    and stable across the span list."""
+    events: List[Dict] = []
+    track_ids: Dict[tuple, int] = {}
+    track_names: Dict[int, str] = {}
+    for s in spans:
+        tid = track_ids.setdefault((s.thread, s.tid),
+                                   len(track_ids) + 1)
+        track_names[tid] = s.thread
+        events.append({
+            "name": s.name,
+            "cat": s.cat or "span",
+            "ph": "X",
+            "ts": round(s.start_us, 3),
+            "dur": round(max(s.dur_us, 0.001), 3),
+            "pid": _PID,
+            "tid": tid,
+            "args": {k: _jsonable(v) for k, v in s.attrs.items()},
+        })
+    for tid, name in track_names.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": _PID,
+                       "tid": tid, "args": {"name": name}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str, spans: Optional[Sequence] = None,
+                        clear: bool = False) -> str:
+    """Write the span ring (or an explicit span list) as Chrome trace
+    JSON; returns the path."""
+    from paimon_tpu.obs.trace import take_spans
+    if spans is None:
+        spans = take_spans(clear=clear)
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    return path
+
+
+# -- Prometheus text exposition ---------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(group: str, metric: str) -> str:
+    return _NAME_RE.sub("_", f"paimon_{group}_{metric}")
+
+
+def _prom_labels(table: str) -> str:
+    if not table:
+        return ""
+    esc = table.replace("\\", "\\\\").replace('"', '\\"')
+    return '{table="' + esc + '"}'
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(rows: Optional[List[Dict]] = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of the registry.
+
+    Counters/gauges map 1:1; histograms render as summaries — the p95
+    quantile comes from the sliding window, while `_sum`/`_count` are
+    the histogram's CUMULATIVE totals (monotonic, as rate()/increase()
+    require; window-derived values would cap at the window size) —
+    plus a `_max` gauge over the window.  `rows` defaults to
+    `global_registry().snapshot_rows()`, THE shared serialization
+    point.
+    """
+    if rows is None:
+        from paimon_tpu.metrics import global_registry
+        rows = global_registry().snapshot_rows()
+    # family name -> (kind, [(labels, line-suffix, value)])
+    families: Dict[str, List] = {}
+    kinds: Dict[str, str] = {}
+    for r in rows:
+        labels = _prom_labels(r.get("table", ""))
+        if r["kind"] == "histogram":
+            base = _prom_name(r["group"], r["metric"])
+            kinds[base] = "summary"
+            fam = families.setdefault(base, [])
+            q = '{quantile="0.95"}' if not labels else \
+                labels[:-1] + ',quantile="0.95"}'
+            fam.append((base + q, r["p95"]))
+            fam.append((base + "_sum" + labels,
+                        r.get("total_sum", r["mean"] * r["count"])))
+            fam.append((base + "_count" + labels,
+                        r.get("total_count", r["count"])))
+            mx = base + "_max"
+            kinds[mx] = "gauge"
+            families.setdefault(mx, []).append((mx + labels, r["max"]))
+        else:
+            name = _prom_name(r["group"], r["metric"])
+            kinds[name] = "counter" if r["kind"] == "counter" else "gauge"
+            families.setdefault(name, []).append(
+                (name + labels, r["value"]))
+    lines: List[str] = []
+    for fam in sorted(families):
+        lines.append(f"# TYPE {fam} {kinds[fam]}")
+        for series, value in families[fam]:
+            lines.append(f"{series} {_fmt(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")

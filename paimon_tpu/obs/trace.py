@@ -1,0 +1,320 @@
+"""Low-overhead structured span tracing.
+
+Design constraints, in priority order:
+
+1. **No-op when disabled.**  ``span(...)`` is called on every pipeline
+   stage of every hot path; with tracing off it must cost one module
+   flag check.  The disabled call returns a shared singleton context
+   manager (no allocation beyond the caller's kwargs dict, which is
+   built per *stage* — per split / per flush / per window — never per
+   row).  `benchmarks/micro.py` ``obs`` measures the disabled path at
+   <2% of scan wall time vs an uninstrumented baseline, asserted by a
+   tier-1 test (tests/test_obs.py).
+2. **Thread-safe bounded collection.**  Spans land in a ring
+   (`collections.deque(maxlen=trace.buffer.spans)`) under one lock;
+   an unbounded trace can never OOM a long-running service.
+3. **Nestable.**  A `contextvars.ContextVar` tracks the current span,
+   so children record their parent id without any caller plumbing.
+   Worker threads start fresh contexts, which is exactly right: each
+   pool thread is its own track in the Chrome trace.
+4. **One timing, two sinks.**  A span that names a ``group``/``metric``
+   also lands its duration in that metric group's latency histogram
+   (`metrics.py`), so the registry snapshot and the trace timeline can
+   never disagree about what was measured.
+
+Enabling is process-global (the planes share thread pools, so
+per-table tracing would tear one timeline into halves): call
+`enable_tracing()` / `disable_tracing()` directly (CLI `--trace`,
+tests), or set the `trace.enabled` / `metrics.enabled` table options —
+every pipeline entry point calls `sync_from_options`, where an
+explicitly-set key wins and an absent key leaves the current state
+untouched (so an explicit `enable_tracing()` is not silently reverted
+by the next untraced table's scan).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["Span", "TraceCollector", "span", "enable_tracing",
+           "disable_tracing", "tracing_enabled", "set_metrics_enabled",
+           "metrics_enabled", "collector", "take_spans",
+           "sync_from_options", "export_path"]
+
+DEFAULT_BUFFER_SPANS = 8192
+
+
+class Span:
+    """One completed timed region. `start_us` is microseconds on the
+    process-wide perf_counter timeline (Chrome trace ts unit)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "cat", "start_us",
+                 "dur_us", "tid", "thread", "attrs")
+
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str,
+                 cat: str, start_us: float, dur_us: float, tid: int,
+                 thread: str, attrs: Dict):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.cat = cat
+        self.start_us = start_us
+        self.dur_us = dur_us
+        self.tid = tid
+        self.thread = thread
+        self.attrs = attrs
+
+    def overlaps(self, other: "Span") -> bool:
+        """Wall-clock interval intersection (tests/benchmarks)."""
+        return self.start_us < other.start_us + other.dur_us and \
+            other.start_us < self.start_us + self.dur_us
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, {self.dur_us / 1000.0:.3f}ms, "
+                f"thread={self.thread!r}, attrs={self.attrs})")
+
+
+class TraceCollector:
+    """Thread-safe bounded span ring; oldest spans evict first."""
+
+    def __init__(self, max_spans: int = DEFAULT_BUFFER_SPANS):
+        self.max_spans = max(1, int(max_spans))
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=self.max_spans)
+        self.dropped = 0          # evicted by the ring bound
+
+    def add(self, s: Span):
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(s)
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def resize(self, max_spans: int):
+        max_spans = max(1, int(max_spans))
+        with self._lock:
+            if max_spans != self.max_spans:
+                self.max_spans = max_spans
+                self._spans = deque(self._spans, maxlen=max_spans)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+
+# -- process-global state ---------------------------------------------------
+
+_enabled = False
+_metrics_on = True
+_collector = TraceCollector()
+_export_path: Optional[str] = None
+_ids = itertools.count(1)
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "paimon_current_span", default=None)
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _MetricSpan:
+    """Tracing disabled, metrics enabled: time the region into its
+    latency histogram only — no ring append, no contextvar."""
+
+    __slots__ = ("group", "metric", "t0")
+
+    def __init__(self, group: str, metric: str):
+        self.group = group
+        self.metric = metric
+
+    def set(self, **attrs):
+        return self
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        from paimon_tpu.metrics import global_registry
+        global_registry().group(self.group).histogram(self.metric) \
+            .update((time.perf_counter() - self.t0) * 1000.0)
+        return False
+
+
+class _LiveSpan:
+    """Tracing enabled: full span with nesting + ring + histogram."""
+
+    __slots__ = ("name", "cat", "group", "metric", "attrs", "t0",
+                 "span_id", "_token")
+
+    def __init__(self, name: str, cat: str, group: Optional[str],
+                 metric: Optional[str], attrs: Dict):
+        self.name = name
+        self.cat = cat
+        self.group = group
+        self.metric = metric
+        self.attrs = attrs
+
+    def set(self, **attrs):
+        """Attach attrs mid-span (e.g. a result size known at the end)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self.span_id = next(_ids)
+        self._token = _current.set(self.span_id)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        _current.reset(self._token)
+        parent = _current.get()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        t = threading.current_thread()
+        _collector.add(Span(
+            self.span_id, parent, self.name, self.cat,
+            self.t0 * 1e6, (t1 - self.t0) * 1e6,
+            t.ident or 0, t.name, self.attrs))
+        if self.group is not None and _metrics_on:
+            from paimon_tpu.metrics import global_registry
+            global_registry().group(self.group).histogram(self.metric) \
+                .update((t1 - self.t0) * 1000.0)
+        return False
+
+
+def span(name: str, *, cat: str = "", group: Optional[str] = None,
+         metric: Optional[str] = None, **attrs):
+    """Context manager timing one stage.
+
+    `cat` buckets spans for the Chrome trace; `group`+`metric` also
+    land the duration in `global_registry().group(group)`'s
+    `histogram(metric)` (use the *_MS constants from metrics.py so the
+    name-drift test sees the producer).  Extra kwargs become span
+    attributes (table/partition/bucket/snapshot/attempt...) — pass raw
+    values, stringification happens at export time.
+    """
+    if not _enabled:
+        if group is not None and _metrics_on:
+            return _MetricSpan(group, metric or name)
+        return _NOOP
+    return _LiveSpan(name, cat, group, metric or name, attrs)
+
+
+# -- switches ----------------------------------------------------------------
+
+def enable_tracing(max_spans: Optional[int] = None):
+    global _enabled
+    if max_spans is not None:
+        _collector.resize(max_spans)
+    _enabled = True
+
+
+def disable_tracing():
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def set_metrics_enabled(flag: bool):
+    global _metrics_on
+    _metrics_on = bool(flag)
+
+
+def metrics_enabled() -> bool:
+    return _metrics_on
+
+
+def collector() -> TraceCollector:
+    return _collector
+
+
+def take_spans(clear: bool = False) -> List[Span]:
+    out = _collector.snapshot()
+    if clear:
+        _collector.clear()
+    return out
+
+
+def export_path() -> Optional[str]:
+    return _export_path
+
+
+def sync_from_options(options) -> None:
+    """Sync the process-global switches from a table's options at a
+    pipeline entry point.  Explicitly-set keys win; absent keys leave
+    the current state untouched.  `options` is a CoreOptions (or
+    anything exposing `.options` with contains/get), or None."""
+    global _export_path
+    if options is None:
+        return
+    raw = getattr(options, "options", None)
+    if raw is None or not hasattr(raw, "contains"):
+        return
+    from paimon_tpu.options import CoreOptions
+    if raw.contains(CoreOptions.TRACE_ENABLED):
+        if raw.get(CoreOptions.TRACE_ENABLED):
+            # only resize when the key is explicitly set — the option
+            # DEFAULT must not shrink a ring a caller enlarged via
+            # enable_tracing(max_spans=...) (resizing drops spans)
+            enable_tracing(
+                raw.get(CoreOptions.TRACE_BUFFER_SPANS)
+                if raw.contains(CoreOptions.TRACE_BUFFER_SPANS)
+                else None)
+        else:
+            disable_tracing()
+    if raw.contains(CoreOptions.METRICS_ENABLED):
+        set_metrics_enabled(bool(raw.get(CoreOptions.METRICS_ENABLED)))
+    if raw.contains(CoreOptions.TRACE_EXPORT_PATH):
+        _export_path = raw.get(CoreOptions.TRACE_EXPORT_PATH)
+
+
+def maybe_export() -> Optional[str]:
+    """Flush the ring to `trace.export.path` if configured (called at
+    pipeline completion points); returns the path written, or None.
+
+    An export failure (unwritable path) must never fail — or, from a
+    `finally`, MASK the error of — the data path it observes: it
+    warns and returns None instead."""
+    if _export_path is None or not _enabled:
+        return None
+    from paimon_tpu.obs.export import export_chrome_trace
+    try:
+        export_chrome_trace(_export_path)
+    except OSError as e:
+        import warnings
+        warnings.warn(f"trace export to {_export_path!r} failed: {e}",
+                      RuntimeWarning)
+        return None
+    return _export_path

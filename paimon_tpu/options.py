@@ -471,6 +471,37 @@ class CoreOptions:
         "Base wait between bucket-flush retries; actual waits use "
         "capped decorrelated jitter (utils/backoff.py)")
 
+    # -- observability (ours; paimon_tpu/obs/) -------------------------------
+    METRICS_ENABLED = ConfigOption(
+        "metrics.enabled", _parse_bool, True,
+        "Record per-stage latency histograms + counters into the "
+        "process metric registry (metrics.py), the source of the "
+        "$metrics system table, the Prometheus /metrics endpoint and "
+        "bench snapshots; false turns the span timers into no-ops. "
+        "Process-global switch, synced from table options at pipeline "
+        "entry — an explicitly-set value wins, an absent key leaves "
+        "the current process state")
+    TRACE_ENABLED = ConfigOption(
+        "trace.enabled", _parse_bool, False,
+        "Collect structured spans (obs/trace.py) from the scan/write/"
+        "compaction/commit planes into the bounded in-process ring, "
+        "queryable via the $traces system table and exportable as "
+        "Chrome trace-event JSON (Perfetto).  Off by default: the "
+        "disabled call path is a no-op measured <2% of scan wall time "
+        "(benchmarks/micro.py obs).  Process-global switch like "
+        "metrics.enabled")
+    TRACE_BUFFER_SPANS = ConfigOption(
+        "trace.buffer.spans", int, 8192,
+        "Capacity of the bounded span ring; the oldest spans evict "
+        "first, so a long-running traced service cannot grow without "
+        "bound")
+    TRACE_EXPORT_PATH = ConfigOption(
+        "trace.export.path", str, None,
+        "When set (with trace.enabled), the span ring is flushed to "
+        "this file as Chrome trace-event JSON at pipeline completion "
+        "points (scan drained, write pool shut down, mesh compaction "
+        "finished); the CLI --trace flag is the one-shot equivalent")
+
     # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
     SCAN_PLAN_SORT_PARTITION = ConfigOption(
         "scan.plan-sort-partition", _parse_bool, False,

@@ -86,7 +86,10 @@ class BucketRetryPolicy:
     def retry_call(self, fn, *, on_retry=None):
         """Run `fn` under this policy: transient errors retry with
         backoff up to max_attempts total attempts, then re-raise.
-        Non-transient errors propagate immediately."""
+        Non-transient errors propagate immediately.  Each backoff
+        sleep is a traced span (obs/trace.py) carrying the attempt
+        number and error class, so retry storms are visible on the
+        timeline instead of reading as unexplained gaps."""
         backoff = self.new_backoff()
         attempt = 0
         while True:
@@ -99,4 +102,7 @@ class BucketRetryPolicy:
                     raise
                 if on_retry is not None:
                     on_retry(attempt, e)
-                backoff.pause()
+                from paimon_tpu.obs.trace import span
+                with span("retry.backoff", cat="compaction",
+                          attempt=attempt, error=type(e).__name__):
+                    backoff.pause()

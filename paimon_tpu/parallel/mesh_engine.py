@@ -523,6 +523,9 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
             compact_before=job.files, compact_after=job.metas))
 
     # -- per-bucket fault isolation (module docstring §4) -------------------
+    from paimon_tpu.obs import trace as _trace
+    from paimon_tpu.obs.trace import span as _obs_span
+    _trace.sync_from_options(table.options)
     policy = retry_policy or BucketRetryPolicy.from_options(table.options)
     fault_metrics = global_registry().compaction_metrics()
     attempts: Dict[Tuple, int] = {}
@@ -562,11 +565,18 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
         from paimon_tpu.compact.manager import MergeTreeCompactManager
 
         def run():
-            mgr = MergeTreeCompactManager(
-                table.file_io, table.path, table.schema, table.options,
-                split.partition, split.bucket, list(split.data_files),
-                schema_manager=table.schema_manager)
-            return mgr.compact(full=True)
+            from paimon_tpu.metrics import COMPACTION_FALLBACK_MS
+            with _obs_span("compaction.fallback", cat="compaction",
+                           group="compaction",
+                           metric=COMPACTION_FALLBACK_MS,
+                           partition=split.partition,
+                           bucket=split.bucket, table=table.path):
+                mgr = MergeTreeCompactManager(
+                    table.file_io, table.path, table.schema,
+                    table.options, split.partition, split.bucket,
+                    list(split.data_files),
+                    schema_manager=table.schema_manager)
+                return mgr.compact(full=True)
 
         result = policy.retry_call(run)
         if result is None or result.is_empty():
@@ -642,7 +652,10 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
             if deadlines:
                 wait = min(deadlines) - _time.monotonic()
                 if wait > 0:
-                    _time.sleep(wait)
+                    with _obs_span("compaction.backoff_wait",
+                                   cat="compaction",
+                                   pending=len(deadlines)):
+                        _time.sleep(wait)
             continue
         # assemble each active lane's window; truncated-key windows take
         # the exact host merge instead of the device kernel
@@ -695,7 +708,15 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
             seq_lo[li, :k] = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             invalid[li, :k] = 0
         try:
-            perm, winner, _ = kernel(lanes_arr, seq_hi, seq_lo, invalid)
+            from paimon_tpu.metrics import COMPACTION_WINDOW_MS
+            with _obs_span("compaction.window", cat="compaction",
+                           group="compaction",
+                           metric=COMPACTION_WINDOW_MS,
+                           lanes=sum(1 for e in device_rows
+                                     if e is not None),
+                           rows=n_max, table=table.path):
+                perm, winner, _ = kernel(lanes_arr, seq_hi, seq_lo,
+                                         invalid)
         except Exception as e:              # noqa: BLE001
             # a kernel failure is a lane/device failure for every
             # bucket in flight this step: each rides its own ladder
@@ -718,8 +739,10 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
                                          wtable.num_rows)
 
     if not messages:
+        _trace.maybe_export()
         return stats
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
                              table.options, branch=table.branch)
     stats.snapshot_id = commit.commit(messages)
+    _trace.maybe_export()
     return stats

@@ -123,7 +123,10 @@ def read_file_retrying(fn: Callable[[], object],
                 .counter(SCAN_READ_RETRIES).inc()
             if backoff is None:
                 backoff = Backoff(base_ms)
-            backoff.pause()
+            from paimon_tpu.obs.trace import span as _span
+            with _span("retry.backoff", cat="scan", attempt=attempt,
+                       what=what, error=type(e).__name__):
+                backoff.pause()
 
 
 def read_or_skip_corrupt(fn: Callable[[], object],
@@ -168,9 +171,12 @@ def iter_split_tables(read, splits: Sequence,
     {"parallelism", "peak_inflight_bytes", "max_inflight_splits",
     "submitted"} for tests/benchmarks.
     """
+    from paimon_tpu.obs import trace as _trace
+
     splits = list(splits)
     if options is None:
         options = getattr(read, "options", None)
+    _trace.sync_from_options(options)
     par = resolve_parallelism(options)
     if stats is not None:
         stats.setdefault("parallelism", par)
@@ -179,6 +185,7 @@ def iter_split_tables(read, splits: Sequence,
         stats.setdefault("submitted", 0)
     if par <= 1 or len(splits) <= 1:
         # serial fast path: no pool, identical to the legacy loop
+        table_path = getattr(read, "table_path", None)
         for i, s in enumerate(splits):
             if stats is not None:
                 b = _estimated_bytes(s)
@@ -187,10 +194,26 @@ def iter_split_tables(read, splits: Sequence,
                     stats["peak_inflight_bytes"], b)
                 stats["max_inflight_splits"] = max(
                     stats["max_inflight_splits"], 1)
-            yield i, s, read.read_split(s)
+            yield i, s, _read_split_traced(read, s, table_path)
+        _trace.maybe_export()
         return
     yield from _iter_pipelined(read, splits, options, par,
                                ordered=ordered, stats=stats)
+
+
+def _read_split_traced(read, split, table_path):
+    """One full split read (IO + decode + merge) under a `scan.split`
+    span — the per-worker track whose overlap across workers is the
+    pipeline's whole point; IO/decode get their own child spans in
+    format/format.py, merge in core/read.py."""
+    from paimon_tpu.metrics import SCAN_SPLIT_MS
+    from paimon_tpu.obs.trace import span
+    with span("scan.split", cat="scan", group="scan",
+              metric=SCAN_SPLIT_MS, table=table_path,
+              partition=getattr(split, "partition", None),
+              bucket=getattr(split, "bucket", None),
+              files=len(getattr(split, "data_files", ()))):
+        return read.read_split(split)
 
 
 def _iter_pipelined(read, splits, options, par, *, ordered, stats):
@@ -199,6 +222,8 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
     from paimon_tpu.metrics import (
         SCAN_PIPELINE_BYTES, SCAN_PIPELINE_SPLITS, global_registry,
     )
+    from paimon_tpu.obs import trace as _trace
+    from paimon_tpu.obs.trace import span as _span
 
     if options is not None:
         extra = options.get(CoreOptions.READ_PREFETCH_SPLITS)
@@ -214,6 +239,7 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
 
     from paimon_tpu.parallel.executors import new_thread_pool
     pool = new_thread_pool(par, "paimon-scan")
+    table_path = getattr(read, "table_path", None)
     inflight = deque()        # [index, split, est_bytes, future]
     inflight_bytes = 0
     next_i = 0
@@ -227,8 +253,13 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
                      <= max_bytes):
                 s = splits[next_i]
                 b = _estimated_bytes(s)
-                inflight.append(
-                    [next_i, s, b, pool.submit(read.read_split, s)])
+                with _span("scan.admit", cat="scan", split=next_i,
+                           bucket=getattr(s, "bucket", None),
+                           est_bytes=b):
+                    inflight.append(
+                        [next_i, s, b,
+                         pool.submit(_read_split_traced, read, s,
+                                     table_path)])
                 inflight_bytes += b
                 next_i += 1
                 c_splits.inc()
@@ -269,3 +300,4 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
         for entry in inflight:
             entry[3].cancel()
         pool.shutdown(wait=not abandoned, cancel_futures=True)
+        _trace.maybe_export()

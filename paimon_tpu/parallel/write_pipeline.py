@@ -115,7 +115,10 @@ def flush_retrying(fn: Callable[[], object],
                 .counter(WRITE_RETRIES).inc()
             if backoff is None:
                 backoff = Backoff(base_ms)
-            backoff.pause()
+            from paimon_tpu.obs.trace import span as _span
+            with _span("retry.backoff", cat="write", attempt=attempt,
+                       what=what, error=type(e).__name__):
+                backoff.pause()
 
 
 class FlushPool:
@@ -160,6 +163,8 @@ class FlushPool:
         self._c_bytes = group.counter(WRITE_FLUSHED_BYTES)
         self._c_wait = group.counter(WRITE_FLUSH_WAIT_MS)
         self._g_inflight = group.gauge(WRITE_INFLIGHT_BYTES)
+        from paimon_tpu.obs import trace as _trace
+        _trace.sync_from_options(options)
 
     @classmethod
     def from_options(cls, options: Optional[CoreOptions]) -> "FlushPool":
@@ -187,7 +192,7 @@ class FlushPool:
             self.peak_inflight_bytes = max(self.peak_inflight_bytes,
                                            est_bytes)
             self.max_inflight_tasks = max(self.max_inflight_tasks, 1)
-            flush_retrying(fn, self.options)
+            self._run_task(key, fn)
             return
         with self._cond:
             self._check_poisoned()
@@ -196,13 +201,25 @@ class FlushPool:
             # backpressure: block while over budget, unless the pool is
             # empty (always admit one so a small budget cannot stall)
             waited = None
-            while self._inflight_tasks > 0 and \
-                    self._inflight_bytes + est_bytes > self.max_bytes:
-                if waited is None:
-                    waited = time.perf_counter()
-                self._cond.wait(timeout=0.5)
-                if self._error is not None:
-                    raise self._first_error()
+            wait_span = None
+            try:
+                while self._inflight_tasks > 0 and \
+                        self._inflight_bytes + est_bytes > self.max_bytes:
+                    if waited is None:
+                        waited = time.perf_counter()
+                        from paimon_tpu.obs.trace import span as _span
+                        wait_span = _span("write.flush_wait",
+                                          cat="write", key=key,
+                                          est_bytes=est_bytes)
+                        wait_span.__enter__()
+                    self._cond.wait(timeout=0.5)
+                    if self._error is not None:
+                        raise self._first_error()
+            finally:
+                # always close the span (KeyboardInterrupt included) or
+                # the producer thread's contextvar keeps a dead parent
+                if wait_span is not None:
+                    wait_span.__exit__(None, None, None)
             if waited is not None:
                 self._c_wait.inc(
                     int((time.perf_counter() - waited) * 1000))
@@ -261,8 +278,23 @@ class FlushPool:
             pool, self._pool = self._pool, None
         if pool is not None:
             pool.shutdown(wait=wait, cancel_futures=True)
+        from paimon_tpu.obs import trace as _trace
+        _trace.maybe_export()
 
     # -- worker side ---------------------------------------------------------
+
+    def _run_task(self, key, fn):
+        """One flush task (sort + encode + upload) under its span —
+        per-bucket-actor tracks in the trace; sort/encode/upload child
+        spans come from core/write.py and format/format.py."""
+        from paimon_tpu.metrics import WRITE_FLUSH_TASK_MS
+        from paimon_tpu.obs.trace import span
+        part, bucket = key if isinstance(key, tuple) and len(key) == 2 \
+            else (None, key)
+        with span("write.flush", cat="write", group="write",
+                  metric=WRITE_FLUSH_TASK_MS, partition=part,
+                  bucket=bucket):
+            flush_retrying(fn, self.options)
 
     def _first_error(self) -> BaseException:
         return RuntimeError("write pipeline already failed; "
@@ -296,7 +328,7 @@ class FlushPool:
                     return
                 est, fn = q.popleft()
             try:
-                flush_retrying(fn, self.options)
+                self._run_task(key, fn)
             except BaseException as e:      # noqa: BLE001 — latched
                 with self._cond:
                     if self._error is None:

@@ -122,6 +122,30 @@ class KvQueryServer:
             def log_message(self, *a):
                 pass
 
+            def do_GET(self):
+                """Prometheus scrape endpoint: the whole process
+                registry (scan/write/compaction/commit groups + stage
+                latency histograms) in text exposition 0.0.4, rendered
+                from MetricRegistry.snapshot_rows — the same
+                serialization the $metrics system table queries."""
+                if self.path != "/metrics":
+                    self.send_error(404)
+                    return
+                try:
+                    from paimon_tpu.obs.export import render_prometheus
+                    body = render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                except Exception as e:      # noqa: BLE001
+                    body = str(e).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):
                 if self.path == "/lookup":
                     handle = self._lookup

@@ -350,6 +350,88 @@ def _row_tracking(table) -> pa.Table:
         "file_name": pa.array([], pa.string())})
 
 
+_METRICS_SCHEMA = pa.schema([
+    ("group", pa.string()), ("table", pa.string()),
+    ("metric", pa.string()), ("kind", pa.string()),
+    ("value", pa.float64()), ("count", pa.int64()),
+    ("mean", pa.float64()), ("p95", pa.float64()),
+    ("max", pa.float64())])
+
+
+def _metrics(table) -> pa.Table:
+    """Live process metric registry as rows (ours; the observability
+    plane's queryable surface).  One row per metric, histograms carry
+    count/mean/p95/max; serialized via MetricRegistry.snapshot_rows —
+    the same point behind the Prometheus endpoint and bench snapshots.
+    The schema is pinned: inferred types would flip to null when e.g.
+    no histogram exists yet."""
+    from paimon_tpu.metrics import global_registry
+    rows = []
+    for r in global_registry().snapshot_rows():
+        rows.append({
+            "group": r["group"],
+            "table": r["table"] or None,
+            "metric": r["metric"],
+            "kind": r["kind"],
+            "value": float(r["value"]),
+            "count": int(r["count"]) if r["kind"] == "histogram"
+            else None,
+            "mean": float(r["mean"]) if r["kind"] == "histogram"
+            else None,
+            "p95": float(r["p95"]) if r["kind"] == "histogram" else None,
+            "max": float(r["max"]) if r["kind"] == "histogram" else None,
+        })
+    return pa.Table.from_pylist(rows, schema=_METRICS_SCHEMA)
+
+
+_TRACES_SCHEMA = pa.schema([
+    ("name", pa.string()), ("cat", pa.string()),
+    ("thread", pa.string()), ("tid", pa.int64()),
+    ("span_id", pa.int64()), ("parent_id", pa.int64()),
+    ("start_us", pa.int64()), ("dur_us", pa.int64()),
+    ("table", pa.string()), ("partition", pa.string()),
+    ("bucket", pa.int64()), ("snapshot", pa.int64()),
+    ("attempt", pa.int64()), ("attrs", pa.string())])
+
+
+def _traces(table) -> pa.Table:
+    """Recent spans from the bounded trace ring (ours).  Well-known
+    attributes (table/partition/bucket/snapshot/attempt) get columns;
+    the rest land in an `attrs` JSON column.  Empty (typed) unless
+    trace.enabled / obs.enable_tracing() collected spans; the schema
+    is pinned so all-null columns don't infer as null type."""
+    import json as _json
+
+    from paimon_tpu.obs.trace import take_spans
+    rows = []
+    for s in take_spans():
+        attrs = dict(s.attrs)
+        bucket = attrs.pop("bucket", None)
+        snap = attrs.pop("snapshot", None)
+        attempt = attrs.pop("attempt", None)
+        rows.append({
+            "name": s.name,
+            "cat": s.cat or None,
+            "thread": s.thread,
+            "tid": s.tid,
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "start_us": int(s.start_us),
+            "dur_us": int(s.dur_us),
+            "table": _opt_str(attrs.pop("table", None)),
+            "partition": _opt_str(attrs.pop("partition", None)),
+            "bucket": bucket if isinstance(bucket, int) else None,
+            "snapshot": snap if isinstance(snap, int) else None,
+            "attempt": attempt if isinstance(attempt, int) else None,
+            "attrs": _json.dumps(attrs, default=str) if attrs else None,
+        })
+    return pa.Table.from_pylist(rows, schema=_TRACES_SCHEMA)
+
+
+def _opt_str(v):
+    return None if v is None else str(v)
+
+
 SYSTEM_TABLES: Dict[str, Callable] = {
     "snapshots": _snapshots,
     "schemas": _schemas,
@@ -369,6 +451,8 @@ SYSTEM_TABLES: Dict[str, Callable] = {
     "table_indexes": _table_indexes,
     "file_key_ranges": _file_key_ranges,
     "row_tracking": _row_tracking,
+    "metrics": _metrics,
+    "traces": _traces,
 }
 
 

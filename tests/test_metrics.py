@@ -1,0 +1,226 @@
+"""Metric-registry hygiene: concurrent-update safety for
+Histogram/MetricRegistry/CompactTimer, lazy allocation + kind safety
+in MetricGroup, and a grep-based drift test asserting every exported
+metric-name constant in metrics.py has a producer in paimon_tpu/
+(the analog of the options drift test in test_docs.py)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from paimon_tpu.metrics import (
+    CompactTimer, Counter, Gauge, Histogram, MetricGroup, MetricRegistry,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- Histogram ---------------------------------------------------------------
+
+def test_histogram_window_semantics():
+    h = Histogram(window=100)
+    for i in range(1000):
+        h.update(float(i))
+    # deque(maxlen) keeps exactly the trailing window
+    assert h.count == 100
+    assert h.max == 999.0
+    assert h.mean == sum(range(900, 1000)) / 100
+    assert h.percentile(0) == 900.0
+    assert h.percentile(100) == 999.0
+    # cumulative totals are monotonic and window-independent
+    # (Prometheus _sum/_count must never decrease or cap at the window)
+    assert h.total_count == 1000
+    assert h.total_sum == float(sum(range(1000)))
+
+
+def test_histogram_concurrent_update_and_read():
+    """Readers take the lock: an unlocked sum()/max() over a deque
+    another thread is appending to raises 'deque mutated during
+    iteration' — this is the regression test for that."""
+    h = Histogram(window=128)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            h.update(float(i % 1000))
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                assert h.mean >= 0.0
+                assert h.max >= 0.0
+                assert 0 <= h.count <= 128
+                assert h.percentile(95) >= 0.0
+        except Exception as e:              # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=writer, name=f"hist-w{i}")
+               for i in range(2)]
+    threads += [threading.Thread(target=reader, name=f"hist-r{i}")
+                for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not errors, errors
+    assert h.count <= 128
+
+
+# -- MetricGroup -------------------------------------------------------------
+
+def test_metric_group_lazy_allocation_identity():
+    g = MetricGroup("g")
+    c = g.counter("a")
+    assert g.counter("a") is c          # no throwaway object per call
+    h = g.histogram("h", window=7)
+    assert g.histogram("h") is h
+    assert h.window == 7                # later window args don't clobber
+    gauge = g.gauge("v")
+    assert g.gauge("v") is gauge
+
+
+def test_metric_group_kind_mismatch_raises():
+    g = MetricGroup("g")
+    g.counter("x")
+    with pytest.raises(TypeError, match="x.*Counter"):
+        g.histogram("x")
+    with pytest.raises(TypeError):
+        g.gauge("x")
+    g.histogram("h")
+    with pytest.raises(TypeError):
+        g.counter("h")
+
+
+def test_metric_group_concurrent_creation():
+    """Many threads racing to create the same metric must all get the
+    SAME object (a torn setdefault would drop increments)."""
+    g = MetricGroup("g")
+    results = []
+
+    def grab():
+        results.append(g.counter("shared"))
+
+    threads = [threading.Thread(target=grab, name=f"mg-{i}")
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert len({id(c) for c in results}) == 1
+
+
+# -- MetricRegistry ----------------------------------------------------------
+
+def test_registry_concurrent_updates():
+    reg = MetricRegistry()
+    n_threads, n_incs = 8, 2000
+
+    def work(i):
+        for _ in range(n_incs):
+            reg.group("g", "t").counter("c").inc()
+            reg.group("g", "t").histogram("h").update(1.0)
+
+    threads = [threading.Thread(target=work, args=(i,), name=f"reg-{i}")
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    snap = reg.snapshot()
+    assert snap["g:t"]["c"] == n_threads * n_incs
+    assert snap["g:t"]["h"]["count"] == 100      # window bound
+
+
+def test_snapshot_rows_is_the_single_serialization_point():
+    reg = MetricRegistry()
+    g = reg.scan_metrics("tbl")
+    g.counter("c").inc(3)
+    g.gauge("v").set(1.5)
+    g.histogram("h").update(10.0)
+    rows = {(r["group"], r["table"], r["metric"]): r
+            for r in reg.snapshot_rows()}
+    assert rows[("scan", "tbl", "c")]["kind"] == "counter"
+    assert rows[("scan", "tbl", "c")]["value"] == 3
+    assert rows[("scan", "tbl", "v")]["kind"] == "gauge"
+    assert rows[("scan", "tbl", "v")]["value"] == 1.5
+    h = rows[("scan", "tbl", "h")]
+    assert h["kind"] == "histogram" and h["count"] == 1 \
+        and h["max"] == 10.0
+    assert h["total_count"] == 1 and h["total_sum"] == 10.0
+    # snapshot() is derived from the same rows
+    snap = reg.snapshot()
+    assert snap["scan:tbl"]["c"] == 3
+    assert snap["scan:tbl"]["h"]["count"] == 1
+
+
+def test_counter_gauge_basics():
+    c = Counter()
+    c.inc()
+    c.inc(4)
+    assert c.count == 5
+    g = Gauge(lambda: 7.0)
+    assert g.value == 7.0
+
+
+# -- CompactTimer ------------------------------------------------------------
+
+def test_compact_timer_concurrent_start_stop():
+    t = CompactTimer(window_ms=60_000)
+    errors = []
+
+    def work():
+        try:
+            for _ in range(300):
+                t.start()
+                t.stop()
+                t.busy_millis()
+        except Exception as e:              # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, name=f"ct-{i}")
+               for i in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30)
+    assert not errors, errors
+    assert t._depth == 0                    # every start matched a stop
+    assert t.busy_millis() >= 0
+
+
+# -- metric-name drift -------------------------------------------------------
+
+def test_metric_name_constants_are_produced():
+    """Every exported ALL_CAPS metric-name constant in metrics.py must
+    be referenced by name somewhere else in paimon_tpu/ — an orphaned
+    constant means a dashboard/test greps for a metric nothing emits
+    (grep-based, like the options drift test in test_docs.py)."""
+    import paimon_tpu.metrics as M
+
+    pkg = os.path.join(REPO, "paimon_tpu")
+    sources = []
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            if os.path.samefile(path, M.__file__):
+                continue
+            with open(path) as f:
+                sources.append(f.read())
+    blob = "\n".join(sources)
+    consts = [n for n in M.__all__ if n.isupper()]
+    assert len(consts) >= 20               # the list actually exports
+    missing = [n for n in consts if n not in blob]
+    assert missing == [], (
+        f"metric-name constants with no producer in paimon_tpu/: "
+        f"{missing}")

@@ -1,0 +1,469 @@
+"""Observability plane acceptance tests (ISSUE 5).
+
+The headline test runs a traced 8-way pipelined write + scan, exports
+Chrome trace-event JSON, and PARSES it: overlapping IO/decode/merge
+(scan) and sort/encode/upload (write) spans from >=2 concurrent worker
+threads, with table/bucket attributes — no eyeballing.  The other
+tests cover the $metrics/$traces system tables (direct + SQL), the
+Prometheus GET /metrics endpoint, option-driven switch sync, the CLI
+surface, and the <2% disabled-path overhead bound (micro `obs`).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu import obs
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, VarCharType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Tracing is process-global: save/restore the switches and clear
+    the ring around every test so no spans leak across tests."""
+    was_tracing = obs.tracing_enabled()
+    was_metrics = obs.metrics_enabled()
+    obs.collector().clear()
+    yield
+    (obs.enable_tracing if was_tracing else obs.disable_tracing)()
+    obs.set_metrics_enabled(was_metrics)
+    obs.collector().clear()
+
+
+def _schema(extra_opts=None):
+    opts = {"bucket": "8", "write-only": "true",
+            "scan.split.parallelism": "8",
+            "write.flush.parallelism": "8"}
+    opts.update(extra_opts or {})
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .column("s", VarCharType())
+            .primary_key("id")
+            .options(opts).build())
+
+
+def _data(rows, seed):
+    rng = np.random.default_rng(seed)
+    ids = rng.permutation(rows)
+    return pa.table({
+        "id": pa.array(ids, pa.int64()),
+        "v": pa.array(rng.random(rows), pa.float64()),
+        "s": pa.array(np.char.add("payload-", ids.astype(str))),
+    })
+
+
+def _build_traced_table(path, rows=120_000, extra_opts=None):
+    """Two overlapping commits (same key range) so every bucket holds
+    2 L0 runs and the scan actually merges."""
+    table = FileStoreTable.create(path, _schema(extra_opts))
+    for seed in (1, 2):
+        wb = table.new_batch_write_builder()
+        with wb.new_write() as w:
+            w.write_arrow(_data(rows, seed))
+            wb.new_commit().commit(w.prepare_commit())
+    return table
+
+
+def _x_events(trace):
+    return [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+
+
+def _has_cross_thread_overlap(events):
+    evts = sorted(events, key=lambda e: e["ts"])
+    for i, a in enumerate(evts):
+        for b in evts[i + 1:]:
+            if b["ts"] >= a["ts"] + a["dur"]:
+                break
+            if a["tid"] != b["tid"]:
+                return True
+    return False
+
+
+class TestChromeTraceExport:
+    def test_traced_pipelined_write_scan_overlap(self, tmp_path):
+        """THE acceptance criterion: export -> parse -> assert."""
+        obs.enable_tracing()
+        table = _build_traced_table(str(tmp_path / "t"))
+        out = table.to_arrow()
+        assert out.num_rows == 120_000
+
+        trace_path = str(tmp_path / "trace.json")
+        obs.export_chrome_trace(trace_path)
+        with open(trace_path) as f:
+            trace = json.load(f)
+        events = _x_events(trace)
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e["name"], []).append(e)
+
+        # -- scan: split admit -> IO -> decode -> merge, per worker ----
+        for name in ("scan.admit", "scan.split", "io.read", "decode",
+                     "scan.merge"):
+            assert by_name.get(name), f"missing {name} spans"
+        split_spans = by_name["scan.split"]
+        assert len({e["tid"] for e in split_spans}) >= 2, \
+            "scan.split spans from fewer than 2 worker threads"
+        assert _has_cross_thread_overlap(split_spans), \
+            "no two scan.split spans overlapped across workers"
+        scan_stage = by_name["io.read"] + by_name["decode"] + \
+            by_name["scan.merge"]
+        assert _has_cross_thread_overlap(scan_stage), \
+            "no cross-thread IO/decode/merge overlap in the scan"
+        # table/bucket attributes ride the spans
+        attred = [e for e in split_spans
+                  if isinstance(e["args"].get("bucket"), int)
+                  and e["args"].get("table")]
+        assert attred, "scan.split spans carry no table/bucket attrs"
+        assert {e["args"]["bucket"] for e in attred} == set(range(8))
+
+        # -- write: sort -> encode -> upload, per bucket actor ---------
+        for name in ("write.flush", "write.sort", "encode", "io.upload"):
+            assert by_name.get(name), f"missing {name} spans"
+        flush_spans = by_name["write.flush"]
+        assert len({e["tid"] for e in flush_spans}) >= 2
+        assert _has_cross_thread_overlap(flush_spans), \
+            "no two write.flush spans overlapped across workers"
+        write_stage = by_name["write.sort"] + by_name["encode"] + \
+            by_name["io.upload"]
+        assert _has_cross_thread_overlap(write_stage), \
+            "no cross-thread sort/encode/upload overlap in the write"
+        assert {e["args"].get("bucket") for e in flush_spans} \
+            >= set(range(8))
+
+        # -- commit: CAS + manifest encode are on the timeline ---------
+        assert by_name.get("commit.cas")
+        assert by_name.get("commit.manifest_encode")
+
+        # thread tracks are named (Perfetto metadata events)
+        meta = [e for e in trace["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "thread_name"]
+        names = {e["args"]["name"] for e in meta}
+        assert any(n.startswith("paimon-scan") for n in names)
+        assert any(n.startswith("paimon-write") for n in names)
+
+    def test_span_nesting_and_ring_bound(self, tmp_path):
+        obs.enable_tracing(max_spans=64)
+        table = _build_traced_table(str(tmp_path / "t"), rows=20_000)
+        table.to_arrow()
+        spans = obs.take_spans()
+        assert len(spans) <= 64                  # bounded ring
+        assert obs.collector().dropped > 0       # and it did evict
+        # children recorded parents (io.read nests under scan.split)
+        by_id = {s.span_id: s for s in spans}
+        nested = [s for s in spans
+                  if s.parent_id is not None and s.parent_id in by_id]
+        assert any(by_id[s.parent_id].name == "scan.split"
+                   for s in nested if s.name in ("io.read", "decode"))
+
+
+class TestSystemTables:
+    def test_metrics_system_table(self, tmp_path):
+        table = _build_traced_table(str(tmp_path / "t"), rows=5_000)
+        table.to_arrow()
+        m = table.system_table("metrics")
+        rows = m.to_pylist()
+        groups = {r["group"] for r in rows}
+        assert {"scan", "write", "commit", "io"} <= groups
+        by_key = {(r["group"], r["metric"]): r for r in rows}
+        assert by_key[("write", "flushes")]["kind"] == "counter"
+        assert by_key[("write", "flushes")]["value"] >= 8
+        h = by_key[("io", "read_ms")]
+        assert h["kind"] == "histogram" and h["count"] >= 1 \
+            and h["p95"] is not None
+
+    def test_traces_system_table(self, tmp_path):
+        obs.enable_tracing()
+        table = _build_traced_table(str(tmp_path / "t"), rows=5_000)
+        table.to_arrow()
+        t = table.system_table("traces")
+        rows = t.to_pylist()
+        assert rows
+        names = {r["name"] for r in rows}
+        assert "scan.split" in names and "write.flush" in names
+        split = [r for r in rows if r["name"] == "scan.split"]
+        assert any(r["bucket"] is not None and r["table"]
+                   for r in split)
+        assert all(r["dur_us"] >= 0 and r["start_us"] > 0
+                   for r in rows)
+        # empty ring still yields the typed schema
+        obs.collector().clear()
+        empty = table.system_table("traces")
+        assert empty.num_rows == 0
+        assert set(t.column_names) == set(empty.column_names)
+
+    def test_sql_executor_metrics_and_traces(self, tmp_path):
+        from paimon_tpu.catalog.catalog import Identifier, create_catalog
+        from paimon_tpu.sql import SQLContext
+
+        obs.enable_tracing()
+        catalog = create_catalog({"warehouse": str(tmp_path / "wh")})
+        catalog.create_database("d1", ignore_if_exists=True)
+        catalog.create_table(Identifier.parse("d1.t"), _schema())
+        ctx = SQLContext(catalog, database="d1")
+        ctx.sql("INSERT INTO t VALUES (1, 1.5, 'a'), (2, 2.5, 'b')")
+        ctx.sql("SELECT * FROM t")
+        m = ctx.sql("SELECT * FROM t$metrics")
+        assert m.num_rows > 0
+        assert "scan" in set(m.column("group").to_pylist())
+        tr = ctx.sql("SELECT * FROM d1.t$traces")
+        assert tr.num_rows > 0
+        assert "write.flush" in set(tr.column("name").to_pylist())
+
+
+PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+]+$")
+
+
+class TestPrometheusEndpoint:
+    def test_get_metrics_valid_exposition(self, tmp_path):
+        from paimon_tpu.metrics import (
+            COMPACTION_BUCKET_RETRIES, global_registry,
+        )
+        from paimon_tpu.service.query_service import KvQueryServer
+
+        table = _build_traced_table(str(tmp_path / "t"), rows=5_000)
+        table.to_arrow()
+        # compaction counters exist the moment the plane touches them
+        table.copy({"write-only": "false"}).compact(full=True)
+        global_registry().compaction_metrics() \
+            .counter(COMPACTION_BUCKET_RETRIES)
+
+        server = KvQueryServer(table).start()
+        try:
+            with urllib.request.urlopen(
+                    f"{server.address}/metrics", timeout=30) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain")
+                body = resp.read().decode()
+        finally:
+            server.stop()
+
+        lines = [ln for ln in body.splitlines() if ln]
+        assert lines
+        declared = set()
+        for ln in lines:
+            if ln.startswith("# TYPE "):
+                _, _, rest = ln.partition("# TYPE ")
+                fam, kind = rest.rsplit(" ", 1)
+                assert kind in ("counter", "gauge", "summary"), ln
+                declared.add(fam)
+            else:
+                assert PROM_SAMPLE.match(ln), f"invalid sample: {ln!r}"
+        # scan/write/compaction counters are all present
+        assert "paimon_scan_pipeline_splits" in declared
+        assert "paimon_write_flushes" in declared
+        assert any(f.startswith("paimon_compaction_")
+                   for f in declared)
+        # per-stage latency summaries made it too
+        assert "paimon_scan_split_ms" in declared
+        assert "paimon_io_read_ms" in declared
+        # every sample's family was declared
+        for ln in lines:
+            if not ln.startswith("#"):
+                name = re.split(r"[{ ]", ln, 1)[0]
+                base = re.sub(r"_(sum|count)$", "", name)
+                assert name in declared or base in declared, ln
+
+    def test_render_prometheus_escapes_labels(self):
+        from paimon_tpu.obs.export import render_prometheus
+        rows = [{"group": "scan", "table": 'we"ird\\t', "metric": "c",
+                 "kind": "counter", "value": 1}]
+        text = render_prometheus(rows)
+        assert 'table="we\\"ird\\\\t"' in text
+
+    def test_summary_sum_count_are_cumulative(self):
+        """Prometheus _count/_sum must be monotonic: they come from
+        the histogram's cumulative totals, not the sliding window
+        (which caps at 100 and would make rate() read zero)."""
+        from paimon_tpu.metrics import MetricRegistry
+        from paimon_tpu.obs.export import render_prometheus
+
+        reg = MetricRegistry()
+        h = reg.scan_metrics().histogram("lat_ms")
+        for i in range(250):
+            h.update(2.0)
+        text = render_prometheus(reg.snapshot_rows())
+        assert "paimon_scan_lat_ms_count 250" in text
+        assert "paimon_scan_lat_ms_sum 500" in text
+
+
+class TestSwitches:
+    def test_sync_from_options_explicit_wins_absent_leaves(self,
+                                                           tmp_path):
+        from paimon_tpu.obs.trace import sync_from_options
+        from paimon_tpu.options import CoreOptions
+
+        obs.disable_tracing()
+        sync_from_options(CoreOptions({"trace.enabled": "true",
+                                       "trace.buffer.spans": "32"}))
+        assert obs.tracing_enabled()
+        assert obs.collector().max_spans == 32
+        # absent key leaves the state (an explicit enable_tracing or a
+        # traced table must not be reverted by the next untraced one)
+        sync_from_options(CoreOptions({"bucket": "1"}))
+        assert obs.tracing_enabled()
+        # ... and an absent buffer key must NOT resize the ring to the
+        # option default (resizing drops collected spans)
+        obs.enable_tracing(max_spans=12345)
+        sync_from_options(CoreOptions({"trace.enabled": "true"}))
+        assert obs.collector().max_spans == 12345
+        sync_from_options(CoreOptions({"trace.enabled": "false"}))
+        assert not obs.tracing_enabled()
+        sync_from_options(CoreOptions({"metrics.enabled": "false"}))
+        assert not obs.metrics_enabled()
+        sync_from_options(CoreOptions({"metrics.enabled": "true"}))
+        assert obs.metrics_enabled()
+
+    def test_table_option_enables_tracing_and_histograms(self,
+                                                         tmp_path):
+        from paimon_tpu.metrics import global_registry
+
+        obs.disable_tracing()
+        table = _build_traced_table(
+            str(tmp_path / "t"), rows=5_000,
+            extra_opts={"trace.enabled": "true"})
+        table.to_arrow()
+        assert obs.tracing_enabled()
+        names = {s.name for s in obs.take_spans()}
+        assert "scan.split" in names and "write.flush" in names
+        snap = global_registry().snapshot()
+        assert snap["scan"]["split_ms"]["count"] >= 8
+        assert snap["write"]["sort_ms"]["count"] >= 8
+
+    def test_unwritable_export_path_never_fails_the_scan(self,
+                                                         tmp_path):
+        out = os.path.join(str(tmp_path), "missing-dir", "x.json")
+        table = _build_traced_table(
+            str(tmp_path / "t"), rows=5_000,
+            extra_opts={"trace.enabled": "true",
+                        "trace.export.path": out})
+        with pytest.warns(RuntimeWarning, match="trace export"):
+            got = table.to_arrow()       # export fails, scan must not
+        assert got.num_rows == 5_000
+
+    def test_chrome_tracks_keyed_by_name_and_ident(self):
+        """Dead-pool ident reuse must not fold a scan worker onto a
+        write worker's track, and two concurrently-live pools that
+        both own a 'paimon-scan_0' must not merge either."""
+        from paimon_tpu.obs.export import to_chrome_trace
+        from paimon_tpu.obs.trace import Span
+
+        def mk(name, thread, tid):
+            return Span(1, None, name, "c", 0.0, 1.0, tid, thread, {})
+
+        trace = to_chrome_trace([
+            mk("a", "paimon-write_0", 7),   # pool died,
+            mk("b", "paimon-scan_0", 7),    # ident 7 reused
+            mk("c", "paimon-scan_0", 9),    # concurrent 2nd scan pool
+            mk("d", "paimon-scan_0", 9),    # same live thread
+        ])
+        ev = {e["name"]: e for e in _x_events(trace)}
+        assert ev["a"]["tid"] != ev["b"]["tid"]
+        assert ev["b"]["tid"] != ev["c"]["tid"]
+        assert ev["c"]["tid"] == ev["d"]["tid"]
+
+    def test_trace_export_path_flushes_on_completion(self, tmp_path):
+        out = str(tmp_path / "auto.json")
+        _build_traced_table(
+            str(tmp_path / "t"), rows=5_000,
+            extra_opts={"trace.enabled": "true",
+                        "trace.export.path": out}).to_arrow()
+        with open(out) as f:
+            trace = json.load(f)
+        assert any(e["name"] == "scan.split"
+                   for e in _x_events(trace))
+
+    def test_metrics_disabled_stops_histograms(self, tmp_path):
+        from paimon_tpu.metrics import global_registry
+
+        obs.disable_tracing()
+        before = global_registry().snapshot() \
+            .get("scan", {}).get("split_ms", {"count": 0})["count"]
+        table = _build_traced_table(
+            str(tmp_path / "t"), rows=5_000,
+            extra_opts={"metrics.enabled": "false"})
+        table.to_arrow()
+        after = global_registry().snapshot() \
+            .get("scan", {}).get("split_ms", {"count": 0})["count"]
+        assert after == before
+
+
+class TestCli:
+    def _bootstrap(self, wh):
+        from paimon_tpu.cli import main
+        assert main(["-w", wh, "db", "create", "d1"]) == 0
+        assert main(["-w", wh, "table", "create", "d1.t",
+                     "--column", "id:BIGINT NOT NULL",
+                     "--column", "v:DOUBLE",
+                     "--primary-key", "id",
+                     "--option", "bucket=2"]) == 0
+        assert main(["-w", wh, "sql",
+                     "INSERT INTO d1.t VALUES (1, 1.5), (2, 2.5)"]) == 0
+
+    def test_table_metrics_command(self, tmp_path, capsys):
+        from paimon_tpu.cli import main
+        wh = str(tmp_path / "wh")
+        self._bootstrap(wh)
+        capsys.readouterr()
+        assert main(["-w", wh, "-f", "json", "table", "metrics",
+                     "d1.t"]) == 0
+        rows = [json.loads(ln) for ln in
+                capsys.readouterr().out.splitlines()]
+        assert any(r["group"] == "commit" for r in rows)
+        assert main(["-w", wh, "-f", "json", "table", "metrics",
+                     "d1.t", "--group", "write"]) == 0
+        rows = [json.loads(ln) for ln in
+                capsys.readouterr().out.splitlines()]
+        assert rows and all(r["group"] == "write" for r in rows)
+
+    def test_read_trace_flag_writes_chrome_json(self, tmp_path,
+                                                capsys):
+        from paimon_tpu.cli import main
+        wh = str(tmp_path / "wh")
+        self._bootstrap(wh)
+        out = str(tmp_path / "scan-trace.json")
+        assert main(["-w", wh, "table", "read", "d1.t",
+                     "--trace", out]) == 0
+        with open(out) as f:
+            trace = json.load(f)
+        assert any(e["name"] == "scan.split"
+                   for e in _x_events(trace))
+        # the scope disabled tracing on the way out
+        assert not obs.tracing_enabled()
+
+
+@pytest.mark.parametrize("entry", ["obs"])
+def test_disabled_tracing_overhead_under_2pct(entry):
+    """Tier-1 bound from the issue: the tracing-DISABLED scan hot path
+    adds <2% vs a no-instrumentation baseline (micro `obs` entry:
+    best-of timings, min overhead over interleaved trials)."""
+    env = dict(os.environ, MICRO_ROWS="60000", MICRO_RUNS="2",
+               OBS_TRIALS="3", JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.micro", entry],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()]
+    by_name = {d["benchmark"]: d for d in lines}
+    assert {"obs_scan_noinstr", "obs_scan_trace_disabled",
+            "obs_scan_trace_enabled",
+            "obs_overhead_disabled_pct"} <= set(by_name)
+    overhead = by_name["obs_overhead_disabled_pct"]["value"]
+    assert overhead < 2.0, (
+        f"disabled-tracing overhead {overhead}% >= 2% "
+        f"(noinstr={by_name['obs_scan_noinstr']['best_seconds']}s, "
+        f"disabled="
+        f"{by_name['obs_scan_trace_disabled']['best_seconds']}s)")
