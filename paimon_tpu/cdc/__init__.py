@@ -11,3 +11,6 @@ from paimon_tpu.cdc.database_sync import CdcDatabaseSync  # noqa: F401
 from paimon_tpu.cdc.formats import (  # noqa: F401
     parse_canal, parse_debezium, parse_maxwell,
 )
+from paimon_tpu.cdc.source import (  # noqa: F401
+    FileCdcSource, MemoryCdcSource,
+)

@@ -132,8 +132,10 @@ class CdcDatabaseSync:
         for name, evs in by_table.items():
             self._writer_for(name, evs).write_events(evs)
 
-    def commit(self, commit_identifier: int) -> Dict[str, Optional[int]]:
-        return {name: w.commit(commit_identifier)
+    def commit(self, commit_identifier: int,
+               properties: Optional[Dict[str, str]] = None
+               ) -> Dict[str, Optional[int]]:
+        return {name: w.commit(commit_identifier, properties=properties)
                 for name, w in self._writers.items()}
 
     def tables(self) -> List[str]:

@@ -98,6 +98,13 @@ class CdcSinkWriter:
         self.commit_user = commit_user or "cdc"
         self._writer = None
         self._pending_msgs = []
+        # which commit identifier the staged messages were last
+        # ATTEMPTED under (None = not yet attempted; they ride the next
+        # commit).  Lets a retried/replayed checkpoint detect that the
+        # previous attempt actually landed (crash between the snapshot
+        # CAS and the ack) and drop the staged messages instead of
+        # re-delivering committed rows under a new identifier.
+        self._pending_ckpt: Optional[int] = None
         self._computed = None
         if computed_columns:
             from paimon_tpu.cdc.computed import parse_computed_columns
@@ -180,7 +187,24 @@ class CdcSinkWriter:
         batch = pa.Table.from_pylist(normalized, schema=schema)
         self._writer.write_arrow(batch, kinds)
 
-    def commit(self, commit_identifier: int) -> Optional[int]:
+    def commit(self, commit_identifier: int,
+               properties: Optional[Dict[str, str]] = None
+               ) -> Optional[int]:
+        """Commit everything staged + buffered under
+        `commit_identifier`; `properties` land in the snapshot (the
+        stream daemon commits its source offset here, atomically with
+        the data).  Exactly-once on every failure shape:
+
+        - replayed identifier (already committed by this user): commit
+          nothing, return None;
+        - prepare fails: staged messages restored, writer reset —
+          retry the SAME identifier;
+        - commit raises (which includes "the CAS actually landed but
+          the process died before the ack"): messages restored keyed
+          by the attempted identifier, so a later commit drops them if
+          that identifier turns out to be durable instead of
+          re-delivering the rows under a fresh identifier.
+        """
         if self._writer is None and not self._pending_msgs:
             return None
         if self._writer is None:
@@ -188,8 +212,18 @@ class CdcSinkWriter:
                 .with_commit_user(self.commit_user)
             self._wb = wb
         commit = self._wb.new_commit()
+        if self._pending_msgs and self._pending_ckpt is not None and \
+                self._pending_ckpt != commit_identifier:
+            # the staged messages already rode a commit attempt under an
+            # OLDER identifier; if that attempt actually landed (crash
+            # between CAS and ack), committing them again here would
+            # re-deliver rows the table already holds
+            if not commit.filter_committed([self._pending_ckpt]):
+                self._pending_msgs = []
+            self._pending_ckpt = None
         msgs = list(self._pending_msgs)
         self._pending_msgs = []
+        self._pending_ckpt = None
         if self._writer is not None:
             try:
                 msgs.extend(self._writer.prepare_commit())
@@ -206,7 +240,19 @@ class CdcSinkWriter:
                 raise
         if not commit.filter_committed([commit_identifier]):
             return None          # replayed checkpoint: exactly-once
-        return commit.commit(msgs, commit_identifier=commit_identifier)
+        try:
+            return commit.commit(msgs,
+                                 commit_identifier=commit_identifier,
+                                 properties=properties)
+        except Exception:
+            # the snapshot CAS may or may not have landed (e.g. the
+            # process is dying mid-checkpoint): keep the messages,
+            # KEYED by this identifier, so the retried/replayed
+            # checkpoint can resolve which happened via
+            # filter_committed instead of guessing
+            self._pending_msgs = msgs
+            self._pending_ckpt = commit_identifier
+            raise
 
     def close(self):
         if self._writer is not None:

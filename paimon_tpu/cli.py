@@ -247,6 +247,54 @@ def cmd_table(args):
             import pyarrow.compute as pc
             out = out.filter(pc.equal(out.column("group"), args.group))
         _print_table(out, args.format)
+    elif cmd == "stream":
+        table = _table(catalog, args.table)
+        from paimon_tpu.cdc.source import FileCdcSource
+        from paimon_tpu.service.stream_daemon import StreamDaemon
+        dynamic = {}
+        for opt in args.option or []:
+            k, _, v = opt.partition("=")
+            dynamic[k] = v
+        source = FileCdcSource(args.source)
+        daemon = StreamDaemon(
+            table, source, format=args.cdc_format,
+            commit_user=args.commit_user,
+            compact=not args.no_compact, serve=not args.no_serve,
+            dynamic_options=dynamic or None)
+        server = None
+        with _TraceScope(getattr(args, "trace", None)):
+            daemon.install_signal_handlers()
+            daemon.start()
+            if not args.no_serve:
+                # the CLI has no in-process consumer; drain the bounded
+                # changelog buffer (keeping the serve loop + freshness
+                # measurement live) — remote consumers use /changelog,
+                # which runs its own resumable per-consumer scans
+                from paimon_tpu.parallel.executors import spawn_thread
+
+                def _drain_buffer():
+                    while daemon.poll_changelog(timeout=1.0) or \
+                            daemon._serve_alive():
+                        pass
+
+                spawn_thread(_drain_buffer,
+                             name="paimon-stream-cli-drain")
+            if args.serve_port is not None:
+                from paimon_tpu.service.query_service import (
+                    KvQueryServer,
+                )
+                server = KvQueryServer(table,
+                                       port=args.serve_port).start()
+                print(f"query service (with /changelog) at "
+                      f"{server.address}", file=sys.stderr)
+            try:
+                status = daemon.run_forever(args.duration)
+            finally:
+                if server is not None:
+                    server.stop()
+        print(json.dumps(status, indent=2, default=str))
+        if any(lp["failed"] for lp in status["loops"].values()):
+            raise SystemExit(1)
     elif cmd == "fsck":
         table = _table(catalog, args.table)
         report = table.fsck(snapshot_id=args.snapshot, deep=args.deep)
@@ -408,6 +456,34 @@ def build_parser() -> argparse.ArgumentParser:
     c = tsub.add_parser("expire-snapshots")
     c.add_argument("table")
     c.add_argument("--retain-max", type=int)
+    c = tsub.add_parser(
+        "stream",
+        help="run the streaming daemon: checkpointed exactly-once CDC "
+             "ingest + triggered compaction + changelog serving")
+    c.add_argument("table")
+    c.add_argument("--source", required=True,
+                   help="JSONL file of CDC envelopes (tailed; offset = "
+                        "line number, checkpointed in snapshot "
+                        "properties)")
+    c.add_argument("--cdc-format", default="debezium",
+                   help="debezium/canal/maxwell/ogg/dms/aliyun")
+    c.add_argument("--commit-user", default="stream-daemon",
+                   help="STABLE id keying exactly-once replay dedup "
+                        "and offset recovery")
+    c.add_argument("--duration", type=float,
+                   help="seconds to run (default: until SIGTERM)")
+    c.add_argument("--serve-port", type=int,
+                   help="also start the query service (adds the "
+                        "/changelog endpoint) on this port")
+    c.add_argument("--no-compact", action="store_true",
+                   help="disable the compaction loop")
+    c.add_argument("--no-serve", action="store_true",
+                   help="disable the changelog-serving loop")
+    c.add_argument("--option", action="append", metavar="K=V",
+                   help="dynamic table options (stream.*, write.*, ...)")
+    c.add_argument("--trace", metavar="OUT.json",
+                   help="trace the daemon; write Chrome trace-event "
+                        "JSON (opens in Perfetto)")
     c = tsub.add_parser(
         "fsck", help="verify the snapshot/manifest/file graph")
     c.add_argument("table")

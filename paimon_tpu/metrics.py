@@ -28,7 +28,12 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "WRITE_SORT_MS", "WRITE_FLUSH_TASK_MS",
            "IO_READ_MS", "IO_DECODE_MS", "IO_ENCODE_MS", "IO_UPLOAD_MS",
            "COMPACTION_WINDOW_MS", "COMPACTION_FALLBACK_MS",
-           "COMMIT_CAS_MS", "COMMIT_MANIFEST_ENCODE_MS"]
+           "COMMIT_CAS_MS", "COMMIT_MANIFEST_ENCODE_MS",
+           "STREAM_EVENTS_INGESTED", "STREAM_CHECKPOINTS",
+           "STREAM_CHECKPOINT_MS", "STREAM_LOOP_RESTARTS",
+           "STREAM_FRESHNESS_MS", "STREAM_CHANGELOG_ROWS",
+           "STREAM_COMPACTIONS", "STREAM_COMPACTIONS_PAUSED",
+           "STREAM_SOURCE_BACKLOG"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -81,6 +86,20 @@ COMPACTION_WINDOW_MS = "window_ms"          # compaction: device window
 COMPACTION_FALLBACK_MS = "fallback_ms"      # compaction: 1-chip rescue
 COMMIT_CAS_MS = "cas_ms"                    # commit: one CAS publish
 COMMIT_MANIFEST_ENCODE_MS = "manifest_encode_ms"
+
+# streaming-daemon counter/gauge/histogram names (stream metric group;
+# producer is service/stream_daemon.py, consumers tests/soak_harness.py
+# + dashboards).  freshness_ms is END-TO-END: event pulled from the CDC
+# source -> its checkpoint's rows visible to a changelog scan.
+STREAM_EVENTS_INGESTED = "events_ingested"    # CDC events written
+STREAM_CHECKPOINTS = "checkpoints"            # offset commits that landed
+STREAM_CHECKPOINT_MS = "checkpoint_ms"        # one checkpoint commit
+STREAM_LOOP_RESTARTS = "loop_restarts"        # supervised loop restarts
+STREAM_FRESHNESS_MS = "freshness_ms"          # event -> changelog-visible
+STREAM_CHANGELOG_ROWS = "changelog_rows_served"
+STREAM_COMPACTIONS = "compactions"            # triggered compaction runs
+STREAM_COMPACTIONS_PAUSED = "compactions_paused"  # skipped: ingest pressure
+STREAM_SOURCE_BACKLOG = "source_backlog"      # gauge: unpulled events
 
 
 class Counter:
@@ -255,6 +274,10 @@ class MetricRegistry:
     def maintenance_metrics(self, table: str = "") -> MetricGroup:
         """Expire / orphan-clean / fsck plane (ours)."""
         return self.group("maintenance", table)
+
+    def stream_metrics(self, table: str = "") -> MetricGroup:
+        """Streaming-daemon plane (ours; service/stream_daemon.py)."""
+        return self.group("stream", table)
 
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind

@@ -10,3 +10,6 @@ the capability, not the wire bytes.
 from paimon_tpu.service.query_service import (  # noqa: F401
     KvQueryClient, KvQueryServer, ServiceManager,
 )
+from paimon_tpu.service.stream_daemon import (  # noqa: F401
+    StreamDaemon, checkpoint_once, recover_checkpoint,
+)

@@ -102,6 +102,20 @@ class KvQueryServer:
         self.address = f"http://{host}:{self.port}"
         self.services = ServiceManager(table.file_io, table.path)
         self._thread: Optional[threading.Thread] = None
+        # per-consumer streaming changelog scans (/changelog): each
+        # consumer id owns a DataTableStreamScan whose position only
+        # advances when that consumer polls, plus a pending-rows
+        # carryover so large batches stream out in bounded chunks.
+        # LRU-bounded: a client cycling consumer ids cannot grow
+        # server memory without bound (an evicted consumer restarts
+        # from a fresh scan).  One lock serializes plan+read per
+        # request — stream scans are stateful and the HTTP server is
+        # threaded.
+        from collections import OrderedDict
+        self._streams = OrderedDict()
+        self._streams_lock = threading.Lock()
+        self.max_changelog_consumers = 256
+        self.changelog_max_rows = 10_000
 
     def start(self) -> "KvQueryServer":
         from paimon_tpu.parallel.executors import spawn_thread
@@ -151,6 +165,8 @@ class KvQueryServer:
                     handle = self._lookup
                 elif self.path == "/scan":
                     handle = self._scan
+                elif self.path == "/changelog":
+                    handle = self._changelog
                 else:
                     self.send_error(404)
                     return
@@ -175,6 +191,48 @@ class KvQueryServer:
                                  {k: _encode_value(x)
                                   for k, x in r.items()}
                                  for r in rows]}
+
+            def _changelog(self, req):
+                """Streaming changelog poll (table/stream_scan.py):
+                each consumer id resumes its own follow-up scan, so
+                repeated polls stream snapshot-by-snapshot changes with
+                row kinds (`_ROW_KIND`).  `caught_up` signals 'poll
+                again later' — the stream never ends.  Serving is
+                read-only on committed snapshots: it stays available
+                while ingest or compaction are down (the daemon's
+                degradation contract)."""
+                consumer = str(req.get("consumer") or "default")
+                limit = int(req.get("max_rows")
+                            or server.changelog_max_rows)
+                with server._streams_lock:
+                    entry = server._streams.get(consumer)
+                    if entry is None:
+                        entry = {"scan": server.table
+                                 .new_read_builder().new_stream_scan(),
+                                 "pending": []}
+                        server._streams[consumer] = entry
+                        while len(server._streams) > \
+                                server.max_changelog_consumers:
+                            server._streams.popitem(last=False)
+                    server._streams.move_to_end(consumer)
+                    snapshot_id = None
+                    if not entry["pending"]:
+                        plan = entry["scan"].plan()
+                        if plan is None:
+                            return {"rows": [], "snapshot_id": None,
+                                    "caught_up": True, "more": False}
+                        snapshot_id = plan.snapshot_id
+                        entry["pending"] = server.table \
+                            .new_read_builder().new_read() \
+                            .to_arrow(plan).to_pylist()
+                    rows = entry["pending"][:limit]
+                    entry["pending"] = entry["pending"][limit:]
+                    more = bool(entry["pending"])
+                return {"rows": [{k: _encode_value(v)
+                                  for k, v in r.items()}
+                                 for r in rows],
+                        "snapshot_id": snapshot_id,
+                        "caught_up": False, "more": more}
 
             def _scan(self, req):
                 """Bounded table scan through the pipelined split
@@ -253,3 +311,17 @@ class KvQueryClient:
                                       "limit": limit}, timeout=60)
         return [{k: _decode_value(v) for k, v in r.items()}
                 for r in payload["rows"]]
+
+    def changelog(self, consumer: str = "default",
+                  max_rows: Optional[int] = None) -> dict:
+        """Poll the next changelog batch for `consumer` (rows carry
+        `_ROW_KIND`); {"caught_up": True} means poll again later, and
+        {"more": True} means the current snapshot has further chunks —
+        poll immediately (large batches stream out bounded;
+        `snapshot_id` is reported on a chunk's first page only)."""
+        payload = self._post("changelog",
+                             {"consumer": consumer,
+                              "max_rows": max_rows}, timeout=60)
+        payload["rows"] = [{k: _decode_value(v) for k, v in r.items()}
+                           for r in payload["rows"]]
+        return payload

@@ -1,0 +1,696 @@
+"""Streaming lakehouse daemon: checkpointed exactly-once CDC ingest,
+level-triggered compaction and changelog serving over ONE table, as
+three supervised concurrent loops.
+
+This is the long-running form of Paimon's core scenario (PAPER.md:
+continuous upserts into per-bucket LSM trees with low-latency streaming
+changelog reads), built from the batch pieces the repo already has:
+
+    ingest   cdc/sink.py + parallel/write_pipeline.py (flush budget =
+             backpressure), offsets committed ATOMICALLY with each
+             snapshot via commit properties
+    compact  compact/compact_action.py -> parallel/mesh_engine.py (full
+             compactions ride PR 2's retry/fallback ladder)
+    serve    table/stream_scan.py follow-up scans, buffered for
+             in-process consumers and exposed on the query service
+             (`/changelog`)
+
+Robustness model
+----------------
+
+**Exactly-once ingest.**  A checkpoint is one snapshot committed with
+`commit_identifier = N` and properties::
+
+    stream.source.offset  offset of the last CDC event included
+    stream.ingest.ts-ms   wall time the checkpoint's first event was
+                          pulled (feeds end-to-end freshness)
+
+Recovery (daemon start OR supervised ingest-loop restart) discards the
+writer (uploaded-but-uncommitted files become orphans for maintenance),
+reads the newest snapshot of this daemon's commit user that carries an
+offset, and re-polls the source after it.  Replay is idempotent twice
+over: the source offset only advances inside committed snapshots, and
+`CdcSinkWriter.commit` + `filter_committed` drop a checkpoint whose
+CAS landed but whose ack was lost (cdc/sink.py).
+
+**Backpressure.**  The ingest loop pulls at most
+`stream.ingest.max-batch` events per poll and hands them straight to
+the writer, whose `write.flush.max-bytes` budget BLOCKS the loop while
+the flush pipeline is saturated — the daemon holds no internal event
+queue, so the source pull rate is coupled to sustained flush/upload
+throughput.  The changelog buffer is likewise bounded
+(`stream.serve.buffer.rows`): a lagging consumer stalls the serving
+loop, never memory.
+
+**Supervision.**  Each loop runs under a supervisor that restarts it on
+any error with capped decorrelated-jitter backoff (utils/backoff.py,
+`stream.restart.*`); a run longer than `stream.restart.healthy-
+threshold` resets the schedule.  Loops degrade independently:
+compaction pauses while ingest is under pressure
+(`stream.compaction.pause-*`), and serving keeps reading committed
+snapshots while ingest or compaction are down or crash-looping.
+
+**Drain.**  `stop()` (also wired to SIGTERM/SIGINT via
+`install_signal_handlers`) stops pulling, commits one final checkpoint
+for everything already ingested, lets the serving loop catch up to the
+final snapshot, then joins all loops.  `kill()` is the crash path used
+by the fault harness: loops abandon work immediately and nothing past
+the last committed checkpoint survives — which is the point.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.table.table import FileStoreTable
+
+__all__ = ["StreamDaemon", "recover_checkpoint", "checkpoint_once",
+           "PROP_OFFSET", "PROP_INGEST_TS"]
+
+PROP_OFFSET = "stream.source.offset"
+PROP_INGEST_TS = "stream.ingest.ts-ms"
+
+DEFAULT_COMMIT_USER = "stream-daemon"
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def find_checkpoint_snapshot(table: FileStoreTable, commit_user: str):
+    """Newest snapshot of `commit_user` carrying an offset property,
+    or None."""
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id()
+    earliest = sm.earliest_snapshot_id()
+    if latest is None or earliest is None:
+        return None
+    for sid in range(latest, earliest - 1, -1):
+        try:
+            snap = sm.snapshot(sid)
+        except FileNotFoundError:
+            continue              # expired under us
+        if snap.commit_user != commit_user:
+            continue
+        if PROP_OFFSET in (snap.properties or {}):
+            return snap
+    return None
+
+
+def recover_checkpoint(table: FileStoreTable,
+                       commit_user: str) -> tuple:
+    """(last committed source offset, last commit identifier) for this
+    daemon user, from the newest snapshot carrying an offset property —
+    (-1, 0) when the daemon has never checkpointed.  The offset is read
+    from snapshot properties, so it is exactly as durable as the data
+    it describes."""
+    snap = find_checkpoint_snapshot(table, commit_user)
+    if snap is None:
+        return -1, 0
+    return int(snap.properties[PROP_OFFSET]), snap.commit_identifier
+
+
+def checkpoint_once(table: FileStoreTable, source, *,
+                    commit_user: str = DEFAULT_COMMIT_USER,
+                    format: str = "debezium",
+                    max_events: Optional[int] = None) -> Optional[int]:
+    """One synchronous ingest step: recover the committed offset, pull
+    every available event past it (up to `max_events`) and commit ONE
+    checkpoint.  This is the daemon's ingest loop unrolled — and the
+    crash-sweep surface for the offset-commit path: killing any
+    mutating op inside it must leave a table that recovers to exactly
+    one copy of every event."""
+    from paimon_tpu.cdc.sink import CdcSinkWriter
+
+    offset, last_ckpt = recover_checkpoint(table, commit_user)
+    events = source.poll(offset, max_events if max_events is not None
+                         else 1 << 30)
+    if not events:
+        return None
+    ingest_ts = _now_ms()
+    sink = CdcSinkWriter(table.copy({"write-only": "true"}),
+                         format=format, commit_user=commit_user)
+    try:
+        sink.write_events([e for _, e in events])
+        return sink.commit(
+            last_ckpt + 1,
+            properties={PROP_OFFSET: str(events[-1][0]),
+                        PROP_INGEST_TS: str(ingest_ts)})
+    finally:
+        sink.close()
+
+
+class _Supervisor:
+    """Runs one loop body in a named thread, restarting it on failure
+    with capped decorrelated-jitter backoff.  The body is expected to
+    loop until the daemon stops and return; any raise is a crash."""
+
+    def __init__(self, daemon: "StreamDaemon", name: str, body):
+        self.daemon = daemon
+        self.name = name
+        self.body = body
+        self.restarts = 0
+        self.consecutive = 0
+        self.last_error: Optional[str] = None
+        self.failed = False
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self):
+        from paimon_tpu.parallel.executors import spawn_thread
+        self.thread = spawn_thread(self._run,
+                                   name=f"paimon-stream-{self.name}")
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+    def join(self, timeout: Optional[float]):
+        if self.thread is not None:
+            self.thread.join(timeout)
+
+    def _run(self):
+        from paimon_tpu.metrics import STREAM_LOOP_RESTARTS
+        from paimon_tpu.obs.trace import span
+        from paimon_tpu.utils.backoff import Backoff
+
+        d = self.daemon
+        backoff: Optional[Backoff] = None
+        while not d._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self.body()
+                return                        # clean exit (stop/drain)
+            except BaseException as e:        # noqa: BLE001 — supervised
+                self.last_error = f"{type(e).__name__}: {e}"
+                if d._killed:
+                    return                    # crash path: expected
+                if d._stop.is_set():
+                    # crashed DURING drain (e.g. the final checkpoint
+                    # commit failed): no restart is coming, so surface
+                    # it — status()/CLI exit code must not report a
+                    # clean drain that wasn't
+                    self.failed = True
+                    return
+                self.restarts += 1
+                d._metrics.counter(STREAM_LOOP_RESTARTS).inc()
+                healthy = (time.monotonic() - t0) * 1000 >= \
+                    d._o["healthy_ms"]
+                self.consecutive = 0 if healthy else self.consecutive + 1
+                if d._o["max_restarts"] is not None and \
+                        self.consecutive > d._o["max_restarts"]:
+                    self.failed = True
+                    return                    # terminal; status carries it
+                if healthy or backoff is None:
+                    backoff = Backoff(d._o["restart_backoff_ms"],
+                                      d._o["restart_cap_ms"])
+                wait_ms = backoff.next_ms()
+                with span("stream.restart.backoff", cat="stream",
+                          loop=self.name, attempt=self.restarts,
+                          error=type(e).__name__):
+                    d._stop.wait(wait_ms / 1000.0)
+
+
+class StreamDaemon:
+    """Drive ingest + compaction + changelog serving over one table.
+
+    Usage::
+
+        daemon = StreamDaemon(table, source).start()
+        ...
+        rows = daemon.poll_changelog(max_rows=1000)
+        ...
+        daemon.stop()          # drain: final checkpoint, serve catches up
+    """
+
+    def __init__(self, table: FileStoreTable, source, *,
+                 format: str = "debezium",
+                 commit_user: str = DEFAULT_COMMIT_USER,
+                 compact: bool = True, serve: bool = True,
+                 dynamic_options: Optional[Dict[str, str]] = None):
+        from paimon_tpu.metrics import global_registry
+        from paimon_tpu.obs.trace import sync_from_options
+
+        self._dynamic = dict(dynamic_options or {})
+        self.table = table.copy(self._dynamic) if self._dynamic else table
+        self.source = source
+        self.format = format
+        self.commit_user = commit_user
+        o = self.table.options
+        sync_from_options(o)
+        self._o = {
+            "ckpt_interval_ms": o.get(
+                CoreOptions.STREAM_CHECKPOINT_INTERVAL),
+            "max_batch": o.get(CoreOptions.STREAM_INGEST_MAX_BATCH),
+            "ingest_poll_ms": o.get(
+                CoreOptions.STREAM_INGEST_POLL_INTERVAL),
+            "compact_interval_ms": o.get(
+                CoreOptions.STREAM_COMPACTION_INTERVAL),
+            "compact_full": o.get(CoreOptions.STREAM_COMPACTION_FULL),
+            "pause_ratio": o.get(
+                CoreOptions.STREAM_COMPACTION_PAUSE_RATIO),
+            "pause_backlog": o.get(
+                CoreOptions.STREAM_COMPACTION_PAUSE_BACKLOG),
+            "serve_poll_ms": o.get(
+                CoreOptions.STREAM_SERVE_POLL_INTERVAL),
+            "serve_buffer_rows": o.get(
+                CoreOptions.STREAM_SERVE_BUFFER_ROWS),
+            "restart_backoff_ms": o.get(
+                CoreOptions.STREAM_RESTART_BACKOFF),
+            "restart_cap_ms": o.get(
+                CoreOptions.STREAM_RESTART_BACKOFF_CAP),
+            "healthy_ms": o.get(CoreOptions.STREAM_RESTART_HEALTHY_MS),
+            "max_restarts": o.get(CoreOptions.STREAM_RESTART_MAX),
+            "expire_interval_ms": o.get(
+                CoreOptions.STREAM_EXPIRE_INTERVAL),
+            "flush_max_bytes": o.get(CoreOptions.WRITE_FLUSH_MAX_BYTES),
+        }
+        self._metrics = global_registry().stream_metrics()
+        self._stop = threading.Event()
+        self._draining = False
+        self._killed = False
+        self._signal = threading.Event()
+        self._last_close_error: Optional[str] = None
+
+        # ingest state (owned by the ingest thread; exposed read-only)
+        self._sink = None
+        self._offset = -1              # last COMMITTED source offset
+        self._offset_pending = -1      # last offset written to the sink
+        self._next_ckpt = 1
+        self._batch_first_pull_ms: Optional[int] = None
+
+        # bounded changelog buffer (serve loop -> consumers)
+        self._buf: List[dict] = []
+        self._buf_cond = threading.Condition()
+
+        self._loops: List[_Supervisor] = [
+            _Supervisor(self, "ingest", self._ingest_body)]
+        if compact:
+            self._loops.append(
+                _Supervisor(self, "compact", self._compact_body))
+        if serve:
+            self._loops.append(
+                _Supervisor(self, "serve", self._serve_body))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "StreamDaemon":
+        for sup in self._loops:
+            sup.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: float = 30.0) -> Dict:
+        """Stop the daemon.  With `drain` (the default) the ingest loop
+        commits a final checkpoint for everything already pulled and
+        the serving loop catches up to the final snapshot before
+        exiting; without it this behaves like `kill()`."""
+        if not drain:
+            return self.kill()
+        self._draining = True
+        self._stop.set()
+        with self._buf_cond:
+            self._buf_cond.notify_all()
+        deadline = time.monotonic() + timeout
+        # join ingest FIRST (it commits the final checkpoint), serve
+        # second (it must still be running to see that final snapshot)
+        for name in ("ingest", "compact", "serve"):
+            for sup in self._loops:
+                if sup.name == name:
+                    sup.join(max(0.1, deadline - time.monotonic()))
+        if any(sup.alive() for sup in self._loops):
+            # a loop is wedged (e.g. a consumer stopped draining the
+            # changelog buffer): force the crash path for what remains
+            self._killed = True
+            with self._buf_cond:
+                self._buf_cond.notify_all()
+            for sup in self._loops:
+                sup.join(5.0)
+        self._close_sink()
+        from paimon_tpu.obs.trace import maybe_export
+        maybe_export()
+        return self.status()
+
+    def kill(self) -> Dict:
+        """Abrupt termination (the fault-injection/crash path): no
+        final checkpoint, no serve catch-up.  Everything since the last
+        committed checkpoint is intentionally lost; a new daemon on the
+        same table + source replays it exactly once."""
+        self._killed = True
+        self._stop.set()
+        with self._buf_cond:
+            self._buf_cond.notify_all()
+        for sup in self._loops:
+            sup.join(10.0)
+        self._close_sink()
+        return self.status()
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT -> graceful drain (run_forever returns)."""
+        import signal
+
+        def handler(signum, frame):
+            self._signal.set()
+
+        try:
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, handler)
+        except ValueError:
+            pass     # not the main thread: caller drives stop() itself
+
+    def run_forever(self, duration_s: Optional[float] = None) -> Dict:
+        """Block until SIGTERM/SIGINT (or `duration_s`), then drain."""
+        self._signal.wait(duration_s)
+        return self.stop(drain=True)
+
+    def status(self) -> Dict:
+        return {
+            "commit_user": self.commit_user,
+            "offset_committed": self._offset,
+            "offset_pending": self._offset_pending,
+            "next_checkpoint": self._next_ckpt,
+            "draining": self._draining,
+            "killed": self._killed,
+            "buffered_rows": len(self._buf),
+            "sink_close_error": self._last_close_error,
+            "loops": {
+                sup.name: {"alive": sup.alive(),
+                           "restarts": sup.restarts,
+                           "failed": sup.failed,
+                           "last_error": sup.last_error}
+                for sup in self._loops},
+        }
+
+    # -- changelog consumption ----------------------------------------------
+
+    def poll_changelog(self, max_rows: int = 4096,
+                       timeout: Optional[float] = None) -> List[dict]:
+        """Pop up to `max_rows` buffered changelog rows (each carries
+        `_ROW_KIND`); blocks up to `timeout` for the first row."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._buf_cond:
+            while not self._buf:
+                if self._stop.is_set() and not self._serve_alive():
+                    return []
+                wait = 0.2 if deadline is None \
+                    else min(0.2, deadline - time.monotonic())
+                if wait <= 0:
+                    return []
+                self._buf_cond.wait(wait)
+            out = self._buf[:max_rows]
+            del self._buf[:max_rows]
+            self._buf_cond.notify_all()
+            return out
+
+    def _serve_alive(self) -> bool:
+        return any(sup.name == "serve" and sup.alive()
+                   for sup in self._loops)
+
+    def _ingest_alive(self) -> bool:
+        return any(sup.name == "ingest" and sup.alive()
+                   for sup in self._loops)
+
+    # -- ingest loop ---------------------------------------------------------
+
+    def _ingest_recover(self):
+        """(Re)entry of the ingest loop = recovery: drop the writer
+        (its uncommitted uploads become orphans), reload the table
+        (schema may have evolved), re-read the committed offset."""
+        from paimon_tpu.cdc.sink import CdcSinkWriter
+
+        self._close_sink()
+        table = FileStoreTable.load(
+            self.table.path, file_io=self.table.file_io,
+            dynamic_options={**self._dynamic, "write-only": "true"})
+        offset, last_ckpt = recover_checkpoint(table, self.commit_user)
+        # in-memory floor: on a supervised IN-PROCESS restart, never
+        # fall behind what this process already saw committed — if the
+        # offset snapshot was expired/lost underneath us, regressing to
+        # it (or to -1) would re-ingest committed events and reuse
+        # identifiers
+        self._offset = max(offset, self._offset)
+        self._offset_pending = self._offset
+        self._next_ckpt = max(last_ckpt + 1, self._next_ckpt)
+        self._batch_first_pull_ms = None
+        self._sink = CdcSinkWriter(table, format=self.format,
+                                   commit_user=self.commit_user)
+
+    def _close_sink(self):
+        if self._sink is None:
+            return
+        try:
+            self._sink.close()
+        except Exception as e:                # noqa: BLE001
+            # close() joins the flush pool; under injected store faults
+            # it can re-raise the latched worker error. The sink is
+            # being discarded either way — record, don't mask the
+            # recovery that is about to run.
+            self._metrics.counter("sink_close_errors").inc()
+            self._last_close_error = f"{type(e).__name__}: {e}"
+        self._sink = None
+
+    def _ingest_body(self):
+        from paimon_tpu.metrics import (
+            STREAM_EVENTS_INGESTED, STREAM_SOURCE_BACKLOG,
+        )
+        from paimon_tpu.obs.trace import span
+
+        self._ingest_recover()
+        o = self._o
+        last_ckpt_at = time.monotonic()
+        while True:
+            if self._killed:
+                return
+            stopping = self._stop.is_set()
+            events = [] if stopping else self.source.poll(
+                self._offset_pending, o["max_batch"])
+            now_mono = time.monotonic()
+            if events:
+                if self._batch_first_pull_ms is None:
+                    self._batch_first_pull_ms = _now_ms()
+                with span("stream.ingest.batch", cat="stream",
+                          events=len(events),
+                          first=events[0][0], last=events[-1][0]):
+                    # write_events blocks on write.flush.max-bytes:
+                    # THE backpressure coupling — no internal queue
+                    self._sink.write_events([e for _, e in events])
+                self._offset_pending = events[-1][0]
+                self._metrics.counter(STREAM_EVENTS_INGESTED) \
+                    .inc(len(events))
+            self._metrics.gauge(STREAM_SOURCE_BACKLOG).set(
+                self.source.backlog(self._offset_pending))
+            pending = self._offset_pending > self._offset
+            if pending and (stopping or
+                            (now_mono - last_ckpt_at) * 1000
+                            >= o["ckpt_interval_ms"]):
+                self._checkpoint()
+                last_ckpt_at = time.monotonic()
+            if stopping:
+                return            # drained (final checkpoint above)
+            if not events:
+                self._stop.wait(o["ingest_poll_ms"] / 1000.0)
+
+    def _checkpoint(self):
+        from paimon_tpu.metrics import (
+            STREAM_CHECKPOINT_MS, STREAM_CHECKPOINTS,
+        )
+        from paimon_tpu.obs.trace import span
+
+        ckpt = self._next_ckpt
+        props = {PROP_OFFSET: str(self._offset_pending),
+                 PROP_INGEST_TS: str(self._batch_first_pull_ms
+                                     or _now_ms())}
+        with span("stream.checkpoint", cat="stream", group="stream",
+                  metric=STREAM_CHECKPOINT_MS, checkpoint=ckpt,
+                  offset=self._offset_pending):
+            self._sink.commit(ckpt, properties=props)
+        # past this line the checkpoint is durable: advance in-memory
+        # state (a crash between commit and here replays the
+        # checkpoint, which filter_committed + pending-keying dedup)
+        self._offset = self._offset_pending
+        self._next_ckpt = ckpt + 1
+        self._batch_first_pull_ms = None
+        self._metrics.counter(STREAM_CHECKPOINTS).inc()
+        # sources that cache events may evict everything at/below the
+        # now-durable offset (FileCdcSource bounds its memory this way)
+        commit_through = getattr(self.source, "commit_through", None)
+        if commit_through is not None:
+            commit_through(self._offset)
+
+    # -- compaction loop -----------------------------------------------------
+
+    def _ingest_pressure(self) -> bool:
+        from paimon_tpu.metrics import (
+            STREAM_SOURCE_BACKLOG, WRITE_INFLIGHT_BYTES, global_registry,
+        )
+
+        inflight = global_registry().write_metrics() \
+            .gauge(WRITE_INFLIGHT_BYTES).value
+        budget = self._o["flush_max_bytes"]
+        if budget and inflight > self._o["pause_ratio"] * budget:
+            return True
+        backlog = self._metrics.gauge(STREAM_SOURCE_BACKLOG).value
+        return backlog > self._o["pause_backlog"]
+
+    def _needs_compaction(self, table: FileStoreTable) -> bool:
+        """Level/size trigger: any bucket at/over the sorted-run
+        trigger (pk tables: level-0 files each count as a run, higher
+        levels one run each — compact/levels.py semantics) or, for
+        append tables, at/over compaction.min.file-num."""
+        snapshot = table.latest_snapshot()
+        if snapshot is None:
+            return False
+        scan = table.new_scan()
+        per_bucket: Dict[tuple, List] = {}
+        for e in scan.read_entries(snapshot):
+            if e.bucket == -2:
+                continue
+            per_bucket.setdefault((e.partition, e.bucket), []) \
+                .append(e.file)
+        if not table.schema.primary_keys:
+            trigger = table.options.get(
+                CoreOptions.COMPACTION_MIN_FILE_NUM)
+            return any(len(fs) >= trigger for fs in per_bucket.values())
+        trigger = table.options.num_sorted_runs_compaction_trigger
+        for files in per_bucket.values():
+            runs = sum(1 for f in files if f.level == 0) + \
+                len({f.level for f in files if f.level > 0})
+            if runs >= trigger:
+                return True
+        return False
+
+    def _compact_body(self):
+        from paimon_tpu.metrics import (
+            STREAM_COMPACTIONS, STREAM_COMPACTIONS_PAUSED,
+        )
+        from paimon_tpu.obs.trace import span
+
+        o = self._o
+        last_expire_at = time.monotonic()
+        while not self._stop.wait(o["compact_interval_ms"] / 1000.0):
+            if self._ingest_pressure():
+                # graceful degradation: ingest pressure wins; try
+                # again next round
+                self._metrics.counter(STREAM_COMPACTIONS_PAUSED).inc()
+                continue
+            table = FileStoreTable.load(
+                self.table.path, file_io=self.table.file_io,
+                dynamic_options=self._dynamic or None)
+            if self._needs_compaction(table):
+                with span("stream.compact", cat="stream",
+                          full=o["compact_full"]):
+                    sid = table.compact(full=o["compact_full"])
+                if sid is not None:
+                    self._metrics.counter(STREAM_COMPACTIONS).inc()
+            if o["expire_interval_ms"] is not None and \
+                    (time.monotonic() - last_expire_at) * 1000 \
+                    >= o["expire_interval_ms"]:
+                # NEVER expire the newest offset-carrying snapshot: it
+                # is the recovery point — losing it would restart the
+                # source from scratch and reuse commit identifiers.
+                # Widening retain_min pins everything back to it (an
+                # idle source under active compaction is exactly when
+                # newer non-ingest snapshots would otherwise push it
+                # out of the retention window).
+                retain_min = None
+                ckpt_snap = find_checkpoint_snapshot(table,
+                                                     self.commit_user)
+                latest = table.snapshot_manager.latest_snapshot_id()
+                if ckpt_snap is not None and latest is not None:
+                    retain_min = latest - ckpt_snap.id + 1
+                table.expire_snapshots(
+                    retain_min=retain_min,
+                    retain_max=None if retain_min is None else max(
+                        retain_min, table.options.get(
+                            CoreOptions.SNAPSHOT_NUM_RETAINED_MAX)))
+                last_expire_at = time.monotonic()
+
+    # -- changelog serving loop ----------------------------------------------
+
+    def _serve_body(self):
+        from paimon_tpu.metrics import (
+            STREAM_CHANGELOG_ROWS, STREAM_FRESHNESS_MS,
+        )
+        from paimon_tpu.obs.trace import span
+
+        # persist serving progress as consumer state so a restarted
+        # serving loop (or daemon incarnation) RESUMES the stream
+        # instead of full-rescanning — resuming replays every delta
+        # (including delete tombstones) exactly from where consumers
+        # last got rows, and re-served batches are upsert-idempotent
+        table = FileStoreTable.load(
+            self.table.path, file_io=self.table.file_io,
+            dynamic_options={**self._dynamic,
+                             "consumer-id": f"{self.commit_user}-serve"})
+        rb = table.new_read_builder()
+        scan = rb.new_stream_scan()
+        while True:
+            if self._killed:
+                return
+            was_first = scan._first
+            plan = scan.plan()
+            if plan is None:
+                if self._stop.is_set() and not self._ingest_alive():
+                    # caught up AND the final checkpoint (committed by
+                    # the ingest loop before it exited) has been served
+                    return
+                self._stop.wait(self._o["serve_poll_ms"] / 1000.0)
+                continue
+            if plan.splits:
+                with span("stream.serve.batch", cat="stream",
+                          snapshot=plan.snapshot_id) as sp:
+                    rows = rb.new_read().to_arrow(plan).to_pylist()
+                    # freshness is only meaningful for follow-up
+                    # deltas (a startup full scan spans all history)
+                    freshness = None if was_first else \
+                        self._freshness_ms(table, plan.snapshot_id)
+                    if freshness is not None:
+                        # event -> visible-in-changelog-scan latency,
+                        # from the ingest ts the checkpoint committed
+                        self._metrics.histogram(STREAM_FRESHNESS_MS) \
+                            .update(freshness)
+                        sp.set(freshness_ms=freshness)
+                if not self._emit(rows):
+                    return          # killed while blocked on the buffer
+                self._metrics.counter(STREAM_CHANGELOG_ROWS) \
+                    .inc(len(rows))
+            # rows are delivered (bounded buffer): record consumer
+            # progress so a restart resumes past this snapshot
+            scan.notify_checkpoint_complete(scan.checkpoint())
+
+    def _freshness_ms(self, table: FileStoreTable,
+                      snapshot_id: Optional[int]) -> Optional[float]:
+        if snapshot_id is None:
+            return None
+        try:
+            snap = table.snapshot_manager.snapshot(snapshot_id)
+        except (FileNotFoundError, OSError):
+            return None
+        props = snap.properties or {}
+        if PROP_INGEST_TS not in props:
+            return None           # not one of our ingest checkpoints
+        return max(0.0, _now_ms() - int(props[PROP_INGEST_TS]))
+
+    def _emit(self, rows: List[dict]) -> bool:
+        """Bounded blocking enqueue: the serving loop stalls (never
+        drops, never grows without bound) while consumers lag.  False
+        when killed while waiting — the rows were NOT delivered, so
+        the caller must not record progress past them."""
+        cap = self._o["serve_buffer_rows"]
+        i = 0
+        with self._buf_cond:
+            while i < len(rows):
+                while len(self._buf) >= cap and not self._killed:
+                    self._buf_cond.wait(0.2)
+                if self._killed:
+                    # partially-delivered batch: progress is NOT
+                    # recorded, the next incarnation re-serves it
+                    # (upsert-idempotent for consumers)
+                    return False
+                take = max(1, cap - len(self._buf))
+                self._buf.extend(rows[i:i + take])
+                i += take
+                self._buf_cond.notify_all()
+        return True

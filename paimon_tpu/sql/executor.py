@@ -41,6 +41,36 @@ def _result(rows: List[str], name: str = "result") -> pa.Table:
     return pa.table({name: pa.array(rows, pa.string())})
 
 
+def _sort_indices(tbl: pa.Table, keys) -> pa.Array:
+    """`pc.sort_indices` over `keys` = [(name, direction, placement)].
+
+    Modern pyarrow (>= 16) accepts only (name, direction) 2-tuples with
+    ONE table-wide `null_placement`; SQL ORDER BY carries per-key NULLS
+    FIRST/LAST.  Uniform placements pass straight through; mixed
+    placements sort by a prepended is-null indicator per key whose
+    placement disagrees with the majority (True first = NULLS FIRST),
+    which pyarrow cannot express natively.
+    """
+    placements = {pl for _, _, pl in keys}
+    if len(placements) <= 1:
+        return pc.sort_indices(
+            tbl, sort_keys=[(n, d) for n, d, _ in keys],
+            null_placement=placements.pop() if placements else "at_end")
+    sort_keys, extra = [], {}
+    for i, (name, direction, placement) in enumerate(keys):
+        ind = f"__nulls{i}"
+        extra[ind] = pc.is_null(tbl.column(name))
+        # nulls-first == indicator True first == descending indicator
+        sort_keys.append(
+            (ind, "descending" if placement == "at_start"
+             else "ascending"))
+        sort_keys.append((name, direction))
+    aug = tbl
+    for cn, arr in extra.items():
+        aug = aug.append_column(cn, arr)
+    return pc.sort_indices(aug, sort_keys=sort_keys)
+
+
 class Scope:
     """A resolved relation: an Arrow table whose columns are internally
     qualified ("alias.col"), plus the bare-name resolution map."""
@@ -842,7 +872,7 @@ class SQLContext:
                         raise SQLError("ORDER BY over a UNION must "
                                        "reference output columns")
                     keys.append((name, direction, pl))
-                out = out.take(pc.sort_indices(out, sort_keys=keys))
+                out = out.take(_sort_indices(out, keys))
             if s.limit is not None:
                 out = out.slice(s.offset or 0, s.limit)
             elif s.offset:
@@ -1025,7 +1055,7 @@ class SQLContext:
             tmp = tmp.append_column(cn, col)
             sort_cols.append(cn)
             keys.append((cn, direction, pl))
-        idxs = pc.sort_indices(tmp, sort_keys=keys)
+        idxs = _sort_indices(tmp, keys)
         return tmp.take(idxs).drop_columns(sort_cols) if sort_cols \
             else tmp.take(idxs)
 
@@ -2006,8 +2036,7 @@ class _WindowSegments:
         cols["__wi"] = pa.array(np.arange(n))
         sort_keys.append(("__wi", "ascending", "at_end"))   # stable
         self._st = pa.table(cols)
-        self.order = np.asarray(pc.sort_indices(self._st,
-                                                sort_keys=sort_keys))
+        self.order = np.asarray(_sort_indices(self._st, sort_keys))
 
         seg_start = np.zeros(n, dtype=bool)
         if n:

@@ -516,11 +516,16 @@ class TableCommit:
 
     def commit(self, messages: Sequence[CommitMessage],
                commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
-               watermark: Optional[int] = None) -> Optional[int]:
+               watermark: Optional[int] = None,
+               properties: Optional[Dict[str, str]] = None
+               ) -> Optional[int]:
         """`watermark` (epoch millis) records event-time progress in the
         snapshot — it only ever advances — feeding watermark-mode auto
         tags and the snapshots system table (reference
-        TableCommitImpl#withWatermark)."""
+        TableCommitImpl#withWatermark).  `properties` are stored on the
+        snapshot itself, atomically with the data — the stream daemon
+        checkpoints its source offsets this way (exactly-once across
+        restarts); ignored on the overwrite path."""
         index_entries = [e for m in messages
                          for e in getattr(m, "index_entries", [])]
         # empty batch commits produce no snapshot unless forced
@@ -545,7 +550,7 @@ class TableCommit:
             sid = self._commit.commit(
                 messages, commit_identifier,
                 index_entries=index_entries or None,
-                watermark=watermark,
+                watermark=watermark, properties=properties,
                 # a streaming empty commit still snapshots so the
                 # identifier is durable for exactly-once replay dedup
                 force_create=not ignore_empty)

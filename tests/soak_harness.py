@@ -1,0 +1,287 @@
+"""Fault-injected soak harness for the streaming daemon.
+
+Runs ingest + compaction + changelog-serving (service/stream_daemon.py)
+over one primary-key table for N wall-clock seconds while a scheduled
+fault plan hits the store:
+
+- **503 storms** — FailingFileIO armed with a bounded `fail_times`, so
+  a burst of mutating ops fails transiently (write retries, supervised
+  loop restarts) and then heals;
+- **torn two-phase uploads** — storms started with a small `fail_after`
+  land on whatever mutating op comes next, including `two_phase.close`
+  (the staged-bytes upload) and `two_phase.commit`;
+- **kill/restart mid-checkpoint** — the store is armed to fail
+  EVERYTHING, the in-flight checkpoint dies, the daemon is killed
+  without drain, and a NEW daemon instance recovers from the
+  checkpointed offset and replays.
+
+The harness is also the exactly-once auditor.  It tracks the expected
+materialized state (id -> v, with deletes) as it emits events, and at
+the end asserts:
+
+1. the table's final state equals the expected state (no lost events);
+2. the changelog stream, materialized in consumption order across all
+   daemon incarnations, equals the expected state (no lost/duplicated
+   deliveries — a duplicate replayed checkpoint would re-deliver rows
+   and a stale delete would corrupt the materialization);
+3. committed source offsets read back from snapshot properties are
+   strictly increasing and end at the last emitted offset (checkpoint
+   atomicity: an offset is committed exactly when its data is);
+4. commit identifiers of ingest checkpoints are strictly increasing
+   (no identifier reuse across kill/restart cycles);
+5. `fsck` is clean;
+6. freshness (event pulled -> visible in a changelog scan) was
+   measured through the obs plane; p95 is reported.
+
+`run_soak` returns a report dict; tests assert on it.  The tier-1
+smoke runs a short deterministic schedule; the `slow` variant runs
+>= 60 s with more cycles (tests/test_stream_daemon.py).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from paimon_tpu.cdc.source import MemoryCdcSource
+from paimon_tpu.core.read import ROW_KIND_COL
+from paimon_tpu.metrics import (
+    STREAM_CHECKPOINTS, STREAM_COMPACTIONS, STREAM_EVENTS_INGESTED,
+    STREAM_FRESHNESS_MS, STREAM_LOOP_RESTARTS, global_registry,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.service.stream_daemon import (
+    PROP_OFFSET, StreamDaemon, recover_checkpoint,
+)
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType
+from tests.failing_fileio import FailingFileIO
+
+__all__ = ["run_soak"]
+
+
+DEFAULT_TABLE_OPTIONS = {
+    "bucket": "2",
+    # small checkpoints + small trigger so a short soak exercises many
+    # checkpoint commits and real compactions
+    "stream.checkpoint.interval": "80",
+    "stream.compaction.interval": "200",
+    "num-sorted-run.compaction-trigger": "3",
+    "stream.serve.poll-interval": "20",
+    "stream.ingest.poll-interval": "10",
+    "stream.restart.backoff": "20",
+    "stream.restart.backoff.cap": "150",
+    "write.retry.backoff": "5",
+    # keep every snapshot: the end-of-run offset audit walks all of
+    # them, and the serving loop must never lose a delta to expiry
+    "snapshot.num-retained.min": "100000",
+    "snapshot.num-retained.max": "100000",
+}
+
+
+class _Auditor:
+    """Expected state + changelog materialization, upsert semantics."""
+
+    def __init__(self):
+        self.expected: Dict[int, int] = {}
+        self.materialized: Dict[int, int] = {}
+
+    def emit(self, key: int, value: Optional[int]):
+        if value is None:
+            self.expected.pop(key, None)
+        else:
+            self.expected[key] = value
+
+    def apply(self, rows: List[dict]):
+        for r in rows:
+            kind = r[ROW_KIND_COL]
+            if kind in (0, 2):                     # +I / +U
+                self.materialized[r["id"]] = r["v"]
+            elif kind == 3:                        # -D
+                self.materialized.pop(r["id"], None)
+
+
+def _drain(daemon: StreamDaemon, auditor: _Auditor,
+           timeout: float = 0.05):
+    while True:
+        rows = daemon.poll_changelog(timeout=timeout)
+        if not rows:
+            return
+        auditor.apply(rows)
+
+
+def run_soak(base_dir: str, *,
+             duration_s: float = 6.0,
+             seed: int = 7,
+             keys: int = 29,
+             emit_batch: int = 4,
+             emit_interval_s: float = 0.004,
+             kills: int = 3,
+             storms: int = 3,
+             storm_fail_times: int = 5,
+             mesh: bool = False,
+             delete_ratio: float = 0.08,
+             table_options: Optional[Dict[str, str]] = None) -> Dict:
+    """Run the soak; returns the report dict (asserting internally on
+    every exactly-once / convergence invariant)."""
+    rng = random.Random(seed)
+    fault_name = f"soak-{uuid.uuid4().hex[:8]}"
+
+    opts = dict(DEFAULT_TABLE_OPTIONS)
+    if mesh:
+        opts["tpu.mesh.compact"] = "true"
+    opts.update(table_options or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", BigIntType())
+              .primary_key("id")
+              .options(opts)
+              .build())
+    base = FileStoreTable.create(f"{base_dir}/soak", schema)
+    fio = FailingFileIO(base.file_io, fault_name)
+    table = FileStoreTable(fio, base.path,
+                           base.schema_manager.latest())
+
+    source = MemoryCdcSource()
+    auditor = _Auditor()
+    counter = {"n": 0}
+
+    def emit_some(k: int):
+        events = []
+        for _ in range(k):
+            n = counter["n"]
+            counter["n"] = n + 1
+            key = n % keys
+            if auditor.expected.get(key) is not None and \
+                    rng.random() < delete_ratio:
+                events.append({"op": "d", "before": {"id": key,
+                                                     "v": n}})
+                auditor.emit(key, None)
+            else:
+                events.append({"op": "c", "after": {"id": key,
+                                                    "v": n}})
+                auditor.emit(key, n)
+        source.append(*events)
+
+    # fault schedule: kills evenly spaced in the middle 70% of the run,
+    # storms offset between them
+    t_start = time.monotonic()
+    t_end = t_start + duration_s
+    emit_until = t_start + duration_s * 0.8
+    kill_at = [t_start + duration_s * (0.15 + 0.7 * (i + 1)
+                                       / (kills + 1))
+               for i in range(kills)]
+    storm_at = [t_start + duration_s * (0.1 + 0.7 * (i + 0.5)
+                                        / (storms + 1))
+                for i in range(storms)]
+    storms_done = kills_done = 0
+
+    g = global_registry().stream_metrics()
+    base_counts = {name: g.counter(name).count
+                   for name in (STREAM_EVENTS_INGESTED,
+                                STREAM_CHECKPOINTS,
+                                STREAM_LOOP_RESTARTS,
+                                STREAM_COMPACTIONS)}
+
+    daemon = StreamDaemon(table, source).start()
+    incarnations = 1
+    last_emit = 0.0
+    try:
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            if now < emit_until and now - last_emit >= emit_interval_s:
+                emit_some(emit_batch)
+                last_emit = now
+            _drain(daemon, auditor, timeout=0.0)
+            if storms_done < storms and now >= storm_at[storms_done]:
+                # transient 503 storm; small fail_after tears whatever
+                # comes next (incl. two-phase closes/commits)
+                FailingFileIO.reset(fault_name,
+                                    rng.randrange(0, 4),
+                                    fail_times=storm_fail_times)
+                storms_done += 1
+            if kills_done < kills and now >= kill_at[kills_done]:
+                # kill mid-checkpoint: everything fails, the in-flight
+                # checkpoint dies, then the process "dies"
+                FailingFileIO.reset(fault_name, 0)
+                time.sleep(0.05)
+                daemon.kill()
+                FailingFileIO.disarm(fault_name)
+                _drain(daemon, auditor)        # old incarnation's tail
+                daemon = StreamDaemon(table, source).start()
+                incarnations += 1
+                kills_done += 1
+            time.sleep(0.002)
+
+        FailingFileIO.disarm(fault_name)
+        # convergence: wait until the last emitted offset is committed
+        last_offset = source.latest_offset()
+        deadline = time.monotonic() + max(30.0, duration_s)
+        while time.monotonic() < deadline:
+            _drain(daemon, auditor, timeout=0.0)
+            if daemon.status()["offset_committed"] >= last_offset:
+                break
+            time.sleep(0.05)
+        status = daemon.stop(drain=True)
+        _drain(daemon, auditor)
+    finally:
+        FailingFileIO.disarm(fault_name)
+        daemon.kill()
+
+    assert status["offset_committed"] == last_offset, \
+        f"daemon never converged: committed " \
+        f"{status['offset_committed']} < emitted {last_offset}"
+
+    # -- audits (all on a clean FileIO) --------------------------------------
+    final = FileStoreTable.load(base.path)
+    table_state = {r["id"]: r["v"]
+                   for r in final.to_arrow().to_pylist()}
+    assert table_state == auditor.expected, \
+        "table state diverged from emitted events (lost/dup writes)"
+    assert auditor.materialized == auditor.expected, \
+        "changelog materialization diverged (lost/dup deliveries)"
+
+    offsets, idents = [], []
+    for snap in final.snapshot_manager.snapshots():
+        if snap.commit_user == "stream-daemon" and snap.properties \
+                and PROP_OFFSET in snap.properties:
+            offsets.append(int(snap.properties[PROP_OFFSET]))
+            idents.append(snap.commit_identifier)
+    assert offsets == sorted(set(offsets)), \
+        f"committed offsets not strictly increasing: {offsets}"
+    assert offsets and offsets[-1] == last_offset
+    assert idents == sorted(set(idents)), \
+        f"commit identifiers not strictly increasing: {idents}"
+    assert recover_checkpoint(final, "stream-daemon")[0] == last_offset
+
+    report = final.fsck()
+    assert report.ok, [v.to_dict() for v in report.violations]
+
+    freshness = g.histogram(STREAM_FRESHNESS_MS)
+    assert freshness.total_count > 0, \
+        "no freshness samples: the serving loop never measured " \
+        "event -> changelog-visible latency"
+
+    return {
+        "duration_s": round(time.monotonic() - t_start, 2),
+        "events_emitted": counter["n"],
+        "events_ingested": g.counter(STREAM_EVENTS_INGESTED).count
+        - base_counts[STREAM_EVENTS_INGESTED],
+        "checkpoints": g.counter(STREAM_CHECKPOINTS).count
+        - base_counts[STREAM_CHECKPOINTS],
+        "loop_restarts": g.counter(STREAM_LOOP_RESTARTS).count
+        - base_counts[STREAM_LOOP_RESTARTS],
+        "compactions": g.counter(STREAM_COMPACTIONS).count
+        - base_counts[STREAM_COMPACTIONS],
+        "kill_restart_cycles": kills_done,
+        "storms": storms_done,
+        "daemon_incarnations": incarnations,
+        "keys_final": len(auditor.expected),
+        "freshness_p95_ms": freshness.percentile(95),
+        "freshness_samples": freshness.total_count,
+        "fsck_ok": True,
+        "final_offset": last_offset,
+        "snapshots": final.snapshot_manager.snapshot_count(),
+    }

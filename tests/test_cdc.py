@@ -118,6 +118,87 @@ def test_cdc_schema_evolution_mid_checkpoint_keeps_buffered_rows(
     assert rows[1]["extra"] == 7
 
 
+def test_cdc_commit_crash_after_cas_does_not_redeliver(
+        tmp_warehouse, monkeypatch):
+    """Crash BETWEEN the snapshot CAS and the commit ack: the messages
+    are restored keyed by the attempted identifier, and a later
+    checkpoint must detect the identifier actually landed and DROP them
+    instead of re-delivering the committed rows (stream-daemon replay
+    keyed by the checkpointed offset rides exactly this)."""
+    from paimon_tpu.table.table import TableCommit
+
+    table = _make(tmp_warehouse)
+    sink = CdcSinkWriter(table, format="debezium", commit_user="job-y")
+
+    real_commit = TableCommit.commit
+    state = {"bombs": 1}
+
+    def exploding_commit(self, messages, commit_identifier=..., **kw):
+        sid = real_commit(self, messages,
+                          commit_identifier=commit_identifier, **kw)
+        if state["bombs"] > 0:
+            state["bombs"] -= 1
+            raise RuntimeError("injected crash after CAS, before ack")
+        return sid
+
+    monkeypatch.setattr(TableCommit, "commit", exploding_commit)
+    sink.write_events([{"op": "c", "after": {"id": 1, "v": 1.0}}])
+    with pytest.raises(RuntimeError, match="after CAS"):
+        sink.commit(1)
+    # the snapshot DID land; the daemon replays with the next identifier
+    sink.write_events([{"op": "c", "after": {"id": 2, "v": 2.0}}])
+    sink.commit(2)
+    sink.close()
+    rows = sorted(FileStoreTable.load(table.path).to_arrow().to_pylist(),
+                  key=lambda r: r["id"])
+    assert rows == [{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}]
+    # checkpoint 1's rows were committed exactly once: the two
+    # snapshots' deltas hold one row each (no re-delivery of id=1)
+    snaps = list(FileStoreTable.load(table.path)
+                 .snapshot_manager.snapshots())
+    assert [s.delta_record_count for s in snaps] == [1, 1]
+
+
+def test_cdc_commit_failure_before_cas_retries_same_checkpoint(
+        tmp_warehouse, monkeypatch):
+    """Commit raises BEFORE the CAS lands: retrying the same identifier
+    must deliver the restored messages exactly once."""
+    from paimon_tpu.table.table import TableCommit
+
+    table = _make(tmp_warehouse)
+    sink = CdcSinkWriter(table, format="debezium", commit_user="job-z")
+
+    real_commit = TableCommit.commit
+    state = {"bombs": 1}
+
+    def failing_commit(self, messages, commit_identifier=..., **kw):
+        if state["bombs"] > 0:
+            state["bombs"] -= 1
+            raise RuntimeError("injected failure before CAS")
+        return real_commit(self, messages,
+                           commit_identifier=commit_identifier, **kw)
+
+    monkeypatch.setattr(TableCommit, "commit", failing_commit)
+    sink.write_events([{"op": "c", "after": {"id": 1, "v": 1.0}}])
+    with pytest.raises(RuntimeError, match="before CAS"):
+        sink.commit(1)
+    assert sink.commit(1) is not None        # retry converges
+    sink.close()
+    assert FileStoreTable.load(table.path).to_arrow().to_pylist() == \
+        [{"id": 1, "v": 1.0}]
+
+
+def test_cdc_commit_properties_land_in_snapshot(tmp_warehouse):
+    table = _make(tmp_warehouse)
+    sink = CdcSinkWriter(table, format="debezium")
+    sink.write_events([{"op": "c", "after": {"id": 1, "v": 1.0}}])
+    sink.commit(3, properties={"stream.source.offset": "41"})
+    sink.close()
+    snap = FileStoreTable.load(table.path).latest_snapshot()
+    assert snap.properties == {"stream.source.offset": "41"}
+    assert snap.commit_identifier == 3
+
+
 # -- computed columns / widening / database sync ------------------------------
 
 def test_computed_columns_partition_from_timestamp(tmp_warehouse):

@@ -1,0 +1,450 @@
+"""Streaming lakehouse daemon (service/stream_daemon.py): checkpointed
+exactly-once ingest, supervised loop restarts, backpressure coupling,
+graceful degradation, drain, changelog serving on the query service,
+and the fault-injected soak (tier-1 smoke + `slow` full variant).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paimon_tpu.cdc.source import FileCdcSource, MemoryCdcSource
+from paimon_tpu.core.read import ROW_KIND_COL
+from paimon_tpu.metrics import (
+    STREAM_CHECKPOINTS, STREAM_COMPACTIONS, STREAM_COMPACTIONS_PAUSED,
+    global_registry,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.service.stream_daemon import (
+    PROP_INGEST_TS, PROP_OFFSET, StreamDaemon, checkpoint_once,
+    recover_checkpoint,
+)
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType
+from tests.soak_harness import run_soak
+
+FAST = {
+    "bucket": "2",
+    "stream.checkpoint.interval": "60",
+    "stream.compaction.interval": "120",
+    "num-sorted-run.compaction-trigger": "3",
+    "stream.serve.poll-interval": "15",
+    "stream.ingest.poll-interval": "10",
+    "stream.restart.backoff": "10",
+    "stream.restart.backoff.cap": "60",
+}
+
+
+def _make(tmp_path, opts=None, name="t"):
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", BigIntType())
+              .primary_key("id")
+              .options({**FAST, **(opts or {})})
+              .build())
+    return FileStoreTable.create(str(tmp_path / name), schema)
+
+
+def _insert(i, key=None):
+    return {"op": "c", "after": {"id": i if key is None else key,
+                                 "v": i}}
+
+
+def _wait(cond, timeout=15.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _consume_state(daemon, state, timeout=0.05):
+    while True:
+        rows = daemon.poll_changelog(timeout=timeout)
+        if not rows:
+            return
+        for r in rows:
+            if r[ROW_KIND_COL] in (0, 2):
+                state[r["id"]] = r["v"]
+            elif r[ROW_KIND_COL] == 3:
+                state.pop(r["id"], None)
+
+
+# -- checkpoint / recovery ----------------------------------------------------
+
+def test_recover_checkpoint_empty(tmp_path):
+    table = _make(tmp_path)
+    assert recover_checkpoint(table, "stream-daemon") == (-1, 0)
+
+
+def test_checkpoint_once_commits_offset_atomically(tmp_path):
+    table = _make(tmp_path)
+    src = MemoryCdcSource([_insert(i) for i in range(5)])
+    sid = checkpoint_once(table, src)
+    assert sid is not None
+    snap = FileStoreTable.load(table.path).latest_snapshot()
+    assert snap.properties[PROP_OFFSET] == "4"
+    assert int(snap.properties[PROP_INGEST_TS]) > 0
+    assert recover_checkpoint(table, "stream-daemon") == (4, 1)
+    # nothing new -> no checkpoint, offset unchanged
+    assert checkpoint_once(table, src) is None
+    src.append(_insert(5))
+    assert checkpoint_once(table, src) is not None
+    assert recover_checkpoint(table, "stream-daemon") == (5, 2)
+
+
+def test_daemon_ingests_serves_and_drains(tmp_path):
+    table = _make(tmp_path)
+    src = MemoryCdcSource()
+    daemon = StreamDaemon(table, src).start()
+    expected = {}
+    for i in range(120):
+        expected[i % 11] = i
+        src.append(_insert(i, key=i % 11))
+    state = {}
+    assert _wait(lambda: daemon.status()["offset_committed"] == 119)
+    status = daemon.stop()               # drain
+    _consume_state(daemon, state)
+    assert status["offset_committed"] == 119
+    assert not any(l["failed"] for l in status["loops"].values())
+    assert state == expected             # changelog materializes exactly
+    t2 = FileStoreTable.load(table.path)
+    assert {r["id"]: r["v"] for r in t2.to_arrow().to_pylist()} \
+        == expected
+    assert t2.fsck().ok
+
+
+def test_kill_restart_replays_exactly_once(tmp_path):
+    """Kill without drain mid-stream; a second daemon must converge to
+    exactly one copy of every event, with offsets strictly increasing
+    and identifiers never reused."""
+    table = _make(tmp_path)
+    src = MemoryCdcSource()
+    for i in range(60):
+        src.append(_insert(i, key=i % 7))
+    d1 = StreamDaemon(table, src).start()
+    _wait(lambda: d1.status()["offset_committed"] >= 0)
+    d1.kill()                            # no final checkpoint
+    committed_at_kill = d1.status()["offset_committed"]
+    for i in range(60, 90):
+        src.append(_insert(i, key=i % 7))
+    d2 = StreamDaemon(table, src).start()
+    assert _wait(lambda: d2.status()["offset_committed"] == 89)
+    d2.stop()
+    final = FileStoreTable.load(table.path)
+    assert {r["id"]: r["v"] for r in final.to_arrow().to_pylist()} \
+        == {i % 7: i for i in range(90)}
+    offs, idents = [], []
+    for s in final.snapshot_manager.snapshots():
+        if s.commit_user == "stream-daemon" and s.properties:
+            offs.append(int(s.properties[PROP_OFFSET]))
+            idents.append(s.commit_identifier)
+    assert offs == sorted(set(offs)) and offs[-1] == 89
+    assert idents == sorted(set(idents))
+    assert committed_at_kill in offs
+    assert final.fsck().ok
+
+
+# -- backpressure / degradation ----------------------------------------------
+
+def test_serve_buffer_is_bounded_backpressure(tmp_path):
+    """An unconsumed changelog buffer must stall the serving loop at
+    its bound, never grow (no unbounded queueing)."""
+    cap = 64
+    table = _make(tmp_path,
+                  {"stream.serve.buffer.rows": str(cap)})
+    src = MemoryCdcSource()
+    daemon = StreamDaemon(table, src, compact=False).start()
+    for i in range(1000):
+        src.append(_insert(i, key=i))    # 1000 distinct keys
+    _wait(lambda: daemon.status()["offset_committed"] == 999)
+    # serving stalls at the cap: admission is chunked, so even a
+    # single large batch cannot overshoot it
+    time.sleep(0.5)
+    assert daemon.status()["buffered_rows"] <= cap
+    seen = {}
+    deadline = time.monotonic() + 30.0
+    while len(seen) < 1000 and time.monotonic() < deadline:
+        _consume_state(daemon, seen, timeout=0.3)
+    daemon.stop()
+    _consume_state(daemon, seen)
+    assert len(seen) == 1000             # everything arrived, in order
+
+
+def test_compaction_pauses_under_ingest_pressure(tmp_path):
+    """Graceful degradation: with the pause threshold forced on, the
+    compaction loop skips rounds instead of competing with ingest."""
+    g = global_registry().stream_metrics()
+    paused0 = g.counter(STREAM_COMPACTIONS_PAUSED).count
+    table = _make(tmp_path,
+                  {"stream.compaction.pause-backlog": "-1"})
+    src = MemoryCdcSource()
+    daemon = StreamDaemon(table, src, serve=False).start()
+    for i in range(100):
+        src.append(_insert(i, key=i % 5))
+    _wait(lambda: daemon.status()["offset_committed"] == 99)
+    _wait(lambda: g.counter(STREAM_COMPACTIONS_PAUSED).count > paused0,
+          timeout=5.0)
+    daemon.stop()
+    assert g.counter(STREAM_COMPACTIONS_PAUSED).count > paused0
+    # no COMPACT snapshot was committed while paused
+    from paimon_tpu.snapshot import CommitKind
+    kinds = {s.commit_kind for s in
+             FileStoreTable.load(table.path)
+             .snapshot_manager.snapshots()}
+    assert CommitKind.COMPACT not in kinds
+
+
+def test_compaction_triggers_on_sorted_runs(tmp_path):
+    g = global_registry().stream_metrics()
+    c0 = g.counter(STREAM_COMPACTIONS).count
+    table = _make(tmp_path)
+    src = MemoryCdcSource()
+    daemon = StreamDaemon(table, src, serve=False).start()
+    # >= 4 checkpoints -> >= 4 level-0 files per bucket -> over trigger
+    for batch in range(6):
+        for i in range(20):
+            src.append(_insert(batch * 20 + i, key=i))
+        time.sleep(0.1)
+    _wait(lambda: daemon.status()["offset_committed"] == 119)
+    _wait(lambda: g.counter(STREAM_COMPACTIONS).count > c0,
+          timeout=10.0)
+    daemon.stop()
+    assert g.counter(STREAM_COMPACTIONS).count > c0
+    final = FileStoreTable.load(table.path)
+    from paimon_tpu.snapshot import CommitKind
+    assert any(s.commit_kind == CommitKind.COMPACT
+               for s in final.snapshot_manager.snapshots())
+    assert {r["id"]: r["v"] for r in final.to_arrow().to_pylist()} \
+        == {i: 100 + i for i in range(20)}
+    assert final.fsck().ok
+
+
+def test_serving_stays_available_when_ingest_is_down(tmp_path):
+    """Read availability: the serving loop keeps answering from
+    committed snapshots while ingest crash-loops on a broken source."""
+
+    class BrokenSource:
+        def __init__(self, inner):
+            self.inner = inner
+            self.broken = False
+
+        def poll(self, after, n):
+            if self.broken:
+                raise IOError("source connection lost")
+            return self.inner.poll(after, n)
+
+        def backlog(self, after):
+            return 0 if self.broken else self.inner.backlog(after)
+
+    inner = MemoryCdcSource()
+    src = BrokenSource(inner)
+    table = _make(tmp_path)
+    daemon = StreamDaemon(table, src, compact=False).start()
+    for i in range(30):
+        inner.append(_insert(i, key=i % 5))
+    _wait(lambda: daemon.status()["offset_committed"] == 29)
+    src.broken = True                    # ingest starts crash-looping
+    _wait(lambda: daemon.status()["loops"]["ingest"]["restarts"] > 0,
+          timeout=10.0)
+    state = {}
+    _consume_state(daemon, state, timeout=1.0)
+    assert state == {i % 5: i for i in range(30)}   # still served
+    src.broken = False                   # ingest recovers by itself
+    inner.append(_insert(30, key=0))
+    assert _wait(lambda: daemon.status()["offset_committed"] == 30)
+    daemon.stop()
+    assert daemon.status()["loops"]["ingest"]["restarts"] >= 1
+
+
+# -- sources ------------------------------------------------------------------
+
+def test_file_cdc_source_tails_jsonl(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(_insert(0)) + "\n")
+        f.write(json.dumps(_insert(1)) + "\n")
+    src = FileCdcSource(path)
+    assert [o for o, _ in src.poll(-1, 10)] == [0, 1]
+    assert src.poll(1, 10) == []
+    with open(path, "a") as f:
+        f.write(json.dumps(_insert(2)) + "\n")
+        f.write('{"op": "c", "after"')        # torn line: not yet an event
+    assert [o for o, _ in src.poll(1, 10)] == [2]
+    with open(path, "a") as f:
+        f.write(': {"id": 9, "v": 9}}\n')     # completes the torn line
+    polled = src.poll(2, 10)
+    assert [o for o, _ in polled] == [3]
+    assert polled[0][1]["after"]["id"] == 9
+    # replay: same offsets return the same events
+    assert src.poll(-1, 10)[0][1] == _insert(0)
+    assert src.backlog(0) == 3
+    # checkpointed eviction bounds memory; later offsets still replay
+    src.commit_through(1)
+    assert len(src._events) == 2
+    assert [o for o, _ in src.poll(1, 10)] == [2, 3]
+    assert src.poll(-1, 10)[0][0] == 2     # evicted range skipped
+    assert src.latest_offset() == 3
+    assert src.backlog(1) == 2
+
+
+# -- query service ------------------------------------------------------------
+
+def test_query_service_changelog_endpoint(tmp_path):
+    from paimon_tpu.service.query_service import (
+        KvQueryClient, KvQueryServer,
+    )
+    table = _make(tmp_path)
+    src = MemoryCdcSource([_insert(i, key=i % 3) for i in range(10)])
+    checkpoint_once(table, src)
+    server = KvQueryServer(FileStoreTable.load(table.path)).start()
+    try:
+        client = KvQueryClient(address=server.address)
+        out = client.changelog(consumer="c1")
+        assert not out["caught_up"]
+        state = {r["id"]: r["v"] for r in out["rows"]
+                 if r[ROW_KIND_COL] in (0, 2)}
+        assert state == {0: 9, 1: 7, 2: 8}
+        # caught up until the next checkpoint commits
+        assert client.changelog(consumer="c1")["caught_up"]
+        src.append(_insert(10, key=0))
+        checkpoint_once(table, src)
+        out = client.changelog(consumer="c1")
+        assert [r["id"] for r in out["rows"]] == [0]
+        assert out["rows"][0]["v"] == 10
+        # an independent consumer starts from its own full scan
+        out2 = client.changelog(consumer="c2")
+        assert {r["id"]: r["v"] for r in out2["rows"]} \
+            == {0: 10, 1: 7, 2: 8}
+        # bounded responses: a large snapshot streams out in chunks
+        first = client.changelog(consumer="c3", max_rows=2)
+        assert len(first["rows"]) == 2 and first["more"]
+        rest = client.changelog(consumer="c3", max_rows=10)
+        assert len(rest["rows"]) == 1 and not rest["more"]
+    finally:
+        server.stop()
+
+
+def test_drain_failure_is_surfaced(tmp_path):
+    """A final checkpoint that fails during drain must be visible:
+    failed flag + last_error, offset_pending > offset_committed —
+    never a silently 'clean' exit."""
+    from paimon_tpu.table.table import FileStoreTable as FST
+    from tests.failing_fileio import FailingFileIO
+
+    base = _make(tmp_path, {"stream.checkpoint.interval": "60000"})
+    fio = FailingFileIO(base.file_io, "drain-fail")
+    table = FST(fio, base.path, base.schema_manager.latest())
+    src = MemoryCdcSource([_insert(i, key=i % 3) for i in range(10)])
+    daemon = StreamDaemon(table, src, compact=False,
+                          serve=False).start()
+    assert _wait(lambda: daemon.status()["offset_pending"] == 9)
+    FailingFileIO.reset("drain-fail", 0)      # everything fails now
+    try:
+        status = daemon.stop(drain=True, timeout=10.0)
+    finally:
+        FailingFileIO.disarm("drain-fail")
+    assert status["loops"]["ingest"]["failed"]
+    assert status["loops"]["ingest"]["last_error"]
+    assert status["offset_committed"] < status["offset_pending"]
+    # recovery on a healed store converges
+    d2 = StreamDaemon(
+        table, src, compact=False, serve=False,
+        dynamic_options={"stream.checkpoint.interval": "50"}).start()
+    assert _wait(lambda: d2.status()["offset_committed"] == 9)
+    d2.stop()
+    assert {r["id"]: r["v"]
+            for r in FST.load(base.path).to_arrow().to_pylist()} \
+        == {i % 3: i for i in range(10)}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_stream_verb(tmp_path, capsys):
+    from paimon_tpu.cli import main
+    wh = str(tmp_path / "wh")
+    assert main(["-w", wh, "db", "create", "d1"]) == 0
+    assert main(["-w", wh, "table", "create", "d1.t",
+                 "--column", "id:BIGINT NOT NULL",
+                 "--column", "v:BIGINT",
+                 "--primary-key", "id", "--option", "bucket=1"]) == 0
+    events = str(tmp_path / "events.jsonl")
+    with open(events, "w") as f:
+        for i in range(25):
+            f.write(json.dumps(_insert(i, key=i % 4)) + "\n")
+    capsys.readouterr()
+    rc = main(["-w", wh, "table", "stream", "d1.t",
+               "--source", events, "--duration", "1.5",
+               "--option", "stream.checkpoint.interval=50",
+               "--option", "stream.ingest.poll-interval=10"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    status = json.loads(out)
+    assert status["offset_committed"] == 24
+    rows = FileStoreTable.load(os.path.join(wh, "d1.db", "t")) \
+        .to_arrow().to_pylist()
+    assert {r["id"]: r["v"] for r in rows} == {i % 4: i
+                                               for i in range(25)}
+
+
+def test_sigterm_drains(tmp_path):
+    """SIGTERM -> clean drain: final checkpoint committed, loops
+    joined (the daemon's signal contract)."""
+    import signal
+
+    table = _make(tmp_path)
+    src = MemoryCdcSource([_insert(i, key=i % 3) for i in range(12)])
+    daemon = StreamDaemon(table, src, compact=False,
+                          serve=False).start()
+    daemon.install_signal_handlers()
+
+    def fire():
+        time.sleep(0.4)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    status = daemon.run_forever(duration_s=20.0)
+    t.join()
+    assert status["offset_committed"] == 11
+    assert not any(l["alive"] for l in status["loops"].values())
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.default_int_handler)
+
+
+# -- the soak -----------------------------------------------------------------
+
+def test_soak_smoke(tmp_path):
+    """Tier-1 smoke of the fault-injected soak: short deterministic
+    schedule — 3 kill/restart cycles mid-checkpoint, 3 transient 503
+    storms (bounded fail_times; small fail_after lands on two-phase
+    uploads too) — asserting zero lost/duplicated CDC events, strictly
+    increasing committed offsets, restart convergence, fsck-clean and
+    measured end-to-end freshness."""
+    report = run_soak(str(tmp_path), duration_s=5.0, seed=7)
+    assert report["kill_restart_cycles"] == 3
+    assert report["storms"] == 3
+    assert report["daemon_incarnations"] == 4
+    assert report["fsck_ok"]
+    assert report["checkpoints"] >= 5
+    assert report["freshness_samples"] > 0
+    assert report["freshness_p95_ms"] < 60_000
+    print("SOAK_SMOKE", json.dumps(report))
+
+
+@pytest.mark.slow
+def test_soak_full(tmp_path):
+    """The full soak (>= 60 s wall clock): mesh compaction on (the
+    retry/fallback ladder is live), 4 kill/restart cycles, 5 storms."""
+    report = run_soak(str(tmp_path), duration_s=60.0, seed=11,
+                      kills=4, storms=5, mesh=True)
+    assert report["kill_restart_cycles"] == 4
+    assert report["daemon_incarnations"] == 5
+    assert report["fsck_ok"]
+    assert report["compactions"] >= 1
+    assert report["freshness_samples"] > 0
+    print("SOAK_FULL", json.dumps(report))
