@@ -427,6 +427,88 @@ def scan_child_main():
     print(json.dumps(out))
 
 
+def serve_child_main():
+    """BENCH_SERVE_CHILD=1 mode: the query-serving benchmark (ISSUE
+    7's hot path — 64 concurrent keep-alive clients mixing point gets
+    and LIMIT'd scans against one KvQueryServer, with admission
+    control and the shared cache tier on).  Prints one JSON line for
+    the parent."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks.serve_bench import measure_serving
+
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", "200000"))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "64"))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "4"))
+    out = measure_serving(rows=rows, clients=clients, seconds=seconds,
+                          emit=None)
+    from paimon_tpu.metrics import global_registry
+    snap = global_registry().snapshot()
+    out["metrics_snapshot"] = {
+        k: v for k, v in snap.items()
+        if k.startswith(("service", "lookup"))}
+    print(json.dumps(out))
+
+
+def run_serve_child(timeout):
+    """Run serve_child_main in a CPU subprocess; parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(BENCH_SERVE_CHILD="1", JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=_REPO, text=True,
+                              capture_output=True,
+                              timeout=max(30.0, timeout))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench serve child: timeout\n")
+        return None
+    if proc.returncode != 0:
+        sys.stderr.write(f"bench serve child rc={proc.returncode}:\n"
+                         f"{proc.stderr[-4000:]}\n")
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sys.stderr.write(f"bench serve child: unparseable output\n"
+                         f"{proc.stdout[-2000:]}\n")
+        return None
+
+
+def compose_serve(result):
+    """The serving-plane metric block attached under "serving" in the
+    one official JSON line: sustained mixed-workload QPS with a nested
+    serving_point_lookup_p95_ms block (trajectory metrics for the
+    query-serving path, alongside compaction/scan/write)."""
+    if result is None:
+        return None
+    return {
+        "metric": "serving_qps",
+        "value": result["qps"],
+        "unit": (f"requests/s ({result['clients']} concurrent "
+                 f"keep-alive clients, {result['rows']} rows, "
+                 f"~90/10 point-get/scan mix, "
+                 f"{result['busy_429']} x 429, "
+                 f"lookup {result['lookup_qps']}/s + "
+                 f"scan {result['scan_qps']}/s)"),
+        "point_lookup_p95_ms": {
+            "metric": "serving_point_lookup_p95_ms",
+            "value": result["point_p95_ms"],
+            "unit": (f"ms client-observed at saturation (p50 "
+                     f"{result['point_p50_ms']}ms, p99 "
+                     f"{result['point_p99_ms']}ms; obs-plane p95 "
+                     f"{result['obs_lookup_p95_ms']}ms); warm "
+                     f"/lookup x{result.get('batch', 8)} keys p50 "
+                     f"{result['warm_point_ms_p50']}ms vs cold "
+                     f"{result['cold_point_ms']}ms = "
+                     f"{result['warm_vs_cold']}x, warm single-get "
+                     f"{result.get('warm_single_ms_p50')}ms; engine "
+                     f"{result['engine_point_us']}us/key batched"),
+            "warm_vs_cold": result["warm_vs_cold"],
+        },
+        "metrics_snapshot": result.get("metrics_snapshot"),
+    }
+
+
 def write_child_main():
     """BENCH_WRITE_CHILD=1 mode: the write/ingest benchmark (pipelined
     flush pool vs serial single-thread baseline — ISSUE 4's hot path).
@@ -776,6 +858,20 @@ def main():
                     sample_rows=sample)
     _BANKED["json"] = final
 
+    # serving-plane metric (ISSUE 7's hot path), banked FIRST among
+    # the secondary blocks: the child is the cheapest (~40s measured
+    # in-env: build 200k rows + 4s sustained load) and the newest
+    # trajectory — it must land even when the compaction headline ate
+    # most of the budget
+    if _remaining() > 120:
+        sv = compose_serve(run_serve_child(timeout=_remaining() - 45))
+        if sv is not None:
+            final["serving"] = sv
+            _BANKED["json"] = final
+        sys.stderr.write(f"bench: serving metric "
+                         f"{None if sv is None else sv['value']}, "
+                         f"remaining {_remaining():.0f}s\n")
+
     # scan-path metric (the OTHER BASELINE hot path): fitted to the
     # remaining budget, banked incrementally so a hung child costs
     # nothing — the compaction headline is already banked above
@@ -827,6 +923,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if os.environ.get("BENCH_SCAN_CHILD") == "1":
         scan_child_main()
+        sys.exit(0)
+    if os.environ.get("BENCH_SERVE_CHILD") == "1":
+        serve_child_main()
         sys.exit(0)
     if os.environ.get("BENCH_WRITE_CHILD") == "1":
         write_child_main()

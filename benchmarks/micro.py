@@ -276,6 +276,15 @@ def bench_obs():
                               "trials": trials}), flush=True)
 
 
+def bench_serve():
+    """Serving-plane trajectory in every micro run (full 64-client
+    matrix in benchmarks/serve_bench.py; this entry keeps cold/warm
+    point-get latency, the engine probe rate and a smaller mixed-load
+    QPS in the micro record)."""
+    from benchmarks.serve_bench import measure_serving
+    measure_serving(rows=min(ROWS, 200_000), clients=16, seconds=2.0)
+
+
 BENCHES = {
     "read_parquet": lambda: bench_read("parquet"),
     "read_orc": lambda: bench_read("orc"),
@@ -286,6 +295,7 @@ BENCHES = {
     "merge": bench_merge,
     "scan": bench_scan,
     "obs": bench_obs,
+    "serve": bench_serve,
 }
 
 

@@ -1,0 +1,281 @@
+"""Query-serving benchmark: concurrent clients mixing bounded scans
+and point gets against one KvQueryServer (the PR-7 serving plane).
+
+Measures, against a primary-key table with several overlapping L0
+runs per bucket:
+
+* COLD point get — first /lookup on a fresh server: keep-alive
+  connect + snapshot plan + per-file SST builds;
+* WARM point gets — the steady state: persistent connection, pinned
+  block cache, per-file SST reuse (the acceptance bar is warm >= 10x
+  cold);
+* a sustained mixed workload: `SERVE_CLIENTS` threads (default 64),
+  ~90% single-key point gets / 10% LIMIT'd scans, reporting QPS plus
+  p50/p95/p99 point-get latency BOTH client-side (every request
+  timed) and from the obs plane (`service` metric-group histograms —
+  the same series Prometheus scrapes).
+
+Usage:
+    python -m benchmarks.serve_bench          # all entries
+Prints ONE JSON line per benchmark (micro.py shape).
+
+Env: SERVE_ROWS (default 200_000), SERVE_CLIENTS (64), SERVE_SECONDS
+(4.0), SERVE_BUCKETS (4), SERVE_COMMITS (4).  CPU-only like micro.py —
+bench.py owns the TPU.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+ROWS = int(os.environ.get("SERVE_ROWS", "200000"))
+CLIENTS = int(os.environ.get("SERVE_CLIENTS", "64"))
+SECONDS = float(os.environ.get("SERVE_SECONDS", "4.0"))
+BUCKETS = int(os.environ.get("SERVE_BUCKETS", "4"))
+COMMITS = int(os.environ.get("SERVE_COMMITS", "4"))
+
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def build_serving_table(path: str, rows: int, buckets: int = BUCKETS,
+                        commits: int = COMMITS):
+    """Write-only pk table with overlapping L0 runs (every commit
+    rewrites a slice), so point gets exercise the newest-run-first
+    walk and scans exercise merge-on-read."""
+    from paimon_tpu.schema import Schema
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.types import BigIntType, DoubleType, VarCharType
+
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .column("name", VarCharType.string_type())
+              .primary_key("id")
+              .options({"bucket": str(buckets), "write-only": "true",
+                        "parquet.enable.dictionary": "false"})
+              .build())
+    table = FileStoreTable.create(path, schema)
+    rng = np.random.default_rng(11)
+    per = rows // commits
+    for c in range(commits):
+        ids = rng.integers(0, rows, per)
+        data = pa.table({
+            "id": pa.array(ids, pa.int64()),
+            "v": pa.array(rng.random(per), pa.float64()),
+            "name": pa.array(np.char.add(f"c{c}-",
+                                         (ids % 997).astype(str))),
+        })
+        wb = table.new_batch_write_builder()
+        with wb.new_write() as w:
+            w.write_arrow(data)
+            wb.new_commit().commit(w.prepare_commit())
+    return table
+
+
+def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
+                    seconds: float = SECONDS, emit=_emit) -> dict:
+    """Run the whole serving benchmark in-process; returns the result
+    dict (also emitted as JSON lines).  Reused by bench.py's serve
+    child for the official BENCH_* record."""
+    from paimon_tpu.metrics import SERVICE_LOOKUP_MS, global_registry
+    from paimon_tpu.service import KvQueryClient, KvQueryServer
+    from paimon_tpu.table import FileStoreTable
+
+    out = {"rows": rows, "clients": clients}
+    with tempfile.TemporaryDirectory() as tmp:
+        table = build_serving_table(os.path.join(tmp, "t"), rows)
+        table = FileStoreTable.load(table.path, dynamic_options={
+            "service.lookup.refresh-interval": "1000"})
+        server = KvQueryServer(table).start()
+        try:
+            rng = np.random.default_rng(3)
+
+            # cold vs warm /lookup, SAME request shape (a small batch
+            # of point gets, like a lookup join probes).  Cold is the
+            # first request on a fresh server: keep-alive connect +
+            # snapshot plan + the per-file SST builds its keys touch;
+            # warm is the steady state the shared caches + pinned
+            # blocks buy (the acceptance bar: warm >= 10x cold).
+            batch = 8
+            cold_client = KvQueryClient(table)
+            cold_keys = [{"id": int(k)}
+                         for k in rng.integers(0, rows, batch)]
+            t0 = time.perf_counter()
+            cold_client.lookup(cold_keys)
+            cold_ms = (time.perf_counter() - t0) * 1000.0
+            out["cold_point_ms"] = round(cold_ms, 3)
+
+            # warm the SST/bucket state fully before the steady state
+            warm_keys = [{"id": int(k)}
+                         for k in rng.integers(0, rows, 2048)]
+            cold_client.lookup(warm_keys)
+
+            # steady-state warm batched gets on one client
+            samples = []
+            single = []
+            for _ in range(300):
+                ks = [{"id": int(k)}
+                      for k in rng.integers(0, rows, batch)]
+                t1 = time.perf_counter()
+                cold_client.lookup(ks)
+                samples.append((time.perf_counter() - t1) * 1000.0)
+            for _ in range(100):
+                k = {"id": int(rng.integers(0, rows))}
+                t1 = time.perf_counter()
+                cold_client.lookup_row(k)
+                single.append((time.perf_counter() - t1) * 1000.0)
+            samples.sort()
+            single.sort()
+            warm_ms = samples[len(samples) // 2]
+            out["warm_point_ms_p50"] = round(warm_ms, 4)
+            out["warm_single_ms_p50"] = \
+                round(single[len(single) // 2], 4)
+            out["batch"] = batch
+            out["warm_vs_cold"] = round(cold_ms / max(warm_ms, 1e-6), 1)
+            cold_client.close()
+
+            # engine-level warm probes (no HTTP): the sub-ms LSM
+            # point-lookup path itself — batched gets against the
+            # pinned block cache + per-file SSTs
+            q = server.query()
+            probe_keys = [{"id": int(k)}
+                          for k in rng.integers(0, rows, 1024)]
+            q.lookup(probe_keys)            # warm every touched block
+            reps, t3 = 0, time.perf_counter()
+            while time.perf_counter() - t3 < 0.5:
+                q.lookup(probe_keys)
+                reps += 1
+            per_key_us = (time.perf_counter() - t3) \
+                / (reps * len(probe_keys)) * 1e6
+            out["engine_point_us"] = round(per_key_us, 3)
+            out["engine_keys_per_s"] = round(1e6 / per_key_us, 1)
+
+            # sustained mixed load: `clients` threads, ~90% point
+            # gets / 10% scans, every request timed client-side
+            stop = threading.Event()
+            counts = {"lookup": 0, "scan": 0, "busy": 0}
+            lat_lookup = []
+            lock = threading.Lock()
+            errors = []
+
+            def worker(seed):
+                from paimon_tpu.service import ServiceBusyError
+                r = np.random.default_rng(seed)
+                my_lat = []
+                my_lookups = my_scans = my_busy = 0
+                try:
+                    with KvQueryClient(
+                            table, tenant=f"t{seed % 8}") as c:
+                        while not stop.is_set():
+                            try:
+                                if r.random() < 0.9:
+                                    k = {"id": int(r.integers(0, rows))}
+                                    t1 = time.perf_counter()
+                                    c.lookup_row(k)
+                                    my_lat.append(
+                                        (time.perf_counter() - t1)
+                                        * 1000.0)
+                                    my_lookups += 1
+                                else:
+                                    c.scan(limit=100)
+                                    my_scans += 1
+                            except ServiceBusyError:
+                                my_busy += 1
+                                time.sleep(0.002)
+                except Exception as e:      # noqa: BLE001
+                    errors.append(repr(e))
+                with lock:
+                    counts["lookup"] += my_lookups
+                    counts["scan"] += my_scans
+                    counts["busy"] += my_busy
+                    lat_lookup.extend(my_lat)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(clients)]
+            t2 = time.perf_counter()
+            [t.start() for t in threads]
+            time.sleep(seconds)
+            stop.set()
+            [t.join() for t in threads]
+            elapsed = time.perf_counter() - t2
+            if errors:
+                raise AssertionError(
+                    f"serving workers failed: {errors[:3]}")
+
+            total = counts["lookup"] + counts["scan"]
+            lat_lookup.sort()
+
+            def pct(p):
+                if not lat_lookup:
+                    return 0.0
+                return lat_lookup[min(len(lat_lookup) - 1,
+                                      int(p / 100 * len(lat_lookup)))]
+
+            out.update({
+                "elapsed_s": round(elapsed, 3),
+                "qps": round(total / elapsed, 1),
+                "lookup_qps": round(counts["lookup"] / elapsed, 1),
+                "scan_qps": round(counts["scan"] / elapsed, 1),
+                "busy_429": counts["busy"],
+                "point_p50_ms": round(pct(50), 4),
+                "point_p95_ms": round(pct(95), 4),
+                "point_p99_ms": round(pct(99), 4),
+            })
+            # the obs-plane view of the same workload (server-side
+            # request histograms — what Prometheus scrapes)
+            h = global_registry().service_metrics(table.name) \
+                .histogram(SERVICE_LOOKUP_MS)
+            out["obs_lookup_p95_ms"] = round(h.percentile(95), 4)
+            out["obs_lookup_p99_ms"] = round(h.percentile(99), 4)
+            out["obs_lookup_count"] = h.total_count
+        finally:
+            server.stop()
+
+    if emit is not None:
+        emit({"benchmark": "serving_cold_point_lookup",
+              "value": out["cold_point_ms"], "unit": "ms",
+              "rows": rows})
+        emit({"benchmark": "serving_warm_point_lookup_p50",
+              "value": out["warm_point_ms_p50"], "unit": "ms",
+              "rows": rows, "batch": out["batch"],
+              "single_ms": out["warm_single_ms_p50"],
+              "warm_vs_cold": out["warm_vs_cold"]})
+        emit({"benchmark": "serving_engine_point_lookup",
+              "value": out["engine_point_us"], "unit": "us/key",
+              "keys_per_s": out["engine_keys_per_s"], "rows": rows})
+        emit({"benchmark": "serving_qps",
+              "value": out["qps"], "unit": "requests/s",
+              "rows": rows, "clients": clients,
+              "lookup_qps": out["lookup_qps"],
+              "scan_qps": out["scan_qps"],
+              "busy_429": out["busy_429"]})
+        emit({"benchmark": "serving_point_lookup_p95_ms",
+              "value": out["point_p95_ms"], "unit": "ms",
+              "p50": out["point_p50_ms"], "p99": out["point_p99_ms"],
+              "obs_p95": out["obs_lookup_p95_ms"],
+              "obs_p99": out["obs_lookup_p99_ms"],
+              "clients": clients})
+    return out
+
+
+def main(argv):
+    measure_serving()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

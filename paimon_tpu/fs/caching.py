@@ -27,9 +27,10 @@ from typing import List, Optional, Tuple
 
 from paimon_tpu.fs.fileio import FileIO
 
-__all__ = ["CachingFileIO", "FooterCache", "global_footer_cache",
-           "footer_cache_disabled", "footer_cache_scope",
-           "scoped_batches"]
+__all__ = ["CachingFileIO", "FooterCache", "ByteCacheState",
+           "global_footer_cache", "shared_cache_state",
+           "evict_dropped_file", "footer_cache_disabled",
+           "footer_cache_scope", "scoped_batches"]
 
 # snapshot-N files are deliberately NOT cached: rollback_to /
 # fast_forward delete and later RECREATE the same snapshot ids with
@@ -180,89 +181,201 @@ def footer_cache_scope(options=None):
     return nullcontext()
 
 
+class ByteCacheState:
+    """The mutable LRU state behind CachingFileIO — whole-file cache,
+    block-range cache, sizes, hit/miss counts and the lock — separable
+    from the wrapper so MANY FileIO wrappers (every FileStoreTable
+    instance that `table.copy()` or the query service creates) can
+    share ONE process-wide, size-bounded tier.  A wrapper built without
+    an explicit state keeps a private one (the legacy per-instance
+    scope)."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 range_cache_bytes: int = 0):
+        self.capacity = capacity_bytes
+        self.range_capacity = range_cache_bytes
+        self.lock = threading.Lock()
+        self.cache: "OrderedDict[str, bytes]" = OrderedDict()
+        self.size = 0
+        self.ranges: "OrderedDict[Tuple[str, int, int], bytes]" = \
+            OrderedDict()
+        self.range_size = 0
+        self.hits = 0
+        self.misses = 0
+        self.range_hits = 0
+        self.range_misses = 0
+
+    def grow_to(self, capacity_bytes: int, range_cache_bytes: int):
+        """Capacities of a shared state only ever GROW to the largest
+        request: one table configuring a bigger cache must not shrink
+        (and thereby flush) the tier under every other table."""
+        with self.lock:
+            self.capacity = max(self.capacity, capacity_bytes)
+            self.range_capacity = max(self.range_capacity,
+                                      range_cache_bytes)
+
+    def evict_path(self, path: str):
+        """Drop every entry (whole-file + all ranges) for `path` —
+        mutation invalidation and the serving plane's snapshot-advance
+        eviction of files dropped by compaction both land here."""
+        with self.lock:
+            data = self.cache.pop(path, None)
+            if data is not None:
+                self.size -= len(data)
+            for key in [k for k in self.ranges if k[0] == path]:
+                self.range_size -= len(self.ranges.pop(key))
+
+    def clear(self):
+        with self.lock:
+            self.cache.clear()
+            self.ranges.clear()
+            self.size = self.range_size = 0
+
+
+_SHARED_STATE: Optional[ByteCacheState] = None
+_SHARED_STATE_LOCK = threading.Lock()
+
+
+def shared_cache_state(capacity_bytes: int = 0,
+                       range_cache_bytes: int = 0) -> ByteCacheState:
+    """THE process-wide byte-cache tier (the cross-request promotion of
+    the per-read CachingFileIO scope): every caller gets the same
+    ByteCacheState, sized to the largest capacities ever requested, so
+    all concurrent /scan, /lookup and /changelog requests — and every
+    `table.copy()` — warm one shared, size-bounded cache."""
+    global _SHARED_STATE
+    with _SHARED_STATE_LOCK:
+        if _SHARED_STATE is None:
+            _SHARED_STATE = ByteCacheState(capacity_bytes,
+                                           range_cache_bytes)
+        else:
+            _SHARED_STATE.grow_to(capacity_bytes, range_cache_bytes)
+        return _SHARED_STATE
+
+
+def evict_dropped_file(path: str):
+    """Snapshot-advance invalidation: a data file dropped by compaction
+    or expiry can never be planned again, so its footer and any shared
+    byte-cache entries are dead weight — evict them eagerly instead of
+    waiting for LRU pressure.  (Correctness never depends on this:
+    only immutable-named files are cached.)"""
+    if _SHARED_STATE is not None:
+        _SHARED_STATE.evict_path(path)
+    _FOOTERS.evict(path)
+
+
 class CachingFileIO(FileIO):
     """LRU whole-file byte cache, plus an optional block-range cache
     keyed by (path, offset, length) for formats that read footers/blobs
     by range (mosaic) instead of whole files.  The range cache only
     serves immutable files NOT already in the whole-file cache (a
-    whole-file hit slices for free)."""
+    whole-file hit slices for free).
+
+    Pass `state=shared_cache_state(...)` to join the process-wide tier
+    (cross-request/cross-instance sharing); without it the wrapper
+    keeps a private state, the legacy scope."""
 
     def __init__(self, inner: FileIO, capacity_bytes: int = 256 << 20,
-                 range_cache_bytes: int = 0):
+                 range_cache_bytes: int = 0,
+                 state: Optional[ByteCacheState] = None):
         self.inner = inner
-        self.capacity = capacity_bytes
-        self._cache: "OrderedDict[str, bytes]" = OrderedDict()
-        self._size = 0
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        # block-range cache (read.cache.range)
-        self.range_capacity = range_cache_bytes
-        self._ranges: "OrderedDict[Tuple[str, int, int], bytes]" = \
-            OrderedDict()
-        self._range_size = 0
-        self.range_hits = 0
-        self.range_misses = 0
+        if state is not None:
+            state.grow_to(capacity_bytes, range_cache_bytes)
+            self.state = state
+        else:
+            self.state = ByteCacheState(capacity_bytes,
+                                        range_cache_bytes)
+
+    # counters/capacities read by tests and benchmarks; shared-state
+    # wrappers deliberately report the TIER's numbers
+    @property
+    def capacity(self) -> int:
+        return self.state.capacity
+
+    @property
+    def range_capacity(self) -> int:
+        return self.state.range_capacity
+
+    @property
+    def hits(self) -> int:
+        return self.state.hits
+
+    @property
+    def misses(self) -> int:
+        return self.state.misses
+
+    @property
+    def range_hits(self) -> int:
+        return self.state.range_hits
+
+    @property
+    def range_misses(self) -> int:
+        return self.state.range_misses
 
     # -- cached reads --------------------------------------------------------
 
     def read_bytes(self, path: str) -> bytes:
         if not _cacheable(path):
             return self.inner.read_bytes(path)
-        with self._lock:
-            data = self._cache.get(path)
+        st = self.state
+        with st.lock:
+            data = st.cache.get(path)
             if data is not None:
-                self._cache.move_to_end(path)
-                self.hits += 1
+                st.cache.move_to_end(path)
+                st.hits += 1
         if data is not None:
             _counters()["file_hits"].inc()
             return data
         data = self.inner.read_bytes(path)
-        self.misses += 1
+        with st.lock:
+            st.misses += 1
         _counters()["file_misses"].inc()
-        if len(data) <= self.capacity:
-            with self._lock:
-                if path not in self._cache:
-                    self._cache[path] = data
-                    self._size += len(data)
-                    while self._size > self.capacity and self._cache:
-                        _, old = self._cache.popitem(last=False)
-                        self._size -= len(old)
+        if len(data) <= st.capacity:
+            with st.lock:
+                if path not in st.cache:
+                    st.cache[path] = data
+                    st.size += len(data)
+                    while st.size > st.capacity and st.cache:
+                        _, old = st.cache.popitem(last=False)
+                        st.size -= len(old)
         return data
 
     def _range_get(self, path: str, offset: int,
                    length: int) -> Optional[bytes]:
         key = (path, offset, length)
-        with self._lock:
-            data = self._ranges.get(key)
+        st = self.state
+        with st.lock:
+            data = st.ranges.get(key)
             if data is not None:
-                self._ranges.move_to_end(key)
-                self.range_hits += 1
+                st.ranges.move_to_end(key)
+                st.range_hits += 1
         return data
 
     def _range_put(self, path: str, offset: int, length: int,
                    data: bytes):
-        if len(data) > self.range_capacity:
+        st = self.state
+        if len(data) > st.range_capacity:
             return
         key = (path, offset, length)
-        with self._lock:
-            if key not in self._ranges:
-                self._ranges[key] = data
-                self._range_size += len(data)
-                while self._range_size > self.range_capacity and \
-                        self._ranges:
-                    _, old = self._ranges.popitem(last=False)
-                    self._range_size -= len(old)
+        with st.lock:
+            if key not in st.ranges:
+                st.ranges[key] = data
+                st.range_size += len(data)
+                while st.range_size > st.range_capacity and \
+                        st.ranges:
+                    _, old = st.ranges.popitem(last=False)
+                    st.range_size -= len(old)
 
     def read_range(self, path: str, offset: int, length: int) -> bytes:
+        st = self.state
         if _cacheable(path):
-            with self._lock:
-                data = self._cache.get(path)
+            with st.lock:
+                data = st.cache.get(path)
                 if data is not None:
-                    self._cache.move_to_end(path)
-                    self.hits += 1
+                    st.cache.move_to_end(path)
+                    st.hits += 1
                     return data[offset:offset + length]
-            if self.range_capacity > 0:
+            if st.range_capacity > 0:
                 data = self._range_get(path, offset, length)
                 if data is not None:
                     c = _counters()
@@ -270,10 +383,12 @@ class CachingFileIO(FileIO):
                     c["range_hit_bytes"].inc(len(data))
                     return data
         # not cached: delegate the range — never force a full-object GET
-        self.misses += 1
+        with st.lock:
+            st.misses += 1
         data = self.inner.read_range(path, offset, length)
-        if self.range_capacity > 0 and _cacheable(path):
-            self.range_misses += 1
+        if st.range_capacity > 0 and _cacheable(path):
+            with st.lock:
+                st.range_misses += 1
             _counters()["range_misses"].inc()
             self._range_put(path, offset, length, data)
         return data
@@ -284,23 +399,24 @@ class CachingFileIO(FileIO):
         locally, the remaining ones go to the inner FileIO in ONE
         vectored call (object stores coalesce them).  Counts into the
         same hit/miss/byte counters as the scalar path."""
+        st = self.state
         if not _cacheable(path) or \
-                (self.range_capacity <= 0 and path not in self._cache):
+                (st.range_capacity <= 0 and path not in st.cache):
             return self.inner.read_ranges(path, ranges)
         out: List[Optional[bytes]] = [None] * len(ranges)
         missing: List[int] = []
         c = _counters()
-        with self._lock:
-            whole = self._cache.get(path)
+        with st.lock:
+            whole = st.cache.get(path)
             if whole is not None:
-                self._cache.move_to_end(path)
-                self.hits += 1          # ONE hit per vectored call,
+                st.cache.move_to_end(path)
+                st.hits += 1            # ONE hit per vectored call,
         if whole is not None:           # like read_bytes would count
             c["file_hits"].inc()
             return [whole[o:o + ln] for o, ln in ranges]
         for i, (offset, length) in enumerate(ranges):
             got = self._range_get(path, offset, length) \
-                if self.range_capacity > 0 else None
+                if st.range_capacity > 0 else None
             if got is not None:
                 c["range_hits"].inc()
                 c["range_hit_bytes"].inc(len(got))
@@ -312,8 +428,9 @@ class CachingFileIO(FileIO):
                 path, [ranges[i] for i in missing])
             for i, data in zip(missing, fetched):
                 out[i] = data
-                if self.range_capacity > 0:
-                    self.range_misses += 1
+                if st.range_capacity > 0:
+                    with st.lock:
+                        st.range_misses += 1
                     c["range_misses"].inc()
                     self._range_put(path, ranges[i][0], ranges[i][1],
                                     data)
@@ -322,12 +439,7 @@ class CachingFileIO(FileIO):
     # -- invalidating mutations ---------------------------------------------
 
     def _evict(self, path: str):
-        with self._lock:
-            data = self._cache.pop(path, None)
-            if data is not None:
-                self._size -= len(data)
-            for key in [k for k in self._ranges if k[0] == path]:
-                self._range_size -= len(self._ranges.pop(key))
+        self.state.evict_path(path)
         _FOOTERS.evict(path)
 
     def write_bytes(self, path, data, overwrite=True):

@@ -7,132 +7,501 @@ local sorted SSTs with bloom filters (lookup/sort/
 SortLookupStoreFactory.java:39) and evicts them by disk size
 (LookupLevels.java:308).
 
-TPU-first shape: a bucket's merged state is materialized once, sorted
-by normalized-key lanes, and SPILLED to a local SST file
-(lookup/sst.py) — RAM holds only a byte-bounded block cache, disk a
-byte-bounded file set.  A lookup batch is one vectorized block-index
-searchsorted plus one in-block searchsorted per touched block;
-thousands of probes per call, no per-key block reads.
+Serving-plane shape (the PR-7 hot path):
+
+* the table is planned ONCE per snapshot and the splits indexed by
+  (partition, bucket); a snapshot-refresh TTL (`refresh_interval_ms`)
+  gates how often the snapshot hint is even read, so steady-state point
+  gets touch no table metadata at all;
+* deduplicate tables (no sequence field / DVs / record-level expire)
+  take the LSM fast path: each data file spills lazily into its OWN
+  immutable local SST (lookup/sst.py), and a point get walks the
+  bucket's sorted runs NEWEST-FIRST — manifest key-range stats and the
+  per-SST bloom prune files BEFORE any IO, a hit or tombstone in a
+  newer run never touches older runs, and a commit only costs SST
+  builds for the NEW files (everything else stays warm);
+* other configurations keep the merged-bucket materialization, now
+  keyed by the bucket's file list so buckets untouched by a commit
+  survive snapshot advances instead of being rebuilt;
+* on snapshot advance, readers for files dropped by compaction are
+  evicted (local SST deleted, pinned blocks dropped, shared byte-cache
+  entries invalidated via fs/caching.evict_dropped_file);
+* a batch lookup CAPTURES one plan (splits are replaced, never
+  mutated, on refresh) and resolves all keys against it: concurrent
+  serving threads never observe a torn batch spanning two snapshots,
+  yet reads/builds/probes run concurrently — only the plan check and
+  swap serialize, so a cold bucket build never stalls other serving
+  threads (same-key builds dedupe on an in-flight event).
+
+RAM holds only the byte-bounded pinned block cache, disk a
+byte-bounded SST set; a lookup batch is one vectorized block-index
+searchsorted plus one in-block searchsorted per touched block.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import tempfile
-from typing import List, Optional, Sequence, Tuple
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
 
 from paimon_tpu.core.bucket import FixedBucketAssigner
+from paimon_tpu.core.read import MergeFileSplitRead, assemble_runs
+from paimon_tpu.data.binary_row import BinaryRowCodec
 from paimon_tpu.lookup.sst import (
     BlockCache, LookupStore, SstReader, pack_lanes,
 )
+from paimon_tpu.ops.merge import KIND_COL, SEQ_COL
 from paimon_tpu.ops.normkey import NormalizedKeyEncoder
-from paimon_tpu.options import CoreOptions
-from paimon_tpu.types import data_type_to_arrow
+from paimon_tpu.options import CoreOptions, MergeEngine
+from paimon_tpu.types import RowKind, data_type_to_arrow
 
 __all__ = ["LocalTableQuery"]
+
+_UNLOADED = object()          # sentinel: no plan loaded yet
 
 
 class LocalTableQuery:
     def __init__(self, table, cache_dir: Optional[str] = None,
-                 max_memory_bytes: int = 256 << 20):
+                 max_memory_bytes: Optional[int] = None,
+                 refresh_interval_ms: int = 0, clock=None):
         if not table.primary_keys:
             raise ValueError("LocalTableQuery requires a primary-key table")
         self.table = table
+        self.options = table.options
         self.pk = table.schema.trimmed_primary_keys()
         rt = table.schema.logical_row_type()
         self.encoder = NormalizedKeyEncoder(
             [data_type_to_arrow(rt.get_field(k).type) for k in self.pk],
             nullable=[rt.get_field(k).type.nullable for k in self.pk])
+        self.key_types = [rt.get_field(k).type for k in self.pk]
+        self._key_codec = BinaryRowCodec(
+            [t.copy(False) for t in self.key_types])
         bucket_keys = table.schema.bucket_keys()
         self.assigner = FixedBucketAssigner(
             bucket_keys, [rt.get_field(k).type for k in bucket_keys],
             max(1, table.options.bucket))
+        if max_memory_bytes is None:
+            max_memory_bytes = table.options.get(
+                CoreOptions.LOOKUP_CACHE_MAX_MEMORY_SIZE)
         self.block_cache = BlockCache(max_memory_bytes)
         self.store = LookupStore(
             cache_dir or tempfile.mkdtemp(prefix="paimon-lookup-"),
             max_disk_bytes=table.options.get(
                 CoreOptions.LOOKUP_CACHE_MAX_DISK_SIZE),
             block_cache=self.block_cache)
-        self._snapshot_id: Optional[int] = None
-        self._empty: set = set()          # negative cache: empty buckets
+        # snapshot-refresh TTL: within it, lookups never touch the
+        # snapshot hint or manifest chain (service.lookup.refresh-
+        # interval on the serving plane; 0 = check every call)
+        self.refresh_interval_ms = max(0, int(refresh_interval_ms))
+        self._clock = clock or (lambda: time.monotonic() * 1000.0)
+        # _lock guards the PLAN (snapshot check/reload) and the
+        # splits/file-ranges swap — never the data-file reads, SST
+        # builds or probes, which run concurrently (LookupStore and
+        # BlockCache are internally locked; _building dedupes
+        # same-key builds): a cold bucket build must not stall every
+        # other serving thread
+        self._lock = threading.RLock()
+        self._build_lock = threading.Lock()
+        self._building: Dict[str, threading.Event] = {}
+        self._snapshot_id = _UNLOADED
+        self._last_check_ms: Optional[float] = None
+        # (partition_key, bucket) -> DataSplit of the current plan
+        self._splits: Dict[Tuple[str, int], object] = {}
+        # file_name -> decoded (min_key_tuple, max_key_tuple) or None
+        self._file_ranges: Dict[str, Optional[Tuple]] = {}
+        # shared split reader: schema evolution, blob resolution and
+        # the merged fallback all ride the normal read path
+        self._read = MergeFileSplitRead(
+            table.file_io, table.path, table.schema, table.options,
+            table.schema_manager)
+        from paimon_tpu.metrics import (
+            LOOKUP_FILES_PRUNED, LOOKUP_READER_BUILDS,
+            LOOKUP_READER_REUSES, LOOKUP_SNAPSHOT_REFRESHES,
+            global_registry,
+        )
+        g = global_registry().lookup_metrics()
+        self._m_refreshes = g.counter(LOOKUP_SNAPSHOT_REFRESHES)
+        self._m_builds = g.counter(LOOKUP_READER_BUILDS)
+        self._m_reuses = g.counter(LOOKUP_READER_REUSES)
+        self._m_pruned = g.counter(LOOKUP_FILES_PRUNED)
+
+    # -- lifecycle -----------------------------------------------------------
 
     def refresh(self):
-        """Drop spilled state (call after new commits)."""
-        self.store.drop_all()
-        self._empty.clear()
-        self._snapshot_id = None
+        """Force the next lookup to re-check the latest snapshot (the
+        TTL is bypassed once).  Spilled per-file SSTs are keyed by
+        immutable file names, so state for files still referenced
+        survives — only vanished files are evicted."""
+        with self._lock:
+            self._last_check_ms = None
+
+    def close(self):
+        """Drop all spilled SSTs and cached blocks (the query service
+        calls this on stop so stopped servers leak no disk).  The
+        store is marked closed FIRST: an in-flight batch racing close
+        gets an error from its rebuild instead of republishing SST
+        files into the just-cleaned directory."""
+        with self._lock:
+            self.store.drop_all(close=True)
+            self._splits = {}
+            self._file_ranges = {}
+            self._snapshot_id = _UNLOADED
+            self._last_check_ms = None
+
+    def __enter__(self) -> "LocalTableQuery":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def snapshot_id(self) -> Optional[int]:
+        """Snapshot the current plan serves (None before any load /
+        on an empty table)."""
+        sid = self._snapshot_id
+        return None if sid is _UNLOADED else sid
+
+    # -- snapshot tracking ---------------------------------------------------
 
     def _check_snapshot(self):
-        latest = self.table.snapshot_manager.latest_snapshot_id()
-        if latest != self._snapshot_id:
-            self.store.drop_all()
-            self._empty.clear()
-            self._snapshot_id = latest
+        """TTL-gated snapshot check; returns the (splits, snapshot_id)
+        pair a batch should resolve against.  Callers capture the
+        RETURNED references: `self._splits` is replaced (never
+        mutated) on refresh, so a captured dict stays internally
+        consistent for the whole batch even while a concurrent
+        refresh swaps in a new plan."""
+        with self._lock:
+            now = self._clock()
+            if self._last_check_ms is None or \
+                    self.refresh_interval_ms <= 0 or \
+                    now - self._last_check_ms >= self.refresh_interval_ms:
+                latest = self.table.snapshot_manager.latest_snapshot_id()
+                if self._snapshot_id is _UNLOADED or \
+                        latest != self._snapshot_id:
+                    self._load_plan()
+                # stamp the TTL only AFTER a successful check: a
+                # transient FS failure must surface as an error on
+                # EVERY lookup until it heals, not poison one caller
+                # and then serve all-miss answers from the
+                # never-loaded plan for the rest of the window
+                self._last_check_ms = now
+            return self._splits, self._snapshot_id
+
+    def _data_path(self, split, meta) -> str:
+        if meta.external_path:
+            return meta.external_path
+        return self._read.path_factory.data_file_path(
+            split.partition, split.bucket, meta.file_name)
+
+    def _load_plan(self):
+        """Re-plan the table and reconcile cached state: keep readers
+        whose backing files are still referenced, evict the rest, and
+        invalidate shared byte-cache entries for data files dropped by
+        compaction/expiry."""
+        plan = self.table.new_read_builder().new_scan().plan()
+        new_splits: Dict[Tuple[str, int], object] = {}
+        for s in plan.splits:
+            new_splits[(self._pkey(s.partition), s.bucket)] = s
+        old_paths = {self._data_path(s, f)
+                     for s in self._splits.values()
+                     for f in s.data_files}
+        # advance the snapshot BEFORE computing the keep-set:
+        # snapshot-keyed bucket readers (DV / record-expire) must be
+        # keyed by the NEW snapshot, or last cycle's state survives
+        # one refresh too long
+        self._snapshot_id = plan.snapshot_id
+        live_keys = set()
+        live_files = set()
+        live_paths = set()
+        for (pkey, b), s in new_splits.items():
+            live_keys.add(self._bucket_store_key(pkey, s,
+                                                 self._snapshot_id))
+            for f in s.data_files:
+                live_keys.add(self._file_store_key(pkey, b, f))
+                live_files.add(f.file_name)
+                live_paths.add(self._data_path(s, f))
+        for key in self.store.keys():
+            if key not in live_keys:
+                self.store.drop(key)
+        from paimon_tpu.fs.caching import evict_dropped_file
+        for path in old_paths - live_paths:
+            evict_dropped_file(path)
+        self._file_ranges = {k: v for k, v in self._file_ranges.items()
+                             if k in live_files}
+        self._splits = new_splits
+        self._m_refreshes.inc()
+
+    # -- keys ----------------------------------------------------------------
+
+    def _norm_partition(self, partition: Tuple) -> Tuple:
+        """Normalize partition values through the partition fields'
+        arrow types, so a caller's python scalars key identically to
+        the plan's decoded values."""
+        pkeys = self.table.partition_keys
+        if not partition or not pkeys:
+            return tuple(partition)
+        rt = self.table.schema.logical_row_type()
+        vals = []
+        for v, k in zip(partition, pkeys):
+            try:
+                t = data_type_to_arrow(rt.get_field(k).type)
+                vals.append(pa.array([v], t)[0].as_py())
+            except (pa.ArrowInvalid, pa.ArrowTypeError, KeyError):
+                vals.append(v)
+        return tuple(vals)
+
+    @staticmethod
+    def _pkey(partition: Tuple) -> str:
+        # unambiguous composite key: joining values with a separator
+        # would collide for e.g. ('a_b','c') vs ('a','b_c')
+        return json.dumps([repr(v) for v in tuple(partition)])
+
+    def _file_store_key(self, pkey: str, bucket: int, meta) -> str:
+        return f"file|{pkey}|{bucket}|{meta.file_name}"
+
+    def _bucket_store_key(self, pkey: str, split, snap) -> str:
+        """Merged-bucket state keyed by the bucket's FILE LIST, so a
+        commit that leaves a bucket untouched leaves its reader warm.
+        DV and record-level-expire configurations additionally key by
+        snapshot (their merged view can change without the file list
+        changing) — `snap` is the snapshot captured WITH the split, so
+        a concurrent refresh cannot pair an old file list with the new
+        snapshot id."""
+        names = ",".join(sorted(f.file_name for f in split.data_files))
+        if split.deletion_vectors or \
+                self.options.record_level_expire_time_ms:
+            names += f"|snap={'unloaded' if snap is _UNLOADED else snap}"
+        digest = hashlib.sha1(names.encode()).hexdigest()[:20]
+        return f"bucket|{pkey}|{split.bucket}|{digest}"
+
+    # -- pruning -------------------------------------------------------------
+
+    def _file_range(self, meta) -> Optional[Tuple]:
+        """Decoded (min_key, max_key) value tuples from manifest stats
+        — the before-any-IO prune; None = undecodable, never prune."""
+        name = meta.file_name
+        if name in self._file_ranges:
+            return self._file_ranges[name]
+        rng = None
+        try:
+            if meta.min_key and meta.max_key:
+                rng = (tuple(self._key_codec.from_bytes(meta.min_key)),
+                       tuple(self._key_codec.from_bytes(meta.max_key)))
+        except Exception:       # noqa: BLE001 — stats are advisory
+            rng = None
+        self._file_ranges[name] = rng
+        return rng
+
+    @staticmethod
+    def _in_range(key_tuple: Tuple, rng: Optional[Tuple]) -> bool:
+        if rng is None:
+            return True
+        try:
+            return rng[0] <= key_tuple <= rng[1]
+        except TypeError:
+            return True          # incomparable types: never prune
+
+    # -- fast-path eligibility ----------------------------------------------
+
+    def _fast_path_ok(self, split) -> bool:
+        """Newest-run-wins short-circuiting is exactly deduplicate
+        semantics; user sequence fields (row order != seq order), DVs
+        (per-file masks) and record-level expire (time-dependent
+        visibility) all need the merged read path."""
+        return (self.options.merge_engine == MergeEngine.DEDUPLICATE
+                and not self.options.sequence_field
+                and not split.deletion_vectors
+                and not self.options.record_level_expire_time_ms)
+
+    # -- readers -------------------------------------------------------------
 
     def _encode_lanes(self, t: pa.Table) -> np.ndarray:
         lanes, _ = self.encoder.encode_table(t, self.pk)
         return lanes
 
-    def _bucket_reader(self, partition: Tuple,
-                       bucket: int) -> Optional[SstReader]:
-        import json
-        # unambiguous composite key: joining values with a separator
-        # would collide for e.g. ('a_b','c') vs ('a','b_c')
-        key = json.dumps([list(map(repr, partition)), bucket,
-                          self._snapshot_id])
-        if key in self._empty:
-            return None
-        reader = self.store.get(key)
-        if reader is not None:
-            return reader
-        rb = self.table.new_read_builder().with_buckets([bucket])
-        if partition and self.table.partition_keys:
-            rb = rb.with_partition_filter(
-                dict(zip(self.table.partition_keys, partition)))
-        plan = rb.new_scan().plan()
-        t = rb.new_read().to_arrow(plan)
-        if t.num_rows == 0:
-            self._empty.add(key)
-            return None
+    def _spill(self, key: str, t: pa.Table) -> SstReader:
         lanes = self._encode_lanes(t)
         order = np.argsort(pack_lanes(lanes), kind="stable")
+        self._m_builds.inc()
         return self.store.put(key, lanes[order],
                               t.take(pa.array(order)))
+
+    def _get_or_build(self, key: str, load) -> Optional[SstReader]:
+        """store.get or build-ONCE: concurrent requests for the same
+        key wait on the in-flight builder instead of duplicating the
+        data-file read; the expensive load/sort/spill runs without
+        any plan lock held."""
+        while True:
+            r = self.store.get(key)
+            if r is not None:
+                self._m_reuses.inc()
+                return r
+            with self._build_lock:
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._building[key] = ev
+                    break                # we are the builder
+            ev.wait()
+            # builder published (or failed — then we become the
+            # builder on the next iteration and surface its error)
+        try:
+            t = load()
+            if t is None:
+                return None  # corrupt + scan.ignore-corrupt-files
+            # spill even when EMPTY (all rows deleted/expired): the
+            # empty SST is the negative cache — without it every
+            # batch touching this bucket re-runs the full read
+            return self._spill(key, t)
+        finally:
+            with self._build_lock:
+                self._building.pop(key, None)
+            ev.set()
+
+    def _probe(self, key: str, load,
+               lanes: np.ndarray) -> Tuple[np.ndarray, pa.Table]:
+        """Build-or-reuse + probe, tolerating a concurrent refresh
+        evicting the SST file between get and probe (the local file
+        vanishes -> OSError): drop the dead entry and rebuild once."""
+        for attempt in (0, 1):
+            reader = self._get_or_build(key, load)
+            if reader is None or reader.num_rows == 0:
+                return np.zeros(0, np.int64), None
+            try:
+                return reader.probe(lanes)
+            except OSError:
+                if attempt:
+                    raise
+                self.store.drop(key)
+
+    def _file_reader_load(self, split, meta):
+        """One data-file read for the lazy per-file SST (immutable
+        thereafter — file names are uuid'd — so it survives snapshot
+        advances until compaction drops the file)."""
+        read_cols = list(dict.fromkeys(
+            [f.name for f in self.table.schema.fields]
+            + [SEQ_COL, KIND_COL]))
+        return self._read._read_file(split, meta, read_cols)
+
+    # -- lookup --------------------------------------------------------------
 
     def lookup(self, keys: Sequence[dict],
                partition: Tuple = ()) -> List[Optional[dict]]:
         """Batch point lookup: one dict of pk values per entry; returns
-        the full row dict or None per key, in input order."""
-        self._check_snapshot()
+        the full row dict or None per key, in input order.  The whole
+        batch resolves against ONE captured plan (no torn batches
+        across a concurrent snapshot refresh); only the plan check
+        itself takes the instance lock — reads, SST builds and probes
+        run concurrently across serving threads."""
+        splits, snap = self._check_snapshot()
         if not keys:
             return []
+        rt = self.table.schema.logical_row_type()
         arrays = {k: pa.array([d[k] for d in keys],
-                              data_type_to_arrow(
-                                  self.table.schema.logical_row_type()
-                                  .get_field(k).type))
+                              data_type_to_arrow(rt.get_field(k).type))
                   for k in self.pk}
         query = pa.table(arrays)
         buckets = self.assigner.assign(query)
         out: List[Optional[dict]] = [None] * len(keys)
+        pkey = self._pkey(self._norm_partition(partition))
         for b in np.unique(buckets):
+            split = splits.get((pkey, int(b)))
+            if split is None:
+                continue         # empty bucket: all misses
             sel = np.flatnonzero(buckets == b)
-            reader = self._bucket_reader(partition, int(b))
-            if reader is None:
-                continue
-            sub = query.take(pa.array(sel))
-            hit_pos, rows = reader.probe(self._encode_lanes(sub))
-            if rows is None:
-                continue
-            row_dicts = rows.to_pylist()
-            for qi, row in zip(hit_pos, row_dicts):
-                q = keys[int(sel[qi])]
-                # lanes may be prefix-truncated for long string keys:
-                # confirm the full key before accepting the hit
-                if all(row.get(k) == q[k] for k in self.pk):
-                    out[int(sel[qi])] = row
+            if self._fast_path_ok(split):
+                self._lookup_runs(pkey, split, query, sel, keys, out)
+            else:
+                self._lookup_merged(pkey, split, snap, query, sel,
+                                    keys, out)
         return out
+
+    def _confirm(self, row: dict, q: dict) -> bool:
+        # lanes may be prefix-truncated for long string keys: confirm
+        # the full key before accepting the hit
+        return all(row.get(k) == q[k] for k in self.pk)
+
+    def _lookup_merged(self, pkey: str, split, snap, query: pa.Table,
+                       sel: np.ndarray, keys, out):
+        """Merged-bucket fallback: the split's full merge-on-read
+        result spilled as one SST (rows are final table rows — no
+        kind/seq columns survive the merge)."""
+        key = self._bucket_store_key(pkey, split, snap)
+        sub = query.take(pa.array(sel))
+        hit_pos, rows = self._probe(
+            key, lambda: self._read.read_split(split),
+            self._encode_lanes(sub))
+        if rows is None:
+            return
+        for qi, row in zip(hit_pos, rows.to_pylist()):
+            q = keys[int(sel[qi])]
+            if self._confirm(row, q):
+                out[int(sel[qi])] = row
+
+    def _lookup_runs(self, pkey: str, split, query: pa.Table,
+                     sel: np.ndarray, keys, out):
+        """LSM point get: walk the bucket's sorted runs newest-first,
+        prune files by manifest key-range stats before any IO, probe
+        per-file SSTs (bloom + block binary search), stop at the first
+        hit or tombstone per key."""
+        sub = query.take(pa.array(sel))
+        lanes = self._encode_lanes(sub)
+        key_tuples = [tuple(d[k] for k in self.pk)
+                      for d in (keys[int(i)] for i in sel)]
+        pending = list(range(len(sel)))
+        runs = assemble_runs(split.data_files)
+        for run in reversed(runs):          # newest run first
+            if not pending:
+                break
+            by_file: Dict[str, Tuple[object, List[int]]] = {}
+            for pos in pending:
+                kt = key_tuples[pos]
+                for meta in run:
+                    if self._in_range(kt, self._file_range(meta)):
+                        by_file.setdefault(
+                            meta.file_name, (meta, []))[1].append(pos)
+            self._m_pruned.inc(len(run) - len(by_file))
+            resolved: Dict[int, Optional[dict]] = {}
+            for fname in sorted(by_file):
+                meta, poss = by_file[fname]
+                poss = [p for p in poss if p not in resolved]
+                if not poss:
+                    continue
+                key = self._file_store_key(pkey, split.bucket, meta)
+                hit_pos, rows = self._probe(
+                    key,
+                    lambda m=meta: self._file_reader_load(split, m),
+                    lanes[np.array(poss)])
+                if rows is None:
+                    continue
+                # highest sequence number wins within one file (a file
+                # should hold one version per key; prefix-collided
+                # lanes are filtered by the full-key confirm)
+                best: Dict[int, Tuple[int, dict]] = {}
+                for hp, row in zip(hit_pos, rows.to_pylist()):
+                    pos = poss[int(hp)]
+                    if not self._confirm(row, keys[int(sel[pos])]):
+                        continue
+                    seq = row.get(SEQ_COL) or 0
+                    if pos not in best or seq >= best[pos][0]:
+                        best[pos] = (seq, row)
+                for pos, (_, row) in best.items():
+                    kind = row.pop(KIND_COL, RowKind.INSERT)
+                    row.pop(SEQ_COL, None)
+                    if kind in (RowKind.UPDATE_BEFORE, RowKind.DELETE):
+                        resolved[pos] = None      # tombstone
+                    else:
+                        resolved[pos] = row
+            for pos, row in resolved.items():
+                out[int(sel[pos])] = row
+            pending = [p for p in pending if p not in resolved]
 
     def lookup_row(self, key: dict, partition: Tuple = ()
                    ) -> Optional[dict]:

@@ -34,6 +34,7 @@ import io
 import json
 import os
 import struct
+import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -139,34 +140,62 @@ class SstWriter:
         return len(data)
 
 
+_COUNTERS = None
+
+
+def _block_counters():
+    """Lookup-group block-cache Counters resolved once per process
+    (same pattern as fs/caching.py — registry lookups take locks,
+    too heavy per block read)."""
+    global _COUNTERS
+    if _COUNTERS is None:
+        from paimon_tpu import metrics as m
+        group = m.global_registry().lookup_metrics()
+        _COUNTERS = {
+            "hits": group.counter(m.LOOKUP_BLOCK_CACHE_HITS),
+            "misses": group.counter(m.LOOKUP_BLOCK_CACHE_MISSES),
+        }
+    return _COUNTERS
+
+
 class BlockCache:
     """Global byte-bounded LRU over decoded blocks (role of reference
-    io/cache/CacheManager for lookup pages)."""
+    io/cache/CacheManager for lookup pages) — the PINNED tier of the
+    point-lookup path: per-reader index state (block first-keys, bloom
+    filter) lives unevictably on the reader itself, only data blocks
+    rotate through this cache.  Thread-safe: the serving plane probes
+    it from every handler thread."""
 
     def __init__(self, max_bytes: int = 256 << 20):
         self.max_bytes = max_bytes
         self._lru: "OrderedDict[Tuple, pa.Table]" = OrderedDict()
         self._bytes = 0
+        self._lock = threading.Lock()
 
     def get(self, key: Tuple) -> Optional[pa.Table]:
-        t = self._lru.get(key)
-        if t is not None:
-            self._lru.move_to_end(key)
+        with self._lock:
+            t = self._lru.get(key)
+            if t is not None:
+                self._lru.move_to_end(key)
+        c = _block_counters()
+        (c["hits"] if t is not None else c["misses"]).inc()
         return t
 
     def put(self, key: Tuple, t: pa.Table):
-        if key in self._lru:
-            self._lru.move_to_end(key)
-            return
-        self._lru[key] = t
-        self._bytes += t.nbytes
-        while self._bytes > self.max_bytes and len(self._lru) > 1:
-            _, old = self._lru.popitem(last=False)
-            self._bytes -= old.nbytes
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                return
+            self._lru[key] = t
+            self._bytes += t.nbytes
+            while self._bytes > self.max_bytes and len(self._lru) > 1:
+                _, old = self._lru.popitem(last=False)
+                self._bytes -= old.nbytes
 
     def drop_file(self, path: str):
-        for k in [k for k in self._lru if k[0] == path]:
-            self._bytes -= self._lru.pop(k).nbytes
+        with self._lock:
+            for k in [k for k in self._lru if k[0] == path]:
+                self._bytes -= self._lru.pop(k).nbytes
 
 
 _GLOBAL_BLOCK_CACHE = BlockCache()
@@ -284,7 +313,13 @@ class LookupStore:
     """Size-bounded local store of SST files, keyed by (partition,
     bucket, snapshot): files evict least-recently-used when the disk
     budget is exceeded (reference SortLookupStoreFactory + LookupLevels
-    file eviction at mergetree/LookupLevels.java:308)."""
+    file eviction at mergetree/LookupLevels.java:308).
+
+    Thread-safe: the serving plane's lookup batches build and probe
+    concurrently (LocalTableQuery only serializes plan swaps, not
+    reads), so the reader map and disk accounting are internally
+    locked.  The SST file write in put() happens OUTSIDE the lock —
+    it is the expensive part and writes a not-yet-published path."""
 
     def __init__(self, directory: str,
                  max_disk_bytes: int = 10 << 30,
@@ -304,8 +339,10 @@ class LookupStore:
                     pass
         self._readers: "OrderedDict[str, SstReader]" = OrderedDict()
         self._disk_bytes = 0              # running total: no per-put stats
+        self._lock = threading.Lock()
+        self._closed = False
 
-    def _evict_to_budget(self):
+    def _evict_to_budget_locked(self):
         while self._disk_bytes > self.max_disk and len(self._readers) > 1:
             name, reader = self._readers.popitem(last=False)
             self._disk_bytes -= reader.file_size
@@ -316,35 +353,80 @@ class LookupStore:
                 pass
 
     def get(self, key: str) -> Optional[SstReader]:
-        r = self._readers.get(key)
-        if r is not None:
-            self._readers.move_to_end(key)
-        return r
+        with self._lock:
+            r = self._readers.get(key)
+            if r is not None:
+                self._readers.move_to_end(key)
+            return r
 
     def put(self, key: str, lanes: np.ndarray, table: pa.Table,
             writer: Optional[SstWriter] = None) -> SstReader:
         import hashlib
+        import uuid
         # hash the key into the file name: composite keys (partition
-        # values etc.) must never collide after path sanitization
+        # values etc.) must never collide after path sanitization.  A
+        # short random suffix keeps concurrent same-key builders from
+        # writing one path (last publisher wins; the loser's file is
+        # removed below)
         digest = hashlib.sha1(key.encode("utf-8")).hexdigest()[:24]
-        path = os.path.join(self.dir, digest + ".sst")
+        path = os.path.join(self.dir,
+                            f"{digest}-{uuid.uuid4().hex[:8]}.sst")
         (writer or SstWriter()).write(path, lanes, table)
         reader = SstReader(path, self.block_cache)
-        old = self._readers.pop(key, None)
-        if old is not None:
-            self.block_cache.drop_file(old.path)
-            self._disk_bytes -= old.file_size
-        self._readers[key] = reader
-        self._disk_bytes += reader.file_size
-        self._evict_to_budget()
-        return self._readers.get(key)
+        with self._lock:
+            if self._closed:
+                # a build racing close(): publishing would leak a
+                # file the owner just promised to have cleaned up
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                raise RuntimeError("lookup store is closed")
+            old = self._readers.pop(key, None)
+            if old is not None:
+                self.block_cache.drop_file(old.path)
+                self._disk_bytes -= old.file_size
+                try:
+                    os.remove(old.path)
+                except OSError:
+                    pass
+            self._readers[key] = reader
+            self._disk_bytes += reader.file_size
+            self._evict_to_budget_locked()
+            return self._readers.get(key)
 
-    def drop_all(self):
-        for _, r in list(self._readers.items()):
+    def drop(self, key: str):
+        """Drop one entry (reader + SST file + its cached blocks) —
+        the serving plane's eviction for files dropped by compaction
+        and buckets dropped by snapshot advance."""
+        with self._lock:
+            r = self._readers.pop(key, None)
+            if r is None:
+                return
+            self.block_cache.drop_file(r.path)
+            self._disk_bytes -= r.file_size
+        try:
+            os.remove(r.path)
+        except OSError:
+            pass
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._readers)
+
+    def drop_all(self, close: bool = False):
+        """Drop every entry; `close=True` additionally marks the store
+        closed so concurrent in-flight builds cannot republish files
+        afterwards (their put() removes its own file and raises)."""
+        with self._lock:
+            readers = list(self._readers.items())
+            self._readers.clear()
+            self._disk_bytes = 0
+            if close:
+                self._closed = True
+        for _, r in readers:
             self.block_cache.drop_file(r.path)
             try:
                 os.remove(r.path)
             except OSError:
                 pass
-        self._readers.clear()
-        self._disk_bytes = 0

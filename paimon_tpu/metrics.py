@@ -33,7 +33,15 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "STREAM_CHECKPOINT_MS", "STREAM_LOOP_RESTARTS",
            "STREAM_FRESHNESS_MS", "STREAM_CHANGELOG_ROWS",
            "STREAM_COMPACTIONS", "STREAM_COMPACTIONS_PAUSED",
-           "STREAM_SOURCE_BACKLOG"]
+           "STREAM_SOURCE_BACKLOG",
+           "SERVICE_REQUESTS", "SERVICE_REJECTED",
+           "SERVICE_QUEUE_DEPTH", "SERVICE_INFLIGHT_BYTES",
+           "SERVICE_TENANT_BYTES", "SERVICE_ADMISSION_WAIT_MS",
+           "SERVICE_LOOKUP_MS", "SERVICE_SCAN_MS",
+           "SERVICE_CHANGELOG_MS", "SERVICE_LOOKUP_KEYS",
+           "LOOKUP_BLOCK_CACHE_HITS", "LOOKUP_BLOCK_CACHE_MISSES",
+           "LOOKUP_READER_BUILDS", "LOOKUP_READER_REUSES",
+           "LOOKUP_FILES_PRUNED", "LOOKUP_SNAPSHOT_REFRESHES"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -100,6 +108,33 @@ STREAM_CHANGELOG_ROWS = "changelog_rows_served"
 STREAM_COMPACTIONS = "compactions"            # triggered compaction runs
 STREAM_COMPACTIONS_PAUSED = "compactions_paused"  # skipped: ingest pressure
 STREAM_SOURCE_BACKLOG = "source_backlog"      # gauge: unpulled events
+
+# query-serving-plane counter/gauge/histogram names (service metric
+# group; producers are service/admission.py + service/query_service.py,
+# consumers benchmarks/serve_bench.py + tests + dashboards).  Per-tenant
+# in-flight bytes render as one gauge per tenant keyed like a table:
+# group("service", tenant) -> prometheus label table="<tenant>".
+SERVICE_REQUESTS = "requests"                 # admitted requests
+SERVICE_REJECTED = "rejected"                 # 429s: queue full/timeout
+SERVICE_QUEUE_DEPTH = "queue_depth"           # gauge: waiters right now
+SERVICE_INFLIGHT_BYTES = "inflight_bytes"     # gauge: admitted bytes now
+SERVICE_TENANT_BYTES = "tenant_inflight_bytes"    # gauge, per tenant
+SERVICE_ADMISSION_WAIT_MS = "admission_wait_ms"   # queued -> admitted
+SERVICE_LOOKUP_MS = "lookup_ms"               # whole /lookup request
+SERVICE_SCAN_MS = "scan_ms"                   # whole /scan request
+SERVICE_CHANGELOG_MS = "changelog_ms"         # whole /changelog poll
+SERVICE_LOOKUP_KEYS = "lookup_keys"           # point-get keys served
+
+# point-lookup-plane counter names (lookup metric group; producers in
+# lookup/sst.py + lookup/local_query.py).  block_cache_* watch the
+# pinned SST block cache; files_pruned counts data files skipped by
+# manifest key-range + bloom stats BEFORE any IO.
+LOOKUP_BLOCK_CACHE_HITS = "block_cache_hits"
+LOOKUP_BLOCK_CACHE_MISSES = "block_cache_misses"
+LOOKUP_READER_BUILDS = "reader_builds"        # SSTs built (file reads)
+LOOKUP_READER_REUSES = "reader_reuses"        # SSTs served warm
+LOOKUP_FILES_PRUNED = "files_pruned"          # skipped by stats, no IO
+LOOKUP_SNAPSHOT_REFRESHES = "snapshot_refreshes"  # plan reloads
 
 
 class Counter:
@@ -278,6 +313,16 @@ class MetricRegistry:
     def stream_metrics(self, table: str = "") -> MetricGroup:
         """Streaming-daemon plane (ours; service/stream_daemon.py)."""
         return self.group("stream", table)
+
+    def service_metrics(self, table: str = "") -> MetricGroup:
+        """Query-serving plane (ours; service/query_service.py +
+        service/admission.py).  `table` doubles as the tenant id for
+        per-tenant gauges."""
+        return self.group("service", table)
+
+    def lookup_metrics(self, table: str = "") -> MetricGroup:
+        """Point-lookup plane (ours; lookup/)."""
+        return self.group("lookup", table)
 
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind

@@ -568,6 +568,58 @@ class CoreOptions:
         "this interval (bounds metadata growth on long-running "
         "daemons); None leaves snapshot expiry to external maintenance")
 
+    # -- query serving plane (ours; service/query_service.py +
+    #    service/admission.py) --------------------------------------------
+    SERVICE_MAX_INFLIGHT_BYTES = ConfigOption(
+        "service.max-inflight-bytes", parse_memory_size, 1 << 30,
+        "Hard budget on the estimated bytes of requests admitted to "
+        "the query service at once (the serving-side analog of "
+        "read.prefetch.max-bytes); further requests queue instead of "
+        "oversubscribing, and an idle service always admits one "
+        "request so a single request larger than the budget cannot "
+        "stall forever")
+    SERVICE_TENANT_MAX_INFLIGHT_BYTES = ConfigOption(
+        "service.tenant.max-inflight-bytes", parse_memory_size, None,
+        "Per-tenant slice of the admission byte budget (tenants are "
+        "named by the request's 'tenant' field / the client's tenant "
+        "id); None = every tenant may use the whole "
+        "service.max-inflight-bytes.  A tenant with nothing in flight "
+        "is always eligible for one request (anti-starvation)")
+    SERVICE_QUEUE_DEPTH = ConfigOption(
+        "service.queue.depth", int, 256,
+        "Bound on requests waiting for admission; a request arriving "
+        "to a full queue is rejected immediately with HTTP 429 "
+        "instead of growing server memory without bound")
+    SERVICE_QUEUE_TIMEOUT = ConfigOption(
+        "service.queue.timeout", _parse_duration_ms, 10_000,
+        "How long a queued request waits for byte budget before the "
+        "service answers HTTP 429 (clients see ServiceBusyError and "
+        "may retry with backoff)")
+    SERVICE_LOOKUP_REFRESH_INTERVAL = ConfigOption(
+        "service.lookup.refresh-interval", _parse_duration_ms, 100,
+        "Snapshot-refresh TTL of the serving-side point-lookup "
+        "engine: within the TTL, point gets are answered from the "
+        "cached plan without touching the snapshot hint or manifest "
+        "chain (lookups may trail commits by up to this long; 0 = "
+        "check the latest snapshot on every call, the embedded "
+        "LocalTableQuery default)")
+    SERVICE_CACHE_SHARED = ConfigOption(
+        "service.cache.shared", _parse_bool, True,
+        "Serve all requests through the process-wide shared cache "
+        "tier (footer cache + whole-file/block-range byte cache, "
+        "fs/caching.py) so concurrent /scan, /lookup and /changelog "
+        "requests warm each other instead of rebuilding per-request "
+        "state; false leaves the table's own FileIO untouched")
+    SERVICE_SCAN_ROW_BYTES = ConfigOption(
+        "service.scan.row-bytes-estimate", int, 256,
+        "Estimated serving-cost bytes per row for admission control "
+        "of LIMIT'd scans and changelog polls (the admission charge "
+        "is limit x this, known before any plan or read IO runs)")
+    SERVICE_LOOKUP_KEY_BYTES = ConfigOption(
+        "service.lookup.key-bytes-estimate", int, 4096,
+        "Estimated serving-cost bytes per point-get key for admission "
+        "control (roughly one SST block read per cold key)")
+
     # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
     SCAN_PLAN_SORT_PARTITION = ConfigOption(
         "scan.plan-sort-partition", _parse_bool, False,

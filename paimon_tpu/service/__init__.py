@@ -7,8 +7,11 @@ HTTP+JSON over the same LocalTableQuery engine — the service plane is
 the capability, not the wire bytes.
 """
 
+from paimon_tpu.service.admission import (  # noqa: F401
+    AdmissionController, AdmissionRejected,
+)
 from paimon_tpu.service.query_service import (  # noqa: F401
-    KvQueryClient, KvQueryServer, ServiceManager,
+    KvQueryClient, KvQueryServer, ServiceBusyError, ServiceManager,
 )
 from paimon_tpu.service.stream_daemon import (  # noqa: F401
     StreamDaemon, checkpoint_once, recover_checkpoint,

@@ -1,0 +1,236 @@
+"""Admission control for the query-serving plane: per-tenant in-flight
+byte budgets with a bounded wait queue.
+
+The serving-side counterpart of the scan pipeline's
+`read.prefetch.max-bytes` throttle (parallel/scan_pipeline.py): every
+request is charged an ESTIMATED byte cost before any heavy work runs;
+requests that would push the process (or their tenant) over budget
+queue — bounded, with a timeout that turns into HTTP 429 — instead of
+oversubscribing memory.  Capacity drains to waiters LARGEST-FIRST
+(the LPT discipline of parallel/packing.py: freeing one big admission
+unblocks the most bytes per wakeup), with the scan pipeline's
+anti-stall rule — an idle budget always admits one request, so a
+single request larger than the whole budget cannot wedge the service.
+
+Observability: queue depth / in-flight bytes gauges, admission-wait
+histogram and admitted/rejected counters in the `service` metric
+group; per-tenant in-flight bytes render as one gauge per tenant
+(group("service", tenant) -> prometheus label table="<tenant>").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["AdmissionController", "AdmissionRejected", "AdmissionTicket"]
+
+DEFAULT_TENANT = "default"
+
+
+class AdmissionRejected(RuntimeError):
+    """Raised when a request cannot be admitted: the wait queue is
+    full, or the byte budget did not free up within the queue timeout.
+    The HTTP layer maps this to 429."""
+
+    status = 429
+
+
+class _Waiter:
+    __slots__ = ("bytes", "tenant", "event", "admitted", "enqueued_at")
+
+    def __init__(self, nbytes: int, tenant: str):
+        self.bytes = nbytes
+        self.tenant = tenant
+        self.event = threading.Event()
+        self.admitted = False
+        self.enqueued_at = time.perf_counter()
+
+
+class AdmissionTicket:
+    """Held while a request runs; releasing returns the bytes to the
+    budget and drains the queue.  Context-manager form preferred."""
+
+    def __init__(self, controller: "AdmissionController", nbytes: int,
+                 tenant: str):
+        self._controller = controller
+        self.bytes = nbytes
+        self.tenant = tenant
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._controller._release(self)
+
+    def __enter__(self) -> "AdmissionTicket":
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class AdmissionController:
+    def __init__(self, max_bytes: int,
+                 tenant_max_bytes: Optional[int] = None,
+                 queue_depth: int = 256,
+                 queue_timeout_ms: int = 10_000,
+                 table: str = ""):
+        self.max_bytes = max(1, int(max_bytes))
+        # `is not None`, not truthiness: an explicit 0 means "throttle
+        # every tenant to the one-request anti-starvation minimum",
+        # the opposite of the unlimited default
+        self.tenant_max_bytes = int(tenant_max_bytes) \
+            if tenant_max_bytes is not None else self.max_bytes
+        self.queue_depth = max(0, int(queue_depth))
+        self.queue_timeout_ms = max(0, int(queue_timeout_ms))
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._tenant_inflight: Dict[str, int] = {}
+        self._waiters: List[_Waiter] = []
+        from paimon_tpu.metrics import (
+            SERVICE_ADMISSION_WAIT_MS, SERVICE_INFLIGHT_BYTES,
+            SERVICE_QUEUE_DEPTH, SERVICE_REJECTED, SERVICE_REQUESTS,
+            global_registry,
+        )
+        self._registry = global_registry()
+        g = self._registry.service_metrics(table)
+        self._m_requests = g.counter(SERVICE_REQUESTS)
+        self._m_rejected = g.counter(SERVICE_REJECTED)
+        self._m_wait = g.histogram(SERVICE_ADMISSION_WAIT_MS)
+        # explicitly-set gauges (not fn-backed): a later controller on
+        # the same table must take the series over, not leave a stale
+        # closure pointing at a dead instance
+        self._g_queue = g.gauge(SERVICE_QUEUE_DEPTH)
+        self._g_inflight = g.gauge(SERVICE_INFLIGHT_BYTES)
+        self._g_queue.set(0)
+        self._g_inflight.set(0)
+        self._tenant_gauges: Dict[str, object] = {}
+
+    # -- introspection (tests/benchmarks) ------------------------------------
+
+    @property
+    def inflight_bytes(self) -> int:
+        return self._inflight
+
+    def tenant_inflight(self, tenant: str) -> int:
+        return self._tenant_inflight.get(tenant, 0)
+
+    @property
+    def queued(self) -> int:
+        return len(self._waiters)
+
+    # -- admission -----------------------------------------------------------
+
+    def _fits_locked(self, nbytes: int, tenant: str) -> bool:
+        t_in = self._tenant_inflight.get(tenant, 0)
+        fits_global = self._inflight + nbytes <= self.max_bytes \
+            or self._inflight == 0
+        fits_tenant = t_in + nbytes <= self.tenant_max_bytes \
+            or t_in == 0
+        return fits_global and fits_tenant
+
+    # bound on DISTINCT per-tenant gauge series: tenant ids arrive
+    # from untrusted request bodies, and registry gauges are
+    # permanent — without a cap a client cycling tenant strings grows
+    # server memory and the /metrics output without bound.  Byte
+    # accounting (self._tenant_inflight) stays exact per tenant (that
+    # dict IS pruned on release); only the observability series fold
+    # into "__other__" past the cap.
+    MAX_TENANT_GAUGES = 256
+
+    def _tenant_gauge(self, tenant: str):
+        g = self._tenant_gauges.get(tenant)
+        if g is None:
+            if len(self._tenant_gauges) >= self.MAX_TENANT_GAUGES:
+                tenant = "__other__"
+                g = self._tenant_gauges.get(tenant)
+                if g is not None:
+                    return g
+            from paimon_tpu.metrics import SERVICE_TENANT_BYTES
+            g = self._registry.service_metrics(tenant).gauge(
+                SERVICE_TENANT_BYTES)
+            self._tenant_gauges[tenant] = g
+        return g
+
+    def _admit_locked(self, nbytes: int, tenant: str):
+        self._inflight += nbytes
+        self._tenant_inflight[tenant] = \
+            self._tenant_inflight.get(tenant, 0) + nbytes
+        self._g_inflight.set(self._inflight)
+        self._tenant_gauge(tenant).set(self._tenant_inflight[tenant])
+        self._m_requests.inc()
+
+    def _drain_locked(self):
+        """Admit every waiter that now fits, LARGEST-FIRST (LPT like
+        parallel/packing.py).  Called with the lock held after any
+        release; a smaller waiter can slip past a larger one only when
+        the larger one genuinely does not fit yet."""
+        if not self._waiters:
+            return
+        for w in sorted(self._waiters,
+                        key=lambda w: (-w.bytes, w.enqueued_at)):
+            if w.admitted:
+                continue
+            if self._fits_locked(w.bytes, w.tenant):
+                w.admitted = True
+                self._admit_locked(w.bytes, w.tenant)
+                w.event.set()
+        self._waiters = [w for w in self._waiters if not w.admitted]
+        self._g_queue.set(len(self._waiters))
+
+    def acquire(self, tenant: str = DEFAULT_TENANT,
+                nbytes: int = 1) -> AdmissionTicket:
+        """Block until `nbytes` fits under both the global and the
+        tenant budget, then return the ticket.  Raises
+        AdmissionRejected immediately when the wait queue is full, or
+        after service.queue.timeout with no capacity."""
+        tenant = tenant or DEFAULT_TENANT
+        nbytes = max(1, int(nbytes))
+        t0 = time.perf_counter()
+        with self._lock:
+            # fast path only when nobody is queued: arrivals must not
+            # starve the waiters the drain is ordering
+            if not self._waiters and self._fits_locked(nbytes, tenant):
+                self._admit_locked(nbytes, tenant)
+                self._m_wait.update(0.0)
+                return AdmissionTicket(self, nbytes, tenant)
+            if len(self._waiters) >= self.queue_depth:
+                self._m_rejected.inc()
+                raise AdmissionRejected(
+                    f"admission queue full "
+                    f"({self.queue_depth} waiting); retry later")
+            w = _Waiter(nbytes, tenant)
+            self._waiters.append(w)
+            self._g_queue.set(len(self._waiters))
+            self._drain_locked()     # we may fit right now
+        if w.event.wait(self.queue_timeout_ms / 1000.0):
+            self._m_wait.update((time.perf_counter() - t0) * 1000.0)
+            return AdmissionTicket(self, nbytes, tenant)
+        with self._lock:
+            if w.admitted:
+                # the drain won the race with the timeout: keep it
+                self._m_wait.update((time.perf_counter() - t0) * 1000.0)
+                return AdmissionTicket(self, nbytes, tenant)
+            self._waiters.remove(w)
+            self._g_queue.set(len(self._waiters))
+            self._m_rejected.inc()
+        raise AdmissionRejected(
+            f"no byte budget within {self.queue_timeout_ms}ms "
+            f"({nbytes} bytes requested, {self._inflight} in flight); "
+            f"retry later")
+
+    def _release(self, ticket: AdmissionTicket):
+        with self._lock:
+            self._inflight -= ticket.bytes
+            left = self._tenant_inflight.get(ticket.tenant, 0) \
+                - ticket.bytes
+            if left > 0:
+                self._tenant_inflight[ticket.tenant] = left
+            else:
+                self._tenant_inflight.pop(ticket.tenant, None)
+            self._g_inflight.set(self._inflight)
+            self._tenant_gauge(ticket.tenant).set(max(0, left))
+            self._drain_locked()

@@ -4,18 +4,41 @@ reference: paimon-service/.../KvQueryServer.java + KvQueryClient.java +
 ServiceManager.java ('primary-key-lookup' address files under
 `<table>/service/`). Powers remote lookup joins
 (PrimaryKeyPartialLookupTable remote mode).
+
+Serving plane (PR 7): the server is MULTI-TENANT and cross-request —
+
+* one shared LocalTableQuery (lookup/local_query.py) with a
+  snapshot-refresh TTL serves every /lookup, probing per-file SSTs
+  against the pinned block cache instead of rebuilding state per
+  request;
+* the table's FileIO joins the process-wide shared byte-cache tier
+  (fs/caching.shared_cache_state), so concurrent /scan, /lookup and
+  /changelog requests warm one footer/file/range cache
+  (service.cache.shared);
+* every request passes ADMISSION CONTROL (service/admission.py):
+  an estimated byte cost is charged against the global and per-tenant
+  in-flight budgets (service.max-inflight-bytes /
+  service.tenant.max-inflight-bytes); requests queue bounded
+  (service.queue.depth) with a timeout (service.queue.timeout) that
+  answers HTTP 429 — the client raises ServiceBusyError;
+* connections are KEEP-ALIVE (HTTP/1.1): KvQueryClient holds one
+  persistent connection and reconnects on stale sockets — connection
+  setup no longer dominates sub-ms point gets.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
-import urllib.error
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from paimon_tpu.lookup import LocalTableQuery
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.service.admission import (
+    AdmissionController, AdmissionRejected,
+)
 
 
 def _encode_value(v):
@@ -63,9 +86,19 @@ def _decode_value(v):
         return [_decode_value(x) for x in v]
     return v
 
-__all__ = ["KvQueryServer", "KvQueryClient", "ServiceManager"]
+__all__ = ["KvQueryServer", "KvQueryClient", "ServiceManager",
+           "ServiceBusyError"]
 
 PRIMARY_KEY_LOOKUP = "primary-key-lookup"
+
+from contextlib import nullcontext as _nullcontext  # noqa: E402
+
+_NULLCTX = _nullcontext()
+
+
+class ServiceBusyError(RuntimeError):
+    """The service answered 429: admission queue full or byte budget
+    exhausted within the queue timeout.  Retry with backoff."""
 
 
 class ServiceManager:
@@ -94,8 +127,36 @@ class ServiceManager:
 
 class KvQueryServer:
     def __init__(self, table, host: str = "127.0.0.1", port: int = 0):
+        opts = table.options
+        if opts.get(CoreOptions.SERVICE_CACHE_SHARED):
+            table = self._join_shared_cache(table)
         self.table = table
-        self.query = LocalTableQuery(table)
+        self.options = table.options
+        # ONE LocalTableQuery shared by every /lookup (plan swaps
+        # serialize; reads/builds/probes run concurrently across
+        # handler threads).  Built lazily so non-pk tables can still
+        # serve /scan and /changelog.
+        self._query: Optional[LocalTableQuery] = None
+        self._query_lock = threading.Lock()
+        self.admission = AdmissionController(
+            max_bytes=opts.get(CoreOptions.SERVICE_MAX_INFLIGHT_BYTES),
+            tenant_max_bytes=opts.get(
+                CoreOptions.SERVICE_TENANT_MAX_INFLIGHT_BYTES),
+            queue_depth=opts.get(CoreOptions.SERVICE_QUEUE_DEPTH),
+            queue_timeout_ms=opts.get(CoreOptions.SERVICE_QUEUE_TIMEOUT),
+            table=table.name)
+        self._scan_row_bytes = opts.get(CoreOptions.SERVICE_SCAN_ROW_BYTES)
+        self._lookup_key_bytes = opts.get(
+            CoreOptions.SERVICE_LOOKUP_KEY_BYTES)
+        from paimon_tpu.metrics import (
+            SERVICE_CHANGELOG_MS, SERVICE_LOOKUP_KEYS, SERVICE_LOOKUP_MS,
+            SERVICE_SCAN_MS, global_registry,
+        )
+        g = global_registry().service_metrics(table.name)
+        self._m_lookup_ms = g.histogram(SERVICE_LOOKUP_MS)
+        self._m_scan_ms = g.histogram(SERVICE_SCAN_MS)
+        self._m_changelog_ms = g.histogram(SERVICE_CHANGELOG_MS)
+        self._m_lookup_keys = g.counter(SERVICE_LOOKUP_KEYS)
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -117,6 +178,40 @@ class KvQueryServer:
         self.max_changelog_consumers = 256
         self.changelog_max_rows = 10_000
 
+    @staticmethod
+    def _join_shared_cache(table):
+        """Rewrap the table over the process-wide shared byte-cache
+        tier (whole-file + block-range), so every request this server
+        — and every other server/table in the process — serves warms
+        one bounded cache (tentpole 1: per-read scope -> process-wide
+        shared tier)."""
+        from paimon_tpu.fs.caching import CachingFileIO, shared_cache_state
+        # grow the shared tier FIRST: a table already wrapped by
+        # read.cache.range rides the shared state with whole-file
+        # capacity 0 — the serving plane's whole-file tier must turn
+        # on for it too, not only for unwrapped tables
+        state = shared_cache_state(
+            256 << 20,
+            table.options.get(CoreOptions.READ_CACHE_RANGE_MAX_BYTES))
+        if isinstance(table.file_io, CachingFileIO):
+            # already caching (shared state grown above if it rides
+            # it; an explicitly-constructed private wrapper keeps its
+            # own configuration)
+            return table
+        wrapped = CachingFileIO(table.file_io, state=state)
+        return type(table)(wrapped, table.path, table.schema,
+                           branch=table.branch)
+
+    def query(self) -> LocalTableQuery:
+        """The shared serving-side point-lookup engine (pk tables)."""
+        with self._query_lock:
+            if self._query is None:
+                self._query = LocalTableQuery(
+                    self.table,
+                    refresh_interval_ms=self.options.get(
+                        CoreOptions.SERVICE_LOOKUP_REFRESH_INTERVAL))
+            return self._query
+
     def start(self) -> "KvQueryServer":
         from paimon_tpu.parallel.executors import spawn_thread
         self._thread = spawn_thread(self.httpd.serve_forever,
@@ -128,19 +223,27 @@ class KvQueryServer:
         self.services.unregister(PRIMARY_KEY_LOOKUP)
         self.httpd.shutdown()
         self.httpd.server_close()
+        with self._query_lock:
+            if self._query is not None:
+                self._query.close()
+                self._query = None
 
     def _make_handler(self):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive: one client connection serves many requests
+            # (Content-Length is set on every response below)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
             def do_GET(self):
                 """Prometheus scrape endpoint: the whole process
-                registry (scan/write/compaction/commit groups + stage
-                latency histograms) in text exposition 0.0.4, rendered
-                from MetricRegistry.snapshot_rows — the same
+                registry (scan/write/compaction/commit/service groups +
+                stage latency histograms) in text exposition 0.0.4,
+                rendered from MetricRegistry.snapshot_rows — the same
                 serialization the $metrics system table queries."""
                 if self.path != "/metrics":
                     self.send_error(404)
@@ -162,31 +265,56 @@ class KvQueryServer:
 
             def do_POST(self):
                 if self.path == "/lookup":
-                    handle = self._lookup
+                    handle, timer = self._lookup, server._m_lookup_ms
                 elif self.path == "/scan":
-                    handle = self._scan
+                    handle, timer = self._scan, server._m_scan_ms
                 elif self.path == "/changelog":
-                    handle = self._changelog
+                    handle, timer = \
+                        self._changelog, server._m_changelog_ms
                 else:
                     self.send_error(404)
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
+                import time as _time
+                t0 = _time.perf_counter()
                 try:
                     body = json.dumps(handle(req)).encode()
-                    self.send_response(200)
+                    status = 200
+                except AdmissionRejected as e:
+                    body = json.dumps({"error": str(e),
+                                       "busy": True}).encode()
+                    status = 429
                 except Exception as e:      # noqa: BLE001
                     body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
+                    status = 500
+                if status != 429:
+                    # 429s spent their time in the admission queue —
+                    # that wait is admission_wait_ms/rejected's story;
+                    # folding up-to-queue-timeout samples into the
+                    # service-time histograms would corrupt p95/p99
+                    timer.update((_time.perf_counter() - t0) * 1000.0)
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            @staticmethod
+            def _tenant(req) -> str:
+                return str(req.get("tenant") or "default")
+
             def _lookup(self, req):
-                rows = server.query.lookup(
-                    req["keys"],
-                    partition=tuple(req.get("partition") or ()))
+                keys = req["keys"]
+                est = max(1, len(keys)) * server._lookup_key_bytes
+                with server.admission.acquire(self._tenant(req), est):
+                    rows = server.query().lookup(
+                        [{k: _decode_value(v) for k, v in d.items()}
+                         for d in keys],
+                        partition=tuple(
+                            _decode_value(v)
+                            for v in req.get("partition") or ()))
+                server._m_lookup_keys.inc(len(keys))
                 return {"rows": [None if r is None else
                                  {k: _encode_value(x)
                                   for k, x in r.items()}
@@ -204,12 +332,14 @@ class KvQueryServer:
                 consumer = str(req.get("consumer") or "default")
                 limit = int(req.get("max_rows")
                             or server.changelog_max_rows)
-                with server._streams_lock:
+                est = max(1, limit) * server._scan_row_bytes
+                with server.admission.acquire(self._tenant(req), est), \
+                        server._streams_lock:
                     entry = server._streams.get(consumer)
                     if entry is None:
                         entry = {"scan": server.table
                                  .new_read_builder().new_stream_scan(),
-                                 "pending": []}
+                                 "pending": [], "plan": None}
                         server._streams[consumer] = entry
                         while len(server._streams) > \
                                 server.max_changelog_consumers:
@@ -217,14 +347,33 @@ class KvQueryServer:
                     server._streams.move_to_end(consumer)
                     snapshot_id = None
                     if not entry["pending"]:
-                        plan = entry["scan"].plan()
+                        # a plan may be PARKED from a prior poll whose
+                        # materialization ticket 429'd — the stream
+                        # scan has already advanced past it, so it
+                        # must be retried, never re-planned (rows
+                        # would be lost)
+                        plan = entry.get("plan") or \
+                            entry["scan"].plan()
                         if plan is None:
                             return {"rows": [], "snapshot_id": None,
                                     "caught_up": True, "more": False}
+                        entry["plan"] = plan
+                        # the initial ticket only covers the poll;
+                        # materializing the snapshot delta is the real
+                        # allocation — charge its on-disk bytes before
+                        # reading (AdmissionRejected -> 429 with the
+                        # plan parked for the consumer's retry)
+                        delta = sum(f.file_size for s in plan.splits
+                                    for f in s.data_files)
+                        extra = max(0, delta - est)
+                        with server.admission.acquire(
+                                self._tenant(req), extra) \
+                                if extra else _NULLCTX:
+                            entry["pending"] = server.table \
+                                .new_read_builder().new_read() \
+                                .to_arrow(plan).to_pylist()
                         snapshot_id = plan.snapshot_id
-                        entry["pending"] = server.table \
-                            .new_read_builder().new_read() \
-                            .to_arrow(plan).to_pylist()
+                        entry["plan"] = None
                     rows = entry["pending"][:limit]
                     entry["pending"] = entry["pending"][limit:]
                     more = bool(entry["pending"])
@@ -238,15 +387,22 @@ class KvQueryServer:
                 """Bounded table scan through the pipelined split
                 reader (parallel/scan_pipeline.py): splits stream
                 through the prefetch pipeline and admission stops as
-                soon as `limit` rows are buffered."""
+                soon as `limit` rows are buffered.  The admission
+                charge is limit x service.scan.row-bytes-estimate —
+                known BEFORE the plan, so even the manifest walk
+                (heavy fan-in on large tables) runs under the ticket,
+                never ahead of the byte budget."""
                 limit = req.get("limit")
                 limit = 10_000 if limit is None else int(limit)
-                rb = server.table.new_read_builder()
-                if req.get("projection"):
-                    rb = rb.with_projection(list(req["projection"]))
-                rb = rb.with_limit(limit)
-                plan = rb.new_scan().plan()
-                t = rb.new_read().to_arrow(plan.splits)
+                est = max(1, limit) * server._scan_row_bytes
+                with server.admission.acquire(self._tenant(req), est):
+                    rb = server.table.new_read_builder()
+                    if req.get("projection"):
+                        rb = rb.with_projection(
+                            list(req["projection"]))
+                    rb = rb.with_limit(limit)
+                    plan = rb.new_scan().plan()
+                    t = rb.new_read().to_arrow(plan.splits)
                 return {"rows": [{k: _encode_value(v)
                                   for k, v in r.items()}
                                  for r in t.to_pylist()],
@@ -258,9 +414,17 @@ class KvQueryServer:
 class KvQueryClient:
     """Remote point lookups; resolves the server address from the
     table's service registry (reference KvQueryClient + ServiceManager
-    discovery)."""
+    discovery).
 
-    def __init__(self, table=None, address: Optional[str] = None):
+    Holds ONE persistent keep-alive connection (http.client) —
+    reconnecting per request used to dominate sub-ms point-get latency
+    — and transparently reopens it when the server or an idle timeout
+    dropped the socket (one retry, then the error surfaces).
+    Thread-safe: a lock serializes requests on the shared connection.
+    """
+
+    def __init__(self, table=None, address: Optional[str] = None,
+                 tenant: str = "default"):
         if address is None:
             if table is None:
                 raise ValueError("need a table or an address")
@@ -271,31 +435,103 @@ class KvQueryClient:
                     "no primary-key-lookup service registered")
             address = addrs[0]
         self.address = address.rstrip("/")
+        self.tenant = tenant
+        hostport = self.address.split("://", 1)[-1]
+        host, _, port = hostport.partition(":")
+        self._host = host
+        self._port = int(port) if port else 80
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._lock = threading.Lock()
+        self.reconnects = 0          # observable: stale-socket reopens
 
-    def _post(self, endpoint: str, body: dict, timeout: int) -> dict:
-        """POST json; server-side errors (HTTP 500 with an {"error"}
-        body) surface as RuntimeError with the server's message —
-        urlopen raises HTTPError before the body would be parsed."""
-        req = urllib.request.Request(
-            f"{self.address}/{endpoint}",
-            data=json.dumps(body).encode(), method="POST")
-        req.add_header("Content-Type", "application/json")
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            try:
-                detail = json.loads(e.read()).get("error", str(e))
-            except ValueError:
-                detail = str(e)
-            raise RuntimeError(
-                f"{endpoint} failed: {detail}") from e
+    def close(self):
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+    def __enter__(self) -> "KvQueryClient":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _post(self, endpoint: str, body: dict, timeout: int,
+              idempotent: bool = True) -> dict:
+        """POST json on the persistent connection.  429 raises
+        ServiceBusyError (admission control pushed back); other
+        server-side errors ({"error"} bodies) surface as RuntimeError
+        with the server's message.
+
+        Stale-socket handling: a reused keep-alive socket that dies
+        while SENDING the request reconnects and resends once (the
+        server saw nothing).  A death AFTER the request was sent is
+        ambiguous — the server may have processed it — so only
+        `idempotent` endpoints (lookup/scan: re-execution is wasted
+        work, never wrong) resend; /changelog advances per-consumer
+        server state, so its ambiguous failures surface to the caller
+        instead of silently skipping a batch."""
+        body = dict(body)
+        body.setdefault("tenant", self.tenant)
+        payload = json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        with self._lock:
+            for attempt in (0, 1):
+                conn = self._conn
+                fresh = conn is None
+                if fresh:
+                    conn = http.client.HTTPConnection(
+                        self._host, self._port, timeout=timeout)
+                sent = False
+                try:
+                    if not fresh:
+                        conn.timeout = timeout
+                        if conn.sock is not None:
+                            conn.sock.settimeout(timeout)
+                    conn.request("POST", f"/{endpoint}", payload,
+                                 headers)
+                    sent = True
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    status = resp.status
+                except (http.client.HTTPException, ConnectionError,
+                        BrokenPipeError, OSError) as e:
+                    conn.close()
+                    self._conn = None
+                    # a FRESH connection that fails is a real error;
+                    # only a reused socket gets the stale-retry, and
+                    # only when resending cannot double-execute
+                    # non-idempotent server work.  A TIMEOUT is not a
+                    # stale socket: the server is still processing —
+                    # resending would double both the work and the
+                    # effective wait exactly when it is saturated
+                    if fresh or attempt or isinstance(e, TimeoutError) \
+                            or (sent and not idempotent):
+                        raise RuntimeError(
+                            f"{endpoint} failed: {e}") from e
+                    self.reconnects += 1
+                    continue
+                self._conn = conn
+                if status == 200:
+                    return json.loads(data)
+                try:
+                    detail = json.loads(data).get("error", "")
+                except ValueError:
+                    detail = data.decode(errors="replace")
+                if status == 429:
+                    raise ServiceBusyError(
+                        f"{endpoint} rejected: {detail}")
+                raise RuntimeError(f"{endpoint} failed: {detail}")
 
     def lookup(self, keys: List[dict],
                partition: tuple = ()) -> List[Optional[dict]]:
-        payload = self._post("lookup",
-                             {"keys": keys,
-                              "partition": list(partition)}, timeout=30)
+        payload = self._post(
+            "lookup",
+            {"keys": [{k: _encode_value(v) for k, v in d.items()}
+                      for d in keys],
+             "partition": [_encode_value(v) for v in partition]},
+            timeout=30)
         return [None if r is None else
                 {k: _decode_value(v) for k, v in r.items()}
                 for r in payload["rows"]]
@@ -321,7 +557,8 @@ class KvQueryClient:
         `snapshot_id` is reported on a chunk's first page only)."""
         payload = self._post("changelog",
                              {"consumer": consumer,
-                              "max_rows": max_rows}, timeout=60)
+                              "max_rows": max_rows}, timeout=60,
+                             idempotent=False)
         payload["rows"] = [{k: _decode_value(v) for k, v in r.items()}
                            for r in payload["rows"]]
         return payload

@@ -49,15 +49,24 @@ class FileStoreTable:
             if dynamic_options else table_schema
         self.options = CoreOptions(Options(opts))
         if self.options.get(CoreOptions.READ_CACHE_RANGE):
-            from paimon_tpu.fs.caching import CachingFileIO
+            from paimon_tpu.fs.caching import (
+                CachingFileIO, shared_cache_state,
+            )
             if not isinstance(file_io, CachingFileIO):
                 # range-only cache: whole-file capacity 0 keeps
                 # read_bytes pass-through, ranged reads (mosaic
-                # footers/blobs) hit the (path, offset, len) LRU
+                # footers/blobs) hit the (path, offset, len) LRU.
+                # The state is the PROCESS-WIDE shared tier: every
+                # table instance (each table.copy(), every concurrent
+                # serving request) joins one size-bounded cache
+                # instead of warming a private one per read
                 file_io = CachingFileIO(
                     file_io, capacity_bytes=0,
                     range_cache_bytes=self.options.get(
-                        CoreOptions.READ_CACHE_RANGE_MAX_BYTES))
+                        CoreOptions.READ_CACHE_RANGE_MAX_BYTES),
+                    state=shared_cache_state(
+                        0, self.options.get(
+                            CoreOptions.READ_CACHE_RANGE_MAX_BYTES)))
         self.file_io = file_io
         self.branch = branch if branch != "main" else self.options.branch
         self.snapshot_manager = SnapshotManager(file_io, self.path,

@@ -1,0 +1,791 @@
+"""Production query-serving plane (PR 7).
+
+Admission control (service/admission.py): byte budgets NEVER
+oversubscribed under threaded load, bounded queue with timeout -> 429,
+largest-first drain, per-tenant slices, idle anti-stall.  Point-lookup
+hot path (lookup/local_query.py): per-file SST fast path vs the full
+scan oracle across updates/deletes/compaction, snapshot-refresh TTL,
+lazy per-bucket readers surviving unrelated commits, eviction of files
+dropped by compaction, manifest-stats pruning.  Serving integration
+(service/query_service.py): concurrent /lookup + /scan + /changelog
+against a table receiving live commits with no torn batches, keep-alive
+connection reuse + reconnect-on-stale, the shared cross-request cache
+tier, HTTP 429 end-to-end, Prometheus service metrics (line-validated),
+and thread/disk hygiene (tier-1, like tests/test_scan_pipeline.py).
+"""
+
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.service import (
+    AdmissionController, AdmissionRejected, KvQueryClient, KvQueryServer,
+    ServiceBusyError,
+)
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, IntType, VarCharType
+
+
+def _pk_table(path, buckets=2, extra_opts=None):
+    opts = {"bucket": str(buckets), "write-only": "true"}
+    opts.update(extra_opts or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("name", VarCharType.string_type())
+              .primary_key("id")
+              .options(opts)
+              .build())
+    return FileStoreTable.create(path, schema)
+
+
+def _commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    with wb.new_write() as w:
+        w.write_dicts(rows, row_kinds=kinds)
+        wb.new_commit().commit(w.prepare_commit())
+
+
+def _service_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("paimon-query", "paimon-scan"))]
+
+
+def _wait_no_service_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while _service_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return _service_threads()
+
+
+# -- admission control -------------------------------------------------------
+
+class TestAdmission:
+    def test_never_oversubscribed_under_load(self):
+        """The acceptance invariant: with every request within budget,
+        admitted bytes NEVER exceed service.max-inflight-bytes, under
+        heavy threaded contention."""
+        budget = 10_000
+        ctl = AdmissionController(max_bytes=budget, queue_depth=1024,
+                                  queue_timeout_ms=30_000)
+        peak = [0]
+        peak_lock = threading.Lock()
+        errors = []
+
+        def worker(seed):
+            import random
+            rng = random.Random(seed)
+            for _ in range(40):
+                n = rng.randint(1, budget // 2)
+                try:
+                    with ctl.acquire(f"tenant{seed % 3}", n):
+                        got = ctl.inflight_bytes
+                        with peak_lock:
+                            peak[0] = max(peak[0], got)
+                        if got > budget:
+                            errors.append(got)
+                        time.sleep(0.0005)
+                except AdmissionRejected as e:    # pragma: no cover
+                    errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(12)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert errors == []
+        assert 0 < peak[0] <= budget
+        assert ctl.inflight_bytes == 0 and ctl.queued == 0
+
+    def test_queue_timeout_rejects_then_recovers(self):
+        ctl = AdmissionController(max_bytes=100, queue_depth=8,
+                                  queue_timeout_ms=50)
+        big = ctl.acquire("a", 100)
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected):
+            ctl.acquire("a", 50)
+        assert time.monotonic() - t0 >= 0.04
+        big.release()
+        with ctl.acquire("a", 50):
+            pass
+
+    def test_queue_overflow_rejects_immediately(self):
+        ctl = AdmissionController(max_bytes=10, queue_depth=2,
+                                  queue_timeout_ms=5_000)
+        ticket = ctl.acquire("a", 10)
+        waiters = []
+
+        def wait():
+            try:
+                waiters.append(ctl.acquire("a", 5))
+            except AdmissionRejected:
+                pass
+
+        ts = [threading.Thread(target=wait) for _ in range(2)]
+        [t.start() for t in ts]
+        deadline = time.monotonic() + 2
+        while ctl.queued < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected, match="queue full"):
+            ctl.acquire("a", 5)
+        assert time.monotonic() - t0 < 1.0     # immediate, no wait
+        ticket.release()
+        [t.join() for t in ts]
+        for w in waiters:
+            w.release()
+
+    def test_largest_first_drain(self):
+        """Freed capacity drains to the LARGEST waiter first (LPT like
+        parallel/packing.py), not FIFO."""
+        ctl = AdmissionController(max_bytes=100, queue_depth=8,
+                                  queue_timeout_ms=10_000)
+        first = ctl.acquire("a", 100)
+        order = []
+
+        def wait(n, tag):
+            with ctl.acquire("a", n):
+                order.append(tag)
+                time.sleep(0.05)
+
+        small = threading.Thread(target=wait, args=(30, "small"))
+        small.start()
+        deadline = time.monotonic() + 2
+        while ctl.queued < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        large = threading.Thread(target=wait, args=(80, "large"))
+        large.start()
+        while ctl.queued < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        first.release()      # 100 free: large (80) admits first;
+        small.join()         # small (30) must wait for it
+        large.join()
+        assert order == ["large", "small"]
+
+    def test_idle_anti_stall_admits_oversized_request(self):
+        ctl = AdmissionController(max_bytes=10, queue_depth=4,
+                                  queue_timeout_ms=50)
+        with ctl.acquire("a", 10_000) as t1:     # idle: always admitted
+            assert t1.bytes == 10_000
+            with pytest.raises(AdmissionRejected):
+                ctl.acquire("a", 1)              # not idle anymore
+        with ctl.acquire("a", 99_999):
+            pass
+
+    def test_tenant_budget_zero_throttles_to_anti_stall_minimum(self):
+        """service.tenant.max-inflight-bytes=0 is an explicit minimal
+        slice (one request at a time per tenant), NOT the unlimited
+        default a falsy check would silently grant."""
+        ctl = AdmissionController(max_bytes=1000, tenant_max_bytes=0,
+                                  queue_depth=4, queue_timeout_ms=50)
+        with ctl.acquire("a", 10):           # idle tenant: one admitted
+            with pytest.raises(AdmissionRejected):
+                ctl.acquire("a", 10)         # second must wait its turn
+            with ctl.acquire("b", 10):       # other tenants unaffected
+                pass
+        with ctl.acquire("a", 10):
+            pass
+
+    def test_tenant_gauge_cardinality_bounded(self):
+        """Tenant ids come from untrusted request bodies: distinct
+        per-tenant gauge series are capped, folding the tail into
+        __other__ instead of growing the registry without bound."""
+        ctl = AdmissionController(max_bytes=1 << 30, queue_depth=4,
+                                  queue_timeout_ms=50)
+        for i in range(ctl.MAX_TENANT_GAUGES + 50):
+            with ctl.acquire(f"spin-{i}", 1):
+                pass
+        assert len(ctl._tenant_gauges) <= ctl.MAX_TENANT_GAUGES + 1
+        assert "__other__" in ctl._tenant_gauges
+
+    def test_per_tenant_budget_isolated(self):
+        ctl = AdmissionController(max_bytes=100, tenant_max_bytes=40,
+                                  queue_depth=8, queue_timeout_ms=50)
+        a1 = ctl.acquire("a", 40)
+        # tenant a is at its slice: next queues (and times out) ...
+        with pytest.raises(AdmissionRejected):
+            ctl.acquire("a", 20)
+        # ... but tenant b is unaffected
+        with ctl.acquire("b", 40):
+            assert ctl.tenant_inflight("a") == 40
+            assert ctl.tenant_inflight("b") == 40
+        a1.release()
+        assert ctl.tenant_inflight("a") == 0
+
+
+# -- point-lookup hot path ---------------------------------------------------
+
+class TestLookupHotPath:
+    def test_fast_path_matches_oracle_updates_and_deletes(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, [{"id": i, "name": f"v1-{i}"} for i in range(100)])
+        _commit(t, [{"id": i, "name": f"v2-{i}"} for i in range(0, 50, 2)])
+        _commit(t, [{"id": i, "name": "x"} for i in range(10, 30)],
+                kinds=[3] * 20)                      # -D tombstones
+        oracle = {r["id"]: r for r in t.to_arrow().to_pylist()}
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        probes = [{"id": i} for i in range(110)]
+        out = q.lookup(probes)
+        for i, got in enumerate(out):
+            assert got == oracle.get(i), (i, got, oracle.get(i))
+        # per-file SSTs spilled (the fast path ran, not merged buckets)
+        assert any(k.startswith("file|") for k in q.store.keys())
+        assert not any(k.startswith("bucket|") for k in q.store.keys())
+
+    def test_merged_fallback_engines_match_oracle(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", IntType())
+                  .primary_key("id")
+                  .options({"bucket": "1", "write-only": "true",
+                            "merge-engine": "aggregation",
+                            "fields.v.aggregate-function": "sum"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        for _ in range(3):
+            _commit(t, [{"id": i, "v": 1} for i in range(20)])
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        assert q.lookup_row({"id": 7}) == {"id": 7, "v": 3}
+        assert q.lookup_row({"id": 99}) is None
+        assert any(k.startswith("bucket|") for k in q.store.keys())
+
+    def test_snapshot_refresh_ttl_gates_hint_reads(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"), buckets=1)
+        _commit(t, [{"id": i, "name": "a"} for i in range(10)])
+        clock = {"t": 0.0}
+        calls = {"n": 0}
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"),
+                            refresh_interval_ms=1000,
+                            clock=lambda: clock["t"])
+        orig = t.snapshot_manager.latest_snapshot_id
+        t.snapshot_manager.latest_snapshot_id = \
+            lambda: calls.__setitem__("n", calls["n"] + 1) or orig()
+        q.lookup_row({"id": 1})
+        n1 = calls["n"]
+        for _ in range(25):
+            clock["t"] += 30
+            q.lookup_row({"id": 1})
+        assert calls["n"] == n1          # inside the TTL: zero reads
+        clock["t"] += 1500
+        q.lookup_row({"id": 1})
+        assert calls["n"] == n1 + 1      # TTL expired: one read
+        # refresh() bypasses the TTL once (a caller that KNOWS it
+        # committed gets fresh results immediately)
+        _commit(t, [{"id": 1, "name": "fresh"}])
+        q.refresh()
+        assert q.lookup_row({"id": 1})["name"] == "fresh"
+
+    def test_lazy_bucket_readers_survive_unrelated_commits(self, tmp_path):
+        """A commit touching bucket X must not invalidate bucket Y's
+        spilled SSTs (the old refresh() dropped everything)."""
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"), buckets=4)
+        _commit(t, [{"id": i, "name": f"v{i}"} for i in range(200)])
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        out = q.lookup([{"id": i} for i in range(200)])
+        assert all(out[i] is not None for i in range(200))
+        warm = set(q.store.keys())
+        assert warm
+        # one-row commit lands in exactly one bucket
+        _commit(t, [{"id": 0, "name": "updated"}])
+        q.refresh()
+        assert q.lookup_row({"id": 0})["name"] == "updated"
+        after = set(q.store.keys())
+        # every previously-warm per-file SST is still there (old files
+        # are immutable and still referenced) plus >= 1 new file SST
+        assert warm <= after
+        assert len(after) > len(warm)
+
+    def test_compaction_evicts_dropped_file_readers(self, tmp_path):
+        """Satellite regression: readers for files dropped by
+        compaction are evicted — no SSTs (disk) for vanished files."""
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"), buckets=2)
+        for c in range(3):
+            _commit(t, [{"id": i, "name": f"c{c}-{i}"}
+                        for i in range(50)])
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        q.lookup([{"id": i} for i in range(50)])
+        before = set(q.store.keys())
+        assert len(before) >= 2
+        t.copy({"write-only": "false"}).compact(full=True)
+        q.refresh()
+        out = q.lookup([{"id": i} for i in range(50)])
+        assert all(r is not None for r in out)
+        after = set(q.store.keys())
+        assert not (before & after), "stale SSTs for compacted-away files"
+        # on-disk SST count matches the live readers (no orphans)
+        on_disk = [f for f in os.listdir(str(tmp_path / "c"))
+                   if f.endswith(".sst")]
+        assert len(on_disk) == len(after)
+
+    def test_manifest_stats_prune_files_before_io(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        from paimon_tpu.metrics import (
+            LOOKUP_FILES_PRUNED, LOOKUP_READER_BUILDS, global_registry,
+        )
+        t = _pk_table(str(tmp_path / "t"), buckets=1)
+        _commit(t, [{"id": i, "name": "lo"} for i in range(50)])
+        _commit(t, [{"id": i, "name": "hi"} for i in range(1000, 1050)])
+        g = global_registry().lookup_metrics()
+        pruned0 = g.counter(LOOKUP_FILES_PRUNED).count
+        builds0 = g.counter(LOOKUP_READER_BUILDS).count
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        # key in the low file only: the high file's [1000,1049] range
+        # excludes it, so only ONE SST is built (one data-file read)
+        assert q.lookup_row({"id": 25})["name"] == "lo"
+        assert g.counter(LOOKUP_FILES_PRUNED).count > pruned0
+        assert g.counter(LOOKUP_READER_BUILDS).count == builds0 + 1
+
+    def test_empty_merged_bucket_is_negative_cached(self, tmp_path):
+        """A merged-fallback bucket whose merge result is 0 rows (all
+        rows deleted) spills an EMPTY SST — repeated lookups must not
+        re-run the full merge-on-read under the serving lock."""
+        from paimon_tpu.lookup import LocalTableQuery
+        from paimon_tpu.metrics import (
+            LOOKUP_READER_BUILDS, global_registry,
+        )
+        t = _pk_table(str(tmp_path / "t"), buckets=1,
+                      extra_opts={"sequence.field": "id"})  # merged path
+        _commit(t, [{"id": i, "name": "a"} for i in range(10)])
+        _commit(t, [{"id": i, "name": "a"} for i in range(10)],
+                kinds=[3] * 10)
+        assert t.to_arrow().num_rows == 0
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        g = global_registry().lookup_metrics()
+        assert q.lookup_row({"id": 3}) is None
+        builds = g.counter(LOOKUP_READER_BUILDS).count
+        for _ in range(5):
+            assert q.lookup_row({"id": 3}) is None
+        assert g.counter(LOOKUP_READER_BUILDS).count == builds
+
+    def test_concurrent_cold_lookups_build_each_sst_once(self, tmp_path):
+        """Same-key builds dedupe on an in-flight event: N threads
+        racing into a cold bucket cost ONE data-file read per file,
+        not N — and none of them serializes behind a plan lock."""
+        from paimon_tpu.lookup import LocalTableQuery
+        from paimon_tpu.metrics import (
+            LOOKUP_READER_BUILDS, global_registry,
+        )
+        t = _pk_table(str(tmp_path / "t"), buckets=1)
+        for c in range(3):
+            _commit(t, [{"id": i, "name": f"c{c}-{i}"}
+                        for i in range(50)])
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        g = global_registry().lookup_metrics()
+        builds0 = g.counter(LOOKUP_READER_BUILDS).count
+        start = threading.Barrier(8)
+        results = []
+
+        def probe():
+            start.wait()
+            results.append(q.lookup([{"id": i} for i in range(50)]))
+
+        threads = [threading.Thread(target=probe) for _ in range(8)]
+        [x.start() for x in threads]
+        [x.join() for x in threads]
+        assert len(results) == 8
+        for r in results:
+            assert all(r[i] is not None for i in range(50))
+            assert r == results[0]
+        built = g.counter(LOOKUP_READER_BUILDS).count - builds0
+        # 3 commits -> at most 3 per-file SSTs; dedup means the 8
+        # racing threads never multiply that
+        assert 1 <= built <= 3, built
+
+    def test_batch_groups_by_partition_bucket_file(self, tmp_path):
+        """Partitioned batched gets: one call resolves keys across
+        buckets, grouped per (partition, bucket, file)."""
+        from paimon_tpu.lookup import LocalTableQuery
+        schema = (Schema.builder()
+                  .column("pt", IntType(False))
+                  .column("id", BigIntType(False))
+                  .column("name", VarCharType.string_type())
+                  .partition_keys("pt")
+                  .primary_key("pt", "id")
+                  .options({"bucket": "2", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        _commit(t, [{"pt": p, "id": i, "name": f"p{p}-{i}"}
+                    for p in (0, 1) for i in range(40)])
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"))
+        out = q.lookup([{"pt": 1, "id": i} for i in range(50)],
+                       partition=(1,))
+        for i in range(40):
+            assert out[i]["name"] == f"p1-{i}"
+        assert all(r is None for r in out[40:])
+
+
+# -- serving integration -----------------------------------------------------
+
+class TestServing:
+    def test_keep_alive_reuses_connection_and_reconnects(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, [{"id": i, "name": f"n{i}"} for i in range(50)])
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(t) as c:
+                for i in range(30):
+                    assert c.lookup_row({"id": i})["name"] == f"n{i}"
+                assert c.reconnects == 0, "keep-alive not reused"
+                # stale socket: next request transparently reconnects
+                c._conn.sock.close()
+                assert c.lookup_row({"id": 3})["name"] == "n3"
+                assert c.reconnects == 1
+        finally:
+            server.stop()
+
+    def test_concurrent_mixed_serving_with_live_commits(self, tmp_path):
+        """N threads mixing /lookup, /scan and /changelog while the
+        table receives live commits.  Every commit writes ONE version
+        to all keys, so a torn lookup batch (part old snapshot, part
+        new) would show mixed versions — asserted never to happen —
+        and each client's observed version never goes backwards."""
+        keys = list(range(40))
+        t = _pk_table(str(tmp_path / "t"),
+                      extra_opts={"service.lookup.refresh-interval": "20"})
+        _commit(t, [{"id": i, "name": "v0"} for i in keys])
+        server = KvQueryServer(t).start()
+        stop = threading.Event()
+        errors = []
+        committed = [0]
+
+        def committer():
+            v = 0
+            while not stop.is_set() and v < 15:
+                v += 1
+                _commit(t, [{"id": i, "name": f"v{v}"} for i in keys])
+                committed[0] = v
+                time.sleep(0.02)
+
+        def lookup_client(n):
+            try:
+                with KvQueryClient(t) as c:
+                    last = -1
+                    while not stop.is_set():
+                        rows = c.lookup([{"id": i} for i in keys])
+                        versions = {r["name"] for r in rows
+                                    if r is not None}
+                        if len(versions) != 1:
+                            errors.append(f"torn batch: {versions}")
+                            return
+                        v = int(versions.pop()[1:])
+                        if v < last:
+                            errors.append(f"went backwards {last}->{v}")
+                            return
+                        last = v
+            except Exception as e:      # noqa: BLE001
+                errors.append(repr(e))
+
+        def scan_client(n):
+            try:
+                with KvQueryClient(t) as c:
+                    while not stop.is_set():
+                        rows = c.scan(limit=len(keys))
+                        if rows:
+                            versions = {r["name"] for r in rows}
+                            # a scan is one committed snapshot too
+                            if len(versions) != 1:
+                                errors.append(
+                                    f"torn scan: {versions}")
+                                return
+            except Exception as e:      # noqa: BLE001
+                errors.append(repr(e))
+
+        def changelog_client(n):
+            try:
+                with KvQueryClient(t) as c:
+                    while not stop.is_set():
+                        c.changelog(consumer=f"c{n}", max_rows=500)
+                        time.sleep(0.01)
+            except Exception as e:      # noqa: BLE001
+                errors.append(repr(e))
+
+        workers = ([threading.Thread(target=lookup_client, args=(i,))
+                    for i in range(3)]
+                   + [threading.Thread(target=scan_client, args=(i,))
+                      for i in range(2)]
+                   + [threading.Thread(target=changelog_client,
+                                       args=(i,)) for i in range(2)])
+        committer_t = threading.Thread(target=committer)
+        [w.start() for w in workers]
+        committer_t.start()
+        committer_t.join()
+        time.sleep(0.2)                 # let clients observe the tail
+        stop.set()
+        [w.join(timeout=30) for w in workers]
+        server.stop()
+        assert errors == []
+        assert committed[0] >= 15
+        assert not _wait_no_service_threads(), "leaked serving threads"
+
+    def test_server_stop_cleans_sst_disk(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, [{"id": i, "name": "x"} for i in range(30)])
+        server = KvQueryServer(t).start()
+        with KvQueryClient(t) as c:
+            c.lookup_row({"id": 1})
+        q = server.query()
+        sst_dir = q.store.dir
+        assert any(f.endswith(".sst") for f in os.listdir(sst_dir))
+        server.stop()
+        assert not any(f.endswith(".sst") for f in os.listdir(sst_dir))
+
+    def test_shared_cache_tier_is_cross_instance(self, tmp_path):
+        """table.copy() instances and servers share ONE process-wide
+        byte-cache state: warm entries from one instance serve the
+        next (tentpole 1)."""
+        from paimon_tpu.fs.caching import CachingFileIO
+        t = _pk_table(str(tmp_path / "t"), buckets=1)
+        _commit(t, [{"id": i, "name": "x"} for i in range(100)])
+        a = t.copy({"read.cache.range": "true"})
+        b = t.copy({"read.cache.range": "true"})
+        assert isinstance(a.file_io, CachingFileIO)
+        assert a.file_io is not b.file_io
+        assert a.file_io.state is b.file_io.state     # ONE tier
+        # the server joins the same tier
+        server = KvQueryServer(t)
+        assert isinstance(server.table.file_io, CachingFileIO)
+        assert server.table.file_io.state is a.file_io.state
+        server.httpd.server_close()
+
+    def test_snapshot_advance_evicts_dropped_files_from_shared_tier(
+            self, tmp_path):
+        from paimon_tpu.fs.caching import shared_cache_state
+        t = _pk_table(str(tmp_path / "t"), buckets=1,
+                      extra_opts={"service.lookup.refresh-interval": "0"})
+        _commit(t, [{"id": i, "name": "x"} for i in range(50)])
+        _commit(t, [{"id": i, "name": "y"} for i in range(50)])
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(t) as c:
+                c.lookup_row({"id": 1})
+                state = shared_cache_state()
+                old_files = {f.file_name
+                             for s in server.query()._splits.values()
+                             for f in s.data_files}
+                # seed the shared tier with the current data files
+                for s in server.query()._splits.values():
+                    for f in s.data_files:
+                        server.table.file_io.read_bytes(
+                            server.query()._data_path(s, f))
+                cached = {p for p in state.cache}
+                assert any(n in p for p in cached for n in old_files)
+                t.copy({"write-only": "false"}).compact(full=True)
+                c.lookup_row({"id": 1})    # refresh observes the drop
+                left = {p for p in state.cache
+                        if any(n in p for n in old_files)}
+                assert left == set(), \
+                    "shared tier kept entries for compacted-away files"
+        finally:
+            server.stop()
+
+    def test_admission_429_end_to_end(self, tmp_path):
+        from paimon_tpu.metrics import SERVICE_REJECTED, global_registry
+        t = _pk_table(str(tmp_path / "t"), extra_opts={
+            "service.max-inflight-bytes": "1",
+            "service.queue.depth": "1",
+            "service.queue.timeout": "50"})
+        _commit(t, [{"id": i, "name": "x"} for i in range(2000)])
+        server = KvQueryServer(t).start()
+        rejected0 = global_registry().service_metrics(t.name) \
+            .counter(SERVICE_REJECTED).count
+        busy = [0]
+
+        def hammer():
+            with KvQueryClient(address=server.address) as c:
+                for _ in range(6):
+                    try:
+                        c.scan(limit=2000)
+                    except ServiceBusyError:
+                        busy[0] += 1
+
+        try:
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(6)]
+            [x.start() for x in threads]
+            [x.join() for x in threads]
+        finally:
+            server.stop()
+        assert busy[0] > 0
+        assert global_registry().service_metrics(t.name) \
+            .counter(SERVICE_REJECTED).count >= rejected0 + busy[0]
+
+    def test_prometheus_exposes_service_metrics(self, tmp_path):
+        """Line-by-line validation (tests/test_obs.py style): the new
+        service/lookup families are declared with correct kinds and
+        every sample parses, including the per-tenant gauge."""
+        prom_sample = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+]+$")
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, [{"id": i, "name": "x"} for i in range(50)])
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(t, tenant="alice") as c:
+                c.lookup([{"id": i} for i in range(10)])
+                c.scan(limit=5)
+                c.changelog(consumer="p")
+            with urllib.request.urlopen(
+                    f"{server.address}/metrics", timeout=30) as resp:
+                assert resp.status == 200
+                body = resp.read().decode()
+        finally:
+            server.stop()
+        lines = [ln for ln in body.splitlines() if ln]
+        declared = {}
+        for ln in lines:
+            if ln.startswith("# TYPE "):
+                fam, kind = ln[len("# TYPE "):].rsplit(" ", 1)
+                assert kind in ("counter", "gauge", "summary"), ln
+                declared[fam] = kind
+            else:
+                assert prom_sample.match(ln), f"invalid sample: {ln!r}"
+        assert declared.get("paimon_service_requests") == "counter"
+        assert declared.get("paimon_service_rejected") == "counter"
+        assert declared.get("paimon_service_queue_depth") == "gauge"
+        assert declared.get("paimon_service_inflight_bytes") == "gauge"
+        assert declared.get(
+            "paimon_service_tenant_inflight_bytes") == "gauge"
+        assert declared.get(
+            "paimon_service_admission_wait_ms") == "summary"
+        assert declared.get("paimon_service_lookup_ms") == "summary"
+        assert declared.get("paimon_service_scan_ms") == "summary"
+        assert declared.get("paimon_service_changelog_ms") == "summary"
+        assert declared.get("paimon_lookup_block_cache_hits") == "counter"
+        assert declared.get(
+            "paimon_lookup_block_cache_misses") == "counter"
+        assert declared.get("paimon_lookup_reader_builds") == "counter"
+        assert declared.get("paimon_lookup_files_pruned") == "counter"
+        # the per-tenant gauge carries the tenant as its label
+        assert any(ln.startswith(
+            'paimon_service_tenant_inflight_bytes{table="alice"}')
+            for ln in lines), "per-tenant gauge series missing"
+
+    def test_failed_snapshot_check_is_not_ttl_cached(self, tmp_path):
+        """A transient FS failure during the snapshot check must raise
+        on EVERY lookup until it heals — stamping the TTL before the
+        load would serve all-miss answers from the never-loaded plan
+        for the rest of the window."""
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"), buckets=1)
+        _commit(t, [{"id": 1, "name": "a"}])
+        q = LocalTableQuery(t, cache_dir=str(tmp_path / "c"),
+                            refresh_interval_ms=60_000,
+                            clock=lambda: 0.0)
+        orig = t.snapshot_manager.latest_snapshot_id
+        t.snapshot_manager.latest_snapshot_id = \
+            lambda: (_ for _ in ()).throw(OSError("fs outage"))
+        with pytest.raises(OSError):
+            q.lookup_row({"id": 1})
+        with pytest.raises(OSError):     # still erroring, not all-miss
+            q.lookup_row({"id": 1})
+        t.snapshot_manager.latest_snapshot_id = orig
+        assert q.lookup_row({"id": 1}) == {"id": 1, "name": "a"}
+
+    def test_partition_values_survive_the_wire(self, tmp_path):
+        """Typed partition values (date) are tagged-encoded like key
+        values — a raw json.dumps would raise TypeError client-side."""
+        import datetime
+        from paimon_tpu.types import DateType
+        schema = (Schema.builder()
+                  .column("dt", DateType(False))
+                  .column("id", BigIntType(False))
+                  .column("name", VarCharType.string_type())
+                  .partition_keys("dt")
+                  .primary_key("dt", "id")
+                  .options({"bucket": "1", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        d = datetime.date(2026, 8, 3)
+        _commit(t, [{"dt": d, "id": i, "name": f"n{i}"}
+                    for i in range(5)])
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(t) as c:
+                row = c.lookup_row({"dt": d, "id": 3}, partition=(d,))
+                assert row == {"dt": d, "id": 3, "name": "n3"}
+        finally:
+            server.stop()
+
+    def test_changelog_delta_charge_parks_plan_across_429(self, tmp_path):
+        """Materializing a snapshot delta is charged at its on-disk
+        bytes; a 429 parks the plan so the consumer retries WITHOUT
+        losing the snapshot's rows (the stream scan has already
+        advanced past it)."""
+        t = _pk_table(str(tmp_path / "t"), buckets=1, extra_opts={
+            "service.max-inflight-bytes": "64",
+            "service.queue.depth": "0",
+            "service.queue.timeout": "50"})
+        _commit(t, [{"id": i, "name": "x" * 50} for i in range(500)])
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(address=server.address) as c:
+                # max_rows=1: the poll ticket is tiny (256B, admitted
+                # idle), so the snapshot's multi-KB on-disk delta
+                # charge is what must queue — and 429
+                with pytest.raises(ServiceBusyError):
+                    c.changelog(consumer="budget", max_rows=1)
+                # the plan is parked, not dropped
+                assert server._streams["budget"]["plan"] is not None
+                # capacity recovers (operator raised the budget):
+                # the SAME snapshot's rows arrive on retry
+                server.admission.max_bytes = 1 << 30
+                got = []
+                while True:
+                    cl = c.changelog(consumer="budget", max_rows=200)
+                    got.extend(cl["rows"])
+                    if cl["caught_up"]:
+                        break
+                assert len(got) == 500, "changelog rows were lost"
+        finally:
+            server.stop()
+
+    def test_serve_bench_smoke(self):
+        """benchmarks/serve_bench emits the cold/warm/engine/QPS lines
+        (tests/test_micro_bench.py style); the warm-vs-cold ratio and
+        the latency percentiles ride in the JSON."""
+        import json
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, SERVE_ROWS="20000", SERVE_CLIENTS="8",
+                   SERVE_SECONDS="1", JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo)
+        proc = subprocess.run(
+            [sys.executable, "-m", "benchmarks.serve_bench"],
+            capture_output=True, text=True, cwd=repo, env=env,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [json.loads(line) for line in proc.stdout.splitlines()]
+        by_name = {d["benchmark"]: d for d in lines}
+        assert {"serving_cold_point_lookup",
+                "serving_warm_point_lookup_p50",
+                "serving_engine_point_lookup", "serving_qps",
+                "serving_point_lookup_p95_ms"} <= set(by_name)
+        assert by_name["serving_warm_point_lookup_p50"][
+            "warm_vs_cold"] > 1
+        assert by_name["serving_qps"]["value"] > 0
+        assert by_name["serving_point_lookup_p95_ms"]["value"] > 0
+
+    def test_non_pk_table_serves_scan_but_rejects_lookup(self, tmp_path):
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("name", VarCharType.string_type())
+                  .options({"bucket": "-1"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        _commit(t, [{"id": i, "name": "x"} for i in range(10)])
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(address=server.address) as c:
+                assert len(c.scan(limit=5)) == 5
+                with pytest.raises(RuntimeError, match="primary-key"):
+                    c.lookup_row({"id": 1})
+        finally:
+            server.stop()
