@@ -557,6 +557,84 @@ def write_child_main():
     print(json.dumps(out))
 
 
+def tier_child_main():
+    """BENCH_TIER_CHILD=1 mode: the tiered-storage benchmark (ISSUE
+    8's hot paths — cold scan / warm SSD re-scan / staged-upload
+    ingest against a latency-injected object store at 0/10/50ms,
+    untiered vs tiered, row identity asserted).  Prints one JSON line
+    for the parent."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks.tier_bench import measure
+
+    rows = int(os.environ.get("BENCH_TIER_ROWS", "300000"))
+    out = measure(rows=rows, emit=None)
+    from paimon_tpu.metrics import global_registry
+    snap = global_registry().snapshot()
+    out["metrics_snapshot"] = {
+        k: v for k, v in snap.items() if k.startswith("cache_disk")}
+    print(json.dumps(out))
+
+
+def run_tier_child(timeout):
+    """Run tier_child_main in a CPU subprocess; parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(BENCH_TIER_CHILD="1", JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=_REPO, text=True,
+                              capture_output=True,
+                              timeout=max(30.0, timeout))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench tier child: timeout\n")
+        return None
+    if proc.returncode != 0:
+        sys.stderr.write(f"bench tier child rc={proc.returncode}:\n"
+                         f"{proc.stderr[-4000:]}\n")
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sys.stderr.write(f"bench tier child: unparseable output\n"
+                         f"{proc.stdout[-2000:]}\n")
+        return None
+
+
+def compose_tier(result):
+    """The tiered-storage metric block attached under "tiered_storage"
+    in the one official JSON line: warm-SSD-re-scan speedup at the
+    highest injected latency + staged-ingest ratio vs the zero-latency
+    baseline at the lowest >=10ms point, with the full 0/10/50ms
+    matrix nested (see benchmarks/tier_bench.py on why each criterion
+    is read at the point that stresses it)."""
+    if result is None:
+        return None
+    acc = result.get("acceptance") or {}
+    w_lat = acc.get("warm_rescan_at_ms")
+    i_lat = acc.get("ingest_at_ms")
+    wr = result["latencies"].get(str(w_lat), {})
+    ir = result["latencies"].get(str(i_lat), {})
+    return {
+        "metric": "tiered_warm_rescan_speedup",
+        "value": acc.get("warm_rescan_speedup", 0.0),
+        "unit": (f"x cold-scan at {w_lat}ms/op injected store latency "
+                 f"({result['rows']} rows, {result['buckets']} "
+                 f"buckets; warm SSD re-scan "
+                 f"{wr.get('warm_scan_tiered_s')}s vs cold "
+                 f"{wr.get('cold_scan_tiered_s')}s, seeded "
+                 f"post-ingest scan {wr.get('seeded_scan_tiered_s')}s;"
+                 f" staged ingest at {i_lat}ms "
+                 f"{ir.get('ingest_tiered_s')}s = "
+                 f"{acc.get('ingest_vs_zero_latency')}x the 0ms "
+                 f"untiered baseline ({result.get('ingest_rows')} "
+                 f"rows), vs inline {ir.get('ingest_untiered_s')}s; "
+                 f"identical={wr.get('identical')})"),
+        "ingest_vs_zero_latency": acc.get("ingest_vs_zero_latency"),
+        "latencies": result["latencies"],
+        "metrics_snapshot": result.get("metrics_snapshot"),
+    }
+
+
 def run_write_child(rows, timeout):
     """Run write_child_main in a CPU subprocess; parsed JSON or None."""
     env = dict(os.environ)
@@ -911,6 +989,19 @@ def main():
             _BANKED["json"] = final
         sys.stderr.write(f"bench: write metric {wr}, "
                          f"remaining {_remaining():.0f}s\n")
+
+    # tiered-storage metric (ISSUE 8's hot paths): the whole 3-latency
+    # child (300k-row scan tables + best-of-2 10M-row ingest pairs) is
+    # ~200s wall measured in-env (the 50ms column + ingest reps
+    # dominate); banked incrementally
+    if _remaining() > 260:
+        tr = compose_tier(run_tier_child(timeout=_remaining() - 30))
+        if tr is not None:
+            final["tiered_storage"] = tr
+            _BANKED["json"] = final
+        sys.stderr.write(f"bench: tier metric "
+                         f"{None if tr is None else tr['value']}, "
+                         f"remaining {_remaining():.0f}s\n")
     _emit_and_exit()
 
 
@@ -929,6 +1020,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if os.environ.get("BENCH_WRITE_CHILD") == "1":
         write_child_main()
+        sys.exit(0)
+    if os.environ.get("BENCH_TIER_CHILD") == "1":
+        tier_child_main()
         sys.exit(0)
     try:
         main()

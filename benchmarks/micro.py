@@ -285,6 +285,16 @@ def bench_serve():
     measure_serving(rows=min(ROWS, 200_000), clients=16, seconds=2.0)
 
 
+def bench_tier():
+    """Tiered host-SSD storage trajectory (full 0/10/50ms matrix in
+    benchmarks/tier_bench.py; this entry keeps the 10ms point — warm
+    SSD re-scan vs cold, staged vs inline ingest — in the micro
+    record)."""
+    from benchmarks.tier_bench import measure
+    measure(rows=min(ROWS, 100_000), ingest_rows=min(ROWS, 400_000),
+            latencies=[0, 10])
+
+
 BENCHES = {
     "read_parquet": lambda: bench_read("parquet"),
     "read_orc": lambda: bench_read("orc"),
@@ -296,6 +306,7 @@ BENCHES = {
     "scan": bench_scan,
     "obs": bench_obs,
     "serve": bench_serve,
+    "tier": bench_tier,
 }
 
 

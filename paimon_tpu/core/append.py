@@ -196,6 +196,8 @@ class AppendOnlyFileStoreWrite:
                  table_schema: TableSchema, options: CoreOptions,
                  restore_max_seq: Optional[Callable[[Tuple, int], int]]
                  = None):
+        from paimon_tpu.parallel.write_pipeline import maybe_wrap_staging
+        file_io, self._stager = maybe_wrap_staging(file_io, options)
         self.file_io = file_io
         self.schema = table_schema
         self.options = options
@@ -278,12 +280,18 @@ class AppendOnlyFileStoreWrite:
             msg = w.take_commit_message()
             if msg is not None:
                 out.append(msg)
+        if self._stager is not None:
+            # durability barrier: all staged uploads acked before any
+            # commit message leaves (see core/write.py)
+            self._stager.drain()
         return out
 
     def close(self):
         if self._flush_pool is not None:
             self._flush_pool.shutdown(wait=True)
             self._flush_pool = None
+        if self._stager is not None:
+            self._stager.close()
         self._writers.clear()
 
 

@@ -608,6 +608,8 @@ class KeyValueFileStoreWrite:
                  = None, branch: str = "main",
                  bucket_files_map: Optional[Callable[[], Dict]]
                  = None, schema_manager=None):
+        from paimon_tpu.parallel.write_pipeline import maybe_wrap_staging
+        file_io, self._stager = maybe_wrap_staging(file_io, options)
         self.file_io = file_io
         self.table_path = table_path
         self.schema = table_schema
@@ -854,6 +856,13 @@ class KeyValueFileStoreWrite:
                 else:
                     out.append(CommitMessage((), 0, self.total_buckets,
                                              index_entries=entries))
+        if self._stager is not None:
+            # durability barrier LAST: every file a message names must
+            # be acked by the object store before the caller may commit
+            # (staged uploads overlapped all the sorting/encoding and
+            # the compaction above; an upload failure raises here and
+            # poisons the stager — commit nothing, close the writer)
+            self._stager.drain()
         return out
 
     def _maybe_compact(self, msg: CommitMessage, existing_map: Dict):
@@ -889,6 +898,12 @@ class KeyValueFileStoreWrite:
             # uploads become orphans for maintenance)
             self._flush_pool.shutdown(wait=True)
             self._flush_pool = None
+        if self._stager is not None:
+            # after the flush pool: no worker stages once we shut the
+            # upload pool; abandoned staged files are removed with the
+            # stage dir (their half-done uploads are orphans, like
+            # abandoned inline uploads)
+            self._stager.close()
         for w in self._writers.values():
             w._drop_spills()         # aborted writes must not leak /tmp
         self._writers.clear()

@@ -35,6 +35,7 @@ from paimon_tpu.fs.fileio import (
 
 __all__ = ["ObjectStoreBackend", "LocalObjectStoreBackend",
            "ObjectStoreFileIO", "FlakyObjectStoreBackend",
+           "LatencyInjectingObjectStoreBackend",
            "RetryingObjectStoreBackend", "TransientStoreError"]
 
 
@@ -217,6 +218,64 @@ class FlakyObjectStoreBackend(ObjectStoreBackend):
                 self.stats["ambiguous"] += 1
                 raise TransientStoreError(f"503 AFTER delete {key}")
         return ok
+
+
+class LatencyInjectingObjectStoreBackend(ObjectStoreBackend):
+    """Latency-injecting wrapper: every backend call sleeps a
+    configurable base + seeded jitter first, so benches and tests can
+    model a REAL object store's per-request round trip (tens of ms)
+    instead of local-disk timings — the difference the host-SSD cache
+    tier and staged uploads exist to hide (benchmarks/tier_bench.py).
+
+    `base_ms` is either one number for every op or a per-op dict keyed
+    by 'put'/'get'/'head'/'list'/'delete' (missing ops pay 0, so e.g.
+    only PUTs can be made slow).  Composable with
+    FlakyObjectStoreBackend in either order: Flaky(Latency(store))
+    charges the round trip before the 503 fires, like a real timeout.
+    Thread-safe: the seeded rng is locked, sleeps happen outside."""
+
+    def __init__(self, inner: ObjectStoreBackend, base_ms=10.0,
+                 jitter_ms: float = 0.0, seed: int = 0):
+        import random
+        self.inner = inner
+        self.base_ms = base_ms
+        self.jitter_ms = jitter_ms
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.stats = {"delayed_calls": 0, "delay_ms_total": 0.0}
+
+    def _delay(self, op: str):
+        import time
+        base = self.base_ms.get(op, 0.0) \
+            if isinstance(self.base_ms, dict) else self.base_ms
+        with self._lock:
+            wait = base + (self._rng.random() * self.jitter_ms
+                           if self.jitter_ms else 0.0)
+            self.stats["delayed_calls"] += 1
+            self.stats["delay_ms_total"] += wait
+        if wait > 0:
+            time.sleep(wait / 1000.0)
+
+    def put(self, key: str, data: bytes, if_none_match: bool = False):
+        self._delay("put")
+        return self.inner.put(key, data, if_none_match=if_none_match)
+
+    def get(self, key: str, offset: int = 0,
+            length: Optional[int] = None) -> bytes:
+        self._delay("get")
+        return self.inner.get(key, offset, length)
+
+    def head(self, key: str) -> Optional[int]:
+        self._delay("head")
+        return self.inner.head(key)
+
+    def list(self, prefix: str) -> List[Tuple[str, int]]:
+        self._delay("list")
+        return self.inner.list(prefix)
+
+    def delete(self, key: str) -> bool:
+        self._delay("delete")
+        return self.inner.delete(key)
 
 
 class RetryingObjectStoreBackend(ObjectStoreBackend):

@@ -41,7 +41,11 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "SERVICE_CHANGELOG_MS", "SERVICE_LOOKUP_KEYS",
            "LOOKUP_BLOCK_CACHE_HITS", "LOOKUP_BLOCK_CACHE_MISSES",
            "LOOKUP_READER_BUILDS", "LOOKUP_READER_REUSES",
-           "LOOKUP_FILES_PRUNED", "LOOKUP_SNAPSHOT_REFRESHES"]
+           "LOOKUP_FILES_PRUNED", "LOOKUP_SNAPSHOT_REFRESHES",
+           "CACHE_DISK_HITS", "CACHE_DISK_MISSES",
+           "CACHE_DISK_PROMOTIONS", "CACHE_DISK_DEMOTIONS",
+           "CACHE_DISK_EVICTIONS", "CACHE_DISK_BYTES",
+           "CACHE_DISK_STAGED_UPLOADS", "CACHE_DISK_STAGE_MS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -135,6 +139,23 @@ LOOKUP_READER_BUILDS = "reader_builds"        # SSTs built (file reads)
 LOOKUP_READER_REUSES = "reader_reuses"        # SSTs served warm
 LOOKUP_FILES_PRUNED = "files_pruned"          # skipped by stats, no IO
 LOOKUP_SNAPSHOT_REFRESHES = "snapshot_refreshes"  # plan reloads
+
+# tiered host-SSD storage counter/gauge/histogram names (cache_disk
+# metric group; producers in fs/caching.py DiskCacheTier + the
+# UploadStager in parallel/write_pipeline.py, consumers
+# benchmarks/tier_bench.py + tests + dashboards).  promotions are
+# memory->disk writes earned by repeated hits, demotions are entries
+# pushed to disk by memory-LRU pressure (or too large for memory),
+# evictions are disk entries dropped by the max-bytes bound OR failed
+# validation (wipe/truncate/bit-flip degrades to the object store).
+CACHE_DISK_HITS = "hits"                      # served from SSD
+CACHE_DISK_MISSES = "misses"                  # disk tier consulted, absent
+CACHE_DISK_PROMOTIONS = "promotions"          # hit-earned mem->disk writes
+CACHE_DISK_DEMOTIONS = "demotions"            # pressure-driven mem->disk
+CACHE_DISK_EVICTIONS = "evictions"            # bound/validation drops
+CACHE_DISK_BYTES = "bytes"                    # gauge: on-disk bytes now
+CACHE_DISK_STAGED_UPLOADS = "staged_uploads"  # uploads acked from stage
+CACHE_DISK_STAGE_MS = "stage_ms"              # one encode->staged-fsync
 
 
 class Counter:
@@ -323,6 +344,11 @@ class MetricRegistry:
     def lookup_metrics(self, table: str = "") -> MetricGroup:
         """Point-lookup plane (ours; lookup/)."""
         return self.group("lookup", table)
+
+    def cache_disk_metrics(self, table: str = "") -> MetricGroup:
+        """Tiered host-SSD storage plane (ours; fs/caching.py disk
+        tier + the write path's staged uploads)."""
+        return self.group("cache_disk", table)
 
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind

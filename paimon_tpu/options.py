@@ -471,6 +471,47 @@ class CoreOptions:
         "Base wait between bucket-flush retries; actual waits use "
         "capped decorrelated jitter (utils/backoff.py)")
 
+    # -- tiered host-SSD storage (ours; fs/caching.py + fs/staging.py +
+    #    parallel/write_pipeline.py UploadStager) ----------------------------
+    CACHE_DISK_DIR = ConfigOption(
+        "cache.disk.dir", str, None,
+        "Directory of the host-SSD second cache tier under the "
+        "in-memory byte caches (fs/caching.py DiskCacheTier): whole-"
+        "file and block-range entries are promoted here on repeated "
+        "hits or memory demotion and served on memory miss, each "
+        "validated by a stored key/length/crc32 header so a stale or "
+        "corrupted cache dir degrades to the object store instead of "
+        "serving wrong bytes.  One tier per directory per process; "
+        "None disables the disk tier")
+    CACHE_DISK_MAX_BYTES = ConfigOption(
+        "cache.disk.max-bytes", parse_memory_size, 1 << 30,
+        "Hard bound on the on-disk bytes of the cache.disk.dir tier; "
+        "space is reserved under the tier lock before any entry file "
+        "is written, so concurrent readers can never overshoot it "
+        "(oldest entries evict first)")
+    CACHE_DISK_PROMOTE_HITS = ConfigOption(
+        "cache.disk.promote-after-hits", int, 2,
+        "In-memory hits of one entry after which it is also written "
+        "to the disk tier (so a later memory demotion costs nothing); "
+        "entries evicted from memory under pressure are demoted to "
+        "disk regardless of hit count")
+    WRITE_STAGE_DIR = ConfigOption(
+        "write.stage.dir", str, None,
+        "When set, flush workers encode data/changelog files to a "
+        "staged local file here (fsync'd), publish their metas, and "
+        "hand the object-store upload to an async upload pool — "
+        "upload retries re-read the staged bytes instead of "
+        "re-sorting/re-encoding, and a completed upload seeds the "
+        "cache.disk read tier.  prepare_commit() still waits for "
+        "every object-store ack (the commit durability contract is "
+        "unchanged); None = the legacy inline upload path")
+    WRITE_STAGE_PARALLELISM = ConfigOption(
+        "write.stage.parallelism", int, None,
+        "Worker threads uploading staged files concurrently; None = "
+        "min(8, cpu count).  More workers hide more object-store "
+        "latency since staged uploads are independent PUTs to "
+        "writer-unique names")
+
     # -- observability (ours; paimon_tpu/obs/) -------------------------------
     METRICS_ENABLED = ConfigOption(
         "metrics.enabled", _parse_bool, True,

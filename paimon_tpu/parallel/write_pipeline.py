@@ -52,8 +52,9 @@ from typing import Callable, Dict, Optional
 
 from paimon_tpu.options import CoreOptions
 
-__all__ = ["FlushPool", "flush_retrying", "lpt_order",
-           "resolve_flush_parallelism"]
+__all__ = ["FlushPool", "UploadStager", "flush_retrying", "lpt_order",
+           "maybe_wrap_staging", "resolve_flush_parallelism",
+           "resolve_stage_parallelism"]
 
 
 def lpt_order(groups):
@@ -76,6 +77,35 @@ def resolve_flush_parallelism(options: Optional[CoreOptions]) -> int:
     if par is None:
         par = min(8, os.cpu_count() or 1)
     return max(1, int(par))
+
+
+def resolve_stage_parallelism(options: Optional[CoreOptions]) -> int:
+    """Upload workers for staged uploads: write.stage.parallelism,
+    defaulting to min(8, cpu count).  Uploads are independent PUTs to
+    writer-unique names, so width here directly hides store latency."""
+    par = None
+    if options is not None:
+        par = options.get(CoreOptions.WRITE_STAGE_PARALLELISM)
+    if par is None:
+        par = min(8, os.cpu_count() or 1)
+    return max(1, int(par))
+
+
+def maybe_wrap_staging(file_io, options: Optional[CoreOptions]):
+    """(file_io, stager-or-None): when write.stage.dir is set, build
+    the writer's UploadStager and wrap its FileIO in a StagingFileIO —
+    the ONE construction point shared by the pk and append file-store
+    writes (flush workers then encode to local SSD + fsync, the upload
+    pool owns the store PUTs, and the writer drains the stager LAST in
+    prepare_commit to keep the durability contract)."""
+    stage_dir = options.get(CoreOptions.WRITE_STAGE_DIR) \
+        if options is not None else None
+    if not stage_dir:
+        return file_io, None
+    from paimon_tpu.fs.staging import StagingFileIO
+    stager = UploadStager(stage_dir, resolve_stage_parallelism(options),
+                          options)
+    return StagingFileIO(file_io, stager), stager
 
 
 def flush_retrying(fn: Callable[[], object],
@@ -339,3 +369,235 @@ class FlushPool:
                     self._inflight_tasks -= 1
                     self._g_inflight.set(self._inflight_bytes)
                     self._cond.notify_all()
+
+
+class UploadStager:
+    """Local-SSD staging between the flush workers and the object
+    store (write.stage.dir; "A Host-SSD Collaborative Write
+    Accelerator for LSM-Tree-Based KV Stores", arxiv 2410.21760).
+
+    `stage(inner, path, data)` writes `data` to a staged local file
+    (tmp + atomic replace on the flush worker; the upload worker
+    fsyncs it just before the PUT, so "fsync, then upload" holds
+    without the sync riding the per-bucket actor's critical path),
+    registers it so reads of `path` can be served from the staged
+    bytes while the upload is in flight (fs/staging.StagingFileIO — compaction re-reading a fresh
+    L0 file inside prepare_commit never waits on the store), and hands
+    the object-store PUT to a bounded upload pool.  Consequences:
+
+    * the flush worker returns after the local fsync — encode and
+      upload overlap even WITHIN one bucket (the per-bucket actor only
+      serializes sort/encode/stage, not the PUTs);
+    * an upload retry (write.retry.*) re-reads the staged bytes — it
+      never re-sorts or re-encodes;
+    * a completed upload seeds the host-SSD read tier
+      (fs/caching.seed_read_cache): newly written files are the
+      hottest reads;
+    * `drain()` is the durability barrier: prepare_commit() calls it
+      LAST, so by the time commit messages leave the writer every file
+      they name is acked by the object store — the commit contract is
+      byte-identical to the inline-upload path.
+
+    Error policy mirrors FlushPool: the first upload error is latched,
+    later stage() calls fail fast, drain() re-raises it with the
+    stager poisoned (cancelled uploads' files are unrecoverable — the
+    writer must be closed and replaced)."""
+
+    def __init__(self, stage_dir: str, parallelism: int,
+                 options: Optional[CoreOptions] = None):
+        import uuid
+        self.parallelism = max(1, int(parallelism))
+        self.options = options
+        # one private subdir per stager: concurrent writers sharing
+        # write.stage.dir never collide, close() can rmtree safely
+        self.dir = os.path.join(stage_dir, f"stage-{uuid.uuid4().hex}")
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: Dict[str, str] = {}      # final path -> staged
+        self._inflight = 0
+        self._error: Optional[BaseException] = None
+        self._poisoned: Optional[BaseException] = None
+        self._pool = None
+        self._shut = False
+        self.staged = 0                          # observability (tests)
+        from paimon_tpu.metrics import (
+            CACHE_DISK_STAGED_UPLOADS, global_registry,
+        )
+        self._c_uploads = global_registry().cache_disk_metrics() \
+            .counter(CACHE_DISK_STAGED_UPLOADS)
+
+    def accepts(self, path: str) -> bool:
+        """Only immutable-named files (uuid'd data/changelog/index
+        blobs) stage; mutable refs must hit the store synchronously."""
+        from paimon_tpu.fs.caching import _cacheable
+        return _cacheable(path)
+
+    def stage(self, inner, path: str, data: bytes):
+        """Durably stage `data` for `path` and schedule its upload.
+        Called from flush workers; raises the latched upload error (if
+        any) so a failing store surfaces at the next flush instead of
+        only at the barrier."""
+        import uuid
+
+        from paimon_tpu.metrics import CACHE_DISK_STAGE_MS
+        from paimon_tpu.obs.trace import span
+        with self._cond:
+            self._check_poisoned()
+            if self._error is not None:
+                raise self._error
+        staged = os.path.join(self.dir, f"{uuid.uuid4().hex}.staged")
+
+        def _write_staged():
+            # plain atomic write on the FLUSH worker (tmp+replace so
+            # pending-read racers never see a torn file); the fsync
+            # happens on the UPLOAD worker just before the PUT —
+            # "fsync, then upload" holds, but the sync cost rides the
+            # wide upload pool instead of the per-bucket actor's
+            # critical path
+            tmp = f"{staged}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, staged)
+
+        with span("io.stage", cat="io", group="cache_disk",
+                  metric=CACHE_DISK_STAGE_MS, path=path,
+                  bytes=len(data)):
+            try:
+                _write_staged()
+            except OSError:
+                # stage dir wiped mid-run: recreate once, else degrade
+                # to the inline upload (staging is an accelerator, a
+                # broken local disk must not fail the write)
+                try:
+                    os.makedirs(self.dir, exist_ok=True)
+                    _write_staged()
+                except OSError:
+                    inner.write_bytes(path, data, overwrite=False)
+                    return
+        with self._cond:
+            self._pending[path] = staged
+            self._inflight += 1
+            self.staged += 1
+        self._ensure_pool().submit(self._upload, inner, path, staged)
+
+    def pending_bytes(self, path: str) -> Optional[bytes]:
+        """The staged bytes of a not-yet-acked upload, or None.  Racing
+        an upload completion is safe: the staged file is unlinked only
+        AFTER the store acked and the path left `_pending`, so a lost
+        race falls back to the store, which now has the file."""
+        with self._lock:
+            staged = self._pending.get(path)
+        if staged is None:
+            return None
+        try:
+            with open(staged, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def pending_size(self, path: str) -> Optional[int]:
+        with self._lock:
+            staged = self._pending.get(path)
+        if staged is None:
+            return None
+        try:
+            return os.path.getsize(staged)
+        except OSError:
+            return None
+
+    def _upload(self, inner, path: str, staged: str):
+        ok = False
+        try:
+            # fsync BEFORE the PUT (deferred from stage(): the staged
+            # bytes must be on stable storage before any object-store
+            # ack can reference them), then re-read the STAGED bytes
+            # (not a closure capture): the retry contract — and crash
+            # evidence — live on local SSD
+            with open(staged, "rb") as f:
+                os.fsync(f.fileno())
+                data = f.read()
+
+            def attempt():
+                try:
+                    inner.write_bytes(path, data, overwrite=False)
+                except FileExistsError:
+                    # ambiguous earlier attempt landed (error after
+                    # effect); byte-equality identifies our write —
+                    # data-file payloads are writer-unique (uuid names)
+                    if inner.read_bytes(path) == data:
+                        return
+                    raise
+
+            flush_retrying(attempt, self.options, what="staged upload")
+            from paimon_tpu.fs.caching import (
+                CachingFileIO, seed_read_cache,
+            )
+            # seed the tier this writer's table actually READS: the
+            # staged wrapper sits over the table's own CachingFileIO,
+            # whose state may be private rather than the shared one
+            seed_read_cache(path, data,
+                            state=inner.state
+                            if isinstance(inner, CachingFileIO)
+                            else None)
+            self._c_uploads.inc()
+            ok = True
+        except BaseException as e:      # noqa: BLE001 — latched
+            with self._cond:
+                if self._error is None:
+                    self._error = e
+        finally:
+            with self._cond:
+                self._pending.pop(path, None)
+                self._inflight -= 1
+                self._cond.notify_all()
+            if ok:
+                try:
+                    os.unlink(staged)
+                except OSError:
+                    pass
+
+    def drain(self):
+        """The durability barrier: wait for every staged upload's ack;
+        re-raise the first upload error with the stager poisoned."""
+        with self._cond:
+            self._check_poisoned()
+            while self._inflight > 0:
+                if self._shut:
+                    # close(cancel_futures) left queued uploads that
+                    # will never run their finally — fail fast instead
+                    # of waiting on an _inflight that cannot drop
+                    raise RuntimeError(
+                        "UploadStager is shut down with uploads "
+                        "cancelled; nothing to drain")
+                self._cond.wait(timeout=0.5)
+            if self._error is not None:
+                err, self._error = self._error, None
+                self._poisoned = err
+                raise err
+
+    def _check_poisoned(self):
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "staged uploads failed earlier; close this writer and "
+                "retry with a fresh one") from self._poisoned
+
+    def _ensure_pool(self):
+        with self._lock:
+            if self._pool is None:
+                if self._shut:
+                    raise RuntimeError("UploadStager is shut down")
+                from paimon_tpu.parallel.executors import new_thread_pool
+                self._pool = new_thread_pool(self.parallelism,
+                                             "paimon-stage")
+            return self._pool
+
+    def close(self):
+        import shutil
+        with self._cond:
+            self._shut = True
+            pool, self._pool = self._pool, None
+            self._cond.notify_all()      # wake any drain() to fail fast
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
+        shutil.rmtree(self.dir, ignore_errors=True)

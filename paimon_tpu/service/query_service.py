@@ -185,7 +185,9 @@ class KvQueryServer:
         — and every other server/table in the process — serves warms
         one bounded cache (tentpole 1: per-read scope -> process-wide
         shared tier)."""
-        from paimon_tpu.fs.caching import CachingFileIO, shared_cache_state
+        from paimon_tpu.fs.caching import (
+            CachingFileIO, shared_cache_state, shared_disk_tier,
+        )
         # grow the shared tier FIRST: a table already wrapped by
         # read.cache.range rides the shared state with whole-file
         # capacity 0 — the serving plane's whole-file tier must turn
@@ -193,6 +195,16 @@ class KvQueryServer:
         state = shared_cache_state(
             256 << 20,
             table.options.get(CoreOptions.READ_CACHE_RANGE_MAX_BYTES))
+        disk_dir = table.options.get(CoreOptions.CACHE_DISK_DIR)
+        if disk_dir:
+            # the serving plane rides the host-SSD second tier too:
+            # memory-LRU demotions land on disk and cold requests are
+            # answered from SSD before the object store
+            state.attach_disk(
+                shared_disk_tier(disk_dir, table.options.get(
+                    CoreOptions.CACHE_DISK_MAX_BYTES)),
+                promote_hits=table.options.get(
+                    CoreOptions.CACHE_DISK_PROMOTE_HITS))
         if isinstance(table.file_io, CachingFileIO):
             # already caching (shared state grown above if it rides
             # it; an explicitly-constructed private wrapper keeps its

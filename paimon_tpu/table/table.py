@@ -48,10 +48,14 @@ class FileStoreTable:
         self.schema = table_schema.copy(opts) \
             if dynamic_options else table_schema
         self.options = CoreOptions(Options(opts))
-        if self.options.get(CoreOptions.READ_CACHE_RANGE):
+        disk_dir = self.options.get(CoreOptions.CACHE_DISK_DIR)
+        if self.options.get(CoreOptions.READ_CACHE_RANGE) or disk_dir:
             from paimon_tpu.fs.caching import (
-                CachingFileIO, shared_cache_state,
+                CachingFileIO, shared_cache_state, shared_disk_tier,
             )
+            range_bytes = self.options.get(
+                CoreOptions.READ_CACHE_RANGE_MAX_BYTES) \
+                if self.options.get(CoreOptions.READ_CACHE_RANGE) else 0
             if not isinstance(file_io, CachingFileIO):
                 # range-only cache: whole-file capacity 0 keeps
                 # read_bytes pass-through, ranged reads (mosaic
@@ -59,14 +63,20 @@ class FileStoreTable:
                 # The state is the PROCESS-WIDE shared tier: every
                 # table instance (each table.copy(), every concurrent
                 # serving request) joins one size-bounded cache
-                # instead of warming a private one per read
+                # instead of warming a private one per read.  With
+                # cache.disk.dir set, memory misses (capacity 0 means
+                # every whole-file read) demote to the host-SSD tier
+                # and are served from it before the object store
                 file_io = CachingFileIO(
                     file_io, capacity_bytes=0,
-                    range_cache_bytes=self.options.get(
-                        CoreOptions.READ_CACHE_RANGE_MAX_BYTES),
-                    state=shared_cache_state(
-                        0, self.options.get(
-                            CoreOptions.READ_CACHE_RANGE_MAX_BYTES)))
+                    range_cache_bytes=range_bytes,
+                    state=shared_cache_state(0, range_bytes))
+            if disk_dir:
+                file_io.state.attach_disk(
+                    shared_disk_tier(disk_dir, self.options.get(
+                        CoreOptions.CACHE_DISK_MAX_BYTES)),
+                    promote_hits=self.options.get(
+                        CoreOptions.CACHE_DISK_PROMOTE_HITS))
         self.file_io = file_io
         self.branch = branch if branch != "main" else self.options.branch
         self.snapshot_manager = SnapshotManager(file_io, self.path,

@@ -1,0 +1,611 @@
+"""Tiered host-SSD storage: disk cache tier + staged uploads.
+
+Covers the ISSUE 8 safety matrix: a wiped/truncated/bit-flipped cache
+dir mid-scan and mid-ingest must degrade to the object store with
+results identical to an uncached run (and fsck clean); the disk tier
+must never exceed cache.disk.max-bytes even under concurrent load;
+staged uploads must retry from the staged bytes (never re-encode),
+surface failures at the prepare_commit barrier, keep the commit
+durability contract, and seed the read tier.
+"""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from paimon_tpu.fs.caching import (
+    ByteCacheState, CachingFileIO, DiskCacheTier, evict_dropped_file,
+    reset_disk_tiers, shared_cache_state,
+)
+from paimon_tpu.fs.fileio import LocalFileIO
+from paimon_tpu.fs.object_store import (
+    FlakyObjectStoreBackend, LatencyInjectingObjectStoreBackend,
+    LocalObjectStoreBackend, ObjectStoreBackend, ObjectStoreFileIO,
+    TransientStoreError,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+ROWS = 50_000
+
+
+@pytest.fixture(autouse=True)
+def _reset_tiers():
+    """Shared disk tiers point at per-test tmpdirs: they must never
+    outlive the test (a later table joining the shared state would
+    resurrect a deleted directory)."""
+    yield
+    reset_disk_tiers()
+
+
+def _data(rows=ROWS, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "id": pa.array(rng.permutation(rows), pa.int64()),
+        "v": pa.array(rng.random(rows), pa.float64()),
+    })
+
+
+def _schema(extra=None):
+    opts = {"bucket": "2", "write-only": "true",
+            "write-buffer-size": "256 kb"}
+    opts.update(extra or {})
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .primary_key("id")
+            .options(opts)
+            .build())
+
+
+def _ingest(table, data=None, chunks=5):
+    data = data if data is not None else _data()
+    wb = table.new_batch_write_builder()
+    per = data.num_rows // chunks
+    with wb.new_write() as w:
+        for i in range(chunks):
+            w.write_arrow(data.slice(i * per, per))
+        wb.new_commit().commit(w.prepare_commit())
+    return data
+
+
+class CountingBackend(ObjectStoreBackend):
+    """Counts per-op calls, keyed coarsely by object class."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.counts = {}
+        self._lock = threading.Lock()
+
+    def _note(self, op, key):
+        name = key.rsplit("/", 1)[-1]
+        kind = "data" if name.startswith("data-") else "other"
+        with self._lock:
+            self.counts[(op, kind)] = self.counts.get((op, kind), 0) + 1
+
+    def put(self, key, data, if_none_match=False):
+        self._note("put", key)
+        return self.inner.put(key, data, if_none_match=if_none_match)
+
+    def get(self, key, offset=0, length=None):
+        self._note("get", key)
+        return self.inner.get(key, offset, length)
+
+    def head(self, key):
+        return self.inner.head(key)
+
+    def list(self, prefix):
+        return self.inner.list(prefix)
+
+    def delete(self, key):
+        return self.inner.delete(key)
+
+    def data_gets(self):
+        with self._lock:
+            return self.counts.get(("get", "data"), 0)
+
+
+def _obj_table(tmp, name, extra=None, backend_wrap=None):
+    backend = LocalObjectStoreBackend(os.path.join(tmp, f"bucket_{name}"))
+    if backend_wrap is not None:
+        backend = backend_wrap(backend)
+    fio = ObjectStoreFileIO(backend, scheme=f"{name}://")
+    table = FileStoreTable.create(f"{name}://t", _schema(extra),
+                                  file_io=fio)
+    return table, backend, fio
+
+
+# -- DiskCacheTier unit behavior ---------------------------------------------
+
+def test_disk_tier_roundtrip_and_validation(tmp_path):
+    t = DiskCacheTier(str(tmp_path / "c"), 1 << 20)
+    key = t.file_key("data-abc")
+    assert t.put(key, b"payload" * 100)
+    assert t.get(key) == b"payload" * 100
+    assert t.get(t.file_key("data-missing")) is None
+
+    # truncate -> validation miss, entry dropped
+    entry = glob.glob(str(tmp_path / "c" / "*.pce"))[0]
+    blob = open(entry, "rb").read()
+    open(entry, "wb").write(blob[:len(blob) // 2])
+    assert t.get(key) is None
+    assert len(t) == 0
+
+    # bit-flip -> crc miss
+    assert t.put(key, b"payload" * 100)
+    entry = glob.glob(str(tmp_path / "c" / "*.pce"))[0]
+    blob = bytearray(open(entry, "rb").read())
+    blob[-1] ^= 0xFF
+    open(entry, "wb").write(bytes(blob))
+    assert t.get(key) is None
+
+    # wrong-key content (a renamed/aliased entry file) never serves
+    assert t.put(t.file_key("data-x"), b"X" * 50)
+    src = glob.glob(str(tmp_path / "c" / "*.pce"))[0]
+    t2 = DiskCacheTier(str(tmp_path / "c2"), 1 << 20)
+    alias = t2._entry_file(t2.file_key("data-y"))
+    os.makedirs(os.path.dirname(alias), exist_ok=True)
+    open(alias, "wb").write(open(src, "rb").read())
+    t2._index[t2.file_key("data-y")] = (alias, os.path.getsize(alias))
+    assert t2.get(t2.file_key("data-y")) is None
+
+
+def test_disk_tier_adoption_across_restart(tmp_path):
+    d = str(tmp_path / "c")
+    t = DiskCacheTier(d, 1 << 20)
+    t.put(t.file_key("data-a"), b"A" * 100)
+    t.put(t.range_key("data-b", 10, 20), b"B" * 20)
+    # a fresh tier over the same dir adopts (and still validates) the
+    # surviving entries — staged-upload seeding survives restarts
+    t2 = DiskCacheTier(d, 1 << 20)
+    assert len(t2) == 2
+    assert t2.get(t2.file_key("data-a")) == b"A" * 100
+    assert t2.get(t2.range_key("data-b", 10, 20)) == b"B" * 20
+    # junk and crash-orphaned put() tmps are removed, not adopted (an
+    # uncounted tmp would breach the max-bytes bound across restarts)
+    open(os.path.join(d, "junk.pce"), "wb").write(b"not an entry")
+    open(os.path.join(d, ".deadbeef.tmp"), "wb").write(b"x" * 1000)
+    t3 = DiskCacheTier(d, 1 << 20)
+    assert len(t3) == 2
+    assert not os.path.exists(os.path.join(d, "junk.pce"))
+    assert not os.path.exists(os.path.join(d, ".deadbeef.tmp"))
+
+
+def test_promote_on_repeated_hits_and_demote_on_pressure(tmp_path):
+    inner = LocalFileIO()
+    big = tmp_path / "data-big.parquet"
+    small = tmp_path / "data-small.parquet"
+    big.write_bytes(b"B" * 600)
+    small.write_bytes(b"s" * 300)
+    st = ByteCacheState(capacity_bytes=700, range_cache_bytes=0)
+    st.attach_disk(DiskCacheTier(str(tmp_path / "c"), 1 << 20),
+                   promote_hits=2)
+    fio = CachingFileIO(inner, capacity_bytes=700, state=st)
+    disk = st.disk
+
+    assert fio.read_bytes(str(big)) == b"B" * 600      # miss -> memory
+    assert disk.get(disk.file_key(str(big))) is None   # 0 hits: not yet
+    fio.read_bytes(str(big))                           # hit 1
+    assert disk.get(disk.file_key(str(big))) is None
+    fio.read_bytes(str(big))                           # hit 2 -> promote
+    assert disk.get(disk.file_key(str(big))) == b"B" * 600
+
+    # inserting `small` overflows the 700-byte memory LRU -> `big` is
+    # demoted (already on disk) and `small`'s later eviction demotes it
+    fio.read_bytes(str(small))
+    assert str(big) not in st.cache
+    fio.read_bytes(str(big))      # comes back via the DISK tier, no
+    os.unlink(small)              # inner read; and small demoted when
+    assert disk.get(disk.file_key(str(small))) == b"s" * 300
+    assert fio.read_bytes(str(small)) == b"s" * 300    # store gone: SSD
+
+
+def test_wipe_cache_dir_mid_run_degrades(tmp_path):
+    inner = LocalFileIO()
+    f = tmp_path / "data-f.parquet"
+    f.write_bytes(b"x" * 1000)
+    st = ByteCacheState(capacity_bytes=0)
+    st.attach_disk(DiskCacheTier(str(tmp_path / "c"), 1 << 20))
+    fio = CachingFileIO(inner, capacity_bytes=0, state=st)
+    assert fio.read_bytes(str(f)) == b"x" * 1000
+    assert st.disk.get(st.disk.file_key(str(f))) is not None
+    import shutil
+    shutil.rmtree(tmp_path / "c")           # wipe mid-run
+    assert fio.read_bytes(str(f)) == b"x" * 1000   # degraded to store
+    # and the tier heals: the dir is recreated for later entries
+    assert fio.read_bytes(str(f)) == b"x" * 1000
+
+
+# -- scan path end-to-end ----------------------------------------------------
+
+def test_scan_rides_ssd_tier_and_matches_uncached(tmp_path):
+    reference, _, _ = _obj_table(str(tmp_path), "ref")
+    expected = _ingest(reference)
+
+    table, backend, fio = _obj_table(
+        str(tmp_path), "tier",
+        extra={"cache.disk.dir": str(tmp_path / "ssd")},
+        backend_wrap=CountingBackend)
+    _ingest(table)
+
+    cold = table.to_arrow().sort_by("id")
+    gets_after_cold = backend.data_gets()
+    assert gets_after_cold > 0
+    warm = table.to_arrow().sort_by("id")
+    # warm re-scan: every data file served from the SSD tier
+    assert backend.data_gets() == gets_after_cold
+    ref_rows = reference.to_arrow().sort_by("id")
+    assert cold.equals(ref_rows) and warm.equals(ref_rows)
+    assert expected.num_rows == cold.num_rows
+
+
+def _purge_memory_tier(table):
+    """Drop the shared state's MEMORY entries only (the whole-file
+    capacity may have been grown by earlier tests in the process —
+    e.g. the serving plane's 256MB — which would otherwise serve reads
+    before the disk tier this test exercises)."""
+    st = table.file_io.state
+    with st.lock:
+        st.cache.clear()
+        st.ranges.clear()
+        st.size = st.range_size = 0
+
+
+def test_corrupt_ssd_entries_mid_scan_identical_and_fsck_clean(tmp_path):
+    table, backend, fio = _obj_table(
+        str(tmp_path), "corr",
+        extra={"cache.disk.dir": str(tmp_path / "ssd")})
+    _ingest(table)
+    baseline = table.to_arrow().sort_by("id")
+    # two more scans earn hit-based promotion (miss, hit 1, hit 2 ->
+    # promote) even when a grown shared MEMORY tier absorbed the first
+    # read; then purge memory so the corrupted re-scan must go
+    # disk -> store
+    table.to_arrow()
+    table.to_arrow()
+    _purge_memory_tier(table)
+
+    entries = sorted(glob.glob(str(tmp_path / "ssd" / "*.pce")))
+    assert entries, "scan did not populate the SSD tier"
+    # truncate one, bit-flip another, delete a third
+    blob = open(entries[0], "rb").read()
+    open(entries[0], "wb").write(blob[:max(1, len(blob) // 3)])
+    if len(entries) > 1:
+        blob = bytearray(open(entries[1], "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(entries[1], "wb").write(bytes(blob))
+    if len(entries) > 2:
+        os.unlink(entries[2])
+
+    again = table.to_arrow().sort_by("id")
+    assert again.equals(baseline)
+    assert table.fsck().ok
+    # a full wipe mid-run degrades too
+    import shutil
+    shutil.rmtree(tmp_path / "ssd")
+    _purge_memory_tier(table)
+    assert table.to_arrow().sort_by("id").equals(baseline)
+
+
+def test_evict_dropped_file_evicts_both_tiers(tmp_path):
+    inner = LocalFileIO()
+    f = tmp_path / "data-g.parquet"
+    f.write_bytes(b"g" * 500)
+    st = shared_cache_state(0, 0)
+    from paimon_tpu.fs.caching import shared_disk_tier
+    # promote_hits=1: the entry reaches disk on its first memory HIT
+    # even when an earlier test grew the shared memory capacity (with
+    # capacity 0 the first MISS already demotes it to disk)
+    st.attach_disk(shared_disk_tier(str(tmp_path / "c"), 1 << 20),
+                   promote_hits=1)
+    fio = CachingFileIO(inner, capacity_bytes=0, state=st)
+    fio.read_bytes(str(f))
+    fio.read_bytes(str(f))
+    assert st.disk.get(st.disk.file_key(str(f))) is not None
+    evict_dropped_file(str(f))
+    # miss (the get above re-warmed LRU order only; eviction dropped it)
+    assert st.disk.get(st.disk.file_key(str(f))) is None
+
+
+# -- max-bytes hygiene under concurrency -------------------------------------
+
+def test_disk_tier_never_exceeds_max_bytes_concurrent(tmp_path):
+    """8 threads hammer a 64KB tier with ~200 distinct 2KB files; a
+    sampler asserts the on-disk entry bytes never exceed the bound at
+    any observed instant."""
+    inner = LocalFileIO()
+    files = []
+    for i in range(200):
+        p = tmp_path / f"data-{i:03d}.bin"
+        p.write_bytes(os.urandom(2048))
+        files.append(str(p))
+    max_bytes = 64 << 10
+    st = ByteCacheState(capacity_bytes=0)
+    st.attach_disk(DiskCacheTier(str(tmp_path / "c"), max_bytes))
+    fio = CachingFileIO(inner, capacity_bytes=0, state=st)
+
+    stop = threading.Event()
+    errors = []
+    peaks = [0]
+
+    def reader(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                fio.read_bytes(files[int(rng.integers(len(files)))])
+        except Exception as e:              # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    def sampler():
+        while not stop.is_set():
+            total = 0
+            for p in glob.glob(str(tmp_path / "c" / "*.pce")):
+                try:
+                    total += os.path.getsize(p)
+                except OSError:
+                    pass
+            peaks[0] = max(peaks[0], total)
+            if total > max_bytes:
+                errors.append(AssertionError(
+                    f"disk tier exceeded its bound: {total} > "
+                    f"{max_bytes}"))
+                stop.set()
+
+    threads = [threading.Thread(target=reader, args=(i,),
+                                name=f"tier-r{i}") for i in range(8)]
+    threads.append(threading.Thread(target=sampler, name="tier-sampler"))
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors, errors
+    assert st.disk.total_bytes <= max_bytes
+    assert peaks[0] > 0, "sampler never saw a populated tier"
+
+
+# -- staged uploads ----------------------------------------------------------
+
+def test_staged_ingest_identical_and_durable(tmp_path):
+    reference, _, _ = _obj_table(str(tmp_path), "sref")
+    _ingest(reference)
+
+    table, backend, fio = _obj_table(
+        str(tmp_path), "stag",
+        extra={"write.stage.dir": str(tmp_path / "stage")})
+    _ingest(table)
+    # durability: every committed data file is IN THE STORE (readable
+    # through a fresh FileIO with no stager attached)
+    fresh = FileStoreTable.load("stag://t", file_io=fio)
+    assert fresh.to_arrow().sort_by("id").equals(
+        reference.to_arrow().sort_by("id"))
+    assert fresh.fsck().ok
+    # no staged leftovers once writers closed
+    assert glob.glob(str(tmp_path / "stage" / "*" / "*")) == []
+
+
+def test_staged_upload_retries_reread_staged_bytes(tmp_path):
+    # every data-file PUT 503s twice before landing: uploads retry
+    # (from the staged bytes) until acked; each data file is staged
+    # EXACTLY once — a re-encode would stage again
+    class StormyPuts(ObjectStoreBackend):
+        def __init__(self, inner):
+            self.inner = inner
+            self.attempts = {}
+            self.injected = 0
+            self._lock = threading.Lock()
+
+        def put(self, key, data, if_none_match=False):
+            if key.rsplit("/", 1)[-1].startswith("data-"):
+                with self._lock:
+                    n = self.attempts.get(key, 0) + 1
+                    self.attempts[key] = n
+                    if n <= 2:
+                        self.injected += 1
+                        raise TransientStoreError(f"503 on put {key}")
+            return self.inner.put(key, data,
+                                  if_none_match=if_none_match)
+
+        def get(self, key, offset=0, length=None):
+            return self.inner.get(key, offset, length)
+
+        def head(self, key):
+            return self.inner.head(key)
+
+        def list(self, prefix):
+            return self.inner.list(prefix)
+
+        def delete(self, key):
+            return self.inner.delete(key)
+
+    table, backend, fio = _obj_table(
+        str(tmp_path), "flaky",
+        extra={"write.stage.dir": str(tmp_path / "stage"),
+               "write.retry.max-attempts": "5",
+               "write.retry.backoff": "1 ms"},
+        backend_wrap=StormyPuts)
+    wb = table.new_batch_write_builder()
+    data = _data(20_000)
+    with wb.new_write() as w:
+        w.write_arrow(data)
+        msgs = w.prepare_commit()
+        stager = w._write._stager
+        n_files = sum(len(m.new_files) for m in msgs)
+        assert n_files > 0
+        assert stager.staged == n_files      # one stage per file, ever
+        wb.new_commit().commit(msgs)
+    assert backend.injected >= 2 * n_files, "storm never fired"
+    assert table.to_arrow().sort_by("id").equals(data.sort_by("id"))
+
+
+def test_staged_upload_failure_surfaces_at_barrier(tmp_path):
+    class DeadPuts(ObjectStoreBackend):
+        def __init__(self, inner):
+            self.inner = inner
+
+        def put(self, key, data, if_none_match=False):
+            if "data-" in key.rsplit("/", 1)[-1]:
+                raise TransientStoreError("503 forever")
+            return self.inner.put(key, data,
+                                  if_none_match=if_none_match)
+
+        def get(self, key, offset=0, length=None):
+            return self.inner.get(key, offset, length)
+
+        def head(self, key):
+            return self.inner.head(key)
+
+        def list(self, prefix):
+            return self.inner.list(prefix)
+
+        def delete(self, key):
+            return self.inner.delete(key)
+
+    table, backend, fio = _obj_table(
+        str(tmp_path), "dead",
+        extra={"write.stage.dir": str(tmp_path / "stage"),
+               "write.retry.max-attempts": "2",
+               "write.retry.backoff": "1 ms"},
+        backend_wrap=DeadPuts)
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    try:
+        w.write_arrow(_data(20_000))
+        with pytest.raises(TransientStoreError):
+            w.prepare_commit()
+        # the stager is poisoned: a retried prepare on the same writer
+        # must refuse instead of committing with files missing
+        with pytest.raises(RuntimeError, match="close this writer"):
+            w.prepare_commit()
+    finally:
+        w.close()
+    # nothing was committed
+    assert table.snapshot_manager.latest_snapshot() is None
+
+
+def test_staged_upload_seeds_read_tier(tmp_path):
+    table, backend, fio = _obj_table(
+        str(tmp_path), "seed",
+        extra={"write.stage.dir": str(tmp_path / "stage"),
+               "cache.disk.dir": str(tmp_path / "ssd")},
+        backend_wrap=CountingBackend)
+    data = _ingest(table)
+    # the upload seeded the SSD tier: the first scan after ingest needs
+    # ZERO object-store GETs for data files
+    assert backend.data_gets() == 0
+    rows = table.to_arrow().sort_by("id")
+    assert backend.data_gets() == 0
+    assert rows.equals(data.sort_by("id"))
+
+
+def test_staged_upload_seeds_private_state_tier(tmp_path):
+    """A table riding a PRIVATE ByteCacheState (explicitly wrapped
+    FileIO) must seed ITS tier, not the process-shared one."""
+    backend = LocalObjectStoreBackend(str(tmp_path / "bucket"))
+    inner = ObjectStoreFileIO(backend, scheme="priv://")
+    st = ByteCacheState(capacity_bytes=0)
+    st.attach_disk(DiskCacheTier(str(tmp_path / "ssd"), 1 << 20))
+    wrapped = CachingFileIO(inner, capacity_bytes=0, state=st)
+    table = FileStoreTable.create(
+        "priv://t",
+        _schema({"write.stage.dir": str(tmp_path / "stage")}),
+        file_io=wrapped)
+    data = _ingest(table, _data(20_000))
+    keys = [k for k in st.disk._index if k.startswith("F|")]
+    assert keys, "upload did not seed the private state's disk tier"
+    assert table.to_arrow().sort_by("id").equals(data.sort_by("id"))
+
+
+def test_range_reads_reach_whole_file_seeds(tmp_path):
+    """With the range-only memory shape (whole-file capacity 0), a
+    ranged read must still be served from a whole-file SSD entry —
+    sliced, with the slice cached as a range entry so the full entry
+    is not re-read for the same range."""
+    inner = LocalFileIO()
+    f = tmp_path / "data-r.bin"
+    f.write_bytes(bytes(range(256)) * 100)
+    st = ByteCacheState(capacity_bytes=0, range_cache_bytes=0)
+    st.attach_disk(DiskCacheTier(str(tmp_path / "c"), 1 << 20))
+    fio = CachingFileIO(inner, capacity_bytes=0, state=st)
+    # seed the whole file (what a staged upload does)
+    st.disk.put(st.disk.file_key(str(f)), f.read_bytes())
+    os.unlink(f)                       # store gone: only SSD can serve
+    got = fio.read_range(str(f), 256, 256)
+    assert got == bytes(range(256))
+    # the slice is now its own range entry
+    assert st.disk.get(st.disk.range_key(str(f), 256, 256)) == got
+    # vectored path too
+    out = fio.read_ranges(str(f), [(0, 16), (512, 16)])
+    assert out[0] == bytes(range(16)) and out[1] == bytes(range(16))
+
+
+def test_mid_ingest_wipes_degrade_and_stay_exact(tmp_path):
+    table, backend, fio = _obj_table(
+        str(tmp_path), "wipe",
+        extra={"write.stage.dir": str(tmp_path / "stage"),
+               "cache.disk.dir": str(tmp_path / "ssd")})
+    data = _data()
+    wb = table.new_batch_write_builder()
+    per = data.num_rows // 5
+    import shutil
+    with wb.new_write() as w:
+        for i in range(5):
+            w.write_arrow(data.slice(i * per, per))
+            if i == 2:
+                # wipe BOTH local tiers mid-ingest: the cache degrades,
+                # staged uploads that already acked are unaffected, and
+                # in-flight staging recreates its dir
+                shutil.rmtree(tmp_path / "ssd", ignore_errors=True)
+        wb.new_commit().commit(w.prepare_commit())
+    assert table.to_arrow().sort_by("id").equals(data.sort_by("id"))
+    assert table.fsck().ok
+
+
+# -- latency injection -------------------------------------------------------
+
+def test_latency_injecting_backend():
+    import time
+
+    class Instant(ObjectStoreBackend):
+        def put(self, key, data, if_none_match=False):
+            pass
+
+        def get(self, key, offset=0, length=None):
+            return b"x"
+
+        def head(self, key):
+            return 1
+
+        def list(self, prefix):
+            return []
+
+        def delete(self, key):
+            return True
+
+    be = LatencyInjectingObjectStoreBackend(
+        Instant(), base_ms={"get": 30.0}, jitter_ms=0.0, seed=1)
+    t0 = time.perf_counter()
+    be.get("k")
+    assert time.perf_counter() - t0 >= 0.028
+    t0 = time.perf_counter()
+    be.put("k", b"")                       # not in the dict -> 0 delay
+    assert time.perf_counter() - t0 < 0.02
+    assert be.stats["delayed_calls"] == 2
+    assert be.stats["delay_ms_total"] == 30.0
+
+    # composable with the fault injector: the round trip is charged
+    # before the 503 fires
+    flaky = FlakyObjectStoreBackend(
+        LatencyInjectingObjectStoreBackend(
+            Instant(), base_ms=5.0, seed=2),
+        seed=2, fail_rate=1.0)
+    with pytest.raises(TransientStoreError):
+        flaky.get("k")
