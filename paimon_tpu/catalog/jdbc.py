@@ -79,7 +79,8 @@ class JdbcCatalog(Catalog):
             except sqlite3.OperationalError:
                 with self._tx():
                     self._conn.rollback()
-                time.sleep(0.02)
+                from paimon_tpu.utils.backoff import wait_for
+                wait_for(0.02, what="jdbc catalog lock")
                 continue
             except sqlite3.IntegrityError:
                 with self._tx():
@@ -93,7 +94,8 @@ class JdbcCatalog(Catalog):
                 if stale:
                     self._release_lock(name)
                 else:
-                    time.sleep(0.02)
+                    from paimon_tpu.utils.backoff import wait_for
+                    wait_for(0.02, what="jdbc catalog lock")
         raise TimeoutError(f"catalog lock {name!r} busy")
 
     def _release_lock(self, name: str):

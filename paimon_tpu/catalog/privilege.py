@@ -92,7 +92,8 @@ class PrivilegeManager:
                     return
                 finally:
                     self.file_io.delete_quietly(lock)
-            time.sleep(0.01)
+            from paimon_tpu.utils.backoff import wait_for
+            wait_for(0.01, what="privilege file lock")
         raise TimeoutError("privilege file lock busy")
 
     def enabled(self) -> bool:

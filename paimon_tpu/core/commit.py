@@ -208,6 +208,7 @@ class FileStoreCommit:
 
         from paimon_tpu.obs.trace import span as _span, sync_from_options
         from paimon_tpu.utils.backoff import Backoff
+        from paimon_tpu.utils.deadline import DeadlineExceededError
 
         sync_from_options(self.options)
         _metrics = global_registry().group("commit")
@@ -224,195 +225,238 @@ class FileStoreCommit:
         new_manifest: Optional[ManifestFileMeta] = None
         changelog_manifest: Optional[ManifestFileMeta] = None
         entries_orig = list(entries)
-        while True:
-            if _attempts > _max_retries or \
-                    (_attempts > 0 and _backoff.budget_exhausted()):
-                # the per-attempt cleanup keeps the (reusable) delta and
-                # changelog manifest FILES; on giving up they would be
-                # orphaned with no snapshot referencing them
-                for m in (new_manifest, changelog_manifest):
-                    if m is not None:
-                        self.file_io.delete_quietly(
-                            self.manifest_file.path(m.file_name))
-                raise CommitConflictError(
-                    f"Commit lost the snapshot CAS race "
-                    f"{_attempts - 1} times (commit.max-retries="
-                    f"{_max_retries}, commit.timeout); giving up")
-            if _attempts > 0:
-                with _span("commit.backoff", cat="commit",
-                           attempt=_attempts, table=self.table_path):
-                    _backoff.pause()
-            _attempts += 1
-            latest = self.snapshot_manager.latest_snapshot()
-            if expected_latest_id is not ... and \
-                    (latest.id if latest else None) != expected_latest_id:
-                # the caller's plan is stale (e.g. deletion vectors built
-                # against an older snapshot): surface a conflict so it can
-                # replan instead of silently losing concurrent changes
-                raise CommitConflictError(
-                    f"Snapshot advanced past "
-                    f"{expected_latest_id} before commit; replan required")
-            if entries_fn is not None:
-                # delete/add set depends on the latest snapshot (e.g.
-                # overwrite): recompute per attempt; per-attempt manifests
-                # are cleaned up on CAS loss below
-                entries = entries_fn(latest)
-                new_manifest = None
-            next_row_id = latest.next_row_id if latest else None
-            candidates = entries if entries_fn is not None else \
-                entries_orig
-            ids_assigned = False
-            if self.row_tracking and any(
-                    e.kind == FileKind.ADD and e.file.first_row_id is None
-                    for e in candidates):
-                # row-id start depends on the latest snapshot, so the
-                # assignment re-runs from the pre-assignment entries
-                # (and the manifest is rewritten) on every CAS attempt
-                from paimon_tpu.core.row_tracking import assign_row_ids
-                start = next_row_id
-                if start is None:
-                    # tracking enabled on an existing table: ids for old
-                    # files stay unassigned; new ids start past all rows
-                    start = latest.total_record_count if latest else 0
-                entries, next_row_id = assign_row_ids(candidates, start)
-                new_manifest = None
-                ids_assigned = True
-            if check_deleted_files and latest is not None:
-                self._assert_files_exist(latest, entries)
+        # per-attempt artifacts, pre-bound so the deadline-abort
+        # handler below can delete whatever the CURRENT attempt
+        # had written when the deadline tripped: a
+        # DeadlineExceededError can surface from ANY store read
+        # inside an attempt (every FileIO read checks the
+        # deadline), not only at the CAS gate — an abort must
+        # never leave this attempt's manifests orphaned
+        base_name = delta_name = changelog_name = None
+        index_manifest = prev_index = None
+        merged_manifests: List[ManifestFileMeta] = []
 
-            from paimon_tpu.metrics import COMMIT_MANIFEST_ENCODE_MS
-
-            def _write_manifest(manifest_entries, which):
-                with _span("commit.manifest_encode", cat="commit",
-                           group="commit",
-                           metric=COMMIT_MANIFEST_ENCODE_MS,
-                           which=which, attempt=_attempts,
-                           entries=len(manifest_entries)):
-                    return self.manifest_file.write(
-                        manifest_entries, schema_id=self.schema.id)
-
-            if new_manifest is None and entries and \
-                    changelog_manifest is None and changelog_entries:
-                # both manifests are needed and independent: encode +
-                # upload the delta manifest on a worker while the
-                # changelog manifest encodes here, so commit prep waits
-                # on completion, not initiation (write-pipeline PR)
-                from paimon_tpu.parallel.executors import new_thread_pool
-                pool = new_thread_pool(1, "paimon-commit")
-                try:
-                    fut = pool.submit(_write_manifest, entries, "delta")
-                    changelog_manifest = _write_manifest(
-                        changelog_entries, "changelog")
-                    new_manifest = fut.result()
-                finally:
-                    pool.shutdown(wait=True)
-            if new_manifest is None and entries:
-                new_manifest = _write_manifest(entries, "delta")
-            if changelog_manifest is None and changelog_entries:
-                changelog_manifest = _write_manifest(changelog_entries,
-                                                     "changelog")
-
-            if latest is None:
-                base_metas: List[ManifestFileMeta] = []
-                new_id = 1
-                prev_total = 0
-                prev_index = None
-            else:
-                base_metas = self.manifest_list.read_all(
-                    latest.base_manifest_list, latest.delta_manifest_list)
-                new_id = latest.id + 1
-                prev_total = latest.total_record_count
-                prev_index = latest.index_manifest
-
-            base_metas, merged_manifests = \
-                self._maybe_merge_manifests(
-                    base_metas, force=force_full_manifest_merge,
-                    skip_missing=skip_missing_manifests)
-            base_name, base_size = self.manifest_list.write(base_metas)
-            delta_metas = [new_manifest] if new_manifest else []
-            delta_name, delta_size = self.manifest_list.write(delta_metas)
-            changelog_name = None
-            changelog_size = None
-            if changelog_manifest is not None:
-                changelog_name, changelog_size = self.manifest_list.write(
-                    [changelog_manifest])
-
-            index_manifest = self.index_manifest_file.combine(
-                prev_index, index_entries or [])
-
-            # watermarks only advance (reference FileStoreCommitImpl:
-            # max of provided and previous)
-            wm_vals = [w for w in
-                       (watermark, latest.watermark if latest else None)
-                       if w is not None]
-            new_watermark = max(wm_vals) if wm_vals else None
-            if force_full_manifest_merge and \
-                    getattr(self, "_force_merge_total", None) is not None:
-                # the full rewrite recounted every live entry — use the
-                # true total (skip_missing may have dropped manifests)
-                prev_total = self._force_merge_total
-                self._force_merge_total = None
-            delta_rows = sum(
-                (e.file.row_count if e.kind == FileKind.ADD
-                 else -e.file.row_count) for e in entries)
-            changelog_rows = sum(e.file.row_count
-                                 for e in changelog_entries)
-            snapshot = Snapshot(
-                id=new_id,
-                schema_id=self.schema.id,
-                base_manifest_list=base_name,
-                base_manifest_list_size=base_size,
-                delta_manifest_list=delta_name,
-                delta_manifest_list_size=delta_size,
-                changelog_manifest_list=changelog_name,
-                changelog_manifest_list_size=changelog_size,
-                index_manifest=index_manifest,
-                commit_user=self.commit_user,
-                commit_identifier=commit_identifier,
-                commit_kind=kind,
-                time_millis=int(_time.time() * 1000),
-                total_record_count=prev_total + delta_rows,
-                delta_record_count=delta_rows,
-                changelog_record_count=changelog_rows or None,
-                properties=properties,
-                statistics=statistics,
-                next_row_id=next_row_id,
-                watermark=new_watermark,
-            )
-            from paimon_tpu.metrics import COMMIT_CAS_MS
-            with _span("commit.cas", cat="commit", group="commit",
-                       metric=COMMIT_CAS_MS, attempt=_attempts,
-                       snapshot=new_id, table=self.table_path) as _cas:
-                _won = self.snapshot_manager.try_commit(snapshot)
-                _cas.set(won=_won)
-            if _won:
-                _metrics.counter("commits").inc()
-                if _attempts > 1:
-                    _metrics.counter("retries").inc(_attempts - 1)
-                _metrics.histogram("duration_ms").update(
-                    (_time.perf_counter() - _t0) * 1000)
-                return new_id
-            # lost the race: clean up everything written for this attempt
-            # and retry against the new latest (the delta manifest is
-            # reusable across attempts unless the entry set is dynamic)
-            self.manifest_list.delete(base_name)
-            self.manifest_list.delete(delta_name)
+        def _delete_attempt_lists():
+            """Drop the CURRENT attempt's manifest lists, index
+            manifest and merged manifests — shared by the lost-CAS
+            retry and the deadline-abort handler so the two abort
+            paths cannot drift (closure: reads the attempt's latest
+            bindings; every delete is quiet + deadline-shielded)."""
+            if base_name:
+                self.manifest_list.delete(base_name)
+            if delta_name:
+                self.manifest_list.delete(delta_name)
             if changelog_name:
                 self.manifest_list.delete(changelog_name)
-            if index_manifest is not None and index_manifest != prev_index:
+            if index_manifest is not None and \
+                    index_manifest != prev_index:
                 self.file_io.delete_quietly(
                     self.index_manifest_file.path(index_manifest))
             for m in merged_manifests:
                 self.file_io.delete_quietly(
                     self.manifest_file.path(m.file_name))
-            if (entries_fn is not None or ids_assigned) and \
-                    new_manifest is not None:
-                # the entry set was rebuilt for this attempt (dynamic
-                # entries or per-attempt row-id assignment): its manifest
-                # is stale too, and must not be referenced by the retry
-                self.file_io.delete_quietly(
-                    self.manifest_file.path(new_manifest.file_name))
-                new_manifest = None
+
+        try:
+            while True:
+                if _attempts > _max_retries or \
+                        (_attempts > 0 and _backoff.budget_exhausted()):
+                    # the per-attempt cleanup keeps the (reusable) delta and
+                    # changelog manifest FILES; on giving up they would be
+                    # orphaned with no snapshot referencing them
+                    for m in (new_manifest, changelog_manifest):
+                        if m is not None:
+                            self.file_io.delete_quietly(
+                                self.manifest_file.path(m.file_name))
+                    raise CommitConflictError(
+                        f"Commit lost the snapshot CAS race "
+                        f"{_attempts - 1} times (commit.max-retries="
+                        f"{_max_retries}, commit.timeout); giving up")
+                if _attempts > 0:
+                    with _span("commit.backoff", cat="commit",
+                               attempt=_attempts, table=self.table_path):
+                        _backoff.pause()
+                _attempts += 1
+                latest = self.snapshot_manager.latest_snapshot()
+                if expected_latest_id is not ... and \
+                        (latest.id if latest else None) != expected_latest_id:
+                    # the caller's plan is stale (e.g. deletion vectors built
+                    # against an older snapshot): surface a conflict so it can
+                    # replan instead of silently losing concurrent changes
+                    raise CommitConflictError(
+                        f"Snapshot advanced past "
+                        f"{expected_latest_id} before commit; replan required")
+                if entries_fn is not None:
+                    # delete/add set depends on the latest snapshot (e.g.
+                    # overwrite): recompute per attempt; per-attempt manifests
+                    # are cleaned up on CAS loss below
+                    entries = entries_fn(latest)
+                    new_manifest = None
+                next_row_id = latest.next_row_id if latest else None
+                candidates = entries if entries_fn is not None else \
+                    entries_orig
+                ids_assigned = False
+                if self.row_tracking and any(
+                        e.kind == FileKind.ADD and e.file.first_row_id is None
+                        for e in candidates):
+                    # row-id start depends on the latest snapshot, so the
+                    # assignment re-runs from the pre-assignment entries
+                    # (and the manifest is rewritten) on every CAS attempt
+                    from paimon_tpu.core.row_tracking import assign_row_ids
+                    start = next_row_id
+                    if start is None:
+                        # tracking enabled on an existing table: ids for old
+                        # files stay unassigned; new ids start past all rows
+                        start = latest.total_record_count if latest else 0
+                    entries, next_row_id = assign_row_ids(candidates, start)
+                    new_manifest = None
+                    ids_assigned = True
+                if check_deleted_files and latest is not None:
+                    self._assert_files_exist(latest, entries)
+
+                from paimon_tpu.metrics import COMMIT_MANIFEST_ENCODE_MS
+
+                def _write_manifest(manifest_entries, which):
+                    with _span("commit.manifest_encode", cat="commit",
+                               group="commit",
+                               metric=COMMIT_MANIFEST_ENCODE_MS,
+                               which=which, attempt=_attempts,
+                               entries=len(manifest_entries)):
+                        return self.manifest_file.write(
+                            manifest_entries, schema_id=self.schema.id)
+
+                if new_manifest is None and entries and \
+                        changelog_manifest is None and changelog_entries:
+                    # both manifests are needed and independent: encode +
+                    # upload the delta manifest on a worker while the
+                    # changelog manifest encodes here, so commit prep waits
+                    # on completion, not initiation (write-pipeline PR)
+                    from paimon_tpu.parallel.executors import new_thread_pool
+                    pool = new_thread_pool(1, "paimon-commit")
+                    try:
+                        fut = pool.submit(_write_manifest, entries, "delta")
+                        changelog_manifest = _write_manifest(
+                            changelog_entries, "changelog")
+                        new_manifest = fut.result()
+                    finally:
+                        pool.shutdown(wait=True)
+                if new_manifest is None and entries:
+                    new_manifest = _write_manifest(entries, "delta")
+                if changelog_manifest is None and changelog_entries:
+                    changelog_manifest = _write_manifest(changelog_entries,
+                                                         "changelog")
+
+                if latest is None:
+                    base_metas: List[ManifestFileMeta] = []
+                    new_id = 1
+                    prev_total = 0
+                    prev_index = None
+                else:
+                    base_metas = self.manifest_list.read_all(
+                        latest.base_manifest_list, latest.delta_manifest_list)
+                    new_id = latest.id + 1
+                    prev_total = latest.total_record_count
+                    prev_index = latest.index_manifest
+
+                base_metas, merged_manifests = \
+                    self._maybe_merge_manifests(
+                        base_metas, force=force_full_manifest_merge,
+                        skip_missing=skip_missing_manifests)
+                base_name, base_size = self.manifest_list.write(base_metas)
+                delta_metas = [new_manifest] if new_manifest else []
+                delta_name, delta_size = self.manifest_list.write(delta_metas)
+                changelog_name = None
+                changelog_size = None
+                if changelog_manifest is not None:
+                    changelog_name, changelog_size = self.manifest_list.write(
+                        [changelog_manifest])
+
+                index_manifest = self.index_manifest_file.combine(
+                    prev_index, index_entries or [])
+
+                # watermarks only advance (reference FileStoreCommitImpl:
+                # max of provided and previous)
+                wm_vals = [w for w in
+                           (watermark, latest.watermark if latest else None)
+                           if w is not None]
+                new_watermark = max(wm_vals) if wm_vals else None
+                if force_full_manifest_merge and \
+                        getattr(self, "_force_merge_total", None) is not None:
+                    # the full rewrite recounted every live entry — use the
+                    # true total (skip_missing may have dropped manifests)
+                    prev_total = self._force_merge_total
+                    self._force_merge_total = None
+                delta_rows = sum(
+                    (e.file.row_count if e.kind == FileKind.ADD
+                     else -e.file.row_count) for e in entries)
+                changelog_rows = sum(e.file.row_count
+                                     for e in changelog_entries)
+                snapshot = Snapshot(
+                    id=new_id,
+                    schema_id=self.schema.id,
+                    base_manifest_list=base_name,
+                    base_manifest_list_size=base_size,
+                    delta_manifest_list=delta_name,
+                    delta_manifest_list_size=delta_size,
+                    changelog_manifest_list=changelog_name,
+                    changelog_manifest_list_size=changelog_size,
+                    index_manifest=index_manifest,
+                    commit_user=self.commit_user,
+                    commit_identifier=commit_identifier,
+                    commit_kind=kind,
+                    time_millis=int(_time.time() * 1000),
+                    total_record_count=prev_total + delta_rows,
+                    delta_record_count=delta_rows,
+                    changelog_record_count=changelog_rows or None,
+                    properties=properties,
+                    statistics=statistics,
+                    next_row_id=next_row_id,
+                    watermark=new_watermark,
+                )
+                from paimon_tpu.metrics import COMMIT_CAS_MS
+                from paimon_tpu.utils.deadline import check_deadline
+                # the point of no return is the CAS itself: a request
+                # whose deadline is already spent must raise HERE, before
+                # publishing — a 504'd caller can clean up / retry an
+                # UNcommitted attempt, but an orphan-committed snapshot
+                # would make the timeout a lie (the except handler around
+                # the whole retry loop cleans this attempt's artifacts)
+                check_deadline("commit CAS")
+                with _span("commit.cas", cat="commit", group="commit",
+                           metric=COMMIT_CAS_MS, attempt=_attempts,
+                           snapshot=new_id, table=self.table_path) as _cas:
+                    _won = self.snapshot_manager.try_commit(snapshot)
+                    _cas.set(won=_won)
+                if _won:
+                    _metrics.counter("commits").inc()
+                    if _attempts > 1:
+                        _metrics.counter("retries").inc(_attempts - 1)
+                    _metrics.histogram("duration_ms").update(
+                        (_time.perf_counter() - _t0) * 1000)
+                    return new_id
+                # lost the race: clean up everything written for this attempt
+                # and retry against the new latest (the delta manifest is
+                # reusable across attempts unless the entry set is dynamic)
+                _delete_attempt_lists()
+                if (entries_fn is not None or ids_assigned) and \
+                        new_manifest is not None:
+                    # the entry set was rebuilt for this attempt (dynamic
+                    # entries or per-attempt row-id assignment): its manifest
+                    # is stale too, and must not be referenced by the retry
+                    self.file_io.delete_quietly(
+                        self.manifest_file.path(new_manifest.file_name))
+                    new_manifest = None
+
+        except DeadlineExceededError:
+            # same cleanup as a lost CAS, plus the manifests the
+            # exhausted-retries path would drop: nothing written
+            # for this attempt may outlive the abort (deletes are
+            # deadline-shielded via delete_quietly)
+            _delete_attempt_lists()
+            for m in (new_manifest, changelog_manifest):
+                if m is not None:
+                    self.file_io.delete_quietly(
+                        self.manifest_file.path(m.file_name))
+            raise
 
     def _assert_files_exist(self, latest: Snapshot,
                             entries: List[ManifestEntry]):

@@ -122,8 +122,15 @@ class FileIO:
         raise NotImplementedError
 
     def delete_quietly(self, path: str):
+        # shielded from the request deadline: quiet deletes are the
+        # abort/cleanup contract and run exactly when the deadline is
+        # already spent — unshielded, the deadline check inside the
+        # store op would raise, be swallowed here, and orphan the very
+        # file this cleanup exists to remove (utils/deadline.py)
+        from paimon_tpu.utils.deadline import deadline_shield
         try:
-            self.delete(path, False)
+            with deadline_shield():
+                self.delete(path, False)
         except Exception:
             pass
 

@@ -36,7 +36,8 @@ from paimon_tpu.fs.fileio import (
 __all__ = ["ObjectStoreBackend", "LocalObjectStoreBackend",
            "ObjectStoreFileIO", "FlakyObjectStoreBackend",
            "LatencyInjectingObjectStoreBackend",
-           "RetryingObjectStoreBackend", "TransientStoreError"]
+           "RetryingObjectStoreBackend", "TransientStoreError",
+           "CircuitOpenError"]
 
 
 class PreconditionFailed(Exception):
@@ -45,6 +46,15 @@ class PreconditionFailed(Exception):
 
 class TransientStoreError(Exception):
     """A retryable server error (HTTP 503 / SlowDown / 500)."""
+
+
+class CircuitOpenError(TransientStoreError):
+    """The per-backend circuit breaker (fs/resilience.py) is OPEN: the
+    store is known-sick and the call failed fast WITHOUT touching it.
+    Subclasses TransientStoreError so the fault taxonomy still files
+    it as transient, but `RetryingObjectStoreBackend` re-raises it
+    immediately — retrying against an open circuit would just sleep
+    through the breaker's whole point (fail fast, shed load)."""
 
 
 class ObjectStoreBackend:
@@ -232,29 +242,69 @@ class LatencyInjectingObjectStoreBackend(ObjectStoreBackend):
     only PUTs can be made slow).  Composable with
     FlakyObjectStoreBackend in either order: Flaky(Latency(store))
     charges the round trip before the 503 fires, like a real timeout.
-    Thread-safe: the seeded rng is locked, sleeps happen outside."""
+    Thread-safe: the seeded rng is locked, sleeps happen outside.
+
+    Chaos extensions (the tail-tolerance PR's injection surface —
+    benchmarks/chaos_bench.py, tests/test_resilience.py):
+
+    - **heavy tail**: with probability `tail_rate`, ops in `tail_ops`
+      pay `tail_multiplier` x base instead of base — the "1% of GETs
+      20x slow" shape hedged reads exist to beat.  When `pareto_alpha`
+      is set the multiplier is drawn from a Pareto(alpha) distribution
+      instead (a genuinely heavy tail: p99 >> p95 >> median, like real
+      object-store stragglers).
+    - **stuck requests**: with probability `stuck_rate`, the op HANGS
+      for `stuck_ms` before proceeding — not an error, a stall.  No
+      retry ladder fires; only a deadline-bounded wait (the resilient
+      backend abandons the in-flight call) gets the caller out.
+
+    The sleep is injectable (`sleep=`) so state-machine tests can run
+    on a virtual clock."""
 
     def __init__(self, inner: ObjectStoreBackend, base_ms=10.0,
-                 jitter_ms: float = 0.0, seed: int = 0):
+                 jitter_ms: float = 0.0, seed: int = 0,
+                 tail_rate: float = 0.0, tail_multiplier: float = 20.0,
+                 pareto_alpha: Optional[float] = None,
+                 tail_ops: Tuple[str, ...] = ("get",),
+                 stuck_rate: float = 0.0, stuck_ms: float = 0.0,
+                 sleep=None):
         import random
+        import time
         self.inner = inner
         self.base_ms = base_ms
         self.jitter_ms = jitter_ms
+        self.tail_rate = tail_rate
+        self.tail_multiplier = tail_multiplier
+        self.pareto_alpha = pareto_alpha
+        self.tail_ops = frozenset(tail_ops)
+        self.stuck_rate = stuck_rate
+        self.stuck_ms = stuck_ms
+        self._sleep = sleep if sleep is not None else time.sleep
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
-        self.stats = {"delayed_calls": 0, "delay_ms_total": 0.0}
+        self.stats = {"delayed_calls": 0, "delay_ms_total": 0.0,
+                      "tail_hits": 0, "stuck_hits": 0}
 
     def _delay(self, op: str):
-        import time
         base = self.base_ms.get(op, 0.0) \
             if isinstance(self.base_ms, dict) else self.base_ms
         with self._lock:
             wait = base + (self._rng.random() * self.jitter_ms
                            if self.jitter_ms else 0.0)
+            if op in self.tail_ops and self.tail_rate and \
+                    self._rng.random() < self.tail_rate:
+                mult = self._rng.paretovariate(self.pareto_alpha) \
+                    if self.pareto_alpha is not None \
+                    else self.tail_multiplier
+                wait = base * mult
+                self.stats["tail_hits"] += 1
+            if self.stuck_rate and self._rng.random() < self.stuck_rate:
+                wait += self.stuck_ms
+                self.stats["stuck_hits"] += 1
             self.stats["delayed_calls"] += 1
             self.stats["delay_ms_total"] += wait
         if wait > 0:
-            time.sleep(wait / 1000.0)
+            self._sleep(wait / 1000.0)
 
     def put(self, key: str, data: bytes, if_none_match: bool = False):
         self._delay("put")
@@ -319,6 +369,12 @@ class RetryingObjectStoreBackend(ObjectStoreBackend):
         for attempt in range(self.max_attempts):
             try:
                 return fn()
+            except CircuitOpenError:
+                # the breaker below us says the store is sick: fail
+                # fast instead of sleeping the whole ladder onto it —
+                # the retry ladder consults breaker state BEFORE any
+                # backoff wait (fs/resilience.py)
+                raise
             except TransientStoreError as e:
                 last = e
                 if attempt + 1 >= self.max_attempts:
@@ -338,6 +394,8 @@ class RetryingObjectStoreBackend(ObjectStoreBackend):
             try:
                 return self.inner.put(key, data,
                                       if_none_match=if_none_match)
+            except CircuitOpenError:
+                raise               # fail fast: breaker open (see _retry)
             except TransientStoreError as e:
                 last = e
                 ambiguous = True       # effect may or may not be applied
@@ -398,32 +456,56 @@ class ObjectStoreFileIO(FileIO):
         return path.lstrip("/")
 
     # -- reads ---------------------------------------------------------------
+    # every read checks the request deadline BEFORE its round trip: a
+    # metadata walk (snapshot probes, manifest chain) is a sequence of
+    # store ops with no other blocking wait between them, and on a
+    # slow store each op can cost hundreds of ms — without this check
+    # a timed-out request would ride the whole chain to completion.
+    # The residual grace after a deadline trips is therefore bounded
+    # by ONE op's latency (plus hedged ops abandon mid-call,
+    # fs/resilience.py).  Writes deliberately have no check: their
+    # cancellation points are the commit CAS gate and the durability
+    # barriers, which own abort-vs-orphan semantics.
+
+    @staticmethod
+    def _check_deadline(what: str):
+        from paimon_tpu.utils.deadline import check_deadline
+        check_deadline(what)
 
     def read_bytes(self, path: str) -> bytes:
+        self._check_deadline("read")
         return self.backend.get(self._key(path))
 
     def read_range(self, path: str, offset: int, length: int) -> bytes:
+        self._check_deadline("read")
         return self.backend.get(self._key(path), offset, length)
 
     def read_ranges(self, path, ranges):
         # ranged GETs, one per range (real stores coalesce via HTTP
         # multi-range; the per-call shape is the same)
         key = self._key(path)
-        return [self.backend.get(key, o, ln) for o, ln in ranges]
+        out = []
+        for o, ln in ranges:
+            self._check_deadline("read")
+            out.append(self.backend.get(key, o, ln))
+        return out
 
     def exists(self, path: str) -> bool:
+        self._check_deadline("exists")
         key = self._key(path)
         if self.backend.head(key) is not None:
             return True
         return bool(self.backend.list(key.rstrip("/") + "/"))
 
     def get_file_size(self, path: str) -> int:
+        self._check_deadline("size")
         size = self.backend.head(self._key(path))
         if size is None:
             raise FileNotFoundError(path)
         return size
 
     def list_status(self, path: str) -> List[FileStatus]:
+        self._check_deadline("list")
         prefix = self._key(path).rstrip("/") + "/"
         out: Dict[str, FileStatus] = {}
         for key, size in self.backend.list(prefix):

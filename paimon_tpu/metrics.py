@@ -45,7 +45,12 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "CACHE_DISK_HITS", "CACHE_DISK_MISSES",
            "CACHE_DISK_PROMOTIONS", "CACHE_DISK_DEMOTIONS",
            "CACHE_DISK_EVICTIONS", "CACHE_DISK_BYTES",
-           "CACHE_DISK_STAGED_UPLOADS", "CACHE_DISK_STAGE_MS"]
+           "CACHE_DISK_STAGED_UPLOADS", "CACHE_DISK_STAGE_MS",
+           "RESILIENCE_HEDGES_ISSUED", "RESILIENCE_HEDGES_WON",
+           "RESILIENCE_HEDGES_ABANDONED", "RESILIENCE_BREAKER_STATE",
+           "RESILIENCE_BREAKER_FAST_FAILS",
+           "RESILIENCE_DEADLINE_EXCEEDED", "RESILIENCE_BROWNOUT_SHEDS",
+           "RESILIENCE_BROWNOUT_LEVEL", "RESILIENCE_HEDGE_WAIT_MS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -156,6 +161,24 @@ CACHE_DISK_EVICTIONS = "evictions"            # bound/validation drops
 CACHE_DISK_BYTES = "bytes"                    # gauge: on-disk bytes now
 CACHE_DISK_STAGED_UPLOADS = "staged_uploads"  # uploads acked from stage
 CACHE_DISK_STAGE_MS = "stage_ms"              # one encode->staged-fsync
+
+# tail-tolerance counter/gauge/histogram names (resilience metric
+# group; producers in fs/resilience.py + utils/deadline.py +
+# service/brownout.py + service/admission.py, consumers
+# benchmarks/chaos_bench.py + tests + dashboards).  The breaker state
+# gauge renders one series per backend: group("resilience", backend
+# name) -> prometheus label table="<backend>"; 0=closed, 1=half-open,
+# 2=open.  brownout_level is the serving plane's degradation rung
+# (0 normal, 1 degrade hedging/prefetch, 2 shed low priority).
+RESILIENCE_HEDGES_ISSUED = "hedges_issued"      # hedge requests sent
+RESILIENCE_HEDGES_WON = "hedges_won"            # hedge beat the primary
+RESILIENCE_HEDGES_ABANDONED = "hedges_abandoned"  # loser left running
+RESILIENCE_BREAKER_STATE = "breaker_state"      # gauge, per backend
+RESILIENCE_BREAKER_FAST_FAILS = "breaker_fast_fails"  # open-circuit rejects
+RESILIENCE_DEADLINE_EXCEEDED = "deadline_exceeded"    # tripped scopes
+RESILIENCE_BROWNOUT_SHEDS = "brownout_sheds"    # requests shed browned-out
+RESILIENCE_BROWNOUT_LEVEL = "brownout_level"    # gauge: current rung
+RESILIENCE_HEDGE_WAIT_MS = "hedge_wait_ms"      # delay before the hedge
 
 
 class Counter:
@@ -349,6 +372,12 @@ class MetricRegistry:
         """Tiered host-SSD storage plane (ours; fs/caching.py disk
         tier + the write path's staged uploads)."""
         return self.group("cache_disk", table)
+
+    def resilience_metrics(self, table: str = "") -> MetricGroup:
+        """Tail-tolerance plane (ours; fs/resilience.py hedges +
+        breakers, utils/deadline.py, service/brownout.py).  `table`
+        doubles as the backend name for per-backend breaker gauges."""
+        return self.group("resilience", table)
 
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind

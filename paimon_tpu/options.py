@@ -512,6 +512,95 @@ class CoreOptions:
         "latency since staged uploads are independent PUTs to "
         "writer-unique names")
 
+    # -- tail tolerance (ours; utils/deadline.py + fs/resilience.py +
+    #    service/brownout.py) -------------------------------------------------
+    REQUEST_TIMEOUT = ConfigOption(
+        "request.timeout", _parse_duration_ms, None,
+        "End-to-end deadline for table entry points (reads, commits, "
+        "CLI ops): a Deadline is installed at entry and honored by "
+        "every blocking wait downstream — retry-ladder sleeps, "
+        "scan/write byte-budget blocks, admission queues, store IO — "
+        "raising the typed DeadlineExceededError once spent (never "
+        "retried, never orphan-committed).  None = no deadline")
+    SERVICE_REQUEST_TIMEOUT = ConfigOption(
+        "service.request.timeout", _parse_duration_ms, None,
+        "Default end-to-end deadline for /lookup, /scan and "
+        "/changelog requests (clients may override per request with "
+        "'timeout_ms'); an exceeded deadline answers HTTP 504 with "
+        "all in-flight work for that request abandoned.  None = no "
+        "server-side deadline")
+    READ_HEDGE_ENABLED = ConfigOption(
+        "read.hedge.enabled", _parse_bool, False,
+        "Hedge slow store reads (fs/resilience.py): GET/ranged-GET/"
+        "HEAD/LIST track an online per-op-class latency quantile and "
+        "a call still in flight past that delay issues ONE duplicate "
+        "request — first success wins, the loser is abandoned.  Never "
+        "applied to mutating ops; disabled automatically under "
+        "brownout")
+    READ_HEDGE_QUANTILE = ConfigOption(
+        "read.hedge.quantile", float, 95.0,
+        "Latency percentile of the op class's recent successes at "
+        "which the hedge fires (95 = hedge the slowest ~5% of reads)")
+    READ_HEDGE_MIN_DELAY = ConfigOption(
+        "read.hedge.min-delay", _parse_duration_ms, 1,
+        "Floor on the adaptive hedge delay, so a very fast store "
+        "cannot drive the hedge trigger into micro-duplication")
+    READ_HEDGE_MAX_RATIO = ConfigOption(
+        "read.hedge.max-ratio", float, 0.05,
+        "Hard cap on hedges as a fraction of hedgeable calls (0.05 = "
+        "at most 5% extra load on the store, the classic tail-at-"
+        "scale budget)")
+    STORE_BREAKER_ENABLED = ConfigOption(
+        "store.breaker.enabled", _parse_bool, False,
+        "Per-backend circuit breaker (fs/resilience.py): a sick store "
+        "trips closed->open and calls fail fast (<10ms, "
+        "CircuitOpenError) instead of queueing retry ladders onto it; "
+        "half-open probes re-close after store.breaker.open-ms")
+    STORE_BREAKER_FAILURE_THRESHOLD = ConfigOption(
+        "store.breaker.failure-threshold", int, 5,
+        "Consecutive store failures that trip the breaker open")
+    STORE_BREAKER_ERROR_RATE = ConfigOption(
+        "store.breaker.error-rate", float, 0.5,
+        "Windowed error-rate trip wire: the breaker also opens when "
+        "at least this fraction of the last store.breaker.window "
+        "outcomes failed (catches sustained partial sickness that "
+        "never produces a long consecutive run)")
+    STORE_BREAKER_WINDOW = ConfigOption(
+        "store.breaker.window", int, 32,
+        "Outcome window for the error-rate trip wire (must be full "
+        "before the rate can trip)")
+    STORE_BREAKER_OPEN_MS = ConfigOption(
+        "store.breaker.open-ms", _parse_duration_ms, 5000,
+        "How long an open breaker rejects before letting half-open "
+        "probes through; a failed probe re-arms the full window")
+    STORE_BREAKER_HALF_OPEN_PROBES = ConfigOption(
+        "store.breaker.half-open-probes", int, 1,
+        "Concurrent trial calls admitted in the half-open state; the "
+        "first success re-closes the breaker")
+    SERVICE_BROWNOUT_ENABLED = ConfigOption(
+        "service.brownout.enabled", _parse_bool, True,
+        "Graceful load shedding for the serving plane (service/"
+        "brownout.py): under breaker-open or queue pressure the "
+        "service climbs a degradation ladder — rung 1 disables "
+        "hedging and shrinks prefetch windows, rung 2 also sheds "
+        "lowest-priority requests with HTTP 429 — and reports it all "
+        "on /healthz")
+    SERVICE_BROWNOUT_QUEUE_RATIO = ConfigOption(
+        "service.brownout.queue-ratio", float, 0.5,
+        "Admission-queue fill fraction (waiters / service.queue."
+        "depth) past which the brownout ladder starts climbing")
+    SERVICE_BROWNOUT_SHED_PRIORITY = ConfigOption(
+        "service.brownout.shed-priority", int, 100,
+        "At brownout rung 2, requests with priority below this are "
+        "shed with HTTP 429 (clients send 'priority'; the default "
+        "request priority is 100, so only explicitly lower-priority "
+        "traffic sheds by default)")
+    SERVICE_BROWNOUT_HOLD_MS = ConfigOption(
+        "service.brownout.hold-ms", _parse_duration_ms, 1000,
+        "Hysteresis: once entered, a brownout rung holds at least "
+        "this long before the ladder may step back down (prevents "
+        "flapping between shed and un-shed at the pressure boundary)")
+
     # -- observability (ours; paimon_tpu/obs/) -------------------------------
     METRICS_ENABLED = ConfigOption(
         "metrics.enabled", _parse_bool, True,

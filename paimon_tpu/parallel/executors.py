@@ -34,8 +34,27 @@ def spawn_thread(target: Callable, *, name: str,
     return t
 
 
+class _DeadlinePropagatingPool(ThreadPoolExecutor):
+    """ThreadPoolExecutor that carries the SUBMITTER's request deadline
+    (utils/deadline.py) into each task: contextvars do not cross pool
+    boundaries on their own, and without this a worker-side retry
+    ladder or byte-budget wait would happily outlive the request that
+    queued it."""
+
+    def submit(self, fn, /, *args, **kwargs):
+        from paimon_tpu.utils.deadline import (
+            current_deadline, run_with_deadline,
+        )
+        dl = current_deadline()
+        if dl is None:
+            return super().submit(fn, *args, **kwargs)
+        return super().submit(run_with_deadline, dl, fn,
+                              *args, **kwargs)
+
+
 def new_thread_pool(workers: int, prefix: str) -> ThreadPoolExecutor:
     """A named ThreadPoolExecutor (`prefix` becomes the thread-name
-    prefix, which the no-leaked-threads tier-1 tests key on)."""
-    return ThreadPoolExecutor(max_workers=max(1, int(workers)),
-                              thread_name_prefix=prefix)
+    prefix, which the no-leaked-threads tier-1 tests key on).  Tasks
+    inherit the submitting thread's request deadline."""
+    return _DeadlinePropagatingPool(max_workers=max(1, int(workers)),
+                                    thread_name_prefix=prefix)

@@ -52,8 +52,13 @@ def is_transient_error(exc: BaseException) -> bool:
 
     from paimon_tpu.format.format import CorruptDataError
     from paimon_tpu.fs.object_store import TransientStoreError
+    from paimon_tpu.utils.deadline import DeadlineExceededError
 
     if isinstance(exc, (CorruptDataError, pa.ArrowException)):
+        return False
+    if isinstance(exc, DeadlineExceededError):
+        # the request's end-to-end budget is spent: retrying can only
+        # waste a sick backend's capacity on a caller that is gone
         return False
     if isinstance(exc, (TransientStoreError, OSError)):
         return True

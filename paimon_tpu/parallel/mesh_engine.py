@@ -652,10 +652,11 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
             if deadlines:
                 wait = min(deadlines) - _time.monotonic()
                 if wait > 0:
+                    from paimon_tpu.utils.backoff import wait_for
                     with _obs_span("compaction.backoff_wait",
                                    cat="compaction",
                                    pending=len(deadlines)):
-                        _time.sleep(wait)
+                        wait_for(wait, what="compaction backoff")
             continue
         # assemble each active lane's window; truncated-key windows take
         # the exact host merge instead of the device kernel

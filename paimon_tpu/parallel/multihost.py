@@ -46,6 +46,21 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None:
         process_id = int(os.environ.get("PROCESS_ID", "0"))
     if num_processes > 1:
+        # jax 0.4.x ships the CPU backend with cross-process
+        # collectives DISABLED by default — without opting into the
+        # Gloo implementation, the first multiprocess computation
+        # fails with "Multiprocess computations aren't implemented on
+        # the CPU backend" (the long-standing test_multihost_real
+        # red).  Harmless on TPU (the setting only affects the CPU
+        # backend); must run before the backend initializes.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except (AttributeError, ValueError, KeyError):
+            # other jax versions: the flag may not exist (newer
+            # releases enable cross-process CPU collectives through
+            # the distributed runtime itself)
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

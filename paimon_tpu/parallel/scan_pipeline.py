@@ -146,6 +146,11 @@ def read_or_skip_corrupt(fn: Callable[[], object],
             return read_file_retrying(fn, options, what=label)
         return fn()
     except Exception as e:      # noqa: BLE001 — reclassified below
+        from paimon_tpu.utils.deadline import DeadlineExceededError
+        if isinstance(e, DeadlineExceededError):
+            # a spent deadline is neither transient nor corrupt bytes:
+            # it must surface as the 504, never be skipped as corrupt
+            raise
         if read_fault_is_retryable(e):
             raise
         if options is not None and \
@@ -185,8 +190,10 @@ def iter_split_tables(read, splits: Sequence,
         stats.setdefault("submitted", 0)
     if par <= 1 or len(splits) <= 1:
         # serial fast path: no pool, identical to the legacy loop
+        from paimon_tpu.utils.deadline import check_deadline
         table_path = getattr(read, "table_path", None)
         for i, s in enumerate(splits):
+            check_deadline("scan")
             if stats is not None:
                 b = _estimated_bytes(s)
                 stats["submitted"] += 1
@@ -231,6 +238,11 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
     else:
         extra = CoreOptions.READ_PREFETCH_SPLITS.default
         max_bytes = CoreOptions.READ_PREFETCH_MAX_BYTES.default
+    from paimon_tpu.fs.resilience import is_degraded
+    if is_degraded():
+        # brownout rung 1+: stop prefetching past the worker pool —
+        # shed our own speculative load before shedding requests
+        extra = 0
     window = par + max(0, extra)
     max_bytes = max(1, max_bytes)
     group = global_registry().scan_metrics()
@@ -240,12 +252,21 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
     from paimon_tpu.parallel.executors import new_thread_pool
     pool = new_thread_pool(par, "paimon-scan")
     table_path = getattr(read, "table_path", None)
+    from paimon_tpu.utils.deadline import (
+        DeadlineExceededError, check_deadline, current_deadline,
+    )
+
     inflight = deque()        # [index, split, est_bytes, future]
     inflight_bytes = 0
     next_i = 0
     abandoned = False
     try:
         while inflight or next_i < len(splits):
+            # a spent request deadline stops admission AND result
+            # waits right here — in-flight workers are abandoned by
+            # the finally block (shutdown without join), their results
+            # discarded
+            check_deadline("scan pipeline")
             # admit work: window + byte budget, always >= 1 in flight
             while next_i < len(splits) and len(inflight) < window and \
                     (not inflight or
@@ -270,6 +291,7 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
                         stats["peak_inflight_bytes"], inflight_bytes)
                     stats["max_inflight_splits"] = max(
                         stats["max_inflight_splits"], len(inflight))
+            dl = current_deadline()
             if ordered:
                 # deliberate backpressure: completed-but-unyielded
                 # splits hold decoded tables in memory, so they keep
@@ -279,18 +301,46 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
                 idx, s, b, fut = inflight.popleft()
             else:
                 cf.wait([e[3] for e in inflight],
+                        timeout=None if dl is None
+                        else dl.remaining_s(),
                         return_when=cf.FIRST_COMPLETED)
-                pos = next(i for i, e in enumerate(inflight)
-                           if e[3].done())
+                pos = next((i for i, e in enumerate(inflight)
+                            if e[3].done()), None)
+                if pos is None:
+                    # deadline ran out with every worker still busy:
+                    # abandon them all (finally skips the join)
+                    abandoned = True
+                    raise DeadlineExceededError(
+                        "scan pipeline: deadline exceeded waiting "
+                        "for any split")
                 idx, s, b, fut = inflight[pos]
                 del inflight[pos]
-            table = fut.result()    # raises the worker's exception
+            if dl is None:
+                table = fut.result()  # raises the worker's exception
+            else:
+                try:
+                    table = fut.result(timeout=dl.remaining_s())
+                except cf.TimeoutError:
+                    # the split read is HUNG past the deadline:
+                    # abandon it (no join — the worker drains in the
+                    # background, its result discarded)
+                    abandoned = True
+                    raise DeadlineExceededError(
+                        f"scan pipeline: deadline exceeded waiting "
+                        f"for split {idx}") from None
             inflight_bytes -= b
             yield idx, s, table
     except GeneratorExit:
         # consumer stopped early (LIMIT satisfied, loader closed):
         # don't block it on in-flight reads whose results are
         # discarded — workers drain in the background and exit
+        abandoned = True
+        raise
+    except DeadlineExceededError:
+        # ANY deadline escape (the loop-top check, a worker-side
+        # raise surfacing through fut.result) must not join workers
+        # that may be hung in store calls — the whole point of the
+        # 504 is to answer within one op's grace
         abandoned = True
         raise
     finally:

@@ -235,6 +235,19 @@ class FlushPool:
             try:
                 while self._inflight_tasks > 0 and \
                         self._inflight_bytes + est_bytes > self.max_bytes:
+                    # the byte-budget block honors the request
+                    # deadline: the un-admitted task's rows would be
+                    # lost to a retried prepare, so a tripped deadline
+                    # poisons the pool like any other producer-side
+                    # abort (the caller must start a fresh writer)
+                    from paimon_tpu.utils.deadline import (
+                        DeadlineExceededError, check_deadline,
+                    )
+                    try:
+                        check_deadline("write byte-budget wait")
+                    except DeadlineExceededError as e:
+                        self._poisoned = e
+                        raise
                     if waited is None:
                         waited = time.perf_counter()
                         from paimon_tpu.obs.trace import span as _span
@@ -279,6 +292,24 @@ class FlushPool:
         with self._cond:
             self._check_poisoned()
             while self._inflight_tasks > 0 and self._error is None:
+                from paimon_tpu.utils.deadline import (
+                    DeadlineExceededError, check_deadline,
+                )
+                try:
+                    check_deadline("write drain barrier")
+                except DeadlineExceededError as e:
+                    # cancel what never started and poison: the
+                    # cancelled payloads are unrecoverable on this
+                    # writer (running tasks are ABANDONED, not joined
+                    # — the deadline must not wait on a hung upload)
+                    for q in self._queues.values():
+                        while q:
+                            est, _ = q.popleft()
+                            self._inflight_bytes -= est
+                            self._inflight_tasks -= 1
+                    self._g_inflight.set(self._inflight_bytes)
+                    self._poisoned = e
+                    raise
                 self._cond.wait(timeout=0.5)
             if self._error is not None:
                 # cancel everything still queued, then wait for the
@@ -570,6 +601,17 @@ class UploadStager:
                     raise RuntimeError(
                         "UploadStager is shut down with uploads "
                         "cancelled; nothing to drain")
+                from paimon_tpu.utils.deadline import (
+                    DeadlineExceededError, check_deadline,
+                )
+                try:
+                    check_deadline("staged-upload drain barrier")
+                except DeadlineExceededError as e:
+                    # in-flight PUTs are abandoned; the stager is
+                    # poisoned so no commit message naming un-acked
+                    # files can ever be assembled
+                    self._poisoned = e
+                    raise
                 self._cond.wait(timeout=0.5)
             if self._error is not None:
                 err, self._error = self._error, None

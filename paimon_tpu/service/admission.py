@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 __all__ = ["AdmissionController", "AdmissionRejected", "AdmissionTicket"]
 
 DEFAULT_TENANT = "default"
+DEFAULT_PRIORITY = 100
 
 
 class AdmissionRejected(RuntimeError):
@@ -100,6 +101,14 @@ class AdmissionController:
         self._m_requests = g.counter(SERVICE_REQUESTS)
         self._m_rejected = g.counter(SERVICE_REJECTED)
         self._m_wait = g.histogram(SERVICE_ADMISSION_WAIT_MS)
+        from paimon_tpu.metrics import RESILIENCE_BROWNOUT_SHEDS
+        self._m_sheds = self._registry.resilience_metrics() \
+            .counter(RESILIENCE_BROWNOUT_SHEDS)
+        # brownout rung 2 (service/brownout.py): requests with
+        # priority below this are shed immediately with 429 — the
+        # lowest-priority tenants lose service first, the high-
+        # priority path keeps its byte budget
+        self._shed_below = 0
         # explicitly-set gauges (not fn-backed): a later controller on
         # the same table must take the series over, not leave a stale
         # closure pointing at a dead instance
@@ -181,16 +190,37 @@ class AdmissionController:
         self._waiters = [w for w in self._waiters if not w.admitted]
         self._g_queue.set(len(self._waiters))
 
+    def set_shed_below(self, priority: int):
+        """Brownout hook: shed acquires with priority < `priority`
+        (0 restores normal admission)."""
+        with self._lock:
+            self._shed_below = int(priority)
+
     def acquire(self, tenant: str = DEFAULT_TENANT,
-                nbytes: int = 1) -> AdmissionTicket:
+                nbytes: int = 1,
+                priority: int = DEFAULT_PRIORITY) -> AdmissionTicket:
         """Block until `nbytes` fits under both the global and the
         tenant budget, then return the ticket.  Raises
-        AdmissionRejected immediately when the wait queue is full, or
-        after service.queue.timeout with no capacity."""
+        AdmissionRejected immediately when the wait queue is full,
+        when brownout is shedding this request's priority class, or
+        after service.queue.timeout with no capacity.  A request
+        deadline (utils/deadline.py) bounds the queue wait: a spent
+        deadline raises DeadlineExceededError (504), never parks the
+        caller for the full queue timeout."""
+        from paimon_tpu.utils.deadline import current_deadline
         tenant = tenant or DEFAULT_TENANT
         nbytes = max(1, int(nbytes))
         t0 = time.perf_counter()
+        dl = current_deadline()
+        if dl is not None:
+            dl.check("admission")
         with self._lock:
+            if priority < self._shed_below:
+                self._m_rejected.inc()
+                self._m_sheds.inc()
+                raise AdmissionRejected(
+                    f"brownout: shedding priority<{self._shed_below} "
+                    f"requests; retry later")
             # fast path only when nobody is queued: arrivals must not
             # starve the waiters the drain is ordering
             if not self._waiters and self._fits_locked(nbytes, tenant):
@@ -206,7 +236,12 @@ class AdmissionController:
             self._waiters.append(w)
             self._g_queue.set(len(self._waiters))
             self._drain_locked()     # we may fit right now
-        if w.event.wait(self.queue_timeout_ms / 1000.0):
+        wait_s = self.queue_timeout_ms / 1000.0
+        deadline_bound = dl is not None and \
+            dl.remaining_s() < wait_s
+        if deadline_bound:
+            wait_s = dl.remaining_s()
+        if w.event.wait(wait_s):
             self._m_wait.update((time.perf_counter() - t0) * 1000.0)
             return AdmissionTicket(self, nbytes, tenant)
         with self._lock:
@@ -216,7 +251,12 @@ class AdmissionController:
                 return AdmissionTicket(self, nbytes, tenant)
             self._waiters.remove(w)
             self._g_queue.set(len(self._waiters))
-            self._m_rejected.inc()
+            if not deadline_bound:
+                self._m_rejected.inc()
+        if deadline_bound:
+            # the request's own deadline ran out first: that is a 504
+            # (the caller's budget), not a 429 (our capacity)
+            dl.check("admission")
         raise AdmissionRejected(
             f"no byte budget within {self.queue_timeout_ms}ms "
             f"({nbytes} bytes requested, {self._inflight} in flight); "

@@ -1,0 +1,190 @@
+"""Brownout: graceful degradation for the query-serving plane.
+
+When the store is sick (circuit breakers open) or the service is
+saturated (admission queue filling, 504/429s climbing), failing ALL
+traffic is the worst answer.  This controller climbs a small, fully
+observable degradation ladder instead:
+
+    rung 0  normal      full prefetch, hedging as configured
+    rung 1  degrade     hedging disabled process-wide + scan prefetch
+                        windows shrunk (fs/resilience.set_degraded):
+                        shed our own speculative store load first
+    rung 2  shed        rung 1 + lowest-priority requests rejected
+                        immediately with HTTP 429
+                        (AdmissionController.set_shed_below)
+
+Signals, recomputed on every observe() (each request) with an
+injectable clock:
+
+* any breaker open        (fs/resilience.breaker_states)
+* queue pressure          (admission.queued / queue_depth >=
+                           service.brownout.queue-ratio)
+* recent failure rate     (429s + 504s in the trailing window)
+
+The rung is the COUNT of firing signals (capped at 2) — one bad sign
+degrades, two shed.  Once climbed, a rung holds for
+`service.brownout.hold-ms` before it may step back down (hysteresis:
+the boundary between shed and un-shed must not flap at request rate).
+Everything lands on /healthz (query_service) and the `resilience`
+metric group (`brownout_level` gauge, `brownout_sheds` counter).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from paimon_tpu.options import CoreOptions
+
+__all__ = ["BrownoutController", "RateWindow"]
+
+
+class RateWindow:
+    """Events-per-second over a trailing window (injectable clock);
+    O(1) amortized — old timestamps evict on record/rate."""
+
+    def __init__(self, window_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.window_s = window_s
+        self._clock = clock
+        self._events: deque = deque()
+        self._lock = threading.Lock()
+
+    def record(self):
+        now = self._clock()
+        with self._lock:
+            self._events.append(now)
+            self._trim(now)
+
+    def _trim(self, now: float):
+        horizon = now - self.window_s
+        while self._events and self._events[0] < horizon:
+            self._events.popleft()
+
+    def rate_per_s(self) -> float:
+        now = self._clock()
+        with self._lock:
+            self._trim(now)
+            return len(self._events) / self.window_s
+
+
+class BrownoutController:
+    """One per KvQueryServer; owns the process 'degraded' switch and
+    the admission shed threshold while active."""
+
+    # recent 429+504 rate that counts as a pressure signal (per
+    # second over the trailing window; saturation shows up here long
+    # before averages move)
+    FAILURE_RATE_PER_S = 1.0
+
+    def __init__(self, admission, options: CoreOptions, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.admission = admission
+        self.enabled = options.get(CoreOptions.SERVICE_BROWNOUT_ENABLED)
+        self.queue_ratio = options.get(
+            CoreOptions.SERVICE_BROWNOUT_QUEUE_RATIO)
+        self.shed_priority = options.get(
+            CoreOptions.SERVICE_BROWNOUT_SHED_PRIORITY)
+        self.hold_ms = options.get(CoreOptions.SERVICE_BROWNOUT_HOLD_MS)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._level = 0
+        self._held_until = 0.0
+        self.rejected = RateWindow(clock=clock)     # 429s
+        self.timeouts = RateWindow(clock=clock)     # 504s
+        from paimon_tpu.metrics import (
+            RESILIENCE_BROWNOUT_LEVEL, global_registry,
+        )
+        self._g_level = global_registry().resilience_metrics() \
+            .gauge(RESILIENCE_BROWNOUT_LEVEL)
+        self._g_level.set(0)
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def record_outcome(self, status: int):
+        """Feed one finished request's HTTP status into the failure-
+        rate signal (called by the server for every response)."""
+        if status == 429:
+            self.rejected.record()
+        elif status == 504:
+            self.timeouts.record()
+
+    def signals(self) -> Dict[str, object]:
+        """The three pressure signals, as /healthz reports them."""
+        from paimon_tpu.fs.resilience import breaker_states
+        states = breaker_states()
+        depth = self.admission.queued
+        cap = max(1, self.admission.queue_depth)
+        fail_rate = self.rejected.rate_per_s() + \
+            self.timeouts.rate_per_s()
+        return {
+            "breakers_open": any(s != "closed" for s in states.values()),
+            "breaker_states": states,
+            "queue_ratio": depth / cap,
+            "queue_pressure": depth / cap >= self.queue_ratio,
+            "failure_rate_per_s": fail_rate,
+            "failure_pressure": fail_rate >= self.FAILURE_RATE_PER_S,
+        }
+
+    def observe(self) -> int:
+        """Recompute the rung and apply its actions; returns the
+        level.  Cheap enough to call per request."""
+        if not self.enabled:
+            return 0
+        sig = self.signals()
+        target = min(2, int(sig["breakers_open"])
+                     + int(sig["queue_pressure"])
+                     + int(sig["failure_pressure"]))
+        with self._lock:
+            now = self._clock()
+            if target > self._level:
+                self._apply_locked(target, now)
+            elif target < self._level and now >= self._held_until:
+                self._apply_locked(target, now)
+            return self._level
+
+    def _apply_locked(self, level: int, now: float):
+        from paimon_tpu.fs.resilience import set_degraded_for
+        self._level = level
+        self._held_until = now + self.hold_ms / 1000.0
+        self._g_level.set(level)
+        # per-SOURCE: several servers in one process each vote; the
+        # process degrades while any of them is browned out
+        set_degraded_for(self, level >= 1)
+        self.admission.set_shed_below(
+            self.shed_priority if level >= 2 else 0)
+
+    def reset(self):
+        """Restore rung 0 unconditionally (server shutdown: the
+        process-wide degraded switch must not outlive the server that
+        set it)."""
+        with self._lock:
+            self._apply_locked(0, self._clock())
+            self._held_until = 0.0
+
+    def healthz(self) -> Dict[str, object]:
+        """The /healthz body: brownout rung, signals, admission
+        pressure and hedging state in one place."""
+        sig = self.signals()
+        return {
+            "status": "ok" if self._level == 0 else "brownout",
+            "brownout_level": self._level,
+            "breakers": sig["breaker_states"],
+            "queue_depth": self.admission.queued,
+            "queue_capacity": self.admission.queue_depth,
+            "inflight_bytes": self.admission.inflight_bytes,
+            "recent_429_per_s": self.rejected.rate_per_s(),
+            "recent_504_per_s": self.timeouts.rate_per_s(),
+            "hedging_enabled": _hedging_on(),
+            "shedding_below_priority":
+                self.shed_priority if self._level >= 2 else None,
+        }
+
+
+def _hedging_on() -> bool:
+    from paimon_tpu.fs.resilience import hedging_allowed
+    return hedging_allowed()

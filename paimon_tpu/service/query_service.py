@@ -148,6 +148,13 @@ class KvQueryServer:
         self._scan_row_bytes = opts.get(CoreOptions.SERVICE_SCAN_ROW_BYTES)
         self._lookup_key_bytes = opts.get(
             CoreOptions.SERVICE_LOOKUP_KEY_BYTES)
+        # tail tolerance: default end-to-end deadline (clients may
+        # override per request with 'timeout_ms' / the
+        # X-Request-Timeout-Ms header) + the brownout ladder
+        self._request_timeout = opts.get(
+            CoreOptions.SERVICE_REQUEST_TIMEOUT)
+        from paimon_tpu.service.brownout import BrownoutController
+        self.brownout = BrownoutController(self.admission, opts)
         from paimon_tpu.metrics import (
             SERVICE_CHANGELOG_MS, SERVICE_LOOKUP_KEYS, SERVICE_LOOKUP_MS,
             SERVICE_SCAN_MS, global_registry,
@@ -235,6 +242,8 @@ class KvQueryServer:
         self.services.unregister(PRIMARY_KEY_LOOKUP)
         self.httpd.shutdown()
         self.httpd.server_close()
+        # the process-wide degraded switch must not outlive the server
+        self.brownout.reset()
         with self._query_lock:
             if self._query is not None:
                 self._query.close()
@@ -257,6 +266,25 @@ class KvQueryServer:
                 stage latency histograms) in text exposition 0.0.4,
                 rendered from MetricRegistry.snapshot_rows — the same
                 serialization the $metrics system table queries."""
+                if self.path == "/healthz":
+                    # tail-tolerance introspection: brownout rung,
+                    # breaker states, queue pressure, recent 429/504
+                    # rates — the operator's one-glance view of HOW
+                    # degraded the plane currently is
+                    try:
+                        server.brownout.observe()
+                        body = json.dumps(
+                            server.brownout.healthz()).encode()
+                        status = 200
+                    except Exception as e:      # noqa: BLE001
+                        body = json.dumps({"error": str(e)}).encode()
+                        status = 500
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path != "/metrics":
                     self.send_error(404)
                     return
@@ -289,10 +317,53 @@ class KvQueryServer:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
                 import time as _time
+
+                from paimon_tpu.utils.deadline import (
+                    DeadlineExceededError, deadline_scope,
+                )
+                # end-to-end deadline: client-supplied per request
+                # (body 'timeout_ms' or X-Request-Timeout-Ms header)
+                # else service.request.timeout; every blocking wait
+                # downstream (admission queue, prefetch byte budget,
+                # retry sleeps, store IO) honors it
+                timeout_ms = req.get("timeout_ms")
+                if timeout_ms is None:
+                    timeout_ms = self.headers.get(
+                        "X-Request-Timeout-Ms")
+                if timeout_ms is None:
+                    timeout_ms = server._request_timeout
+                # NOTE explicit None checks, not `or`: timeout_ms=0
+                # is a real (already-expired) deadline the caller
+                # asked for, not an absent one
+                if timeout_ms is not None:
+                    try:
+                        timeout_ms = float(timeout_ms)
+                    except (TypeError, ValueError):
+                        # malformed CLIENT input is a 400, not a 500
+                        body = json.dumps(
+                            {"error": f"invalid timeout_ms: "
+                                      f"{timeout_ms!r}"}).encode()
+                        self.send_response(400)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                server.brownout.observe()
                 t0 = _time.perf_counter()
                 try:
-                    body = json.dumps(handle(req)).encode()
+                    with deadline_scope(timeout_ms):
+                        body = json.dumps(handle(req)).encode()
                     status = 200
+                except DeadlineExceededError as e:
+                    # the request's budget is spent: in-flight work
+                    # for it was cancelled/abandoned downstream; tell
+                    # the caller the truth with a 504
+                    body = json.dumps({"error": str(e),
+                                       "deadline": True}).encode()
+                    status = 504
                 except AdmissionRejected as e:
                     body = json.dumps({"error": str(e),
                                        "busy": True}).encode()
@@ -300,10 +371,12 @@ class KvQueryServer:
                 except Exception as e:      # noqa: BLE001
                     body = json.dumps({"error": str(e)}).encode()
                     status = 500
-                if status != 429:
-                    # 429s spent their time in the admission queue —
-                    # that wait is admission_wait_ms/rejected's story;
-                    # folding up-to-queue-timeout samples into the
+                server.brownout.record_outcome(status)
+                if status not in (429, 504):
+                    # 429s spent their time in the admission queue and
+                    # 504s are deadline-bounded by construction —
+                    # admission_wait_ms / rejected / deadline_exceeded
+                    # tell those stories; folding them into the
                     # service-time histograms would corrupt p95/p99
                     timer.update((_time.perf_counter() - t0) * 1000.0)
                 self.send_response(status)
@@ -316,10 +389,19 @@ class KvQueryServer:
             def _tenant(req) -> str:
                 return str(req.get("tenant") or "default")
 
+            @staticmethod
+            def _priority(req) -> int:
+                from paimon_tpu.service.admission import DEFAULT_PRIORITY
+                try:
+                    return int(req.get("priority", DEFAULT_PRIORITY))
+                except (TypeError, ValueError):
+                    return DEFAULT_PRIORITY
+
             def _lookup(self, req):
                 keys = req["keys"]
                 est = max(1, len(keys)) * server._lookup_key_bytes
-                with server.admission.acquire(self._tenant(req), est):
+                with server.admission.acquire(self._tenant(req), est,
+                                              self._priority(req)):
                     rows = server.query().lookup(
                         [{k: _decode_value(v) for k, v in d.items()}
                          for d in keys],
@@ -345,7 +427,8 @@ class KvQueryServer:
                 limit = int(req.get("max_rows")
                             or server.changelog_max_rows)
                 est = max(1, limit) * server._scan_row_bytes
-                with server.admission.acquire(self._tenant(req), est), \
+                with server.admission.acquire(self._tenant(req), est,
+                                              self._priority(req)), \
                         server._streams_lock:
                     entry = server._streams.get(consumer)
                     if entry is None:
@@ -379,7 +462,8 @@ class KvQueryServer:
                                     for f in s.data_files)
                         extra = max(0, delta - est)
                         with server.admission.acquire(
-                                self._tenant(req), extra) \
+                                self._tenant(req), extra,
+                                self._priority(req)) \
                                 if extra else _NULLCTX:
                             entry["pending"] = server.table \
                                 .new_read_builder().new_read() \
@@ -407,7 +491,8 @@ class KvQueryServer:
                 limit = req.get("limit")
                 limit = 10_000 if limit is None else int(limit)
                 est = max(1, limit) * server._scan_row_bytes
-                with server.admission.acquire(self._tenant(req), est):
+                with server.admission.acquire(self._tenant(req), est,
+                                              self._priority(req)):
                     rb = server.table.new_read_builder()
                     if req.get("projection"):
                         rb = rb.with_projection(
@@ -436,7 +521,9 @@ class KvQueryClient:
     """
 
     def __init__(self, table=None, address: Optional[str] = None,
-                 tenant: str = "default"):
+                 tenant: str = "default",
+                 priority: Optional[int] = None,
+                 timeout_ms: Optional[float] = None):
         if address is None:
             if table is None:
                 raise ValueError("need a table or an address")
@@ -448,6 +535,8 @@ class KvQueryClient:
             address = addrs[0]
         self.address = address.rstrip("/")
         self.tenant = tenant
+        self.priority = priority          # None = server default (100)
+        self.timeout_ms = timeout_ms      # per-request deadline -> 504
         hostport = self.address.split("://", 1)[-1]
         host, _, port = hostport.partition(":")
         self._host = host
@@ -486,6 +575,10 @@ class KvQueryClient:
         instead of silently skipping a batch."""
         body = dict(body)
         body.setdefault("tenant", self.tenant)
+        if self.priority is not None:
+            body.setdefault("priority", self.priority)
+        if self.timeout_ms is not None:
+            body.setdefault("timeout_ms", self.timeout_ms)
         payload = json.dumps(body).encode()
         headers = {"Content-Type": "application/json"}
         with self._lock:
@@ -534,7 +627,31 @@ class KvQueryClient:
                 if status == 429:
                     raise ServiceBusyError(
                         f"{endpoint} rejected: {detail}")
+                if status == 504:
+                    from paimon_tpu.utils.deadline import (
+                        DeadlineExceededError,
+                    )
+                    raise DeadlineExceededError(
+                        f"{endpoint} timed out server-side: {detail}")
                 raise RuntimeError(f"{endpoint} failed: {detail}")
+
+    def healthz(self) -> dict:
+        """GET /healthz: brownout rung, breaker states, queue depth
+        and recent 429/504 rates (one-shot connection — health checks
+        must not contend on the request socket)."""
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"healthz failed: {resp.status} "
+                    f"{data.decode(errors='replace')}")
+            return json.loads(data)
+        finally:
+            conn.close()
 
     def lookup(self, keys: List[dict],
                partition: tuple = ()) -> List[Optional[dict]]:

@@ -2,10 +2,25 @@
 
 reference: paimon-core/.../utils/SnapshotManager.java (snapshot/snapshot-N,
 EARLIEST/LATEST hints that may be stale; full scan as fallback).
+
+Latest-snapshot cache (tail-tolerance PR satellite, ROADMAP item 5
+residual): one commit used to pay ~5 `latest_snapshot()` walks, each
+2-3 store round trips (hint read + exists probe + forward walk +
+snapshot JSON read) — the chain that kept small-batch ingest
+latency-bound.  A validated per-manager cache cuts each walk to 1-2
+`exists` probes: the cached id N is trusted iff snapshot-(N+1) is
+absent AND snapshot-N still exists (guards external rollback), and a
+newer commit just walks forward FROM the cache instead of from the
+hint.  Invalidation is CAS-bumped: `try_commit` advances the cache on
+a win AND on a loss (the contested id provably exists — the winner
+wrote it), `delete_snapshot` of the cached tip drops it.  Correctness
+never depends on the cache: every path re-probes the store before
+answering, so a stale cache costs round trips, not wrong answers.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional
 
 from paimon_tpu.fs import FileIO
@@ -24,6 +39,13 @@ class SnapshotManager:
         self.file_io = file_io
         self.table_path = table_path.rstrip("/")
         self.branch = branch or "main"
+        self._cache_lock = threading.Lock()
+        # id-ONLY cache, deliberately: rollback_to / fast_forward can
+        # delete and RECREATE a snapshot id with different content
+        # (even bypassing this manager — fast_forward writes through a
+        # fresh one), so the tip's JSON is re-read on every
+        # latest_snapshot(); only the walk to FIND the tip is cached
+        self._cached_latest_id: Optional[int] = None
 
     @property
     def snapshot_dir(self) -> str:
@@ -75,16 +97,44 @@ class SnapshotManager:
         ids = self._all_ids()
         return ids[0] if ids else None
 
+    def _note_latest(self, snapshot_id: int):
+        with self._cache_lock:
+            self._cached_latest_id = snapshot_id
+
+    def _invalidate_latest(self):
+        with self._cache_lock:
+            self._cached_latest_id = None
+
     def latest_snapshot_id(self) -> Optional[int]:
+        with self._cache_lock:
+            cached = self._cached_latest_id
+        if cached is not None:
+            if not self.snapshot_exists(cached + 1):
+                if self.snapshot_exists(cached):
+                    return cached           # 2 probes, no hint read
+                # the cached tip vanished (external rollback): fall
+                # back to the full hint path below
+                self._invalidate_latest()
+            else:
+                # a newer commit landed: walk forward FROM the cache
+                i = cached + 1
+                while self.snapshot_exists(i + 1):
+                    i += 1
+                self._note_latest(i)
+                return i
         hint = self._hint(LATEST)
         if hint is not None and self.snapshot_exists(hint):
             # hint may be stale downward (newer commits); walk forward
             i = hint
             while self.snapshot_exists(i + 1):
                 i += 1
+            self._note_latest(i)
             return i
         ids = self._all_ids()
-        return ids[-1] if ids else None
+        if ids:
+            self._note_latest(ids[-1])
+            return ids[-1]
+        return None
 
     def latest_snapshot(self) -> Optional[Snapshot]:
         sid = self.latest_snapshot_id()
@@ -124,14 +174,21 @@ class SnapshotManager:
     # -- writes --------------------------------------------------------------
 
     def try_commit(self, snapshot: Snapshot) -> bool:
-        """Atomically publish snapshot-N; False if id taken (CAS)."""
+        """Atomically publish snapshot-N; False if id taken (CAS).
+        Both outcomes BUMP the latest cache: a win makes `snapshot`
+        the tip, a loss proves the contested id exists (the winner
+        wrote it), so the next walk starts there instead of at the
+        hint."""
         ok = self.file_io.try_to_write_atomic(
             self.snapshot_path(snapshot.id),
             snapshot.to_json().encode("utf-8"))
         if ok:
+            self._note_latest(snapshot.id)
             self.commit_latest_hint(snapshot.id)
             if snapshot.id == 1 or self._hint(EARLIEST) is None:
                 self.commit_earliest_hint(snapshot.id)
+        else:
+            self._note_latest(snapshot.id)
         return ok
 
     def commit_latest_hint(self, snapshot_id: int):
@@ -148,4 +205,10 @@ class SnapshotManager:
             pass  # hints are best-effort
 
     def delete_snapshot(self, snapshot_id: int):
+        with self._cache_lock:
+            if self._cached_latest_id is not None and \
+                    snapshot_id >= self._cached_latest_id:
+                # rollback at/past the cached tip (expiry only deletes
+                # OLD snapshots, which never affect the latest cache)
+                self._cached_latest_id = None
         self.file_io.delete_quietly(self.snapshot_path(snapshot_id))

@@ -48,6 +48,13 @@ class FileStoreTable:
         self.schema = table_schema.copy(opts) \
             if dynamic_options else table_schema
         self.options = CoreOptions(Options(opts))
+        if self.options.get(CoreOptions.STORE_BREAKER_ENABLED) or \
+                self.options.get(CoreOptions.READ_HEDGE_ENABLED):
+            # tail tolerance sits closest to the store, UNDER the
+            # caching wrap below: cache hits never pay breaker/hedge
+            # accounting, and every real store attempt does
+            from paimon_tpu.fs.resilience import maybe_wrap_resilience
+            file_io = maybe_wrap_resilience(file_io, self.options)
         disk_dir = self.options.get(CoreOptions.CACHE_DISK_DIR)
         if self.options.get(CoreOptions.READ_CACHE_RANGE) or disk_dir:
             from paimon_tpu.fs.caching import (
@@ -157,19 +164,26 @@ class FileStoreTable:
                  predicate: Optional[Predicate] = None,
                  with_row_ids: bool = False,
                  limit: Optional[int] = None) -> pa.Table:
-        rb = self.new_read_builder()
-        if projection:
-            rb = rb.with_projection(projection)
-        if predicate is not None:
-            rb = rb.with_filter(predicate)
-        if with_row_ids:
-            rb = rb.with_row_ids()
-        if limit is not None:
-            # pushed LIMIT: the pipelined read stops admitting splits
-            # once enough rows are buffered
-            rb = rb.with_limit(limit)
-        scan = rb.new_scan()
-        return rb.new_read().to_arrow(scan.plan().splits)
+        # request.timeout entry point covering the PLAN too: the
+        # manifest walk is store IO and must ride the same deadline
+        # as the read (TableRead.to_arrow's own entry scope only
+        # guards reads over pre-built plans)
+        from paimon_tpu.utils.deadline import deadline_scope
+        with deadline_scope(self.options.get(
+                CoreOptions.REQUEST_TIMEOUT), entry=True):
+            rb = self.new_read_builder()
+            if projection:
+                rb = rb.with_projection(projection)
+            if predicate is not None:
+                rb = rb.with_filter(predicate)
+            if with_row_ids:
+                rb = rb.with_row_ids()
+            if limit is not None:
+                # pushed LIMIT: the pipelined read stops admitting
+                # splits once enough rows are buffered
+                rb = rb.with_limit(limit)
+            scan = rb.new_scan()
+            return rb.new_read().to_arrow(scan.plan().splits)
 
     def compact(self, full: bool = False,
                 partition_filter: Optional[dict] = None) -> Optional[int]:
@@ -544,7 +558,20 @@ class TableCommit:
         TableCommitImpl#withWatermark).  `properties` are stored on the
         snapshot itself, atomically with the data — the stream daemon
         checkpoints its source offsets this way (exactly-once across
-        restarts); ignored on the overwrite path."""
+        restarts); ignored on the overwrite path.
+
+        A configured `request.timeout` installs an end-to-end deadline
+        (entry point): retry/CAS backoffs stop sleeping once it is
+        spent and the snapshot CAS is never attempted past it — a
+        timed-out commit raises instead of orphan-committing."""
+        from paimon_tpu.utils.deadline import deadline_scope
+        with deadline_scope(self.table.options.get(
+                CoreOptions.REQUEST_TIMEOUT), entry=True):
+            return self._commit_with_deadline(
+                messages, commit_identifier, watermark, properties)
+
+    def _commit_with_deadline(self, messages, commit_identifier,
+                              watermark, properties) -> Optional[int]:
         index_entries = [e for m in messages
                          for e in getattr(m, "index_entries", [])]
         # empty batch commits produce no snapshot unless forced
@@ -868,7 +895,15 @@ class TableRead:
             yield i, s, self._finalize(t, apply_limit=False)
 
     def to_arrow(self, splits) -> pa.Table:
-        """Accepts a ScanPlan or a list of DataSplits."""
+        """Accepts a ScanPlan or a list of DataSplits.  A configured
+        `request.timeout` installs an end-to-end deadline here (entry
+        point; an already-active request deadline wins)."""
+        from paimon_tpu.utils.deadline import deadline_scope
+        with deadline_scope(self.builder.table.options.get(
+                CoreOptions.REQUEST_TIMEOUT), entry=True):
+            return self._to_arrow(splits)
+
+    def _to_arrow(self, splits) -> pa.Table:
         if isinstance(splits, ScanPlan):
             split_list, streaming = splits.splits, splits.streaming
         else:

@@ -12,6 +12,14 @@ total stall time.
 Users: `RetryingObjectStoreBackend` (object-store 503 storms),
 `FileStoreCommit` (snapshot CAS races), and the mesh compaction
 engine's per-bucket retry ladder (parallel/fault.py).
+
+Every wait here is DEADLINE-AWARE (utils/deadline.py): when the
+calling request carries a deadline, `pause()` never sleeps past the
+remaining budget and raises DeadlineExceededError instead of starting
+a wait the caller cannot afford — a retry ladder can no longer hold a
+timed-out request hostage.  `wait_for()` is the same contract for
+one-shot waits (the tier-1 lint bans bare `time.sleep(` outside this
+module so no un-interruptible wait can creep back in).
 """
 
 from __future__ import annotations
@@ -20,7 +28,23 @@ import random
 import time
 from typing import Callable, Optional
 
-__all__ = ["Backoff"]
+__all__ = ["Backoff", "wait_for"]
+
+
+def wait_for(seconds: float, *,
+             sleep: Callable[[float], None] = time.sleep,
+             what: str = "wait"):
+    """One deadline-aware sleep: caps the wait to the current
+    deadline's remaining budget and raises DeadlineExceededError when
+    that budget is already spent.  THE sanctioned replacement for bare
+    `time.sleep` in library code (see the tier-1 lint)."""
+    from paimon_tpu.utils.deadline import current_deadline
+    dl = current_deadline()
+    if dl is not None:
+        dl.check(what)
+        seconds = min(seconds, dl.remaining_s())
+    if seconds > 0:
+        sleep(seconds)
 
 
 class Backoff:
@@ -77,11 +101,19 @@ class Backoff:
 
     def pause(self) -> bool:
         """Sleep for the next wait.  False (no sleep) when the
-        max-elapsed budget is already spent — time to give up."""
+        max-elapsed budget is already spent — time to give up.  When
+        the calling request carries a deadline (utils/deadline.py),
+        the wait is capped to its remaining budget and an
+        already-exceeded deadline raises DeadlineExceededError —
+        retry ladders stop sleeping the moment the caller is gone."""
         if self._started is None:
             self._started = self._clock()
         if self.budget_exhausted():
             return False
+        from paimon_tpu.utils.deadline import current_deadline
+        dl = current_deadline()
+        if dl is not None:
+            dl.check("retry backoff")
         wait = self.next_ms()
         if wait > 0:
             if self.max_elapsed_ms is not None:
@@ -89,5 +121,8 @@ class Backoff:
                 wait = min(wait,
                            max(0.0, self.max_elapsed_ms
                                - self.elapsed_ms()))
-            self._sleep(wait / 1000.0)
+            if dl is not None:
+                wait = min(wait, dl.remaining_ms())
+            if wait > 0:
+                self._sleep(wait / 1000.0)
         return True

@@ -1,5 +1,6 @@
 """Tier-1 lint: no NEW silent broad-exception swallowing in
-paimon_tpu/, and no bare thread construction outside parallel/.
+paimon_tpu/, no bare thread construction outside parallel/, and no
+bare `time.sleep(` outside utils/backoff.py.
 
 An `except Exception: pass` (or bare except / continue body) hides
 every error class — including the transient faults the maintenance
@@ -15,6 +16,15 @@ deliberate, local decisions.
 threads and pools go through parallel/executors.py (spawn_thread /
 new_thread_pool) so every worker carries an attributable name and the
 no-leaked-thread tier-1 tests can key on it.
+
+`time.sleep(` outside paimon_tpu/utils/backoff.py is banned: every
+wait in library code must be deadline-aware and injectable — either a
+`Backoff.pause()` (retry ladders) or `wait_for()` (one-shot waits),
+both of which cap to the current request deadline
+(utils/deadline.py) and raise once it is spent.  A bare sleep is an
+un-interruptible stall a timed-out request cannot escape.  Injectable
+sleeps stored as attributes (`self._sleep(...)`) are fine — only
+direct `time.sleep` / `from time import sleep` CALLS are flagged.
 """
 
 import ast
@@ -108,6 +118,55 @@ def _bare_thread_constructions():
                 if name == "Thread":
                     found.append(f"{rel}:{node.lineno}")
     return found
+
+
+def _bare_sleep_calls():
+    """Direct `time.sleep(...)` / `sleep(...)`-imported-from-time call
+    sites outside paimon_tpu/utils/backoff.py, as '<relpath>:<line>'
+    strings."""
+    found = []
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if rel == "paimon_tpu/utils/backoff.py":
+                continue       # the one reviewed home of real sleeps
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), rel)
+            # names bound by `from time import sleep` (any alias)
+            time_sleep_names = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) and \
+                        node.module == "time":
+                    for alias in node.names:
+                        if alias.name == "sleep":
+                            time_sleep_names.add(
+                                alias.asname or alias.name)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                hit = (isinstance(fn, ast.Attribute) and
+                       fn.attr == "sleep" and
+                       isinstance(fn.value, ast.Name) and
+                       fn.value.id in ("time", "_time")) or \
+                      (isinstance(fn, ast.Name) and
+                       fn.id in time_sleep_names)
+                if hit:
+                    found.append(f"{rel}:{node.lineno}")
+    return found
+
+
+def test_no_bare_sleeps_outside_backoff():
+    offenders = _bare_sleep_calls()
+    assert not offenders, (
+        f"bare time.sleep( outside utils/backoff.py — every wait must "
+        f"be deadline-aware/injectable: use Backoff.pause() for retry "
+        f"ladders or utils.backoff.wait_for() for one-shot waits: "
+        f"{sorted(offenders)}")
 
 
 def test_no_bare_threads_outside_parallel():

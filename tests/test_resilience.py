@@ -1,0 +1,946 @@
+"""Tail-tolerance plane tests: deadlines, hedged reads, circuit
+breakers, brownout, snapshot-hint cache.
+
+State machines run on injectable clocks; chaos regressions assert the
+two invariants that make hedging safe to ship: NO duplicate side
+effects (mutations are never hedged) and byte-identical results under
+heavy-tailed / stuck-store injection.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from paimon_tpu import Schema
+from paimon_tpu.fs.object_store import (
+    CircuitOpenError, LatencyInjectingObjectStoreBackend,
+    LocalObjectStoreBackend, ObjectStoreBackend, ObjectStoreFileIO,
+    RetryingObjectStoreBackend, TransientStoreError,
+)
+from paimon_tpu.fs.resilience import (
+    CircuitBreaker, LatencyTracker, ResilientObjectStoreBackend,
+    maybe_wrap_resilience, set_degraded,
+)
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+from paimon_tpu.utils.backoff import Backoff, wait_for
+from paimon_tpu.utils.deadline import (
+    Deadline, DeadlineExceededError, check_deadline, current_deadline,
+    deadline_scope,
+)
+
+
+class CountingBackend(ObjectStoreBackend):
+    """Counts every op per kind; optionally fails reads on demand."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.counts = {"put": 0, "get": 0, "head": 0, "list": 0,
+                       "delete": 0}
+        self.fail_reads = False
+        self._lock = threading.Lock()
+
+    def _tick(self, op):
+        with self._lock:
+            self.counts[op] += 1
+
+    def put(self, key, data, if_none_match=False):
+        self._tick("put")
+        return self.inner.put(key, data, if_none_match=if_none_match)
+
+    def get(self, key, offset=0, length=None):
+        self._tick("get")
+        if self.fail_reads:
+            raise TransientStoreError("injected 503")
+        return self.inner.get(key, offset, length)
+
+    def head(self, key):
+        self._tick("head")
+        if self.fail_reads:
+            raise TransientStoreError("injected 503")
+        return self.inner.head(key)
+
+    def list(self, prefix):
+        self._tick("list")
+        if self.fail_reads:
+            raise TransientStoreError("injected 503")
+        return self.inner.list(prefix)
+
+    def delete(self, key):
+        self._tick("delete")
+        return self.inner.delete(key)
+
+
+def _schema(**extra):
+    opts = {"bucket": "2"}
+    opts.update({k: str(v) for k, v in extra.items()})
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .primary_key("id")
+            .options(opts).build())
+
+
+def _fill(table, n=400, start=0):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": start + i, "v": float(start + i)}
+                   for i in range(n)])
+    wb.new_commit().commit(w.prepare_commit())
+    w.close()
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_deadline_scope_and_check():
+    clk = [0.0]
+    with deadline_scope(100, clock=lambda: clk[0]) as dl:
+        assert current_deadline() is dl
+        assert 99 < dl.remaining_ms() <= 100
+        check_deadline("t")                  # not exceeded: no raise
+        clk[0] = 0.2
+        assert dl.exceeded()
+        with pytest.raises(DeadlineExceededError):
+            check_deadline("t")
+    assert current_deadline() is None
+    check_deadline("no scope: never raises")
+
+
+def test_deadline_entry_scope_outer_wins():
+    with deadline_scope(50_000) as outer:
+        # a table-level request.timeout must NOT shorten or extend an
+        # active service deadline
+        with deadline_scope(1, entry=True) as inner:
+            assert inner is outer
+            assert current_deadline() is outer
+
+
+def test_deadline_none_is_noop():
+    with deadline_scope(None) as dl:
+        assert dl is None
+        assert current_deadline() is None
+
+
+def test_deadline_counts_metric_once():
+    from paimon_tpu.metrics import (
+        RESILIENCE_DEADLINE_EXCEEDED, global_registry,
+    )
+    c = global_registry().resilience_metrics().counter(
+        RESILIENCE_DEADLINE_EXCEEDED)
+    before = c.count
+    clk = [0.0]
+    with pytest.raises(DeadlineExceededError):
+        with deadline_scope(10, clock=lambda: clk[0]):
+            clk[0] = 1.0
+            check_deadline("x")
+    assert c.count == before + 1
+
+
+def test_deadline_propagates_into_thread_pool():
+    from paimon_tpu.parallel.executors import new_thread_pool
+    pool = new_thread_pool(1, "dl-test")
+    try:
+        with deadline_scope(60_000) as dl:
+            seen = pool.submit(current_deadline).result()
+            assert seen is dl
+        assert pool.submit(current_deadline).result() is None
+    finally:
+        pool.shutdown()
+
+
+def test_backoff_pause_honors_deadline():
+    clk = [0.0]
+    sleeps = []
+    with deadline_scope(100, clock=lambda: clk[0]):
+        b = Backoff(1000.0, sleep=sleeps.append, clock=lambda: clk[0])
+        b.pause()
+        # the 1000ms base wait was capped to the 100ms budget
+        assert sleeps and sleeps[0] <= 0.1001
+        clk[0] = 0.2
+        with pytest.raises(DeadlineExceededError):
+            b.pause()
+
+
+def test_wait_for_honors_deadline():
+    clk = [0.0]
+    sleeps = []
+    with deadline_scope(50, clock=lambda: clk[0]):
+        wait_for(10.0, sleep=sleeps.append)
+        assert sleeps and sleeps[0] <= 0.0501
+        clk[0] = 1.0
+        with pytest.raises(DeadlineExceededError):
+            wait_for(0.001, sleep=sleeps.append)
+
+
+def test_deadline_not_transient_not_corrupt_skippable():
+    from paimon_tpu.options import CoreOptions, Options
+    from paimon_tpu.parallel.fault import is_transient_error
+    from paimon_tpu.parallel.scan_pipeline import read_or_skip_corrupt
+    assert not is_transient_error(DeadlineExceededError("x"))
+    opts = CoreOptions(Options({"scan.ignore-corrupt-files": "true"}))
+
+    def boom():
+        raise DeadlineExceededError("spent")
+
+    with pytest.raises(DeadlineExceededError):
+        read_or_skip_corrupt(boom, opts, "f")
+
+
+# -- latency tracker ---------------------------------------------------------
+
+def test_latency_tracker_quantiles_and_cold_model():
+    t = LatencyTracker(window=100, min_samples=10)
+    assert t.percentile_ms("get", 95) is None       # cold: no hedging
+    for i in range(100):
+        t.record("get", float(i))
+    p95 = t.percentile_ms("get", 95)
+    assert 90 <= p95 <= 99
+    assert t.percentile_ms("head", 95) is None      # per-op-class
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_consecutive_failures_trip_and_recover():
+    clk = [0.0]
+    b = CircuitBreaker("t1", failure_threshold=3, open_ms=1000,
+                       clock=lambda: clk[0])
+    assert b.state == "closed"
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()                    # fail fast
+    clk[0] = 0.9
+    assert not b.allow()                    # still open
+    clk[0] = 1.01
+    assert b.allow()                        # half-open probe admitted
+    assert b.state == "half_open"
+    assert not b.allow()                    # only 1 probe slot
+    b.record_success()
+    assert b.state == "closed"
+    assert b.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    clk = [0.0]
+    b = CircuitBreaker("t2", failure_threshold=1, open_ms=1000,
+                       clock=lambda: clk[0])
+    b.record_failure()
+    assert b.state == "open"
+    clk[0] = 1.1
+    assert b.allow()
+    b.record_failure()                      # probe failed
+    assert b.state == "open"
+    clk[0] = 2.0
+    assert not b.allow()                    # timer re-armed at 1.1
+    clk[0] = 2.2
+    assert b.allow()
+
+
+def test_breaker_error_rate_trips_without_consecutive_run():
+    clk = [0.0]
+    b = CircuitBreaker("t3", failure_threshold=100, error_rate=0.5,
+                       window=8, open_ms=1000, clock=lambda: clk[0])
+    # alternate success/failure: never 2 consecutive, rate = 50%
+    for _ in range(5):
+        b.record_failure()
+        if b.state == "open":
+            break
+        b.record_success()
+    assert b.state == "open"
+
+
+def test_breaker_half_open_lost_probe_heals():
+    """Regression (review): a probe whose outcome is never recorded
+    (hung store call, or an exception outside the recorded taxonomy)
+    must not wedge the breaker in HALF_OPEN with zero slots forever —
+    after another open-ms of silence, fresh probes are granted."""
+    clk = [0.0]
+    b = CircuitBreaker("t-wedge", failure_threshold=1, open_ms=1000,
+                       clock=lambda: clk[0])
+    b.record_failure()
+    clk[0] = 1.1
+    assert b.allow()                        # probe slot consumed ...
+    # ... and its outcome never recorded (probe hung)
+    clk[0] = 1.5
+    assert not b.allow()                    # still waiting on the probe
+    clk[0] = 2.2                            # open-ms past half-open entry
+    assert b.allow()                        # healed: fresh probe slot
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_probe_lost_cas_counts_success(tmp_path):
+    """Regression (review): PreconditionFailed (a LOST CAS) is an
+    authoritative store answer — breaker success, never an
+    outcome-less consumed probe slot."""
+    from paimon_tpu.fs.object_store import PreconditionFailed
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"theirs")
+    clk = [0.0]
+    b = CircuitBreaker("t-cas", failure_threshold=1, open_ms=1000,
+                       clock=lambda: clk[0])
+    res = ResilientObjectStoreBackend(inner, breaker=b)
+    b.record_failure()
+    clk[0] = 1.1                            # half-open
+    with pytest.raises(PreconditionFailed):
+        res.put("k", b"ours", if_none_match=True)   # the probe: lost CAS
+    assert b.state == "closed"              # authoritative answer healed it
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker("t4", failure_threshold=3, error_rate=1.0,
+                       window=1000)
+    for _ in range(10):
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_open_fails_fast_through_retry_ladder(tmp_path):
+    """Acceptance: breaker-open calls fail in <10ms instead of riding
+    the retry ladder's backoff sleeps."""
+    counting = CountingBackend(
+        LocalObjectStoreBackend(str(tmp_path / "b")))
+    breaker = CircuitBreaker("t5", failure_threshold=2, open_ms=60_000)
+    res = ResilientObjectStoreBackend(counting, name="t5",
+                                      breaker=breaker)
+    retry = RetryingObjectStoreBackend(res, max_attempts=6,
+                                       backoff_s=1.0)
+    counting.fail_reads = True
+    with pytest.raises(TransientStoreError):
+        retry.get("k")                      # trips the breaker inside
+    assert breaker.state == "open"
+    before = counting.counts["get"]
+    t0 = time.perf_counter()
+    with pytest.raises(CircuitOpenError):
+        retry.get("k")
+    elapsed_ms = (time.perf_counter() - t0) * 1000
+    assert elapsed_ms < 10, f"breaker-open call took {elapsed_ms:.1f}ms"
+    assert counting.counts["get"] == before     # zero store traffic
+
+
+# -- hedged reads ------------------------------------------------------------
+
+def _warm_resilient(counting, **kw):
+    res = ResilientObjectStoreBackend(counting, hedge_enabled=True,
+                                      **kw)
+    res.tracker = LatencyTracker(min_samples=5)
+    return res
+
+
+def test_hedge_fires_and_first_success_wins(tmp_path):
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"payload")
+    lat = LatencyInjectingObjectStoreBackend(inner, base_ms=0.5,
+                                             seed=3)
+    counting = CountingBackend(lat)
+    res = _warm_resilient(counting, hedge_min_delay_ms=1.0,
+                          hedge_max_ratio=0.5)
+    for _ in range(30):
+        assert res.get("k") == b"payload"
+    # one stuck request: the hedge must answer long before 2s
+    lat.stuck_rate, lat.stuck_ms = 1.0, 2000.0
+    issued_before = res._hedges
+
+    stuck_once = [True]
+    orig_delay = lat._delay
+
+    def delay_once(op):
+        if stuck_once[0]:
+            stuck_once[0] = False
+            orig_delay(op)                   # pays the 2s stall
+        else:
+            lat.stuck_rate = 0.0
+            orig_delay(op)
+
+    lat._delay = delay_once
+    t0 = time.perf_counter()
+    assert res.get("k") == b"payload"
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.0, f"hedge did not rescue: {elapsed:.2f}s"
+    assert res._hedges == issued_before + 1
+    from paimon_tpu.metrics import (
+        RESILIENCE_HEDGES_WON, global_registry,
+    )
+    assert global_registry().resilience_metrics().counter(
+        RESILIENCE_HEDGES_WON).count >= 1
+
+
+def test_hedge_rate_cap(tmp_path):
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"x")
+    slow = LatencyInjectingObjectStoreBackend(inner, base_ms=3.0,
+                                              seed=1)
+    counting = CountingBackend(slow)
+    res = _warm_resilient(counting, hedge_min_delay_ms=0.1,
+                          hedge_max_ratio=0.05)
+    # constant-latency ops: EVERY op exceeds its p95-of-equal-values
+    # delay, so only the cap can hold hedges down
+    for _ in range(100):
+        res.get("k")
+    assert res._hedges <= 0.05 * res._ops + 1
+    assert counting.counts["get"] <= 106    # <=5% duplicated + slack
+
+
+def test_hedge_never_on_mutations(tmp_path):
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    lat = LatencyInjectingObjectStoreBackend(inner, base_ms=0.2, seed=2)
+    counting = CountingBackend(lat)
+    res = _warm_resilient(counting, hedge_min_delay_ms=0.1,
+                          hedge_max_ratio=1.0)
+    inner.put("warm", b"w")
+    for _ in range(20):
+        res.get("warm")
+    # slow EVERY op: if mutations could hedge, these would duplicate
+    lat.base_ms = 50.0
+    res.put("k1", b"v1")
+    res.delete("k1")
+    assert counting.counts["put"] == 1      # exactly one store PUT
+    assert counting.counts["delete"] == 1   # exactly one store DELETE
+
+
+def test_hedge_disabled_under_brownout(tmp_path):
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"x")
+    counting = CountingBackend(
+        LatencyInjectingObjectStoreBackend(inner, base_ms=2.0, seed=1))
+    res = _warm_resilient(counting, hedge_min_delay_ms=0.1,
+                          hedge_max_ratio=1.0)
+    for _ in range(10):
+        res.get("k")
+    set_degraded(True)
+    try:
+        before = res._hedges
+        for _ in range(10):
+            res.get("k")
+        assert res._hedges == before        # no hedges while degraded
+    finally:
+        set_degraded(False)
+
+
+def test_hedged_missing_key_raises_immediately(tmp_path):
+    """Regression (review): FileNotFoundError is an authoritative
+    answer — the hedged wait raises it at once instead of waiting out
+    the straggling loser (whose later error must not overwrite it)."""
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("warm", b"w")
+    lat = LatencyInjectingObjectStoreBackend(inner, base_ms=0.5, seed=3)
+    res = _warm_resilient(CountingBackend(lat), hedge_min_delay_ms=0.5,
+                          hedge_max_ratio=1.0)
+    for _ in range(20):
+        res.get("warm")
+    # ONLY the primary stalls 2s; the hedge fires and its FNF must
+    # win immediately instead of waiting out the stuck loser
+    lat.stuck_rate, lat.stuck_ms = 1.0, 2000.0
+    calls = [0]
+    orig_delay = lat._delay
+
+    def delay_first_only(op):
+        calls[0] += 1
+        if calls[0] > 1:
+            lat.stuck_rate = 0.0
+        orig_delay(op)
+
+    lat._delay = delay_first_only
+    t0 = time.perf_counter()
+    with pytest.raises(FileNotFoundError):
+        res.get("absent-key")
+    assert time.perf_counter() - t0 < 1.5
+    res.close()
+
+
+def test_spent_deadline_does_not_eat_half_open_probe(tmp_path):
+    """Regression (review): the deadline check runs BEFORE the
+    breaker gate, so a spent deadline cannot consume the only
+    half-open probe slot outcome-less."""
+    clk = [0.0]
+    b = CircuitBreaker("t-slot", failure_threshold=1, open_ms=1000,
+                       clock=lambda: clk[0])
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"x")
+    res = ResilientObjectStoreBackend(inner, breaker=b)
+    b.record_failure()
+    clk[0] = 1.1                            # half-open window reached
+    dclk = [0.0]
+    with deadline_scope(10, clock=lambda: dclk[0]):
+        dclk[0] = 1.0                       # spent
+        with pytest.raises(DeadlineExceededError):
+            res.get("k")
+    # the spent-deadline call raised BEFORE the breaker gate, so the
+    # probe slot is still available to a healthy caller right now —
+    # no outcome-less consumption, no open_ms re-wait
+    assert res.get("k") == b"x"
+    assert b.state == "closed"
+
+
+def test_degraded_switch_aggregates_across_sources():
+    """Regression (review): two serving planes in one process — one
+    recovering must not clear the other's active brownout."""
+    from paimon_tpu.fs.resilience import is_degraded, set_degraded_for
+    a, b = object(), object()
+    set_degraded_for(a, True)
+    set_degraded_for(b, True)
+    set_degraded_for(b, False)
+    assert is_degraded()                    # a still browned out
+    set_degraded_for(a, False)
+    assert not is_degraded()
+
+
+def test_service_invalid_timeout_is_400(tmp_path):
+    """Regression (review): a malformed timeout_ms is the client's
+    error (400), not a server 500."""
+    from paimon_tpu.service.query_service import KvQueryClient, KvQueryServer
+    t = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    _fill(t, 10)
+    srv = KvQueryServer(t).start()
+    try:
+        c = KvQueryClient(address=srv.address)
+        with pytest.raises(RuntimeError, match="invalid timeout_ms"):
+            c._post("scan", {"limit": 5, "timeout_ms": "1s"},
+                    timeout=30)
+    finally:
+        srv.stop()
+
+
+def test_deadline_abandons_stuck_read(tmp_path):
+    """A HUNG store GET (stall, not error) cannot outlive the
+    deadline: with hedging enabled the resilient wrapper abandons
+    the in-flight call mid-flight — even on a COLD latency model
+    (no hedge fires yet, but the pooled wait still bounds it)."""
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"x")
+    lat = LatencyInjectingObjectStoreBackend(inner, base_ms=0.2, seed=1)
+    res = ResilientObjectStoreBackend(lat, hedge_enabled=True)
+    lat.stuck_rate, lat.stuck_ms = 1.0, 5000.0
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        with deadline_scope(100):
+            res.get("k")
+    assert time.perf_counter() - t0 < 2.0   # did NOT wait out the hang
+    res.close()
+
+
+def test_breaker_only_reads_stay_inline_under_deadline(tmp_path):
+    """Hedging off: a deadline in scope must NOT funnel reads through
+    the hedge pool (no pool is ever built) — breaker-only configs pay
+    zero dispatch overhead and are bounded cooperatively."""
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    inner.put("k", b"x")
+    res = ResilientObjectStoreBackend(inner, hedge_enabled=False,
+                                      breaker=CircuitBreaker("inl"))
+    with deadline_scope(60_000):
+        assert res.get("k") == b"x"
+    assert res._pool is None
+
+
+def test_mutations_proceed_with_spent_deadline(tmp_path):
+    """Regression (review): the commit's deadline-abort cleanup runs
+    exactly when the deadline is already spent — its deletes must
+    still reach the store through the resilient wrapper, or every
+    504'd commit orphans its manifests."""
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    res = ResilientObjectStoreBackend(inner, hedge_enabled=True)
+    clk = [0.0]
+    with deadline_scope(10, clock=lambda: clk[0]):
+        clk[0] = 1.0                       # spent
+        res.put("k", b"x")                 # no raise: CAS gate owns it
+        assert inner.head("k") is not None
+        assert res.delete("k")
+        assert inner.head("k") is None
+    res.close()
+
+
+@pytest.mark.parametrize("slow_shape", ["all-ops", "puts-only"])
+def test_commit_deadline_abort_cleans_manifests(tmp_path, slow_shape):
+    """End-to-end: a commit that trips its request.timeout before the
+    CAS publishes NOTHING — no new snapshot, and every manifest/list
+    written for the aborted attempt is deleted (through the resilient
+    wrapper: the cleanup deletes are SHIELDED from the spent
+    deadline).  'puts-only' makes the deadline trip AFTER the
+    manifests are written (reads stay fast, the budget burns on the
+    manifest PUTs), exercising the real cleanup-delete path."""
+    store = LocalObjectStoreBackend(str(tmp_path / "b"))
+    lat = LatencyInjectingObjectStoreBackend(store, base_ms=0.0, seed=1)
+    fio = ObjectStoreFileIO(lat, scheme=f"dlc{slow_shape[0]}://")
+    t = FileStoreTable.create(
+        f"dlc{slow_shape[0]}://t",
+        _schema(**{"store.breaker.enabled": "true"}),
+        file_io=fio)
+    _fill(t, 100)
+    manifests_before = {k for k, _ in store.list("t/manifest/")}
+    t2 = t.copy({"request.timeout": "40"})
+    wb = t2.new_batch_write_builder()
+    w = wb.new_write()
+    w.write_dicts([{"id": i, "v": 1.0} for i in range(1000, 1100)])
+    msgs = w.prepare_commit()              # data uploads: still fast
+    lat.base_ms = 15.0 if slow_shape == "all-ops" else {"put": 30.0}
+    with pytest.raises(DeadlineExceededError):
+        wb.new_commit().commit(msgs)
+    lat.base_ms = 0.0
+    w.close()
+    assert t.snapshot_manager.latest_snapshot_id() == 1   # nothing published
+    manifests_after = {k for k, _ in store.list("t/manifest/")}
+    assert manifests_after == manifests_before, \
+        manifests_after - manifests_before
+
+
+def test_delete_quietly_shielded_from_spent_deadline(tmp_path):
+    """Regression (review): best-effort cleanup deletes run exactly
+    when the deadline is spent — the shield keeps the store op from
+    raising-and-being-swallowed into an orphaning no-op, even through
+    a hedge-enabled resilient wrapper whose delete() probes head()."""
+    from paimon_tpu.options import CoreOptions, Options
+    inner = LocalObjectStoreBackend(str(tmp_path / "b"))
+    fio = ObjectStoreFileIO(inner, scheme="shield://")
+    opts = CoreOptions(Options({"read.hedge.enabled": "true"}))
+    wrapped = maybe_wrap_resilience(fio, opts)
+    wrapped.write_bytes("shield://k", b"x")
+    clk = [0.0]
+    with deadline_scope(10, clock=lambda: clk[0]):
+        clk[0] = 1.0                       # spent
+        wrapped.delete_quietly("shield://k")
+    assert inner.head("k") is None, "cleanup delete was a no-op"
+
+
+def test_copy_enables_resilience_under_cache_wrap(tmp_path):
+    """Regression (review): enabling breaker/hedge via
+    table.copy() on a cache-wrapped table (read.cache.range) must
+    thread resilience UNDER the cache, not silently no-op."""
+    from paimon_tpu.fs.caching import CachingFileIO
+    store = LocalObjectStoreBackend(str(tmp_path / "b"))
+    fio = ObjectStoreFileIO(store, scheme="cw://")
+    t = FileStoreTable.create(
+        "cw://t", _schema(**{"read.cache.range": "true"}),
+        file_io=fio)
+    _fill(t, 50)
+    assert isinstance(t.file_io, CachingFileIO)
+    t2 = t.copy({"store.breaker.enabled": "true"})
+    assert isinstance(t2.file_io, CachingFileIO)
+    assert isinstance(t2.file_io.inner, ObjectStoreFileIO)
+    assert isinstance(t2.file_io.inner.backend,
+                      ResilientObjectStoreBackend)
+    # same shared cache state, rows intact
+    assert t2.file_io.state is t.file_io.state
+    assert t2.to_arrow().num_rows == 50
+
+
+def test_service_timeout_zero_is_a_real_deadline(tmp_path):
+    """Regression (review): timeout_ms=0 means 'already expired'
+    (immediate 504), not 'no deadline'."""
+    from paimon_tpu.service.query_service import KvQueryClient, KvQueryServer
+    t = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    _fill(t, 20)
+    srv = KvQueryServer(t).start()
+    try:
+        c = KvQueryClient(address=srv.address, timeout_ms=0)
+        with pytest.raises(DeadlineExceededError):
+            c.scan(limit=10)
+    finally:
+        srv.stop()
+
+
+# -- chaos regression: identical rows, no duplicate side effects -------------
+
+def test_chaos_hedged_scan_byte_identical(tmp_path):
+    """Under a 10%-of-GETs-50x tail plus hedging, scans return exactly
+    the rows an unhedged table returns, and the chaos run issues ZERO
+    extra mutations (fsck-grade safety for reads)."""
+    plain_store = LocalObjectStoreBackend(str(tmp_path / "b"))
+    fio_plain = ObjectStoreFileIO(plain_store, scheme="objfs://")
+    t_plain = FileStoreTable.create("objfs://t", _schema(),
+                                    file_io=fio_plain)
+    _fill(t_plain, 600)
+    expected = t_plain.to_arrow().sort_by("id")
+
+    lat = LatencyInjectingObjectStoreBackend(
+        plain_store, base_ms=0.5, seed=7, tail_rate=0.1,
+        tail_multiplier=50.0)
+    counting = CountingBackend(lat)
+    fio_chaos = ObjectStoreFileIO(counting, scheme="objfs://")
+    t_chaos = FileStoreTable.load(
+        "objfs://t", file_io=fio_chaos,
+        dynamic_options={"read.hedge.enabled": "true",
+                         "read.hedge.min-delay": "1",
+                         "read.hedge.max-ratio": "0.3",
+                         "store.breaker.enabled": "true"})
+    res = t_chaos.file_io.backend
+    assert isinstance(res, ResilientObjectStoreBackend)
+    res.tracker = LatencyTracker(min_samples=5)
+    mutations_before = counting.counts["put"] + counting.counts["delete"]
+    for _ in range(4):
+        got = t_chaos.to_arrow().sort_by("id")
+        assert got.equals(expected)
+    assert counting.counts["put"] + counting.counts["delete"] == \
+        mutations_before, "hedged READS caused store mutations"
+
+
+def test_chaos_hedged_ingest_no_duplicates(tmp_path):
+    """Writes through a resilient+hedged table under pareto tail:
+    row counts exact (no duplicate flushes/commits), fsck clean."""
+    store = LocalObjectStoreBackend(str(tmp_path / "b"))
+    lat = LatencyInjectingObjectStoreBackend(
+        store, base_ms=0.3, seed=11, tail_rate=0.05,
+        pareto_alpha=1.2)
+    fio = ObjectStoreFileIO(RetryingObjectStoreBackend(lat),
+                            scheme="objfs://")
+    t = FileStoreTable.create(
+        "objfs://t", _schema(**{"read.hedge.enabled": "true",
+                                "store.breaker.enabled": "true"}),
+        file_io=fio)
+    _fill(t, 300, start=0)
+    _fill(t, 300, start=300)
+    got = t.to_arrow()
+    assert got.num_rows == 600
+    assert sorted(set(got.column("id").to_pylist())) == list(range(600))
+    from paimon_tpu.maintenance.fsck import fsck
+    report = fsck(t)
+    assert not report.violations, report.violations
+
+
+# -- admission + brownout ----------------------------------------------------
+
+def test_admission_deadline_bounds_queue_wait():
+    from paimon_tpu.service.admission import AdmissionController
+    ctrl = AdmissionController(max_bytes=100, queue_depth=8,
+                               queue_timeout_ms=30_000,
+                               table="dl-q")
+    big = ctrl.acquire("a", 100)            # budget fully consumed
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededError):
+        with deadline_scope(50):
+            ctrl.acquire("b", 100)
+    # bounded by the 50ms deadline, NOT the 30s queue timeout
+    assert time.perf_counter() - t0 < 5.0
+    big.release()
+
+
+def test_admission_brownout_shed_by_priority():
+    from paimon_tpu.metrics import (
+        RESILIENCE_BROWNOUT_SHEDS, global_registry,
+    )
+    from paimon_tpu.service.admission import (
+        AdmissionController, AdmissionRejected,
+    )
+    ctrl = AdmissionController(max_bytes=1 << 20, table="shed")
+    sheds = global_registry().resilience_metrics().counter(
+        RESILIENCE_BROWNOUT_SHEDS)
+    before = sheds.count
+    ctrl.set_shed_below(100)
+    with pytest.raises(AdmissionRejected):
+        ctrl.acquire("low", 10, priority=1)
+    assert sheds.count == before + 1
+    ctrl.acquire("hi", 10, priority=100).release()   # default passes
+    ctrl.set_shed_below(0)
+    ctrl.acquire("low", 10, priority=1).release()    # restored
+
+
+def test_brownout_ladder_and_hysteresis(tmp_path):
+    from paimon_tpu.options import CoreOptions, Options
+    from paimon_tpu.service.admission import AdmissionController
+    from paimon_tpu.service.brownout import BrownoutController
+    clk = [0.0]
+    ctrl = AdmissionController(max_bytes=1 << 20, queue_depth=10,
+                               table="bo")
+    opts = CoreOptions(Options({"service.brownout.hold-ms": "1000"}))
+    bo = BrownoutController(ctrl, opts, clock=lambda: clk[0])
+    assert bo.observe() == 0
+    # signal 1: failure rate (10 events in the 10s window = 1/s)
+    for _ in range(10):
+        bo.timeouts.record()
+    assert bo.observe() == 1
+    from paimon_tpu.fs.resilience import hedging_allowed
+    assert not hedging_allowed()
+    # signal 2: an open breaker -> rung 2, low priority sheds
+    b = CircuitBreaker("bo-store", failure_threshold=1, open_ms=60_000,
+                       clock=lambda: clk[0])
+    res = ResilientObjectStoreBackend(
+        LocalObjectStoreBackend(str(tmp_path / "b")),
+        name="bo-store", breaker=b)
+    b.record_failure()
+    assert bo.observe() == 2
+    assert ctrl._shed_below == 100
+    hz = bo.healthz()
+    assert hz["status"] == "brownout"
+    assert hz["brownout_level"] == 2
+    assert hz["breakers"].get("bo-store") == "open"
+    assert hz["shedding_below_priority"] == 100
+    # failure-rate signal clears, breaker stays open -> target rung 1,
+    # but the hold (entered at t=0, 1000ms) keeps rung 2 (hysteresis)
+    bo.timeouts._events.clear()
+    clk[0] = 0.5
+    assert bo.observe() == 2
+    clk[0] = 1.5                            # past hold-ms
+    assert bo.observe() == 1                # steps DOWN
+    bo.reset()
+    assert bo.level == 0
+    assert hedging_allowed()
+    assert ctrl._shed_below == 0
+    res.close()
+
+
+# -- serving plane 504 + healthz --------------------------------------------
+
+@pytest.mark.parametrize("via", ["body", "option"])
+def test_service_deadline_504(tmp_path, via):
+    from paimon_tpu.service.query_service import KvQueryClient, KvQueryServer
+    store = LocalObjectStoreBackend(str(tmp_path / "b"))
+    lat = LatencyInjectingObjectStoreBackend(store, base_ms=0.0, seed=1)
+    fio = ObjectStoreFileIO(lat, scheme="objfs://")
+    t = FileStoreTable.create("objfs://t", _schema(), file_io=fio)
+    _fill(t, 200)
+    opts = {"service.cache.shared": "false"}
+    if via == "option":
+        opts["service.request.timeout"] = "80"
+    srv = KvQueryServer(t.copy(opts)).start()
+    try:
+        ok = KvQueryClient(address=srv.address)
+        assert ok.lookup([{"id": 3}])[0]["v"] == 3.0
+        # every GET now stalls 300ms: the request cannot finish in 80ms
+        lat.stuck_rate, lat.stuck_ms = 1.0, 300.0
+        kw = {"timeout_ms": 80} if via == "body" else {}
+        slow = KvQueryClient(address=srv.address, **kw)
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceededError):
+            slow.scan(limit=100)
+        # 504 within deadline + small grace (one stalled op may have
+        # to finish before the next check runs)
+        assert (time.perf_counter() - t0) * 1000 < 80 + 1500
+        lat.stuck_rate = 0.0
+        hz = ok.healthz()
+        assert hz["recent_504_per_s"] > 0
+    finally:
+        srv.stop()
+
+
+def test_resilience_group_on_prometheus(tmp_path):
+    from paimon_tpu.metrics import global_registry
+    from paimon_tpu.obs.export import render_prometheus
+    # ensure the group exists with at least one of each kind
+    CircuitBreaker("prom-backend")
+    global_registry().resilience_metrics().counter("deadline_exceeded")
+    text = render_prometheus()
+    assert "# TYPE paimon_resilience_breaker_state gauge" in text
+    assert 'paimon_resilience_breaker_state{table="prom-backend"} 0' \
+        in text
+    assert "paimon_resilience_deadline_exceeded" in text
+    for line in text.splitlines():
+        if line.startswith("paimon_resilience"):
+            # line-validated: name{labels} value
+            parts = line.rsplit(" ", 1)
+            assert len(parts) == 2 and parts[1] is not None
+            float(parts[1])
+
+
+# -- snapshot-hint cache -----------------------------------------------------
+
+def test_latest_snapshot_cache_cuts_store_roundtrips(tmp_path):
+    counting = CountingBackend(
+        LocalObjectStoreBackend(str(tmp_path / "b")))
+    fio = ObjectStoreFileIO(counting, scheme="objfs://")
+    t = FileStoreTable.create("objfs://t", _schema(), file_io=fio)
+    _fill(t, 50)
+    sm = t.snapshot_manager
+    sm.latest_snapshot()                     # prime the cache
+    before = dict(counting.counts)
+    for _ in range(5):
+        assert sm.latest_snapshot_id() == 1
+    probes = sum(counting.counts.values()) - sum(before.values())
+    # warm walks are pure exists probes (head+list per exists), never
+    # hint reads: <= 4 ops per walk vs ~8+ for the hint path
+    assert probes <= 5 * 4, probes
+    assert counting.counts["get"] == before["get"]   # no hint/json reads
+
+
+def test_latest_snapshot_cache_sees_external_commit(tmp_path):
+    fio = ObjectStoreFileIO(
+        LocalObjectStoreBackend(str(tmp_path / "b")), scheme="objfs://")
+    t = FileStoreTable.create("objfs://t", _schema(), file_io=fio)
+    _fill(t, 10)
+    assert t.snapshot_manager.latest_snapshot_id() == 1
+    # an EXTERNAL writer commits snapshot 2 (fresh table handle =
+    # fresh SnapshotManager; the first handle's cache must walk
+    # forward, not answer stale)
+    t2 = FileStoreTable.load("objfs://t", file_io=fio)
+    _fill(t2, 10, start=10)
+    assert t2.snapshot_manager.latest_snapshot_id() == 2
+    assert t.snapshot_manager.latest_snapshot_id() == 2
+
+
+def test_latest_snapshot_cache_survives_rollback(tmp_path):
+    fio = ObjectStoreFileIO(
+        LocalObjectStoreBackend(str(tmp_path / "b")), scheme="objfs://")
+    t = FileStoreTable.create("objfs://t", _schema(), file_io=fio)
+    _fill(t, 10)
+    _fill(t, 10, start=10)
+    _fill(t, 10, start=20)
+    assert t.snapshot_manager.latest_snapshot_id() == 3
+    t.rollback_to(1)
+    assert t.snapshot_manager.latest_snapshot_id() == 1
+    assert t.to_arrow().num_rows == 10
+    # recommit after rollback re-uses id 2 with NEW content
+    _fill(t, 5, start=100)
+    assert t.snapshot_manager.latest_snapshot_id() == 2
+    assert t.to_arrow().num_rows == 15
+
+
+def test_latest_snapshot_cache_cas_bump_on_loss(tmp_path):
+    from paimon_tpu.snapshot.snapshot_manager import SnapshotManager
+    fio = ObjectStoreFileIO(
+        LocalObjectStoreBackend(str(tmp_path / "b")), scheme="objfs://")
+    t = FileStoreTable.create("objfs://t", _schema(), file_io=fio)
+    _fill(t, 10)
+    sm = SnapshotManager(fio, "objfs://t")
+    snap = sm.snapshot(1)
+    # losing a CAS on id 1 proves it exists: the cache bumps there
+    lost = sm.try_commit(snap)
+    assert not lost
+    assert sm._cached_latest_id == 1
+    assert sm.latest_snapshot_id() == 1
+
+
+# -- wiring ------------------------------------------------------------------
+
+def test_maybe_wrap_resilience_idempotent_and_shared(tmp_path):
+    from paimon_tpu.options import CoreOptions, Options
+    store = LocalObjectStoreBackend(str(tmp_path / "b"))
+    fio = ObjectStoreFileIO(store, scheme="objfs://")
+    opts = CoreOptions(Options({"store.breaker.enabled": "true"}))
+    w1 = maybe_wrap_resilience(fio, opts)
+    w2 = maybe_wrap_resilience(
+        ObjectStoreFileIO(store, scheme="objfs://"), opts)
+    assert isinstance(w1.backend, ResilientObjectStoreBackend)
+    # one breaker per physical store, shared across table handles
+    assert w1.backend is w2.backend
+    # wrapping the already-wrapped FileIO is a no-op
+    assert maybe_wrap_resilience(w1, opts) is w1
+    # disabled options: untouched
+    off = CoreOptions(Options({}))
+    assert maybe_wrap_resilience(fio, off) is fio
+
+
+def test_scan_pipeline_prefetch_shrinks_when_degraded(tmp_path):
+    t = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema(**{"scan.split.parallelism": "2",
+                   "read.prefetch.splits": "4"}))
+    _fill(t, 400)
+    _fill(t, 400, start=400)
+    from paimon_tpu.parallel.scan_pipeline import iter_split_tables
+    rb = t.new_read_builder()
+    plan = rb.new_scan().plan()
+    read = rb.new_read()
+    set_degraded(True)
+    try:
+        stats = {}
+        rows = sum(tb.num_rows for _, _, tb in iter_split_tables(
+            read._read, plan.splits, t.options, stats=stats))
+        assert rows == 800
+        # window = parallelism only, no prefetch extra
+        assert stats["max_inflight_splits"] <= 2
+    finally:
+        set_degraded(False)
