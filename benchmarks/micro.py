@@ -295,6 +295,16 @@ def bench_tier():
             latencies=[0, 10])
 
 
+def bench_multihost():
+    """Multi-host write-plane trajectory (full 1M-row matrix in
+    benchmarks/multihost_bench.py via bench.py's multihost_write
+    block; this entry keeps a smaller 1-proc vs 2-proc-gloo-mesh
+    ingest comparison — rows asserted identical to the oracle — in
+    the micro record)."""
+    from benchmarks.multihost_bench import measure
+    measure(rows=min(ROWS, 200_000))
+
+
 BENCHES = {
     "read_parquet": lambda: bench_read("parquet"),
     "read_orc": lambda: bench_read("orc"),
@@ -307,6 +317,7 @@ BENCHES = {
     "obs": bench_obs,
     "serve": bench_serve,
     "tier": bench_tier,
+    "multihost": bench_multihost,
 }
 
 

@@ -67,6 +67,11 @@ class FileStoreCommit:
         self.row_tracking = (
             options.get(CoreOptions.ROW_TRACKING_ENABLED)
             and not table_schema.primary_keys)
+        # optional lost-CAS observer (attempt number per loss): the
+        # multi-host write plane (parallel/distributed.py) hangs its
+        # commit_conflicts / commit_retries accounting here — commit
+        # arbitration is THIS retry loop, observed from outside
+        self.conflict_listener: Optional[callable] = None
 
     # -- public API ----------------------------------------------------------
 
@@ -131,7 +136,9 @@ class FileStoreCommit:
                   partition_filter: Optional[dict] = None,
                   commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
                   index_entries: Optional[list] = None,
-                  watermark: Optional[int] = None) -> Optional[int]:
+                  watermark: Optional[int] = None,
+                  properties: Optional[Dict[str, str]] = None
+                  ) -> Optional[int]:
         """INSERT OVERWRITE: delete current files (optionally restricted to
         a partition spec) and add new ones atomically
         (reference FileStoreCommitImpl.overwrite). The delete set is
@@ -162,6 +169,7 @@ class FileStoreCommit:
         return self._try_commit([], [], commit_identifier,
                                 CommitKind.OVERWRITE, entries_fn=entries_fn,
                                 index_entries=index_entries,
+                                properties=properties,
                                 watermark=watermark)
 
     def filter_committed(self, commit_identifiers: Sequence[int]
@@ -436,6 +444,8 @@ class FileStoreCommit:
                 # lost the race: clean up everything written for this attempt
                 # and retry against the new latest (the delta manifest is
                 # reusable across attempts unless the entry set is dynamic)
+                if self.conflict_listener is not None:
+                    self.conflict_listener(_attempts)
                 _delete_attempt_lists()
                 if (entries_fn is not None or ids_assigned) and \
                         new_manifest is not None:

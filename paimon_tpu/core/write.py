@@ -523,6 +523,19 @@ class _BucketWriter:
         return None if msg.is_empty() else msg
 
 
+def dicts_to_arrow(arrow_schema: pa.Schema, rows: Sequence[dict],
+                   row_kinds: Optional[Sequence[int]] = None
+                   ) -> Tuple[pa.Table, Optional[np.ndarray]]:
+    """Dict rows -> (Arrow table, int8 kinds array or None): the ONE
+    conversion behind TableWrite.write_dicts and the distributed
+    plane's write_dicts, so coercion/default behavior cannot drift
+    between the single-process and multi-host paths."""
+    table = pa.Table.from_pylist(list(rows), schema=arrow_schema)
+    kinds = np.asarray(row_kinds, dtype=np.int8) \
+        if row_kinds is not None else None
+    return table, kinds
+
+
 def extract_row_kinds(table: pa.Table,
                       row_kinds: Optional[np.ndarray]
                       ) -> Tuple[pa.Table, np.ndarray]:

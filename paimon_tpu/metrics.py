@@ -50,7 +50,10 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "RESILIENCE_HEDGES_ABANDONED", "RESILIENCE_BREAKER_STATE",
            "RESILIENCE_BREAKER_FAST_FAILS",
            "RESILIENCE_DEADLINE_EXCEEDED", "RESILIENCE_BROWNOUT_SHEDS",
-           "RESILIENCE_BROWNOUT_LEVEL", "RESILIENCE_HEDGE_WAIT_MS"]
+           "RESILIENCE_BROWNOUT_LEVEL", "RESILIENCE_HEDGE_WAIT_MS",
+           "MULTIHOST_COMMIT_CONFLICTS", "MULTIHOST_COMMIT_RETRIES",
+           "MULTIHOST_OWNERSHIP_HANDOFFS", "MULTIHOST_BARRIER_WAIT_MS",
+           "MULTIHOST_FOREIGN_ROWS", "MULTIHOST_CONFIG_WARNINGS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -179,6 +182,23 @@ RESILIENCE_DEADLINE_EXCEEDED = "deadline_exceeded"    # tripped scopes
 RESILIENCE_BROWNOUT_SHEDS = "brownout_sheds"    # requests shed browned-out
 RESILIENCE_BROWNOUT_LEVEL = "brownout_level"    # gauge: current rung
 RESILIENCE_HEDGE_WAIT_MS = "hedge_wait_ms"      # delay before the hedge
+
+# multi-host write-plane counter/histogram names (multihost metric
+# group; producers in parallel/multihost.py + parallel/distributed.py,
+# consumers benchmarks/multihost_bench.py + tests + dashboards).
+# commit_conflicts counts snapshot-CAS losses observed by distributed
+# commits (each is one peer's concurrent publish); commit_retries
+# counts distributed commits that needed >1 CAS attempt before
+# winning; ownership_handoffs counts (partition,bucket) owners that
+# moved between ownership-map versions (bucket rescale); barrier_wait_ms
+# is the per-process wall time spent inside cross-host barriers
+# (sync_global_devices) — the direct cost of global agreement.
+MULTIHOST_COMMIT_CONFLICTS = "commit_conflicts"
+MULTIHOST_COMMIT_RETRIES = "commit_retries"
+MULTIHOST_OWNERSHIP_HANDOFFS = "ownership_handoffs"
+MULTIHOST_BARRIER_WAIT_MS = "barrier_wait_ms"
+MULTIHOST_FOREIGN_ROWS = "foreign_rows_routed"  # rows exchanged to owners
+MULTIHOST_CONFIG_WARNINGS = "config_warnings"   # collective-config fallbacks
 
 
 class Counter:
@@ -378,6 +398,12 @@ class MetricRegistry:
         breakers, utils/deadline.py, service/brownout.py).  `table`
         doubles as the backend name for per-backend breaker gauges."""
         return self.group("resilience", table)
+
+    def multihost_metrics(self, table: str = "") -> MetricGroup:
+        """Multi-host write plane (ours; parallel/multihost.py
+        barriers + parallel/distributed.py sharded-ownership writers
+        and commit arbitration)."""
+        return self.group("multihost", table)
 
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind

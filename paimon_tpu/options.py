@@ -601,6 +601,37 @@ class CoreOptions:
         "this long before the ladder may step back down (prevents "
         "flapping between shed and un-shed at the pressure boundary)")
 
+    # -- multi-host write plane (ours; parallel/multihost.py +
+    #    parallel/distributed.py) --------------------------------------------
+    MULTIHOST_COMMIT_ARBITRATION = ConfigOption(
+        "multihost.commit.arbitration", str, "cas",
+        "How concurrent per-process commits publish on a multi-host "
+        "mesh (parallel/distributed.py): 'cas' = every process "
+        "commits its own messages and the snapshot CAS serializes "
+        "them with conflict re-resolution (reference FileStoreCommit "
+        "optimistic retry); 'coordinator' = commit messages are "
+        "gathered to an elected committer process over the mesh and "
+        "published as ONE snapshot per global checkpoint (reference "
+        "committer-operator singleton)")
+    MULTIHOST_WRITE_ROUTING = ConfigOption(
+        "multihost.write.routing", str, "exchange",
+        "What a distributed writer does with rows whose "
+        "(partition,bucket) is owned by another process: 'exchange' = "
+        "reroute them to their owners with one cross-host allgather "
+        "per batch (input streams must be DISJOINT across processes); "
+        "'spmd' = silently keep only owned rows (every process must "
+        "see the IDENTICAL global batch — the jax SPMD shape); "
+        "'local-only' = raise, for pre-partitioned pipelines where a "
+        "foreign row is a routing bug")
+    MULTIHOST_SCAN_PIN = ConfigOption(
+        "multihost.scan.pin-snapshot", _parse_bool, True,
+        "Snapshot-consistent cross-host scans: all processes agree on "
+        "ONE pinned snapshot id (broadcast from process 0) before "
+        "planning, so every host reads the same table version and "
+        "split ownership covers exactly one consistent state.  false "
+        "= each process plans its own latest snapshot (scans may "
+        "straddle concurrent commits)")
+
     # -- observability (ours; paimon_tpu/obs/) -------------------------------
     METRICS_ENABLED = ConfigOption(
         "metrics.enabled", _parse_bool, True,

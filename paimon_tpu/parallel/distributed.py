@@ -1,0 +1,617 @@
+"""Distributed write plane: sharded bucket ownership, commit
+arbitration, snapshot-consistent cross-host scans, online rescale.
+
+The reference scales writers across an engine cluster with a
+committer-operator singleton serializing snapshot publication (SURVEY
+§5; FileStoreCommit CAS).  "Fast Updates on Read-Optimized Databases
+Using Multi-Core CPUs" (arxiv 1109.6885) partitions ownership so
+writers never contend; this module lifts that model from cores to
+hosts on a JAX multi-host mesh:
+
+- **Ownership** (`OwnershipMap`): every (partition, bucket) is owned
+  by exactly one process, deterministically (crc32 shard of the
+  partition/bucket identity mod process count — NOT Python `hash()`,
+  which is salted per process).  Owners never contend: each host's
+  writers flush through the existing per-bucket actor pipeline
+  (parallel/write_pipeline.py) on disjoint key ranges.  The map is
+  versioned in snapshot properties (`multihost.ownership.*`) so a
+  restarted or late-joining process can see which generation the
+  table's tip was written under.
+
+- **Routing**: rows arriving at a non-owner are handled per
+  `multihost.write.routing` — 'exchange' reroutes them to their
+  owners with one cross-host allgather per batch (disjoint input
+  streams), 'spmd' keeps only owned rows (identical global batch on
+  every process, the jax SPMD shape), 'local-only' raises.
+
+- **Commit arbitration** (`multihost.commit.arbitration`): 'cas' has
+  every process commit its own messages under a per-process commit
+  user; the snapshot rename-CAS serializes them and FileStoreCommit's
+  optimistic retry re-resolves conflicts (observed through
+  `conflict_listener` into the multihost metric group).
+  'coordinator' gathers every process's commit messages to an elected
+  committer over the mesh and publishes ONE snapshot per global
+  checkpoint — the reference's committer-operator singleton.  Both
+  end in a barrier, so after `commit()` returns every process sees
+  every peer's rows.
+
+- **Pinned scans** (`pinned_scan_plan`): all processes agree on one
+  snapshot id via a small broadcast, plan against it, and read their
+  byte-balanced `assign_splits` share — a cross-host scan of exactly
+  one consistent table version.
+
+- **Online rescale** (`rescale_buckets`): drain-and-handoff — every
+  writer drains and publishes under the OLD layout, one barrier, the
+  elected process rewrites the table to the new bucket count
+  (parallel/rescale.py all_to_all routing), another barrier, and
+  every writer reopens under the new ownership map (version bumped,
+  handoffs counted).  Live write traffic resumes immediately.
+
+Everything degrades to single-process: ownership collapses to
+process 0, routing is a no-op, arbitration is a plain commit, and the
+barriers return without touching a collective.
+"""
+
+from __future__ import annotations
+
+import pickle
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from paimon_tpu.options import CoreOptions
+from paimon_tpu.parallel import multihost as MH
+from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
+
+__all__ = ["OwnershipMap", "OwnershipError", "DistributedWritePlane",
+           "owner_of", "pinned_scan_plan",
+           "OWNERSHIP_VERSION_PROP", "OWNERSHIP_PROCESSES_PROP",
+           "OWNERSHIP_BUCKETS_PROP"]
+
+# snapshot property keys carrying the ownership-map generation: every
+# distributed commit stamps them, so the table's tip records which map
+# its files were routed under (rescale bumps the version)
+OWNERSHIP_VERSION_PROP = "multihost.ownership.version"
+OWNERSHIP_PROCESSES_PROP = "multihost.ownership.processes"
+OWNERSHIP_BUCKETS_PROP = "multihost.ownership.buckets"
+
+_ROUTINGS = ("exchange", "spmd", "local-only")
+_ARBITRATIONS = ("cas", "coordinator")
+
+
+class OwnershipError(RuntimeError):
+    """A row reached a process that does not own its bucket (routing
+    'local-only'), or peers disagree on the write-plane topology."""
+
+
+def owner_of(partition: Tuple, bucket: int, process_count: int) -> int:
+    """Deterministic owner of (partition, bucket): a crc32 shard over
+    the group identity.  crc32, NOT `hash()` — Python string hashing
+    is salted per process, and every process must compute the SAME
+    map.  repr() of partition values (str/int/date/...) is stable
+    across processes for the types partitions can hold."""
+    if process_count <= 1:
+        return 0
+    key = repr((tuple(partition), int(bucket))).encode("utf-8")
+    return zlib.crc32(key) % process_count
+
+
+@dataclass(frozen=True)
+class OwnershipMap:
+    """One generation of the sharded write-ownership function."""
+    version: int
+    num_processes: int
+    num_buckets: int
+
+    def owner_of(self, partition: Tuple, bucket: int) -> int:
+        return owner_of(partition, bucket, self.num_processes)
+
+    def to_properties(self) -> Dict[str, str]:
+        return {OWNERSHIP_VERSION_PROP: str(self.version),
+                OWNERSHIP_PROCESSES_PROP: str(self.num_processes),
+                OWNERSHIP_BUCKETS_PROP: str(self.num_buckets)}
+
+    def handoffs_to(self, other: "OwnershipMap") -> int:
+        """How many non-partitioned bucket owners move between this
+        map and `other` (new buckets count as handoffs — they start
+        owned by somebody).  Feeds the ownership_handoffs counter."""
+        moved = 0
+        for b in range(other.num_buckets):
+            if b >= self.num_buckets:
+                moved += 1
+            elif self.owner_of((), b) != other.owner_of((), b):
+                moved += 1
+        return moved
+
+
+def resume_ownership_map(table, max_walk: int = 64
+                         ) -> Optional[OwnershipMap]:
+    """The ownership map recorded at the table's tip: walk snapshots
+    newest-first for the properties (bounded — compaction snapshots
+    don't carry them; distributed commits, the rescale overwrite AND
+    the empty-rescale stamp do, so a restart right after a rescale
+    still resumes the bumped generation).  None when the table has
+    never seen a distributed commit."""
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id()
+    if latest is None:
+        return None
+    earliest = sm.earliest_snapshot_id() or latest
+    for sid in range(latest, max(earliest, latest - max_walk) - 1, -1):
+        if not sm.snapshot_exists(sid):
+            continue
+        props = sm.snapshot(sid).properties or {}
+        if OWNERSHIP_VERSION_PROP in props:
+            return OwnershipMap(
+                int(props[OWNERSHIP_VERSION_PROP]),
+                int(props.get(OWNERSHIP_PROCESSES_PROP) or 0),
+                int(props.get(OWNERSHIP_BUCKETS_PROP) or 0))
+    return None
+
+
+def resume_ownership_version(table, max_walk: int = 64) -> int:
+    """Version-only view of resume_ownership_map (0 = never)."""
+    m = resume_ownership_map(table, max_walk)
+    return m.version if m is not None else 0
+
+
+def pinned_scan_plan(table, process_index: Optional[int] = None,
+                     process_count: Optional[int] = None):
+    """Snapshot-consistent cross-host scan plan: agree on ONE snapshot
+    id (process 0's latest, via a small broadcast — unless
+    multihost.scan.pin-snapshot=false), plan against it, and return
+    (snapshot_id, this process's byte-balanced split share).  Every
+    process computes the same global plan; no coordinator hands out
+    work.  (None, []) when the table has no snapshot."""
+    local = table.snapshot_manager.latest_snapshot_id() or 0
+    if table.options.get(CoreOptions.MULTIHOST_SCAN_PIN):
+        sid = MH.broadcast_value(local)
+    else:
+        sid = local
+    if sid == 0:
+        return None, []
+    plan = table.new_read_builder().new_scan().plan(snapshot_id=sid)
+    mine = MH.assign_splits(plan.splits, process_index, process_count)
+    return sid, mine
+
+
+def _table_to_ipc(t: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return sink.getvalue().to_pybytes()
+
+
+def _table_from_ipc(b: bytes) -> pa.Table:
+    with pa.ipc.open_stream(pa.BufferReader(b)) as r:
+        return r.read_all()
+
+
+class DistributedWritePlane:
+    """One process's slice of the multi-host write plane over a
+    fixed-bucket table.  SPMD contract: every process constructs the
+    plane, calls `write_*` the same number of times (routing
+    'exchange' runs one collective per batch), and calls `commit` /
+    `rescale_buckets` at the same points — the same program-order
+    discipline every jax multi-host program already follows.
+
+    Usage (identical on every host):
+        plane = table.new_distributed_write()
+        plane.write_dicts(my_host_rows)      # routed to owners
+        plane.commit()                       # arbitrated publish
+        sid, splits = plane.pinned_scan()    # consistent read share
+        plane.close()
+    """
+
+    def __init__(self, table, base_user: str = "writer",
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 committer_index: int = 0):
+        import jax
+
+        self.table = table
+        self.process_index = (jax.process_index()
+                              if process_index is None else process_index)
+        self.process_count = (jax.process_count()
+                              if process_count is None else process_count)
+        self.committer_index = committer_index % max(1, self.process_count)
+        self.base_user = base_user
+        if table.options.bucket < 1:
+            raise OwnershipError(
+                "distributed writes need a fixed-bucket table "
+                f"(bucket={table.options.bucket}): dynamic/postpone "
+                "bucket assignment is stateful per process and cannot "
+                "be sharded deterministically")
+        if not table.schema.primary_keys:
+            raise OwnershipError(
+                "distributed writes need a primary-key table: the "
+                "append writer has no precomputed-bucket route for "
+                "the ownership split")
+        if table.schema.cross_partition_update():
+            raise OwnershipError(
+                "distributed writes do not support cross-partition "
+                "update tables: the global index that reroutes "
+                "partition changes is per-process state")
+        self.routing = table.options.get(
+            CoreOptions.MULTIHOST_WRITE_ROUTING)
+        if self.routing not in _ROUTINGS:
+            raise ValueError(f"multihost.write.routing must be one of "
+                             f"{_ROUTINGS}, got {self.routing!r}")
+        self.arbitration = table.options.get(
+            CoreOptions.MULTIHOST_COMMIT_ARBITRATION)
+        if self.arbitration not in _ARBITRATIONS:
+            raise ValueError(f"multihost.commit.arbitration must be one "
+                             f"of {_ARBITRATIONS}, got "
+                             f"{self.arbitration!r}")
+        from paimon_tpu.metrics import (
+            MULTIHOST_BARRIER_WAIT_MS, MULTIHOST_COMMIT_CONFLICTS,
+            MULTIHOST_COMMIT_RETRIES, MULTIHOST_CONFIG_WARNINGS,
+            MULTIHOST_FOREIGN_ROWS, MULTIHOST_OWNERSHIP_HANDOFFS,
+            global_registry,
+        )
+        self._metrics = global_registry().multihost_metrics()
+        # pre-allocate the group's series so dashboards and the
+        # Prometheus endpoint always expose them (a conflict-free run
+        # must render commit_conflicts 0, not omit the series)
+        for c in (MULTIHOST_COMMIT_CONFLICTS, MULTIHOST_COMMIT_RETRIES,
+                  MULTIHOST_OWNERSHIP_HANDOFFS, MULTIHOST_FOREIGN_ROWS,
+                  MULTIHOST_CONFIG_WARNINGS):
+            self._metrics.counter(c)
+        self._metrics.histogram(MULTIHOST_BARRIER_WAIT_MS)
+        # dynamic (load-time) options are NOT in the on-disk schema;
+        # remember them so the rescale handoff's table reload can
+        # re-apply them (copy() REPLACES dynamic options, and silently
+        # losing write-only / retry tuning mid-run is a footgun)
+        base_opts = table.schema_manager.latest().options
+        self._dynamic_opts = {
+            k: v for k, v in table.options.to_map().items()
+            if base_opts.get(k) != v}
+        recorded = resume_ownership_map(table)
+        buckets = table.options.bucket
+        if recorded is None:
+            self.ownership = OwnershipMap(1, self.process_count,
+                                          buckets)
+        elif (recorded.num_processes, recorded.num_buckets) == \
+                (self.process_count, buckets):
+            self.ownership = OwnershipMap(recorded.version,
+                                          self.process_count, buckets)
+        else:
+            # the topology changed without a coordinated rescale (a
+            # resized cluster, or a legacy tip without the full
+            # properties): that IS a new ownership function — reusing
+            # the recorded version would let one number denote two
+            # different maps.  Bump the generation and account the
+            # moved owners.
+            self.ownership = OwnershipMap(recorded.version + 1,
+                                          self.process_count, buckets)
+            if recorded.num_processes and recorded.num_buckets:
+                from paimon_tpu.metrics import (
+                    MULTIHOST_OWNERSHIP_HANDOFFS,
+                )
+                moved = recorded.handoffs_to(self.ownership)
+                if moved:
+                    self._metrics.counter(
+                        MULTIHOST_OWNERSHIP_HANDOFFS).inc(moved)
+        self._had_conflict = False
+        self._closed = False
+        self._open_writer()
+
+    # -- wiring --------------------------------------------------------------
+
+    @property
+    def commit_user(self) -> str:
+        """Per-process under 'cas' (the CAS serializes N users); ONE
+        stable committer user under 'coordinator' (exactly-once replay
+        dedup keys on it)."""
+        if self.arbitration == "coordinator":
+            return f"{self.base_user}-committer"
+        return f"{self.base_user}-p{self.process_index}"
+
+    def _open_writer(self):
+        from paimon_tpu.core.bucket import FixedBucketAssigner
+        wb = self.table.new_batch_write_builder()
+        wb.commit_user = self.commit_user
+        self._write = wb.new_write()
+        self._commit = wb.new_commit()
+        # commit arbitration IS FileStoreCommit's CAS retry loop;
+        # observe its lost races into the multihost group
+        self._commit._commit.conflict_listener = self._on_conflict
+        schema = self.table.schema
+        rt = schema.logical_row_type()
+        bucket_keys = schema.bucket_keys() or \
+            schema.trimmed_primary_keys()
+        self._assigner = FixedBucketAssigner(
+            bucket_keys, [rt.get_field(k).type for k in bucket_keys],
+            self.table.options.bucket)
+        self._partition_keys = schema.partition_keys
+
+    def _on_conflict(self, attempt: int):
+        from paimon_tpu.metrics import MULTIHOST_COMMIT_CONFLICTS
+        self._metrics.counter(MULTIHOST_COMMIT_CONFLICTS).inc()
+        self._had_conflict = True
+
+    # -- writes --------------------------------------------------------------
+
+    def write_dicts(self, rows: Sequence[dict],
+                    row_kinds: Optional[Sequence[int]] = None):
+        from paimon_tpu.core.write import dicts_to_arrow
+        t, kinds = dicts_to_arrow(self.table.arrow_schema(), rows,
+                                  row_kinds)
+        self.write_arrow(t, kinds)
+
+    def write_arrow(self, data: pa.Table,
+                    row_kinds: Optional[np.ndarray] = None):
+        """Route a batch: owned rows go straight into the local
+        per-bucket actor pipeline; foreign rows are exchanged /
+        dropped / rejected per multihost.write.routing.  Routing
+        'exchange' is a COLLECTIVE — every process must call
+        write_arrow the same number of times, even with empty
+        batches."""
+        if self._closed:
+            raise RuntimeError("write plane is closed")
+        from paimon_tpu.core.write import extract_row_kinds
+        data, kinds = extract_row_kinds(data, row_kinds)
+        # field defaults fill BEFORE the ownership hash: the inner
+        # TableWrite applies them after this split, so hashing the
+        # pre-default NULLs here would route a defaulted bucket-key
+        # row to a different bucket than the single-process path
+        # (idempotent — the inner second application sees no NULLs)
+        data = self._write._apply_field_defaults(data)
+        local_idx, foreign_idx, buckets = self._split_local_foreign(data)
+        if self.routing == "local-only" and len(foreign_idx):
+            raise OwnershipError(
+                f"{len(foreign_idx)} rows hash to buckets owned by "
+                f"other processes (routing=local-only); partition the "
+                f"input stream by ownership or use routing=exchange")
+        if len(local_idx):
+            idx = pa.array(local_idx)
+            self._write.write_arrow(data.take(idx), kinds[local_idx],
+                                    buckets=buckets[local_idx])
+        if self.routing == "exchange":
+            self._exchange(data, kinds, foreign_idx)
+
+    def _split_local_foreign(self, data: pa.Table):
+        """(local_row_indices, foreign_row_indices, bucket[i]) for one
+        batch — the ownership split, computed once per batch from the
+        same FixedBucketAssigner hash the writers use."""
+        from paimon_tpu.core.write import group_by_partition_bucket
+        if data.num_rows == 0:
+            empty = np.empty(0, dtype=np.int64)
+            return empty, empty, np.empty(0, dtype=np.int32)
+        buckets = np.asarray(self._assigner.assign(data),
+                             dtype=np.int32)
+        local: List[np.ndarray] = []
+        foreign: List[np.ndarray] = []
+        for (part, bucket), idx in group_by_partition_bucket(
+                data, buckets, self._partition_keys):
+            if self.ownership.owner_of(part, bucket) == \
+                    self.process_index:
+                local.append(idx)
+            else:
+                foreign.append(idx)
+        cat = (lambda parts: np.sort(np.concatenate(parts))
+               if parts else np.empty(0, dtype=np.int64))
+        return cat(local), cat(foreign), buckets
+
+    def _exchange(self, data: pa.Table, kinds: np.ndarray,
+                  foreign_idx: np.ndarray):
+        """Reroute foreign rows to their owners: one padded allgather
+        of Arrow-IPC payloads; every process then keeps the rows IT
+        owns from every peer's payload.  Runs unconditionally in
+        'exchange' mode (collective symmetry — peers with zero foreign
+        rows still participate with an empty payload)."""
+        from paimon_tpu.core.write import ROW_KIND_COL, extract_row_kinds
+        if len(foreign_idx):
+            sub = data.take(pa.array(foreign_idx))
+            sub = sub.append_column(
+                ROW_KIND_COL, pa.array(kinds[foreign_idx], pa.int8()))
+        else:
+            sub = data.slice(0, 0).append_column(
+                ROW_KIND_COL, pa.array([], pa.int8()))
+        payloads = MH.allgather_bytes(_table_to_ipc(sub))
+        from paimon_tpu.metrics import MULTIHOST_FOREIGN_ROWS
+        routed = 0
+        for p, payload in enumerate(payloads):
+            if p == self.process_index:
+                continue          # my own foreign rows went to peers
+            recv = _table_from_ipc(payload)
+            if recv.num_rows == 0:
+                continue
+            recv, recv_kinds = extract_row_kinds(recv, None)
+            local_idx, _, buckets = self._split_local_foreign(recv)
+            if len(local_idx):
+                idx = pa.array(local_idx)
+                self._write.write_arrow(recv.take(idx),
+                                        recv_kinds[local_idx],
+                                        buckets=buckets[local_idx])
+                routed += len(local_idx)
+        if routed:
+            self._metrics.counter(MULTIHOST_FOREIGN_ROWS).inc(routed)
+
+    # -- commit arbitration --------------------------------------------------
+
+    def commit(self, commit_identifier: int = BATCH_COMMIT_IDENTIFIER,
+               properties: Optional[Dict[str, str]] = None
+               ) -> Optional[int]:
+        """Arbitrated publish of every process's pending writes; all
+        processes return only after every peer's rows are visible
+        (barrier).  Returns the latest snapshot id this process
+        observed (None when the whole checkpoint was empty)."""
+        if self._closed:
+            raise RuntimeError("write plane is closed")
+        msgs = self._write.prepare_commit()
+        props = self.ownership.to_properties()
+        if properties:
+            props.update(properties)
+        self._had_conflict = False
+        if self.arbitration == "coordinator":
+            sid = self._commit_coordinator(msgs, commit_identifier,
+                                           props)
+        else:
+            sid = self._commit.commit(msgs, commit_identifier,
+                                      properties=props)
+            MH.barrier("multihost-commit")
+            if sid is None:
+                sid = self.table.snapshot_manager.latest_snapshot_id()
+        if self._had_conflict:
+            from paimon_tpu.metrics import MULTIHOST_COMMIT_RETRIES
+            self._metrics.counter(MULTIHOST_COMMIT_RETRIES).inc()
+        return sid
+
+    def _commit_coordinator(self, msgs, commit_identifier, props
+                            ) -> Optional[int]:
+        """Elected-committer arbitration: gather every process's
+        commit messages over the mesh, the committer publishes ONE
+        snapshot per global checkpoint, everyone barriers on the
+        result (reference committer-operator singleton).  The wire is
+        pickle over the padded allgather — trusted same-binary
+        processes of one mesh, never external input."""
+        payloads = MH.allgather_bytes(pickle.dumps(list(msgs)))
+        sid = None
+        if self.process_index == self.committer_index:
+            all_msgs = [m for pl in payloads for m in pickle.loads(pl)]
+            sid = self._commit.commit(all_msgs, commit_identifier,
+                                      properties=props)
+        MH.barrier("multihost-commit")
+        if sid is None:
+            sid = self.table.snapshot_manager.latest_snapshot_id()
+        return sid
+
+    def filter_committed(self, identifiers: Sequence[int]) -> List[int]:
+        """Exactly-once replay dedup against this plane's commit user
+        (coordinator: the shared committer user)."""
+        return self._commit.filter_committed(identifiers)
+
+    # -- scans ---------------------------------------------------------------
+
+    def pinned_scan(self):
+        """(snapshot_id, my split share) — see pinned_scan_plan."""
+        return pinned_scan_plan(self.table, self.process_index,
+                                self.process_count)
+
+    def scan_to_arrow(self) -> pa.Table:
+        """Read this process's pinned split share as one Arrow table
+        (empty table with the right schema when nothing is owned)."""
+        sid, splits = self.pinned_scan()
+        read = self.table.new_read_builder().new_read()
+        tables = [read.read_split(s) for s in splits]
+        if not tables:
+            return self.table.arrow_schema().empty_table()
+        return pa.concat_tables(tables, promote_options="none")
+
+    # -- online rescale ------------------------------------------------------
+
+    def rescale_buckets(self, new_buckets: int) -> Optional[int]:
+        """Change the bucket count under live write traffic:
+        drain-and-handoff.  Every process drains and publishes its
+        pending rows under the OLD ownership map (arbitrated commit =
+        barrier included), the elected process rewrites the table to
+        `new_buckets` (parallel/rescale.py), a barrier publishes the
+        handoff, and every process reopens its writers under the NEW
+        map (version bumped; moved owners counted as
+        ownership_handoffs).  Returns the rescale snapshot id as this
+        process observes it."""
+        if self._closed:
+            raise RuntimeError("write plane is closed")
+        # preconditions checked on EVERY process BEFORE any barrier:
+        # a committer-only failure would strand the peers inside
+        # sync_global_devices (and a hard-died peer SIGABRTs the rest
+        # at shutdown) — validation errors must raise identically
+        # everywhere, with the plane still usable
+        if new_buckets < 1:
+            raise ValueError(f"new_buckets must be >= 1, got "
+                             f"{new_buckets}")
+        if self.table.schema.partition_keys:
+            raise OwnershipError(
+                "rescale of partitioned tables is per-partition and "
+                "not supported by the distributed plane")
+        # 1. drain: nothing written under the old layout may still be
+        # buffered when the layout changes
+        self.commit()
+        old_map = self.ownership
+        new_map = OwnershipMap(old_map.version + 1, self.process_count,
+                               new_buckets)
+        # an EMPTY drained table has nothing to rewrite —
+        # rescale_table_buckets would no-op WITHOUT the schema change
+        # and every process would then fail the post-handoff bucket
+        # check; the rescale of an empty table is just the schema
+        # change.  Every process reads the same post-drain tip (the
+        # commit barrier ordered all drains before this), so the
+        # branch is deterministic across the mesh.
+        tip = self.table.snapshot_manager.latest_snapshot()
+        empty = tip is None or tip.total_record_count == 0
+        # 2. elected rewrite (the all_to_all routing + overwrite
+        # commit); peers wait at the barrier.  The routing collective
+        # runs on the elected host's LOCAL devices — a global-mesh
+        # program issued by one process would desynchronize the
+        # peers' collective streams (gloo matches ops by order, and
+        # the peers are parked at the barrier, not in the shuffle).
+        # The overwrite snapshot itself carries the NEW map's version
+        # properties, so a process restarting between the rescale and
+        # the first post-rescale commit resumes the bumped generation
+        # instead of regressing to the drain commit's
+        if self.process_index == self.committer_index:
+            if empty:
+                from paimon_tpu.schema import SchemaChange, SchemaManager
+                SchemaManager(
+                    self.table.file_io, self.table.path,
+                    self.table.branch).commit_changes(
+                        SchemaChange.set_option("bucket",
+                                                str(new_buckets)))
+            else:
+                import jax
+                from jax.sharding import Mesh
+                local = Mesh(np.asarray(jax.local_devices()),
+                             ("buckets",))
+                self.table.rescale_buckets(
+                    new_buckets, mesh=local,
+                    properties=new_map.to_properties())
+        MH.barrier("multihost-rescale")
+        # 3. handoff: reopen against the new schema generation,
+        # re-applying the load-time dynamic options copy() would drop
+        # (minus any stale dynamic bucket override — the rescaled
+        # schema is authoritative for the bucket count)
+        self._write.close()
+        dyn = {k: v for k, v in self._dynamic_opts.items()
+               if k != "bucket"}
+        self.table = self.table.copy(dyn)
+        if self.table.options.bucket != new_buckets:
+            raise OwnershipError(
+                f"rescale handoff: table reports bucket="
+                f"{self.table.options.bucket}, expected {new_buckets}")
+        self.ownership = new_map
+        from paimon_tpu.metrics import MULTIHOST_OWNERSHIP_HANDOFFS
+        moved = old_map.handoffs_to(self.ownership)
+        if moved:
+            self._metrics.counter(MULTIHOST_OWNERSHIP_HANDOFFS).inc(
+                moved)
+        self._open_writer()
+        if empty:
+            # the empty branch produced no snapshot to carry the new
+            # generation: stamp it with one forced empty snapshot so
+            # a restart before the first post-rescale commit still
+            # resumes the bumped version (same guarantee as the
+            # overwrite branch)
+            if self.process_index == self.committer_index:
+                self._commit._commit.commit(
+                    [], properties=self.ownership.to_properties(),
+                    force_create=True)
+            MH.barrier("multihost-rescale-stamp")
+        return self.table.snapshot_manager.latest_snapshot_id()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._write.close()
+
+    def __enter__(self) -> "DistributedWritePlane":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

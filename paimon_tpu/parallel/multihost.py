@@ -18,14 +18,21 @@ message bus.  This module is the glue:
   ingestion path for jax_data loaders.
 - `assign_splits(...)`: deterministic scan-split ownership per process
   (the analog of the reference's split enumerator handing splits to
-  parallel source readers).
+  parallel source readers), byte-size-aware LPT like
+  parallel/packing.py so one host never owns all the large splits.
+- `barrier(...)` / `broadcast_value(...)` / `allgather_bytes(...)`:
+  the small agreement primitives the distributed write plane
+  (parallel/distributed.py) builds commit arbitration, pinned-snapshot
+  scans and rescale handoffs on.
 
 Everything degrades to single-process: `initialize` is a no-op when
 num_processes==1, the mesh covers local devices, split assignment
-returns everything.
+returns everything, and the agreement primitives return their inputs
+without touching a collective.
 """
 
 import os
+import time as _time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -56,11 +63,28 @@ def initialize(coordinator_address: Optional[str] = None,
         try:
             jax.config.update("jax_cpu_collectives_implementation",
                               "gloo")
-        except (AttributeError, ValueError, KeyError):
+        except (AttributeError, ValueError, KeyError) as e:
             # other jax versions: the flag may not exist (newer
             # releases enable cross-process CPU collectives through
-            # the distributed runtime itself)
-            pass
+            # the distributed runtime itself).  NOT silent: a pod that
+            # falls back to broken CPU collectives fails much later
+            # with an inscrutable "Multiprocess computations aren't
+            # implemented" — surface the config miss now so that
+            # failure is diagnosable from the warning + metric.
+            import warnings
+
+            from paimon_tpu.metrics import (
+                MULTIHOST_CONFIG_WARNINGS, global_registry,
+            )
+            warnings.warn(
+                "multihost.initialize: could not opt the CPU backend "
+                f"into Gloo cross-process collectives ({e!r}); if "
+                "this jax build lacks them, the first cross-process "
+                "computation will fail with 'Multiprocess "
+                "computations aren't implemented on the CPU backend'",
+                RuntimeWarning, stacklevel=2)
+            global_registry().multihost_metrics().counter(
+                MULTIHOST_CONFIG_WARNINGS).inc()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -105,20 +129,49 @@ def process_local_batch(mesh, name_to_array, axis: str = "data"):
     return out
 
 
+def split_weight(split) -> int:
+    """A split's assignment weight: on-disk bytes from manifest stats
+    (DataFileMeta.file_size sums — available before any file IO, same
+    source as parallel/packing.bucket_row_counts).  Objects without
+    data_files weigh 1 so plain sequences still round-robin."""
+    files = getattr(split, "data_files", None)
+    if not files:
+        return 1
+    return max(1, sum(int(f.file_size) for f in files))
+
+
 def assign_splits(splits: Sequence, process_index: Optional[int] = None,
                   process_count: Optional[int] = None) -> List:
-    """Deterministic split ownership: split i belongs to process
-    i % process_count.  Every process plans the same scan and reads
-    only its own splits — no coordinator, no shuffle, same contract as
-    the torch loader's (rank, worker) sharding."""
+    """Deterministic byte-size-aware split ownership: splits pack onto
+    processes with the same greedy LPT policy as parallel/packing.py,
+    keyed on manifest byte sizes — round-robin by index ignored sizes,
+    so one host could own every large split while its peers finished
+    early and idled at the scan barrier.  Every process computes the
+    SAME plan (sort + tie-breaks are total orders over (size, index)),
+    reads only its own share, and no coordinator or shuffle is needed
+    — the contract of the reference's split enumerator and the torch
+    loader's (rank, worker) sharding, unchanged."""
     import jax
 
     if process_index is None:
         process_index = jax.process_index()
     if process_count is None:
         process_count = jax.process_count()
-    return [s for i, s in enumerate(splits)
-            if i % process_count == process_index]
+    if process_count <= 1:
+        return list(splits)
+    weights = [split_weight(s) for s in splits]
+    order = sorted(range(len(splits)),
+                   key=lambda i: (-weights[i], i))
+    loads = [0] * process_count
+    mine: List[int] = []
+    for i in order:
+        target = min(range(process_count), key=lambda p: (loads[p], p))
+        loads[target] += weights[i]
+        if target == process_index:
+            mine.append(i)
+    # preserve plan order within the owned share (stable for callers
+    # that zip splits with prior state)
+    return [splits[i] for i in sorted(mine)]
 
 
 def distributed_write_commit_user(base: str = "writer") -> str:
@@ -129,3 +182,68 @@ def distributed_write_commit_user(base: str = "writer") -> str:
     import jax
 
     return f"{base}-p{jax.process_index()}"
+
+
+# -- agreement primitives (parallel/distributed.py builds on these) ----------
+
+def barrier(name: str = "barrier") -> float:
+    """Block until every process reaches this point; returns the wait
+    in milliseconds (also recorded in the multihost metric group —
+    the direct cost of global agreement).  Single-process: 0ms."""
+    import jax
+
+    if jax.process_count() == 1:
+        return 0.0
+    from jax.experimental import multihost_utils
+
+    from paimon_tpu.metrics import (
+        MULTIHOST_BARRIER_WAIT_MS, global_registry,
+    )
+    t0 = _time.perf_counter()
+    multihost_utils.sync_global_devices(name)
+    waited = (_time.perf_counter() - t0) * 1000
+    global_registry().multihost_metrics().histogram(
+        MULTIHOST_BARRIER_WAIT_MS).update(waited)
+    return waited
+
+
+def broadcast_value(value: int, root: int = 0) -> int:
+    """Agree on one int64 across all processes: `root`'s value wins
+    (the "small broadcast" pinning one snapshot id for a
+    snapshot-consistent cross-host scan).  Single-process: identity."""
+    import jax
+
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(
+        np.asarray(int(value), dtype=np.int64),
+        is_source=jax.process_index() == root)
+    return int(np.asarray(out))
+
+
+def allgather_bytes(payload: bytes) -> List[bytes]:
+    """Every process contributes one bytes payload; every process
+    receives ALL of them, indexed by process id.  Two-phase (length
+    allgather -> padded uint8 allgather) so payload sizes may differ.
+    This is the commit-message wire of coordinator arbitration and the
+    row-exchange wire of 'exchange' routing.  Single-process:
+    [payload]."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [bytes(payload)]
+    from jax.experimental import multihost_utils
+
+    arr = np.frombuffer(bytes(payload), dtype=np.uint8)
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(arr)], dtype=np.int64)))
+    lengths = lengths.reshape(jax.process_count(), -1)[:, 0]
+    max_len = max(1, int(lengths.max()))
+    padded = np.zeros(max_len, dtype=np.uint8)
+    padded[:len(arr)] = arr
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(jax.process_count(), max_len)
+    return [gathered[p, :int(lengths[p])].tobytes()
+            for p in range(jax.process_count())]

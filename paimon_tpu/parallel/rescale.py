@@ -139,12 +139,15 @@ def rescale_dispatch_sharded(hashes: np.ndarray, new_buckets: int,
     return result
 
 
-def rescale_table_buckets(table, new_buckets: int, mesh=None
+def rescale_table_buckets(table, new_buckets: int, mesh=None,
+                          properties: Optional[Dict[str, str]] = None
                           ) -> Optional[int]:
     """Rewrite a fixed-bucket primary-key table to `new_buckets`: the
     mesh computes the routing (abs(hash % B) + all_to_all), the host
-    moves rows, writes the new bucket files and commits an overwrite,
-    then records the new bucket count in the schema."""
+    moves rows, writes the new bucket files and commits an overwrite
+    (stamped with `properties`, e.g. the distributed write plane's
+    ownership-map generation), then records the new bucket count in
+    the schema."""
     import pyarrow as pa
 
     from paimon_tpu.core.bucket import KeyHasher, _bucket_from_hash
@@ -219,7 +222,7 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None
     try:
         commit = FileStoreCommit(table.file_io, table.path, table.schema,
                                  table.options, branch=table.branch)
-        sid = commit.overwrite(messages)
+        sid = commit.overwrite(messages, properties=properties)
     except BaseException:
         sm.commit_changes(SchemaChange.set_option(
             "bucket", str(table.options.bucket)))

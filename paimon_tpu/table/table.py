@@ -154,6 +154,21 @@ class FileStoreTable:
     def new_read_builder(self) -> "ReadBuilder":
         return ReadBuilder(self)
 
+    def new_distributed_write(self, base_user: str = "writer",
+                              process_index: Optional[int] = None,
+                              process_count: Optional[int] = None):
+        """This process's slice of the multi-host write plane
+        (parallel/distributed.py): sharded (partition,bucket)
+        ownership over a JAX multi-host mesh, arbitrated commits
+        (multihost.commit.arbitration), pinned-snapshot cross-host
+        scans and online bucket rescale.  process_index/count default
+        from the initialized jax distributed runtime
+        (parallel/multihost.initialize)."""
+        from paimon_tpu.parallel.distributed import DistributedWritePlane
+        return DistributedWritePlane(self, base_user=base_user,
+                                     process_index=process_index,
+                                     process_count=process_count)
+
     def new_scan(self) -> FileStoreScan:
         return FileStoreScan(self.file_io, self.path, self.schema,
                              self.options, self.branch)
@@ -193,14 +208,19 @@ class FileStoreTable:
         return compact_table(self, full=full,
                              partition_filter=partition_filter)
 
-    def rescale_buckets(self, new_buckets: int, mesh=None
+    def rescale_buckets(self, new_buckets: int, mesh=None,
+                        properties: Optional[Dict[str, str]] = None
                         ) -> Optional[int]:
         """Change a fixed-bucket pk table's bucket count: the device
         mesh computes the row routing (abs(hash % B) + all_to_all
         repartition), the host rewrites files and commits an overwrite
-        (reference rescale-bucket procedure via ChannelComputer)."""
+        (reference rescale-bucket procedure via ChannelComputer).
+        `properties` are stamped on the overwrite snapshot (the
+        distributed write plane records its ownership-map generation
+        this way)."""
         from paimon_tpu.parallel.rescale import rescale_table_buckets
-        return rescale_table_buckets(self, new_buckets, mesh=mesh)
+        return rescale_table_buckets(self, new_buckets, mesh=mesh,
+                                     properties=properties)
 
     def rescale_postpone(self) -> Optional[int]:
         """Move bucket-postpone staging data into real buckets (reference
@@ -509,10 +529,9 @@ class TableWrite:
 
     def write_dicts(self, rows: Sequence[dict],
                     row_kinds: Optional[Sequence[int]] = None):
-        schema = self.table.arrow_schema()
-        table = pa.Table.from_pylist(list(rows), schema=schema)
-        kinds = np.asarray(row_kinds, dtype=np.int8) \
-            if row_kinds is not None else None
+        from paimon_tpu.core.write import dicts_to_arrow
+        table, kinds = dicts_to_arrow(self.table.arrow_schema(), rows,
+                                      row_kinds)
         self.write_arrow(table, kinds)
 
     def prepare_commit(self) -> List[CommitMessage]:

@@ -160,6 +160,73 @@ def _bare_sleep_calls():
     return found
 
 
+def _distributed_initialize_calls():
+    """`jax.distributed.initialize(...)` bring-up sites outside
+    paimon_tpu/parallel/multihost.py, as '<relpath>:<line>' strings —
+    in every spelling: the attribute chain `<x>.distributed
+    .initialize(...)`, the import form `from jax.distributed import
+    initialize`, and `from jax import distributed as d` followed by
+    `d.initialize(...)`.  multihost.initialize is the ONE reviewed
+    bring-up: it opts the CPU backend into Gloo cross-process
+    collectives BEFORE the backend initializes (multihost.py:57); a
+    direct call elsewhere bypasses that and resurrects the
+    'Multiprocess computations aren't implemented' failure mode."""
+    found = []
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if rel == "paimon_tpu/parallel/multihost.py":
+                continue       # the one reviewed bring-up path
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), rel)
+            # names bound by `from jax.distributed import initialize`
+            # (any alias) and module aliases from
+            # `from jax import distributed [as d]`
+            init_names = set()
+            dist_aliases = set()
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ImportFrom):
+                    continue
+                if node.module == "jax.distributed":
+                    for alias in node.names:
+                        if alias.name == "initialize":
+                            init_names.add(alias.asname or alias.name)
+                            found.append(f"{rel}:{node.lineno}")
+                elif node.module == "jax":
+                    for alias in node.names:
+                        if alias.name == "distributed":
+                            dist_aliases.add(alias.asname or alias.name)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                hit = (isinstance(fn, ast.Attribute) and
+                       fn.attr == "initialize" and
+                       ((isinstance(fn.value, ast.Attribute) and
+                         fn.value.attr == "distributed") or
+                        (isinstance(fn.value, ast.Name) and
+                         fn.value.id in dist_aliases))) or \
+                      (isinstance(fn, ast.Name) and
+                       fn.id in init_names)
+                if hit:
+                    found.append(f"{rel}:{node.lineno}")
+    return found
+
+
+def test_no_distributed_initialize_outside_multihost():
+    offenders = _distributed_initialize_calls()
+    assert not offenders, (
+        f"direct jax.distributed.initialize( outside "
+        f"parallel/multihost.py — use multihost.initialize(), which "
+        f"opts the CPU backend into Gloo collectives before the "
+        f"backend comes up (skipping it breaks multi-process CPU "
+        f"meshes): {sorted(offenders)}")
+
+
 def test_no_bare_sleeps_outside_backoff():
     offenders = _bare_sleep_calls()
     assert not offenders, (

@@ -76,8 +76,20 @@ class TestProcessLocalBatch:
         _ = jax.block_until_ready(batches[0]["id"])
 
 
+class _FakeFile:
+    def __init__(self, size):
+        self.file_size = size
+
+
+class _FakeSplit:
+    def __init__(self, *sizes):
+        self.data_files = [_FakeFile(s) for s in sizes]
+
+
 class TestSplitAssignment:
     def test_partition_of_splits(self):
+        # equal-weight splits degrade LPT to round-robin (ties break
+        # on index), preserving the original ownership contract
         splits = list(range(10))
         owned = [MH.assign_splits(splits, p, 3) for p in range(3)]
         assert sorted(x for part in owned for x in part) == splits
@@ -86,5 +98,71 @@ class TestSplitAssignment:
     def test_default_single_process_owns_all(self):
         assert MH.assign_splits([1, 2, 3]) == [1, 2, 3]
 
+    def test_byte_aware_lpt_balances_large_splits(self):
+        # round-robin by index would give process 0 BOTH huge splits
+        # (indices 0 and 2); byte-aware LPT spreads them
+        splits = [_FakeSplit(1000), _FakeSplit(1), _FakeSplit(1000),
+                  _FakeSplit(1)]
+        owned = [MH.assign_splits(splits, p, 2) for p in range(2)]
+        # disjoint cover
+        ids = sorted(id(s) for part in owned for s in part)
+        assert ids == sorted(id(s) for s in splits)
+        loads = [sum(MH.split_weight(s) for s in part)
+                 for part in owned]
+        assert max(loads) <= 1001          # one big + one small each
+
+    def test_lpt_deterministic_across_callers(self):
+        import random
+        sizes = [random.Random(7).randrange(1, 10_000)
+                 for _ in range(50)]
+        splits = [_FakeSplit(s) for s in sizes]
+        for p in range(4):
+            a = MH.assign_splits(splits, p, 4)
+            b = MH.assign_splits(splits, p, 4)
+            assert [id(s) for s in a] == [id(s) for s in b]
+        # every process's plan agrees: union is a disjoint cover
+        all_owned = [s for p in range(4)
+                     for s in MH.assign_splits(splits, p, 4)]
+        assert sorted(id(s) for s in all_owned) == \
+            sorted(id(s) for s in splits)
+
+    def test_split_weight_floor(self):
+        assert MH.split_weight(object()) == 1
+        assert MH.split_weight(_FakeSplit()) == 1
+        assert MH.split_weight(_FakeSplit(0, 0)) == 1
+
     def test_commit_user(self):
         assert MH.distributed_write_commit_user("w") == "w-p0"
+
+
+class TestInitializeConfigWarning:
+    def test_gloo_config_failure_warns_not_silent(self, monkeypatch):
+        """A jax build where the Gloo opt-in flag is missing must warn
+        through the obs plane (+ multihost config_warnings counter),
+        not silently proceed into broken CPU collectives."""
+        import warnings
+
+        import jax
+
+        from paimon_tpu.metrics import (
+            MULTIHOST_CONFIG_WARNINGS, global_registry,
+        )
+
+        def boom(key, value):
+            raise ValueError(f"no such config {key}")
+
+        inits = []
+        monkeypatch.setattr(jax.config, "update", boom)
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: inits.append(kw))
+        counter = global_registry().multihost_metrics().counter(
+            MULTIHOST_CONFIG_WARNINGS)
+        before = counter.count
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            idx, count = MH.initialize("127.0.0.1:1", 2, 0)
+        assert len(inits) == 1              # runtime still brought up
+        msgs = [str(w.message) for w in caught]
+        assert any("Gloo" in m and "cross-process" in m for m in msgs), \
+            msgs
+        assert counter.count == before + 1
