@@ -105,6 +105,12 @@ class CdcSinkWriter:
         # CAS and the ack) and drop the staged messages instead of
         # re-delivering committed rows under a new identifier.
         self._pending_ckpt: Optional[int] = None
+        # optional () -> {str: str} forwarded to
+        # FileStoreCommit.properties_provider on every commit this
+        # sink issues: re-evaluated per CAS attempt, which is how the
+        # distributed stream daemon keeps lease/ownership stamps
+        # fresh across commit retries (explicit properties win)
+        self.properties_provider = None
         self._computed = None
         if computed_columns:
             from paimon_tpu.cdc.computed import parse_computed_columns
@@ -188,12 +194,15 @@ class CdcSinkWriter:
         self._writer.write_arrow(batch, kinds)
 
     def commit(self, commit_identifier: int,
-               properties: Optional[Dict[str, str]] = None
-               ) -> Optional[int]:
+               properties: Optional[Dict[str, str]] = None,
+               force_create: bool = False) -> Optional[int]:
         """Commit everything staged + buffered under
         `commit_identifier`; `properties` land in the snapshot (the
         stream daemon commits its source offset here, atomically with
-        the data).  Exactly-once on every failure shape:
+        the data).  `force_create` publishes a snapshot even with
+        nothing buffered — distributed daemons advance their offset
+        (and renew their lease) through checkpoints whose owned share
+        of the window was empty.  Exactly-once on every failure shape:
 
         - replayed identifier (already committed by this user): commit
           nothing, return None;
@@ -205,13 +214,17 @@ class CdcSinkWriter:
           that identifier turns out to be durable instead of
           re-delivering the rows under a fresh identifier.
         """
-        if self._writer is None and not self._pending_msgs:
+        if self._writer is None and not self._pending_msgs and \
+                not force_create:
             return None
         if self._writer is None:
             wb = self.table.new_stream_write_builder() \
                 .with_commit_user(self.commit_user)
             self._wb = wb
         commit = self._wb.new_commit()
+        if self.properties_provider is not None:
+            commit._commit.properties_provider = \
+                self.properties_provider
         if self._pending_msgs and self._pending_ckpt is not None and \
                 self._pending_ckpt != commit_identifier:
             # the staged messages already rode a commit attempt under an
@@ -241,6 +254,9 @@ class CdcSinkWriter:
         if not commit.filter_committed([commit_identifier]):
             return None          # replayed checkpoint: exactly-once
         try:
+            # (TableCommit force-creates empty snapshots for any
+            # non-batch identifier, so bypassing the early return
+            # above is all `force_create` needs to do here)
             return commit.commit(msgs,
                                  commit_identifier=commit_identifier,
                                  properties=properties)

@@ -72,6 +72,16 @@ class FileStoreCommit:
         # commit_conflicts / commit_retries accounting here — commit
         # arbitration is THIS retry loop, observed from outside
         self.conflict_listener: Optional[callable] = None
+        # optional () -> {str: str} merged into EVERY snapshot this
+        # commit object publishes (explicit per-call properties win on
+        # key collisions).  The multi-host maintenance plane hangs its
+        # lease-renewal + ownership-generation stamps here so every
+        # plane-issued commit — data checkpoints, compactions,
+        # heartbeats — carries them: under plane-only traffic the tip
+        # is always stamped and ownership/lease recovery never has to
+        # walk past foreign snapshots.  Called once per CAS attempt,
+        # so lease timestamps stay fresh across commit retries.
+        self.properties_provider: Optional[callable] = None
 
     # -- public API ----------------------------------------------------------
 
@@ -398,6 +408,14 @@ class FileStoreCommit:
                      else -e.file.row_count) for e in entries)
                 changelog_rows = sum(e.file.row_count
                                      for e in changelog_entries)
+                eff_properties = properties
+                if self.properties_provider is not None:
+                    # provider stamps merge UNDER the explicit ones;
+                    # evaluated per attempt so lease renewals reflect
+                    # the attempt that actually publishes
+                    merged_props = dict(self.properties_provider() or {})
+                    merged_props.update(properties or {})
+                    eff_properties = merged_props or None
                 snapshot = Snapshot(
                     id=new_id,
                     schema_id=self.schema.id,
@@ -415,7 +433,7 @@ class FileStoreCommit:
                     total_record_count=prev_total + delta_rows,
                     delta_record_count=delta_rows,
                     changelog_record_count=changelog_rows or None,
-                    properties=properties,
+                    properties=eff_properties,
                     statistics=statistics,
                     next_row_id=next_row_id,
                     watermark=new_watermark,

@@ -234,9 +234,18 @@ def _clean_empty_dirs(table, bucket_dirs) -> None:
 def expire_snapshots(table, retain_max: Optional[int] = None,
                      retain_min: Optional[int] = None,
                      older_than_ms: Optional[int] = None,
-                     dry_run: bool = False) -> ExpireResult:
+                     dry_run: bool = False,
+                     min_retained_snapshot_id: Optional[int] = None
+                     ) -> ExpireResult:
     """Expire old snapshots. Defaults come from snapshot.num-retained.*
-    and snapshot.time-retained options."""
+    and snapshot.time-retained options.
+
+    `min_retained_snapshot_id` is an absolute floor: that snapshot and
+    everything after it survive regardless of the count/age windows.
+    The distributed stream daemons pin EVERY host's newest
+    offset-carrying checkpoint here — expiring a peer's recovery point
+    would make its restart (or a survivor's takeover of its offsets)
+    replay from scratch and reuse commit identifiers."""
     options = table.options
     if retain_max is None:
         retain_max = options.get(CoreOptions.SNAPSHOT_NUM_RETAINED_MAX)
@@ -284,6 +293,9 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
     consumer_min = table.consumer_manager.min_next_snapshot()
     if consumer_min is not None:
         end = min(end, consumer_min)
+    if min_retained_snapshot_id is not None:
+        # absolute recovery floor (multi-host checkpoint protection)
+        end = min(end, min_retained_snapshot_id)
     end = min(end, latest)              # always keep the latest
     if end <= earliest:
         return result

@@ -17,6 +17,13 @@ crash-point sweep tests) can tell corruption classes apart:
     index       missing-index-manifest, corrupt-index-manifest,
                 dangling-index-file
     changelog   dangling-changelog-file
+    multihost   ownership-inconsistency (the multihost.ownership.*
+                properties the sharded write/maintenance planes stamp
+                must be internally consistent along the chain: a
+                version that regresses, one version denoting two
+                different (processes, buckets, dead) maps, or a dead
+                set that shrinks within one topology means a botched
+                takeover — diagnosable offline, not fixable)
 
 Manifest kinds are split by object class on purpose: `fix_violations`
 drops + rewrites DATA manifests (missing-manifest/corrupt-manifest),
@@ -61,6 +68,7 @@ class ViolationKind:
     ROW_COUNT_MISMATCH = "row-count-mismatch"
     DANGLING_INDEX_FILE = "dangling-index-file"
     DANGLING_CHANGELOG_FILE = "dangling-changelog-file"
+    OWNERSHIP_INCONSISTENCY = "ownership-inconsistency"
 
     # classes fix_violations can repair ON THE LATEST SNAPSHOT (older
     # snapshots heal by expiring); the rest only heal by restore/expiry
@@ -363,6 +371,76 @@ class _GraphWalker:
                         f"changelog file missing: {path}", sid)
 
 
+def _check_ownership_chain(table, report: FsckReport, ids: List[int]):
+    """Multi-host ownership stamps (parallel/distributed.py +
+    parallel/maintenance_plane.py) must be internally consistent along
+    the snapshot chain:
+
+    1. `multihost.ownership.version` never regresses — a takeover, a
+       rescale and a topology change each BUMP it, so a later snapshot
+       stamped with an older version means two planes disagreed about
+       the current generation (split-brain) or a botched takeover
+       resumed a stale map;
+    2. one version denotes exactly one map: every snapshot stamping
+       version V must record the same (processes, buckets, dead) —
+       a stamped process count disagreeing with the recorded bucket
+       map is the signature of a restart that reused a version for a
+       different topology.
+
+    (A new generation MAY clear the dead set — a full-cohort rejoin
+    bumps the version; what it may never do is reuse an old one.)
+    """
+    from paimon_tpu.parallel.distributed import (
+        OWNERSHIP_BUCKETS_PROP, OWNERSHIP_DEAD_PROP,
+        OWNERSHIP_PROCESSES_PROP, OWNERSHIP_VERSION_PROP,
+    )
+    sm = table.snapshot_manager
+    prev_sid = prev_version = None
+    by_version: dict = {}
+    for sid in ids:
+        try:
+            snap = sm.snapshot(sid)
+        except (FileNotFoundError, OSError, ValueError, KeyError):
+            continue   # missing/corrupt: reported by the graph walk
+        props = snap.properties or {}
+        if OWNERSHIP_VERSION_PROP not in props:
+            continue
+        try:
+            version = int(props[OWNERSHIP_VERSION_PROP])
+            shape = (int(props.get(OWNERSHIP_PROCESSES_PROP) or 0),
+                     int(props.get(OWNERSHIP_BUCKETS_PROP) or 0))
+            dead = frozenset(
+                int(p) for p in
+                (props.get(OWNERSHIP_DEAD_PROP) or "").split(",")
+                if p.strip())
+        except ValueError:
+            report.add(ViolationKind.OWNERSHIP_INCONSISTENCY,
+                       f"{SNAPSHOT_PREFIX}{sid}",
+                       "unparsable multihost.ownership.* properties",
+                       sid)
+            continue
+        if prev_version is not None and version < prev_version:
+            report.add(
+                ViolationKind.OWNERSHIP_INCONSISTENCY,
+                f"{SNAPSHOT_PREFIX}{sid}",
+                f"ownership version regressed: snapshot {prev_sid} "
+                f"stamped v{prev_version}, later snapshot {sid} "
+                f"stamps v{version}", sid)
+        recorded = by_version.get(version)
+        if recorded is None:
+            by_version[version] = (shape, dead, sid)
+        elif recorded[0] != shape or recorded[1] != dead:
+            report.add(
+                ViolationKind.OWNERSHIP_INCONSISTENCY,
+                f"{SNAPSHOT_PREFIX}{sid}",
+                f"ownership version {version} denotes two different "
+                f"maps: snapshot {recorded[2]} records "
+                f"processes/buckets {recorded[0]} dead "
+                f"{sorted(recorded[1])}, snapshot {sid} records "
+                f"{shape} dead {sorted(dead)}", sid)
+        prev_sid, prev_version = sid, version
+
+
 def _check_chain(table, report: FsckReport) -> List[int]:
     """Snapshot chain contiguity + EARLIEST/LATEST hint validity.
     Returns the sorted existing snapshot ids."""
@@ -400,6 +478,9 @@ def fsck(table, snapshot_id: Optional[int] = None,
     ids = _check_chain(table, report)
     if not ids:
         return report
+    # chain-level multihost ownership consistency (cheap: properties
+    # only, no manifest IO) — always on, like the hint checks
+    _check_ownership_chain(table, report, ids)
 
     if snapshot_id is not None:
         targets = [snapshot_id] if snapshot_id in ids else []

@@ -53,7 +53,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "RESILIENCE_BROWNOUT_LEVEL", "RESILIENCE_HEDGE_WAIT_MS",
            "MULTIHOST_COMMIT_CONFLICTS", "MULTIHOST_COMMIT_RETRIES",
            "MULTIHOST_OWNERSHIP_HANDOFFS", "MULTIHOST_BARRIER_WAIT_MS",
-           "MULTIHOST_FOREIGN_ROWS", "MULTIHOST_CONFIG_WARNINGS"]
+           "MULTIHOST_FOREIGN_ROWS", "MULTIHOST_CONFIG_WARNINGS",
+           "MULTIHOST_OWNED_BUCKETS", "MULTIHOST_MAINTENANCE_TAKEOVERS",
+           "MULTIHOST_LEASE_RENEWALS", "MULTIHOST_LEASE_EXPIRED"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -199,6 +201,21 @@ MULTIHOST_OWNERSHIP_HANDOFFS = "ownership_handoffs"
 MULTIHOST_BARRIER_WAIT_MS = "barrier_wait_ms"
 MULTIHOST_FOREIGN_ROWS = "foreign_rows_routed"  # rows exchanged to owners
 MULTIHOST_CONFIG_WARNINGS = "config_warnings"   # collective-config fallbacks
+
+# multi-host MAINTENANCE-plane names (same multihost group; producer is
+# parallel/maintenance_plane.py, consumers the multi-host soak tests +
+# dashboards).  owned_buckets is a per-process gauge of the
+# (partition,bucket) groups this process currently owns (it JUMPS on a
+# takeover — the visible re-lease of a dead peer's buckets);
+# lease_renewals counts this process's successful lease stamps
+# (commit-carried or heartbeat); lease_expired counts peers this
+# process's failure detector declared dead; maintenance_takeovers
+# counts completed adoptions (ownership version bumped with the dead
+# set recorded — the acceptance signal of host-death tolerance).
+MULTIHOST_OWNED_BUCKETS = "owned_buckets"
+MULTIHOST_MAINTENANCE_TAKEOVERS = "maintenance_takeovers"
+MULTIHOST_LEASE_RENEWALS = "lease_renewals"
+MULTIHOST_LEASE_EXPIRED = "lease_expired"
 
 
 class Counter:

@@ -631,6 +631,38 @@ class CoreOptions:
         "split ownership covers exactly one consistent state.  false "
         "= each process plans its own latest snapshot (scans may "
         "straddle concurrent commits)")
+    MULTIHOST_LEASE_INTERVAL = ConfigOption(
+        "multihost.lease.interval", _parse_duration_ms, 10000,
+        "Target lease-renewal cadence of the multi-host maintenance "
+        "plane (parallel/maintenance_plane.py): every plane-issued "
+        "commit renews the committer's lease as snapshot properties; "
+        "when no commit happened within this interval the plane "
+        "publishes a small heartbeat snapshot so an idle-but-alive "
+        "host is never mistaken for a dead one")
+    MULTIHOST_LEASE_TIMEOUT = ConfigOption(
+        "multihost.lease.timeout", _parse_duration_ms, 60000,
+        "Failure-detector threshold: a maintenance-plane participant "
+        "whose newest lease renewal (max-merged over the recent "
+        "snapshot chain) is older than this is presumed DEAD, and its "
+        "(partition,bucket) groups are deterministically re-assigned "
+        "to the survivors (ownership version bump, dead set recorded "
+        "in snapshot properties).  Must comfortably exceed "
+        "multihost.lease.interval plus worst-case commit latency — a "
+        "premature declaration splits ownership of live buckets")
+    MULTIHOST_MAINTENANCE_TAKEOVER = ConfigOption(
+        "multihost.maintenance.takeover", _parse_bool, True,
+        "Whether survivors automatically adopt a dead host's buckets "
+        "(compaction, expiry election, changelog serving and — for "
+        "distributed stream daemons — its committed CDC offsets, "
+        "exactly-once).  false = the failure detector still reports "
+        "lease_expired, but ownership stays frozen until an operator "
+        "intervenes")
+    MULTIHOST_MAINTENANCE_LEASE_WALK = ConfigOption(
+        "multihost.maintenance.lease-walk", int, 16,
+        "How many recent snapshots the lease reader max-merges to "
+        "build the failure-detector view.  One snapshot would race "
+        "concurrent committers (each stamps the view IT knew); a "
+        "small window resolves the interleaving by max()")
 
     # -- observability (ours; paimon_tpu/obs/) -------------------------------
     METRICS_ENABLED = ConfigOption(

@@ -69,14 +69,27 @@ from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
 __all__ = ["OwnershipMap", "OwnershipError", "DistributedWritePlane",
            "owner_of", "pinned_scan_plan",
            "OWNERSHIP_VERSION_PROP", "OWNERSHIP_PROCESSES_PROP",
-           "OWNERSHIP_BUCKETS_PROP"]
+           "OWNERSHIP_BUCKETS_PROP", "OWNERSHIP_DEAD_PROP",
+           "LEASE_PROP_PREFIX", "lease_props", "merge_lease_view"]
 
 # snapshot property keys carrying the ownership-map generation: every
 # distributed commit stamps them, so the table's tip records which map
-# its files were routed under (rescale bumps the version)
+# its files were routed under (rescale bumps the version).  The
+# maintenance plane (parallel/maintenance_plane.py) adds two more
+# planes of properties on the SAME commits:
+#   multihost.ownership.dead   csv of process ids whose buckets have
+#                              been taken over by survivors (monotone
+#                              within one topology generation)
+#   multihost.lease.p<i>       wall-clock ms of process i's last lease
+#                              renewal as known by the committer — a
+#                              max-merge CRDT: readers fold the last
+#                              few snapshots so concurrent committers
+#                              cannot regress each other's renewals
 OWNERSHIP_VERSION_PROP = "multihost.ownership.version"
 OWNERSHIP_PROCESSES_PROP = "multihost.ownership.processes"
 OWNERSHIP_BUCKETS_PROP = "multihost.ownership.buckets"
+OWNERSHIP_DEAD_PROP = "multihost.ownership.dead"
+LEASE_PROP_PREFIX = "multihost.lease.p"
 
 _ROUTINGS = ("exchange", "spmd", "local-only")
 _ARBITRATIONS = ("cas", "coordinator")
@@ -87,32 +100,80 @@ class OwnershipError(RuntimeError):
     'local-only'), or peers disagree on the write-plane topology."""
 
 
-def owner_of(partition: Tuple, bucket: int, process_count: int) -> int:
+def owner_of(partition: Tuple, bucket: int, process_count: int,
+             dead: frozenset = frozenset()) -> int:
     """Deterministic owner of (partition, bucket): a crc32 shard over
     the group identity.  crc32, NOT `hash()` — Python string hashing
     is salted per process, and every process must compute the SAME
     map.  repr() of partition values (str/int/date/...) is stable
-    across processes for the types partitions can hold."""
+    across processes for the types partitions can hold.
+
+    `dead` processes own nothing: a group whose primary owner is dead
+    is re-sharded (same crc32, re-salted) over the SURVIVORS in rank
+    order — every survivor computes the identical takeover map from
+    the store-recorded dead set alone, with no communication (the
+    dead peer cannot join a collective)."""
     if process_count <= 1:
         return 0
     key = repr((tuple(partition), int(bucket))).encode("utf-8")
-    return zlib.crc32(key) % process_count
+    primary = zlib.crc32(key) % process_count
+    if primary not in dead:
+        return primary
+    survivors = [p for p in range(process_count) if p not in dead]
+    if not survivors:
+        raise OwnershipError(
+            "every process of the topology is recorded dead; the "
+            "table needs a fresh plane bring-up (new generation)")
+    return survivors[zlib.crc32(key + b"#takeover") % len(survivors)]
 
 
 @dataclass(frozen=True)
 class OwnershipMap:
-    """One generation of the sharded write-ownership function."""
+    """One generation of the sharded write-ownership function.
+
+    `dead` is the set of processes whose lease expired and whose
+    buckets survivors have adopted: they own nothing until they
+    rejoin (which is a new generation — the version bumps whenever
+    the ownership FUNCTION changes, takeover included)."""
     version: int
     num_processes: int
     num_buckets: int
+    dead: frozenset = frozenset()
 
     def owner_of(self, partition: Tuple, bucket: int) -> int:
-        return owner_of(partition, bucket, self.num_processes)
+        return owner_of(partition, bucket, self.num_processes,
+                        self.dead)
+
+    def alive(self) -> List[int]:
+        return [p for p in range(self.num_processes)
+                if p not in self.dead]
+
+    def with_dead(self, dead) -> "OwnershipMap":
+        """The takeover generation: same topology, `dead` added to
+        the dead set, version bumped (a different ownership function
+        must never share a version number)."""
+        merged = frozenset(self.dead) | frozenset(dead)
+        if merged == frozenset(self.dead):
+            return self
+        return OwnershipMap(self.version + 1, self.num_processes,
+                            self.num_buckets, merged)
+
+    def owned_groups(self, process_index: int, partitions=((),)
+                     ) -> List[Tuple[Tuple, int]]:
+        """Every (partition, bucket) this process owns, for the given
+        partition universe (default: the unpartitioned table)."""
+        return [(part, b) for part in partitions
+                for b in range(self.num_buckets)
+                if self.owner_of(part, b) == process_index]
 
     def to_properties(self) -> Dict[str, str]:
-        return {OWNERSHIP_VERSION_PROP: str(self.version),
-                OWNERSHIP_PROCESSES_PROP: str(self.num_processes),
-                OWNERSHIP_BUCKETS_PROP: str(self.num_buckets)}
+        props = {OWNERSHIP_VERSION_PROP: str(self.version),
+                 OWNERSHIP_PROCESSES_PROP: str(self.num_processes),
+                 OWNERSHIP_BUCKETS_PROP: str(self.num_buckets)}
+        if self.dead:
+            props[OWNERSHIP_DEAD_PROP] = ",".join(
+                str(p) for p in sorted(self.dead))
+        return props
 
     def handoffs_to(self, other: "OwnershipMap") -> int:
         """How many non-partitioned bucket owners move between this
@@ -127,29 +188,87 @@ class OwnershipMap:
         return moved
 
 
+def _map_from_properties(props: Dict[str, str]) -> OwnershipMap:
+    dead = frozenset(
+        int(p) for p in (props.get(OWNERSHIP_DEAD_PROP) or "").split(",")
+        if p.strip())
+    return OwnershipMap(
+        int(props[OWNERSHIP_VERSION_PROP]),
+        int(props.get(OWNERSHIP_PROCESSES_PROP) or 0),
+        int(props.get(OWNERSHIP_BUCKETS_PROP) or 0), dead)
+
+
 def resume_ownership_map(table, max_walk: int = 64
                          ) -> Optional[OwnershipMap]:
     """The ownership map recorded at the table's tip: walk snapshots
-    newest-first for the properties (bounded — compaction snapshots
-    don't carry them; distributed commits, the rescale overwrite AND
-    the empty-rescale stamp do, so a restart right after a rescale
-    still resumes the bumped generation).  None when the table has
-    never seen a distributed commit."""
+    newest-first for the properties.  Every PLANE-issued commit —
+    writes, compactions, heartbeats, the rescale overwrite AND the
+    empty-rescale stamp — carries them (core/commit.py
+    properties_provider), so under plane-only traffic the TIP itself
+    is stamped and the walk is one snapshot deep; the bound only
+    matters when foreign commit users (ad-hoc batch writers, repair
+    tools) interleave.  If the bounded walk finds nothing but the
+    chain continues, keep walking to the earliest snapshot rather
+    than inventing a fresh generation: before this fix a long run of
+    maintenance-only commits under other commit users pushed the last
+    stamped snapshot past the 64-snapshot window and the plane
+    restarted at version 1 — one version number denoting two
+    different ownership functions.  None only when NO retained
+    snapshot carries the properties."""
     sm = table.snapshot_manager
     latest = sm.latest_snapshot_id()
     if latest is None:
         return None
     earliest = sm.earliest_snapshot_id() or latest
-    for sid in range(latest, max(earliest, latest - max_walk) - 1, -1):
+    for sid in range(latest, earliest - 1, -1):
         if not sm.snapshot_exists(sid):
             continue
         props = sm.snapshot(sid).properties or {}
         if OWNERSHIP_VERSION_PROP in props:
-            return OwnershipMap(
-                int(props[OWNERSHIP_VERSION_PROP]),
-                int(props.get(OWNERSHIP_PROCESSES_PROP) or 0),
-                int(props.get(OWNERSHIP_BUCKETS_PROP) or 0))
+            return _map_from_properties(props)
     return None
+
+
+def lease_props(process_index: int, now_ms: int,
+                view: Optional[Dict[int, int]] = None
+                ) -> Dict[str, str]:
+    """The lease properties one commit stamps: the committer's view of
+    every holder's last renewal, with its OWN entry renewed to
+    `now_ms`.  Committing the full known view (not just self) makes
+    the tip a usable failure-detector input on its own."""
+    merged = dict(view or {})
+    merged[process_index] = max(now_ms,
+                                merged.get(process_index, 0))
+    return {f"{LEASE_PROP_PREFIX}{p}": str(ms)
+            for p, ms in sorted(merged.items())}
+
+
+def merge_lease_view(table, max_walk: int = 16) -> Dict[int, int]:
+    """{process -> newest known lease-renewal ms}: max-merge the lease
+    properties of the last `max_walk` snapshots.  Folding a small
+    window (not just the tip) keeps concurrent committers from
+    regressing each other — each stamps the view IT knew, and the
+    interleaving is resolved by max()."""
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id()
+    if latest is None:
+        return {}
+    earliest = sm.earliest_snapshot_id() or latest
+    view: Dict[int, int] = {}
+    for sid in range(latest, max(earliest, latest - max_walk) - 1, -1):
+        if not sm.snapshot_exists(sid):
+            continue
+        props = sm.snapshot(sid).properties or {}
+        for k, v in props.items():
+            if not k.startswith(LEASE_PROP_PREFIX):
+                continue
+            try:
+                p, ms = int(k[len(LEASE_PROP_PREFIX):]), int(v)
+            except ValueError:
+                continue
+            if ms > view.get(p, -1):
+                view[p] = ms
+    return view
 
 
 def resume_ownership_version(table, max_walk: int = 64) -> int:
@@ -275,16 +394,17 @@ class DistributedWritePlane:
             self.ownership = OwnershipMap(1, self.process_count,
                                           buckets)
         elif (recorded.num_processes, recorded.num_buckets) == \
-                (self.process_count, buckets):
+                (self.process_count, buckets) and not recorded.dead:
             self.ownership = OwnershipMap(recorded.version,
                                           self.process_count, buckets)
         else:
             # the topology changed without a coordinated rescale (a
-            # resized cluster, or a legacy tip without the full
-            # properties): that IS a new ownership function — reusing
-            # the recorded version would let one number denote two
-            # different maps.  Bump the generation and account the
-            # moved owners.
+            # resized cluster, a legacy tip without the full
+            # properties, or a recorded DEAD set — the full write
+            # cohort standing up again is a rejoin): that IS a new
+            # ownership function — reusing the recorded version would
+            # let one number denote two different maps.  Bump the
+            # generation and account the moved owners.
             self.ownership = OwnershipMap(recorded.version + 1,
                                           self.process_count, buckets)
             if recorded.num_processes and recorded.num_buckets:
@@ -297,6 +417,10 @@ class DistributedWritePlane:
                         MULTIHOST_OWNERSHIP_HANDOFFS).inc(moved)
         self._had_conflict = False
         self._closed = False
+        # introspection: which new buckets THIS host rewrote in the
+        # most recent rescale (the distributed-rescale tests assert
+        # the share stays within the host's owned set)
+        self.last_rescale_written_buckets: List[int] = []
         self._open_writer()
 
     # -- wiring --------------------------------------------------------------
@@ -543,32 +667,69 @@ class DistributedWritePlane:
         # branch is deterministic across the mesh.
         tip = self.table.snapshot_manager.latest_snapshot()
         empty = tip is None or tip.total_record_count == 0
-        # 2. elected rewrite (the all_to_all routing + overwrite
-        # commit); peers wait at the barrier.  The routing collective
-        # runs on the elected host's LOCAL devices — a global-mesh
-        # program issued by one process would desynchronize the
-        # peers' collective streams (gloo matches ops by order, and
-        # the peers are parked at the barrier, not in the shuffle).
+        # 2. the rewrite.  On a REAL multi-host mesh every host
+        # rewrites only the new buckets it will OWN under the bumped
+        # map: each host reads the same drained tip, computes the
+        # (pure, key-hash) routing on its HOST-LOCAL devices, writes
+        # its owned buckets' files, and ships the resulting commit
+        # messages to the elected committer over the allgather — the
+        # rewrite IO shards N-ways and only the snapshot publication
+        # is elected.  (A global-mesh routing program issued by one
+        # process would desynchronize the peers' gloo collective
+        # streams; host-local meshes keep the collective orders
+        # independent.)  Fake topologies (explicit process_index/count
+        # inside ONE real process, where the allgather degrades to
+        # [self]) keep the elected full rewrite — sharding there would
+        # silently drop the other fake processes' buckets.
         # The overwrite snapshot itself carries the NEW map's version
         # properties, so a process restarting between the rescale and
         # the first post-rescale commit resumes the bumped generation
         # instead of regressing to the drain commit's
-        if self.process_index == self.committer_index:
-            if empty:
+        import jax
+        sharded_rewrite = (not empty and self.process_count > 1
+                           and jax.process_count() == self.process_count)
+        self.last_rescale_written_buckets: List[int] = []
+        if empty:
+            if self.process_index == self.committer_index:
                 from paimon_tpu.schema import SchemaChange, SchemaManager
                 SchemaManager(
                     self.table.file_io, self.table.path,
                     self.table.branch).commit_changes(
                         SchemaChange.set_option("bucket",
                                                 str(new_buckets)))
-            else:
-                import jax
-                from jax.sharding import Mesh
-                local = Mesh(np.asarray(jax.local_devices()),
-                             ("buckets",))
-                self.table.rescale_buckets(
-                    new_buckets, mesh=local,
-                    properties=new_map.to_properties())
+        elif sharded_rewrite:
+            from jax.sharding import Mesh
+
+            from paimon_tpu.parallel.rescale import (
+                rescale_commit, rescale_routing, rescale_write_messages,
+            )
+            local = Mesh(np.asarray(jax.local_devices()), ("buckets",))
+            values = self.table.to_arrow()
+            routing = rescale_routing(self.table, values, new_buckets,
+                                      mesh=local)
+            mine = [b for b in routing
+                    if new_map.owner_of((), int(b))
+                    == self.process_index]
+            msgs = rescale_write_messages(self.table, values, routing,
+                                          new_buckets, buckets=mine)
+            self.last_rescale_written_buckets = sorted(
+                int(m.bucket) for m in msgs)
+            payloads = MH.allgather_bytes(pickle.dumps(list(msgs)))
+            if self.process_index == self.committer_index:
+                all_msgs = [m for pl in payloads
+                            for m in pickle.loads(pl)]
+                rescale_commit(self.table, new_buckets, all_msgs,
+                               properties=new_map.to_properties())
+        elif self.process_index == self.committer_index:
+            from jax.sharding import Mesh
+            local = Mesh(np.asarray(jax.local_devices()),
+                         ("buckets",))
+            sid = self.table.rescale_buckets(
+                new_buckets, mesh=local,
+                properties=new_map.to_properties())
+            if sid is not None:
+                self.last_rescale_written_buckets = sorted(
+                    range(new_buckets))
         MH.barrier("multihost-rescale")
         # 3. handoff: reopen against the new schema generation,
         # re-applying the load-time dynamic options copy() would drop

@@ -445,7 +445,9 @@ def _needs_rewrite(split, max_level: int) -> bool:
 
 
 def compact_table_mesh(table, mesh=None, axis: str = "buckets",
-                       retry_policy=None) -> MeshCompactStats:
+                       retry_policy=None, group_filter=None,
+                       commit_user=None, properties=None,
+                       properties_provider=None) -> MeshCompactStats:
     """Full compaction of every bucket of a primary-key table through
     the streaming mesh engine: engine-dispatched window kernels over a
     [B, window] lane stack, skew-aware bucket packing, one COMPACT
@@ -490,6 +492,13 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
     plan = table.new_read_builder().new_scan().plan()
     max_level = table.options.max_level
     splits = [s for s in plan.splits if s.data_files]
+    if group_filter is not None:
+        # sharded maintenance plane: this host compacts only the
+        # (partition, bucket) groups it owns (the scheduling seam of
+        # parallel/maintenance_plane.py) — peers run the same program
+        # over their own shares
+        splits = [s for s in splits
+                  if group_filter(tuple(s.partition), s.bucket)]
     jobs_splits = [s for s in splits if _needs_rewrite(s, max_level)]
     stats = MeshCompactStats(lanes=n_dev)
     if not jobs_splits:
@@ -743,7 +752,10 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
         _trace.maybe_export()
         return stats
     commit = FileStoreCommit(table.file_io, table.path, table.schema,
-                             table.options, branch=table.branch)
-    stats.snapshot_id = commit.commit(messages)
+                             table.options, commit_user=commit_user,
+                             branch=table.branch)
+    if properties_provider is not None:
+        commit.properties_provider = properties_provider
+    stats.snapshot_id = commit.commit(messages, properties=properties)
     _trace.maybe_export()
     return stats

@@ -189,7 +189,14 @@ def distributed_write_commit_user(base: str = "writer") -> str:
 def barrier(name: str = "barrier") -> float:
     """Block until every process reaches this point; returns the wait
     in milliseconds (also recorded in the multihost metric group —
-    the direct cost of global agreement).  Single-process: 0ms."""
+    the direct cost of global agreement).  Single-process: 0ms.
+
+    Deadline-aware like every other blocking wait in the repo
+    (utils/deadline.py): a request whose budget is already spent must
+    not ENTER a collective it may never leave — the tier-1 lint bans
+    direct sync_global_devices / broadcast_one_to_all /
+    process_allgather calls outside this module for exactly this
+    reason (plus the wait metric)."""
     import jax
 
     if jax.process_count() == 1:
@@ -199,6 +206,8 @@ def barrier(name: str = "barrier") -> float:
     from paimon_tpu.metrics import (
         MULTIHOST_BARRIER_WAIT_MS, global_registry,
     )
+    from paimon_tpu.utils.deadline import check_deadline
+    check_deadline(f"multihost barrier {name!r}")
     t0 = _time.perf_counter()
     multihost_utils.sync_global_devices(name)
     waited = (_time.perf_counter() - t0) * 1000
@@ -217,6 +226,8 @@ def broadcast_value(value: int, root: int = 0) -> int:
         return int(value)
     from jax.experimental import multihost_utils
 
+    from paimon_tpu.utils.deadline import check_deadline
+    check_deadline("multihost broadcast")
     out = multihost_utils.broadcast_one_to_all(
         np.asarray(int(value), dtype=np.int64),
         is_source=jax.process_index() == root)
@@ -236,6 +247,8 @@ def allgather_bytes(payload: bytes) -> List[bytes]:
         return [bytes(payload)]
     from jax.experimental import multihost_utils
 
+    from paimon_tpu.utils.deadline import check_deadline
+    check_deadline("multihost allgather")
     arr = np.frombuffer(bytes(payload), dtype=np.uint8)
     lengths = np.asarray(multihost_utils.process_allgather(
         np.asarray([len(arr)], dtype=np.int64)))

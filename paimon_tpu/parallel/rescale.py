@@ -23,7 +23,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["rescale_dispatch_sharded", "rescale_table_buckets"]
+__all__ = ["rescale_dispatch_sharded", "rescale_table_buckets",
+           "rescale_routing", "rescale_write_messages",
+           "rescale_commit"]
 
 _INVALID = np.uint32(0xFFFFFFFF)
 
@@ -139,26 +141,7 @@ def rescale_dispatch_sharded(hashes: np.ndarray, new_buckets: int,
     return result
 
 
-def rescale_table_buckets(table, new_buckets: int, mesh=None,
-                          properties: Optional[Dict[str, str]] = None
-                          ) -> Optional[int]:
-    """Rewrite a fixed-bucket primary-key table to `new_buckets`: the
-    mesh computes the routing (abs(hash % B) + all_to_all), the host
-    moves rows, writes the new bucket files and commits an overwrite
-    (stamped with `properties`, e.g. the distributed write plane's
-    ownership-map generation), then records the new bucket count in
-    the schema."""
-    import pyarrow as pa
-
-    from paimon_tpu.core.bucket import KeyHasher, _bucket_from_hash
-    from paimon_tpu.core.kv_file import KeyValueFileWriter
-    from paimon_tpu.core.read import MergeFileSplitRead
-    from paimon_tpu.core.write import CommitMessage, build_kv_table
-    from paimon_tpu.core.commit import FileStoreCommit
-    from paimon_tpu.ops.merge import sort_table
-    from paimon_tpu.options import CoreOptions
-    from paimon_tpu.schema import SchemaChange, SchemaManager
-
+def _validate_rescale(table, new_buckets: int):
     if not table.primary_keys or table.options.bucket < 1:
         raise ValueError("rescale targets fixed-bucket pk tables")
     if table.partition_keys:
@@ -167,9 +150,18 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None,
     if new_buckets < 1:
         raise ValueError("new_buckets must be >= 1")
 
-    values = table.to_arrow()      # merged current state, value columns
-    if values.num_rows == 0:
-        return None
+
+def rescale_routing(table, values, new_buckets: int,
+                    mesh=None) -> Dict[int, np.ndarray]:
+    """{new_bucket: global row indices into `values`} via the mesh
+    all_to_all dispatch, bit-compat-checked against the host bucket
+    formula.  Bucket membership is a pure function of the row keys, so
+    every host of a multi-host plane computes an EQUIVALENT routing
+    from the same pinned snapshot regardless of its local mesh shape —
+    which is what lets the distributed rescale shard the rewrite by
+    target-bucket ownership with no routing exchange."""
+    from paimon_tpu.core.bucket import KeyHasher, _bucket_from_hash
+
     bucket_keys = table.schema.bucket_keys() or \
         table.schema.trimmed_primary_keys()
     rt = table.schema.logical_row_type()
@@ -177,13 +169,29 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None,
                        [rt.get_field(k).type for k in bucket_keys])
     hashes = (hasher.hashes(values)
               & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-
     routing = rescale_dispatch_sharded(hashes, new_buckets, mesh)
     # bit-compat guard against the host formula
     host_buckets = _bucket_from_hash(hashes, new_buckets)
     for b, gids in routing.items():
         assert (host_buckets[gids] == b).all(), \
             "device routing diverged from reference bucket formula"
+    return routing
+
+
+def rescale_write_messages(table, values, routing, new_buckets: int,
+                           buckets: Optional[List[int]] = None):
+    """Write the rescaled bucket files for `buckets` (default: every
+    routed bucket) and return their CommitMessages.  A multi-host
+    plane passes each host the subset it will OWN under the bumped
+    ownership map, so the rewrite IO shards across hosts and the
+    elected committer only publishes."""
+    import pyarrow as pa
+
+    from paimon_tpu.core.kv_file import KeyValueFileWriter
+    from paimon_tpu.core.read import MergeFileSplitRead
+    from paimon_tpu.core.write import CommitMessage, build_kv_table
+    from paimon_tpu.ops.merge import sort_table
+    from paimon_tpu.options import CoreOptions
 
     reader = MergeFileSplitRead(table.file_io, table.path, table.schema,
                                 table.options)
@@ -199,24 +207,35 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None,
         **table.options.kv_writer_kwargs())
     max_level = table.options.max_level
 
+    wanted = None if buckets is None else {int(b) for b in buckets}
     messages: List[CommitMessage] = []
     for b, gids in sorted(routing.items()):
+        if wanted is not None and int(b) not in wanted:
+            continue
         rows = values.take(pa.array(gids))
         kv = build_kv_table(rows, table.schema,
                             np.arange(rows.num_rows, dtype=np.int64),
                             np.zeros(rows.num_rows, dtype=np.int8))
         order = sort_table(kv, reader.key_cols,
-                           key_encoder=reader.key_encoder)
+                          key_encoder=reader.key_encoder)
         kv = kv.take(pa.array(order))
         metas = writer.write((), int(b), kv, level=max_level)
         messages.append(CommitMessage((), int(b), new_buckets,
                                       new_files=metas))
+    return messages
 
-    # reference procedure order: ALTER the bucket option first, then
-    # INSERT OVERWRITE the reorganized data (writers must be paused for
-    # the whole rescale, like the reference's offline rescale job).  If
-    # the overwrite fails, roll the option back so the pre-rescale
-    # layout stays consistent with the schema.
+
+def rescale_commit(table, new_buckets: int, messages,
+                   properties: Optional[Dict[str, str]] = None
+                   ) -> Optional[int]:
+    """Publish a rescale: ALTER the bucket option first, then INSERT
+    OVERWRITE the reorganized data (reference procedure order; writers
+    must be paused for the whole rescale, like the reference's offline
+    rescale job).  If the overwrite fails, roll the option back so the
+    pre-rescale layout stays consistent with the schema."""
+    from paimon_tpu.core.commit import FileStoreCommit
+    from paimon_tpu.schema import SchemaChange, SchemaManager
+
     sm = SchemaManager(table.file_io, table.path, table.branch)
     sm.commit_changes(SchemaChange.set_option("bucket", str(new_buckets)))
     try:
@@ -228,3 +247,23 @@ def rescale_table_buckets(table, new_buckets: int, mesh=None,
             "bucket", str(table.options.bucket)))
         raise
     return sid
+
+
+def rescale_table_buckets(table, new_buckets: int, mesh=None,
+                          properties: Optional[Dict[str, str]] = None
+                          ) -> Optional[int]:
+    """Rewrite a fixed-bucket primary-key table to `new_buckets`: the
+    mesh computes the routing (abs(hash % B) + all_to_all), the host
+    moves rows, writes the new bucket files and commits an overwrite
+    (stamped with `properties`, e.g. the distributed write plane's
+    ownership-map generation), then records the new bucket count in
+    the schema."""
+    _validate_rescale(table, new_buckets)
+    values = table.to_arrow()      # merged current state, value columns
+    if values.num_rows == 0:
+        return None
+    routing = rescale_routing(table, values, new_buckets, mesh)
+    messages = rescale_write_messages(table, values, routing,
+                                      new_buckets)
+    return rescale_commit(table, new_buckets, messages,
+                          properties=properties)

@@ -201,12 +201,24 @@ class FileStoreTable:
             return rb.new_read().to_arrow(scan.plan().splits)
 
     def compact(self, full: bool = False,
-                partition_filter: Optional[dict] = None) -> Optional[int]:
+                partition_filter: Optional[dict] = None,
+                group_filter=None, commit_user: Optional[str] = None,
+                properties: Optional[Dict[str, str]] = None,
+                properties_provider=None) -> Optional[int]:
         """Trigger compaction and commit the result
-        (reference flink CompactAction, but engine-free here)."""
+        (reference flink CompactAction, but engine-free here).
+        `group_filter` is a (partition, bucket) -> bool scheduling
+        predicate — the sharded maintenance plane passes its
+        ownership filter so each host compacts only its own groups;
+        `commit_user`/`properties`/`properties_provider` land on the
+        COMPACT snapshot."""
         from paimon_tpu.compact.compact_action import compact_table
         return compact_table(self, full=full,
-                             partition_filter=partition_filter)
+                             partition_filter=partition_filter,
+                             group_filter=group_filter,
+                             commit_user=commit_user,
+                             properties=properties,
+                             properties_provider=properties_provider)
 
     def rescale_buckets(self, new_buckets: int, mesh=None,
                         properties: Optional[Dict[str, str]] = None
@@ -294,13 +306,14 @@ class FileStoreTable:
     def expire_snapshots(self, retain_max: Optional[int] = None,
                          retain_min: Optional[int] = None,
                          older_than_ms: Optional[int] = None,
-                         dry_run: bool = False):
+                         dry_run: bool = False,
+                         min_retained_snapshot_id: Optional[int] = None):
         """reference operation/ExpireSnapshotsImpl.java."""
         from paimon_tpu.maintenance import expire_snapshots
-        return expire_snapshots(self, retain_max=retain_max,
-                                retain_min=retain_min,
-                                older_than_ms=older_than_ms,
-                                dry_run=dry_run)
+        return expire_snapshots(
+            self, retain_max=retain_max, retain_min=retain_min,
+            older_than_ms=older_than_ms, dry_run=dry_run,
+            min_retained_snapshot_id=min_retained_snapshot_id)
 
     def remove_orphan_files(self, older_than_ms: Optional[int] = None,
                             dry_run: bool = False,

@@ -1,0 +1,82 @@
+"""Shared deterministic CDC stream for the multi-host soak
+(tests/test_multihost_maintenance.py).
+
+Every host of the mesh — and the auditing parent — must see the
+IDENTICAL global event stream (the SPMD shape of the distributed
+stream daemon), so the generator is a pure function of the offset:
+event n upserts key `n % keys` with value n, except that a crc32-
+derived slice of offsets are DELETES of the key (tombstones must
+survive takeover and serve-catch-up too).  No RNG state, no clock:
+two processes and the parent replay byte-identical histories.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional
+
+DEFAULT_KEYS = 41
+
+SOAK_TABLE_OPTIONS = {
+    "bucket": "4",
+    "stream.checkpoint.interval": "60",
+    "stream.compaction.interval": "120",
+    "stream.ingest.poll-interval": "10",
+    "stream.serve.poll-interval": "15",
+    "num-sorted-run.compaction-trigger": "3",
+    "multihost.lease.interval": "200",
+    "multihost.lease.timeout": "1500",
+    # keep every snapshot: the offset audit walks all of them and the
+    # serve takeover must never lose a delta to expiry
+    "snapshot.num-retained.min": "100000",
+    "snapshot.num-retained.max": "100000",
+}
+
+
+def _is_delete(n: int) -> bool:
+    return zlib.crc32(f"soak-{n}".encode()) % 12 == 0
+
+
+def gen_event(n: int, keys: int = DEFAULT_KEYS) -> Dict:
+    """The n-th event of the global stream (pure function of n)."""
+    key = n % keys
+    if _is_delete(n):
+        return {"op": "d", "before": {"id": key, "v": n}}
+    return {"op": "c", "after": {"id": key, "v": n}}
+
+
+def gen_events(n0: int, n1: int, keys: int = DEFAULT_KEYS
+               ) -> List[Dict]:
+    return [gen_event(n, keys) for n in range(n0, n1)]
+
+
+def expected_state(total: int, keys: int = DEFAULT_KEYS
+                   ) -> Dict[int, int]:
+    """{key: value} after replaying events 0..total-1."""
+    state: Dict[int, int] = {}
+    for n in range(total):
+        key = n % keys
+        if _is_delete(n):
+            state.pop(key, None)
+        else:
+            state[key] = n
+    return state
+
+
+def materialize(streams: List[List[dict]],
+                kind_col: str = "_ROW_KIND") -> Dict[int, int]:
+    """Apply consumed changelog rows stream-by-stream (each stream in
+    its consumption order).  For the host-kill soak the dead host's
+    stream is applied FIRST: every row it delivered predates the
+    takeover, and the survivor re-serves the unserved suffix per
+    adopted bucket before continuing — suffix replays are idempotent
+    here exactly like daemon restarts are for single-host serving."""
+    out: Dict[int, int] = {}
+    for rows in streams:
+        for r in rows:
+            kind = r[kind_col]
+            if kind in (0, 2):                       # +I / +U
+                out[r["id"]] = r["v"]
+            elif kind == 3:                          # -D
+                out.pop(r["id"], None)
+    return out

@@ -222,8 +222,15 @@ class TestFakeTopologyPlane:
         rows1 = [{"id": i, "v": 1} for i in range(100)]
         for p in (p0, p1):
             p.write_dicts(rows1)
-        # live traffic: rows buffered and UNcommitted when the rescale
-        # arrives; drain-and-handoff publishes them under the old map
+        # fake topology runs the two planes SEQUENTIALLY, so the
+        # drains must land before the first rescale call like the
+        # real-mesh barrier orders them — p1 draining after p0's
+        # rewrite would stamp the old ownership generation past the
+        # new one, which fsck now flags as ownership-inconsistency
+        # (the REAL 2-process coordinator test covers true
+        # buffered-rows-during-rescale traffic)
+        p0.commit()
+        p1.commit()
         handoffs = global_registry().multihost_metrics().counter(
             MULTIHOST_OWNERSHIP_HANDOFFS)
         before = handoffs.count

@@ -217,6 +217,66 @@ def _distributed_initialize_calls():
     return found
 
 
+_COLLECTIVES = {"sync_global_devices", "broadcast_one_to_all",
+                "process_allgather"}
+
+
+def _raw_collective_calls():
+    """`sync_global_devices` / `broadcast_one_to_all` /
+    `process_allgather` call sites (and their `from ... import`
+    bindings) outside paimon_tpu/parallel/multihost.py, as
+    '<relpath>:<line>' strings.  multihost.py's barrier() /
+    broadcast_value() / allgather_bytes() are the ONE reviewed wrap:
+    they are deadline-bounded (a spent request budget never enters a
+    collective it may not leave), record barrier_wait_ms, and degrade
+    to single-process no-ops.  A raw jax.experimental.multihost_utils
+    call elsewhere gets none of that — and a hung collective with a
+    dead peer is exactly the failure the lease-based maintenance
+    plane exists to tolerate."""
+    found = []
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if rel == "paimon_tpu/parallel/multihost.py":
+                continue       # the one reviewed home of collectives
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), rel)
+            # names bound by `from jax.experimental.multihost_utils
+            # import sync_global_devices [as x]` (any alias)
+            bound = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) and node.module \
+                        and node.module.endswith("multihost_utils"):
+                    for alias in node.names:
+                        if alias.name in _COLLECTIVES:
+                            bound.add(alias.asname or alias.name)
+                            found.append(f"{rel}:{node.lineno}")
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                hit = (isinstance(fn, ast.Attribute) and
+                       fn.attr in _COLLECTIVES) or \
+                      (isinstance(fn, ast.Name) and fn.id in bound)
+                if hit:
+                    found.append(f"{rel}:{node.lineno}")
+    return found
+
+
+def test_no_raw_collectives_outside_multihost():
+    offenders = _raw_collective_calls()
+    assert not offenders, (
+        f"raw sync_global_devices / broadcast_one_to_all / "
+        f"process_allgather outside parallel/multihost.py — use "
+        f"multihost.barrier() / broadcast_value() / allgather_bytes(), "
+        f"the deadline-bounded, metric-instrumented agreement "
+        f"primitives: {sorted(offenders)}")
+
+
 def test_no_distributed_initialize_outside_multihost():
     offenders = _distributed_initialize_calls()
     assert not offenders, (

@@ -1,0 +1,741 @@
+"""Mesh-sharded maintenance plane (parallel/maintenance_plane.py):
+lease-based, takeover-capable bucket ownership for compaction, expiry
+and changelog serving.
+
+Fake-topology layer: planes with explicit (process_index,
+process_count) over one table in ONE process drive the lease
+protocol, the failure detector (injected clocks), deterministic
+takeover, the scheduling filters, the stamped-commit recovery
+regression and the fsck ownership check without a mesh.  The
+in-process two-daemon takeover test at the bottom is the single-box
+rehearsal of the real 2-process gloo soak
+(tests/test_multihost_maintenance.py).
+"""
+
+import time
+
+import pytest
+
+from paimon_tpu.metrics import (
+    MULTIHOST_LEASE_EXPIRED, MULTIHOST_LEASE_RENEWALS,
+    MULTIHOST_MAINTENANCE_TAKEOVERS, MULTIHOST_OWNED_BUCKETS,
+    global_registry,
+)
+from paimon_tpu.parallel.distributed import (
+    OwnershipError, OwnershipMap, lease_props, merge_lease_view,
+    owner_of, resume_ownership_map,
+)
+from paimon_tpu.parallel.maintenance_plane import MaintenancePlane
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, IntType
+
+
+def _schema(buckets=4, extra=None):
+    opts = {"bucket": str(buckets)}
+    opts.update(extra or {})
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", IntType())
+            .primary_key("id")
+            .options(opts)
+            .build())
+
+
+def _table(tmp_path, name="t", buckets=4, extra=None):
+    return FileStoreTable.create(str(tmp_path / name),
+                                 _schema(buckets, extra))
+
+
+def _write_commit(table, rows, user=None):
+    wb = table.new_batch_write_builder()
+    if user:
+        wb.commit_user = user
+    with wb.new_write() as w:
+        w.write_dicts(rows)
+        return wb.new_commit().commit(w.prepare_commit())
+
+
+# -- ownership with a dead set ------------------------------------------------
+
+class TestTakeoverOwnership:
+    def test_dead_owner_reassigned_to_survivors_deterministically(self):
+        n = 4
+        dead = frozenset({2})
+        owners = [owner_of((), b, n, dead) for b in range(64)]
+        # twice the same map, nothing owned by the dead process,
+        # survivors all participate
+        assert owners == [owner_of((), b, n, dead) for b in range(64)]
+        assert 2 not in owners
+        assert set(owners) <= {0, 1, 3}
+        # only groups the dead process owned move; everything else is
+        # byte-stable across the takeover
+        for b in range(64):
+            if owner_of((), b, n) != 2:
+                assert owner_of((), b, n, dead) == owner_of((), b, n)
+
+    def test_every_survivor_computes_the_same_successor_map(self):
+        # the whole point: N survivors adopt with NO communication
+        a = OwnershipMap(3, 4, 32).with_dead({1})
+        b = OwnershipMap(3, 4, 32).with_dead({1})
+        assert a == b
+        assert a.version == 4
+        assert [a.owner_of((), x) for x in range(32)] == \
+            [b.owner_of((), x) for x in range(32)]
+
+    def test_with_dead_idempotent_and_monotone(self):
+        m = OwnershipMap(1, 3, 8)
+        m2 = m.with_dead({2})
+        assert m2.version == 2 and m2.dead == frozenset({2})
+        assert m2.with_dead({2}) is m2          # no spurious bump
+        m3 = m2.with_dead({0})
+        assert m3.version == 3
+        assert m3.dead == frozenset({0, 2})
+        assert m3.alive() == [1]
+
+    def test_all_dead_raises(self):
+        with pytest.raises(OwnershipError, match="dead"):
+            owner_of((), 0, 2, frozenset({0, 1}))
+
+    def test_dead_set_roundtrips_through_properties(self):
+        from paimon_tpu.parallel.distributed import _map_from_properties
+        m = OwnershipMap(5, 4, 16, frozenset({1, 3}))
+        assert _map_from_properties(m.to_properties()) == m
+
+
+# -- leases -------------------------------------------------------------------
+
+class TestLeases:
+    def test_lease_props_renew_self_and_carry_view(self):
+        p = lease_props(1, 500, {0: 100, 1: 200})
+        assert p == {"multihost.lease.p0": "100",
+                     "multihost.lease.p1": "500"}
+        # never regress own entry
+        p = lease_props(1, 50, {1: 200})
+        assert p["multihost.lease.p1"] == "200"
+
+    def test_merge_lease_view_max_merges_recent_chain(self, tmp_path):
+        t = _table(tmp_path)
+        # two committers race: each stamps the view IT knew; the
+        # reader folds the window with max()
+        from paimon_tpu.core.commit import FileStoreCommit
+        c = FileStoreCommit(t.file_io, t.path, t.schema, t.options,
+                            commit_user="x")
+        c.commit([], properties=lease_props(0, 1000, {1: 50}),
+                 force_create=True)
+        c.commit([], properties=lease_props(1, 800, {0: 900}),
+                 force_create=True)
+        view = merge_lease_view(FileStoreTable.load(t.path))
+        assert view == {0: 1000, 1: 800}
+
+
+# -- the plane ----------------------------------------------------------------
+
+def _plane(table, pid, count, clock, base="maint"):
+    return MaintenancePlane(table, base_user=base, process_index=pid,
+                            process_count=count, clock=clock)
+
+
+class TestMaintenancePlane:
+    def test_detector_declares_stale_peer_once(self, tmp_path):
+        t = _table(tmp_path, extra={"multihost.lease.timeout": "1000",
+                                    "multihost.lease.interval": "100"})
+        now = {"ms": 10_000}
+        clock = lambda: now["ms"]                          # noqa: E731
+        g = global_registry().multihost_metrics()
+        expired0 = g.counter(MULTIHOST_LEASE_EXPIRED).count
+        takeovers0 = g.counter(MULTIHOST_MAINTENANCE_TAKEOVERS).count
+
+        p0 = _plane(t, 0, 2, clock)
+        p0.ensure_lease()
+        p1 = _plane(FileStoreTable.load(t.path), 1, 2, clock)
+        p1.ensure_lease()
+        p0.refresh_view()
+        # both healthy: no verdicts
+        assert p0.detect_expired() == frozenset()
+        # p1 goes silent past the timeout
+        now["ms"] += 5_000
+        assert p0.detect_expired() == frozenset({1})
+        # declared exactly once (the caller is acting on it)
+        assert p0.detect_expired() == frozenset()
+        assert g.counter(MULTIHOST_LEASE_EXPIRED).count == expired0 + 1
+        # adoption bumps the generation and the owned gauge jumps
+        owned_before = g.gauge(MULTIHOST_OWNED_BUCKETS).value
+        v = p0.ownership.version
+        p0.adopt({1})
+        assert p0.ownership.version == v + 1
+        assert p0.ownership.dead == frozenset({1})
+        assert g.counter(MULTIHOST_MAINTENANCE_TAKEOVERS).count == \
+            takeovers0 + 1
+        assert g.gauge(MULTIHOST_OWNED_BUCKETS).value > owned_before
+        assert g.gauge(MULTIHOST_OWNED_BUCKETS).value == 4
+
+    def test_own_renewals_keep_self_alive(self, tmp_path):
+        t = _table(tmp_path, extra={"multihost.lease.timeout": "1000"})
+        now = {"ms": 0}
+        p0 = _plane(t, 0, 2, lambda: now["ms"])
+        p0.ensure_lease()
+        now["ms"] += 10_000
+        assert 0 not in p0.expired_processes()   # never self
+
+    def test_heartbeat_renews_idle_lease_and_stamps(self, tmp_path):
+        t = _table(tmp_path, extra={"multihost.lease.interval": "100",
+                                    "multihost.lease.timeout": "1000"})
+        now = {"ms": 1_000}
+        p0 = _plane(t, 0, 2, lambda: now["ms"])
+        g = global_registry().multihost_metrics()
+        renewals0 = g.counter(MULTIHOST_LEASE_RENEWALS).count
+        assert p0.ensure_lease() is not None
+        assert not p0.heartbeat_due()
+        assert p0.maybe_heartbeat() is None      # fresh: not due
+        now["ms"] += 500
+        sid = p0.maybe_heartbeat()
+        assert sid is not None
+        assert g.counter(MULTIHOST_LEASE_RENEWALS).count == \
+            renewals0 + 2
+        fresh = FileStoreTable.load(t.path)
+        # the heartbeat snapshot carries ownership + lease stamps
+        snap = fresh.latest_snapshot()
+        assert snap.properties["multihost.ownership.version"] == "1"
+        assert snap.properties["multihost.lease.p0"] == str(now["ms"])
+        assert merge_lease_view(fresh)[0] == now["ms"]
+        # heartbeats are disabled on single-process planes
+        p_solo = _plane(_table(tmp_path, "solo"), 0, 1,
+                        lambda: now["ms"])
+        assert p_solo.maybe_heartbeat() is None
+
+    def test_plane_refuses_recorded_dead_self(self, tmp_path):
+        t = _table(tmp_path, extra={"multihost.lease.timeout": "500"})
+        now = {"ms": 0}
+        p0 = _plane(t, 0, 2, lambda: now["ms"])
+        p0.ensure_lease()
+        p0.adopt({1})
+        p0.maybe_heartbeat() if p0.heartbeat_due() else \
+            p0.ensure_lease()                    # publish the map
+        with pytest.raises(OwnershipError, match="DEAD"):
+            _plane(FileStoreTable.load(t.path), 1, 2,
+                   lambda: now["ms"])
+        # survivors resume the recorded generation, dead set included
+        p0b = _plane(FileStoreTable.load(t.path), 0, 2,
+                     lambda: now["ms"])
+        assert p0b.ownership.dead == frozenset({1})
+
+    def test_expiry_election_fails_over(self, tmp_path):
+        t = _table(tmp_path)
+        now = {"ms": 0}
+        p0 = _plane(t, 0, 2, lambda: now["ms"])
+        p1 = _plane(FileStoreTable.load(t.path), 1, 2,
+                    lambda: now["ms"])
+        assert p0.owns_expiry() and not p1.owns_expiry()
+        p1.adopt({0})
+        assert p1.owns_expiry()
+
+    def test_group_filters_partition_the_table(self, tmp_path):
+        t = _table(tmp_path, buckets=8)
+        p0 = _plane(t, 0, 2, lambda: 0)
+        p1 = _plane(FileStoreTable.load(t.path), 1, 2, lambda: 0)
+        owned0 = {b for b in range(8) if p0.owns((), b)}
+        owned1 = {b for b in range(8) if p1.owns((), b)}
+        assert owned0 | owned1 == set(range(8))
+        assert owned0.isdisjoint(owned1)
+
+
+# -- stamped-commit recovery (satellite regression) ---------------------------
+
+class TestStampedRecovery:
+    def test_resume_survives_long_foreign_maintenance_run(self,
+                                                          tmp_path):
+        """Satellite 1: a long run of maintenance-only commits under
+        OTHER commit users used to push the last ownership-stamped
+        snapshot past resume_ownership_map's 64-snapshot walk, and
+        the plane restarted at a version that already meant something
+        else.  The walk now continues to the earliest retained
+        snapshot."""
+        t = _table(tmp_path, extra={"snapshot.num-retained.min": "200",
+                                    "snapshot.num-retained.max": "200"})
+        plane = t.new_distributed_write(process_index=0,
+                                        process_count=2)
+        plane.write_dicts([{"id": i, "v": 0} for i in range(50)])
+        plane.commit()
+        plane.close()
+        # 70 foreign snapshots (uuid commit users, no stamps)
+        for k in range(70):
+            _write_commit(FileStoreTable.load(t.path),
+                          [{"id": 1000 + k, "v": k}])
+        resumed = resume_ownership_map(FileStoreTable.load(t.path))
+        assert resumed is not None and resumed.version == 1
+        # and the plane resumes the SAME generation, no spurious bump
+        again = FileStoreTable.load(t.path).new_distributed_write(
+            process_index=0, process_count=2)
+        assert again.ownership.version == 1
+        again.close()
+
+    def test_plane_issued_compaction_commits_are_stamped(self,
+                                                         tmp_path):
+        """The other half of the satellite: compaction issued BY the
+        plane stamps lease + ownership, so plane-only traffic keeps
+        the tip stamped (one-snapshot recovery walk)."""
+        t = _table(tmp_path, extra={
+            "num-sorted-run.compaction-trigger": "1"})
+        now = {"ms": 5_000}
+        plane = _plane(t, 0, 2, lambda: now["ms"])
+        for k in range(3):
+            _write_commit(
+                FileStoreTable.load(
+                    t.path, dynamic_options={"write-only": "true"}),
+                [{"id": i, "v": k} for i in range(40)])
+        props = dict(plane.stamp_properties())
+        sid = FileStoreTable.load(t.path).compact(
+            full=True, group_filter=plane.group_filter(),
+            commit_user=plane.commit_user,
+            properties_provider=plane.stamp_properties)
+        assert sid is not None
+        snap = FileStoreTable.load(t.path).snapshot_manager \
+            .snapshot(sid)
+        assert snap.commit_user == plane.commit_user
+        assert snap.properties["multihost.ownership.version"] == \
+            props["multihost.ownership.version"]
+        assert "multihost.lease.p0" in snap.properties
+        # the compaction touched ONLY owned groups
+        fresh = FileStoreTable.load(t.path)
+        scan = fresh.new_scan()
+        for e in scan.read_entries(fresh.latest_snapshot()):
+            part = tuple(scan._partition_codec.from_bytes(e.partition))
+            if e.file.level and e.file.level > 0:
+                assert plane.owns(part, e.bucket), \
+                    f"compacted foreign bucket {e.bucket}"
+
+
+# -- fsck ownership check -----------------------------------------------------
+
+class TestFsckOwnership:
+    def _stamped_commit(self, table, user, props, rows):
+        from paimon_tpu.core.commit import FileStoreCommit
+        c = FileStoreCommit(table.file_io, table.path, table.schema,
+                            table.options, commit_user=user)
+        return c.commit([], properties=props, force_create=True)
+
+    def test_version_regression_flagged(self, tmp_path):
+        t = _table(tmp_path)
+        m1 = OwnershipMap(1, 2, 4)
+        m2 = OwnershipMap(2, 2, 4, frozenset({1}))
+        self._stamped_commit(t, "a", m1.to_properties(), [])
+        self._stamped_commit(t, "a", m2.to_properties(), [])
+        self._stamped_commit(t, "b", m1.to_properties(), [])  # stale!
+        report = FileStoreTable.load(t.path).fsck()
+        kinds = report.kinds()
+        assert "ownership-inconsistency" in kinds
+        assert any("regressed" in v.detail
+                   for v in report.by_kind("ownership-inconsistency"))
+
+    def test_one_version_two_maps_flagged(self, tmp_path):
+        t = _table(tmp_path)
+        self._stamped_commit(
+            t, "a", OwnershipMap(3, 2, 4).to_properties(), [])
+        self._stamped_commit(
+            t, "b", OwnershipMap(3, 4, 4).to_properties(), [])
+        report = FileStoreTable.load(t.path).fsck()
+        viols = report.by_kind("ownership-inconsistency")
+        assert viols and any("two different maps" in v.detail
+                             for v in viols)
+
+    def test_healthy_takeover_chain_is_clean(self, tmp_path):
+        t = _table(tmp_path)
+        m1 = OwnershipMap(1, 2, 4)
+        self._stamped_commit(t, "a", m1.to_properties(), [])
+        self._stamped_commit(t, "a", m1.to_properties(), [])
+        m2 = m1.with_dead({1})
+        self._stamped_commit(t, "a", m2.to_properties(), [])
+        assert FileStoreTable.load(t.path).fsck().ok
+
+
+# -- expire floor -------------------------------------------------------------
+
+def test_expire_respects_min_retained_snapshot_floor(tmp_path):
+    t = _table(tmp_path, extra={"snapshot.num-retained.min": "1",
+                                "snapshot.num-retained.max": "2"})
+    for k in range(8):
+        _write_commit(FileStoreTable.load(t.path),
+                      [{"id": k, "v": k}])
+    fresh = FileStoreTable.load(t.path)
+    # without the floor, retain_max=2 would expire everything < 7
+    result = fresh.expire_snapshots(older_than_ms=2 ** 62,
+                                    min_retained_snapshot_id=3)
+    assert result.expired_snapshots == [1, 2]
+    sm = FileStoreTable.load(t.path).snapshot_manager
+    assert sm.earliest_snapshot_id() == 3
+
+
+# -- review-fix regressions ---------------------------------------------------
+
+class TestReviewFixes:
+    def _daemon(self, t, pid, count, base="stream-daemon",
+                source=None):
+        from paimon_tpu.cdc.source import MemoryCdcSource
+        from paimon_tpu.service.stream_daemon import StreamDaemon
+        plane = MaintenancePlane(t, base_user=base, process_index=pid,
+                                 process_count=count)
+        return StreamDaemon(t, source or MemoryCdcSource(),
+                            commit_user=base, plane=plane)
+
+    def test_reconcile_queues_peer_published_takeovers(self, tmp_path):
+        """A 3-host mesh where a faster survivor publishes the
+        takeover first: this host's detector suppresses the peer
+        (already in ownership.dead), but its OWN re-sharded share is
+        still unbackfilled — the reconciliation must queue it from
+        the global map minus the local ledger."""
+        t = _table(tmp_path)
+        d = self._daemon(t, 0, 3)
+        d.plane.ownership = d.plane.ownership.with_dead({2})
+        assert d.plane.detect_expired() == frozenset()  # suppressed
+        d._reconcile_adoptions()
+        assert d._pending_adoptions == [2]
+        d._reconcile_adoptions()                        # idempotent
+        assert d._pending_adoptions == [2]
+        # durably adopted: nothing left to queue
+        d._pending_adoptions.clear()
+        d._ingest_dead = frozenset({2})
+        d._reconcile_adoptions()
+        assert d._pending_adoptions == []
+
+    def test_takeover_disabled_freezes_ownership(self, tmp_path):
+        t = _table(tmp_path, extra={
+            "multihost.maintenance.takeover": "false"})
+        d = self._daemon(t, 0, 2)
+        d.plane.ownership = d.plane.ownership.with_dead({1})
+        assert not d.plane.takeover_enabled
+        d._reconcile_adoptions({1})
+        assert d._pending_adoptions == []
+        # the standalone path also freezes
+        assert d.plane.detect_and_take_over() == frozenset()
+
+    def test_stamp_refreshes_generation_from_store(self, tmp_path):
+        """A commit losing its CAS race to a peer's takeover
+        re-evaluates the provider per attempt; the stamp must carry
+        the NEW generation read back from the store, not the stale
+        in-memory one (which would land an ownership regression at
+        the tip)."""
+        t = _table(tmp_path)
+        now = {"ms": 0}
+        p0 = _plane(t, 0, 3, lambda: now["ms"])
+        p1 = _plane(FileStoreTable.load(t.path), 1, 3,
+                    lambda: now["ms"])
+        p0.adopt({2})
+        p0.ensure_lease()          # publishes v2 dead={2}
+        stamped = p1.stamp_properties()
+        assert stamped["multihost.ownership.version"] == "2"
+        assert stamped["multihost.ownership.dead"] == "2"
+        assert p1.ownership.version == 2
+
+    def test_expiry_floor_protects_pending_adoption(self, tmp_path):
+        """A dead peer's newest offset checkpoint stays protected
+        until EVERY alive process's ledger covers it — one survivor's
+        published takeover must not let expiry drop the offset the
+        other survivor's pending backfill still needs."""
+        from paimon_tpu.core.commit import FileStoreCommit
+
+        t = _table(tmp_path)
+
+        def stamp(user, props):
+            c = FileStoreCommit(t.file_io, t.path, t.schema,
+                                t.options, commit_user=user)
+            c.commit([], properties=props, force_create=True)
+
+        m1 = OwnershipMap(1, 3, 4)
+        base = {"stream.source.offset": "10",
+                "stream.ingest.ts-ms": "1"}
+        for p in (0, 1, 2):
+            stamp(f"stream-daemon-p{p}",
+                  {**base, **m1.to_properties()})
+        dead_ckpt = FileStoreTable.load(t.path) \
+            .snapshot_manager.latest_snapshot_id()   # p2's checkpoint
+        # p0 publishes ITS takeover of p2 (ledger covers 2)...
+        m2 = m1.with_dead({2})
+        stamp("stream-daemon-p0",
+              {**m2.to_properties(), "stream.adopted": "2",
+               "stream.source.offset": "11",
+               "stream.ingest.ts-ms": "2"})
+        # ...but p1's ledger does NOT cover 2 yet
+        fresh = FileStoreTable.load(t.path)
+        d1 = self._daemon(fresh, 1, 3)
+        floor = d1._expiry_floor(fresh)
+        assert floor is not None and floor <= dead_ckpt, \
+            (floor, dead_ckpt)
+        # once p1's ledger covers 2, the dead checkpoint is released
+        stamp("stream-daemon-p1",
+              {**m2.to_properties(), "stream.adopted": "2",
+               "stream.source.offset": "11",
+               "stream.ingest.ts-ms": "3"})
+        fresh2 = FileStoreTable.load(fresh.path)
+        d1b = self._daemon(fresh2, 1, 3)
+        floor2 = d1b._expiry_floor(fresh2)
+        assert floor2 is not None and floor2 > dead_ckpt
+
+    def test_adoption_backfills_through_poll_position(self, tmp_path):
+        """The backfill upper bound is the survivor's POLL position,
+        not its committed offset: events polled-but-uncheckpointed
+        had their adopted-group share filtered out while the dead
+        peer still owned it, and forward ingest resumes past them —
+        stopping the backfill at the committed offset would lose them
+        forever.  Reproduced by giving the survivor a checkpoint
+        interval longer than the soak, so its committed offset stays
+        far behind its poll position at adoption time."""
+        import pyarrow  # noqa: F401  (environment guard)
+
+        from paimon_tpu.cdc.source import MemoryCdcSource
+        from paimon_tpu.service.stream_daemon import StreamDaemon
+
+        opts = {
+            "stream.compaction.interval": "80",
+            "stream.ingest.poll-interval": "10",
+            "stream.serve.poll-interval": "15",
+            "multihost.lease.interval": "120",
+            "multihost.lease.timeout": "900",
+            "snapshot.num-retained.min": "100000",
+            "snapshot.num-retained.max": "100000",
+        }
+        schema = (Schema.builder()
+                  .column("id", BigIntType(False))
+                  .column("v", BigIntType())
+                  .primary_key("id")
+                  .options({"bucket": "4", **opts})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "gap"), schema)
+        source = MemoryCdcSource()
+        expected = {}
+
+        def emit(n0, n1):
+            evs = []
+            for n in range(n0, n1):
+                key = n % 23
+                evs.append({"op": "c", "after": {"id": key, "v": n}})
+                expected[key] = n
+            source.append(*evs)
+
+        planes = [MaintenancePlane(FileStoreTable.load(t.path),
+                                   base_user="stream-daemon",
+                                   process_index=i, process_count=2)
+                  for i in range(2)]
+        # survivor checkpoint interval >> test duration: its
+        # committed offset lags its poll position at adoption
+        d0 = StreamDaemon(
+            FileStoreTable.load(t.path), source,
+            commit_user="stream-daemon", plane=planes[0],
+            dynamic_options={"stream.checkpoint.interval": "60000"}
+        ).start()
+        d1 = StreamDaemon(
+            FileStoreTable.load(t.path), source,
+            commit_user="stream-daemon", plane=planes[1],
+            dynamic_options={"stream.checkpoint.interval": "50"}
+        ).start()
+        try:
+            emit(0, 120)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    d1.status()["offset_committed"] < 119:
+                d0.poll_changelog(timeout=0.0)
+                d1.poll_changelog(timeout=0.0)
+                time.sleep(0.02)
+            assert d1.status()["offset_committed"] >= 119
+            d1.kill()
+            # events keep flowing while d0 has still never
+            # checkpointed (offset_committed == -1, poll far ahead)
+            emit(120, 240)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                d0.poll_changelog(timeout=0.0)
+                st = d0.status()
+                if st["distributed"]["adopted"] == [1] and \
+                        st["offset_committed"] >= 239:
+                    break
+                time.sleep(0.03)
+            st = d0.status()
+            assert st["distributed"]["adopted"] == [1], st
+            d0.stop(drain=True)
+        finally:
+            d0.kill(), d1.kill()
+
+        final = FileStoreTable.load(t.path)
+        state = {r["id"]: r["v"]
+                 for r in final.to_arrow().to_pylist()}
+        assert state == expected, \
+            "adopted-group events polled past the survivor's " \
+            "committed offset were lost"
+        assert final.fsck().ok
+
+
+# -- in-process two-daemon takeover (single-box rehearsal) --------------------
+
+def test_two_daemon_takeover_in_process(tmp_path):
+    """Two distributed stream daemons (fake 2-process topology) over
+    one table and one replayable source; daemon 1 is killed mid-run
+    and daemon 0 adopts its buckets: no event lost or duplicated, the
+    final table is byte-identical to the single-process oracle,
+    per-user offsets stay strictly increasing, the takeover is
+    visible in maintenance_takeovers, and fsck (ownership check
+    included) is clean."""
+    import pyarrow as pa
+
+    from paimon_tpu.cdc.source import MemoryCdcSource
+    from paimon_tpu.core.read import ROW_KIND_COL
+    from paimon_tpu.service.stream_daemon import StreamDaemon
+
+    def big_schema(extra=None):
+        o = {"bucket": "4"}
+        o.update(extra or {})
+        # v is BigInt: the CDC sink infers python ints as BigInt and
+        # would widen an Int column, diverging from the oracle schema
+        return (Schema.builder()
+                .column("id", BigIntType(False))
+                .column("v", BigIntType())
+                .primary_key("id")
+                .options(o)
+                .build())
+
+    opts = {
+        "stream.checkpoint.interval": "60",
+        "stream.compaction.interval": "80",
+        "stream.ingest.poll-interval": "10",
+        "stream.serve.poll-interval": "15",
+        "num-sorted-run.compaction-trigger": "3",
+        "multihost.lease.interval": "150",
+        "multihost.lease.timeout": "1200",
+        "snapshot.num-retained.min": "100000",
+        "snapshot.num-retained.max": "100000",
+    }
+    t = FileStoreTable.create(str(tmp_path / "dist"),
+                              big_schema(opts))
+
+    # one deterministic global event stream, replayable by offset;
+    # each daemon gets its own source HANDLE over the same events
+    # (poll is read-only)
+    source = MemoryCdcSource()
+    expected = {}
+
+    def emit(n0, n1):
+        events = []
+        for n in range(n0, n1):
+            key = n % 37
+            events.append({"op": "c", "after": {"id": key, "v": n}})
+            expected[key] = n
+        source.append(*events)
+
+    g = global_registry().multihost_metrics()
+    takeovers0 = g.counter(MULTIHOST_MAINTENANCE_TAKEOVERS).count
+
+    planes = [
+        MaintenancePlane(FileStoreTable.load(t.path),
+                         base_user="stream-daemon",
+                         process_index=i, process_count=2)
+        for i in range(2)]
+    daemons = [
+        StreamDaemon(FileStoreTable.load(t.path), source,
+                     commit_user="stream-daemon",
+                     plane=planes[i]).start()
+        for i in range(2)]
+
+    consumed = [[], []]
+
+    def drain(i):
+        while True:
+            rows = daemons[i].poll_changelog(timeout=0.0)
+            if not rows:
+                return
+            consumed[i].extend(rows)
+
+    total = 0
+    try:
+        # phase 1: both alive
+        for _ in range(6):
+            emit(total, total + 30)
+            total += 30
+            time.sleep(0.12)
+            drain(0), drain(1)
+        # both must have checkpointed before the kill so the takeover
+        # has a real offset to adopt
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                daemons[0].status()["offset_committed"] < 0
+                or daemons[1].status()["offset_committed"] < 0):
+            drain(0), drain(1)
+            time.sleep(0.05)
+        assert daemons[1].status()["offset_committed"] >= 0
+
+        # phase 2: host 1 dies abruptly (no drain, no final
+        # checkpoint — everything past its last checkpoint is lost
+        # and must be re-ingested by the survivor)
+        daemons[1].kill()
+        drain(1)
+        # keep emitting through the outage
+        for _ in range(6):
+            emit(total, total + 30)
+            total += 30
+            time.sleep(0.1)
+            drain(0)
+
+        # phase 3: the survivor converges on EVERYTHING
+        last = source.latest_offset()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            drain(0)
+            st = daemons[0].status()
+            if st["offset_committed"] >= last and \
+                    st["distributed"]["adopted"] == [1]:
+                break
+            time.sleep(0.05)
+        st = daemons[0].status()
+        assert st["distributed"]["adopted"] == [1], st
+        assert st["offset_committed"] >= last, st
+        daemons[0].stop(drain=True)
+        drain(0)
+    finally:
+        for d in daemons:
+            d.kill()
+
+    assert g.counter(MULTIHOST_MAINTENANCE_TAKEOVERS).count > takeovers0
+
+    # table state == oracle (byte identity)
+    final = FileStoreTable.load(t.path)
+    oracle = FileStoreTable.create(
+        str(tmp_path / "oracle"), big_schema())
+    wb = oracle.new_batch_write_builder()
+    with wb.new_write() as w:
+        w.write_dicts([{"id": k, "v": v}
+                       for k, v in sorted(expected.items())])
+        wb.new_commit().commit(w.prepare_commit())
+    assert final.to_arrow().sort_by("id").equals(
+        oracle.to_arrow().sort_by("id"))
+
+    # changelog exactly-once: dead host's stream first (all its rows
+    # predate the takeover), then the survivor's (which replays the
+    # unserved suffix per adopted bucket before continuing) — the
+    # merged materialization must equal the expected state
+    materialized = {}
+    for stream in (consumed[1], consumed[0]):
+        for r in stream:
+            if r[ROW_KIND_COL] in (0, 2):
+                materialized[r["id"]] = r["v"]
+            elif r[ROW_KIND_COL] == 3:
+                materialized.pop(r["id"], None)
+    assert materialized == expected
+
+    # offsets strictly increasing per commit user; both users present
+    offsets = {0: [], 1: []}
+    for snap in final.snapshot_manager.snapshots():
+        for p in (0, 1):
+            if snap.commit_user == f"stream-daemon-p{p}" and \
+                    snap.properties and \
+                    "stream.source.offset" in snap.properties:
+                offsets[p].append(
+                    int(snap.properties["stream.source.offset"]))
+    assert offsets[0] and offsets[1]
+    for p in (0, 1):
+        assert offsets[p] == sorted(set(offsets[p])), offsets[p]
+    assert offsets[0][-1] >= source.latest_offset()
+
+    # the takeover generation is stamped and the graph is clean —
+    # ownership consistency included
+    resumed = resume_ownership_map(final)
+    assert resumed is not None and resumed.dead == frozenset({1})
+    report = final.fsck()
+    assert report.ok, [v.to_dict() for v in report.violations]
