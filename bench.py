@@ -327,7 +327,10 @@ def child_main():
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
-    platform = jax.devices()[0].platform
+    dev0 = jax.devices()[0]
+    platform = dev0.platform
+    device_kind = dev0.device_kind
+    backend = jax.default_backend()
 
     with tempfile.TemporaryDirectory() as tmp:
         table = build_table(os.path.join(tmp, "t"), rows, runs)
@@ -359,6 +362,7 @@ def child_main():
         bw = _merge._LINK_BW
     print(json.dumps({
         "rows": rows, "runs": runs, "dt": dt, "platform": platform,
+        "device_kind": device_kind, "jax_backend": backend,
         "paths": pc, "link": list(bw) if bw else None,
         "vec_at_scale": vec_at_scale,
     }))
@@ -404,6 +408,21 @@ def scan_child_main():
         out["identical"] = bool(
             serial.to_arrow().sort_by("id")
             .equals(piped.to_arrow().sort_by("id")))
+        # ISSUE 12 acceptance leg: the raw-page device decode plane
+        # scans the same table byte-identically to the pyarrow path
+        # (format/rawpage.py; per-engine oracle coverage in tier-1,
+        # this records it at bench scale with the timing)
+        dev = table.copy({"read.device-decode": "true",
+                          "scan.split.parallelism": str(pool)})
+        out["dt_device_decode"] = timed(dev)
+        out["device_decode_identical"] = bool(
+            dev.to_arrow().sort_by("id")
+            .equals(piped.to_arrow().sort_by("id")))
+        from paimon_tpu.metrics import (
+            SCAN_DEVICE_DECODE_FILES, global_registry as _greg,
+        )
+        out["device_decode_files"] = _greg().group("scan").counter(
+            SCAN_DEVICE_DECODE_FILES).count
     agg_rows = min(rows, 4_000_000)
     with tempfile.TemporaryDirectory() as tmp:
         table = build_scan_table(os.path.join(tmp, "t"), "aggregation",
@@ -869,15 +888,30 @@ def compose_scan(result):
                     f"rows/s vs_serial="
                     f"{round(agg['dt_serial'] / agg['dt_pipelined'], 2)}"
                     f" identical={agg['identical']}")
+    dd_note = ""
+    out_extra = {}
+    if "dt_device_decode" in result:
+        dd_note = (f"; device-decode "
+                   f"{round(result['rows'] / result['dt_device_decode'], 1)}"
+                   f" rows/s identical="
+                   f"{result['device_decode_identical']} "
+                   f"({result.get('device_decode_files', 0)} files)")
+        out_extra = {
+            "device_decode_rows_per_sec":
+                round(result["rows"] / result["dt_device_decode"], 1),
+            "device_decode_identical":
+                result["device_decode_identical"],
+        }
     return {
         "metric": "merge_on_read_scan_rows_per_sec",
         "value": round(ours, 1),
         "unit": (f"rows/s ({result['rows']} rows, 8 buckets x 5 runs, "
                  f"dedup, parquet, {result['pool']}-way pipelined scan "
                  f"vs serial-1T {round(serial, 1)} rows/s, "
-                 f"identical={result['identical']}{agg_note})"),
+                 f"identical={result['identical']}{agg_note}{dd_note})"),
         "vs_serial": round(result["dt_serial"] / result["dt_pipelined"],
                            3),
+        **out_extra,
         "metrics_snapshot": result.get("metrics_snapshot"),
     }
 
@@ -965,6 +999,13 @@ def compose(result, baselines, fallback_note="", sample_rows=None):
         "unit": (f"rows/s ({result['rows']} rows, {result['runs']} runs, "
                  f"{shape_note}, platform={platform}{base_note}"
                  f"{path_note})"),
+        # self-describing header: the DETECTED jax backend + device
+        # kind, measured inside the child that ran the workload — an
+        # accelerator run needs no unit-string archaeology (`platform`
+        # keeps the forced/fallback qualifier, these stay raw)
+        "jax_backend": result.get("jax_backend"),
+        "device_kind": result.get("device_kind"),
+        "merge_paths": result.get("paths"),
         # honest denominator (VERDICT r3 missing #1 / weak #4)
         "vs_baseline": round(ours / denom, 3) if denom else 0.0,
     }

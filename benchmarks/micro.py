@@ -28,6 +28,13 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pyarrow as pa  # noqa: E402
 
+# self-describing records: the DETECTED backend + device kind ride
+# every BENCH_MICRO line (an accelerator run is visible without
+# trusting the cpu-forcing preamble above to have worked)
+_DEV0 = jax.devices()[0]
+_PLATFORM = _DEV0.platform
+_DEVICE_KIND = _DEV0.device_kind
+
 ROWS = int(os.environ.get("MICRO_ROWS", str(1_000_000)))
 RUNS = int(os.environ.get("MICRO_RUNS", "3"))
 # sub-millisecond best-times are dominated by timer/dispatch noise and
@@ -104,7 +111,8 @@ def _emit(name: str, rows: int, seconds, **extra):
         seconds, reps = seconds          # time over a >=10ms batch
     out = {"benchmark": name, "value": round(rows / seconds, 1),
            "unit": "rows/s", "rows": rows,
-           "best_seconds": round(seconds, 9)}
+           "best_seconds": round(seconds, 9),
+           "platform": _PLATFORM, "device_kind": _DEVICE_KIND}
     if reps > 1:
         out["timed_reps"] = reps
     out.update(extra)                    # extra may override unit

@@ -299,6 +299,36 @@ class MergeTreeCompactManager:
                 fmt = get_format(ext)
                 path = f.external_path or self.path_factory.data_file_path(
                     self.partition, self.bucket, f.file_name)
+                if fmt.identifier == "parquet" and self.options.get(
+                        CoreOptions.READ_DEVICE_DECODE):
+                    # row-group-at-a-time device decode keeps the
+                    # streamed plane's ~runs x chunk memory bound;
+                    # an unsupported file drops to the pyarrow
+                    # read_batches path below
+                    from paimon_tpu.format.rawpage import \
+                        _FALLBACK_ERRORS, iter_batches_device
+                    batches = None
+                    try:
+                        batches = iter_batches_device(
+                            self.file_io, path, chunk_rows,
+                            self.options)
+                    except _FALLBACK_ERRORS:
+                        from paimon_tpu.metrics import (
+                            SCAN_DEVICE_DECODE_FALLBACKS,
+                            global_registry,
+                        )
+                        global_registry().group("scan").counter(
+                            SCAN_DEVICE_DECODE_FALLBACKS).inc()
+                    if batches is not None:
+                        for batch in batches:
+                            t = evolve_table(
+                                batch, f.schema_id, self.schema,
+                                self.schema_manager,
+                                self._schema_cache,
+                                keep_sys_cols=True)
+                            yield (t, *self.key_encoder.encode_table_ex(
+                                t, self.key_cols))
+                        continue
                 from paimon_tpu.fs.caching import scoped_batches
                 # scoped_batches holds the footer-cache gate only
                 # WHILE advancing the inner iterator, never across our
@@ -332,8 +362,12 @@ class MergeTreeCompactManager:
                 self.partition, self.bucket, merged, level=output_level,
                 file_source=FileSource.COMPACT)
 
-        with ThreadPoolExecutor(max_workers=2) as pool, \
-                ThreadPoolExecutor(max_workers=1) as merge_pool:
+        # two merge workers: the OVC/native merges and the numpy
+        # epilogues release the GIL, so adjacent windows genuinely
+        # overlap; futures are still consumed in submission order so
+        # output files stay in key order
+        with ThreadPoolExecutor(max_workers=3) as pool, \
+                ThreadPoolExecutor(max_workers=2) as merge_pool:
 
             def merge_window(items):
                 tables = [item[0] for item in items]
@@ -383,7 +417,9 @@ class MergeTreeCompactManager:
             merge_runs_streamed(
                 [_prefetch(run_iter(rf)) for rf in runs_meta],
                 self.key_cols, self.key_encoder, emit, merge_window,
-                pass_encoded=True)
+                pass_encoded=True,
+                window_rows=self.options.get(
+                    CoreOptions.MERGE_WINDOW_ROWS))
             while merge_futs:
                 _collect(merge_futs.pop(0))
             flush()

@@ -17,6 +17,7 @@ from paimon_tpu.format import get_format
 from paimon_tpu.format.format import extract_simple_stats
 from paimon_tpu.fs import FileIO
 from paimon_tpu.manifest import DataFileMeta, FileSource, SimpleStats
+from paimon_tpu.options import CoreOptions
 from paimon_tpu.ops.merge import KIND_COL, SEQ_COL
 from paimon_tpu.schema.table_schema import TableSchema
 from paimon_tpu.types import DataType, SpecialFields
@@ -234,10 +235,16 @@ def read_kv_file(file_io: FileIO, path_factory: FileStorePathFactory,
     path = path_factory.data_file_path(partition, bucket, meta.file_name)
     if meta.external_path:
         path = meta.external_path
-    from paimon_tpu.fs.caching import footer_cache_scope
-    with footer_cache_scope(options):
-        table = fmt.create_reader().read(file_io, path,
-                                         projection=projection)
+    table = None
+    if fmt.identifier == "parquet" and options is not None \
+            and options.get(CoreOptions.READ_DEVICE_DECODE):
+        from paimon_tpu.format.rawpage import maybe_read_device
+        table = maybe_read_device(file_io, path, projection, options)
+    if table is None:
+        from paimon_tpu.fs.caching import footer_cache_scope
+        with footer_cache_scope(options):
+            table = fmt.create_reader().read(file_io, path,
+                                             projection=projection)
     if schema is not None:
         from paimon_tpu.format.blob import maybe_resolve_blobs
         table = maybe_resolve_blobs(file_io, path_factory, partition,

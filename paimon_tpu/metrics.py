@@ -22,6 +22,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "SCAN_RANGE_CACHE_HITS", "SCAN_RANGE_CACHE_MISSES",
            "SCAN_RANGE_CACHE_HIT_BYTES", "SCAN_PIPELINE_SPLITS",
            "SCAN_PIPELINE_BYTES", "SCAN_READ_RETRIES",
+           "SCAN_DEVICE_DECODE_FILES", "SCAN_DEVICE_DECODE_FALLBACKS",
            "WRITE_FLUSHES", "WRITE_FLUSHED_BYTES", "WRITE_FLUSH_WAIT_MS",
            "WRITE_INFLIGHT_BYTES", "WRITE_RETRIES",
            "SCAN_SPLIT_MS", "SCAN_MERGE_MS",
@@ -80,6 +81,8 @@ SCAN_RANGE_CACHE_HIT_BYTES = "range_cache_hit_bytes"
 SCAN_PIPELINE_SPLITS = "pipeline_splits"          # splits prefetched
 SCAN_PIPELINE_BYTES = "pipeline_bytes"            # est. bytes admitted
 SCAN_READ_RETRIES = "read_retries"                # transient IO retries
+SCAN_DEVICE_DECODE_FILES = "device_decode_files"  # raw-page device reads
+SCAN_DEVICE_DECODE_FALLBACKS = "device_decode_fallbacks"  # host fallbacks
 
 # write-pipeline counter names (write metric group; producers in
 # parallel/write_pipeline.py, consumers in write_bench.py / tests /

@@ -107,6 +107,18 @@ def load() -> Optional[ctypes.CDLL]:
     lib.merge_winners_u64.argtypes = [p_u64, p_i64, i64, ctypes.c_int,
                                       p_i32, p_u8]
     lib.merge_winners_u64.restype = ctypes.c_int
+    p_u32 = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    lib.ovc_codes_u64.argtypes = [p_u64, p_i64, p_i64, i64, p_u64]
+    lib.ovc_codes_u64.restype = ctypes.c_int
+    lib.ovc_codes_lanes.argtypes = [p_u32, p_i64, p_i64, i64, i64,
+                                    p_u64]
+    lib.ovc_codes_lanes.restype = ctypes.c_int
+    lib.ovc_merge_u64.argtypes = [p_u64, p_i64, p_u64, p_i64, i64, i64,
+                                  p_i32, p_u64]
+    lib.ovc_merge_u64.restype = ctypes.c_int
+    lib.ovc_merge_lanes.argtypes = [p_u32, p_i64, p_u64, p_i64, i64,
+                                    i64, i64, p_i32, p_u64]
+    lib.ovc_merge_lanes.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -143,6 +155,93 @@ def radix_argsort(keys: np.ndarray) -> Optional[np.ndarray]:
     if lib.radix_argsort_u64(keys, len(keys), perm) != 0:
         return None
     return perm
+
+
+def ovc_codes_u64(keys: np.ndarray, seq: np.ndarray,
+                  starts: np.ndarray) -> Optional[np.ndarray]:
+    """Initial per-run offset-value codes for packed u64 keys (two
+    logical big-endian u32 lanes), or None when the native library is
+    unavailable OR any run violates its (key, seq) ascending sort
+    contract — exposed for the code-semantics tests; ovc_merge_u64
+    runs this same C pass internally."""
+    lib = load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    seq = np.ascontiguousarray(seq, dtype=np.int64)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    codes = np.empty(len(keys), dtype=np.uint64)
+    if lib.ovc_codes_u64(keys, seq, starts, len(starts) - 1,
+                         codes) != 0:
+        return None
+    return codes
+
+
+def ovc_codes_lanes(lanes: np.ndarray, seq: np.ndarray,
+                    starts: np.ndarray) -> Optional[np.ndarray]:
+    """Lane-matrix variant of ovc_codes_u64."""
+    lib = load()
+    if lib is None:
+        return None
+    lanes = np.ascontiguousarray(lanes, dtype=np.uint32)
+    seq = np.ascontiguousarray(seq, dtype=np.int64)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    codes = np.empty(lanes.shape[0], dtype=np.uint64)
+    if lib.ovc_codes_lanes(lanes, seq, starts, len(starts) - 1,
+                           lanes.shape[1], codes) != 0:
+        return None
+    return codes
+
+
+def ovc_merge_u64(keys: np.ndarray, seq: np.ndarray,
+                  starts: np.ndarray) -> Optional[tuple]:
+    """Offset-value coded k-way merge of sorted runs over packed u64
+    keys: one C pass computes the initial per-run codes (verifying the
+    (key, seq) sort contract), a second runs the single-int-compare
+    merge.  Returns (perm, code_out) in merged order, or None when the
+    native library is unavailable or a run violates its contract (the
+    caller falls back to the sort paths)."""
+    lib = load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    seq = np.ascontiguousarray(seq, dtype=np.int64)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    n = len(keys)
+    k = len(starts) - 1
+    codes = np.empty(n, dtype=np.uint64)
+    if lib.ovc_codes_u64(keys, seq, starts, k, codes) != 0:
+        return None
+    perm = np.empty(n, dtype=np.int32)
+    code = np.empty(n, dtype=np.uint64)
+    if lib.ovc_merge_u64(keys, seq, codes, starts, k, n,
+                         perm, code) != 0:
+        return None
+    return perm, code
+
+
+def ovc_merge_lanes(lanes: np.ndarray, seq: np.ndarray,
+                    starts: np.ndarray) -> Optional[tuple]:
+    """Lane-matrix variant of ovc_merge_u64 for multi-lane normalized
+    keys (wide/composite/string-prefix keys)."""
+    lib = load()
+    if lib is None:
+        return None
+    lanes = np.ascontiguousarray(lanes, dtype=np.uint32)
+    seq = np.ascontiguousarray(seq, dtype=np.int64)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    n, num_lanes = lanes.shape
+    k = len(starts) - 1
+    codes = np.empty(n, dtype=np.uint64)
+    if lib.ovc_codes_lanes(lanes, seq, starts, k, num_lanes,
+                           codes) != 0:
+        return None
+    perm = np.empty(n, dtype=np.int32)
+    code = np.empty(n, dtype=np.uint64)
+    if lib.ovc_merge_lanes(lanes, seq, codes, starts, k,
+                           n, num_lanes, perm, code) != 0:
+        return None
+    return perm, code
 
 
 def merge_winners(keys: np.ndarray, seq: np.ndarray, keep_last: bool

@@ -78,7 +78,8 @@ def sequence_groups(schema: TableSchema,
 def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
                            truncated: Optional[np.ndarray] = None,
                            full_key=None, order_lanes=None,
-                           packed: Optional[np.ndarray] = None):
+                           packed: Optional[np.ndarray] = None,
+                           run_starts: Optional[np.ndarray] = None):
     """Shared device sort -> (order over real rows, segment ids).
 
     If some rows' string keys exceeded the lane prefix (`truncated`),
@@ -86,8 +87,9 @@ def _segment_ids_from_sort(lanes: np.ndarray, seq: np.ndarray,
     are repaired on the host by re-sorting on the full key (`full_key`:
     row index -> comparable tuple) and splitting sub-segments."""
     n = lanes.shape[0]
-    perm, winner, _ = device_sorted_winners(lanes, seq, "last",
-                                            order_lanes, packed=packed)
+    perm, winner, _ = device_sorted_winners(
+        lanes, seq, "last", order_lanes, packed=packed,
+        run_starts=run_starts if order_lanes is None else None)
     real = perm < n
     order = perm[real].astype(np.int64)
     win_sorted = winner[real]
@@ -251,8 +253,11 @@ def merge_runs_agg(runs: Sequence[pa.Table], key_cols: Sequence[str],
     order_lanes = user_seq_order_lanes(
         table, seq_fields, options.sequence_field_descending) \
         if seq_fields else None
+    run_starts = np.concatenate(
+        [[0], np.cumsum([r.num_rows for r in runs])]).astype(np.int64)
     order, seg_id, win_sorted = _segment_ids_from_sort(
-        lanes, seq, truncated, full_key, order_lanes, packed=packed)
+        lanes, seq, truncated, full_key, order_lanes, packed=packed,
+        run_starts=run_starts)
     return aggregate_sorted_segments(table, order, seg_id, win_sorted,
                                      key_cols, schema, options)
 

@@ -1,0 +1,212 @@
+"""Device-resident decoders for Parquet page primitives.
+
+Grounded in "Do GPUs Really Need New Tabular File Formats?" (arXiv
+2602.17335): standard columnar formats saturate accelerators once
+decode is restructured as vectorized device ops.  The raw-page reader
+(format/rawpage.py) slices UNDECODED column-chunk pages off the store
+and uploads the page bytes once; everything per-value happens here as
+traced JAX ops, so decode fuses with the downstream normalized-key
+transform and the merge kernel into one XLA program — no host
+round-trip between "bytes arrived" and "merge ran" (the lowering-proof
+tier-1 test compiles exactly that program and asserts no host
+callbacks).
+
+Covered primitives (the ones the compaction/scan hot path meets):
+  * PLAIN fixed-width values — a bitcast reinterpret of the page bytes
+    (INT32/INT64/FLOAT/DOUBLE physical types);
+  * RLE/bit-packed hybrid runs — definition levels and dictionary
+    indices; run HEADERS are parsed on the host (a few dozen sequential
+    varints per page), the per-value expansion is a vectorized
+    searchsorted-over-cumulative-counts gather + bitwise unpack;
+  * dictionary index gather;
+  * definition-level null expansion (values scatter to present slots).
+
+Everything in this module must stay traceable: host materialization
+(np.asarray / .tolist() / jax.device_get) is BANNED here by the tier-1
+AST lint — the host boundary lives in format/rawpage.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["unpack_bits", "expand_rle_hybrid", "plain_to_u64",
+           "plain_to_u32", "dict_gather", "expand_nulls",
+           "int64_to_key_lanes", "float64_to_key_lanes",
+           "int32_to_key_lanes", "fused_decode_merge", "pad_pow2"]
+
+
+def pad_pow2(n: int, floor: int = 1024) -> int:
+    """Shape bucket for jit compile-cache stability (mirrors
+    ops/merge._pad_size)."""
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# bitwise unpack
+# ---------------------------------------------------------------------------
+
+
+def unpack_bits(words: jnp.ndarray, bit_width: int,
+                bit_offsets: jnp.ndarray) -> jnp.ndarray:
+    """Gather `bit_width`-bit little-endian values at arbitrary bit
+    offsets from a u32 word stream (the parquet bit-packed layout).
+
+    words: uint32[W] little-endian view of the page bytes, with at
+    least one word of slack past the last read so the two-word window
+    never reads out of bounds.  bit_offsets: int32[n] absolute bit
+    positions.  Returns uint32[n]."""
+    if bit_width == 0:
+        return jnp.zeros(bit_offsets.shape, jnp.uint32)
+    word_idx = (bit_offsets >> 5).astype(jnp.int32)
+    bit_in = (bit_offsets & 31).astype(jnp.uint32)
+    lo = words[word_idx].astype(jnp.uint64)
+    hi = words[word_idx + 1].astype(jnp.uint64)
+    window = lo | (hi << jnp.uint64(32))
+    mask = jnp.uint64((1 << bit_width) - 1)
+    return ((window >> bit_in.astype(jnp.uint64)) & mask).astype(
+        jnp.uint32)
+
+
+def expand_rle_hybrid(words: jnp.ndarray,
+                      run_is_packed: jnp.ndarray,
+                      run_value: jnp.ndarray,
+                      run_cum: jnp.ndarray,
+                      run_bit_start: jnp.ndarray,
+                      bit_width: int,
+                      count: int) -> jnp.ndarray:
+    """Expand parsed RLE/bit-packed hybrid runs to per-value u32.
+
+    The host parses the run headers (format/rawpage.py — a handful of
+    varints); expansion is pure device work: each output position finds
+    its run by searchsorted over the cumulative run counts, RLE runs
+    broadcast their value, bit-packed runs unpack at
+    run_bit_start[run] + (pos - run_start) * bit_width.
+
+    run_is_packed: uint32[R] (1 = bit-packed run)
+    run_value:     uint32[R] (RLE repeated value; 0 for packed runs)
+    run_cum:       int32[R] INCLUSIVE cumulative value counts
+    run_bit_start: int32[R] absolute bit offset of a packed run's data
+    count:         static output length (padded positions read run 0)
+    """
+    pos = jnp.arange(count, dtype=jnp.int32)
+    run = jnp.searchsorted(run_cum, pos, side="right").astype(jnp.int32)
+    run = jnp.minimum(run, run_cum.shape[0] - 1)
+    run_start = jnp.where(run > 0, run_cum[run - 1], 0)
+    within = pos - run_start
+    bit_offs = run_bit_start[run] + within * bit_width
+    packed_vals = unpack_bits(words, bit_width,
+                              jnp.maximum(bit_offs, 0))
+    return jnp.where(run_is_packed[run] != 0, packed_vals,
+                     run_value[run])
+
+
+# ---------------------------------------------------------------------------
+# PLAIN fixed-width reinterpret
+# ---------------------------------------------------------------------------
+
+
+def plain_to_u32(page_bytes: jnp.ndarray, count: int) -> jnp.ndarray:
+    """PLAIN INT32/FLOAT page payload -> uint32[count] (little-endian
+    bitcast reinterpret; caller slices the byte array to 4*count)."""
+    b = page_bytes[:4 * count].reshape(count, 4)
+    return jax.lax.bitcast_convert_type(b, jnp.uint32)
+
+
+def plain_to_u64(page_bytes: jnp.ndarray, count: int) -> jnp.ndarray:
+    """PLAIN INT64/DOUBLE page payload -> uint64[count]."""
+    b = page_bytes[:8 * count].reshape(count, 8)
+    return jax.lax.bitcast_convert_type(b, jnp.uint64)
+
+
+def dict_gather(dict_values: jnp.ndarray,
+                indices: jnp.ndarray) -> jnp.ndarray:
+    """Dictionary decode: PLAIN-decoded dictionary page values gathered
+    by the data pages' RLE-hybrid indices."""
+    idx = jnp.minimum(indices.astype(jnp.int32),
+                      dict_values.shape[0] - 1)
+    return dict_values[idx]
+
+
+def expand_nulls(values: jnp.ndarray, present: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter dense (nulls-stripped) values onto their logical slots.
+
+    present: bool[n] from the definition levels (def == max_def).
+    Returns (full[n] with zeros at null slots, present) — static
+    shapes: position i reads values[cumsum(present)[i] - 1] behind a
+    mask instead of a dynamic-shape scatter."""
+    vidx = jnp.cumsum(present.astype(jnp.int32)) - 1
+    vidx = jnp.clip(vidx, 0, values.shape[0] - 1)
+    full = jnp.where(present, values[vidx], 0)
+    return full, present
+
+
+# ---------------------------------------------------------------------------
+# fused decode -> normalized-key lanes
+# ---------------------------------------------------------------------------
+
+
+def int64_to_key_lanes(u: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """uint64 raw int64 bits -> (packed u64, hi lane, lo lane): the
+    order-preserving sign-bit flip of ops/normkey._ints_to_u64, fused
+    into the decode program."""
+    packed = u ^ jnp.uint64(1 << 63)
+    hi = (packed >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = (packed & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    return packed, hi, lo
+
+
+def float64_to_key_lanes(u: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """uint64 raw double bits -> IEEE-total-order packed key + lanes
+    (ops/normkey._floats_to_u64 semantics)."""
+    neg = (u >> jnp.uint64(63)) != 0
+    packed = jnp.where(neg, ~u, u ^ jnp.uint64(1 << 63))
+    hi = (packed >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = (packed & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    return packed, hi, lo
+
+
+def int32_to_key_lanes(v: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """uint32 raw int32 bits -> widened order-preserving u64 key +
+    lanes (normkey casts every int kind to int64 first)."""
+    s = v.astype(jnp.int32).astype(jnp.int64)
+    packed = jax.lax.bitcast_convert_type(s, jnp.uint64) \
+        ^ jnp.uint64(1 << 63)
+    hi = (packed >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = (packed & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    return packed, hi, lo
+
+
+@partial(jax.jit, static_argnames=("keep", "kind"))
+def fused_decode_merge(key_bytes: jnp.ndarray, seq_bytes: jnp.ndarray,
+                       invalid: jnp.ndarray, keep: str = "last",
+                       kind: str = "int64"):
+    """The tentpole program: raw PLAIN page bytes of the key and
+    sequence columns in, merge winners out — decode, normalized-key
+    transform and segmented winner-select lower as ONE jitted XLA
+    program with no host callback anywhere inside (tier-1 lowering
+    proof inspects exactly this jaxpr/HLO).
+
+    key_bytes/seq_bytes: uint8[8n] PLAIN page payloads; invalid:
+    uint32[n] (1 = padding row).  Returns (perm, winner, packed)."""
+    n = invalid.shape[0]
+    raw = plain_to_u64(key_bytes, n)
+    if kind == "float64":
+        packed, hi, lo = float64_to_key_lanes(raw)
+    else:
+        packed, hi, lo = int64_to_key_lanes(raw)
+    seq_u = plain_to_u64(seq_bytes, n)
+    seq_hi = (seq_u >> jnp.uint64(32)).astype(jnp.uint32)
+    seq_lo = (seq_u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    from paimon_tpu.ops.merge import segmented_merge_body
+    perm, winner, _ = segmented_merge_body(
+        [hi, lo], seq_hi, seq_lo, invalid, keep, num_key_lanes=2)
+    return perm, winner, packed

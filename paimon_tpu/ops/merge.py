@@ -62,7 +62,7 @@ def _pad_size(n: int) -> int:
 
 def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
                          num_key_lanes: Optional[int] = None,
-                         use_pallas: bool = False):
+                         use_pallas: bool = False, ovc_off=None):
     """Traceable kernel body shared by the single-chip path, the sharded
     multi-bucket path (parallel/sharded_merge.py) and the driver entry.
 
@@ -71,6 +71,10 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
     are user-defined sequence order (reference
     utils/UserDefinedSeqComparator: rows within a key order by the
     sequence field first, internal sequence breaks ties).
+    `ovc_off`: optional uint32[N] per-row offset-value-code offsets vs
+    the run predecessor (ops/ovc.run_ovc_offsets) — rides the sort as a
+    payload so the winner-select resolves run-consecutive neighbor
+    pairs from the single-int code and only lane-compares the rest.
     Returns (perm, winner, prev_in_seg)."""
     num_lanes = len(lane_list)
     if num_key_lanes is None:
@@ -78,24 +82,29 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
     n = invalid.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
     operands = [invalid] + list(lane_list) + [seq_hi, seq_lo, iota]
+    if ovc_off is not None:
+        operands.append(ovc_off)          # payload, not a sort key
     sorted_ops = jax.lax.sort(operands, num_keys=num_lanes + 3,
                               is_stable=True)
     s_invalid = sorted_ops[0]
     s_lanes = sorted_ops[1:1 + num_key_lanes]
-    perm = sorted_ops[-1]
+    perm = sorted_ops[num_lanes + 3]
+    s_off = sorted_ops[-1] if ovc_off is not None else None
 
     if use_pallas:
         # fused VMEM pass over all lanes at once; eq_next_mask itself
         # falls back to the identical XLA ops for unsupported shapes or
         # backends (ops/pallas_kernels.py)
         from paimon_tpu.ops.pallas_kernels import eq_next_mask
-        eq_next = eq_next_mask(list(s_lanes), s_invalid)
+        eq_next = eq_next_mask(list(s_lanes), s_invalid,
+                               ovc_off=s_off, perm=perm)
     else:
         # single source of truth for the mask semantics (incl. the
         # validity guard: a real row whose key encodes like padding
         # must not join the padding segment)
         from paimon_tpu.ops.pallas_kernels import _eq_next_xla
-        eq_next = _eq_next_xla(list(s_lanes), s_invalid)
+        eq_next = _eq_next_xla(list(s_lanes), s_invalid, s_off, perm,
+                               num_key_lanes)
     eq_prev = jnp.concatenate([jnp.array([False]), eq_next[:-1]])
     valid = s_invalid == 0
     if keep == "last":
@@ -110,10 +119,20 @@ def segmented_merge_body(lane_list, seq_hi, seq_lo, invalid, keep: str,
 
 @lru_cache(maxsize=64)
 def _merge_fn(num_lanes: int, keep: str, num_key_lanes: int,
-              use_pallas: bool):
+              use_pallas: bool, with_ovc: bool = False):
     """Build the jitted merge kernel for a lane count.  `use_pallas`
     is part of the cache key so the PAIMON_DISABLE_PALLAS kill switch
     takes effect on the next call, not the next process."""
+
+    if with_ovc:
+        @jax.jit
+        def fn_ovc(lanes, seq_hi, seq_lo, invalid, ovc_off):
+            return segmented_merge_body(
+                [lanes[i] for i in range(num_lanes)], seq_hi, seq_lo,
+                invalid, keep, num_key_lanes=num_key_lanes,
+                use_pallas=use_pallas, ovc_off=ovc_off)
+
+        return fn_ovc
 
     @jax.jit
     def fn(lanes, seq_hi, seq_lo, invalid):
@@ -180,7 +199,7 @@ def _merge_fn_packed(num_lanes: int, keep: str, num_key_lanes: int,
 _LINK_BW: Optional[Tuple[float, float]] = None
 
 # merges taken per path this process (observability: bench + metrics)
-PATH_COUNTS = {"host": 0, "device": 0}
+PATH_COUNTS = {"host": 0, "device": 0, "ovc": 0}
 
 # cost-model constants (rows/s), calibrated from TPU_PROFILE.log and
 # the CPU-fallback bench: the device measured ~80M sorted rows/s with
@@ -459,7 +478,8 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                           order_lanes: Optional[np.ndarray] = None,
                           winners_only: bool = False,
                           packed: Optional[np.ndarray] = None,
-                          overlapped: bool = False
+                          overlapped: bool = False,
+                          run_starts: Optional[np.ndarray] = None
                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the device kernel.
 
@@ -469,6 +489,11 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
     `winners_only=True` promises the caller uses ONLY the winner rows
     (never full perm ordering within segments nor prev), unlocking the
     packed-key fast path for fixed-width two-lane keys.
+    `run_starts`: optional int64[k+1] boundaries marking the input as k
+    concatenated (key, seq)-SORTED runs — unlocks the offset-value
+    coded O(n log k) tree-of-losers merge (ops/ovc.py) on the host
+    path, replacing the full sort; rows need not be pre-validated (the
+    OVC path verifies the sort contract and falls back when violated).
     Returns (perm, winner_mask, prev_in_segment) as numpy arrays — of
     the power-of-two padded size on the accelerator path, UNPADDED
     (length N, all rows valid) on the host lexsort path.  Callers must
@@ -511,8 +536,17 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
         return _bitmask_sorted_winners(lanes, seq, keep, order_lanes,
                                        np.asarray(packed))
     if use_host:
-        PATH_COUNTS["host"] += 1
         no_user_order = order_lanes is None or order_lanes.shape[1] == 0
+        if run_starts is not None and no_user_order and len(run_starts) > 1:
+            # sorted-run inputs: offset-value coded merge replaces the
+            # sort (single-int compares, segment boundaries for free)
+            from paimon_tpu.ops.ovc import ovc_sorted_winners
+            res = ovc_sorted_winners(lanes, seq, keep, run_starts,
+                                     num_key_lanes, packed=packed)
+            if res is not None:
+                PATH_COUNTS["ovc"] += 1
+                return res
+        PATH_COUNTS["host"] += 1
         full = lanes if no_user_order \
             else np.concatenate([lanes, order_lanes], axis=1)
         return _host_sorted_winners(full, seq, keep, num_key_lanes,
@@ -539,20 +573,35 @@ def device_sorted_winners(lanes: np.ndarray, seq: np.ndarray,
                                                pallas_enabled)
     lane_list = tuple(jnp.asarray(lanes_p[:, i]) for i in range(num_lanes))
     use_pallas = pallas_enabled()
+    # sorted-run inputs ship their offset-value codes to the device:
+    # the winner-select consumes the single-int offsets first and only
+    # lane-compares pairs the codes cannot decide (full variant only —
+    # the packed/bitmask returns already collapse keys to one u64)
+    ovc_args = ()
+    with_ovc = run_starts is not None and not winners_only
+    if with_ovc:
+        from paimon_tpu.ops.ovc import OVC_OFF_SENTINEL, run_ovc_offsets
+        off = np.full(m, OVC_OFF_SENTINEL, dtype=np.uint32)
+        off[:n] = run_ovc_offsets(lanes, run_starts)
+        ovc_args = (jnp.asarray(off),)
     builder = _merge_fn_packed if winners_only else _merge_fn
     try:
-        fn = builder(num_lanes, keep, num_key_lanes, use_pallas)
+        fn = builder(num_lanes, keep, num_key_lanes, use_pallas,
+                     with_ovc) if builder is _merge_fn \
+            else builder(num_lanes, keep, num_key_lanes, use_pallas)
         out = fn(lane_list, jnp.asarray(seq_hi),
-                 jnp.asarray(seq_lo), jnp.asarray(invalid))
+                 jnp.asarray(seq_lo), jnp.asarray(invalid), *ovc_args)
     except jax.errors.JaxRuntimeError:
         # a Mosaic compile rejection on the real backend must not fail
         # the merge: drop to the pure-XLA kernel for the whole process
         if not use_pallas:
             raise
         disable_pallas_runtime("Mosaic compile failed")
-        fn = builder(num_lanes, keep, num_key_lanes, False)
+        fn = builder(num_lanes, keep, num_key_lanes, False,
+                     with_ovc) if builder is _merge_fn \
+            else builder(num_lanes, keep, num_key_lanes, False)
         out = fn(lane_list, jnp.asarray(seq_hi),
-                 jnp.asarray(seq_lo), jnp.asarray(invalid))
+                 jnp.asarray(seq_lo), jnp.asarray(invalid), *ovc_args)
     if winners_only:
         # one 4-byte word/row off the device: perm | (winner << 31)
         packed = np.asarray(out)
@@ -702,6 +751,18 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
             table, key_names)
     seq = np.asarray(table.column(SEQ_COL).combine_chunks().cast(pa.int64()))
 
+    # sorted-run boundaries for the OVC merge path: every input run
+    # (or pre-cut window chunk — chunks of one run arrive in run order,
+    # so treating each as its own run preserves arrival order) is
+    # individually (key, seq)-sorted by the write/compact invariants;
+    # the OVC path re-verifies and falls back if a caller violates that
+    if encoded is not None:
+        run_lens = [e[0].shape[0] for e in encoded]
+    else:
+        run_lens = [r.num_rows for r in runs]
+    run_starts = np.concatenate(
+        [[0], np.cumsum(run_lens)]).astype(np.int64)
+
     keep = "first" if merge_engine == "first-row" else "last"
     if seq_fields and keep == "first":
         # reference forbids the combo: "first by user sequence" would
@@ -717,7 +778,8 @@ def merge_runs(runs: Sequence[pa.Table], key_names: Sequence[str],
     perm, winner, prev = device_sorted_winners(
         lanes, seq, keep, order_lanes,
         winners_only=not with_prev and not truncated.any(),
-        packed=packed, overlapped=overlapped)
+        packed=packed, overlapped=overlapped,
+        run_starts=run_starts if order_lanes is None else None)
 
     win_pos = np.flatnonzero(winner)
     indices = perm[win_pos].astype(np.int64)

@@ -64,6 +64,11 @@ class _RunState:
         self._chunks = chunks
         self.key_cols = list(key_cols)
         self.encoder = encoder
+        # single-fixed-key tables: bounds and cuts compare the packed
+        # u64 (one searchsorted) instead of lane tuples — the window
+        # comparator consuming the single-int code (ops/ovc.py is the
+        # same idea inside the merge itself)
+        self.packed_mode = getattr(encoder, "packs_single_key", False)
         # (table, lanes, truncated, packed-u64-or-None) quads
         self.buffer: List[Tuple] = []
         self.exhausted = False
@@ -93,14 +98,34 @@ class _RunState:
         if lanes is None:
             lanes, trunc, packed = self.encoder.encode_table_ex(
                 t, self.key_cols)
+        elif packed is None and self.packed_mode:
+            # upstream handed raw lanes: derive the packed key so every
+            # buffered chunk cuts through the same u64 comparator
+            mat = np.asarray(lanes)
+            packed = (mat[:, 0].astype(np.uint64) << np.uint64(32)) \
+                | mat[:, 1].astype(np.uint64)
         self.buffer.append((t, lanes, trunc, packed))
         return True
 
     def last_key(self) -> Optional[Tuple]:
         if not self.buffer:
             return None
+        if self.packed_mode:
+            return int(self.buffer[-1][3][-1])
         lanes = self.buffer[-1][1]
         return tuple(lanes[-1])
+
+    def key_at(self, idx: int):
+        """Key of the idx-th buffered row (run order), or None when
+        fewer rows are buffered — the per-run window-size cap probe."""
+        for t, lanes, _trunc, packed in self.buffer:
+            n = t.num_rows
+            if idx < n:
+                if self.packed_mode:
+                    return int(packed[idx])
+                return tuple(lanes[idx])
+            idx -= n
+        return None
 
     def cut_lt(self, bound: Tuple) -> List[Tuple]:
         """Remove and return rows with key lanes < bound (a prefix of the
@@ -111,7 +136,11 @@ class _RunState:
             if new_buffer:
                 new_buffer.append((t, lanes, trunc, packed))  # past bound
                 continue
-            k = _cut_point(lanes, bound)
+            if self.packed_mode:
+                k = int(np.searchsorted(packed, np.uint64(bound),
+                                        side="left"))
+            else:
+                k = _cut_point(lanes, bound)
             if k == t.num_rows:
                 head.append((t, lanes, trunc, packed))
             else:
@@ -136,6 +165,7 @@ def iter_merge_windows(
     key_cols: Sequence[str],
     key_encoder: NormalizedKeyEncoder,
     stats: Optional[Dict[str, int]] = None,
+    window_rows: Optional[int] = None,
 ) -> Iterator[List[Tuple]]:
     """Pull-based window stream: yields one run-ordered item list per key
     window, in ascending key order.  Each item is a (table, lanes,
@@ -151,7 +181,18 @@ def iter_merge_windows(
 
     `stats`, when given, records "peak_buffered_rows": the max total
     rows buffered across runs at any point — the observable that the
-    bounded-host-RAM contract is tested against."""
+    bounded-host-RAM contract is tested against.
+
+    `window_rows` caps each run's contribution per window: the bound is
+    lowered to the smallest buffered key at row `window_rows` of any
+    run, so a window holds ~k x window_rows rows instead of everything
+    below the natural bound (whole-file chunks otherwise degenerate to
+    ONE window holding nearly the entire bucket, serializing the
+    downstream merge pipeline behind a single giant sort).  The lowered
+    bound is an existing key, so the key-window invariant — a key's
+    rows never straddle windows — is unchanged; windows where the cap
+    makes no progress (one key group wider than the cap) fall back to
+    the natural bound."""
     runs = [_RunState(it, key_cols, key_encoder)
             for it in run_chunk_iters]
     for r in runs:
@@ -175,6 +216,19 @@ def iter_merge_windows(
             return
         bound = min(r.last_key() for r in non_exhausted)
         heads: List = []
+        if window_rows:
+            caps = [c for c in (r.key_at(window_rows) for r in runs)
+                    if c is not None]
+            if caps:
+                cap = min(caps)
+                if cap < bound:
+                    for r in runs:          # run order = merge stability
+                        heads.extend(r.cut_lt(cap))
+                    if heads:
+                        yield heads
+                        continue
+                    # a single key group wider than the cap: fall back
+                    # to the natural bound below so the stream advances
         for r in runs:                      # run order = merge stability
             heads.extend(r.cut_lt(bound))
         if heads:
@@ -204,6 +258,7 @@ def merge_runs_streamed(
     emit: Callable[[pa.Table], None],
     merge_window: Callable[[List], pa.Table],
     pass_encoded: bool = False,
+    window_rows: Optional[int] = None,
 ) -> None:
     """Stream-merge k runs (oldest first) and emit merged key windows in
     ascending key order.
@@ -216,6 +271,7 @@ def merge_runs_streamed(
     (table, lanes, truncated, packed) tuples so the kernel can skip
     re-encoding (and re-packing) the window's keys."""
     for items in iter_merge_windows(run_chunk_iters, key_cols,
-                                    key_encoder):
+                                    key_encoder,
+                                    window_rows=window_rows):
         emit(merge_window(items if pass_encoded
                           else [item[0] for item in items]))

@@ -51,6 +51,44 @@ def _split_u64(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
             (x & np.uint64(0xFFFFFFFF)).astype(np.uint32))
 
 
+class LazyPackedLanes:
+    """[n, 2] u32 lane-matrix VIEW over a packed u64 key vector.
+
+    The hot single-fixed-key paths (OVC merge, packed radix, the
+    searchsorted window cut) sort the packed u64 and never read the
+    lane matrix, so the encoder hands back this deferred view instead
+    of paying a [n, 2] allocation + two strided column writes per
+    chunk; np.asarray(...) materializes with a one-shot cache for the
+    paths that do want lanes (device kernels, lexsort fallbacks)."""
+
+    def __init__(self, packed: np.ndarray):
+        self.packed = packed
+        self.shape = (len(packed), 2)
+        self._mat: Optional[np.ndarray] = None
+
+    def _materialize(self) -> np.ndarray:
+        if self._mat is None:
+            hi, lo = _split_u64(self.packed)
+            self._mat = np.stack([hi, lo], axis=1)
+        return self._mat
+
+    def __array__(self, dtype=None, copy=None):
+        out = self._materialize()
+        if dtype is not None:
+            out = out.astype(dtype)
+        if copy and out is self._mat:
+            out = out.copy()
+        return out
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LazyPackedLanes(self.packed[idx])
+        return self._materialize()[idx]
+
+
 class NormalizedKeyEncoder:
     """Encodes the key columns of Arrow batches into uint32 lane matrices."""
 
@@ -91,11 +129,21 @@ class NormalizedKeyEncoder:
     def num_lanes(self) -> int:
         return sum(self.lanes_per_col)
 
+    @property
+    def packs_single_key(self) -> bool:
+        """True when this encoder's keys pack into ONE u64 (single
+        non-null fixed-width column — the hot pk shape): encode_*_ex
+        then returns a LazyPackedLanes view and consumers may compare
+        by the packed integer alone."""
+        return (self.num_lanes == 2 and len(self.key_types) == 1
+                and not self.nullable[0]
+                and self._kinds[0] in ("int", "float"))
+
     def encode_columns(self, columns: Sequence[pa.ChunkedArray],
                        ) -> Tuple[np.ndarray, np.ndarray]:
         """-> (lanes uint32[N, num_lanes], truncated bool[N])."""
         lanes, truncated, _ = self.encode_columns_ex(columns)
-        return lanes, truncated
+        return np.asarray(lanes), truncated
 
     def encode_columns_ex(self, columns: Sequence[pa.ChunkedArray],
                           ) -> Tuple[np.ndarray, np.ndarray,
@@ -107,6 +155,20 @@ class NormalizedKeyEncoder:
         re-packing the lanes (3 temporaries saved at bucket scale)."""
         assert len(columns) == len(self.key_types)
         n = len(columns[0]) if columns else 0
+        if self.packs_single_key and n > 0:
+            # hot pk shape: ONLY the packed u64 is computed; the [n, 2]
+            # lane matrix is a deferred view most consumers never touch
+            arr = columns[0]
+            arr = arr.combine_chunks() \
+                if isinstance(arr, pa.ChunkedArray) else arr
+            if arr.null_count:
+                raise ValueError(
+                    "null value in a key column declared NOT NULL")
+            if self._kinds[0] == "int":
+                u = _ints_to_u64(np.asarray(arr.cast(pa.int64())))
+            else:
+                u = _floats_to_u64(np.asarray(arr.cast(pa.float64())))
+            return LazyPackedLanes(u), np.zeros(n, dtype=bool), u
         lanes = np.zeros((n, self.num_lanes), dtype=np.uint32)
         truncated = np.zeros(n, dtype=bool)
         packed: Optional[np.ndarray] = None

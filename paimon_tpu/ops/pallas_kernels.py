@@ -62,8 +62,15 @@ def pallas_enabled() -> bool:
     return jax.default_backend() in ("tpu", "cpu")
 
 
+# ovc_off value marking rows whose offset-value code is unusable (run
+# starts: their predecessor is the -inf sentinel, not a real row) —
+# keep in sync with ops/ovc.OVC_OFF_SENTINEL
+_OVC_SENTINEL = 0xFFFFFFFF
+
+
 @lru_cache(maxsize=16)
-def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
+def _eq_next_fn(num_lanes: int, n: int, interpret: bool,
+                with_ovc: bool = False, num_key_lanes: int = 0):
     from jax.experimental import pallas as pl
 
     rows = n // _LANE
@@ -76,7 +83,8 @@ def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
                         lambda i: (i, jnp.int32(0)))
 
     def kernel(*refs):
-        # refs: cur lanes... nxt lanes... inv_cur, inv_nxt, out
+        # refs: cur lanes... nxt lanes... inv_cur, inv_nxt,
+        #       [off_nxt, perm_cur, perm_nxt,] out
         cur = refs[:num_lanes]
         nxt = refs[num_lanes:2 * num_lanes]
         inv_cur = refs[2 * num_lanes]
@@ -85,19 +93,33 @@ def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
         eq = cur[0][...] == nxt[0][...]
         for l in range(1, num_lanes):
             eq = jnp.logical_and(eq, cur[l][...] == nxt[l][...])
+        if with_ovc:
+            # single-int offset-value codes first: a sorted-adjacent
+            # pair that is also run-consecutive resolves key equality
+            # from the next row's code alone (offset past the key
+            # lanes = same key); only the remaining pairs use the full
+            # lane-compare chain above
+            off_nxt = refs[2 * num_lanes + 2]
+            perm_cur = refs[2 * num_lanes + 3]
+            perm_nxt = refs[2 * num_lanes + 4]
+            consec = perm_nxt[...] == perm_cur[...] + 1
+            known = off_nxt[...] != jnp.uint32(_OVC_SENTINEL)
+            eq_code = off_nxt[...] >= jnp.uint32(num_key_lanes)
+            eq = jnp.where(jnp.logical_and(consec, known), eq_code, eq)
         eq = jnp.logical_and(eq, inv_cur[...] == inv_nxt[...])
         out[...] = eq.astype(jnp.uint32)
 
+    n_in = 2 * num_lanes + 2 + (3 if with_ovc else 0)
     fn = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[spec] * (2 * num_lanes + 2),
+        in_specs=[spec] * n_in,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.uint32),
         interpret=interpret,
     )
 
-    def run(lane_list, invalid):
+    def run(lane_list, invalid, ovc_off=None, perm=None):
         def shaped(a):
             return a.reshape(rows, _LANE)
 
@@ -107,6 +129,8 @@ def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
         args = ([shaped(a) for a in lane_list]
                 + [shifted(a) for a in lane_list]
                 + [shaped(invalid), shifted(invalid)])
+        if with_ovc:
+            args += [shifted(ovc_off), shaped(perm), shifted(perm)]
         eq = fn(*args).reshape(n)
         # the final element wraps around to position 0: never a segment
         # continuation
@@ -115,22 +139,41 @@ def _eq_next_fn(num_lanes: int, n: int, interpret: bool):
     return run
 
 
-def _eq_next_xla(lane_list, invalid):
+def _eq_next_xla(lane_list, invalid, ovc_off=None, perm=None,
+                 num_key_lanes: int = 0):
     lanes_mat = jnp.stack(list(lane_list))
     eq = jnp.all(lanes_mat[:, :-1] == lanes_mat[:, 1:], axis=0)
+    if ovc_off is not None:
+        consec = perm[1:] == perm[:-1] + 1
+        known = ovc_off[1:] != jnp.uint32(_OVC_SENTINEL)
+        eq_code = ovc_off[1:] >= jnp.uint32(num_key_lanes)
+        eq = jnp.where(consec & known, eq_code, eq)
     eq = eq & (invalid[:-1] == invalid[1:])
     return jnp.concatenate([eq, jnp.array([False])])
 
 
 def eq_next_mask(lane_list: Sequence[jnp.ndarray],
-                 invalid: jnp.ndarray) -> jnp.ndarray:
+                 invalid: jnp.ndarray,
+                 ovc_off: jnp.ndarray = None,
+                 perm: jnp.ndarray = None) -> jnp.ndarray:
     """bool[N]: position i continues the same (validity, lanes...)
     segment at i+1.  Fused Pallas pass on tpu/cpu backends for
     tile-aligned N; every other case takes the equivalent XLA ops, so
-    callers never need their own shape/backend gate."""
+    callers never need their own shape/backend gate.
+
+    `ovc_off`/`perm` (sorted-order offset-value-code offsets + the sort
+    permutation) switch on the single-int-code fast path: pairs whose
+    codes decide key equality skip the lane-compare chain, the rest
+    fall through to it (ops/ovc.run_ovc_offsets documents the code)."""
     n = invalid.shape[0]
+    num_key_lanes = len(lane_list)
     if n == 0 or n % PALLAS_TILE != 0 or not pallas_enabled():
-        return _eq_next_xla(lane_list, invalid)
+        return _eq_next_xla(lane_list, invalid, ovc_off, perm,
+                            num_key_lanes)
     interpret = jax.default_backend() != "tpu"
-    run = _eq_next_fn(len(lane_list), n, interpret)
+    run = _eq_next_fn(len(lane_list), n, interpret,
+                      with_ovc=ovc_off is not None,
+                      num_key_lanes=num_key_lanes)
+    if ovc_off is not None:
+        return run(list(lane_list), invalid, ovc_off, perm)
     return run(list(lane_list), invalid)

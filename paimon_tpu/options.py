@@ -290,6 +290,15 @@ class CoreOptions:
         "larger windows amortize per-window sync/flush overhead "
         "(~20% at 30M rows/10 runs measured in-env) at ~runs x rows "
         "x row-bytes peak memory")
+    MERGE_WINDOW_ROWS = ConfigOption(
+        "tpu.merge.window-rows", int, 1 << 18,
+        "Per-run row cap of one streamed merge key window (ours): the "
+        "window bound is lowered to the smallest buffered key at this "
+        "row index, so a window carries ~runs x this many rows and "
+        "adjacent windows overlap on the merge workers instead of one "
+        "window swallowing the whole bucket; a key group wider than "
+        "the cap falls back to the natural bound (keys never straddle "
+        "windows)")
     MESH_COMPACT = ConfigOption(
         "tpu.mesh.compact", _parse_bool, False,
         "Route full compactions of primary-key tables through the "
@@ -447,6 +456,16 @@ class CoreOptions:
     READ_CACHE_RANGE_MAX_BYTES = ConfigOption(
         "read.cache.range.max-bytes", parse_memory_size, 128 << 20,
         "Capacity of the block-range cache enabled by read.cache.range")
+    READ_DEVICE_DECODE = ConfigOption(
+        "read.device-decode", _parse_bool, False,
+        "Route parquet data-file reads through the device decode plane "
+        "(format/rawpage.py + ops/decode.py): undecoded column-chunk "
+        "pages are sliced via ranged reads (riding the block-range "
+        "cache and SSD tier) and every per-value transform — "
+        "RLE/bit-packed level expansion, dictionary gather, PLAIN "
+        "reinterpret — runs as vectorized device ops; files outside "
+        "the covered encodings fall back to the pyarrow host path "
+        "(scan group device_decode_files/_fallbacks counters)")
 
     # -- pipelined write/ingest (ours; parallel/write_pipeline.py) -----------
     WRITE_FLUSH_PARALLELISM = ConfigOption(

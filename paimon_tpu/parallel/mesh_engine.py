@@ -122,19 +122,20 @@ class _MeshWindowKernel:
         self.sharding = NamedSharding(mesh, P(axis))
         self._n_dev = mesh.shape[axis]
 
-        def per_lane(lanes, seq_hi, seq_lo, invalid):
+        def per_lane(lanes, seq_hi, seq_lo, invalid, ovc_off):
             perm, winner, _ = segmented_merge_body(
                 [lanes[:, i] for i in range(num_lanes)],
                 seq_hi, seq_lo, invalid, keep,
-                num_key_lanes=num_key_lanes)
+                num_key_lanes=num_key_lanes, ovc_off=ovc_off)
             return perm, winner
 
         @partial(shard_map, mesh=mesh,
-                 in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                 in_specs=(P(axis), P(axis), P(axis), P(axis),
+                           P(axis)),
                  out_specs=(P(axis), P(axis), P()))
-        def step(lanes, seq_hi, seq_lo, invalid):
+        def step(lanes, seq_hi, seq_lo, invalid, ovc_off):
             perm, winner = jax.vmap(per_lane)(lanes, seq_hi, seq_lo,
-                                              invalid)
+                                              invalid, ovc_off)
             total = jax.lax.psum(
                 jnp.sum(winner.astype(jnp.int64)), axis)
             return perm, winner, total.reshape(1)
@@ -142,11 +143,12 @@ class _MeshWindowKernel:
         self._fn = jax.jit(step)
 
     def __call__(self, lanes: np.ndarray, seq_hi: np.ndarray,
-                 seq_lo: np.ndarray, invalid: np.ndarray):
+                 seq_lo: np.ndarray, invalid: np.ndarray,
+                 ovc_off: np.ndarray):
         import jax
 
         args = [jax.device_put(a, self.sharding)
-                for a in (lanes, seq_hi, seq_lo, invalid)]
+                for a in (lanes, seq_hi, seq_lo, invalid, ovc_off)]
         perm, winner, total = self._fn(*args)
         jax.block_until_ready((perm, winner, total))
         return (np.asarray(perm), np.asarray(winner),
@@ -353,6 +355,34 @@ class _BucketJob:
             fmt = get_format(ext)
             path = f.external_path or ctx.path_factory.data_file_path(
                 self.split.partition, self.split.bucket, f.file_name)
+            if fmt.identifier == "parquet" and options.get(
+                    CoreOptions.READ_DEVICE_DECODE):
+                # row-group-at-a-time device decode (memory bound as
+                # the pyarrow batch path); unsupported files drop to
+                # the format reader below
+                from paimon_tpu.format.rawpage import (
+                    _FALLBACK_ERRORS, iter_batches_device,
+                )
+                batches = None
+                try:
+                    batches = iter_batches_device(
+                        ctx.table.file_io, path, ctx.chunk_rows,
+                        options)
+                except _FALLBACK_ERRORS:
+                    from paimon_tpu.metrics import (
+                        SCAN_DEVICE_DECODE_FALLBACKS, global_registry,
+                    )
+                    global_registry().group("scan").counter(
+                        SCAN_DEVICE_DECODE_FALLBACKS).inc()
+                if batches is not None:
+                    for batch in batches:
+                        t = evolve_table(
+                            batch, f.schema_id, ctx.schema,
+                            ctx.schema_manager, ctx.schema_cache,
+                            keep_sys_cols=True)
+                        yield (t, *ctx.key_encoder.encode_table_ex(
+                            t, ctx.key_cols))
+                    continue
             # gate held only while advancing the inner iterator (see
             # fs.caching.scoped_batches), never across our yields
             for batch in scoped_batches(
@@ -376,7 +406,9 @@ class _BucketJob:
             self._windows = iter_merge_windows(
                 [_prefetch(self._run_iter(rf)) for rf in runs_meta],
                 self.ctx.key_cols, self.ctx.key_encoder,
-                stats=self.stream_stats)
+                stats=self.stream_stats,
+                window_rows=self.ctx.table.options.get(
+                    CoreOptions.MERGE_WINDOW_ROWS))
         return next(self._windows, None)
 
     def emit(self, merged) -> None:
@@ -694,29 +726,40 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
                                                axis=1)
                 seq = np.asarray(wtable.column(SEQ_COL).combine_chunks()
                                  .cast("int64"))
+                # each window item is one sorted-run piece: its
+                # offset-value codes ride to the device so the kernel's
+                # winner-select consumes the single-int offsets first
+                item_starts = np.concatenate(
+                    [[0], np.cumsum([it[0].num_rows
+                                     for it in items])]).astype(np.int64)
             except Exception as e:          # noqa: BLE001
                 _handle_bucket_failure(li, job, e)
                 continue
-            device_rows[li] = (job, wtable, lanes_mat, seq)
+            device_rows[li] = (job, wtable, lanes_mat, seq, item_starts)
             n_max = max(n_max, wtable.num_rows)
         if n_max == 0:
             continue
+        from paimon_tpu.ops.ovc import OVC_OFF_SENTINEL, run_ovc_offsets
         n_pad = _pad_size(n_max)
         lanes_arr = np.zeros((n_dev, n_pad, ctx.num_lanes),
                              dtype=np.uint32)
         seq_hi = np.zeros((n_dev, n_pad), dtype=np.uint32)
         seq_lo = np.zeros((n_dev, n_pad), dtype=np.uint32)
         invalid = np.ones((n_dev, n_pad), dtype=np.uint32)
+        ovc_arr = np.full((n_dev, n_pad), OVC_OFF_SENTINEL,
+                          dtype=np.uint32)
         for li, entry in enumerate(device_rows):
             if entry is None:
                 continue
-            _, wtable, lanes_mat, seq = entry
+            _, wtable, lanes_mat, seq, item_starts = entry
             k = wtable.num_rows
             lanes_arr[li, :k] = lanes_mat
             u = seq.astype(np.int64).view(np.uint64)
             seq_hi[li, :k] = (u >> np.uint64(32)).astype(np.uint32)
             seq_lo[li, :k] = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             invalid[li, :k] = 0
+            ovc_arr[li, :k] = run_ovc_offsets(lanes_arr[li, :k],
+                                              item_starts)
         try:
             from paimon_tpu.metrics import COMPACTION_WINDOW_MS
             with _obs_span("compaction.window", cat="compaction",
@@ -726,7 +769,7 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
                                      if e is not None),
                            rows=n_max, table=table.path):
                 perm, winner, _ = kernel(lanes_arr, seq_hi, seq_lo,
-                                         invalid)
+                                         invalid, ovc_arr)
         except Exception as e:              # noqa: BLE001
             # a kernel failure is a lane/device failure for every
             # bucket in flight this step: each rides its own ladder
@@ -737,7 +780,7 @@ def compact_table_mesh(table, mesh=None, axis: str = "buckets",
         for li, entry in enumerate(device_rows):
             if entry is None:
                 continue
-            job, wtable, _, _ = entry
+            job, wtable = entry[0], entry[1]
             try:
                 job.emit(ctx.merge_window_device(wtable, perm[li],
                                                  winner[li]))

@@ -661,21 +661,42 @@ class StreamDaemon:
         """(partition, bucket) of one CDC event, or None for events
         that parse to no changes.  All changes of one pk event share
         the key, so the first change decides."""
+        return self._event_groups([event])[0]
+
+    def _event_groups(self, events) -> list:
+        """[(partition, bucket) or None] for a whole poll batch: the
+        bucket hash runs ONCE vectorized over the batch's key rows
+        (core/bucket KeyHasher numpy path) instead of building a
+        one-row table per event — the ROADMAP item 5 residual.  The
+        per-row path (_event_group) is the oracle the equivalence test
+        compares against."""
         import pyarrow as pa
-        changes = self._parse_event(event)
-        if not changes:
-            return None
-        row = changes[0][0]
+        rows: list = []
+        present: list = []
+        for i, event in enumerate(events):
+            changes = self._parse_event(event)
+            if not changes:
+                rows.append(None)
+                continue
+            rows.append(changes[0][0])
+            present.append(i)
+        groups: list = [None] * len(events)
+        if not present:
+            return groups
         if self._key_schema is None:
             arrow = self.table.arrow_schema()
             self._key_schema = pa.schema(
                 [arrow.field(k) for k in self._bucket_key_names])
         sub = pa.Table.from_pylist(
-            [{k: row.get(k) for k in self._bucket_key_names}],
+            [{k: rows[i].get(k) for k in self._bucket_key_names}
+             for i in present],
             schema=self._key_schema)
-        bucket = int(self._assigner.assign(sub)[0])
-        part = tuple(row.get(k) for k in self._partition_key_names)
-        return part, bucket
+        buckets = self._assigner.assign(sub)
+        for i, bucket in zip(present, buckets):
+            part = tuple(rows[i].get(k)
+                         for k in self._partition_key_names)
+            groups[i] = (part, int(bucket))
+        return groups
 
     def _forward_map(self):
         """The forward-ingest ownership map: the plane's topology with
@@ -690,7 +711,11 @@ class StreamDaemon:
 
     def _owns_forward_event(self, offset: int, event,
                             m=None) -> bool:
-        g = self._event_group(event)
+        return self._owns_forward_group(
+            offset, self._event_group(event), m)
+
+    def _owns_forward_group(self, offset: int, g,
+                            m=None) -> bool:
         if g is None:
             return False
         part, bucket = g
@@ -760,14 +785,26 @@ class StreamDaemon:
                   peer_offset=off_j, own_offset=off_i):
             with self._commit_lock:
                 backfill = []
-                if off_j < off_i:
-                    for off, ev in self.source.poll(off_j, 1 << 30):
-                        if off > off_i:
-                            break
-                        g = self._event_group(ev)
+                cursor = off_j
+                while cursor < off_i:
+                    # bounded slices: a peer that died far behind must
+                    # not buffer its whole gap at once — each slice is
+                    # one vectorized bucket-hash (the batched router
+                    # the ingest loop uses), and only the adopted
+                    # subset is retained
+                    polled = self.source.poll(cursor, 1 << 16)
+                    if not polled:
+                        break
+                    window = [ev for off, ev in polled
+                              if off <= off_i]
+                    for ev, g in zip(window,
+                                     self._event_groups(window)):
                         if g is not None and \
                                 self._adopted_from(j, *g):
                             backfill.append(ev)
+                    cursor = polled[-1][0]
+                    if len(window) < len(polled):
+                        break              # crossed off_i inside slice
                 self._floors[j] = off_j
                 self.plane.adopt({j})
                 # ledger entry BEFORE the publishing commit so the
@@ -864,11 +901,14 @@ class StreamDaemon:
                     # SPMD split: every host sees the identical
                     # stream; each writes only its owned share (plus
                     # floor suppression for adopted groups).  One
-                    # forward map per batch — it only changes under
-                    # the commit lock, never mid-poll
+                    # forward map AND one vectorized bucket-hash per
+                    # poll batch — the map only changes under the
+                    # commit lock, never mid-poll
                     fm = self._forward_map()
-                    mine = [e for off, e in events
-                            if self._owns_forward_event(off, e, fm)]
+                    groups = self._event_groups(
+                        [e for _, e in events])
+                    mine = [e for (off, e), g in zip(events, groups)
+                            if self._owns_forward_group(off, g, fm)]
                 with span("stream.ingest.batch", cat="stream",
                           events=len(events), owned=len(mine),
                           first=events[0][0], last=events[-1][0]):

@@ -267,6 +267,60 @@ def _raw_collective_calls():
     return found
 
 
+# device-kernel modules whose bodies must stay traceable end to end:
+# a host materialization here silently reintroduces the round-trip the
+# device decode plane exists to remove (the host boundary lives in
+# format/rawpage.py, which orchestrates these kernels)
+_KERNEL_MODULES = (
+    "paimon_tpu/ops/decode.py",
+    "paimon_tpu/ops/pallas_kernels.py",
+)
+
+
+def _host_materialization_calls():
+    """`np.asarray(...)` / `<x>.tolist()` / `jax.device_get(...)` call
+    sites inside the device-kernel modules, as '<relpath>:<line>'
+    strings.  A line carrying an explicit `# host-ok:` marker (with a
+    reason) is a reviewed exemption — same spirit as the time.sleep /
+    threading.Thread allowlists."""
+    found = []
+    for rel in _KERNEL_MODULES:
+        path = os.path.join(REPO, rel)
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        lines = src.splitlines()
+        tree = ast.parse(src, rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            hit = (fn.attr == "asarray"
+                   and isinstance(fn.value, ast.Name)
+                   and fn.value.id in ("np", "numpy")) \
+                or fn.attr == "tolist" \
+                or (fn.attr == "device_get"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "jax")
+            if not hit:
+                continue
+            if "# host-ok:" in lines[node.lineno - 1]:
+                continue
+            found.append(f"{rel}:{node.lineno}")
+    return found
+
+
+def test_no_host_materialization_in_kernel_modules():
+    offenders = _host_materialization_calls()
+    assert not offenders, (
+        f"host materialization (np.asarray / .tolist() / "
+        f"jax.device_get) inside a device-kernel module — keep the "
+        f"kernel traceable and materialize at the format/rawpage.py "
+        f"boundary instead, or mark a reviewed exception with "
+        f"`# host-ok: <reason>`: {sorted(offenders)}")
+
+
 def test_no_raw_collectives_outside_multihost():
     offenders = _raw_collective_calls()
     assert not offenders, (

@@ -446,12 +446,23 @@ class TestCli:
 
 
 @pytest.mark.parametrize("entry", ["obs"])
-def test_disabled_tracing_overhead_under_2pct(entry):
+def test_disabled_tracing_overhead_bounded(entry):
     """Tier-1 bound from the issue: the tracing-DISABLED scan hot path
-    adds <2% vs a no-instrumentation baseline (micro `obs` entry:
-    best-of timings, min overhead over interleaved trials)."""
+    adds negligible overhead vs a no-instrumentation baseline (micro
+    `obs` entry: best-of timings, min overhead over interleaved
+    trials).
+
+    Deflaked (ISSUE 12): the true disabled overhead is ~0.1%, but a
+    60k-row scan is ~10 ms and under parallel-test load a single noisy
+    baseline round used to push the ratio past the old 2% line when
+    run with the whole suite (passed in isolation).  Two levers, per
+    the issue: more interleaved trials (5 — the min over trials is the
+    honest estimate, extra rounds only help) and a 5% tolerance that
+    still catches any real per-span regression (a single reintroduced
+    hot-path span costs >30%) while sitting far above scheduler
+    noise."""
     env = dict(os.environ, MICRO_ROWS="60000", MICRO_RUNS="2",
-               OBS_TRIALS="3", JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+               OBS_TRIALS="5", JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     proc = subprocess.run(
         [sys.executable, "-m", "benchmarks.micro", entry],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=420)
@@ -462,8 +473,8 @@ def test_disabled_tracing_overhead_under_2pct(entry):
             "obs_scan_trace_enabled",
             "obs_overhead_disabled_pct"} <= set(by_name)
     overhead = by_name["obs_overhead_disabled_pct"]["value"]
-    assert overhead < 2.0, (
-        f"disabled-tracing overhead {overhead}% >= 2% "
+    assert overhead < 5.0, (
+        f"disabled-tracing overhead {overhead}% >= 5% "
         f"(noinstr={by_name['obs_scan_noinstr']['best_seconds']}s, "
         f"disabled="
         f"{by_name['obs_scan_trace_disabled']['best_seconds']}s)")
