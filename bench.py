@@ -447,20 +447,35 @@ def scan_child_main():
 
 
 def serve_child_main():
-    """BENCH_SERVE_CHILD=1 mode: the query-serving benchmark (ISSUE
-    7's hot path — 64 concurrent keep-alive clients mixing point gets
-    and LIMIT'd scans against one KvQueryServer, with admission
-    control and the shared cache tier on).  Prints one JSON line for
-    the parent."""
+    """BENCH_SERVE_CHILD=1 mode: the query-serving benchmark — the
+    single-replica leg (64 concurrent keep-alive clients mixing point
+    gets and LIMIT'd scans against one event-loop KvQueryServer) plus
+    the PR-13 MULTI-REPLICA rig (replica subprocesses behind the
+    consistent-hash router, topology-following client processes,
+    labeled client/obs latency series, oracle row identity asserted).
+    Prints one JSON line for the parent."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    from benchmarks.serve_bench import measure_serving
+    from benchmarks.serve_bench import measure_replicated, measure_serving
 
     rows = int(os.environ.get("BENCH_SERVE_ROWS", "200000"))
     clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "64"))
     seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "4"))
+    replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", "12"))
     out = measure_serving(rows=rows, clients=clients, seconds=seconds,
                           emit=None)
+    if replicas > 1:
+        os.environ.setdefault("SERVE_REPLICA_WORKERS", "8")
+        rep = measure_replicated(
+            rows=rows, clients=clients,
+            seconds=float(os.environ.get(
+                "BENCH_SERVE_REPLICATED_SECONDS", "8")),
+            replicas=replicas,
+            client_procs=int(os.environ.get(
+                "BENCH_SERVE_CLIENT_PROCS", "8")),
+            emit=None)
+        rep.pop("latency_series", None)
+        out["replicated"] = rep
     from paimon_tpu.metrics import global_registry
     snap = global_registry().snapshot()
     out["metrics_snapshot"] = {
@@ -497,10 +512,15 @@ def compose_serve(result):
     """The serving-plane metric block attached under "serving" in the
     one official JSON line: sustained mixed-workload QPS with a nested
     serving_point_lookup_p95_ms block (trajectory metrics for the
-    query-serving path, alongside compaction/scan/write)."""
+    query-serving path, alongside compaction/scan/write), plus the
+    PR-13 "replicated" sub-block (multi-replica rig; labeled series:
+    client_ok = successful lookups client-observed, client_all also
+    times 429-ended requests, obs = server-side histograms pooled
+    across replicas — compare client_ok vs obs, never across
+    labels)."""
     if result is None:
         return None
-    return {
+    block = {
         "metric": "serving_qps",
         "value": result["qps"],
         "unit": (f"requests/s ({result['clients']} concurrent "
@@ -508,13 +528,16 @@ def compose_serve(result):
                  f"~90/10 point-get/scan mix, "
                  f"{result['busy_429']} x 429, "
                  f"lookup {result['lookup_qps']}/s + "
-                 f"scan {result['scan_qps']}/s)"),
+                 f"scan {result['scan_qps']}/s; single replica, "
+                 f"event-loop engine)"),
         "point_lookup_p95_ms": {
             "metric": "serving_point_lookup_p95_ms",
             "value": result["point_p95_ms"],
-            "unit": (f"ms client-observed at saturation (p50 "
+            "unit": (f"ms client_ok-observed at saturation (p50 "
                      f"{result['point_p50_ms']}ms, p99 "
-                     f"{result['point_p99_ms']}ms; obs-plane p95 "
+                     f"{result['point_p99_ms']}ms; client_all p95 "
+                     f"{result.get('client_all_p95_ms')}ms; "
+                     f"obs-plane p95 "
                      f"{result['obs_lookup_p95_ms']}ms); warm "
                      f"/lookup x{result.get('batch', 8)} keys p50 "
                      f"{result['warm_point_ms_p50']}ms vs cold "
@@ -526,6 +549,44 @@ def compose_serve(result):
         },
         "metrics_snapshot": result.get("metrics_snapshot"),
     }
+    rep = result.get("replicated")
+    if rep:
+        # ISSUE 13 acceptance vs the BENCH_r07 single-replica
+        # baseline (102.1 qps, obs-plane lookup p95 491.1138 ms)
+        base_qps, base_p95 = 102.1, 491.1138
+        block["replicated"] = {
+            "metric": "serving_replicated_qps",
+            "value": rep["qps"],
+            "unit": (f"requests/s ({rep['replicas']} replica "
+                     f"processes behind the consistent-hash router, "
+                     f"{rep['clients']} clients in "
+                     f"{rep['client_procs']} processes following "
+                     f"/topology, ~90/10 mix, {rep['busy_429']} x "
+                     f"429, {rep['oracle_rows_checked']} sampled "
+                     f"rows oracle-identical)"),
+            "vs_r07_qps": round(rep["qps"] / base_qps, 2),
+            "point_lookup_p95_ms": {
+                "metric": "serving_replicated_point_lookup_p95_ms",
+                "value": rep["obs_lookup_p95_ms"],
+                "unit": (f"ms obs-plane pooled across replicas (p99 "
+                         f"{rep['obs_lookup_p99_ms']}ms, straggler "
+                         f"max p95 {rep['obs_lookup_p95_ms_max']}ms; "
+                         f"client_ok p95 {rep['client_ok_p95_ms']}ms "
+                         f"p99 {rep['client_ok_p99_ms']}ms; "
+                         f"client_all p95 "
+                         f"{rep['client_all_p95_ms']}ms)"),
+                "vs_r07_p95": round(
+                    base_p95 / max(rep["obs_lookup_p95_ms"], 1e-9),
+                    2),
+            },
+            "per_replica": rep.get("per_replica"),
+            "latency_series": ("client_ok = successful lookups only; "
+                               "client_all also times 429-ended "
+                               "requests; obs = server-side "
+                               "histograms pooled across replicas — "
+                               "compare client_ok vs obs"),
+        }
+    return block
 
 
 def write_child_main():
@@ -1124,12 +1185,13 @@ def main():
                     sample_rows=sample)
     _BANKED["json"] = final
 
-    # serving-plane metric (ISSUE 7's hot path), banked FIRST among
-    # the secondary blocks: the child is the cheapest (~40s measured
-    # in-env: build 200k rows + 4s sustained load) and the newest
-    # trajectory — it must land even when the compaction headline ate
-    # most of the budget
-    if _remaining() > 120:
+    # serving-plane metric (ISSUE 7's hot path + ISSUE 13's
+    # multi-replica rig), banked FIRST among the secondary blocks:
+    # the child is ~170s measured in-env (build 200k rows + 4s
+    # single-replica load + 12 replica processes with warmup + 8s
+    # replicated load) and the newest trajectory — it must land even
+    # when the compaction headline ate most of the budget
+    if _remaining() > 150:
         sv = compose_serve(run_serve_child(timeout=_remaining() - 45))
         if sv is not None:
             final["serving"] = sv

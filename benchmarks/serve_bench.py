@@ -1,31 +1,44 @@
 """Query-serving benchmark: concurrent clients mixing bounded scans
-and point gets against one KvQueryServer (the PR-7 serving plane).
+and point gets against the serving plane.
 
-Measures, against a primary-key table with several overlapping L0
-runs per bucket:
+Two rigs:
 
-* COLD point get — first /lookup on a fresh server: keep-alive
-  connect + snapshot plan + per-file SST builds;
-* WARM point gets — the steady state: persistent connection, pinned
-  block cache, per-file SST reuse (the acceptance bar is warm >= 10x
-  cold);
-* a sustained mixed workload: `SERVE_CLIENTS` threads (default 64),
-  ~90% single-key point gets / 10% LIMIT'd scans, reporting QPS plus
-  p50/p95/p99 point-get latency BOTH client-side (every request
-  timed) and from the obs plane (`service` metric-group histograms —
-  the same series Prometheus scrapes).
+* SINGLE-REPLICA (`measure_serving`, the PR-7 leg): `SERVE_CLIENTS`
+  in-process threads against one KvQueryServer (now the event-loop
+  engine) — cold vs warm point gets, engine-level batched probes, and
+  the sustained ~90/10 point-get/scan mix.
+* MULTI-REPLICA (`measure_replicated`, the PR-13 leg):
+  `SERVE_REPLICAS` replica SUBPROCESSES (real parallelism — one
+  serving process per replica, sharing the table directory), a
+  consistent-hash ReplicaRouter in the parent, and
+  `SERVE_CLIENT_PROCS` client subprocesses whose KvQueryClients
+  follow /topology to the owning replica directly.  Row identity of
+  sampled lookups is asserted against the merged-scan oracle.
+
+Latency is reported as EXPLICITLY LABELED series (the r07/r08 records
+compared apples-to-oranges: the client timed 429-rejected requests
+that the server-side histograms exclude):
+
+  client_ok_*   client-observed, successful lookups only
+  client_all_*  client-observed, INCLUDING requests that ended 429
+                (timed to the rejection — the saturation view)
+  obs_*         server-side service histograms (successes only; what
+                Prometheus scrapes).  client_ok vs obs is the
+                apples-to-apples pair.
 
 Usage:
-    python -m benchmarks.serve_bench          # all entries
+    python -m benchmarks.serve_bench          # both rigs
 Prints ONE JSON line per benchmark (micro.py shape).
 
 Env: SERVE_ROWS (default 200_000), SERVE_CLIENTS (64), SERVE_SECONDS
-(4.0), SERVE_BUCKETS (4), SERVE_COMMITS (4).  CPU-only like micro.py —
-bench.py owns the TPU.
+(4.0), SERVE_BUCKETS (4), SERVE_COMMITS (4), SERVE_REPLICAS (6),
+SERVE_CLIENT_PROCS (4).  CPU-only like micro.py — bench.py owns the
+TPU.
 """
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import threading
@@ -45,6 +58,8 @@ CLIENTS = int(os.environ.get("SERVE_CLIENTS", "64"))
 SECONDS = float(os.environ.get("SERVE_SECONDS", "4.0"))
 BUCKETS = int(os.environ.get("SERVE_BUCKETS", "4"))
 COMMITS = int(os.environ.get("SERVE_COMMITS", "4"))
+REPLICAS = int(os.environ.get("SERVE_REPLICAS", "6"))
+CLIENT_PROCS = int(os.environ.get("SERVE_CLIENT_PROCS", "4"))
 
 
 def _emit(obj):
@@ -165,17 +180,21 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
             out["engine_keys_per_s"] = round(1e6 / per_key_us, 1)
 
             # sustained mixed load: `clients` threads, ~90% point
-            # gets / 10% scans, every request timed client-side
+            # gets / 10% scans, every request timed client-side.
+            # TWO labeled client series (see module docstring): _ok
+            # times successful lookups only (the obs-plane comparable),
+            # _all also times requests that ended 429
             stop = threading.Event()
             counts = {"lookup": 0, "scan": 0, "busy": 0}
-            lat_lookup = []
+            lat_ok = []
+            lat_all = []
             lock = threading.Lock()
             errors = []
 
             def worker(seed):
                 from paimon_tpu.service import ServiceBusyError
                 r = np.random.default_rng(seed)
-                my_lat = []
+                my_ok, my_all = [], []
                 my_lookups = my_scans = my_busy = 0
                 try:
                     with KvQueryClient(
@@ -185,10 +204,13 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
                                 if r.random() < 0.9:
                                     k = {"id": int(r.integers(0, rows))}
                                     t1 = time.perf_counter()
-                                    c.lookup_row(k)
-                                    my_lat.append(
-                                        (time.perf_counter() - t1)
-                                        * 1000.0)
+                                    try:
+                                        c.lookup_row(k)
+                                    finally:
+                                        my_all.append(
+                                            (time.perf_counter() - t1)
+                                            * 1000.0)
+                                    my_ok.append(my_all[-1])
                                     my_lookups += 1
                                 else:
                                     c.scan(limit=100)
@@ -202,7 +224,8 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
                     counts["lookup"] += my_lookups
                     counts["scan"] += my_scans
                     counts["busy"] += my_busy
-                    lat_lookup.extend(my_lat)
+                    lat_ok.extend(my_ok)
+                    lat_all.extend(my_all)
 
             threads = [threading.Thread(target=worker, args=(i,))
                        for i in range(clients)]
@@ -217,13 +240,14 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
                     f"serving workers failed: {errors[:3]}")
 
             total = counts["lookup"] + counts["scan"]
-            lat_lookup.sort()
+            lat_ok.sort()
+            lat_all.sort()
 
-            def pct(p):
-                if not lat_lookup:
+            def pct(vals, p):
+                if not vals:
                     return 0.0
-                return lat_lookup[min(len(lat_lookup) - 1,
-                                      int(p / 100 * len(lat_lookup)))]
+                return vals[min(len(vals) - 1,
+                                int(p / 100 * len(vals)))]
 
             out.update({
                 "elapsed_s": round(elapsed, 3),
@@ -231,9 +255,23 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
                 "lookup_qps": round(counts["lookup"] / elapsed, 1),
                 "scan_qps": round(counts["scan"] / elapsed, 1),
                 "busy_429": counts["busy"],
-                "point_p50_ms": round(pct(50), 4),
-                "point_p95_ms": round(pct(95), 4),
-                "point_p99_ms": round(pct(99), 4),
+                # legacy keys (client_ok series) kept for trajectory
+                # comparisons with r06-r08 records
+                "point_p50_ms": round(pct(lat_ok, 50), 4),
+                "point_p95_ms": round(pct(lat_ok, 95), 4),
+                "point_p99_ms": round(pct(lat_ok, 99), 4),
+                "client_ok_p50_ms": round(pct(lat_ok, 50), 4),
+                "client_ok_p95_ms": round(pct(lat_ok, 95), 4),
+                "client_ok_p99_ms": round(pct(lat_ok, 99), 4),
+                "client_all_p50_ms": round(pct(lat_all, 50), 4),
+                "client_all_p95_ms": round(pct(lat_all, 95), 4),
+                "client_all_p99_ms": round(pct(lat_all, 99), 4),
+                "latency_series": ("client_ok = successful lookups "
+                                   "only; client_all also times "
+                                   "429-ended requests; obs = "
+                                   "server-side histograms "
+                                   "(successes only) — compare "
+                                   "client_ok vs obs"),
             })
             # the obs-plane view of the same workload (server-side
             # request histograms — what Prometheus scrapes)
@@ -272,8 +310,326 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
     return out
 
 
+# -- multi-replica rig (PR 13) ------------------------------------------------
+
+
+def replica_child_main(table_path: str, replica_id: int) -> int:
+    """`--replica-serve` mode: one serving process.  Prints its
+    address, serves until stdin closes, then exits — the parent owns
+    the lifecycle through the pipe."""
+    # N replica processes on one box: arrow's default CPU pool (one
+    # thread per core, PER PROCESS) would oversubscribe the machine
+    # Nx under load — cap it; a real deployment pins one replica per
+    # node/cgroup instead
+    pa.set_cpu_count(2)
+    pa.set_io_thread_count(2)
+    from paimon_tpu.service import KvQueryServer
+    from paimon_tpu.table import FileStoreTable
+
+    table = FileStoreTable.load(table_path, dynamic_options={
+        "service.lookup.refresh-interval": "1000",
+        "scan.split.parallelism": "2",
+        # a small handler pool: more concurrent handlers than cores-
+        # per-replica just convoy on the GIL and stretch every
+        # request's service time (queueing belongs in the engine's
+        # dispatch queue, not interleaved execution)
+        "service.workers": os.environ.get("SERVE_REPLICA_WORKERS",
+                                          "6")})
+    server = KvQueryServer(table, replica_id=replica_id)
+    server.server.start()          # no registry write: parent routes
+    print(f"ADDR {replica_id} {server.address}", flush=True)
+    sys.stdin.read()               # parent closes the pipe to stop us
+    server.server.stop()
+    return 0
+
+
+def client_child_main(router_addr: str, seconds: float, rows: int,
+                      threads: int, seed: int) -> int:
+    """`--client-load` mode: one client process running `threads`
+    topology-following KvQueryClients of the ~90/10 mix; prints one
+    JSON result line."""
+    from paimon_tpu.service import KvQueryClient, ServiceBusyError
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    agg = {"lookup": 0, "scan": 0, "busy": 0, "errors": []}
+    lat_ok, lat_all = [], []
+    replicas_seen = set()
+
+    def worker(widx):
+        r = np.random.default_rng(seed * 1000 + widx)
+        my_ok, my_all = [], []
+        my_lookups = my_scans = my_busy = 0
+        try:
+            with KvQueryClient(address=router_addr,
+                               tenant=f"t{seed}-{widx}") as c:
+                while not stop.is_set():
+                    try:
+                        if r.random() < 0.9:
+                            k = {"id": int(r.integers(0, rows))}
+                            t1 = time.perf_counter()
+                            try:
+                                c.lookup_row(k)
+                            finally:
+                                my_all.append(
+                                    (time.perf_counter() - t1)
+                                    * 1000.0)
+                            my_ok.append(my_all[-1])
+                            my_lookups += 1
+                        else:
+                            c.scan(limit=100)
+                            my_scans += 1
+                    except ServiceBusyError:
+                        my_busy += 1
+                        time.sleep(0.002)
+                if c.last_replica is not None:
+                    replicas_seen.add(c.last_replica)
+        except Exception as e:      # noqa: BLE001
+            agg["errors"].append(repr(e))
+        with lock:
+            agg["lookup"] += my_lookups
+            agg["scan"] += my_scans
+            agg["busy"] += my_busy
+            lat_ok.extend(my_ok)
+            lat_all.extend(my_all)
+
+    ths = [threading.Thread(target=worker, args=(i,))
+           for i in range(threads)]
+    t0 = time.perf_counter()
+    [t.start() for t in ths]
+    time.sleep(seconds)
+    stop.set()
+    [t.join() for t in ths]
+    print(json.dumps({
+        "elapsed_s": time.perf_counter() - t0,
+        "lookup": agg["lookup"], "scan": agg["scan"],
+        "busy": agg["busy"], "errors": agg["errors"][:3],
+        "replicas_seen": sorted(replicas_seen),
+        "lat_ok": lat_ok, "lat_all": lat_all}), flush=True)
+    return 0
+
+
+def _spawn_replicas(table_path: str, n: int, timeout: float = 120.0):
+    """Start n replica subprocesses; returns (procs, {id: address})."""
+    procs = []
+    addrs = {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for i in range(n):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "benchmarks.serve_bench",
+             "--replica-serve", table_path, str(i)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+    deadline = time.time() + timeout
+    for p in procs:
+        line = p.stdout.readline().strip()
+        if not line.startswith("ADDR ") or time.time() > deadline:
+            _stop_replicas(procs)
+            raise RuntimeError(f"replica failed to start: {line!r}")
+        _tag, rid, addr = line.split(" ", 2)
+        addrs[int(rid)] = addr
+    return procs, addrs
+
+
+def _stop_replicas(procs):
+    for p in procs:
+        try:
+            p.stdin.close()        # EOF = shutdown request
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _replica_stats(addr: str) -> dict:
+    import urllib.request
+    with urllib.request.urlopen(addr + "/stats", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def measure_replicated(rows: int = ROWS, clients: int = CLIENTS,
+                       seconds: float = SECONDS,
+                       replicas: int = REPLICAS,
+                       client_procs: int = CLIENT_PROCS,
+                       emit=_emit) -> dict:
+    """The PR-13 acceptance rig: replica subprocesses behind a
+    consistent-hash router, client subprocesses following /topology,
+    labeled client/obs latency series, and sampled row identity vs
+    the merged-scan oracle."""
+    from paimon_tpu.service import KvQueryClient
+    from paimon_tpu.service.router import ReplicaRouter
+    from paimon_tpu.table import FileStoreTable
+
+    client_procs = max(1, min(client_procs, clients))
+    per_proc = max(1, clients // client_procs)
+    out = {"rows": rows, "clients": client_procs * per_proc,
+           "client_procs": client_procs, "replicas": replicas}
+    with tempfile.TemporaryDirectory() as tmp:
+        table = build_serving_table(os.path.join(tmp, "t"), rows)
+        # the oracle BEFORE serving starts: merged-scan truth
+        oracle_t = table.to_arrow().sort_by("id")
+        oracle = {i: (v, n) for i, v, n in zip(
+            oracle_t.column("id").to_pylist(),
+            oracle_t.column("v").to_pylist(),
+            oracle_t.column("name").to_pylist())}
+        procs, addrs = _spawn_replicas(table.path, replicas)
+        router = None
+        try:
+            router = ReplicaRouter(addresses=addrs,
+                                   table_name="t").start()
+            # warm EVERY replica directly (each process builds its own
+            # plan + per-file SSTs; an unwarmed replica would serve
+            # its cold builds from inside the measured window)
+            rng = np.random.default_rng(5)
+            warm_keys = [{"id": int(k)}
+                         for k in rng.integers(0, rows, 2048)]
+            for addr in addrs.values():
+                with KvQueryClient(address=addr,
+                                   follow_topology=False) as warm:
+                    for i in range(0, len(warm_keys), 256):
+                        warm.lookup(warm_keys[i:i + 256])
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            t0 = time.perf_counter()
+            cprocs = [subprocess.Popen(
+                [sys.executable, "-m", "benchmarks.serve_bench",
+                 "--client-load", router.address, str(seconds),
+                 str(rows), str(per_proc), str(i)],
+                stdout=subprocess.PIPE, text=True, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+                for i in range(client_procs)]
+            results = []
+            for p in cprocs:
+                stdout, _ = p.communicate(timeout=seconds + 300)
+                results.append(json.loads(
+                    stdout.strip().splitlines()[-1]))
+            elapsed = time.perf_counter() - t0
+            errors = [e for r in results for e in r["errors"]]
+            if errors:
+                raise AssertionError(
+                    f"replicated clients failed: {errors[:3]}")
+            lookups = sum(r["lookup"] for r in results)
+            scans = sum(r["scan"] for r in results)
+            busy = sum(r["busy"] for r in results)
+            lat_ok = sorted(x for r in results for x in r["lat_ok"])
+            lat_all = sorted(x for r in results for x in r["lat_all"])
+            # client-process elapsed (the workload window), not the
+            # parent's spawn-to-join time
+            window = max(r["elapsed_s"] for r in results)
+
+            def pct(vals, p):
+                if not vals:
+                    return 0.0
+                return vals[min(len(vals) - 1,
+                                int(p / 100 * len(vals)))]
+
+            replicas_seen = sorted(
+                {x for r in results for x in r["replicas_seen"]})
+            # obs plane: per-replica service histograms via /stats;
+            # the fleet number is the POOLED percentile over the
+            # replicas' trailing sample windows (per-replica p95s
+            # cannot be merged), with the max kept as the straggler
+            # view
+            per_replica = {}
+            obs_p95s, obs_p99s = [], []
+            pooled = []
+            for rid, addr in sorted(addrs.items()):
+                st = _replica_stats(addr)
+                lm = dict(st["lookup_ms"])
+                pooled.extend(lm.pop("window", []))
+                per_replica[str(rid)] = lm | {
+                    "snapshot_id": st["snapshot_id"]}
+                if lm["count"]:
+                    obs_p95s.append(lm["p95"])
+                    obs_p99s.append(lm["p99"])
+            pooled.sort()
+            # row identity vs the oracle THROUGH the router, sampled
+            # across tenants (and therefore replicas)
+            checked = 0
+            for tenant_i in range(8):
+                with KvQueryClient(address=router.address,
+                                   tenant=f"check-{tenant_i}") as c:
+                    ids = [int(k) for k in rng.integers(0, rows, 32)]
+                    got = c.lookup([{"id": i} for i in ids])
+                    for i, row in zip(ids, got):
+                        exp = oracle.get(i)
+                        if exp is None:
+                            assert row is None, (i, row)
+                        else:
+                            assert row is not None and \
+                                (row["v"], row["name"]) == exp, \
+                                (i, row, exp)
+                            checked += 1
+            out.update({
+                "elapsed_s": round(elapsed, 3),
+                "window_s": round(window, 3),
+                "qps": round((lookups + scans) / window, 1),
+                "lookup_qps": round(lookups / window, 1),
+                "scan_qps": round(scans / window, 1),
+                "busy_429": busy,
+                "client_ok_p50_ms": round(pct(lat_ok, 50), 4),
+                "client_ok_p95_ms": round(pct(lat_ok, 95), 4),
+                "client_ok_p99_ms": round(pct(lat_ok, 99), 4),
+                "client_all_p50_ms": round(pct(lat_all, 50), 4),
+                "client_all_p95_ms": round(pct(lat_all, 95), 4),
+                "client_all_p99_ms": round(pct(lat_all, 99), 4),
+                "obs_lookup_p95_ms": round(pct(pooled, 95), 4),
+                "obs_lookup_p99_ms": round(pct(pooled, 99), 4),
+                "obs_lookup_p95_ms_max": round(max(obs_p95s), 4)
+                if obs_p95s else 0.0,
+                "obs_lookup_p99_ms_max": round(max(obs_p99s), 4)
+                if obs_p99s else 0.0,
+                "per_replica": per_replica,
+                "replicas_seen": replicas_seen,
+                "oracle_rows_checked": checked,
+                "latency_series": ("client_ok = successful lookups "
+                                   "only; client_all also times "
+                                   "429-ended requests; obs = "
+                                   "server-side histograms (max "
+                                   "across replicas) — compare "
+                                   "client_ok vs obs"),
+            })
+        finally:
+            if router is not None:
+                router.stop()
+            _stop_replicas(procs)
+    if emit is not None:
+        emit({"benchmark": "serving_replicated_qps",
+              "value": out["qps"], "unit": "requests/s",
+              "rows": rows, "replicas": replicas,
+              "clients": out["clients"],
+              "lookup_qps": out["lookup_qps"],
+              "scan_qps": out["scan_qps"],
+              "busy_429": out["busy_429"],
+              "replicas_seen": out["replicas_seen"]})
+        emit({"benchmark": "serving_replicated_point_lookup_p95_ms",
+              "value": out["client_ok_p95_ms"], "unit": "ms",
+              "client_ok_p99": out["client_ok_p99_ms"],
+              "client_all_p95": out["client_all_p95_ms"],
+              "obs_p95": out["obs_lookup_p95_ms"],
+              "obs_p99": out["obs_lookup_p99_ms"],
+              "obs_p95_max": out["obs_lookup_p95_ms_max"],
+              "obs_p99_max": out["obs_lookup_p99_ms_max"],
+              "replicas": replicas,
+              "oracle_rows_checked": out["oracle_rows_checked"]})
+    return out
+
+
 def main(argv):
+    if argv and argv[0] == "--replica-serve":
+        return replica_child_main(argv[1], int(argv[2]))
+    if argv and argv[0] == "--client-load":
+        return client_child_main(argv[1], float(argv[2]),
+                                 int(argv[3]), int(argv[4]),
+                                 int(argv[5]))
     measure_serving()
+    if REPLICAS > 1:
+        measure_replicated()
     return 0
 
 

@@ -152,7 +152,15 @@ class _BucketWriter:
         self.kind_buffers.append(kinds)
         # sequence numbers are reserved HERE, on the single-threaded
         # caller, never inside a pooled flush task
-        self.seq_buffers.append(self._assign_seq(table.num_rows))
+        seqs = self._assign_seq(table.num_rows)
+        self.seq_buffers.append(seqs)
+        if self.parent.delta_listener is not None:
+            # serving-plane hot delta tier (service/delta.py): the
+            # batch becomes point-lookup-visible the moment it is
+            # buffered — AFTER sequence reservation, so delta
+            # newest-wins order is exactly flush order
+            self.parent.delta_listener(self.partition, self.bucket,
+                                       table, kinds, seqs)
         self.buffered_bytes += table.nbytes
         opts = self.parent.options
         if self.parent.spillable:
@@ -679,6 +687,10 @@ class KeyValueFileStoreWrite:
             nullable=[rt.get_field(k).type.nullable
                       for k in table_schema.trimmed_primary_keys()])
         self._writers: Dict[Tuple, _BucketWriter] = {}
+        # serving-plane hook (service/delta.py ServingWriter): called
+        # with (partition, bucket, table, kinds, seqs) for every
+        # buffered batch, on the single-threaded caller
+        self.delta_listener = None
         self._flush_pool = None       # lazily built (write_pipeline)
         # bounded dispatch lookahead: batch N+1's hash/group-by/take
         # runs on a prep worker while batch N routes (seq reservation
@@ -817,12 +829,16 @@ class KeyValueFileStoreWrite:
     def _prep_executor(self):
         """Lookahead pool (up to 4 workers, bounded by the flush
         parallelism); None (inline) on the serial path so
-        write.flush.parallelism=1 stays byte-for-byte legacy."""
+        write.flush.parallelism=1 stays byte-for-byte legacy.  Also
+        None with a delta listener attached: the serving plane's
+        visibility contract is 'readable when write() returns', which
+        requires synchronous in-order routing — deferred prep would
+        publish the batch to the delta tier whole batches late."""
         from paimon_tpu.parallel.write_pipeline import (
             resolve_flush_parallelism,
         )
         par = resolve_flush_parallelism(self.options)
-        if par <= 1:
+        if par <= 1 or self.delta_listener is not None:
             return None
         if self._prep_pool is None:
             from paimon_tpu.parallel.executors import new_thread_pool

@@ -69,10 +69,19 @@ _UNLOADED = object()          # sentinel: no plan loaded yet
 class LocalTableQuery:
     def __init__(self, table, cache_dir: Optional[str] = None,
                  max_memory_bytes: Optional[int] = None,
-                 refresh_interval_ms: int = 0, clock=None):
+                 refresh_interval_ms: int = 0, clock=None,
+                 delta=None):
         if not table.primary_keys:
             raise ValueError("LocalTableQuery requires a primary-key table")
         self.table = table
+        # hot delta tier (service/delta.py): unflushed serving-writer
+        # rows probed BEFORE the LSM walk — a delta hit (or tombstone)
+        # short-circuits, a miss falls through.  Registered as a
+        # reader: sealed generations retire only once OUR plan covers
+        # them too
+        self._delta = delta
+        if delta is not None:
+            delta.register_reader(self)
         self.options = table.options
         self.pk = table.schema.trimmed_primary_keys()
         rt = table.schema.logical_row_type()
@@ -121,15 +130,16 @@ class LocalTableQuery:
             table.file_io, table.path, table.schema, table.options,
             table.schema_manager)
         from paimon_tpu.metrics import (
-            LOOKUP_FILES_PRUNED, LOOKUP_READER_BUILDS,
-            LOOKUP_READER_REUSES, LOOKUP_SNAPSHOT_REFRESHES,
-            global_registry,
+            LOOKUP_DELTA_HITS, LOOKUP_FILES_PRUNED,
+            LOOKUP_READER_BUILDS, LOOKUP_READER_REUSES,
+            LOOKUP_SNAPSHOT_REFRESHES, global_registry,
         )
         g = global_registry().lookup_metrics()
         self._m_refreshes = g.counter(LOOKUP_SNAPSHOT_REFRESHES)
         self._m_builds = g.counter(LOOKUP_READER_BUILDS)
         self._m_reuses = g.counter(LOOKUP_READER_REUSES)
         self._m_pruned = g.counter(LOOKUP_FILES_PRUNED)
+        self._m_delta_hits = g.counter(LOOKUP_DELTA_HITS)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -148,6 +158,8 @@ class LocalTableQuery:
         gets an error from its rebuild instead of republishing SST
         files into the just-cleaned directory."""
         with self._lock:
+            if self._delta is not None:
+                self._delta.unregister_reader(self)
             self.store.drop_all(close=True)
             self._splits = {}
             self._file_ranges = {}
@@ -236,6 +248,10 @@ class LocalTableQuery:
         self._file_ranges = {k: v for k, v in self._file_ranges.items()
                              if k in live_files}
         self._splits = new_splits
+        if self._delta is not None:
+            # our plan now covers everything at/below this snapshot:
+            # sealed delta generations retire once EVERY reader says so
+            self._delta.reader_advanced(self, plan.snapshot_id)
         self._m_refreshes.inc()
 
     # -- keys ----------------------------------------------------------------
@@ -399,7 +415,14 @@ class LocalTableQuery:
         batch resolves against ONE captured plan (no torn batches
         across a concurrent snapshot refresh); only the plan check
         itself takes the instance lock — reads, SST builds and probes
-        run concurrently across serving threads."""
+        run concurrently across serving threads.
+
+        With a delta tier attached, every key probes the captured
+        delta view FIRST: a hit (the newest unflushed write) or a
+        tombstone answers without touching the LSM; misses fall
+        through to the SST walk.  The view is captured BEFORE the plan
+        (service/delta.py explains why that order is load-bearing)."""
+        view = self._delta.view() if self._delta is not None else None
         splits, snap = self._check_snapshot()
         if not keys:
             return []
@@ -411,11 +434,35 @@ class LocalTableQuery:
         buckets = self.assigner.assign(query)
         out: List[Optional[dict]] = [None] * len(keys)
         pkey = self._pkey(self._norm_partition(partition))
+        in_delta = np.zeros(len(keys), dtype=bool)
+        if view is not None and not view.empty and view.touches(
+                pkey, {int(b) for b in np.unique(buckets)}):
+            # arrow-normalized key tuples (same normalization the
+            # write side's to_pylist applied); the touches() gate
+            # above keeps batches whose buckets hold no delta rows on
+            # the pure vectorized path
+            norm = query.to_pylist()
+            for i, d in enumerate(norm):
+                kt = tuple(d[k] for k in self.pk)
+                hit = view.probe(pkey, int(buckets[i]), kt)
+                if not view.is_miss(hit):
+                    # hit row or tombstone (None): the newest write
+                    # for this key — the LSM cannot hold anything
+                    # newer under the single-serving-writer contract
+                    out[i] = dict(hit) if hit is not None else None
+                    in_delta[i] = True
+            hits = int(in_delta.sum())
+            if hits:
+                self._m_delta_hits.inc(hits)
+            if in_delta.all():
+                return out
         for b in np.unique(buckets):
             split = splits.get((pkey, int(b)))
             if split is None:
                 continue         # empty bucket: all misses
-            sel = np.flatnonzero(buckets == b)
+            sel = np.flatnonzero((buckets == b) & ~in_delta)
+            if not len(sel):
+                continue         # whole bucket answered by the delta
             if self._fast_path_ok(split):
                 self._lookup_runs(pkey, split, query, sel, keys, out)
             else:

@@ -40,9 +40,15 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "SERVICE_TENANT_BYTES", "SERVICE_ADMISSION_WAIT_MS",
            "SERVICE_LOOKUP_MS", "SERVICE_SCAN_MS",
            "SERVICE_CHANGELOG_MS", "SERVICE_LOOKUP_KEYS",
+           "SERVICE_LOOP_LAG_MS", "SERVICE_CONNECTIONS",
+           "SERVICE_DELTA_ROWS", "SERVICE_DELTA_BYTES",
+           "SERVICE_DELTA_OVERFLOWS", "SERVICE_ROUTER_FORWARDED",
+           "SERVICE_ROUTER_UPSTREAM_ERRORS",
+           "SERVICE_SCAN_CACHE_HITS", "SERVICE_SCAN_CACHE_MISSES",
            "LOOKUP_BLOCK_CACHE_HITS", "LOOKUP_BLOCK_CACHE_MISSES",
            "LOOKUP_READER_BUILDS", "LOOKUP_READER_REUSES",
            "LOOKUP_FILES_PRUNED", "LOOKUP_SNAPSHOT_REFRESHES",
+           "LOOKUP_DELTA_HITS",
            "CACHE_DISK_HITS", "CACHE_DISK_MISSES",
            "CACHE_DISK_PROMOTIONS", "CACHE_DISK_DEMOTIONS",
            "CACHE_DISK_EVICTIONS", "CACHE_DISK_BYTES",
@@ -142,6 +148,25 @@ SERVICE_SCAN_MS = "scan_ms"                   # whole /scan request
 SERVICE_CHANGELOG_MS = "changelog_ms"         # whole /changelog poll
 SERVICE_LOOKUP_KEYS = "lookup_keys"           # point-get keys served
 
+# event-loop serving engine + hot delta tier + replica router names
+# (same service metric group; producers are service/async_server.py,
+# service/delta.py and service/router.py).  loop_lag_ms is THE health
+# canary of the event-loop engine: how long a finished response waited
+# before the loop picked it up — a starved loop is late at accepting,
+# reading and writing all at once.  delta_rows/delta_bytes gauge the
+# in-memory delta tier (unflushed serving-writer rows merged into
+# point lookups); delta_overflow counts writes that pushed the tier
+# past service.delta.max-bytes (the "commit now" signal).
+SERVICE_LOOP_LAG_MS = "loop_lag_ms"           # response ready -> flushed
+SERVICE_CONNECTIONS = "connections"           # gauge: open sockets now
+SERVICE_DELTA_ROWS = "delta_rows"             # gauge: delta-tier rows
+SERVICE_DELTA_BYTES = "delta_bytes"           # gauge: delta-tier bytes
+SERVICE_DELTA_OVERFLOWS = "delta_overflow"    # writes past max-bytes
+SERVICE_ROUTER_FORWARDED = "router_forwarded"     # proxied requests
+SERVICE_ROUTER_UPSTREAM_ERRORS = "router_upstream_errors"
+SERVICE_SCAN_CACHE_HITS = "scan_cache_hits"       # snapshot-keyed
+SERVICE_SCAN_CACHE_MISSES = "scan_cache_misses"   # result cache
+
 # point-lookup-plane counter names (lookup metric group; producers in
 # lookup/sst.py + lookup/local_query.py).  block_cache_* watch the
 # pinned SST block cache; files_pruned counts data files skipped by
@@ -152,6 +177,7 @@ LOOKUP_READER_BUILDS = "reader_builds"        # SSTs built (file reads)
 LOOKUP_READER_REUSES = "reader_reuses"        # SSTs served warm
 LOOKUP_FILES_PRUNED = "files_pruned"          # skipped by stats, no IO
 LOOKUP_SNAPSHOT_REFRESHES = "snapshot_refreshes"  # plan reloads
+LOOKUP_DELTA_HITS = "delta_hits"              # keys answered by delta
 
 # tiered host-SSD storage counter/gauge/histogram names (cache_disk
 # metric group; producers in fs/caching.py DiskCacheTier + the
@@ -302,6 +328,14 @@ class Histogram:
             vals = sorted(self._values)
             i = min(len(vals) - 1, int(p / 100 * len(vals)))
             return vals[i]
+
+    def window_values(self) -> List[float]:
+        """The trailing sample window as a list (fleet aggregation:
+        pooling several instances' windows gives a TRUE pooled
+        percentile, which no combination of per-instance percentiles
+        can)."""
+        with self._lock:
+            return list(self._values)
 
     @property
     def mean(self) -> float:

@@ -831,6 +831,45 @@ class CoreOptions:
         "service.lookup.key-bytes-estimate", int, 4096,
         "Estimated serving-cost bytes per point-get key for admission "
         "control (roughly one SST block read per cold key)")
+    SERVICE_WORKERS = ConfigOption(
+        "service.workers", int, 16,
+        "Handler threads behind the event-loop request engine "
+        "(service/async_server.py): request bodies execute on this "
+        "bounded pool while the single loop thread owns every socket "
+        "— concurrent connections cost file descriptors, not threads")
+    SERVICE_MAX_CONNECTIONS = ConfigOption(
+        "service.max-connections", int, 1024,
+        "Bound on concurrently open client connections per server; "
+        "accepts past it answer HTTP 503 and close immediately (file "
+        "descriptors are the budgeted resource of the event-loop "
+        "engine, and even those are bounded)")
+    SERVICE_REPLICAS = ConfigOption(
+        "service.replicas", int, 1,
+        "Read replicas started by ReplicaSet (service/router.py): N "
+        "query servers over one table — sharing the process-wide "
+        "byte-cache tier and the host-SSD tier — fronted by a router "
+        "that consistent-hashes tenants across them; 1 = the classic "
+        "single-server plane, no router")
+    SERVICE_REPLICA_VNODES = ConfigOption(
+        "service.replicas.virtual-nodes", int, 64,
+        "Virtual nodes per replica on the router's consistent-hash "
+        "ring: more vnodes = smoother tenant spread and smaller "
+        "reassignment when the replica count changes")
+    SERVICE_DELTA_ENABLED = ConfigOption(
+        "service.delta.enabled", _parse_bool, True,
+        "Serve point lookups from the hot in-memory delta tier "
+        "(service/delta.py): rows written through a serving writer "
+        "are readable in microseconds — before any flush or commit — "
+        "merged newest-first over the LSM with the same tombstone "
+        "semantics; requires deduplicate merge semantics (no "
+        "sequence.field / record-level expire)")
+    SERVICE_DELTA_MAX_BYTES = ConfigOption(
+        "service.delta.max-bytes", parse_memory_size, 256 << 20,
+        "Soft bound on the delta tier's resident bytes: crossing it "
+        "counts delta_overflow and is the signal to commit (sealed "
+        "generations are pruned as soon as every attached reader's "
+        "plan covers them; uncommitted rows are never dropped — "
+        "dropping them would un-publish an acknowledged write)")
 
     # -- scan / read (reference CoreOptions.java:1416,2120-2200) -------------
     SCAN_PLAN_SORT_PARTITION = ConfigOption(

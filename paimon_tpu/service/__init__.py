@@ -10,8 +10,14 @@ the capability, not the wire bytes.
 from paimon_tpu.service.admission import (  # noqa: F401
     AdmissionController, AdmissionRejected,
 )
+from paimon_tpu.service.delta import (  # noqa: F401
+    DeltaTier, ServingWriter,
+)
 from paimon_tpu.service.query_service import (  # noqa: F401
     KvQueryClient, KvQueryServer, ServiceBusyError, ServiceManager,
+)
+from paimon_tpu.service.router import (  # noqa: F401
+    ReplicaRouter, ReplicaSet,
 )
 from paimon_tpu.service.stream_daemon import (  # noqa: F401
     StreamDaemon, checkpoint_once, recover_checkpoint,

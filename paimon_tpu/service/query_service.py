@@ -24,6 +24,26 @@ Serving plane (PR 7): the server is MULTI-TENANT and cross-request —
 * connections are KEEP-ALIVE (HTTP/1.1): KvQueryClient holds one
   persistent connection and reconnects on stale sockets — connection
   setup no longer dominates sub-ms point gets.
+
+Web-scale serving plane (PR 13) — this server now rides the
+EVENT-LOOP request engine (service/async_server.py, reference Paimon's
+Netty KvQueryServer): one loop thread owns every socket, handlers run
+on a bounded `service.workers` pool, pipelined HTTP/1.1 keep-alive
+requests parse and answer in order, and 1k+ concurrent connections
+cost file descriptors instead of OS threads.  Every answer carries an
+`X-Replica-Id` debug header; /healthz reports the replica id, the
+pinned snapshot, the delta tier's size and the event-loop lag.  Two
+companions complete the plane:
+
+* HORIZONTAL READ REPLICAS (service/router.py): N servers over one
+  table — sharing the process byte-cache + SSD tiers — behind a
+  consistent-hash router; `KvQueryClient` follows the router's
+  /topology to talk to the owning replica directly;
+* the HOT DELTA TIER (service/delta.py): a serving writer's unflushed
+  rows merge into every /lookup newest-first (same tombstone/sequence
+  semantics as the SST walk), so a freshly written key is readable in
+  microseconds — before any flush or commit — and generations retire
+  only once every replica's plan covers them.
 """
 
 from __future__ import annotations
@@ -31,13 +51,15 @@ from __future__ import annotations
 import http.client
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from paimon_tpu.lookup import LocalTableQuery
 from paimon_tpu.options import CoreOptions
 from paimon_tpu.service.admission import (
     AdmissionController, AdmissionRejected,
+)
+from paimon_tpu.service.async_server import (
+    AsyncHttpServer, HttpRequest, HttpResponse,
 )
 
 
@@ -126,12 +148,25 @@ class ServiceManager:
 
 
 class KvQueryServer:
-    def __init__(self, table, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, table, host: str = "127.0.0.1", port: int = 0,
+                 replica_id: int = 0, delta=None):
         opts = table.options
         if opts.get(CoreOptions.SERVICE_CACHE_SHARED):
             table = self._join_shared_cache(table)
         self.table = table
         self.options = table.options
+        self.replica_id = int(replica_id)
+        # hot delta tier: unflushed serving-writer rows merged into
+        # every /lookup (shared process-wide by table path, so N
+        # in-process replicas and the serving writer see ONE tier)
+        if delta is None and table.primary_keys and \
+                opts.get(CoreOptions.SERVICE_DELTA_ENABLED):
+            from paimon_tpu.service.delta import (
+                delta_eligible, shared_delta_tier,
+            )
+            if delta_eligible(table):
+                delta = shared_delta_tier(table)
+        self._delta = delta
         # ONE LocalTableQuery shared by every /lookup (plan swaps
         # serialize; reads/builds/probes run concurrently across
         # handler threads).  Built lazily so non-pk tables can still
@@ -156,7 +191,9 @@ class KvQueryServer:
         from paimon_tpu.service.brownout import BrownoutController
         self.brownout = BrownoutController(self.admission, opts)
         from paimon_tpu.metrics import (
-            SERVICE_CHANGELOG_MS, SERVICE_LOOKUP_KEYS, SERVICE_LOOKUP_MS,
+            SERVICE_CHANGELOG_MS, SERVICE_CONNECTIONS,
+            SERVICE_LOOKUP_KEYS, SERVICE_LOOKUP_MS, SERVICE_LOOP_LAG_MS,
+            SERVICE_SCAN_CACHE_HITS, SERVICE_SCAN_CACHE_MISSES,
             SERVICE_SCAN_MS, global_registry,
         )
         g = global_registry().service_metrics(table.name)
@@ -164,12 +201,19 @@ class KvQueryServer:
         self._m_scan_ms = g.histogram(SERVICE_SCAN_MS)
         self._m_changelog_ms = g.histogram(SERVICE_CHANGELOG_MS)
         self._m_lookup_keys = g.counter(SERVICE_LOOKUP_KEYS)
-        handler = self._make_handler()
-        self.httpd = ThreadingHTTPServer((host, port), handler)
-        self.port = self.httpd.server_address[1]
+        # the event-loop engine (service/async_server.py): handlers
+        # run on the bounded service.workers pool; the loop thread
+        # owns every socket and pipelined keep-alive parse
+        self.server = AsyncHttpServer(
+            host, port, self._handle,
+            workers=opts.get(CoreOptions.SERVICE_WORKERS),
+            max_connections=opts.get(CoreOptions.SERVICE_MAX_CONNECTIONS),
+            name=f"paimon-serve-r{self.replica_id}",
+            lag_histogram=g.histogram(SERVICE_LOOP_LAG_MS),
+            connections_gauge=g.gauge(SERVICE_CONNECTIONS))
+        self.port = self.server.port
         self.address = f"http://{host}:{self.port}"
         self.services = ServiceManager(table.file_io, table.path)
-        self._thread: Optional[threading.Thread] = None
         # per-consumer streaming changelog scans (/changelog): each
         # consumer id owns a DataTableStreamScan whose position only
         # advances when that consumer polls, plus a pending-rows
@@ -184,6 +228,22 @@ class KvQueryServer:
         self._streams_lock = threading.Lock()
         self.max_changelog_consumers = 256
         self.changelog_max_rows = 10_000
+        # snapshot-keyed scan result cache: a bounded /scan is a PURE
+        # function of (snapshot, limit, projection) — the same request
+        # against the same snapshot merges the same runs to the same
+        # rows, so serving plane scans pay the merge once per
+        # snapshot, not once per request.  A commit changes the
+        # snapshot id and therefore the key; LRU-bounded.  Disabled
+        # under record-level expire: row visibility there changes
+        # with the CLOCK, not the snapshot id, so the key would lie
+        self._scan_cache = OrderedDict()
+        self._scan_cache_lock = threading.Lock()
+        self.max_scan_cache_entries = 64
+        self._scan_cache_enabled = \
+            not opts.record_level_expire_time_ms
+        self._m_scan_cache_hits = g.counter(SERVICE_SCAN_CACHE_HITS)
+        self._m_scan_cache_misses = g.counter(
+            SERVICE_SCAN_CACHE_MISSES)
 
     @staticmethod
     def _join_shared_cache(table):
@@ -228,20 +288,40 @@ class KvQueryServer:
                 self._query = LocalTableQuery(
                     self.table,
                     refresh_interval_ms=self.options.get(
-                        CoreOptions.SERVICE_LOOKUP_REFRESH_INTERVAL))
+                        CoreOptions.SERVICE_LOOKUP_REFRESH_INTERVAL),
+                    delta=self._delta)
             return self._query
 
+    def new_serving_writer(self, commit_user: Optional[str] = None):
+        """A writer whose rows are readable via /lookup IMMEDIATELY —
+        before any flush or commit — through the hot delta tier
+        (service/delta.py).  One serving writer per table: delta
+        visibility assumes its per-bucket sequence numbers are the
+        newest in flight."""
+        if self._delta is None:
+            from paimon_tpu.service.delta import delta_ineligible_reason
+            raise ValueError(
+                "delta tier unavailable: "
+                + (delta_ineligible_reason(self.table)
+                   or "service.delta.enabled=false"))
+        from paimon_tpu.service.delta import ServingWriter
+        return ServingWriter(self.table, self._delta,
+                             commit_user=commit_user)
+
     def start(self) -> "KvQueryServer":
-        from paimon_tpu.parallel.executors import spawn_thread
-        self._thread = spawn_thread(self.httpd.serve_forever,
-                                    name="paimon-query-server")
+        self.server.start()
         self.services.register(PRIMARY_KEY_LOOKUP, self.address)
         return self
 
     def stop(self):
         self.services.unregister(PRIMARY_KEY_LOOKUP)
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        self.shutdown()
+
+    def shutdown(self):
+        """Teardown minus the service-registry unregister (ReplicaSet
+        replicas never registered — the router did): stop the engine,
+        restore the process-wide degraded switch, drop lookup state."""
+        self.server.stop()
         # the process-wide degraded switch must not outlive the server
         self.brownout.reset()
         with self._query_lock:
@@ -249,263 +329,298 @@ class KvQueryServer:
                 self._query.close()
                 self._query = None
 
-    def _make_handler(self):
-        server = self
+    # -- request dispatch (runs on the engine's worker pool) -----------------
 
-        class Handler(BaseHTTPRequestHandler):
-            # keep-alive: one client connection serves many requests
-            # (Content-Length is set on every response below)
-            protocol_version = "HTTP/1.1"
+    def _json_response(self, status: int, obj,
+                       headers: Optional[dict] = None) -> HttpResponse:
+        hdrs = {"X-Replica-Id": str(self.replica_id)}
+        if headers:
+            hdrs.update(headers)
+        return HttpResponse(status, json.dumps(obj).encode(),
+                            headers=hdrs)
 
-            def log_message(self, *a):
-                pass
+    def _handle(self, req: HttpRequest) -> HttpResponse:
+        if req.method == "GET":
+            return self._handle_get(req)
+        if req.method == "POST":
+            return self._handle_post(req)
+        return self._json_response(405, {"error": "method not allowed"})
 
-            def do_GET(self):
-                """Prometheus scrape endpoint: the whole process
-                registry (scan/write/compaction/commit/service groups +
-                stage latency histograms) in text exposition 0.0.4,
-                rendered from MetricRegistry.snapshot_rows — the same
-                serialization the $metrics system table queries."""
-                if self.path == "/healthz":
-                    # tail-tolerance introspection: brownout rung,
-                    # breaker states, queue pressure, recent 429/504
-                    # rates — the operator's one-glance view of HOW
-                    # degraded the plane currently is
-                    try:
-                        server.brownout.observe()
-                        body = json.dumps(
-                            server.brownout.healthz()).encode()
-                        status = 200
-                    except Exception as e:      # noqa: BLE001
-                        body = json.dumps({"error": str(e)}).encode()
-                        status = 500
-                    self.send_response(status)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                if self.path != "/metrics":
-                    self.send_error(404)
-                    return
-                try:
-                    from paimon_tpu.obs.export import render_prometheus
-                    body = render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8")
-                except Exception as e:      # noqa: BLE001
-                    body = str(e).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Type", "text/plain")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    def _handle_get(self, req: HttpRequest) -> HttpResponse:
+        """GET /metrics (Prometheus text exposition of the whole
+        process registry, rendered from MetricRegistry.snapshot_rows —
+        the same serialization the $metrics system table queries),
+        GET /healthz (brownout + engine + delta introspection) and
+        GET /stats (per-replica obs summary as JSON — what the router
+        aggregates)."""
+        if req.path == "/healthz":
+            # tail-tolerance introspection: brownout rung, breaker
+            # states, queue pressure, recent 429/504 rates — plus the
+            # replica id, pinned snapshot, delta-tier size and
+            # event-loop lag: the operator's one-glance view of HOW
+            # degraded the plane currently is and WHO answered
+            try:
+                self.brownout.observe()
+                return self._json_response(200, self.healthz())
+            except Exception as e:      # noqa: BLE001
+                return self._json_response(500, {"error": str(e)})
+        if req.path == "/stats":
+            try:
+                return self._json_response(200, self.stats())
+            except Exception as e:      # noqa: BLE001
+                return self._json_response(500, {"error": str(e)})
+        if req.path != "/metrics":
+            return self._json_response(404, {"error": "not found"})
+        try:
+            from paimon_tpu.obs.export import render_prometheus
+            return HttpResponse(
+                200, render_prometheus().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+                headers={"X-Replica-Id": str(self.replica_id)})
+        except Exception as e:      # noqa: BLE001
+            return HttpResponse(500, str(e).encode(),
+                                content_type="text/plain")
 
-            def do_POST(self):
-                if self.path == "/lookup":
-                    handle, timer = self._lookup, server._m_lookup_ms
-                elif self.path == "/scan":
-                    handle, timer = self._scan, server._m_scan_ms
-                elif self.path == "/changelog":
-                    handle, timer = \
-                        self._changelog, server._m_changelog_ms
-                else:
-                    self.send_error(404)
-                    return
-                n = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(n))
-                import time as _time
+    def healthz(self) -> dict:
+        """The /healthz body: the brownout controller's view plus the
+        serving-engine vitals this replica owns."""
+        body = self.brownout.healthz()
+        with self._query_lock:
+            snap = self._query.snapshot_id \
+                if self._query is not None else None
+        body.update({
+            "replica_id": self.replica_id,
+            "snapshot_id": snap,
+            "delta": None if self._delta is None
+            else self._delta.stats(),
+            "event_loop": {
+                "recent_lag_ms": round(self.server.recent_lag_ms, 3),
+                "connections": self.server.connection_count,
+            },
+        })
+        return body
 
-                from paimon_tpu.utils.deadline import (
-                    DeadlineExceededError, deadline_scope,
-                )
-                # end-to-end deadline: client-supplied per request
-                # (body 'timeout_ms' or X-Request-Timeout-Ms header)
-                # else service.request.timeout; every blocking wait
-                # downstream (admission queue, prefetch byte budget,
-                # retry sleeps, store IO) honors it
-                timeout_ms = req.get("timeout_ms")
-                if timeout_ms is None:
-                    timeout_ms = self.headers.get(
-                        "X-Request-Timeout-Ms")
-                if timeout_ms is None:
-                    timeout_ms = server._request_timeout
-                # NOTE explicit None checks, not `or`: timeout_ms=0
-                # is a real (already-expired) deadline the caller
-                # asked for, not an absent one
-                if timeout_ms is not None:
-                    try:
-                        timeout_ms = float(timeout_ms)
-                    except (TypeError, ValueError):
-                        # malformed CLIENT input is a 400, not a 500
-                        body = json.dumps(
-                            {"error": f"invalid timeout_ms: "
-                                      f"{timeout_ms!r}"}).encode()
-                        self.send_response(400)
-                        self.send_header("Content-Type",
-                                         "application/json")
-                        self.send_header("Content-Length",
-                                         str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return
-                server.brownout.observe()
-                t0 = _time.perf_counter()
-                try:
-                    with deadline_scope(timeout_ms):
-                        body = json.dumps(handle(req)).encode()
-                    status = 200
-                except DeadlineExceededError as e:
-                    # the request's budget is spent: in-flight work
-                    # for it was cancelled/abandoned downstream; tell
-                    # the caller the truth with a 504
-                    body = json.dumps({"error": str(e),
-                                       "deadline": True}).encode()
-                    status = 504
-                except AdmissionRejected as e:
-                    body = json.dumps({"error": str(e),
-                                       "busy": True}).encode()
-                    status = 429
-                except Exception as e:      # noqa: BLE001
-                    body = json.dumps({"error": str(e)}).encode()
-                    status = 500
-                server.brownout.record_outcome(status)
-                if status not in (429, 504):
-                    # 429s spent their time in the admission queue and
-                    # 504s are deadline-bounded by construction —
-                    # admission_wait_ms / rejected / deadline_exceeded
-                    # tell those stories; folding them into the
-                    # service-time histograms would corrupt p95/p99
-                    timer.update((_time.perf_counter() - t0) * 1000.0)
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    def stats(self) -> dict:
+        """Per-replica obs-plane summary (request-latency histograms
+        as percentiles) — the router's /healthz aggregation and the
+        multi-replica bench read THIS instead of re-parsing the
+        Prometheus text."""
+        def h(hist):
+            return {"count": hist.total_count,
+                    "p50": round(hist.percentile(50), 4),
+                    "p95": round(hist.percentile(95), 4),
+                    "p99": round(hist.percentile(99), 4),
+                    # trailing window samples: the router/bench pool
+                    # these across replicas for a TRUE fleet
+                    # percentile (per-replica p95s cannot be merged)
+                    "window": [round(v, 4)
+                               for v in hist.window_values()]}
+        with self._query_lock:
+            snap = self._query.snapshot_id \
+                if self._query is not None else None
+        return {"replica_id": self.replica_id,
+                "snapshot_id": snap,
+                "lookup_ms": h(self._m_lookup_ms),
+                "scan_ms": h(self._m_scan_ms),
+                "lookup_keys": self._m_lookup_keys.count,
+                "delta": None if self._delta is None
+                else self._delta.stats()}
 
-            @staticmethod
-            def _tenant(req) -> str:
-                return str(req.get("tenant") or "default")
+    def _handle_post(self, req: HttpRequest) -> HttpResponse:
+        if req.path == "/lookup":
+            handle, timer = self._lookup, self._m_lookup_ms
+        elif req.path == "/scan":
+            handle, timer = self._scan, self._m_scan_ms
+        elif req.path == "/changelog":
+            handle, timer = self._changelog, self._m_changelog_ms
+        else:
+            return self._json_response(404, {"error": "not found"})
+        try:
+            body = json.loads(req.body or b"{}")
+        except ValueError:
+            return self._json_response(400, {"error": "invalid JSON"})
+        import time as _time
 
-            @staticmethod
-            def _priority(req) -> int:
-                from paimon_tpu.service.admission import DEFAULT_PRIORITY
-                try:
-                    return int(req.get("priority", DEFAULT_PRIORITY))
-                except (TypeError, ValueError):
-                    return DEFAULT_PRIORITY
+        from paimon_tpu.utils.deadline import (
+            DeadlineExceededError, deadline_scope,
+        )
+        # end-to-end deadline: client-supplied per request (body
+        # 'timeout_ms' or X-Request-Timeout-Ms header) else
+        # service.request.timeout; every blocking wait downstream
+        # (admission queue, prefetch byte budget, retry sleeps, store
+        # IO) honors it
+        timeout_ms = body.get("timeout_ms")
+        if timeout_ms is None:
+            timeout_ms = req.headers.get("x-request-timeout-ms")
+        if timeout_ms is None:
+            timeout_ms = self._request_timeout
+        # NOTE explicit None checks, not `or`: timeout_ms=0 is a real
+        # (already-expired) deadline the caller asked for, not an
+        # absent one
+        if timeout_ms is not None:
+            try:
+                timeout_ms = float(timeout_ms)
+            except (TypeError, ValueError):
+                # malformed CLIENT input is a 400, not a 500
+                return self._json_response(
+                    400, {"error": f"invalid timeout_ms: "
+                                   f"{timeout_ms!r}"})
+        self.brownout.observe()
+        t0 = _time.perf_counter()
+        try:
+            with deadline_scope(timeout_ms):
+                out = handle(body)
+            status, payload = 200, out
+        except DeadlineExceededError as e:
+            # the request's budget is spent: in-flight work for it was
+            # cancelled/abandoned downstream; tell the caller the
+            # truth with a 504
+            status, payload = 504, {"error": str(e), "deadline": True}
+        except AdmissionRejected as e:
+            status, payload = 429, {"error": str(e), "busy": True}
+        except Exception as e:      # noqa: BLE001
+            status, payload = 500, {"error": str(e)}
+        self.brownout.record_outcome(status)
+        if status not in (429, 504):
+            # 429s spent their time in the admission queue and 504s
+            # are deadline-bounded by construction —
+            # admission_wait_ms / rejected / deadline_exceeded tell
+            # those stories; folding them into the service-time
+            # histograms would corrupt p95/p99
+            timer.update((_time.perf_counter() - t0) * 1000.0)
+        return self._json_response(status, payload)
 
-            def _lookup(self, req):
-                keys = req["keys"]
-                est = max(1, len(keys)) * server._lookup_key_bytes
-                with server.admission.acquire(self._tenant(req), est,
-                                              self._priority(req)):
-                    rows = server.query().lookup(
-                        [{k: _decode_value(v) for k, v in d.items()}
-                         for d in keys],
-                        partition=tuple(
-                            _decode_value(v)
-                            for v in req.get("partition") or ()))
-                server._m_lookup_keys.inc(len(keys))
-                return {"rows": [None if r is None else
-                                 {k: _encode_value(x)
-                                  for k, x in r.items()}
-                                 for r in rows]}
+    @staticmethod
+    def _tenant(req) -> str:
+        return str(req.get("tenant") or "default")
 
-            def _changelog(self, req):
-                """Streaming changelog poll (table/stream_scan.py):
-                each consumer id resumes its own follow-up scan, so
-                repeated polls stream snapshot-by-snapshot changes with
-                row kinds (`_ROW_KIND`).  `caught_up` signals 'poll
-                again later' — the stream never ends.  Serving is
-                read-only on committed snapshots: it stays available
-                while ingest or compaction are down (the daemon's
-                degradation contract)."""
-                consumer = str(req.get("consumer") or "default")
-                limit = int(req.get("max_rows")
-                            or server.changelog_max_rows)
-                est = max(1, limit) * server._scan_row_bytes
-                with server.admission.acquire(self._tenant(req), est,
-                                              self._priority(req)), \
-                        server._streams_lock:
-                    entry = server._streams.get(consumer)
-                    if entry is None:
-                        entry = {"scan": server.table
-                                 .new_read_builder().new_stream_scan(),
-                                 "pending": [], "plan": None}
-                        server._streams[consumer] = entry
-                        while len(server._streams) > \
-                                server.max_changelog_consumers:
-                            server._streams.popitem(last=False)
-                    server._streams.move_to_end(consumer)
-                    snapshot_id = None
-                    if not entry["pending"]:
-                        # a plan may be PARKED from a prior poll whose
-                        # materialization ticket 429'd — the stream
-                        # scan has already advanced past it, so it
-                        # must be retried, never re-planned (rows
-                        # would be lost)
-                        plan = entry.get("plan") or \
-                            entry["scan"].plan()
-                        if plan is None:
-                            return {"rows": [], "snapshot_id": None,
-                                    "caught_up": True, "more": False}
-                        entry["plan"] = plan
-                        # the initial ticket only covers the poll;
-                        # materializing the snapshot delta is the real
-                        # allocation — charge its on-disk bytes before
-                        # reading (AdmissionRejected -> 429 with the
-                        # plan parked for the consumer's retry)
-                        delta = sum(f.file_size for s in plan.splits
-                                    for f in s.data_files)
-                        extra = max(0, delta - est)
-                        with server.admission.acquire(
-                                self._tenant(req), extra,
-                                self._priority(req)) \
-                                if extra else _NULLCTX:
-                            entry["pending"] = server.table \
-                                .new_read_builder().new_read() \
-                                .to_arrow(plan).to_pylist()
-                        snapshot_id = plan.snapshot_id
-                        entry["plan"] = None
-                    rows = entry["pending"][:limit]
-                    entry["pending"] = entry["pending"][limit:]
-                    more = bool(entry["pending"])
-                return {"rows": [{k: _encode_value(v)
-                                  for k, v in r.items()}
-                                 for r in rows],
-                        "snapshot_id": snapshot_id,
-                        "caught_up": False, "more": more}
+    @staticmethod
+    def _priority(req) -> int:
+        from paimon_tpu.service.admission import DEFAULT_PRIORITY
+        try:
+            return int(req.get("priority", DEFAULT_PRIORITY))
+        except (TypeError, ValueError):
+            return DEFAULT_PRIORITY
 
-            def _scan(self, req):
-                """Bounded table scan through the pipelined split
-                reader (parallel/scan_pipeline.py): splits stream
-                through the prefetch pipeline and admission stops as
-                soon as `limit` rows are buffered.  The admission
-                charge is limit x service.scan.row-bytes-estimate —
-                known BEFORE the plan, so even the manifest walk
-                (heavy fan-in on large tables) runs under the ticket,
-                never ahead of the byte budget."""
-                limit = req.get("limit")
-                limit = 10_000 if limit is None else int(limit)
-                est = max(1, limit) * server._scan_row_bytes
-                with server.admission.acquire(self._tenant(req), est,
-                                              self._priority(req)):
-                    rb = server.table.new_read_builder()
-                    if req.get("projection"):
-                        rb = rb.with_projection(
-                            list(req["projection"]))
-                    rb = rb.with_limit(limit)
-                    plan = rb.new_scan().plan()
-                    t = rb.new_read().to_arrow(plan.splits)
-                return {"rows": [{k: _encode_value(v)
-                                  for k, v in r.items()}
-                                 for r in t.to_pylist()],
-                        "snapshot_id": plan.snapshot_id}
+    def _lookup(self, req):
+        keys = req["keys"]
+        est = max(1, len(keys)) * self._lookup_key_bytes
+        with self.admission.acquire(self._tenant(req), est,
+                                    self._priority(req)):
+            rows = self.query().lookup(
+                [{k: _decode_value(v) for k, v in d.items()}
+                 for d in keys],
+                partition=tuple(_decode_value(v)
+                                for v in req.get("partition") or ()))
+        self._m_lookup_keys.inc(len(keys))
+        return {"rows": [None if r is None else
+                         {k: _encode_value(x) for k, x in r.items()}
+                         for r in rows]}
 
-        return Handler
+    def _changelog(self, req):
+        """Streaming changelog poll (table/stream_scan.py): each
+        consumer id resumes its own follow-up scan, so repeated polls
+        stream snapshot-by-snapshot changes with row kinds
+        (`_ROW_KIND`).  `caught_up` signals 'poll again later' — the
+        stream never ends.  Serving is read-only on committed
+        snapshots: it stays available while ingest or compaction are
+        down (the daemon's degradation contract)."""
+        consumer = str(req.get("consumer") or "default")
+        limit = int(req.get("max_rows") or self.changelog_max_rows)
+        est = max(1, limit) * self._scan_row_bytes
+        with self.admission.acquire(self._tenant(req), est,
+                                    self._priority(req)), \
+                self._streams_lock:
+            entry = self._streams.get(consumer)
+            if entry is None:
+                entry = {"scan": self.table
+                         .new_read_builder().new_stream_scan(),
+                         "pending": [], "plan": None}
+                self._streams[consumer] = entry
+                while len(self._streams) > \
+                        self.max_changelog_consumers:
+                    self._streams.popitem(last=False)
+            self._streams.move_to_end(consumer)
+            snapshot_id = None
+            if not entry["pending"]:
+                # a plan may be PARKED from a prior poll whose
+                # materialization ticket 429'd — the stream scan has
+                # already advanced past it, so it must be retried,
+                # never re-planned (rows would be lost)
+                plan = entry.get("plan") or entry["scan"].plan()
+                if plan is None:
+                    return {"rows": [], "snapshot_id": None,
+                            "caught_up": True, "more": False}
+                entry["plan"] = plan
+                # the initial ticket only covers the poll;
+                # materializing the snapshot delta is the real
+                # allocation — charge its on-disk bytes before reading
+                # (AdmissionRejected -> 429 with the plan parked for
+                # the consumer's retry)
+                delta = sum(f.file_size for s in plan.splits
+                            for f in s.data_files)
+                extra = max(0, delta - est)
+                with self.admission.acquire(
+                        self._tenant(req), extra,
+                        self._priority(req)) if extra else _NULLCTX:
+                    entry["pending"] = self.table \
+                        .new_read_builder().new_read() \
+                        .to_arrow(plan).to_pylist()
+                snapshot_id = plan.snapshot_id
+                entry["plan"] = None
+            rows = entry["pending"][:limit]
+            entry["pending"] = entry["pending"][limit:]
+            more = bool(entry["pending"])
+        return {"rows": [{k: _encode_value(v) for k, v in r.items()}
+                         for r in rows],
+                "snapshot_id": snapshot_id,
+                "caught_up": False, "more": more}
+
+    def _scan(self, req):
+        """Bounded table scan through the pipelined split reader
+        (parallel/scan_pipeline.py): splits stream through the
+        prefetch pipeline and admission stops as soon as `limit` rows
+        are buffered.  The admission charge is limit x
+        service.scan.row-bytes-estimate — known BEFORE the plan, so
+        even the manifest walk (heavy fan-in on large tables) runs
+        under the ticket, never ahead of the byte budget."""
+        limit = req.get("limit")
+        limit = 10_000 if limit is None else int(limit)
+        est = max(1, limit) * self._scan_row_bytes
+        projection = tuple(req.get("projection") or ())
+        with self.admission.acquire(self._tenant(req), est,
+                                    self._priority(req)):
+            rb = self.table.new_read_builder()
+            if projection:
+                rb = rb.with_projection(list(projection))
+            rb = rb.with_limit(limit)
+            plan = rb.new_scan().plan()
+            # snapshot-keyed result cache: same snapshot + same args
+            # = same rows (the plan above re-checks the snapshot, so
+            # a commit invalidates by changing the key); bypassed
+            # when row visibility is clock-dependent (record-level
+            # expire)
+            key = (plan.snapshot_id, limit, projection)
+            if self._scan_cache_enabled:
+                with self._scan_cache_lock:
+                    cached = self._scan_cache.get(key)
+                    if cached is not None:
+                        self._scan_cache.move_to_end(key)
+                if cached is not None:
+                    self._m_scan_cache_hits.inc()
+                    return cached
+                self._m_scan_cache_misses.inc()
+            t = rb.new_read().to_arrow(plan.splits)
+        out = {"rows": [{k: _encode_value(v) for k, v in r.items()}
+                        for r in t.to_pylist()],
+               "snapshot_id": plan.snapshot_id}
+        if self._scan_cache_enabled:
+            with self._scan_cache_lock:
+                self._scan_cache[key] = out
+                while len(self._scan_cache) > \
+                        self.max_scan_cache_entries:
+                    self._scan_cache.popitem(last=False)
+        return out
 
 
 class KvQueryClient:
@@ -513,17 +628,26 @@ class KvQueryClient:
     table's service registry (reference KvQueryClient + ServiceManager
     discovery).
 
-    Holds ONE persistent keep-alive connection (http.client) —
+    Holds persistent keep-alive connections (http.client) —
     reconnecting per request used to dominate sub-ms point-get latency
-    — and transparently reopens it when the server or an idle timeout
+    — and transparently reopens one when the server or an idle timeout
     dropped the socket (one retry, then the error surfaces).
-    Thread-safe: a lock serializes requests on the shared connection.
-    """
+    Thread-safe: a lock serializes requests on the shared connections.
+
+    FOLLOWS THE ROUTER (service/router.py): on first use the client
+    probes GET /topology once; against a ReplicaRouter it builds the
+    SAME consistent-hash ring and talks to this tenant's owning
+    replica DIRECTLY (one connection per replica), skipping the proxy
+    hop.  Against a plain replica the probe 404s and the classic
+    single-address path runs.  `last_replica` surfaces which replica
+    answered the most recent request (the X-Replica-Id debug header —
+    what the torn-batch and coherence tests key on)."""
 
     def __init__(self, table=None, address: Optional[str] = None,
                  tenant: str = "default",
                  priority: Optional[int] = None,
-                 timeout_ms: Optional[float] = None):
+                 timeout_ms: Optional[float] = None,
+                 follow_topology: bool = True):
         if address is None:
             if table is None:
                 raise ValueError("need a table or an address")
@@ -537,19 +661,31 @@ class KvQueryClient:
         self.tenant = tenant
         self.priority = priority          # None = server default (100)
         self.timeout_ms = timeout_ms      # per-request deadline -> 504
-        hostport = self.address.split("://", 1)[-1]
-        host, _, port = hostport.partition(":")
-        self._host = host
-        self._port = int(port) if port else 80
-        self._conn: Optional[http.client.HTTPConnection] = None
+        self._follow = follow_topology
+        self._ring = None                 # HashRing once discovered
+        self._topology_checked = False
+        self._conns: dict = {}            # address -> HTTPConnection
         self._lock = threading.Lock()
         self.reconnects = 0          # observable: stale-socket reopens
+        self.last_replica: Optional[str] = None   # X-Replica-Id
+
+    @staticmethod
+    def _hostport(address: str):
+        hostport = address.rstrip("/").split("://", 1)[-1]
+        host, _, port = hostport.partition(":")
+        return host, int(port) if port else 80
+
+    @property
+    def _conn(self):
+        """The base-address connection (kept for introspection: tests
+        kill its socket to exercise the stale-reconnect path)."""
+        return self._conns.get(self.address)
 
     def close(self):
         with self._lock:
-            if self._conn is not None:
-                self._conn.close()
-                self._conn = None
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()
 
     def __enter__(self) -> "KvQueryClient":
         return self
@@ -558,9 +694,42 @@ class KvQueryClient:
         self.close()
         return False
 
+    def _ensure_topology_locked(self, timeout: int):
+        """One-shot router discovery: a ReplicaRouter answers
+        /topology with the ring; a plain replica 404s (or refuses) and
+        the classic single-address path stays."""
+        if self._topology_checked or not self._follow:
+            return
+        self._topology_checked = True
+        host, port = self._hostport(self.address)
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", "/topology")
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return
+            topo = json.loads(data)
+            if not topo.get("router"):
+                return
+            from paimon_tpu.service.router import HashRing
+            self._ring = HashRing(topo["replicas"],
+                                  topo.get("virtual_nodes", 64))
+        except (http.client.HTTPException, ConnectionError, OSError,
+                ValueError, KeyError):
+            pass          # no topology: single-address path
+        finally:
+            conn.close()
+
+    def _target_address(self) -> str:
+        if self._ring is None:
+            return self.address
+        return self._ring.pick(self.tenant)["address"].rstrip("/")
+
     def _post(self, endpoint: str, body: dict, timeout: int,
               idempotent: bool = True) -> dict:
-        """POST json on the persistent connection.  429 raises
+        """POST json on the persistent connection to this tenant's
+        target (the owning replica when a ring is known).  429 raises
         ServiceBusyError (admission control pushed back); other
         server-side errors ({"error"} bodies) surface as RuntimeError
         with the server's message.
@@ -582,12 +751,15 @@ class KvQueryClient:
         payload = json.dumps(body).encode()
         headers = {"Content-Type": "application/json"}
         with self._lock:
+            self._ensure_topology_locked(timeout)
+            address = self._target_address()
+            host, port = self._hostport(address)
             for attempt in (0, 1):
-                conn = self._conn
+                conn = self._conns.get(address)
                 fresh = conn is None
                 if fresh:
                     conn = http.client.HTTPConnection(
-                        self._host, self._port, timeout=timeout)
+                        host, port, timeout=timeout)
                 sent = False
                 try:
                     if not fresh:
@@ -600,10 +772,11 @@ class KvQueryClient:
                     resp = conn.getresponse()
                     data = resp.read()
                     status = resp.status
+                    replica = resp.getheader("X-Replica-Id")
                 except (http.client.HTTPException, ConnectionError,
                         BrokenPipeError, OSError) as e:
                     conn.close()
-                    self._conn = None
+                    self._conns.pop(address, None)
                     # a FRESH connection that fails is a real error;
                     # only a reused socket gets the stale-retry, and
                     # only when resending cannot double-execute
@@ -617,7 +790,9 @@ class KvQueryClient:
                             f"{endpoint} failed: {e}") from e
                     self.reconnects += 1
                     continue
-                self._conn = conn
+                self._conns[address] = conn
+                if replica is not None:
+                    self.last_replica = replica
                 if status == 200:
                     return json.loads(data)
                 try:
@@ -638,9 +813,10 @@ class KvQueryClient:
     def healthz(self) -> dict:
         """GET /healthz: brownout rung, breaker states, queue depth
         and recent 429/504 rates (one-shot connection — health checks
-        must not contend on the request socket)."""
-        conn = http.client.HTTPConnection(self._host, self._port,
-                                          timeout=10)
+        must not contend on the request socket).  Against a router
+        this is the AGGREGATED fleet health."""
+        host, port = self._hostport(self.address)
+        conn = http.client.HTTPConnection(host, port, timeout=10)
         try:
             conn.request("GET", "/healthz")
             resp = conn.getresponse()

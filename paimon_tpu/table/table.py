@@ -537,6 +537,19 @@ class TableWrite:
                                    pc.fill_null(arr, scalar))
         return data
 
+    def set_delta_listener(self, listener):
+        """Serving-plane hook (service/delta.py): `listener(partition,
+        bucket, table, kinds, seqs)` fires for every buffered batch on
+        the single-threaded write caller, after sequence reservation —
+        the hot delta tier publishes lookup visibility from it.  Only
+        the primary-key fixed-bucket write path supports it (the
+        ServingWriter gates eligibility)."""
+        from paimon_tpu.core.write import KeyValueFileStoreWrite
+        if not isinstance(self._write, KeyValueFileStoreWrite):
+            raise ValueError(
+                "delta listener requires the primary-key write path")
+        self._write.delta_listener = listener
+
     def write_pandas(self, df):
         self.write_arrow(pa.Table.from_pandas(df, preserve_index=False))
 

@@ -1,6 +1,7 @@
 """Tier-1 lint: no NEW silent broad-exception swallowing in
-paimon_tpu/, no bare thread construction outside parallel/, and no
-bare `time.sleep(` outside utils/backoff.py.
+paimon_tpu/, no bare thread construction outside parallel/, no bare
+`time.sleep(` outside utils/backoff.py, and no raw `socket` /
+`selectors` usage outside service/async_server.py.
 
 An `except Exception: pass` (or bare except / continue body) hides
 every error class — including the transient faults the maintenance
@@ -265,6 +266,53 @@ def _raw_collective_calls():
                 if hit:
                     found.append(f"{rel}:{node.lineno}")
     return found
+
+
+_NET_MODULES = {"socket", "selectors"}
+
+
+def _raw_network_imports():
+    """`import socket` / `import selectors` (and their from-import
+    forms, any alias) outside paimon_tpu/service/async_server.py, as
+    '<relpath>:<line>' strings.  The event-loop request engine is the
+    ONE reviewed home of non-blocking socket code: its loop owns
+    every fd, bounds connections and pipelining, measures loop lag
+    and shuts down cleanly — an ad-hoc `socket`/`selectors` loop
+    elsewhere gets none of that (and the no-leaked-thread/fd tier-1
+    hygiene cannot see it).  HTTP clients use http.client, servers
+    use service/async_server.AsyncHttpServer."""
+    found = []
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if rel == "paimon_tpu/service/async_server.py":
+                continue       # the one reviewed home of raw sockets
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), rel)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name.split(".")[0] in _NET_MODULES:
+                            found.append(f"{rel}:{node.lineno}")
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module and \
+                            node.module.split(".")[0] in _NET_MODULES:
+                        found.append(f"{rel}:{node.lineno}")
+    return found
+
+
+def test_no_raw_sockets_outside_async_server():
+    offenders = _raw_network_imports()
+    assert not offenders, (
+        f"raw socket/selectors import outside "
+        f"service/async_server.py — ad-hoc network loops are banned: "
+        f"serve through AsyncHttpServer (bounded, observable, "
+        f"shutdown-clean) and talk HTTP through http.client: "
+        f"{sorted(offenders)}")
 
 
 # device-kernel modules whose bodies must stay traceable end to end:

@@ -553,7 +553,7 @@ class TestServing:
         server = KvQueryServer(t)
         assert isinstance(server.table.file_io, CachingFileIO)
         assert server.table.file_io.state is a.file_io.state
-        server.httpd.server_close()
+        server.server.stop()          # never started: releases the fd
 
     def test_snapshot_advance_evicts_dropped_files_from_shared_tier(
             self, tmp_path):
@@ -755,8 +755,8 @@ class TestServing:
         import sys
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ, SERVE_ROWS="20000", SERVE_CLIENTS="8",
-                   SERVE_SECONDS="1", JAX_PLATFORMS="cpu",
-                   PYTHONPATH=repo)
+                   SERVE_SECONDS="1", SERVE_REPLICAS="1",
+                   JAX_PLATFORMS="cpu", PYTHONPATH=repo)
         proc = subprocess.run(
             [sys.executable, "-m", "benchmarks.serve_bench"],
             capture_output=True, text=True, cwd=repo, env=env,

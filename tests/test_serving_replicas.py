@@ -1,0 +1,691 @@
+"""Web-scale serving plane (PR 13): event-loop engine, read replicas,
+hot delta tier.
+
+Async engine (service/async_server.py): pipelined HTTP/1.1 requests on
+one socket answered in order, malformed input -> 400, connection cap ->
+503, /healthz engine vitals (replica id, snapshot pin, delta size,
+event-loop lag), thread hygiene after stop.
+
+Delta tier (service/delta.py): a key written through the serving
+writer is readable via /lookup BEFORE any flush or commit, tombstones
+answer None, newest write wins, post-flush answers are byte-identical,
+abandoned writers un-publish their uncommitted rows, generations retire
+only once EVERY attached reader's plan covers them (min-floor), and
+ineligible configurations are refused with a reason.
+
+Replicas + router (service/router.py): shared_cache_state coherence
+under concurrent replicas (live commits + compaction: snapshot advance
+on one replica evicts dropped files process-wide before the new plan
+serves; no torn batches anywhere), consistent-hash stability across
+fleet resizes, aggregated /healthz and federated /metrics, the
+/topology-following client vs the dumb proxy path, and the
+X-Replica-Id debug header end to end.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from paimon_tpu.schema import Schema
+from paimon_tpu.service import (
+    KvQueryClient, KvQueryServer, ReplicaRouter, ReplicaSet,
+)
+from paimon_tpu.service.delta import (
+    DeltaTier, ServingWriter, delta_ineligible_reason,
+    reset_delta_tiers, shared_delta_tier,
+)
+from paimon_tpu.service.router import HashRing, _relabel_prometheus
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, RowKind, VarCharType
+
+
+@pytest.fixture(autouse=True)
+def _fresh_delta_tiers():
+    reset_delta_tiers()
+    yield
+    reset_delta_tiers()
+
+
+def _pk_table(path, buckets=2, extra_opts=None):
+    opts = {"bucket": str(buckets), "write-only": "true",
+            "service.lookup.refresh-interval": "0"}
+    opts.update(extra_opts or {})
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .column("name", VarCharType.string_type())
+              .primary_key("id")
+              .options(opts)
+              .build())
+    return FileStoreTable.create(path, schema)
+
+
+def _commit(table, rows, kinds=None):
+    wb = table.new_batch_write_builder()
+    with wb.new_write() as w:
+        w.write_dicts(rows, row_kinds=kinds)
+        wb.new_commit().commit(w.prepare_commit())
+
+
+def _rows(n, name="seed", lo=0):
+    return [{"id": i, "v": float(i), "name": f"{name}{i}"}
+            for i in range(lo, lo + n)]
+
+
+def _serving_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("paimon-serve", "paimon-router"))]
+
+
+# -- async engine ------------------------------------------------------------
+
+
+class TestAsyncEngine:
+    def test_pipelined_requests_answered_in_order(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(50))
+        server = KvQueryServer(t).start()
+        try:
+            reqs = []
+            for i in range(8):
+                body = json.dumps(
+                    {"keys": [{"id": i}]}).encode()
+                reqs.append(
+                    (f"POST /lookup HTTP/1.1\r\nHost: x\r\n"
+                     f"Content-Type: application/json\r\n"
+                     f"Content-Length: {len(body)}\r\n\r\n"
+                     ).encode() + body)
+            sk = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10)
+            sk.sendall(b"".join(reqs))        # all 8 back-to-back
+            buf = b""
+            deadline = time.time() + 20
+            while buf.count(b"HTTP/1.1 200") < 8 and \
+                    time.time() < deadline:
+                buf += sk.recv(1 << 20)
+            sk.close()
+            assert buf.count(b"HTTP/1.1 200") == 8
+            # responses carry the payloads IN REQUEST ORDER
+            offs = [buf.find(f'"name": "seed{i}"'.encode())
+                    for i in range(8)]
+            assert all(o >= 0 for o in offs), offs
+            assert offs == sorted(offs), offs
+        finally:
+            server.stop()
+
+    def test_malformed_request_answers_400(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        server = KvQueryServer(t).start()
+        try:
+            sk = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=5)
+            sk.sendall(b"NOT-HTTP\r\n\r\n")
+            assert b"400" in sk.recv(65536)
+            sk.close()
+        finally:
+            server.stop()
+
+    def test_connection_cap_answers_503(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"), extra_opts={
+            "service.max-connections": "2"})
+        _commit(t, _rows(5))
+        server = KvQueryServer(t).start()
+        socks = []
+        try:
+            for _ in range(2):
+                sk = socket.create_connection(
+                    ("127.0.0.1", server.port), timeout=5)
+                # a round trip proves the connection is accepted
+                body = b'{"keys": [{"id": 1}]}'
+                sk.sendall((f"POST /lookup HTTP/1.1\r\nHost: x\r\n"
+                            f"Content-Length: {len(body)}\r\n\r\n"
+                            ).encode() + body)
+                assert b"200" in sk.recv(1 << 20)
+                socks.append(sk)
+            extra = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=5)
+            got = extra.recv(65536)
+            assert b"503" in got or got == b""   # refused over the cap
+            extra.close()
+        finally:
+            for sk in socks:
+                sk.close()
+            server.stop()
+
+    def test_healthz_reports_engine_vitals(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(20))
+        server = KvQueryServer(t, replica_id=7).start()
+        try:
+            with KvQueryClient(address=server.address) as c:
+                c.lookup_row({"id": 3})
+                hz = c.healthz()
+            assert hz["replica_id"] == 7
+            assert hz["snapshot_id"] == 1           # pinned plan
+            assert hz["delta"] is not None          # tier attached
+            assert hz["delta"]["rows"] == 0
+            assert "recent_lag_ms" in hz["event_loop"]
+            assert hz["event_loop"]["connections"] >= 0
+        finally:
+            server.stop()
+
+    def test_loop_lag_histogram_is_fed(self, tmp_path):
+        from paimon_tpu.metrics import SERVICE_LOOP_LAG_MS, global_registry
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(10))
+        server = KvQueryServer(t).start()
+        try:
+            with KvQueryClient(address=server.address) as c:
+                for i in range(5):
+                    c.lookup_row({"id": i})
+            h = global_registry().service_metrics(t.name) \
+                .histogram(SERVICE_LOOP_LAG_MS)
+            assert h.total_count >= 5
+        finally:
+            server.stop()
+
+    def test_stop_leaves_no_threads(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        server = KvQueryServer(t).start()
+        with KvQueryClient(address=server.address) as c:
+            c.lookup_row({"id": 1})
+        server.stop()
+        deadline = time.monotonic() + 5
+        while _serving_threads() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not _serving_threads()
+
+
+# -- hot delta tier ----------------------------------------------------------
+
+
+class TestDeltaTier:
+    def test_written_key_readable_before_any_flush_or_commit(
+            self, tmp_path):
+        """THE acceptance property: a serving-writer row answers
+        /lookup with zero snapshots committed for it, and the
+        post-flush answer is identical."""
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(50))
+        server = KvQueryServer(t).start()
+        try:
+            sw = server.new_serving_writer()
+            with KvQueryClient(address=server.address) as c:
+                sw.write_dicts([
+                    {"id": 1000, "v": 7.5, "name": "fresh"},
+                    {"id": 3, "v": 99.0, "name": "updated"}])
+                snap_before = t.snapshot_manager.latest_snapshot_id()
+                pre_new = c.lookup_row({"id": 1000})
+                pre_upd = c.lookup_row({"id": 3})
+                assert pre_new == {"id": 1000, "v": 7.5,
+                                   "name": "fresh"}
+                assert pre_upd == {"id": 3, "v": 99.0,
+                                   "name": "updated"}
+                # genuinely pre-commit: no snapshot advanced
+                assert t.snapshot_manager.latest_snapshot_id() \
+                    == snap_before
+                sid = sw.commit()
+                assert sid == snap_before + 1
+                server.query().refresh()
+                post_new = c.lookup_row({"id": 1000})
+                post_upd = c.lookup_row({"id": 3})
+            assert post_new == pre_new        # identical post-flush
+            assert post_upd == pre_upd
+            # the LSM now owns the rows; the delta drained
+            assert server._delta.stats()["rows"] == 0
+            sw.close()
+        finally:
+            server.stop()
+
+    def test_delete_tombstone_visible_before_commit(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(20))
+        server = KvQueryServer(t).start()
+        try:
+            sw = server.new_serving_writer()
+            with KvQueryClient(address=server.address) as c:
+                assert c.lookup_row({"id": 5}) is not None
+                sw.write_dicts([{"id": 5, "v": 0.0, "name": "x"}],
+                               row_kinds=[RowKind.DELETE])
+                assert c.lookup_row({"id": 5}) is None   # pre-commit
+                sw.commit()
+                server.query().refresh()
+                assert c.lookup_row({"id": 5}) is None   # post-commit
+            sw.close()
+        finally:
+            server.stop()
+
+    def test_newest_write_wins_within_delta(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        tier = shared_delta_tier(t)
+        with ServingWriter(t, tier) as sw:
+            from paimon_tpu.lookup import LocalTableQuery
+            q = LocalTableQuery(t, delta=tier)
+            sw.write_dicts([{"id": 9, "v": 1.0, "name": "first"}])
+            sw.write_dicts([{"id": 9, "v": 2.0, "name": "second"}])
+            assert q.lookup([{"id": 9}])[0]["name"] == "second"
+            # delete then re-insert: the re-insert wins
+            sw.write_dicts([{"id": 9, "v": 0.0, "name": "x"}],
+                           row_kinds=[RowKind.DELETE])
+            assert q.lookup([{"id": 9}])[0] is None
+            sw.write_dicts([{"id": 9, "v": 3.0, "name": "third"}])
+            assert q.lookup([{"id": 9}])[0]["name"] == "third"
+            q.close()
+
+    def test_abandoned_writer_unpublishes_uncommitted_rows(
+            self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        server = KvQueryServer(t).start()
+        try:
+            sw = server.new_serving_writer()
+            with KvQueryClient(address=server.address) as c:
+                sw.write_dicts([{"id": 77, "v": 1.0, "name": "u"}])
+                assert c.lookup_row({"id": 77}) is not None
+                sw.close()        # never committed
+                assert c.lookup_row({"id": 77}) is None
+        finally:
+            server.stop()
+
+    def test_generation_retires_only_after_every_reader_advances(
+            self, tmp_path):
+        """Min-floor pruning: replica A refreshing must not un-publish
+        rows replica B still serves from an older plan."""
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(10))
+        tier = shared_delta_tier(t)
+        a = LocalTableQuery(t, delta=tier)
+        b = LocalTableQuery(t, delta=tier)
+        a.lookup([{"id": 1}])
+        b.lookup([{"id": 1}])                 # both pinned at snap 1
+        with ServingWriter(t, tier) as sw:
+            sw.write_dicts([{"id": 500, "v": 1.0, "name": "d"}])
+            sw.commit()                       # sealed at snapshot 2
+            assert tier.stats()["sealed_generations"] == 1
+            a.refresh()
+            a.lookup([{"id": 1}])             # A advanced to snap 2
+            # B still pins snap 1: the generation must survive
+            assert tier.stats()["sealed_generations"] == 1
+            assert b.lookup([{"id": 500}])[0]["name"] == "d"
+            b.refresh()
+            b.lookup([{"id": 1}])             # B advanced too
+            assert tier.stats()["sealed_generations"] == 0
+            # every reader now answers from the LSM
+            assert a.lookup([{"id": 500}])[0]["name"] == "d"
+            assert b.lookup([{"id": 500}])[0]["name"] == "d"
+        a.close()
+        b.close()
+
+    def test_unloaded_reader_blocks_pruning(self, tmp_path):
+        """A registered reader that has not loaded a plan (or is
+        mid-first-load having sampled an older snapshot) has an
+        UNKNOWN floor: sealing must keep the generation until it
+        reports in — pruning would un-publish rows its about-to-
+        install plan may not cover."""
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        tier = shared_delta_tier(t)
+        pending = LocalTableQuery(t, delta=tier)   # registered, no plan
+        with ServingWriter(t, tier) as sw:
+            sw.write_dicts([{"id": 800, "v": 1.0, "name": "k"}])
+            sw.commit()
+            assert tier.stats()["sealed_generations"] == 1
+            pending.lookup([{"id": 800}])          # first load -> floor
+            assert tier.stats()["sealed_generations"] == 0
+        pending.close()
+
+    def test_closing_a_reader_releases_its_floor(self, tmp_path):
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        tier = shared_delta_tier(t)
+        stale = LocalTableQuery(t, delta=tier)
+        stale.lookup([{"id": 1}])             # pins snapshot 1
+        live = LocalTableQuery(t, delta=tier)
+        live.lookup([{"id": 1}])
+        with ServingWriter(t, tier) as sw:
+            sw.write_dicts([{"id": 600, "v": 1.0, "name": "z"}])
+            sw.commit()
+            live.refresh()
+            live.lookup([{"id": 1}])
+            assert tier.stats()["sealed_generations"] == 1  # stale pins
+            stale.close()                     # unregister -> re-prune
+            assert tier.stats()["sealed_generations"] == 0
+        live.close()
+
+    def test_ineligible_configurations_are_refused_with_reason(
+            self, tmp_path):
+        t = _pk_table(str(tmp_path / "seq"), extra_opts={
+            "sequence.field": "v",
+            "service.delta.enabled": "true"})
+        assert "sequence.field" in delta_ineligible_reason(t)
+        server = KvQueryServer(t)
+        assert server._delta is None          # silently not attached
+        with pytest.raises(ValueError, match="sequence.field"):
+            server.new_serving_writer()
+        server.server.stop()
+        t2 = _pk_table(str(tmp_path / "off"), extra_opts={
+            "service.delta.enabled": "false"})
+        server2 = KvQueryServer(t2)
+        assert server2._delta is None
+        with pytest.raises(ValueError, match="delta tier unavailable"):
+            server2.new_serving_writer()
+        server2.server.stop()
+
+    def test_overflow_counter_past_max_bytes(self, tmp_path):
+        from paimon_tpu.metrics import (
+            SERVICE_DELTA_OVERFLOWS, global_registry,
+        )
+        t = _pk_table(str(tmp_path / "t"), extra_opts={
+            "service.delta.max-bytes": "1"})
+        _commit(t, _rows(2))
+        tier = shared_delta_tier(t)
+        before = global_registry().service_metrics(t.name) \
+            .counter(SERVICE_DELTA_OVERFLOWS).count
+        with ServingWriter(t, tier) as sw:
+            sw.write_dicts(_rows(50, name="big", lo=1000))
+            after = global_registry().service_metrics(t.name) \
+                .counter(SERVICE_DELTA_OVERFLOWS).count
+            assert after > before
+            # overflow never drops uncommitted rows
+            assert tier.stats()["rows"] == 50
+
+    def test_partitioned_table_delta_visibility(self, tmp_path):
+        """The delta key includes the partition: a pre-commit row is
+        visible under ITS partition only, with the same write-side and
+        probe-side composite key encoding."""
+        from paimon_tpu.lookup import LocalTableQuery
+        schema = (Schema.builder()
+                  .column("p", BigIntType(False))
+                  .column("id", BigIntType(False))
+                  .column("name", VarCharType.string_type())
+                  .partition_keys("p")
+                  .primary_key("p", "id")
+                  .options({"bucket": "2", "write-only": "true"})
+                  .build())
+        t = FileStoreTable.create(str(tmp_path / "t"), schema)
+        _commit(t, [{"p": 1, "id": i, "name": f"a{i}"}
+                    for i in range(10)])
+        tier = shared_delta_tier(t)
+        q = LocalTableQuery(t, delta=tier)
+        with ServingWriter(t, tier) as sw:
+            sw.write_dicts([{"p": 1, "id": 77, "name": "fresh"},
+                            {"p": 2, "id": 78, "name": "other"}])
+            hit = q.lookup([{"p": 1, "id": 77}], partition=(1,))[0]
+            assert hit == {"p": 1, "id": 77, "name": "fresh"}
+            # the other partition's key is not visible under p=1
+            assert q.lookup([{"p": 1, "id": 78}],
+                            partition=(1,))[0] is None
+            assert q.lookup([{"p": 2, "id": 78}],
+                            partition=(2,))[0]["name"] == "other"
+            sw.commit()
+            q.refresh()
+            assert q.lookup([{"p": 1, "id": 77}],
+                            partition=(1,))[0]["name"] == "fresh"
+        q.close()
+
+    def test_view_capture_survives_concurrent_seal_and_prune(
+            self, tmp_path):
+        """A captured view keeps serving generations that seal+prune
+        swap out underneath it (lists are replaced, never mutated)."""
+        from paimon_tpu.lookup import LocalTableQuery
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        tier = shared_delta_tier(t)
+        with ServingWriter(t, tier) as sw:
+            sw.write_dicts([{"id": 300, "v": 1.0, "name": "cap"}])
+            view = tier.view()                # captured pre-seal
+            sw.commit()
+            q = LocalTableQuery(t, delta=tier)
+            q.lookup([{"id": 1}])             # advance -> prune
+            q.close()
+            assert tier.stats()["sealed_generations"] == 0
+            kt = (300,)
+            hit = view.probe(tier._pkey(()), _bucket_of(t, 300), kt)
+            assert not view.is_miss(hit) and hit["name"] == "cap"
+
+
+def _bucket_of(table, key_id: int) -> int:
+    import pyarrow as pa
+
+    from paimon_tpu.core.bucket import FixedBucketAssigner
+    rt = table.schema.logical_row_type()
+    from paimon_tpu.types import data_type_to_arrow
+    bucket_keys = table.schema.bucket_keys()
+    assigner = FixedBucketAssigner(
+        bucket_keys, [rt.get_field(k).type for k in bucket_keys],
+        max(1, table.options.bucket))
+    q = pa.table({"id": pa.array([key_id], pa.int64())})
+    return int(assigner.assign(q)[0])
+
+
+# -- replicas + router -------------------------------------------------------
+
+
+class TestReplicas:
+    def test_replica_set_serves_all_tenants_with_debug_header(
+            self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(100))
+        with ReplicaSet(t, replicas=3) as rs:
+            rs.start()
+            seen = set()
+            for i in range(16):
+                with KvQueryClient(address=rs.address,
+                                   tenant=f"tenant-{i}") as c:
+                    assert c.lookup_row({"id": i})["name"] == f"seed{i}"
+                    assert c.last_replica is not None
+                    seen.add(int(c.last_replica))
+            # consistent hashing spreads 16 tenants over >1 replica
+            assert len(seen) > 1, seen
+
+    def test_proxy_path_forwards_and_reports_replica(self, tmp_path):
+        """A dumb client (follow_topology=False) rides the router
+        proxy; the X-Replica-Id header still reports who answered."""
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(20))
+        with ReplicaSet(t, replicas=2) as rs:
+            rs.start()
+            with KvQueryClient(address=rs.address, tenant="bob",
+                               follow_topology=False) as c:
+                assert c.lookup_row({"id": 3})["name"] == "seed3"
+                assert c._ring is None
+                assert list(c._conns) == [rs.address]   # proxied
+                proxied = int(c.last_replica)
+            expected = rs.router.ring.pick("bob")["id"]
+            assert proxied == expected
+
+    def test_torn_batch_and_cache_coherence_under_live_commits(
+            self, tmp_path):
+        """ISSUE satellite: snapshot advance on one replica must evict
+        dropped files everywhere (shared_cache_state is process-wide)
+        before the new plan serves — concurrent lookups on EVERY
+        replica across live commits + compaction always see a
+        consistent version, never a torn batch or stale bytes."""
+        t = _pk_table(str(tmp_path / "t"), buckets=2, extra_opts={
+            "write-only": "false",            # compaction drops files
+            "read.cache.range": "true"})
+        _commit(t, _rows(200, name="v0-"))
+        with ReplicaSet(t, replicas=3) as rs:
+            rs.start()
+            stop = threading.Event()
+            errors = []
+
+            def committer():
+                try:
+                    for gen in range(1, 6):
+                        _commit(t, _rows(200, name=f"v{gen}-"))
+                        time.sleep(0.05)
+                except Exception as e:      # noqa: BLE001
+                    errors.append(f"commit: {e!r}")
+                finally:
+                    stop.set()
+
+            def prober(tenant):
+                try:
+                    with KvQueryClient(address=rs.address,
+                                       tenant=tenant) as c:
+                        while not stop.is_set():
+                            rows = c.lookup(
+                                [{"id": k} for k in range(0, 40, 7)])
+                            vers = {r["name"].split("-")[0]
+                                    for r in rows if r}
+                            # one BATCH never spans two versions
+                            assert len(vers) <= 1, \
+                                f"torn batch: {vers}"
+                except Exception as e:      # noqa: BLE001
+                    errors.append(f"probe[{tenant}]: {e!r}")
+
+            probers = [threading.Thread(target=prober,
+                                        args=(f"tenant-{i}",))
+                       for i in range(6)]
+            cth = threading.Thread(target=committer)
+            [p.start() for p in probers]
+            cth.start()
+            cth.join()
+            [p.join() for p in probers]
+            assert not errors, errors[:3]
+            # after everything lands, every replica serves v5 bytes
+            for i in range(8):
+                with KvQueryClient(address=rs.address,
+                                   tenant=f"late-{i}") as c:
+                    row = c.lookup_row({"id": 11})
+                    assert row["name"] == "v5-11", row
+
+    def test_shared_tier_evicts_dropped_files_across_replicas(
+            self, tmp_path):
+        """Compaction on a refresh of ONE replica's plan invalidates
+        the dropped files' bytes in the PROCESS-wide tier: no replica
+        can serve stale cached bytes for vanished files."""
+        from paimon_tpu.fs.caching import shared_cache_state
+        t = _pk_table(str(tmp_path / "t"), buckets=1, extra_opts={
+            "service.lookup.refresh-interval": "100000"})
+        _commit(t, _rows(50, name="a"))
+        _commit(t, _rows(50, name="b"))
+        with ReplicaSet(t, replicas=2) as rs:
+            rs.start()
+            # warm BOTH replicas' plans + the shared byte tier (each
+            # replica must hold the pre-compaction plan for the test
+            # to mean anything)
+            for s in rs.servers:
+                assert s.query().lookup([{"id": 7}])[0]["name"] == "b7"
+            old_files = {f.file_name
+                         for s in t.new_read_builder().new_scan()
+                         .plan().splits for f in s.data_files}
+            t.compact(full=True)              # rewrites -> drops files
+            # ONE replica refreshes; eviction is process-wide
+            rs.servers[0].query().refresh()
+            rs.servers[0].query().lookup([{"id": 7}])
+            state = shared_cache_state()
+            with state.lock:
+                cached_paths = set(state.cache.keys()) | \
+                    {p for (p, _o, _l) in state.ranges.keys()}
+            for path in cached_paths:
+                assert not any(path.endswith(f) for f in old_files), \
+                    f"stale bytes for dropped file: {path}"
+            # the OTHER replica (plan still old is fine — its files
+            # may be gone) re-reads fresh bytes on refresh
+            rs.servers[1].query().refresh()
+            row = rs.servers[1].query().lookup([{"id": 7}])[0]
+            assert row["name"] == "b7"
+
+    def test_router_healthz_aggregates_and_metrics_federate(
+            self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(10))
+        with ReplicaSet(t, replicas=2) as rs:
+            rs.start()
+            with KvQueryClient(address=rs.address) as c:
+                c.lookup_row({"id": 1})
+                hz = c.healthz()
+            assert hz["router"] is True
+            assert hz["replica_count"] == 2
+            assert set(hz["replicas"]) == {"0", "1"}
+            assert hz["replicas"]["0"]["replica_id"] == 0
+            # in-process fleet: /metrics renders the shared registry
+            import urllib.request
+            text = urllib.request.urlopen(
+                rs.address + "/metrics", timeout=10).read().decode()
+            assert "paimon_service_requests" in text
+
+    def test_hash_ring_stability_on_resize(self):
+        nodes3 = [{"id": i, "address": f"http://h:{8000 + i}"}
+                  for i in range(3)]
+        nodes4 = nodes3 + [{"id": 3, "address": "http://h:8003"}]
+        r3, r4 = HashRing(nodes3, 64), HashRing(nodes4, 64)
+        tenants = [f"tenant-{i}" for i in range(1000)]
+        moved = sum(r3.pick(x)["id"] != r4.pick(x)["id"]
+                    for x in tenants)
+        # consistent hashing: ~1/4 of tenants move, never a reshuffle
+        assert moved < 500, moved
+        # and the mapping is deterministic across ring rebuilds
+        r3b = HashRing(nodes3, 64)
+        assert all(r3.pick(x)["id"] == r3b.pick(x)["id"]
+                   for x in tenants)
+
+    def test_relabel_prometheus_injects_replica_label(self):
+        text = ("# HELP paimon_service_requests x\n"
+                "# TYPE paimon_service_requests counter\n"
+                "paimon_service_requests 5\n"
+                'paimon_service_lookup_ms{table="t",quantile="p95"}'
+                " 1.5\n")
+        out = _relabel_prometheus(text, 2)
+        assert 'paimon_service_requests{replica="2"} 5' in out
+        assert ('paimon_service_lookup_ms{replica="2",table="t",'
+                'quantile="p95"} 1.5') in out
+        assert out.splitlines()[0].startswith("# HELP")
+
+    def test_delta_visible_on_every_replica(self, tmp_path):
+        """The tier is shared by table path: one serving writer, N
+        replicas, zero commits — all replicas answer the fresh key."""
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(10))
+        with ReplicaSet(t, replicas=3) as rs:
+            rs.start()
+            sw = rs.new_serving_writer()
+            sw.write_dicts([{"id": 900, "v": 1.0, "name": "hot"}])
+            answered = set()
+            for i in range(12):
+                with KvQueryClient(address=rs.address,
+                                   tenant=f"tn-{i}") as c:
+                    assert c.lookup_row({"id": 900})["name"] == "hot"
+                    answered.add(int(c.last_replica))
+            assert len(answered) > 1          # not all one replica
+            sw.close()
+
+    def test_stop_leaves_no_threads(self, tmp_path):
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(5))
+        rs = ReplicaSet(t, replicas=2).start()
+        with KvQueryClient(address=rs.address) as c:
+            c.lookup_row({"id": 1})
+        rs.stop()
+        deadline = time.monotonic() + 5
+        while _serving_threads() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not _serving_threads()
+
+
+def test_replicated_bench_rig_smoke():
+    """benchmarks/serve_bench --replica-serve/--client-load rig end to
+    end at toy scale: replica subprocesses come up, the router routes,
+    client processes follow /topology, the labeled latency series and
+    the oracle identity check all land in the record."""
+    from benchmarks.serve_bench import measure_replicated
+    out = measure_replicated(rows=5000, clients=8, seconds=1.0,
+                             replicas=2, client_procs=2, emit=None)
+    assert out["qps"] > 0
+    assert out["oracle_rows_checked"] > 0
+    assert set(out["per_replica"]) == {"0", "1"}
+    for series in ("client_ok_p95_ms", "client_all_p95_ms",
+                   "obs_lookup_p95_ms", "obs_lookup_p95_ms_max"):
+        assert series in out, series
+    assert "client_ok" in out["latency_series"]
