@@ -1,0 +1,19 @@
+"""Rule catalog: importing this package registers every rule with the
+engine.  Grouped by family:
+
+* hygiene  — the seven migrated tier-1 AST lints (swallow, threads,
+  sleeps, sockets, collectives, distributed-init,
+  host-materialization)
+* drift    — metric-name and options-doc drift (previously grep tests)
+* locks    — lock-order: inter-procedural lock-acquisition cycles
+* eventloop — loop-blocking: blocking primitive reachable from the
+  event-loop thread
+* deadline — deadline-wait: unbounded blocking waits outside the
+  sanctioned bounded forms
+* fault    — fault-taxonomy: transient store errors handled outside
+  parallel/fault.py's ladder
+"""
+
+from paimon_tpu.analysis.rules import (  # noqa: F401
+    deadline, drift, eventloop, fault, hygiene, locks,
+)

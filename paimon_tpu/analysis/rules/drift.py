@@ -1,0 +1,98 @@
+"""Drift rules: artifacts that must track the source.
+
+Previously grep/subprocess tests (tests/test_metrics.py's
+metric-producer grep, tests/test_docs.py's generate_options --check);
+now engine rules with structured findings, running over the
+already-parsed sources — no re-walk, no subprocess.
+
+Both rules are repo-shaped (they need paimon_tpu.metrics /
+docs/generate_options.py next to the package) and no-op on fixture
+packages that lack those anchors.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from paimon_tpu.analysis.engine import Finding, rule
+from paimon_tpu.analysis.model import ProgramModel
+
+
+@rule("metric-drift",
+      "exported metric-name constant with no producer")
+def check_metric_drift(model: ProgramModel) -> List[Finding]:
+    """Every exported ALL_CAPS metric-name constant in metrics.py must
+    be referenced by name somewhere else in the package — an orphaned
+    constant means a dashboard/test greps for a metric nothing
+    emits."""
+    metrics_mod = model.modules.get("metrics.py")
+    if metrics_mod is None or model.package_name != "paimon_tpu":
+        return []
+    import paimon_tpu.metrics as M
+    consts = [n for n in M.__all__ if n.isupper()]
+    blob = "\n".join(m.source for m in model.modules.values()
+                     if m is not metrics_mod)
+    out = []
+    for name in consts:
+        if name in blob:
+            continue
+        m = re.search(rf"^{name}\b", metrics_mod.source, re.MULTILINE)
+        line = metrics_mod.source[:m.start()].count("\n") + 1 if m \
+            else 1
+        out.append(Finding(
+            "metric-drift", metrics_mod.rel, line,
+            f"metric-name constant {name} has no producer in "
+            f"{model.package_name}/ — emit it or delete it"))
+    return out
+
+
+@rule("options-drift",
+      "docs/options.md or CoreOptions out of sync")
+def check_options_drift(model: ProgramModel) -> List[Finding]:
+    """docs/options.md must regenerate byte-identically from
+    paimon_tpu/options.py, and no option key may be declared twice
+    (duplicates with the same attribute name collapse in the class
+    dict — the second silently wins)."""
+    gen_path = os.path.join(model.repo_root, "docs",
+                            "generate_options.py")
+    if not os.path.exists(gen_path) or \
+            model.package_name != "paimon_tpu":
+        return []
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "paimon_docs_generate_options", gen_path)
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+
+    out: List[Finding] = []
+    options_mod = model.modules.get("options.py")
+    options_rel = options_mod.rel if options_mod \
+        else "paimon_tpu/options.py"
+    import inspect
+
+    from paimon_tpu.options import CoreOptions
+    dups = gen.duplicate_option_keys(inspect.getsource(CoreOptions))
+    for key in dups:
+        line = 1
+        if options_mod:
+            m = re.search(re.escape(key), options_mod.source)
+            if m:
+                line = options_mod.source[:m.start()].count("\n") + 1
+        out.append(Finding(
+            "options-drift", options_rel, line,
+            f"option key '{key}' declared more than once in "
+            f"CoreOptions — the second declaration silently wins"))
+    if dups:
+        return out      # render() refuses to run on duplicates
+    current_path = os.path.join(model.repo_root, "docs", "options.md")
+    current = open(current_path).read() \
+        if os.path.exists(current_path) else ""
+    if current != gen.render():
+        out.append(Finding(
+            "options-drift", "docs/options.md", 1,
+            "docs/options.md is out of date with "
+            "paimon_tpu/options.py — run "
+            "`python docs/generate_options.py`"))
+    return out

@@ -77,6 +77,9 @@ class PrivilegeManager:
                     if st and st[0].mtime_ms and \
                             st[0].mtime_ms < (time.time() - 10) * 1000:
                         self.file_io.delete_quietly(lock)
+                # lint-ok: swallow best-effort stale-lock breaking on
+                # a catalog without the privilege meta table — failure
+                # just means the next mutation retries the break
                 except Exception:
                     pass
             # the token must be writer-unique: on object stores, an

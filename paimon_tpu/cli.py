@@ -372,6 +372,47 @@ def cmd_sql(args):
                 print(f"error: {e}", file=sys.stderr)
 
 
+def cmd_lint(args):
+    """Whole-program static analysis: the same engine pass tier-1
+    runs (paimon_tpu/analysis/), for humans and external CI.  Exit 1
+    when any unsuppressed finding exists."""
+    import os
+
+    from paimon_tpu.analysis import all_rules, run_package
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id:22s} {r.title}")
+        return
+    if args.rules:
+        from paimon_tpu.analysis import META_RULES
+        known = {r.id for r in all_rules()} | set(META_RULES)
+        unknown = sorted(set(args.rules) - known)
+        if unknown:
+            raise SystemExit(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                f"(see `paimon lint --list-rules`)")
+    package_dir = args.package_dir or os.path.dirname(
+        os.path.abspath(__file__))
+    report = run_package(package_dir,
+                         rule_ids=args.rules if args.rules else None)
+    if args.json:
+        print(report.to_json())
+    else:
+        for f in report.findings:
+            if f.suppressed and not args.show_suppressed:
+                continue
+            tag = " [suppressed]" if f.suppressed else ""
+            print(f"{f.file}:{f.line}: [{f.rule}]{tag} {f.message}")
+        s = report.to_dict()["summary"]
+        print(f"{len(report.model.modules)} files, "
+              f"{len(report.rules)} rules: "
+              f"{s['unsuppressed']} finding(s), "
+              f"{s['suppressed']} suppressed")
+    if report.unsuppressed:
+        raise SystemExit(1)
+
+
 # -- parser -----------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -529,6 +570,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("query", nargs="?", help="statement; omit for a REPL")
     s.add_argument("--database", "-d", default="default")
     s.set_defaults(func=cmd_sql)
+
+    ln = sub.add_parser(
+        "lint", help="whole-program static analysis (the tier-1 "
+                     "rule engine); exit 1 on unsuppressed findings")
+    ln.add_argument("--json", action="store_true",
+                    help="machine-readable report (findings incl. "
+                         "suppressed, summary counts)")
+    ln.add_argument("--rule", action="append", dest="rules",
+                    metavar="ID",
+                    help="run only this rule id (repeatable; "
+                         "see --list-rules)")
+    ln.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ln.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in text output")
+    ln.add_argument("--package-dir", metavar="DIR",
+                    help="package root to analyse (default: the "
+                         "installed paimon_tpu)")
+    ln.set_defaults(func=cmd_lint)
     return p
 
 

@@ -354,7 +354,9 @@ class FileStoreCommit:
                         fut = pool.submit(_write_manifest, entries, "delta")
                         changelog_manifest = _write_manifest(
                             changelog_entries, "changelog")
-                        new_manifest = fut.result()
+                        from paimon_tpu.utils.deadline import wait_future
+                        new_manifest = wait_future(
+                            fut, "commit delta manifest write")
                     finally:
                         pool.shutdown(wait=True)
                 if new_manifest is None and entries:

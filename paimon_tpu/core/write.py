@@ -29,6 +29,7 @@ from paimon_tpu.options import CoreOptions, MergeEngine
 from paimon_tpu.ops.merge import KIND_COL, SEQ_COL, merge_runs, sort_table
 from paimon_tpu.schema.table_schema import TableSchema
 from paimon_tpu.types import RowKind
+from paimon_tpu.utils.deadline import wait_future
 from paimon_tpu.utils.path_factory import FileStorePathFactory
 
 __all__ = ["CommitMessage", "KeyValueFileStoreWrite", "build_kv_table"]
@@ -814,9 +815,11 @@ class KeyValueFileStoreWrite:
         # bounded lookahead: at most 4 batches prepped ahead (each holds
         # a batch-sized copy), routed strictly in submission order
         while len(self._prep) > 4:
-            self._route(self._prep.popleft().result())
+            self._route(wait_future(self._prep.popleft(),
+                                    "write prep backpressure"))
         while self._prep and self._prep[0].done():
-            self._route(self._prep.popleft().result())
+            self._route(wait_future(self._prep.popleft(),
+                                    "write prep drain"))
 
     def _route(self, groups):
         for (part, bucket), sub, kinds in groups:
@@ -824,7 +827,8 @@ class KeyValueFileStoreWrite:
 
     def _drain_prep(self):
         while self._prep:
-            self._route(self._prep.popleft().result())
+            self._route(wait_future(self._prep.popleft(),
+                                    "write prep drain"))
 
     def _prep_executor(self):
         """Lookahead pool (up to 4 workers, bounded by the flush

@@ -131,6 +131,9 @@ class FileIO:
         try:
             with deadline_shield():
                 self.delete(path, False)
+        # lint-ok: swallow quiet delete IS the two-phase-commit
+        # cleanup contract: best-effort removal whose failure must
+        # never fail the caller (fsck collects any orphan later)
         except Exception:
             pass
 

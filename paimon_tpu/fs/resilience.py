@@ -495,6 +495,9 @@ class ResilientObjectStoreBackend(ObjectStoreBackend):
                         self._m_won.inc()
                     if pending:
                         self._m_abandoned.inc(len(pending))
+                    # lint-ok: deadline-wait f is in the cf.wait done
+                    # set: the result is already available, this call
+                    # cannot block
                     return f.result()
                 if isinstance(err, FileNotFoundError):
                     # an authoritative answer, not a failure: the key

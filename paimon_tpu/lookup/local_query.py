@@ -59,7 +59,9 @@ from paimon_tpu.lookup.sst import (
 from paimon_tpu.ops.merge import KIND_COL, SEQ_COL
 from paimon_tpu.ops.normkey import NormalizedKeyEncoder
 from paimon_tpu.options import CoreOptions, MergeEngine
+from paimon_tpu.parallel.fault import is_transient_error
 from paimon_tpu.types import RowKind, data_type_to_arrow
+from paimon_tpu.utils.deadline import check_deadline
 
 __all__ = ["LocalTableQuery"]
 
@@ -365,7 +367,11 @@ class LocalTableQuery:
                     ev = threading.Event()
                     self._building[key] = ev
                     break                # we are the builder
-            ev.wait()
+            # bounded wait on the in-flight builder: a request whose
+            # deadline is spent stops waiting (the builder keeps
+            # running and publishes for the next caller)
+            while not ev.wait(0.05):
+                check_deadline("lookup sst build")
             # builder published (or failed — then we become the
             # builder on the next iteration and surface its error)
         try:
@@ -392,8 +398,11 @@ class LocalTableQuery:
                 return np.zeros(0, np.int64), None
             try:
                 return reader.probe(lanes)
-            except OSError:
-                if attempt:
+            except OSError as e:
+                # route the retry decision through the fault taxonomy:
+                # a deterministic decode error must surface, only the
+                # transient flavor earns the one rebuild
+                if attempt or not is_transient_error(e):
                     raise
                 self.store.drop(key)
 

@@ -90,9 +90,10 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(path)
             break
+        # lint-ok: fault-taxonomy deterministic local recovery, not a
+        # store retry: a cached .so from another platform/arch fails
+        # to dlopen, so drop the cache and compile fresh exactly once
         except OSError:
-            # a cached .so from another platform/arch (or stale): drop
-            # the cache and compile fresh for this machine
             lib = None
             continue
     if lib is None:

@@ -316,6 +316,9 @@ def _iter_pipelined(read, splits, options, par, *, ordered, stats):
                 idx, s, b, fut = inflight[pos]
                 del inflight[pos]
             if dl is None:
+                # lint-ok: deadline-wait no-deadline branch of an
+                # already-deadline-aware wait: the else-branch below
+                # bounds with remaining_s() and abandons hung splits
                 table = fut.result()  # raises the worker's exception
             else:
                 try:

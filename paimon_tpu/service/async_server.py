@@ -361,6 +361,9 @@ class AsyncHttpServer:
             try:
                 sock.setsockopt(socket.IPPROTO_TCP,
                                 socket.TCP_NODELAY, 1)
+            # lint-ok: fault-taxonomy best-effort socket option on a
+            # fresh connection, never re-attempted: losing TCP_NODELAY
+            # degrades latency, not correctness — not a store retry
             except OSError:
                 pass
             conn = _Conn(sock)
@@ -452,6 +455,11 @@ class AsyncHttpServer:
         anything was pending."""
         moved = False
         while True:
+            # lint-ok: loop-blocking micro critical section shared
+            # with workers: both sides only append/popleft under the
+            # lock, never block inside it — the hand-off IS the
+            # event-loop completion design (loop lag is measured one
+            # line below to catch it regressing)
             with self._done_lock:
                 if not self._done:
                     break

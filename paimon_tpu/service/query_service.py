@@ -773,6 +773,10 @@ class KvQueryClient:
                     data = resp.read()
                     status = resp.status
                     replica = resp.getheader("X-Replica-Id")
+                # lint-ok: fault-taxonomy stale keep-alive reconnect,
+                # deliberately narrower than the store ladder: exactly
+                # one resend, only for idempotent work on a reused
+                # socket, never on timeout (see the guard below)
                 except (http.client.HTTPException, ConnectionError,
                         BrokenPipeError, OSError) as e:
                     conn.close()

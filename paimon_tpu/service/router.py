@@ -114,6 +114,10 @@ class _UpstreamPool:
                 data = resp.read()
                 out_headers = dict(resp.getheaders())
                 status = resp.status
+            # lint-ok: fault-taxonomy stale keep-alive reconnect,
+            # deliberately narrower than the store ladder: one resend
+            # on a reused pooled socket, a fresh connection's failure
+            # raises immediately
             except (http.client.HTTPException, ConnectionError,
                     OSError):
                 conn.close()

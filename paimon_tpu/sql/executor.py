@@ -746,6 +746,8 @@ class SQLContext:
                 inner_cols = {f.name for f in tbl.row_type().fields}
                 inner_alias = sub.from_.alias or \
                     sub.from_.name.split(".")[-1]
+            # lint-ok: swallow EXISTS rewrite probe: any failure here
+            # just falls back to the unoptimized (correct) plan
             except Exception:
                 pass
 

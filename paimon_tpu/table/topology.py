@@ -23,6 +23,8 @@ import queue
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from paimon_tpu.utils.deadline import check_deadline
+
 import numpy as np
 import pyarrow as pa
 
@@ -41,6 +43,10 @@ class _Worker:
 
     def _run(self):
         while True:
+            # lint-ok: deadline-wait the worker's idle inbox wait: a
+            # daemon thread parked with no request waiting on it;
+            # lifecycle (not a deadline) bounds it — stop() enqueues
+            # _STOP and joins
             item = self.q.get()
             if item is _STOP:
                 return
@@ -72,7 +78,11 @@ class _Worker:
         out: List = []
         done = threading.Event()
         self.q.put(("prepare", out, done))
-        done.wait()
+        # bounded wait: the worker sets `done` on success AND on
+        # failure, but a request whose deadline is spent must not
+        # wait out a wedged writer
+        while not done.wait(0.2):
+            check_deadline("stream ingest prepare")
         if self.error:
             raise RuntimeError("writer worker failed") from self.error
         return out

@@ -38,7 +38,7 @@ from typing import Callable, Optional
 
 __all__ = ["Deadline", "DeadlineExceededError", "current_deadline",
            "deadline_scope", "deadline_shield", "check_deadline",
-           "remaining_ms", "run_with_deadline"]
+           "remaining_ms", "run_with_deadline", "wait_future"]
 
 
 class DeadlineExceededError(RuntimeError):
@@ -163,6 +163,38 @@ def deadline_shield():
         yield
     finally:
         _CURRENT.reset(token)
+
+
+def wait_future(fut, what: str = "future", poll_s: float = 0.5):
+    """Deadline-bounded `Future.result()` — THE sanctioned wait for an
+    executor future (the tier-1 deadline-wait rule bans a bare
+    `.result()` outside this module).
+
+    With no deadline in scope it is exactly `fut.result()` (callers
+    without a request budget wait as long as the work takes, their own
+    contract).  With a deadline, the wait polls in `poll_s` slices
+    capped to the remaining budget and raises DeadlineExceededError
+    the moment the budget is spent — a hung worker can no longer hold
+    a timed-out request (the worker itself keeps running and its
+    result is discarded, same abandonment contract as the scan
+    pipeline's hung-split path)."""
+    dl = _CURRENT.get()
+    if dl is None:
+        return fut.result()
+    import concurrent.futures as _cf
+    while True:
+        dl.check(what)
+        try:
+            return fut.result(timeout=min(poll_s, dl.remaining_s()))
+        except _cf.TimeoutError:
+            if fut.done():
+                # the future completed in the window between the wait
+                # timing out and this check (or the worker itself
+                # raised) — a done future answers instantly with the
+                # WORKER's outcome; re-raising the poll's TimeoutError
+                # here would turn a successful result into a crash
+                return fut.result()
+            continue
 
 
 def run_with_deadline(dl: Optional[Deadline], fn: Callable, /,

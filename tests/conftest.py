@@ -34,3 +34,13 @@ def pytest_configure(config):
 @pytest.fixture
 def tmp_warehouse(tmp_path):
     return str(tmp_path / "warehouse")
+
+
+@pytest.fixture(scope="session")
+def lint_report():
+    """ONE whole-program analysis pass (paimon_tpu/analysis/) shared
+    by every tier-1 lint test — one parse per file per test session,
+    replacing the seven independent full-tree AST walks the old
+    tests/test_lint_swallow.py performed."""
+    from paimon_tpu.analysis import default_report
+    return default_report()

@@ -1,0 +1,790 @@
+"""Whole-program analysis plane: engine, model, and rule fixtures.
+
+Each new rule gets known-BAD fixture packages that must produce
+exactly the expected finding and known-GOOD ones that must produce
+none; the suppression machinery (reasoned markers, stale markers,
+reasonless markers) is exercised directly; `paimon lint --json`'s
+output shape is pinned for external CI; and the production tree runs
+the FULL catalog with zero unsuppressed findings — the tier-1
+acceptance gate.
+
+Regression notes for the violations the new rules surfaced (fixed in
+the same PR that shipped the rules) live in
+test_fixed_violations_stay_fixed below.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from paimon_tpu.analysis import run_package
+
+
+def make_pkg(tmp_path, files):
+    """A throwaway package the model can parse: rule scoping matches
+    on package-relative paths, so fixtures mirror the real layout
+    (service/..., parallel/...)."""
+    pkg = tmp_path / "fixturepkg"
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    init = pkg / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    return str(pkg)
+
+
+def lint(tmp_path, files, rules):
+    return run_package(make_pkg(tmp_path, files), rule_ids=rules)
+
+
+# -- lock-order --------------------------------------------------------------
+
+_LOCK_CYCLE = """
+    import threading
+
+    ALPHA_LOCK = threading.Lock()
+    BETA_LOCK = threading.Lock()
+
+    def forward():
+        with ALPHA_LOCK:
+            take_beta()
+
+    def take_beta():
+        with BETA_LOCK:
+            pass
+
+    def backward():
+        with BETA_LOCK:
+            take_alpha()
+
+    def take_alpha():
+        with ALPHA_LOCK:
+            pass
+"""
+
+
+def test_lock_order_two_lock_cycle(tmp_path):
+    """The classic inversion: forward() holds ALPHA and takes BETA
+    through a callee, backward() holds BETA and takes ALPHA — a cycle
+    only an inter-procedural view can see."""
+    rep = lint(tmp_path, {"service/locks.py": _LOCK_CYCLE},
+               ["lock-order"])
+    findings = rep.unsuppressed_by_rule("lock-order")
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+    assert "ALPHA_LOCK" in findings[0].message
+    assert "BETA_LOCK" in findings[0].message
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    """Same locks, same nesting, but ONE global order — no cycle, no
+    finding."""
+    rep = lint(tmp_path, {"service/locks.py": """
+        import threading
+
+        ALPHA_LOCK = threading.Lock()
+        BETA_LOCK = threading.Lock()
+
+        def forward():
+            with ALPHA_LOCK:
+                take_beta()
+
+        def take_beta():
+            with BETA_LOCK:
+                pass
+
+        def also_forward():
+            with ALPHA_LOCK:
+                with BETA_LOCK:
+                    pass
+    """}, ["lock-order"])
+    assert rep.unsuppressed_by_rule("lock-order") == []
+
+
+def test_lock_order_self_call_reacquire(tmp_path):
+    """`self.m()` runs on the SAME instance: re-acquiring the held
+    non-reentrant lock one call away is a guaranteed self-deadlock."""
+    rep = lint(tmp_path, {"service/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def put(self, k, v):
+                with self._lock:
+                    self._evict()
+
+            def _evict(self):
+                with self._lock:
+                    pass
+    """}, ["lock-order"])
+    findings = rep.unsuppressed_by_rule("lock-order")
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_order_rlock_reacquire_is_clean(tmp_path):
+    """The same shape over an RLock is reentrant by design."""
+    rep = lint(tmp_path, {"service/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def put(self, k, v):
+                with self._lock:
+                    self._evict()
+
+            def _evict(self):
+                with self._lock:
+                    pass
+    """}, ["lock-order"])
+    assert rep.unsuppressed_by_rule("lock-order") == []
+
+
+def test_lock_order_condition_aliases_to_its_lock(tmp_path):
+    """Condition(self._lock) IS self._lock: with-ing the condition
+    then with-ing the lock through a self-call must report the
+    re-acquisition, not invent a second lock."""
+    rep = lint(tmp_path, {"service/pipe.py": """
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def push(self):
+                with self._cond:
+                    self._locked_len()
+
+            def _locked_len(self):
+                with self._lock:
+                    return 0
+    """}, ["lock-order"])
+    findings = rep.unsuppressed_by_rule("lock-order")
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+# -- loop-blocking -----------------------------------------------------------
+
+def _server_fixture(helper_body):
+    return {
+        "parallel/executors.py": """
+            def spawn_thread(fn, name=None):
+                return fn
+        """,
+        "service/async_server.py": f"""
+            import threading
+            from fixturepkg.parallel.executors import spawn_thread
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    spawn_thread(self._loop, name="srv-loop")
+
+                def _loop(self):
+                    while True:
+                        self._tick()
+
+                def _tick(self):
+                    self._helper()
+
+                def _helper(self):
+{textwrap.indent(textwrap.dedent(helper_body), ' ' * 20)}
+        """,
+    }
+
+
+def test_loop_blocking_two_hops_from_loop(tmp_path):
+    """A lock acquisition TWO calls below the loop callback — the
+    regression shape per-function lints can never see."""
+    rep = lint(tmp_path, _server_fixture("""
+        with self._lock:
+            pass
+    """), ["loop-blocking"])
+    findings = rep.unsuppressed_by_rule("loop-blocking")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "lock" in f.message
+    assert "_loop -> " in f.message and "_helper" in f.message
+
+
+def test_loop_blocking_clean_loop(tmp_path):
+    rep = lint(tmp_path, _server_fixture("""
+        return 1
+    """), ["loop-blocking"])
+    assert rep.unsuppressed_by_rule("loop-blocking") == []
+
+
+def test_loop_blocking_missing_root_is_a_finding(tmp_path):
+    """Renaming the loop thread must not silently disable the rule."""
+    rep = lint(tmp_path, {"service/async_server.py": """
+        def serve():
+            return None
+    """}, ["loop-blocking"])
+    findings = rep.unsuppressed_by_rule("loop-blocking")
+    assert len(findings) == 1
+    assert "cannot locate" in findings[0].message
+
+
+# -- deadline-wait -----------------------------------------------------------
+
+def test_deadline_wait_unbounded_forms(tmp_path):
+    """Zero-arg Queue.get / Event.wait / Future.result are exactly
+    the waits a spent deadline cannot escape."""
+    rep = lint(tmp_path, {"work.py": """
+        def consume(q):
+            return q.get()
+
+        def wait_event(ev):
+            ev.wait()
+
+        def collect(fut):
+            return fut.result()
+    """}, ["deadline-wait"])
+    findings = rep.unsuppressed_by_rule("deadline-wait")
+    assert [f.line for f in findings] == [3, 6, 9]
+    kinds = "\n".join(f.message for f in findings)
+    assert "queue-get" in kinds
+    assert "unbounded wait" in kinds
+    assert "future-result" in kinds
+
+
+def test_deadline_wait_bounded_forms_are_clean(tmp_path):
+    rep = lint(tmp_path, {"work.py": """
+        def consume(q):
+            return q.get(timeout=1.0)
+
+        def wait_event(ev):
+            while not ev.wait(0.05):
+                check_deadline("work")
+
+        def collect(fut):
+            return fut.result(timeout=2.0)
+
+        def lookup(d, k):
+            return d.get(k)
+    """}, ["deadline-wait"])
+    assert rep.unsuppressed_by_rule("deadline-wait") == []
+
+
+def test_deadline_wait_module_level_cf_wait(tmp_path):
+    """concurrent.futures.wait(fs) takes futures positionally — only
+    an explicit timeout= bounds it."""
+    rep = lint(tmp_path, {"work.py": """
+        import concurrent.futures as cf
+
+        def gather(futs):
+            cf.wait(futs)
+
+        def gather_bounded(futs):
+            cf.wait(futs, timeout=1.0)
+    """}, ["deadline-wait"])
+    findings = rep.unsuppressed_by_rule("deadline-wait")
+    assert [f.line for f in findings] == [5]
+
+
+# -- fault-taxonomy ----------------------------------------------------------
+
+def test_fault_taxonomy_swallowed_transient(tmp_path):
+    """A swallowed 503 outside the fault plane: the bug class where a
+    storm of transient errors reads as silence."""
+    rep = lint(tmp_path, {"client.py": """
+        def fetch(store):
+            try:
+                return store.read()
+            except TransientStoreError:
+                return None
+    """}, ["fault-taxonomy"])
+    findings = rep.unsuppressed_by_rule("fault-taxonomy")
+    assert len(findings) == 1
+    assert "TransientStoreError" in findings[0].message
+
+
+def test_fault_taxonomy_hand_rolled_retry(tmp_path):
+    rep = lint(tmp_path, {"client.py": """
+        def fetch(store):
+            while True:
+                try:
+                    return store.read()
+                except OSError:
+                    continue
+    """}, ["fault-taxonomy"])
+    findings = rep.unsuppressed_by_rule("fault-taxonomy")
+    assert len(findings) == 1
+    assert "hand-rolled" in findings[0].message
+
+
+def test_fault_taxonomy_skip_loop_and_ladder_are_clean(tmp_path):
+    """for-over-collection skip loops are item-level fault isolation,
+    not retries; a retry that consults the taxonomy is the sanctioned
+    shape; the fault plane itself is whitelisted."""
+    rep = lint(tmp_path, {
+        "sweep.py": """
+            import os
+
+            def sweep(paths):
+                for p in paths:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        continue
+
+            def fetch(store):
+                while True:
+                    try:
+                        return store.read()
+                    except OSError as e:
+                        if not is_transient_error(e):
+                            raise
+                        continue
+        """,
+        "parallel/fault.py": """
+            def classify(store):
+                try:
+                    return store.read()
+                except TransientStoreError:
+                    return None
+        """,
+    }, ["fault-taxonomy"])
+    assert rep.unsuppressed_by_rule("fault-taxonomy") == []
+
+
+# -- migrated hygiene rules (fixture spot checks) ----------------------------
+
+def test_hygiene_rules_on_fixtures(tmp_path):
+    rep = lint(tmp_path, {"util.py": """
+        import socket
+        import threading
+        import time
+
+        def nap():
+            time.sleep(1)
+
+        def spin():
+            return threading.Thread(target=nap)
+
+        def quiet():
+            try:
+                nap()
+            except Exception:
+                pass
+    """}, ["sleeps", "threads", "sockets", "swallow"])
+    assert len(rep.unsuppressed_by_rule("sleeps")) == 1
+    assert len(rep.unsuppressed_by_rule("threads")) == 1
+    assert len(rep.unsuppressed_by_rule("sockets")) == 1
+    assert len(rep.unsuppressed_by_rule("swallow")) == 1
+
+
+def test_hygiene_home_modules_are_exempt(tmp_path):
+    rep = lint(tmp_path, {
+        "utils/backoff.py": "import time\n\n\ndef zz():\n"
+                            "    time.sleep(1)\n",
+        "parallel/executors.py": "import threading\n\n\n"
+                                 "def t():\n"
+                                 "    return threading.Thread()\n",
+        "service/async_server.py": "import socket\nimport selectors\n",
+    }, ["sleeps", "threads", "sockets"])
+    assert rep.unsuppressed == []
+
+
+# -- suppression machinery ---------------------------------------------------
+
+def test_suppression_reason_and_stale_and_reasonless(tmp_path):
+    rep = lint(tmp_path, {"util.py": """
+        import time
+
+        def reviewed():
+            time.sleep(1)  # lint-ok: sleeps fixture: reviewed wait
+
+        def stale():
+            return 1  # lint-ok: sleeps nothing sleeps here anymore
+
+        def reasonless():
+            time.sleep(2)  # lint-ok: sleeps
+
+        def typo():
+            return 2  # lint-ok: sleps missing rule
+    """}, ["sleeps"])
+    # the reviewed site is suppressed but still visible in the report
+    sleeps = rep.by_rule("sleeps")
+    assert len(sleeps) == 2
+    suppressed = [f for f in sleeps if f.suppressed]
+    assert len(suppressed) == 1
+    assert suppressed[0].suppress_reason == "fixture: reviewed wait"
+    # the reasonless marker does NOT suppress, and is itself flagged
+    assert len(rep.unsuppressed_by_rule("sleeps")) == 1
+    bad = rep.unsuppressed_by_rule("bad-suppression")
+    assert len(bad) == 2              # reasonless + unknown rule id
+    assert any("no reason" in f.message for f in bad)
+    assert any("unknown rule" in f.message for f in bad)
+    stale = rep.unsuppressed_by_rule("stale-suppression")
+    assert len(stale) == 1
+    assert stale[0].line == 8
+
+
+def test_suppression_comment_above_covers_next_code_line(tmp_path):
+    rep = lint(tmp_path, {"util.py": """
+        import time
+
+        def reviewed():
+            # lint-ok: sleeps reviewed wait with a reason that
+            # wraps over two comment lines
+            time.sleep(1)
+    """}, ["sleeps"])
+    assert rep.unsuppressed == []
+    assert len(rep.by_rule("sleeps")) == 1
+    assert rep.by_rule("sleeps")[0].suppressed
+
+
+def test_marker_inside_string_literal_is_inert(tmp_path):
+    """Docstrings and fixture strings that MENTION lint-ok must not
+    create live markers (they would all be stale)."""
+    rep = lint(tmp_path, {"util.py": '''
+        DOC = """use `# lint-ok: sleeps why` to exempt a wait"""
+
+        def f():
+            return DOC
+    '''}, ["sleeps"])
+    assert rep.unsuppressed == []
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_lint_json_shape(tmp_path, capsys):
+    """The machine contract external CI consumes: findings with
+    rule/file/line/message/suppressed, a summary, the rule list, and
+    exit 1 on unsuppressed findings."""
+    from paimon_tpu.cli import main
+
+    pkg = make_pkg(tmp_path, {"util.py": """
+        import time
+
+        def nap():
+            time.sleep(1)
+    """})
+    rc = main(["lint", "--json", "--package-dir", pkg,
+               "--rule", "sleeps"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["package"] == "fixturepkg"
+    assert out["files"] == 2              # __init__.py + util.py
+    assert "sleeps" in out["rules"]
+    assert "stale-suppression" in out["rules"]
+    assert out["summary"]["unsuppressed"] == 1
+    assert out["summary"]["total"] == 1
+    (f,) = out["findings"]
+    assert f["rule"] == "sleeps"
+    assert f["file"].endswith("util.py")
+    assert f["line"] == 5
+    assert f["suppressed"] is False
+    assert isinstance(f["message"], str) and f["message"]
+
+
+def test_cli_lint_clean_exit_zero(tmp_path, capsys):
+    from paimon_tpu.cli import main
+
+    pkg = make_pkg(tmp_path, {"util.py": "def f():\n    return 1\n"})
+    rc = main(["lint", "--package-dir", pkg, "--rule", "sleeps"])
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_list_rules(capsys):
+    from paimon_tpu.cli import main
+
+    assert main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("swallow", "threads", "sleeps", "sockets",
+                "collectives", "distributed-init",
+                "host-materialization", "metric-drift",
+                "options-drift", "lock-order", "loop-blocking",
+                "deadline-wait", "fault-taxonomy"):
+        assert rid in out, f"rule {rid} missing from catalog"
+
+
+# -- the production tree -----------------------------------------------------
+
+def test_production_tree_zero_unsuppressed_findings(lint_report):
+    """THE acceptance gate: the full 13-rule catalog over paimon_tpu/
+    reports zero unsuppressed findings — every new finding is either a
+    bug to fix or a deliberate pattern that needs a reviewed,
+    reasoned `# lint-ok:` marker at the site."""
+    assert lint_report.unsuppressed == [], (
+        "unsuppressed findings:\n"
+        + "\n".join(str(f) for f in lint_report.unsuppressed))
+
+
+def test_production_rule_catalog_is_complete(lint_report):
+    ids = {r.id for r in lint_report.rules}
+    assert ids >= {"swallow", "threads", "sleeps", "sockets",
+                   "collectives", "distributed-init",
+                   "host-materialization", "metric-drift",
+                   "options-drift", "lock-order", "loop-blocking",
+                   "deadline-wait", "fault-taxonomy"}
+    assert len(ids) >= 13
+
+
+def test_production_suppressions_all_carry_reasons(lint_report):
+    """Every suppressed finding in the tree has a non-empty reason
+    (the engine enforces this; this pins the contract)."""
+    suppressed = [f for f in lint_report.findings if f.suppressed]
+    assert suppressed, "expected reviewed suppressions in the tree"
+    for f in suppressed:
+        assert f.suppress_reason, f
+
+
+def test_fixed_violations_stay_fixed(lint_report):
+    """Regression notes for the genuine violations the four new rules
+    surfaced (fixed in the PR that shipped the rules):
+
+    * lookup/local_query.py `_get_or_build`: the in-flight-builder
+      wait was a bare `ev.wait()` — a caller whose deadline was spent
+      (or whose builder died) parked forever; now a bounded wait loop
+      calling check_deadline().
+    * table/topology.py `_Worker.prepare`: `done.wait()` trusted the
+      writer thread unconditionally; a wedged writer held the
+      checkpoint barrier forever; now bounded + deadline-checked.
+    * compact/manager.py `_prefetch`: the consumer's `q.get()` could
+      outlive a stalled pump; now a bounded poll that re-checks the
+      deadline (and still releases the pump via the cancel flag).
+    * compact/manager.py / core/write.py / core/commit.py: every
+      blocking `.result()` on compaction/prep/manifest futures now
+      rides utils.deadline.wait_future() — bounded polling under a
+      request deadline, plain result() without one.
+    * lookup/local_query.py `_probe`: the evicted-SST rebuild-once
+      retried EVERY OSError; it now consults
+      parallel/fault.is_transient_error so deterministic decode
+      errors surface instead of re-running the build.
+
+    The checks below pin each fix at source level so a revert
+    resurfaces here (and as an engine finding)."""
+    mods = lint_report.model.modules
+    lq = mods["lookup/local_query.py"].source
+    assert "while not ev.wait(" in lq
+    assert "is_transient_error" in lq
+    topo = mods["table/topology.py"].source
+    assert "while not done.wait(" in topo
+    mgr = mods["compact/manager.py"].source
+    assert "q.get(timeout=" in mgr
+    assert "wait_future(" in mgr
+    assert "wait_future(" in mods["core/write.py"].source
+    assert "wait_future(" in mods["core/commit.py"].source
+    # and the rules that found them stay green
+    for rid in ("deadline-wait", "fault-taxonomy", "lock-order",
+                "loop-blocking"):
+        assert lint_report.unsuppressed_by_rule(rid) == []
+
+
+def test_wait_future_contract():
+    """The sanctioned future wait: plain result() without a deadline,
+    bounded polling + DeadlineExceededError with one."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paimon_tpu.utils.deadline import (
+        DeadlineExceededError, deadline_scope, wait_future,
+    )
+
+    with ThreadPoolExecutor(1) as pool:
+        fut = pool.submit(lambda: 42)
+        assert wait_future(fut) == 42
+        fut = pool.submit(lambda: 43)
+        with deadline_scope(10_000):
+            assert wait_future(fut, poll_s=0.01) == 43
+
+        import threading
+        release = threading.Event()
+        hung = pool.submit(release.wait, 30)
+        with deadline_scope(50):
+            with pytest.raises(DeadlineExceededError):
+                wait_future(hung, poll_s=0.01)
+        release.set()           # let the worker finish; pool joins
+
+
+# -- model / engine regressions ----------------------------------------------
+
+def test_defs_in_all_compound_bodies_are_visible(tmp_path):
+    """A def can hide in ANY compound statement.  The model once
+    indexed only if/try/with BODIES — functions defined in loop
+    bodies, except handlers, else/finally branches were invisible to
+    every rule, so an unbounded wait inside one kept the tree green."""
+    rep = lint(tmp_path, {"hidden.py": """
+        def in_loop(items):
+            for it in items:
+                def load(fut):
+                    return fut.result()
+                load(it)
+
+        def in_handler(q):
+            try:
+                return None
+            except ValueError:
+                def drain():
+                    return q.get()
+                return drain()
+
+        def in_else_finally(flag, q):
+            try:
+                pass
+            finally:
+                def tail(ev):
+                    ev.wait()
+                tail(flag)
+    """}, ["deadline-wait"])
+    findings = rep.unsuppressed_by_rule("deadline-wait")
+    kinds = "\n".join(f.message for f in findings)
+    assert len(findings) == 3, kinds
+    assert "future-result" in kinds
+    assert "queue-get" in kinds
+
+
+def test_lock_order_cycle_through_recursive_chain(tmp_path):
+    """Mutually recursive callees must not poison the transitive-
+    acquire memo: a result computed while an ancestor is on the DFS
+    stack is INCOMPLETE and memoizing it permanently dropped the
+    cycle's lock contributions — the textbook inversion below went
+    unreported."""
+    rep = lint(tmp_path, {"service/recur.py": """
+        import threading
+
+        ALPHA_LOCK = threading.Lock()
+        BETA_LOCK = threading.Lock()
+
+        def thread_one():
+            with ALPHA_LOCK:
+                take_alpha()
+
+        def thread_two():
+            with BETA_LOCK:
+                take_beta()
+
+        def take_alpha():
+            with ALPHA_LOCK:
+                take_beta()
+
+        def take_beta():
+            with BETA_LOCK:
+                take_alpha()
+    """}, ["lock-order"])
+    findings = rep.unsuppressed_by_rule("lock-order")
+    assert len(findings) == 1
+    assert "ALPHA_LOCK" in findings[0].message
+    assert "BETA_LOCK" in findings[0].message
+
+
+def test_nested_def_is_not_a_method(tmp_path):
+    """A def nested inside a method is a closure, not a method:
+    registering it let `self.<name>()` resolve to it, producing
+    phantom call edges (here: a false 'guaranteed self-deadlock' on a
+    call that is an AttributeError at runtime)."""
+    rep = lint(tmp_path, {"service/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def maker(self):
+                def helper():
+                    with self._lock:
+                        pass
+                return helper
+
+            def other(self, obj):
+                with self._lock:
+                    obj.helper()
+    """}, ["lock-order"])
+    assert rep.unsuppressed_by_rule("lock-order") == []
+
+
+def test_nested_def_does_not_shadow_real_method(tmp_path):
+    """A later nested def sharing a real method's name must not
+    overwrite it in the class's method table — self-call edges would
+    silently redirect to the closure."""
+    rep = lint(tmp_path, {"service/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def evict(self):
+                with self._lock:
+                    pass
+
+            def later(self):
+                def evict():
+                    return None
+                return evict()
+
+            def put(self):
+                with self._lock:
+                    self.evict()
+    """}, ["lock-order"])
+    findings = rep.unsuppressed_by_rule("lock-order")
+    # put() -> the REAL evict() re-acquires the held lock
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_wait_future_done_in_race_window():
+    """Future.result(timeout=) can raise TimeoutError after the
+    worker completed (the wait's lock is released before the raise).
+    wait_future must answer with the WORKER's outcome, not re-raise
+    the poll's timeout as if the worker failed."""
+    import concurrent.futures as cf
+
+    from paimon_tpu.utils.deadline import deadline_scope, wait_future
+
+    class RacyFuture(cf.Future):
+        """First timed result() raises TimeoutError even though the
+        future is done — the race window, made deterministic."""
+
+        def __init__(self):
+            super().__init__()
+            self._raced = False
+
+        def result(self, timeout=None):
+            if timeout is not None and not self._raced:
+                self._raced = True
+                raise cf.TimeoutError()
+            return super().result(timeout)
+
+    fut = RacyFuture()
+    fut.set_result("the-value")
+    with deadline_scope(5_000):
+        assert wait_future(fut, poll_s=0.01) == "the-value"
+
+    # a worker that genuinely raised TimeoutError still propagates it
+    fut = RacyFuture()
+    fut.set_exception(cf.TimeoutError("worker timed out"))
+    with deadline_scope(5_000):
+        with pytest.raises(cf.TimeoutError, match="worker timed out"):
+            wait_future(fut, poll_s=0.01)
+
+
+def test_meta_rule_ids_round_trip(tmp_path, capsys):
+    """Every report's `rules` array advertises bad-suppression /
+    stale-suppression — an id copied from the JSON back into --rule
+    (or run()) must be accepted, and an unknown id must raise a
+    usable error, not a bare KeyError."""
+    from paimon_tpu.analysis import run_package
+    from paimon_tpu.cli import main
+
+    pkg = make_pkg(tmp_path, {"util.py": "def f():\n    return 1\n"})
+    rep = run_package(pkg, rule_ids=["sleeps", "stale-suppression"])
+    assert rep.unsuppressed == []
+    assert main(["lint", "--package-dir", pkg,
+                 "--rule", "bad-suppression"]) == 0
+    capsys.readouterr()
+    with pytest.raises(ValueError, match="unknown rule id 'typo'"):
+        run_package(pkg, rule_ids=["typo"])

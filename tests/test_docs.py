@@ -1,4 +1,10 @@
-"""Docs generator drift check (paimon-docs analog)."""
+"""Docs generator drift check (paimon-docs analog).
+
+The tier-1 drift assertion now rides the analysis engine's
+options-drift rule (one shared pass, structured findings); the
+generator's own behaviors (CLI --check exit code, duplicate-key
+detection) keep their direct tests.
+"""
 
 import os
 import subprocess
@@ -7,8 +13,16 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_options_doc_up_to_date():
-    """docs/options.md regenerates cleanly from paimon_tpu/options.py."""
+def test_options_doc_up_to_date(lint_report):
+    """docs/options.md regenerates cleanly from paimon_tpu/options.py
+    — the engine's options-drift rule, wrapped for tier-1."""
+    offenders = lint_report.unsuppressed_by_rule("options-drift")
+    assert offenders == [], [f.message for f in offenders]
+
+
+def test_generate_options_check_exit_code():
+    """The CLI contract external tooling uses: --check exits 0 when
+    docs/options.md is current."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "docs",
                                       "generate_options.py"), "--check"],

@@ -198,29 +198,17 @@ def test_compact_timer_concurrent_start_stop():
 
 # -- metric-name drift -------------------------------------------------------
 
-def test_metric_name_constants_are_produced():
+def test_metric_name_constants_are_produced(lint_report):
     """Every exported ALL_CAPS metric-name constant in metrics.py must
     be referenced by name somewhere else in paimon_tpu/ — an orphaned
-    constant means a dashboard/test greps for a metric nothing emits
-    (grep-based, like the options drift test in test_docs.py)."""
+    constant means a dashboard/test greps for a metric nothing emits.
+    Now an engine rule (metric-drift) over the shared program model;
+    this is its tier-1 wrapper."""
     import paimon_tpu.metrics as M
 
-    pkg = os.path.join(REPO, "paimon_tpu")
-    sources = []
-    for root, dirs, files in os.walk(pkg):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            if os.path.samefile(path, M.__file__):
-                continue
-            with open(path) as f:
-                sources.append(f.read())
-    blob = "\n".join(sources)
     consts = [n for n in M.__all__ if n.isupper()]
     assert len(consts) >= 20               # the list actually exports
-    missing = [n for n in consts if n not in blob]
-    assert missing == [], (
+    offenders = lint_report.unsuppressed_by_rule("metric-drift")
+    assert offenders == [], (
         f"metric-name constants with no producer in paimon_tpu/: "
-        f"{missing}")
+        f"{[f.message for f in offenders]}")
