@@ -791,6 +791,80 @@ def compose_chaos(result):
     }
 
 
+def plan_child_main():
+    """BENCH_PLAN_CHILD=1 mode: the incremental-metadata-plane
+    benchmark (ISSUE 15 acceptance — a synthetic million-file table
+    where steady-state delta-applied plan latency is flat in total
+    live-file count and >=20x the cold full walk, the post-commit
+    re-plan's manifest reads op-counted, and vectorized sidecar
+    pruning measured on/off).  Prints one JSON line for the parent."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks.plan_bench import measure_plan
+
+    scales = tuple(
+        int(s) for s in os.environ.get(
+            "BENCH_PLAN_SCALES", "10000,100000,1000000").split(","))
+    print(json.dumps(measure_plan(scales=scales)))
+
+
+def run_plan_child(timeout, scales=None):
+    """Run plan_child_main in a CPU subprocess; parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(BENCH_PLAN_CHILD="1", JAX_PLATFORMS="cpu")
+    if scales:
+        env["BENCH_PLAN_SCALES"] = ",".join(str(s) for s in scales)
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, cwd=_REPO, text=True,
+                              capture_output=True,
+                              timeout=max(30.0, timeout))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench plan child: timeout\n")
+        return None
+    if proc.returncode != 0:
+        sys.stderr.write(f"bench plan child rc={proc.returncode}:\n"
+                         f"{proc.stderr[-4000:]}\n")
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sys.stderr.write(f"bench plan child: unparseable output\n"
+                         f"{proc.stdout[-2000:]}\n")
+        return None
+
+
+def compose_plan(result):
+    """The incremental-metadata-plane metric block attached under
+    "metadata_plane" in the one official JSON line: cold-vs-delta
+    plan speedup at the largest scale, with per-scale latencies, the
+    op-count audit and the pruning matrix nested."""
+    if result is None:
+        return None
+    scales = result.get("scales") or []
+    if not scales:
+        return None
+    top = scales[-1]
+    ops = top.get("delta_replan_ops") or {}
+    return {
+        "metric": "plan_cold_vs_delta_applied",
+        "value": top.get("cold_vs_delta", 0.0),
+        "unit": (f"x (cold full walk {top.get('cold_plan_ms')}ms vs "
+                 f"delta-applied re-plan {top.get('delta_plan_ms')}ms "
+                 f"at {top.get('files')} live files; delta flatness "
+                 f"{result.get('delta_flatness')}x across "
+                 f"{scales[0].get('files')}->{top.get('files')} files; "
+                 f"post-commit re-plan read "
+                 f"{ops.get('manifest_reads')} manifest + "
+                 f"{ops.get('list_reads')} list; bucket-prune "
+                 f"{top.get('prune_off_ms')}ms -> "
+                 f"{top.get('prune_on_ms')}ms with "
+                 f"{top.get('manifests_pruned')} manifests pruned)"),
+        "delta_flatness": result.get("delta_flatness"),
+        "scales": scales,
+    }
+
+
 def multihost_child_main():
     """BENCH_MULTIHOST_CHILD=1 mode: the multi-host write-plane
     benchmark (ISSUE 10 acceptance — 1-proc vs 2-proc ingest of the
@@ -1265,6 +1339,27 @@ def main():
                          f"{None if ch is None else ch['value']}, "
                          f"remaining {_remaining():.0f}s\n")
 
+    # incremental-metadata-plane metric (ISSUE 15's acceptance): the
+    # full 10k/100k/1M child is ~280s wall measured in-env (the 1M
+    # synthetic build + its one cold walk dominate); tighter budgets
+    # drop the 1M scale rather than the block
+    plan_scales = None
+    if _remaining() > 360:
+        plan_scales = (10_000, 100_000, 1_000_000)
+    elif _remaining() > 140:
+        plan_scales = (10_000, 100_000)
+    elif _remaining() > 60:
+        plan_scales = (10_000,)
+    if plan_scales:
+        pl = compose_plan(run_plan_child(timeout=_remaining() - 30,
+                                         scales=plan_scales))
+        if pl is not None:
+            final["metadata_plane"] = pl
+            _BANKED["json"] = final
+        sys.stderr.write(f"bench: plan metric "
+                         f"{None if pl is None else pl['value']}, "
+                         f"remaining {_remaining():.0f}s\n")
+
     # multi-host write metric (ISSUE 10's acceptance): the child is
     # ~60s wall measured in-env (1M-row single ingest + 2-proc gloo
     # mesh bring-up + ingest + identity scan); banked incrementally
@@ -1289,6 +1384,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if os.environ.get("BENCH_SCAN_CHILD") == "1":
         scan_child_main()
+        sys.exit(0)
+    if os.environ.get("BENCH_PLAN_CHILD") == "1":
+        plan_child_main()
         sys.exit(0)
     if os.environ.get("BENCH_CHAOS_CHILD") == "1":
         chaos_child_main()

@@ -303,6 +303,28 @@ def bench_tier():
             latencies=[0, 10])
 
 
+def bench_plan():
+    """Incremental metadata plane trajectory (full 10k/100k/1M matrix
+    in benchmarks/plan_bench.py via bench.py's metadata_plane block;
+    this entry keeps a 20k-file cold-vs-delta-applied plan comparison
+    plus the bucket-prune legs in the micro record)."""
+    from benchmarks.plan_bench import measure_plan
+    files = min(max(ROWS // 50, 5_000), 20_000)
+    r = measure_plan(scales=(files,), delta_reps=3)
+    s = r["scales"][0]
+    for name, value, unit in (
+            ("plan_cold_ms", s["cold_plan_ms"], "ms"),
+            ("plan_delta_ms", s["delta_plan_ms"], "ms"),
+            ("plan_cold_vs_delta", s["cold_vs_delta"], "x"),
+            ("plan_prune_speedup",
+             round(s["prune_off_ms"] / max(s["prune_on_ms"], 1e-6), 2),
+             "x")):
+        print(json.dumps({"benchmark": name, "value": value,
+                          "unit": unit, "files": s["files"],
+                          "platform": _PLATFORM,
+                          "device_kind": _DEVICE_KIND}), flush=True)
+
+
 def bench_multihost():
     """Multi-host write-plane trajectory (full 1M-row matrix in
     benchmarks/multihost_bench.py via bench.py's multihost_write
@@ -326,6 +348,7 @@ BENCHES = {
     "serve": bench_serve,
     "tier": bench_tier,
     "multihost": bench_multihost,
+    "plan": bench_plan,
 }
 
 

@@ -1,0 +1,244 @@
+"""Plan-latency benchmark: the incremental metadata plane at scale
+(ISSUE 15 / ROADMAP item 4 acceptance).
+
+Builds a synthetic table whose manifest chain references N live data
+files WITHOUT writing any data bytes (planning never opens data
+files), then measures at each scale:
+
+* cold   — full manifest walk, plan cache reset first;
+* delta  — steady-state streaming re-plan: one small commit, then a
+           warm plan that advances the cached state by ONLY that
+           commit's delta manifests (op-count audited on the FileIO:
+           the re-plan must fetch exactly the delta manifest list +
+           the manifest files it names);
+* prune  — key-range-filtered cold walk with the columnar stats
+           sidecar (vectorized, pruned manifests never fetched) vs
+           with pruning disabled.
+
+Acceptance: delta-applied latency flat in total live-file count and
+>= 20x the cold walk at 1M files; results land in bench.py's
+`metadata_plane` block (BENCH_r10) and micro.py's "plan" entry.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from paimon_tpu.core.commit import FileStoreCommit
+from paimon_tpu.core.plan_cache import reset_plan_caches
+from paimon_tpu.core.write import CommitMessage
+from paimon_tpu.data.binary_row import BinaryRowCodec
+from paimon_tpu.manifest import DataFileMeta
+from paimon_tpu.manifest.simple_stats import SimpleStats
+from paimon_tpu.metrics import (
+    PLAN_MANIFESTS_PRUNED, PLAN_MANIFESTS_READ, global_registry,
+)
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType
+
+__all__ = ["build_synthetic_table", "measure_plan"]
+
+_ROWS_PER_FILE = 1000
+
+
+def _schema(buckets: int) -> Schema:
+    return (Schema.builder()
+            .column("id", BigIntType(False))
+            .column("v", DoubleType())
+            .primary_key("id")
+            .options({"bucket": str(buckets), "write-only": "true",
+                      # the chain shape under continuous streaming:
+                      # one delta manifest per commit, folded only by
+                      # the explicit manifest full-compaction below
+                      "manifest.merge-min-count": "1000000",
+                      # synthetic entries are tiny: keep compacted
+                      # base manifests small enough that the chain
+                      # stays a CHAIN (pruning has units to skip)
+                      "manifest.target-file-size": "256kb"})
+            .build())
+
+
+def _file_meta(codec: BinaryRowCodec, idx: int) -> DataFileMeta:
+    """Synthetic 1k-row data file covering the key band
+    [idx*1000, idx*1000+999] — bands are disjoint so per-manifest key
+    stats stay selective (the clustered production shape)."""
+    lo = idx * _ROWS_PER_FILE
+    hi = lo + _ROWS_PER_FILE - 1
+    min_key = codec.to_bytes((lo,))
+    max_key = codec.to_bytes((hi,))
+    return DataFileMeta(
+        file_name=f"data-plan-{idx}.parquet",
+        file_size=1 << 20,
+        row_count=_ROWS_PER_FILE,
+        min_key=min_key,
+        max_key=max_key,
+        key_stats=SimpleStats(min_key, max_key, [0]),
+        value_stats=SimpleStats.EMPTY,
+        min_sequence_number=lo,
+        max_sequence_number=hi,
+        schema_id=0,
+        level=1,
+    )
+
+
+def build_synthetic_table(path: str, files: int, buckets: int = 64,
+                          files_per_commit: int = 2000
+                          ) -> FileStoreTable:
+    """A table whose manifest chain holds `files` live entries (no
+    data bytes on disk — planning is pure metadata), full-compacted
+    once so the base is sorted/clustered like production, with a tail
+    of delta commits on top."""
+    table = FileStoreTable.create(path, _schema(buckets))
+    codec = BinaryRowCodec([BigIntType(False)])
+    commit = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options, commit_user="plan-bench")
+    idx = 0
+    while idx < files:
+        n = min(files_per_commit, files - idx)
+        msgs: Dict[int, List[DataFileMeta]] = {}
+        for i in range(idx, idx + n):
+            msgs.setdefault(i % buckets, []).append(
+                _file_meta(codec, i))
+        commit.commit([CommitMessage((), b, buckets, new_files=fs)
+                       for b, fs in sorted(msgs.items())],
+                      commit_identifier=idx)
+        idx += n
+    # production base: one full manifest compaction clusters the
+    # chain; the delta tail on top is what steady-state plans fold
+    table.compact_manifests(force=True)
+    return table
+
+
+class _CountingFileIO:
+    """Counts manifest-plane reads (lists vs manifests) by path."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.manifest_reads = 0
+        self.list_reads = 0
+
+    def read_bytes(self, path, *a, **k):
+        name = path.rsplit("/", 1)[-1]
+        if "/manifest/" in path:
+            if name.startswith("manifest-list-"):
+                self.list_reads += 1
+            elif not name.startswith("stats-"):
+                self.manifest_reads += 1
+        return self._inner.read_bytes(path, *a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _plan_once(table) -> float:
+    t0 = time.perf_counter()
+    plan = table.new_scan().plan()
+    dt = time.perf_counter() - t0
+    assert plan.splits
+    return dt
+
+
+def measure_plan(scales=(10_000, 100_000, 1_000_000),
+                 buckets: int = 64, delta_reps: int = 5,
+                 workdir: Optional[str] = None, emit=None) -> dict:
+    """The full matrix; returns the bench record (and emits one
+    BENCH_MICRO-style line per (scale, mode) via `emit`)."""
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="paimon-plan-bench-")
+    codec = BinaryRowCodec([BigIntType(False)])
+    out = {"scales": [], "rows_per_file": _ROWS_PER_FILE,
+           "buckets": buckets}
+    try:
+        for files in scales:
+            path = f"{workdir}/t{files}"
+            t_build = time.perf_counter()
+            table = build_synthetic_table(path, files, buckets=buckets)
+            build_s = time.perf_counter() - t_build
+
+            # cold: full walk, nothing cached
+            reset_plan_caches()
+            cold_s = _plan_once(table)
+
+            # steady state: warm plan, then commit->re-plan cycles
+            _plan_once(table)
+            commit = FileStoreCommit(table.file_io, table.path,
+                                     table.schema, table.options,
+                                     commit_user="plan-bench-delta")
+            delta_times = []
+            for rep in range(delta_reps):
+                commit.commit(
+                    [CommitMessage((), 0, buckets, new_files=[
+                        _file_meta(codec, files + rep)])],
+                    commit_identifier=10_000_000 + rep)
+                delta_times.append(_plan_once(table))
+            delta_s = sorted(delta_times)[len(delta_times) // 2]
+
+            # op-count audit: one more commit, the warm re-plan reads
+            # exactly that snapshot's delta manifest list + manifests
+            commit.commit(
+                [CommitMessage((), 0, buckets, new_files=[
+                    _file_meta(codec, files + delta_reps)])],
+                commit_identifier=10_000_000 + delta_reps)
+            cio = _CountingFileIO(table.file_io)
+            watched = FileStoreTable(cio, table.path,
+                                     table.schema_manager.latest(),
+                                     branch=table.branch)
+            watched.new_scan().plan()
+            delta_ops = {"manifest_reads": cio.manifest_reads,
+                         "list_reads": cio.list_reads}
+
+            # pruning legs: single-bucket scan (the lookup/point-read
+            # shape) over the (partition, bucket, key)-clustered base,
+            # sidecar on vs off — vectorized bucket-range pruning
+            # skips whole manifests before any fetch
+            pm = global_registry().plan_metrics()
+            uncached = table.copy({"scan.plan.cache": "false"})
+            p0 = pm.counter(PLAN_MANIFESTS_PRUNED).count
+            r0 = pm.counter(PLAN_MANIFESTS_READ).count
+            t0 = time.perf_counter()
+            uncached.new_scan().with_buckets([0]).plan()
+            prune_on_s = time.perf_counter() - t0
+            pruned = pm.counter(PLAN_MANIFESTS_PRUNED).count - p0
+            read_on = pm.counter(PLAN_MANIFESTS_READ).count - r0
+            no_sidecar = table.copy({"scan.plan.cache": "false",
+                                     "manifest.stats.sidecar": "false"})
+            t0 = time.perf_counter()
+            no_sidecar.new_scan().with_buckets([0]).plan()
+            prune_off_s = time.perf_counter() - t0
+
+            rec = {
+                "files": files,
+                "build_s": round(build_s, 3),
+                "cold_plan_ms": round(cold_s * 1000, 3),
+                "delta_plan_ms": round(delta_s * 1000, 3),
+                "cold_vs_delta": round(cold_s / delta_s, 2),
+                "delta_replan_ops": delta_ops,
+                "prune_on_ms": round(prune_on_s * 1000, 3),
+                "prune_off_ms": round(prune_off_s * 1000, 3),
+                "manifests_pruned": int(pruned),
+                "manifests_read_filtered": int(read_on),
+            }
+            out["scales"].append(rec)
+            if emit is not None:
+                emit({"benchmark": f"plan_{files}", **rec})
+            shutil.rmtree(path, ignore_errors=True)
+        first, last = out["scales"][0], out["scales"][-1]
+        out["delta_flatness"] = round(
+            last["delta_plan_ms"] / max(first["delta_plan_ms"], 1e-6),
+            2)
+        out["speedup_at_max_scale"] = last["cold_vs_delta"]
+        return out
+    finally:
+        reset_plan_caches()
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure_plan(
+        emit=lambda rec: print(json.dumps(rec), flush=True))))

@@ -190,6 +190,10 @@ def cmd_table(args):
         with _TraceScope(getattr(args, "trace", None)):
             sid = table.compact(full=args.full)
         print(f"snapshot {sid}" if sid else "nothing to do")
+    elif cmd == "compact-manifests":
+        table = _table(catalog, args.table)
+        sid = table.compact_manifests(force=not args.if_needed)
+        print(f"snapshot {sid}" if sid else "nothing to do")
     elif cmd == "import":
         table = _table(catalog, args.table)
         path = args.file
@@ -471,6 +475,14 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--trace", metavar="OUT.json",
                    help="trace the compaction; write Chrome "
                         "trace-event JSON (opens in Perfetto)")
+    c = tsub.add_parser(
+        "compact-manifests",
+        help="fold accumulated delta manifests into sorted, "
+             "partition-clustered base manifests")
+    c.add_argument("table")
+    c.add_argument("--if-needed", action="store_true",
+                   help="run only when the manifest.full-compaction."
+                        "threshold trigger fires")
     c = tsub.add_parser("import")
     c.add_argument("table")
     c.add_argument("file", help="csv/json/parquet file")

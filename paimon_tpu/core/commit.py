@@ -53,9 +53,16 @@ class FileStoreCommit:
         codec = {"zstd": "zstandard", "none": "null"}.get(compression,
                                                           compression)
         mdir = self.path_factory.manifest_dir
+        key_types = [rt.get_field(k).type
+                     for k in table_schema.trimmed_primary_keys()]
+        sidecar = bool(options.get(CoreOptions.MANIFEST_STATS_SIDECAR))
         self.manifest_file = ManifestFile(file_io, mdir, codec,
-                                          self.partition_types)
-        self.manifest_list = ManifestList(file_io, mdir, codec)
+                                          self.partition_types,
+                                          key_types=key_types,
+                                          sidecar=sidecar)
+        self.manifest_list = ManifestList(
+            file_io, mdir, codec, partition_types=self.partition_types,
+            key_types=key_types, sidecar=sidecar)
         self.index_manifest_file = IndexManifestFile(file_io, mdir, codec)
         self.manifest_target_size = options.get(
             CoreOptions.MANIFEST_TARGET_FILE_SIZE)
@@ -560,20 +567,33 @@ class FileStoreCommit:
                         f"{e.file.file_name}; a concurrent compaction "
                         f"wrote this level. Retry from the new snapshot.")
 
-    def compact_manifests(self, skip_missing: bool = False
+    def compact_manifests(self, skip_missing: bool = False,
+                          properties: Optional[Dict[str, str]] = None
                           ) -> Optional[int]:
         """Force one full manifest rewrite: every base+delta manifest is
         read, DELETE entries are folded away, and the merged entry set
-        is committed as a COMPACT snapshot with an empty delta
-        (reference flink/procedure/CompactManifestProcedure). Returns
-        the new snapshot id, or None when the table has no snapshot.
-        `skip_missing` tolerates manifest FILES deleted out of band
-        (reference RemoveUnexistingManifestsProcedure) — entries they
-        held are lost, which is the point of that repair."""
+        is committed as a COMPACT snapshot with an empty delta — the
+        base rewritten as sorted, partition-clustered, size-bounded
+        manifests (reference flink/procedure/CompactManifestProcedure +
+        manifest full-compaction). Returns the new snapshot id, or None
+        when the table has no snapshot.  `skip_missing` tolerates
+        manifest FILES deleted out of band (reference
+        RemoveUnexistingManifestsProcedure) — entries they held are
+        lost, which is the point of that repair.
+
+        A pure full-compaction commits as COMPACT with an empty delta
+        — the live-entry set is unchanged, so the delta-apply plan
+        cache folds it as a no-op.  The `skip_missing` repair DROPS
+        entries without DELETE records, so it commits as OVERWRITE:
+        every cached plan (this process or any other) invalidates
+        instead of serving ghost entries for files the repair
+        removed."""
         if self.snapshot_manager.latest_snapshot() is None:
             return None
         return self._try_commit([], [], BATCH_COMMIT_IDENTIFIER,
-                                CommitKind.COMPACT,
+                                CommitKind.OVERWRITE if skip_missing
+                                else CommitKind.COMPACT,
+                                properties=properties,
                                 force_full_manifest_merge=True,
                                 skip_missing_manifests=skip_missing)
 
@@ -598,16 +618,33 @@ class FileStoreCommit:
                     # repair mode: the manifest is gone, its entries
                     # are unrecoverable — drop it from the chain
             merged = merge_manifest_entries(entries)
-            # the rewrite KNOWS thetrue row total; expose it so the
+            # the rewrite KNOWS the true row total; expose it so the
             # snapshot does not inherit counts from dropped manifests
             self._force_merge_total = sum(
                 e.file.row_count for e in merged
                 if e.kind == FileKind.ADD)
             if not merged:
                 return [], []
-            meta = self.manifest_file.write(merged,
-                                            schema_id=self.schema.id)
-            return [meta], [meta]
+            # sorted, partition-clustered, size-bounded base manifests
+            # (reference Paimon manifest full-compaction): each output
+            # manifest covers a narrow (partition, bucket, key) band,
+            # so the per-manifest stats the columnar sidecar persists
+            # stay selective and the vectorized prune keeps whole
+            # manifests unfetched.  Raw-byte key order is a clustering
+            # heuristic only — correctness never depends on it.
+            merged.sort(key=lambda e: (e.partition, e.bucket,
+                                       e.file.min_key or b""))
+            total_size = sum(m.file_size for m in metas)
+            total_entries = sum(m.num_added_files + m.num_deleted_files
+                                for m in metas) or 1
+            per_entry = max(64, total_size // total_entries) \
+                if total_size else 256
+            chunk = max(1, int(self.manifest_target_size // per_entry))
+            out = []
+            for i in range(0, len(merged), chunk):
+                out.append(self.manifest_file.write(
+                    merged[i:i + chunk], schema_id=self.schema.id))
+            return out, list(out)
         if len(metas) < self.manifest_merge_min:
             return metas, []
         small = [m for m in metas if m.file_size < self.manifest_target_size]

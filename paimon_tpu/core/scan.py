@@ -4,11 +4,29 @@ DataSplits.
 reference: operation/AbstractFileStoreScan.java (manifest pruning),
 table/source/SnapshotReaderImpl.java:87 (generateSplits:412),
 MergeTreeSplitGenerator.java:38, DataSplit.java:62.
+
+Incremental metadata plane (ours; ROADMAP item 4):
+
+* **Delta-apply plan reuse** — `plan()` consults the process-shared
+  plan cache (core/plan_cache.py): with a cached live-entry state at
+  snapshot N, a plan for N+k reads ONLY the delta manifest lists of
+  snapshots N+1..N+k and folds ADD/DELETE entries into the cached
+  groups; OVERWRITE commits, expired snapshots, unknown DELETEs and
+  recreated snapshot ids invalidate back to the cold walk.  A second
+  level reuses GENERATED splits per filter signature, regenerating
+  only the (partition, bucket) groups the deltas touched — the
+  steady-state streaming re-plan is O(delta) end to end.
+* **Vectorized manifest pruning** — `_prune_manifests` evaluates
+  partition/bucket/key-range predicates against whole manifest lists
+  at once via the columnar stats sidecar
+  (manifest/stats_sidecar.py), so pruned manifests are never fetched
+  and none of their entries are decoded (the `plan` metric group's
+  entries_decoded counter is the proof meter).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass, field as dc_field, replace as dc_replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from paimon_tpu.data.binary_row import BinaryRowCodec
@@ -20,7 +38,7 @@ from paimon_tpu.manifest import (
 from paimon_tpu.options import CoreOptions
 from paimon_tpu.predicate import Predicate
 from paimon_tpu.schema.table_schema import TableSchema
-from paimon_tpu.snapshot import Snapshot, SnapshotManager
+from paimon_tpu.snapshot import CommitKind, Snapshot, SnapshotManager
 from paimon_tpu.utils.path_factory import FileStorePathFactory
 
 __all__ = ["DataSplit", "ScanPlan", "FileStoreScan"]
@@ -71,18 +89,43 @@ class FileStoreScan:
         self.snapshot_manager = SnapshotManager(file_io, table_path, branch)
         self.path_factory = FileStorePathFactory.from_options(
             table_path, schema.partition_keys, options)
+        self.branch = branch
         rt = schema.logical_row_type()
         self.partition_types = [rt.get_field(k).type
                                 for k in schema.partition_keys]
+        self.key_types = [rt.get_field(k).type
+                          for k in schema.trimmed_primary_keys()]
         self._partition_codec = BinaryRowCodec(self.partition_types)
         compression = options.get(CoreOptions.MANIFEST_COMPRESSION)
         codec = {"zstd": "zstandard", "none": "null"}.get(compression,
                                                           compression)
         mdir = self.path_factory.manifest_dir
+        sidecar = bool(options.get(CoreOptions.MANIFEST_STATS_SIDECAR))
         self.manifest_file = ManifestFile(file_io, mdir, codec,
-                                          self.partition_types)
-        self.manifest_list = ManifestList(file_io, mdir, codec)
+                                          self.partition_types,
+                                          key_types=self.key_types,
+                                          sidecar=sidecar)
+        self.manifest_list = ManifestList(
+            file_io, mdir, codec, partition_types=self.partition_types,
+            key_types=self.key_types, sidecar=sidecar)
         self.index_manifest_file = IndexManifestFile(file_io, mdir, codec)
+        # plan metric group, pre-allocated so the Prometheus endpoint
+        # always renders the series (the whole incremental metadata
+        # plane reports here; manifest_compactions' producer is
+        # maintenance/manifest_compact.py)
+        from paimon_tpu.metrics import (
+            PLAN_DELTA_APPLIES, PLAN_ENTRIES_DECODED,
+            PLAN_MANIFEST_COMPACTIONS, PLAN_MANIFESTS_PRUNED,
+            PLAN_MANIFESTS_READ, PLAN_MS, PLAN_PLANS, global_registry,
+        )
+        pm = global_registry().plan_metrics()
+        self._m_plans = pm.counter(PLAN_PLANS)
+        self._m_plan_ms = pm.histogram(PLAN_MS)
+        self._m_delta_applies = pm.counter(PLAN_DELTA_APPLIES)
+        self._m_manifests_read = pm.counter(PLAN_MANIFESTS_READ)
+        self._m_manifests_pruned = pm.counter(PLAN_MANIFESTS_PRUNED)
+        self._m_entries_decoded = pm.counter(PLAN_ENTRIES_DECODED)
+        pm.counter(PLAN_MANIFEST_COMPACTIONS)
         self._partition_filter: Optional[dict] = None
         self._bucket_filter: Optional[set] = None
         self._file_index_cache: Dict[str, object] = {}
@@ -125,15 +168,84 @@ class FileStoreScan:
             snapshot = self.snapshot_manager.latest_snapshot()
         if snapshot is None:
             return ScanPlan(None, [], streaming=streaming)
-        entries = self.read_entries(snapshot)
-        plan = ScanPlan(snapshot.id, self.generate_splits(
-            snapshot.id, entries, for_streaming=streaming,
-            snapshot=snapshot),
-            streaming=streaming)
+        splits = self._plan_splits(snapshot, streaming)
+        plan = ScanPlan(snapshot.id, splits, streaming=streaming)
+        dt_ms = (_time.perf_counter() - t0) * 1000
+        self._m_plans.inc()
+        self._m_plan_ms.update(dt_ms)
         g = global_registry().group("scan")
-        g.histogram("plan_ms").update((_time.perf_counter() - t0) * 1000)
+        g.histogram("plan_ms").update(dt_ms)
         g.counter("plans").inc()
         return plan
+
+    def _plan_splits(self, snapshot: Snapshot,
+                     streaming: bool) -> List[DataSplit]:
+        """Split set for one snapshot, via the plan cache when a state
+        can be served/advanced, else the classic pruned cold walk."""
+        cache = self._plan_cache()
+        if cache is not None:
+            state = cache.state()
+            if state is not None:
+                if state.snapshot_id == snapshot.id:
+                    if state.matches_tip(snapshot):
+                        return self._splits_from_state(
+                            cache, state, snapshot, streaming,
+                            touched=frozenset(),
+                            split_base_id=snapshot.id)
+                    # recreated snapshot id (rollback/fast-forward):
+                    # the cached state describes different content
+                    cache.drop_state(state)
+                elif state.snapshot_id < snapshot.id:
+                    adv = self._advance_state(state, snapshot)
+                    if adv is not None:
+                        new_state, touched = adv
+                        cache.put_state(new_state, state)
+                        self._m_delta_applies.inc()
+                        return self._splits_from_state(
+                            cache, new_state, snapshot, streaming,
+                            touched=touched,
+                            split_base_id=state.snapshot_id)
+                    cache.drop_state(state)
+                elif self._state_anchor_alive(state):
+                    # genuine time travel to an OLDER snapshot: serve
+                    # it from a cold walk without disturbing the
+                    # cached tip
+                    cache = None
+                else:
+                    # ROLLED-BACK tip: our higher-id anchor snapshot
+                    # is gone — drop the dead state (else every plan
+                    # pays an uncached cold walk until the id climbs
+                    # back past it) and rebuild at this snapshot
+                    cache.drop_state(state)
+        if cache is not None and self._partition_filter is None \
+                and self._bucket_filter is None and \
+                self._key_prune_bounds() is None and \
+                not cache.over_bound(snapshot.id):
+            # unfiltered cold walk: the full live-entry set is exactly
+            # the cache state — build it once, then generate from it.
+            # (prunable key bounds take the fallback instead: the
+            # sidecar can skip whole manifests there, while this walk
+            # would fetch every one)
+            state, live = self._cold_state(snapshot)
+            if state is not None:
+                cache.put_state(state, None)
+                return self._splits_from_state(
+                    cache, state, snapshot, streaming,
+                    touched=None, split_base_id=None)
+            # over scan.plan.cache.max-entries: the walk already
+            # decoded the full live set — generate from it instead of
+            # re-walking, and remember the verdict so later plans on
+            # this tip go straight to the pruned fallback
+            cache.mark_over_bound(snapshot.id)
+            return self.generate_splits(snapshot.id, live,
+                                        for_streaming=streaming,
+                                        snapshot=snapshot)
+        # filtered (or cache-disabled / over-bound) cold walk: the
+        # vectorized manifest prune keeps whole manifests unfetched
+        entries = self.read_entries(snapshot, _use_cache=False)
+        return self.generate_splits(snapshot.id, entries,
+                                    for_streaming=streaming,
+                                    snapshot=snapshot)
 
     def plan_delta(self, snapshot: Snapshot,
                    streaming: bool = False) -> ScanPlan:
@@ -164,58 +276,366 @@ class FileStoreScan:
                                              snapshot=snapshot),
                         streaming=streaming)
 
-    def read_entries(self, snapshot: Snapshot) -> List[ManifestEntry]:
+    def read_entries(self, snapshot: Snapshot,
+                     _use_cache: bool = True) -> List[ManifestEntry]:
+        """Live (merged, ADD-only) entry set at one snapshot.  Served
+        from — and feeding — the delta-apply plan cache when it can;
+        may return a SUPERSET of a filtered scan's visible entries
+        (manifest-level pruning is conservative; callers apply their
+        own per-entry filters, and `plan()` runs `_entry_visible`)."""
+        cache = self._plan_cache() if _use_cache else None
+        if cache is not None:
+            state = cache.state()
+            if state is not None:
+                if state.snapshot_id == snapshot.id:
+                    if state.matches_tip(snapshot):
+                        return [e for d in state.groups.values()
+                                for e in d.values()]
+                    # recreated snapshot id (rollback/fast-forward):
+                    # drop it, or every read re-walks and the rebuilt
+                    # state can never publish over the stale one
+                    cache.drop_state(state)
+                elif state.snapshot_id < snapshot.id:
+                    adv = self._advance_state(state, snapshot)
+                    if adv is not None:
+                        new_state, _ = adv
+                        cache.put_state(new_state, state)
+                        self._m_delta_applies.inc()
+                        return [e for d in new_state.groups.values()
+                                for e in d.values()]
+                    cache.drop_state(state)
+                elif self._state_anchor_alive(state):
+                    # genuine time travel to an OLDER snapshot: serve
+                    # it from the pruned fallback without disturbing
+                    # (or futilely rebuilding under) the cached tip
+                    cache = None
+                else:
+                    # rolled-back tip: drop the dead state and
+                    # rebuild at this snapshot (mirrors _plan_splits)
+                    cache.drop_state(state)
+            if cache is not None and self._partition_filter is None \
+                    and self._bucket_filter is None and \
+                    self._key_prune_bounds() is None and \
+                    not cache.over_bound(snapshot.id):
+                state, live = self._cold_state(snapshot)
+                if state is not None:
+                    cache.put_state(state, None)
+                    return live
+                # over bound: reuse this walk's live set, and skip
+                # the attempt for later reads of the same tip
+                cache.mark_over_bound(snapshot.id)
+                return live
         metas = self.manifest_list.read_all(snapshot.base_manifest_list,
                                             snapshot.delta_manifest_list)
-        metas = self._prune_manifests(metas)
+        metas = self._prune_manifests(metas, snapshot)
         entries = merge_manifest_entries(self._read_manifests(metas))
         return [e for e in entries if e.kind == FileKind.ADD]
+
+    # -- delta-apply plan cache ----------------------------------------------
+
+    def _plan_cache(self):
+        """The process-shared TablePlanCache, or None when disabled."""
+        if self.options is None or \
+                not self.options.get(CoreOptions.SCAN_PLAN_CACHE):
+            return None
+        from paimon_tpu.core.plan_cache import shared_plan_cache
+        return shared_plan_cache(self.table_path, self.branch)
+
+    def _state_anchor_alive(self, state) -> bool:
+        """True when the cached state's anchor snapshot still exists
+        with the same content — distinguishes genuine time travel
+        (cached tip stays) from a rolled-back tip (the state is dead
+        and must drop)."""
+        try:
+            anchor = self.snapshot_manager.snapshot(state.snapshot_id)
+        except (OSError, ValueError):
+            return False
+        return anchor is not None and state.matches_tip(anchor)
+
+    def _fold_entry(self, groups, copied, touched, e) -> bool:
+        """Apply one delta entry to the copy-on-write group map.
+        False = a DELETE whose file is not live (the delta was
+        computed against a state we do not hold — invalidate)."""
+        g = (e.partition, e.bucket)
+        d = groups.get(g)
+        if g not in copied:
+            d = dict(d) if d is not None else {}
+            groups[g] = d
+            copied.add(g)
+        touched.add(g)
+        ident = e.identifier()
+        if e.kind == FileKind.ADD:
+            d[ident] = e
+            return True
+        if ident in d:
+            del d[ident]
+            return True
+        return False
+
+    def _cold_state(self, snapshot: Snapshot):
+        """Full UNPRUNED walk building the cacheable live-entry state
+        (no scan filters applied — the state serves any filter; they
+        run per entry at split generation).  Returns (state, live
+        entries); state is None when the table exceeds
+        scan.plan.cache.max-entries, but the decoded live-entry set is
+        ALWAYS returned so the caller never re-walks the chain it just
+        paid for."""
+        from paimon_tpu.core.plan_cache import PlanState
+        metas = self.manifest_list.read_all(snapshot.base_manifest_list,
+                                            snapshot.delta_manifest_list)
+        entries = self._read_manifests(metas)
+        groups: Dict[Tuple[bytes, int], Dict[tuple, ManifestEntry]] = {}
+        copied: set = set()
+        for e in entries:
+            self._fold_entry(groups, copied, set(), e)
+        groups = {g: d for g, d in groups.items() if d}
+        count = sum(len(d) for d in groups.values())
+        live = [e for d in groups.values() for e in d.values()]
+        if count > self.options.get(
+                CoreOptions.SCAN_PLAN_CACHE_MAX_ENTRIES):
+            return None, live
+        return PlanState(snapshot.id, snapshot.base_manifest_list,
+                         snapshot.delta_manifest_list,
+                         snapshot.index_manifest, groups, count), live
+
+    def _advance_state(self, state, snapshot: Snapshot):
+        """Advance a cached state to `snapshot` by folding ONLY the
+        delta manifest lists of the intermediate snapshots — the
+        O(delta) steady-state re-plan.  Returns (new_state,
+        frozenset(touched group keys)) or None to invalidate:
+        OVERWRITE commits (INSERT OVERWRITE, dropped partitions,
+        bucket rescale — their delete set was computed against a
+        racing latest and must never be folded blind), an expired or
+        recreated snapshot along the walk, a DELETE of a file we do
+        not hold, or outgrowing the entry bound."""
+        from paimon_tpu.core.plan_cache import PlanState
+        try:
+            prev = self.snapshot_manager.snapshot(state.snapshot_id)
+        except (OSError, ValueError):
+            return None
+        if prev is None or not state.matches_tip(prev):
+            # rollback/fast-forward recreated our anchor id with
+            # different content: the chain above it is not ours
+            return None
+        groups = dict(state.groups)          # copy-on-write outer map
+        copied: set = set()
+        touched: set = set()
+        max_entries = self.options.get(
+            CoreOptions.SCAN_PLAN_CACHE_MAX_ENTRIES)
+        for sid in range(state.snapshot_id + 1, snapshot.id + 1):
+            if sid == snapshot.id:
+                snap = snapshot
+            else:
+                try:
+                    snap = self.snapshot_manager.snapshot(sid)
+                except (OSError, ValueError):
+                    return None
+                if snap is None:
+                    return None
+            if snap.commit_kind == CommitKind.OVERWRITE:
+                return None
+            try:
+                metas = self.manifest_list.read(snap.delta_manifest_list)
+                entries = self._read_manifests(metas)
+            except (OSError, ValueError):
+                # list OR manifest file gone mid-walk (expired or
+                # repaired under us): invalidate to the cold walk
+                return None
+            for e in entries:
+                if not self._fold_entry(groups, copied, touched, e):
+                    return None
+        for g in list(touched):
+            if not groups.get(g):
+                groups.pop(g, None)
+        count = sum(len(d) for d in groups.values())
+        if count > max_entries:
+            return None
+        return (PlanState(snapshot.id, snapshot.base_manifest_list,
+                          snapshot.delta_manifest_list,
+                          snapshot.index_manifest, groups, count),
+                frozenset(touched))
+
+    def _split_signature(self, streaming: bool):
+        """Hashable identity of the filters AND options split
+        generation depends on, or None when key/value/level
+        predicates (not identity-comparable across scan objects) make
+        split states unreusable.  The binning options matter because
+        the cache is shared per (table, branch) across handles whose
+        dynamic options may differ (table.copy)."""
+        if self._key_filter is not None or \
+                self._value_filter is not None or \
+                self._level_filter is not None:
+            return None
+        pf = None
+        if self._partition_filter:
+            pf = frozenset((k, str(v))
+                           for k, v in self._partition_filter.items())
+        bf = frozenset(self._bucket_filter) \
+            if self._bucket_filter is not None else None
+        return (streaming, pf, bf,
+                self.options.get(CoreOptions.SOURCE_SPLIT_TARGET_SIZE),
+                self.options.get(
+                    CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST))
+
+    def _dv_from_state(self, cache, snapshot: Snapshot):
+        """UNFILTERED deletion-vector index, memoized per index
+        manifest name (splits look up their own (partition, bucket)
+        key, so extra groups are inert)."""
+        key = snapshot.index_manifest
+        hit, dv = cache.dv_memo(key)
+        if hit:
+            return dv
+        dv = self._load_deletion_vectors(snapshot.id, snapshot,
+                                         unfiltered=True)
+        cache.put_dv_memo(key, dv)
+        return dv
+
+    def _splits_from_state(self, cache, state, snapshot: Snapshot,
+                           streaming: bool, touched, split_base_id):
+        """Generate this scan's splits from a cached live-entry state
+        — zero manifest IO.  With a reusable filter signature and a
+        split state generated at `split_base_id`, only `touched`
+        groups re-run split generation (None = all)."""
+        from paimon_tpu.core.plan_cache import SplitState
+        sig = self._split_signature(streaming)
+        base = None
+        if sig is not None and touched is not None:
+            st = cache.split_state(sig)
+            if st is not None and \
+                    st.index_manifest == snapshot.index_manifest:
+                if st.snapshot_id == snapshot.id:
+                    base, regen = st.group_splits, frozenset()
+                elif split_base_id is not None and \
+                        st.snapshot_id == split_base_id:
+                    base, regen = st.group_splits, touched
+        dv_index = self._dv_from_state(cache, snapshot)
+        group_splits: Dict[Tuple[bytes, int], tuple] = {}
+        for g in state.groups:
+            if base is not None and g not in regen and g in base:
+                old = base[g]
+                if old and old[0].snapshot_id != snapshot.id:
+                    old = tuple(dc_replace(s, snapshot_id=snapshot.id)
+                                for s in old)
+                group_splits[g] = old
+                continue
+            visible = [e for e in state.groups[g].values()
+                       if self._entry_visible(e)]
+            group_splits[g] = tuple(self._group_splits(
+                snapshot.id, g, visible, dv_index,
+                for_delta=False, for_streaming=streaming))
+        if sig is not None:
+            cache.put_split_state(sig, SplitState(
+                snapshot.id, snapshot.index_manifest, group_splits))
+        out: List[DataSplit] = []
+        for g in sorted(group_splits):
+            out.extend(group_splits[g])
+        return out
+
+    # -- manifest IO ---------------------------------------------------------
 
     def _read_manifests(self, metas) -> List[ManifestEntry]:
         # scan.manifest.parallelism (reference
         # AbstractFileStoreScan#parallelism): manifest decode overlaps
-        # file reads; order is preserved by mapping in meta order
+        # file reads; order is preserved by mapping in meta order.
+        # Routed through parallel/executors so the submitter's request
+        # deadline propagates into the manifest-read workers.
         par = self.options.get(CoreOptions.SCAN_MANIFEST_PARALLELISM) \
             if self.options is not None else None
         if par and par > 1 and len(metas) > 1:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=par) as pool:
+            from paimon_tpu.parallel.executors import new_thread_pool
+            pool = new_thread_pool(par, "paimon-scan-manifest")
+            try:
                 per = list(pool.map(
                     lambda m: self.manifest_file.read(m.file_name),
                     metas))
-            return [e for chunk in per for e in chunk]
-        entries: List[ManifestEntry] = []
-        for m in metas:
-            entries.extend(self.manifest_file.read(m.file_name))
+            finally:
+                pool.shutdown(wait=True)
+            entries = [e for chunk in per for e in chunk]
+        else:
+            entries = []
+            for m in metas:
+                entries.extend(self.manifest_file.read(m.file_name))
+        self._m_manifests_read.inc(len(metas))
+        self._m_entries_decoded.inc(len(entries))
         return entries
 
-    def _prune_manifests(self, metas):
-        """Skip whole manifests via partition stats
-        (reference AbstractFileStoreScan manifest-level pruning)."""
-        if not self._partition_filter or not self.partition_types:
+    def _key_prune_bounds(self):
+        """(lo, hi) bounds the key filter puts on the FIRST trimmed
+        primary key (the sidecar's k_min/k_max column), or None."""
+        if self._key_filter is None or not self.schema.primary_keys:
+            return None
+        from paimon_tpu.predicate import conjunctive_bounds
+        names = self.schema.trimmed_primary_keys()
+        if not names:
+            return None
+        b = conjunctive_bounds(self._key_filter, names[0])
+        if b is None or (b[0] is None and b[1] is None):
+            return None
+        return b
+
+    def _prune_manifests(self, metas, snapshot: Optional[Snapshot] = None):
+        """Skip whole manifests before any fetch (reference
+        AbstractFileStoreScan manifest-level pruning).  With a
+        columnar stats sidecar next to the snapshot's manifest lists
+        (manifest/stats_sidecar.py) the partition/bucket/key-range
+        predicates evaluate VECTORIZED over the whole list; metas the
+        sidecar does not cover fall back to the per-meta python
+        partition check.  Pruned manifests are never fetched and none
+        of their entries are decoded (plan group's entries_decoded is
+        the proof meter)."""
+        key_bounds = self._key_prune_bounds()
+        if (not self._partition_filter or not self.partition_types) \
+                and self._bucket_filter is None and key_bounds is None:
             return metas
+        masks: Dict[str, bool] = {}
+        if snapshot is not None and self.options.get(
+                CoreOptions.MANIFEST_STATS_SIDECAR):
+            from paimon_tpu.manifest.stats_sidecar import prune_keep_mask
+            for list_name in (snapshot.base_manifest_list,
+                              snapshot.delta_manifest_list):
+                if not list_name:
+                    continue
+                stats = self.manifest_list.read_sidecar(list_name)
+                if stats is None:
+                    continue
+                keep = prune_keep_mask(
+                    stats, self.schema.partition_keys,
+                    self._partition_filter, self._bucket_filter,
+                    key_bounds)
+                masks.update(zip(stats["file_name"].to_pylist(),
+                                 keep.tolist()))
         out = []
+        pruned = 0
         for m in metas:
-            stats = m.partition_stats
-            if not stats.null_counts and stats.min_values == b"":
+            k = masks.get(m.file_name)
+            if k is None:
+                k = self._python_prune_keep(m)
+            if k:
                 out.append(m)
-                continue
-            try:
-                mins, maxs = stats.decode(self.partition_types)
-            except Exception:
-                out.append(m)
-                continue
-            keep = True
-            for i, k in enumerate(self.schema.partition_keys):
-                if k in self._partition_filter:
-                    v = self._partition_filter[k]
-                    if mins[i] is not None and maxs[i] is not None and \
-                            not (str(mins[i]) <= str(v) <= str(maxs[i])):
-                        keep = False
-                        break
-            if keep:
-                out.append(m)
+            else:
+                pruned += 1
+        self._m_manifests_pruned.inc(pruned)
         return out
+
+    def _python_prune_keep(self, m) -> bool:
+        """Per-meta fallback for manifests without sidecar stats
+        (partition equality against decoded partition stats only)."""
+        if not self._partition_filter or not self.partition_types:
+            return True
+        stats = m.partition_stats
+        if not stats.null_counts and stats.min_values == b"":
+            return True
+        try:
+            mins, maxs = stats.decode(self.partition_types)
+        except Exception:
+            return True
+        for i, k in enumerate(self.schema.partition_keys):
+            if k in self._partition_filter:
+                v = self._partition_filter[k]
+                if mins[i] is not None and maxs[i] is not None and \
+                        not (str(mins[i]) <= str(v) <= str(maxs[i])):
+                    return False
+        return True
 
     def _partition_matches(self, pbytes: bytes) -> bool:
         """Shared partition-filter check for data entries and DV index
@@ -370,59 +790,71 @@ class FileStoreScan:
         # they always load; no-op when the snapshot carries no index
         # manifest, and pruned by the scan's partition/bucket filters
         dv_index = self._load_deletion_vectors(snapshot_id, snapshot)
-        for (pbytes, bucket), group in sorted(
+        for key, group in sorted(
                 groups.items(), key=lambda kv: (kv[0][0], kv[0][1])):
-            if not self._bucket_value_match(group):
-                continue
-            partition = self._partition_codec.from_bytes(pbytes)
-            files = [g.file for g in group]
-            total_buckets = group[0].total_buckets
-            max_level = max(f.level for f in files)
-            # append tables never merge; pk tables are raw-convertible only
-            # when a single non-L0 run fully covers the bucket
-            raw = (not self.schema.primary_keys) or \
-                  (not for_delta
-                   and all(f.level == max_level and max_level > 0
-                           for f in files)
-                   and all((f.delete_row_count or 0) == 0 for f in files)
-                   and (pbytes, bucket) not in dv_index)
-            # append tables never merge across files, so a big bucket
-            # bins into several size-bounded splits for parallel readers
-            # (reference source.split.target-size / open-file-cost in
-            # append splits; pk buckets must stay whole for the merge)
-            file_bins = [files]
-            if not self.schema.primary_keys and len(files) > 1:
-                target = self.options.get(
-                    CoreOptions.SOURCE_SPLIT_TARGET_SIZE)
-                open_cost = self.options.get(
-                    CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST)
-                file_bins = []
-                cur, cur_size = [], 0
-                for f in files:
-                    sz = max(f.file_size, open_cost)
-                    if cur and cur_size + sz > target:
-                        file_bins.append(cur)
-                        cur, cur_size = [], 0
-                    cur.append(f)
-                    cur_size += sz
-                if cur:
-                    file_bins.append(cur)
-            for bin_files in file_bins:
-                splits.append(DataSplit(
-                    snapshot_id=snapshot_id,
-                    partition=partition,
-                    bucket=bucket,
-                    total_buckets=total_buckets,
-                    data_files=bin_files,
-                    raw_convertible=raw or for_delta,
-                    deletion_vectors=dv_index.get((pbytes, bucket)),
-                    for_streaming=for_streaming,
-                    is_delta=for_delta,
-                ))
+            splits.extend(self._group_splits(snapshot_id, key, group,
+                                             dv_index, for_delta,
+                                             for_streaming))
         return splits
 
+    def _group_splits(self, snapshot_id: int, key: Tuple[bytes, int],
+                      group: List[ManifestEntry], dv_index,
+                      for_delta: bool, for_streaming: bool
+                      ) -> List[DataSplit]:
+        """Splits for ONE (partition, bucket) group of already-visible
+        entries — the unit the split-level plan cache regenerates when
+        a delta touches the group."""
+        if not group or not self._bucket_value_match(group):
+            return []
+        pbytes, bucket = key
+        partition = self._partition_codec.from_bytes(pbytes)
+        files = [g.file for g in group]
+        total_buckets = group[0].total_buckets
+        max_level = max(f.level for f in files)
+        # append tables never merge; pk tables are raw-convertible only
+        # when a single non-L0 run fully covers the bucket
+        raw = (not self.schema.primary_keys) or \
+              (not for_delta
+               and all(f.level == max_level and max_level > 0
+                       for f in files)
+               and all((f.delete_row_count or 0) == 0 for f in files)
+               and (pbytes, bucket) not in dv_index)
+        # append tables never merge across files, so a big bucket
+        # bins into several size-bounded splits for parallel readers
+        # (reference source.split.target-size / open-file-cost in
+        # append splits; pk buckets must stay whole for the merge)
+        file_bins = [files]
+        if not self.schema.primary_keys and len(files) > 1:
+            target = self.options.get(
+                CoreOptions.SOURCE_SPLIT_TARGET_SIZE)
+            open_cost = self.options.get(
+                CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST)
+            file_bins = []
+            cur, cur_size = [], 0
+            for f in files:
+                sz = max(f.file_size, open_cost)
+                if cur and cur_size + sz > target:
+                    file_bins.append(cur)
+                    cur, cur_size = [], 0
+                cur.append(f)
+                cur_size += sz
+            if cur:
+                file_bins.append(cur)
+        return [DataSplit(
+            snapshot_id=snapshot_id,
+            partition=partition,
+            bucket=bucket,
+            total_buckets=total_buckets,
+            data_files=bin_files,
+            raw_convertible=raw or for_delta,
+            deletion_vectors=dv_index.get((pbytes, bucket)),
+            for_streaming=for_streaming,
+            is_delta=for_delta,
+        ) for bin_files in file_bins]
+
     def _load_deletion_vectors(self, snapshot_id: int,
-                               snapshot: Optional[Snapshot] = None):
+                               snapshot: Optional[Snapshot] = None,
+                               unfiltered: bool = False):
         if snapshot is None:
             try:
                 snapshot = self.snapshot_manager.snapshot(snapshot_id)
@@ -436,12 +868,15 @@ class FileStoreScan:
             if e.index_file.index_type != "DELETION_VECTORS":
                 continue
             # honor the scan's bucket/partition filters: skip whole DV
-            # files for buckets this plan will never read
-            if self._bucket_filter is not None and \
-                    e.bucket not in self._bucket_filter:
-                continue
-            if not self._partition_matches(e.partition):
-                continue
+            # files for buckets this plan will never read (`unfiltered`
+            # loads everything — the plan cache's memoized index serves
+            # any scan; splits look up their own (partition, bucket))
+            if not unfiltered:
+                if self._bucket_filter is not None and \
+                        e.bucket not in self._bucket_filter:
+                    continue
+                if not self._partition_matches(e.partition):
+                    continue
             dvs = read_deletion_vectors(
                 self.file_io,
                 self.path_factory.index_file_path(e.index_file.file_name),

@@ -118,6 +118,11 @@ class LocalTableQuery:
         # same-key builds): a cold bucket build must not stall every
         # other serving thread
         self._lock = threading.RLock()
+        # serializes plan REFRESHES only (double-buffer): the new plan
+        # builds aside under this lock and publishes under _lock by
+        # reference swap; a lookup that finds a refresh in flight
+        # serves the current plan instead of waiting
+        self._refresh_lock = threading.Lock()
         self._build_lock = threading.Lock()
         self._building: Dict[str, threading.Event] = {}
         self._snapshot_id = _UNLOADED
@@ -190,22 +195,39 @@ class LocalTableQuery:
         RETURNED references: `self._splits` is replaced (never
         mutated) on refresh, so a captured dict stays internally
         consistent for the whole batch even while a concurrent
-        refresh swaps in a new plan."""
+        refresh swaps in a new plan.
+
+        Double-buffered (ROADMAP item 2 residual): the refresh builds
+        the new plan ASIDE and publishes it by reference swap under
+        `_lock`, and a lookup arriving while another thread holds the
+        refresh serves the CURRENT plan instead of blocking on the
+        manifest walk.  Only the very first load (no plan yet) waits.
+        The TTL stamps only AFTER a successful check: a transient FS
+        failure keeps surfacing on refresh attempts until it heals —
+        though concurrent lookups ride the last good plan."""
         with self._lock:
             now = self._clock()
-            if self._last_check_ms is None or \
-                    self.refresh_interval_ms <= 0 or \
-                    now - self._last_check_ms >= self.refresh_interval_ms:
-                latest = self.table.snapshot_manager.latest_snapshot_id()
-                if self._snapshot_id is _UNLOADED or \
-                        latest != self._snapshot_id:
-                    self._load_plan()
-                # stamp the TTL only AFTER a successful check: a
-                # transient FS failure must surface as an error on
-                # EVERY lookup until it heals, not poison one caller
-                # and then serve all-miss answers from the
-                # never-loaded plan for the rest of the window
-                self._last_check_ms = now
+            due = (self._last_check_ms is None or
+                   self.refresh_interval_ms <= 0 or
+                   now - self._last_check_ms >= self.refresh_interval_ms)
+            loaded = self._snapshot_id is not _UNLOADED
+        if due:
+            if self._refresh_lock.acquire(blocking=not loaded):
+                try:
+                    latest = \
+                        self.table.snapshot_manager.latest_snapshot_id()
+                    with self._lock:
+                        stale = (self._snapshot_id is _UNLOADED or
+                                 latest != self._snapshot_id)
+                    if stale:
+                        self._load_plan()
+                    with self._lock:
+                        self._last_check_ms = self._clock()
+                finally:
+                    self._refresh_lock.release()
+            # else: a concurrent refresh is in flight — serve the
+            # published plan, never block the lookup on it
+        with self._lock:
             return self._splits, self._snapshot_id
 
     def _data_path(self, split, meta) -> str:
@@ -218,38 +240,46 @@ class LocalTableQuery:
         """Re-plan the table and reconcile cached state: keep readers
         whose backing files are still referenced, evict the rest, and
         invalidate shared byte-cache entries for data files dropped by
-        compaction/expiry."""
+        compaction/expiry.
+
+        Runs WITHOUT holding `_lock` (caller serializes refreshes via
+        `_refresh_lock`): the whole plan — a manifest walk riding the
+        delta-apply plan cache — and the keep-set math happen aside,
+        then the new plan publishes by one reference swap, so
+        concurrent lookups never block on a refresh.  Keys are
+        computed against the NEW snapshot: snapshot-keyed bucket
+        readers (DV / record-expire) must be keyed by it, or last
+        cycle's state survives one refresh too long."""
         plan = self.table.new_read_builder().new_scan().plan()
         new_splits: Dict[Tuple[str, int], object] = {}
         for s in plan.splits:
             new_splits[(self._pkey(s.partition), s.bucket)] = s
-        old_paths = {self._data_path(s, f)
-                     for s in self._splits.values()
-                     for f in s.data_files}
-        # advance the snapshot BEFORE computing the keep-set:
-        # snapshot-keyed bucket readers (DV / record-expire) must be
-        # keyed by the NEW snapshot, or last cycle's state survives
-        # one refresh too long
-        self._snapshot_id = plan.snapshot_id
         live_keys = set()
         live_files = set()
         live_paths = set()
         for (pkey, b), s in new_splits.items():
             live_keys.add(self._bucket_store_key(pkey, s,
-                                                 self._snapshot_id))
+                                                 plan.snapshot_id))
             for f in s.data_files:
                 live_keys.add(self._file_store_key(pkey, b, f))
                 live_files.add(f.file_name)
                 live_paths.add(self._data_path(s, f))
+        with self._lock:
+            old_splits = self._splits
+            self._snapshot_id = plan.snapshot_id
+            self._splits = new_splits
+            self._file_ranges = {k: v
+                                 for k, v in self._file_ranges.items()
+                                 if k in live_files}
+        old_paths = {self._data_path(s, f)
+                     for s in old_splits.values()
+                     for f in s.data_files}
         for key in self.store.keys():
             if key not in live_keys:
                 self.store.drop(key)
         from paimon_tpu.fs.caching import evict_dropped_file
         for path in old_paths - live_paths:
             evict_dropped_file(path)
-        self._file_ranges = {k: v for k, v in self._file_ranges.items()
-                             if k in live_files}
-        self._splits = new_splits
         if self._delta is not None:
             # our plan now covers everything at/below this snapshot:
             # sealed delta generations retire once EVERY reader says so

@@ -14,6 +14,9 @@ from paimon_tpu.maintenance.repair import fix_violations  # noqa: F401
 from paimon_tpu.maintenance.mark_done import (  # noqa: F401
     PartitionMarkDoneTrigger, mark_partitions_done,
 )
+from paimon_tpu.maintenance.manifest_compact import (  # noqa: F401
+    compact_manifests, manifest_compaction_needed,
+)
 from paimon_tpu.maintenance.orphan import remove_orphan_files  # noqa: F401
 from paimon_tpu.maintenance.partition_expire import (  # noqa: F401
     expire_partitions,

@@ -34,6 +34,14 @@ class ExpireResult:
         return not self.expired_snapshots
 
 
+def _sidecar_name(list_name: str) -> str:
+    """Columnar stats sidecar next to a manifest list (may not exist;
+    referenced-set membership just keeps a live list's sidecar from
+    being reclaimed, and delete paths are quiet)."""
+    from paimon_tpu.manifest.stats_sidecar import sidecar_name
+    return sidecar_name(list_name)
+
+
 def _snapshot_refs(table, snapshot: Snapshot
                    ) -> Tuple[Set[Tuple], Set[str]]:
     """(data file refs {(partition_bytes, bucket, file_name, external_path)},
@@ -53,6 +61,8 @@ def _snapshot_refs(table, snapshot: Snapshot
     def _read_list(list_name):
         entries = []
         manifests.add(list_name)
+        # the columnar stats sidecar lives and dies with its list
+        manifests.add(_sidecar_name(list_name))
         try:
             metas = scan.manifest_list.read(list_name)
         except FileNotFoundError:
@@ -100,6 +110,7 @@ def _walk_manifest_list(scan, list_name: str, data: Set[Tuple],
     semantics and keeps its own walk)."""
     entries = []
     manifests.add(list_name)
+    manifests.add(_sidecar_name(list_name))
     try:
         metas = scan.manifest_list.read(list_name)
     except FileNotFoundError:

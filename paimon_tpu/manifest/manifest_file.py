@@ -32,6 +32,15 @@ class ManifestFileMeta:
     schema_id: int
     min_row_id: Optional[int] = None
     max_row_id: Optional[int] = None
+    # manifest-level pruning stats (ours; feed the columnar stats
+    # sidecar — manifest/stats_sidecar.py): bucket range and the
+    # trimmed-primary-key min/max (BinaryRow bytes, compared decoded)
+    # over every entry in the manifest.  Optional so old manifests
+    # round-trip; None disables the corresponding vectorized prune.
+    min_bucket: Optional[int] = None
+    max_bucket: Optional[int] = None
+    min_key: Optional[bytes] = None
+    max_key: Optional[bytes] = None
 
     def to_avro(self) -> dict:
         return {
@@ -44,10 +53,16 @@ class ManifestFileMeta:
             "_SCHEMA_ID": self.schema_id,
             "_MIN_ROW_ID": self.min_row_id,
             "_MAX_ROW_ID": self.max_row_id,
+            "_MIN_BUCKET": self.min_bucket,
+            "_MAX_BUCKET": self.max_bucket,
+            "_MIN_KEY": self.min_key,
+            "_MAX_KEY": self.max_key,
         }
 
     @staticmethod
     def from_avro(d: dict) -> "ManifestFileMeta":
+        min_key = d.get("_MIN_KEY")
+        max_key = d.get("_MAX_KEY")
         return ManifestFileMeta(
             file_name=d["_FILE_NAME"],
             file_size=d["_FILE_SIZE"],
@@ -57,6 +72,10 @@ class ManifestFileMeta:
             schema_id=d["_SCHEMA_ID"],
             min_row_id=d.get("_MIN_ROW_ID"),
             max_row_id=d.get("_MAX_ROW_ID"),
+            min_bucket=d.get("_MIN_BUCKET"),
+            max_bucket=d.get("_MAX_BUCKET"),
+            min_key=bytes(min_key) if min_key is not None else None,
+            max_key=bytes(max_key) if max_key is not None else None,
         )
 
 
@@ -81,6 +100,10 @@ MANIFEST_FILE_META_AVRO_SCHEMA = {
         {"name": "_SCHEMA_ID", "type": "long"},
         {"name": "_MIN_ROW_ID", "type": ["null", "long"], "default": None},
         {"name": "_MAX_ROW_ID", "type": ["null", "long"], "default": None},
+        {"name": "_MIN_BUCKET", "type": ["null", "int"], "default": None},
+        {"name": "_MAX_BUCKET", "type": ["null", "int"], "default": None},
+        {"name": "_MIN_KEY", "type": ["null", "bytes"], "default": None},
+        {"name": "_MAX_KEY", "type": ["null", "bytes"], "default": None},
     ],
 }
 
@@ -90,11 +113,20 @@ class ManifestFile:
 
     def __init__(self, file_io: FileIO, manifest_dir: str,
                  compression: str = "zstandard",
-                 partition_types: Optional[list] = None):
+                 partition_types: Optional[list] = None,
+                 key_types: Optional[list] = None,
+                 sidecar: bool = True):
         self.file_io = file_io
         self.manifest_dir = manifest_dir.rstrip("/")
         self.compression = compression
         self.partition_types = partition_types or []
+        # trimmed-primary-key types: enables per-manifest key-range
+        # stats (min/max over every entry's file key stats).  The
+        # stats' only consumer is the columnar sidecar — when it is
+        # disabled, skip the two-BinaryRow-decodes-per-entry work on
+        # the commit hot path
+        self.key_types = key_types or []
+        self.sidecar = sidecar
         self._suffix = 0
 
     def new_file_name(self) -> str:
@@ -114,6 +146,10 @@ class ManifestFile:
         self.file_io.write_bytes(self.path(name), data, overwrite=False)
         num_added = sum(1 for e in entries if e.kind == FileKind.ADD)
         num_deleted = len(entries) - num_added
+        min_bucket = min((e.bucket for e in entries), default=None)
+        max_bucket = max((e.bucket for e in entries), default=None)
+        min_key, max_key = self._key_range(entries) \
+            if self.sidecar else (None, None)
         return ManifestFileMeta(
             file_name=name,
             file_size=len(data),
@@ -121,6 +157,10 @@ class ManifestFile:
             num_deleted_files=num_deleted,
             partition_stats=self._partition_stats(entries),
             schema_id=schema_id,
+            min_bucket=min_bucket,
+            max_bucket=max_bucket,
+            min_key=min_key,
+            max_key=max_key,
         )
 
     def read(self, name: str) -> List[ManifestEntry]:
@@ -130,6 +170,33 @@ class ManifestFile:
 
     def delete(self, name: str):
         self.file_io.delete_quietly(self.path(name))
+
+    def _key_range(self, entries: Sequence[ManifestEntry]
+                   ) -> Tuple[Optional[bytes], Optional[bytes]]:
+        """Min/max trimmed-primary-key over every entry's file key
+        stats, compared DECODED (BinaryRow bytes are little-endian
+        slots, not order-comparable), returned as the winning rows'
+        raw bytes.  None on any undecodable key — stats are advisory
+        and the vectorized prune keeps unconstrained manifests."""
+        if not self.key_types or not entries:
+            return None, None
+        from paimon_tpu.data.binary_row import BinaryRowCodec
+        codec = BinaryRowCodec([t.copy(False) for t in self.key_types])
+        best_min = best_max = None          # (decoded tuple, raw bytes)
+        try:
+            for e in entries:
+                mk, xk = e.file.min_key, e.file.max_key
+                if not mk or not xk:
+                    return None, None
+                lo = tuple(codec.from_bytes(mk))
+                hi = tuple(codec.from_bytes(xk))
+                if best_min is None or lo < best_min[0]:
+                    best_min = (lo, mk)
+                if best_max is None or hi > best_max[0]:
+                    best_max = (hi, xk)
+        except Exception:                   # noqa: BLE001 — advisory
+            return None, None
+        return best_min[1], best_max[1]
 
     def _partition_stats(self,
                          entries: Sequence[ManifestEntry]) -> SimpleStats:
@@ -155,13 +222,24 @@ class ManifestFile:
 
 
 class ManifestList:
-    """Reads/writes manifest-list-<uuid>-<n> files."""
+    """Reads/writes manifest-list-<uuid>-<n> files.
+
+    With `sidecar=True` (and typed partition/key columns available)
+    every written list also gets a `stats-<name>` columnar sidecar
+    (manifest/stats_sidecar.py) that scan planning prunes against
+    vectorized, before fetching any manifest file."""
 
     def __init__(self, file_io: FileIO, manifest_dir: str,
-                 compression: str = "zstandard"):
+                 compression: str = "zstandard",
+                 partition_types: Optional[list] = None,
+                 key_types: Optional[list] = None,
+                 sidecar: bool = False):
         self.file_io = file_io
         self.manifest_dir = manifest_dir.rstrip("/")
         self.compression = compression
+        self.partition_types = partition_types or []
+        self.key_types = key_types or []
+        self.sidecar = sidecar
         self._suffix = 0
 
     def new_file_name(self) -> str:
@@ -178,12 +256,48 @@ class ManifestList:
             MANIFEST_FILE_META_AVRO_SCHEMA, [m.to_avro() for m in metas],
             codec=self.compression)
         self.file_io.write_bytes(self.path(name), data, overwrite=False)
+        if self.sidecar and metas:
+            from paimon_tpu.manifest.stats_sidecar import (
+                build_sidecar, sidecar_path,
+            )
+            from paimon_tpu.utils.deadline import DeadlineExceededError
+            try:
+                blob = build_sidecar(metas, self.partition_types,
+                                     self.key_types)
+                if blob is not None:
+                    self.file_io.write_bytes(
+                        sidecar_path(self.path(name)), blob,
+                        overwrite=False)
+            except (DeadlineExceededError, KeyboardInterrupt,
+                    SystemExit):
+                # genuine abort: the list PUT already landed but the
+                # caller will treat this write as failed — without
+                # this delete the list is unrecorded and no abort
+                # path can ever clean it (delete_quietly is
+                # deadline-shielded, so this runs even when the
+                # sidecar PUT tripped the request deadline)
+                self.file_io.delete_quietly(self.path(name))
+                raise
+            except Exception:
+                # the sidecar is ADVISORY — readers fall back to the
+                # python prune when it is absent or undecodable, so a
+                # build or PUT failure must never fail a commit whose
+                # required artifacts all landed; sweep any torn blob
+                # and proceed without one
+                self.file_io.delete_quietly(
+                    sidecar_path(self.path(name)))
         return name, len(data)
 
     def read(self, name: str) -> List[ManifestFileMeta]:
         _, records = avro_fmt.read_container(
             self.file_io.read_bytes(self.path(name)))
         return [ManifestFileMeta.from_avro(r) for r in records]
+
+    def read_sidecar(self, name: str):
+        """The columnar stats sidecar for one list (arrow Table), or
+        None when absent/undecodable."""
+        from paimon_tpu.manifest.stats_sidecar import read_sidecar
+        return read_sidecar(self.file_io, self.path(name))
 
     def read_all(self, base_name: str,
                  delta_name: Optional[str]) -> List[ManifestFileMeta]:
@@ -193,4 +307,6 @@ class ManifestList:
         return out
 
     def delete(self, name: str):
+        from paimon_tpu.manifest.stats_sidecar import sidecar_path
         self.file_io.delete_quietly(self.path(name))
+        self.file_io.delete_quietly(sidecar_path(self.path(name)))

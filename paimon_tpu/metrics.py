@@ -62,7 +62,10 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "MULTIHOST_OWNERSHIP_HANDOFFS", "MULTIHOST_BARRIER_WAIT_MS",
            "MULTIHOST_FOREIGN_ROWS", "MULTIHOST_CONFIG_WARNINGS",
            "MULTIHOST_OWNED_BUCKETS", "MULTIHOST_MAINTENANCE_TAKEOVERS",
-           "MULTIHOST_LEASE_RENEWALS", "MULTIHOST_LEASE_EXPIRED"]
+           "MULTIHOST_LEASE_RENEWALS", "MULTIHOST_LEASE_EXPIRED",
+           "PLAN_PLANS", "PLAN_MS", "PLAN_DELTA_APPLIES",
+           "PLAN_MANIFESTS_READ", "PLAN_MANIFESTS_PRUNED",
+           "PLAN_ENTRIES_DECODED", "PLAN_MANIFEST_COMPACTIONS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -245,6 +248,24 @@ MULTIHOST_OWNED_BUCKETS = "owned_buckets"
 MULTIHOST_MAINTENANCE_TAKEOVERS = "maintenance_takeovers"
 MULTIHOST_LEASE_RENEWALS = "lease_renewals"
 MULTIHOST_LEASE_EXPIRED = "lease_expired"
+
+# incremental-metadata-plane counter/histogram names (plan metric
+# group; producers in core/scan.py + maintenance/manifest_compact.py,
+# consumers benchmarks/plan_bench.py + tests + dashboards).
+# plan_delta_applies counts plans served by advancing a cached plan
+# with only the new snapshots' delta manifests (the steady-state
+# streaming re-plan path); manifests_pruned counts whole manifest
+# files skipped by the columnar stats sidecar BEFORE any fetch, and
+# entries_decoded is the proof meter — it must not move for pruned
+# manifests.  The whole group is pre-allocated at FileStoreScan
+# construction so the Prometheus endpoint always renders the series.
+PLAN_PLANS = "plans"                          # scan plans produced
+PLAN_MS = "plan_ms"                           # one whole plan() call
+PLAN_DELTA_APPLIES = "plan_delta_applies"     # cache-advanced plans
+PLAN_MANIFESTS_READ = "manifests_read"        # manifest files fetched
+PLAN_MANIFESTS_PRUNED = "manifests_pruned"    # skipped before fetch
+PLAN_ENTRIES_DECODED = "entries_decoded"      # manifest entries decoded
+PLAN_MANIFEST_COMPACTIONS = "manifest_compactions"  # full rewrites
 
 
 class Counter:
@@ -452,6 +473,12 @@ class MetricRegistry:
         breakers, utils/deadline.py, service/brownout.py).  `table`
         doubles as the backend name for per-backend breaker gauges."""
         return self.group("resilience", table)
+
+    def plan_metrics(self, table: str = "") -> MetricGroup:
+        """Incremental metadata plane (ours; core/scan.py delta-apply
+        plan cache + vectorized manifest pruning +
+        maintenance/manifest_compact.py)."""
+        return self.group("plan", table)
 
     def multihost_metrics(self, table: str = "") -> MetricGroup:
         """Multi-host write plane (ours; parallel/multihost.py

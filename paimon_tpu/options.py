@@ -739,6 +739,13 @@ class CoreOptions:
         "Triggered compactions run full (eligible for the mesh engine "
         "with its retry/fallback ladder); false picks incremental "
         "units through the single-chip universal-compaction manager")
+    STREAM_MANIFEST_COMPACTION_INTERVAL = ConfigOption(
+        "stream.manifest-compaction.interval", _parse_duration_ms,
+        60_000,
+        "How often the compaction loop probes the manifest "
+        "full-compaction trigger (the probe reads the snapshot's "
+        "manifest lists — too frequent is wasted metadata IO); "
+        "None disables the probe")
     STREAM_COMPACTION_PAUSE_RATIO = ConfigOption(
         "stream.compaction.pause-ratio", float, 0.5,
         "Graceful degradation: the compaction loop SKIPS its round "
@@ -924,6 +931,22 @@ class CoreOptions:
         "scan.manifest.parallelism", int, None,
         "Threads for reading manifest files during scan planning "
         "(None = serial)")
+    MANIFEST_FULL_COMPACTION_THRESHOLD = ConfigOption(
+        "manifest.full-compaction.threshold", int, 50,
+        "Full-rewrite manifests once the chain holds this many small "
+        "(sub-half-target-size) manifests (None disables the trigger)")
+    MANIFEST_STATS_SIDECAR = ConfigOption(
+        "manifest.stats.sidecar", _parse_bool, True,
+        "Write a columnar partition/bucket/key-range stats sidecar "
+        "next to every manifest list (vectorized manifest pruning)")
+    SCAN_PLAN_CACHE = ConfigOption(
+        "scan.plan.cache", _parse_bool, True,
+        "Reuse cached plans across snapshots by applying only the new "
+        "snapshots' delta manifests (invalidated by overwrites)")
+    SCAN_PLAN_CACHE_MAX_ENTRIES = ConfigOption(
+        "scan.plan.cache.max-entries", int, 4_000_000,
+        "Largest live-entry count the delta-apply plan cache will hold "
+        "for one table; bigger tables fall back to cold walks")
     SNAPSHOT_CLEAN_EMPTY_DIRECTORIES = ConfigOption(
         "snapshot.clean-empty-directories", _parse_bool, False,
         "Remove emptied partition/bucket directories after snapshot "

@@ -174,6 +174,56 @@ def conjunctive_equalities(pred):
     return out
 
 
+def conjunctive_bounds(pred, field: str):
+    """Inclusive (lo, hi) value bounds that must hold on `field` for a
+    row to match, folded from every range/equality leaf reachable
+    through AND nodes only; either side may be None (unbounded).
+    Returns None when the predicate puts NO usable bound on the field —
+    callers must then keep everything.  This is the manifest-level
+    vectorized prune's contract: the bounds are necessary conditions,
+    so dropping a manifest whose [min,max] misses [lo,hi] can never
+    drop a match (OR nodes contribute nothing, conservatively)."""
+    lo = hi = None
+
+    def fold(lo, hi, new_lo, new_hi):
+        if new_lo is not None and (lo is None or new_lo > lo):
+            lo = new_lo
+        if new_hi is not None and (hi is None or new_hi < hi):
+            hi = new_hi
+        return lo, hi
+
+    if isinstance(pred, Leaf):
+        v = pred.literal
+        if pred.op == "eq" and v is not None:
+            lo, hi = fold(lo, hi, v, v)
+        elif pred.op in ("gt", "ge") and v is not None:
+            lo, hi = fold(lo, hi, v, None)
+        elif pred.op in ("lt", "le") and v is not None:
+            lo, hi = fold(lo, hi, None, v)
+        elif pred.op == "in" and v and all(x is not None for x in v):
+            try:
+                lo, hi = fold(lo, hi, min(v), max(v))
+            except TypeError:
+                return None
+        else:
+            return None
+        if pred.field != field:
+            return None
+        return lo, hi
+    if isinstance(pred, Compound) and pred.op == "and":
+        found = False
+        for c in pred.children:
+            b = conjunctive_bounds(c, field)
+            if b is not None:
+                found = True
+                try:
+                    lo, hi = fold(lo, hi, b[0], b[1])
+                except TypeError:
+                    return None
+        return (lo, hi) if found else None
+    return None
+
+
 class Compound(Predicate):
     def __init__(self, op: str, children: Sequence[Predicate]):
         assert op in ("and", "or", "not")

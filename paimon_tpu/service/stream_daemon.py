@@ -366,6 +366,8 @@ class StreamDaemon:
             "compact_interval_ms": o.get(
                 CoreOptions.STREAM_COMPACTION_INTERVAL),
             "compact_full": o.get(CoreOptions.STREAM_COMPACTION_FULL),
+            "manifest_compact_interval_ms": o.get(
+                CoreOptions.STREAM_MANIFEST_COMPACTION_INTERVAL),
             "pause_ratio": o.get(
                 CoreOptions.STREAM_COMPACTION_PAUSE_RATIO),
             "pause_backlog": o.get(
@@ -1059,6 +1061,7 @@ class StreamDaemon:
 
         o = self._o
         last_expire_at = time.monotonic()
+        last_manifest_probe_at = time.monotonic()
         while not self._stop.wait(o["compact_interval_ms"] / 1000.0):
             if self.plane is not None:
                 # failure-detector round: newly-expired peers (and
@@ -1097,6 +1100,27 @@ class StreamDaemon:
                             self.plane.note_renewal()
                 if sid is not None:
                     self._metrics.counter(STREAM_COMPACTIONS).inc()
+            # manifest full-compaction (incremental metadata plane):
+            # elected like expiry on the mesh — one host folds the
+            # accumulated delta manifests once the count trigger
+            # fires; CAS-committed, so a racing peer just retries.
+            # Interval-gated like expiry: the trigger probe itself
+            # reads the snapshot's manifest lists, so running it on
+            # every 2s compact tick is continuous wasted metadata IO
+            if o["manifest_compact_interval_ms"] is not None and \
+                    (self.plane is None or self.plane.owns_expiry()) \
+                    and (time.monotonic() - last_manifest_probe_at) \
+                    * 1000 >= o["manifest_compact_interval_ms"]:
+                last_manifest_probe_at = time.monotonic()
+                with span("stream.compact_manifests", cat="stream"):
+                    if self.plane is None:
+                        msid = table.compact_manifests(force=False)
+                    else:
+                        msid = table.compact_manifests(
+                            force=False, commit_user=self.commit_user,
+                            properties_provider=self._plane_props)
+                        if msid is not None:
+                            self.plane.note_renewal()
             if o["expire_interval_ms"] is not None and \
                     (self.plane is None or self.plane.owns_expiry()) \
                     and (time.monotonic() - last_expire_at) * 1000 \

@@ -234,6 +234,22 @@ class FileStoreTable:
         return rescale_table_buckets(self, new_buckets, mesh=mesh,
                                      properties=properties)
 
+    def compact_manifests(self, force: bool = True,
+                          commit_user: Optional[str] = None,
+                          properties: Optional[Dict[str, str]] = None,
+                          properties_provider=None) -> Optional[int]:
+        """Manifest full-compaction: fold the accumulated delta
+        manifests into sorted, partition-clustered base manifests
+        (maintenance/manifest_compact.py).  `force=False` runs only
+        when the manifest.full-compaction.threshold trigger fires."""
+        from paimon_tpu.maintenance.manifest_compact import (
+            compact_manifests,
+        )
+        return compact_manifests(self, force=force,
+                                 commit_user=commit_user,
+                                 properties=properties,
+                                 properties_provider=properties_provider)
+
     def rescale_postpone(self) -> Optional[int]:
         """Move bucket-postpone staging data into real buckets (reference
         postpone/ rescale job; bucket=-2 tables)."""

@@ -1,0 +1,808 @@
+"""Incremental metadata plane (ROADMAP item 4): delta-apply plan
+reuse, vectorized manifest pruning and manifest full-compaction.
+
+Covers the ISSUE 15 acceptance:
+
+* delta-applied plans are ENTRY-IDENTICAL to cold full walks across
+  every commit kind (append, compact, overwrite incl. dropped
+  partitions, rescale, tags/time travel, deletion vectors) — the
+  overwrite family must INVALIDATE instead of mis-applying;
+* a steady-state streaming re-plan after one commit reads exactly
+  that snapshot's delta manifest list + its manifest files (op-count
+  asserted on the FileIO);
+* the columnar stats sidecar prunes whole manifests BEFORE any fetch
+  (pruned manifests never read; the plan group's entries_decoded
+  counter never moves for them);
+* manifest full-compaction survives a crash at every mutating op
+  (readable + restart-converges + fsck-clean) and its trigger fires
+  on manifest.full-compaction.threshold;
+* the serving plane's double-buffered plan swap: a lookup arriving
+  during a slow refresh serves the current plan instead of blocking.
+"""
+
+import threading
+import time
+
+import pytest
+
+from paimon_tpu.core.plan_cache import reset_plan_caches
+from paimon_tpu.metrics import (
+    PLAN_DELTA_APPLIES, PLAN_ENTRIES_DECODED, PLAN_MANIFESTS_PRUNED,
+    PLAN_MANIFESTS_READ, global_registry,
+)
+from paimon_tpu.predicate import and_, greater_than, less_or_equal
+from paimon_tpu.schema import Schema
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.types import BigIntType, DoubleType, IntType
+from tests.crash_sweep import crash_point_sweep
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_cache():
+    reset_plan_caches()
+    yield
+    reset_plan_caches()
+
+
+def _pm():
+    return global_registry().plan_metrics()
+
+
+def _counter(name) -> int:
+    return _pm().counter(name).count
+
+
+def _schema(opts=None, partitioned=False, buckets=2):
+    b = Schema.builder().column("id", BigIntType(False)) \
+        .column("v", DoubleType())
+    if partitioned:
+        b = b.column("pt", IntType(False)).partition_keys("pt") \
+            .primary_key("pt", "id")
+    else:
+        b = b.primary_key("id")
+    return b.options({"bucket": str(buckets), "write-only": "true",
+                      **(opts or {})}).build()
+
+
+def _commit(table, rows, overwrite=False, static_partition=None):
+    wb = table.new_batch_write_builder()
+    if overwrite:
+        wb = wb.with_overwrite(static_partition)
+    with wb.new_write() as w:
+        w.write_dicts(rows)
+        return wb.new_commit().commit(w.prepare_commit())
+
+
+def _canon_plan(plan):
+    """Order-preserving canonical projection of a plan's splits (files
+    by value-identity, DV keys, flags)."""
+    return [(s.snapshot_id, s.partition, s.bucket, s.total_buckets,
+             tuple(f.file_name for f in s.data_files),
+             s.raw_convertible,
+             tuple(sorted((s.deletion_vectors or {}).keys())))
+            for s in plan.splits]
+
+
+def _cold_plan(table, **filters):
+    """Plan with the cache OFF — the oracle's cold full walk."""
+    cold = table.copy({"scan.plan.cache": "false"})
+    scan = cold.new_scan()
+    if "partition_filter" in filters:
+        scan = scan.with_partition_filter(filters["partition_filter"])
+    if "key_filter" in filters:
+        scan = scan.with_key_filter(filters["key_filter"])
+    return scan.plan()
+
+
+class RecordingFileIO:
+    """Thin FileIO proxy recording every read path."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.reads = []
+
+    def read_bytes(self, path, *a, **k):
+        self.reads.append(path)
+        return self._inner.read_bytes(path, *a, **k)
+
+    def read_utf8(self, path):
+        self.reads.append(path)
+        return self._inner.read_utf8(path)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# -- delta-apply vs cold-walk entry identity --------------------------------
+
+
+def test_delta_apply_oracle_across_commit_kinds(tmp_path):
+    """After every commit kind the cached (delta-applied) plan is
+    entry-identical to a cold full walk; appends/compacts ADVANCE the
+    state, the overwrite family invalidates it."""
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+
+    def check(expect_delta_applied=None):
+        before = _counter(PLAN_DELTA_APPLIES)
+        warm = table.new_scan().plan()
+        applied = _counter(PLAN_DELTA_APPLIES) - before
+        cold = _cold_plan(table)
+        assert _canon_plan(warm) == _canon_plan(cold)
+        if expect_delta_applied is not None:
+            assert bool(applied) == expect_delta_applied
+        return warm
+
+    # cold populate, then a pure hit
+    _commit(table, [{"id": i, "v": 1.0} for i in range(8)])
+    check(expect_delta_applied=False)
+    check(expect_delta_applied=False)          # tip hit: no IO, no apply
+
+    # APPEND advances
+    _commit(table, [{"id": i, "v": 2.0} for i in range(4, 12)])
+    check(expect_delta_applied=True)
+
+    # COMPACT (ADD + DELETE entries in one delta) advances
+    table.compact(full=True)
+    check(expect_delta_applied=True)
+
+    # OVERWRITE invalidates, then the rebuilt state serves again
+    _commit(table, [{"id": i, "v": 9.0} for i in range(3)],
+            overwrite=True)
+    check(expect_delta_applied=False)
+    _commit(table, [{"id": 50, "v": 5.0}])
+    check(expect_delta_applied=True)
+
+    # bucket RESCALE (overwrite kind) invalidates, never mis-applies
+    table.rescale_buckets(4)
+    check(expect_delta_applied=False)
+    _commit(table, [{"id": 60, "v": 6.0}])
+    check(expect_delta_applied=True)
+
+
+def test_delta_apply_oracle_dropped_partition(tmp_path):
+    """A dropped partition is an OVERWRITE whose delete set covers the
+    partition: the cached plan must invalidate and match the cold
+    walk (the dropped partition's files gone)."""
+    table = FileStoreTable.create(str(tmp_path / "t"),
+                                  _schema(partitioned=True))
+    for pt in range(3):
+        _commit(table, [{"id": i, "v": float(pt), "pt": pt}
+                        for i in range(6)])
+    warm = table.new_scan().plan()
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+
+    # drop partition pt=1 (INSERT OVERWRITE of the static partition
+    # with no rows)
+    _commit(table, [], overwrite=True, static_partition={"pt": 1})
+    before = _counter(PLAN_DELTA_APPLIES)
+    warm = table.new_scan().plan()
+    assert _counter(PLAN_DELTA_APPLIES) == before    # invalidated
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+    parts = {s.partition for s in warm.splits}
+    assert (1,) not in parts and {(0,), (2,)} <= parts
+
+
+def test_delta_apply_oracle_deletion_vectors(tmp_path):
+    """DV commits change the index manifest: the advanced state must
+    regenerate splits with the new DV index and match the cold walk
+    (append table — pk deletes write retractions, not DVs)."""
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", DoubleType())
+              .options({"bucket": "1", "bucket-key": "id",
+                        "deletion-vectors.enabled": "true"})
+              .build())
+    table = FileStoreTable.create(str(tmp_path / "t"), schema)
+    _commit(table, [{"id": i, "v": 1.0} for i in range(10)])
+    table.new_scan().plan()                        # populate
+    from paimon_tpu.predicate import equal
+    table.delete_where(equal("id", 3))
+    warm = table.new_scan().plan()
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+    assert any(s.deletion_vectors for s in warm.splits)
+    assert table.to_arrow().num_rows == 9
+
+
+def test_delta_apply_tag_time_travel(tmp_path):
+    """Planning a TAGGED (older) snapshot bypasses the cache without
+    disturbing it; planning the tip afterwards still delta-applies."""
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    _commit(table, [{"id": i, "v": 1.0} for i in range(4)])
+    table.create_tag("v1")
+    table.new_scan().plan()
+    _commit(table, [{"id": i, "v": 2.0} for i in range(2, 6)])
+
+    tag_snap = table.snapshot_manager.snapshot(1)
+    old = table.new_scan().plan(snapshot=tag_snap)
+    cold_old = table.copy({"scan.plan.cache": "false"}) \
+        .new_scan().plan(snapshot=tag_snap)
+    assert _canon_plan(old) == _canon_plan(cold_old)
+
+    before = _counter(PLAN_DELTA_APPLIES)
+    warm = table.new_scan().plan()
+    assert _counter(PLAN_DELTA_APPLIES) == before + 1
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+
+
+def test_rollback_recreated_snapshot_invalidates(tmp_path):
+    """rollback_to deletes and RECREATES snapshot ids with different
+    content — the cached tip must never serve the old chain."""
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    for i in range(3):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(4)])
+    table.new_scan().plan()                        # cache at snapshot 3
+    table.rollback_to(1)
+    _commit(table, [{"id": 9, "v": 9.0}])          # recreates id 2
+    _commit(table, [{"id": 10, "v": 10.0}])        # recreates id 3
+    warm = table.new_scan().plan()
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+    ids = {r["id"] for r in table.to_arrow().to_pylist()}
+    assert ids == {0, 1, 2, 3, 9, 10}
+
+
+def test_rollback_to_older_tip_rebuilds_state(tmp_path):
+    """rollback_to leaves the cached state anchored on a DELETED
+    higher id — plans at the regressed tip must drop it and rebuild
+    (not pay an uncached cold walk on every plan until the id climbs
+    back), while genuine time travel keeps the cached tip."""
+    from paimon_tpu.core.plan_cache import shared_plan_cache
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    for i in range(3):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(4)])
+    table.new_scan().plan()                        # cache at snapshot 3
+    cache = shared_plan_cache(table.path, table.branch)
+
+    # genuine time travel: the cached tip survives
+    old_snap = table.snapshot_manager.snapshot(2)
+    cold = table.copy({"scan.plan.cache": "false"})
+    warm = table.new_scan().plan(old_snap)
+    assert _canon_plan(warm) == _canon_plan(cold.new_scan().plan(old_snap))
+    assert cache.state() is not None and cache.state().snapshot_id == 3
+
+    # rolled-back tip: the dead state drops and rebuilds at the tip
+    table.rollback_to(2)
+    warm = table.new_scan().plan()
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+    state = cache.state()
+    assert state is not None and state.snapshot_id == 2
+    # and delta-apply resumes immediately on the next commit
+    before = _counter(PLAN_DELTA_APPLIES)
+    _commit(table, [{"id": 9, "v": 9.0}])
+    warm = table.new_scan().plan()
+    assert _counter(PLAN_DELTA_APPLIES) == before + 1
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+
+
+def test_split_state_not_shared_across_split_size_options(tmp_path):
+    """The split-state cache is shared per (table, branch) across
+    handles whose DYNAMIC options differ: source.split.target-size
+    must be part of the signature or one handle serves splits binned
+    with another handle's size."""
+    schema = Schema.builder().column("id", BigIntType(False)) \
+        .column("v", DoubleType()) \
+        .options({"bucket": "1", "bucket-key": "id",
+                  "write-only": "true"}).build()
+    table = FileStoreTable.create(str(tmp_path / "t"), schema)
+    for i in range(4):                  # 4 append files in one bucket
+        _commit(table, [{"id": 100 * i + j, "v": float(i)}
+                        for j in range(50)])
+
+    wide = table.new_scan().plan()      # default 128MB bin: 1 split
+    assert len(wide.splits) == 1
+    narrow = table.copy({"source.split.target-size": "1"}) \
+        .new_scan().plan()              # 1-byte bins: 1 split/file
+    assert len(narrow.splits) == 4
+    assert len(table.new_scan().plan().splits) == 1   # wide unchanged
+
+
+def test_read_entries_recovers_after_rollback_recreated_id(tmp_path):
+    """read_entries must DROP a cached state whose snapshot id was
+    recreated (rollback) and publish the rebuilt one — otherwise every
+    maintenance-loop read_entries re-walks the full chain and discards
+    it (put_state refuses same-id publishes over a live state)."""
+    from paimon_tpu.core.plan_cache import shared_plan_cache
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    for i in range(3):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(4)])
+    table.new_scan().plan()                        # cache at snapshot 3
+    table.rollback_to(2)
+    _commit(table, [{"id": 9, "v": 9.0}])          # recreates id 3
+
+    snap = table.latest_snapshot()
+    entries = table.new_scan().read_entries(snap)
+    assert {r["id"] for r in table.to_arrow().to_pylist()} == \
+        {0, 1, 2, 3, 9}
+    # the rebuilt state PUBLISHED (stale same-id state dropped first)
+    cache = shared_plan_cache(table.path, table.branch)
+    state = cache.state()
+    assert state is not None and state.matches_tip(snap)
+    assert state.entry_count == len(entries)
+    # and the next read is a pure state hit (delta_applies untouched,
+    # no walk) — proven by entry identity with a cold read
+    cold = table.copy({"scan.plan.cache": "false"}) \
+        .new_scan().read_entries(snap)
+    assert sorted(e.identifier() for e in entries) == \
+        sorted(e.identifier() for e in cold)
+
+
+def test_streaming_replan_is_entry_identical_over_a_stream(tmp_path):
+    """The streaming daemon shape: commit → re-plan, many times; every
+    warm plan equals the cold walk and all but the first delta-apply."""
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    before = _counter(PLAN_DELTA_APPLIES)
+    for i in range(8):
+        _commit(table, [{"id": i * 3 + d, "v": float(i)}
+                        for d in range(3)])
+        warm = table.new_scan().plan()
+        assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+    assert _counter(PLAN_DELTA_APPLIES) - before == 7
+
+
+# -- op-count: a streaming re-plan reads only the delta ---------------------
+
+
+def test_replan_reads_only_the_delta_manifests(tmp_path):
+    """After one commit on a warm cache, plan() fetches EXACTLY the
+    new snapshot's delta manifest list and the manifest files it
+    names — never the base list or any older manifest."""
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    for i in range(3):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(6)])
+    table.new_scan().plan()                        # warm the cache
+
+    sid = _commit(table, [{"id": 100, "v": 4.0}])
+    snap = table.snapshot_manager.snapshot(sid)
+    delta_list = snap.delta_manifest_list
+    delta_manifests = {m.file_name for m in
+                       table.new_scan().manifest_list.read(delta_list)}
+    assert delta_manifests                         # non-empty delta
+
+    rio = RecordingFileIO(table.file_io)
+    watched = FileStoreTable(rio, table.path,
+                             table.schema_manager.latest(),
+                             branch=table.branch)
+    plan = watched.new_scan().plan()
+    assert plan.snapshot_id == sid
+
+    manifest_reads = [p.rsplit("/", 1)[-1] for p in rio.reads
+                      if "/manifest/" in p]
+    # the delta list + exactly its manifests; NOTHING else from the
+    # manifest plane (no base list, no old manifests, no sidecars)
+    assert sorted(manifest_reads) == sorted(
+        [delta_list] + list(delta_manifests)), manifest_reads
+
+
+def test_over_bound_table_never_walks_twice(tmp_path):
+    """Tables over scan.plan.cache.max-entries pay the cold walk ONCE
+    per plan: the over-bound cold state's decoded entries are reused
+    instead of discarded-and-re-walked, and later plans on the same
+    tip skip the cold-state attempt entirely."""
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"scan.plan.cache.max-entries": "1"}))
+    for i in range(3):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(6)])
+    oracle = _canon_plan(_cold_plan(table))
+
+    rio = RecordingFileIO(table.file_io)
+    watched = FileStoreTable(rio, table.path,
+                             table.schema_manager.latest(),
+                             branch=table.branch)
+
+    # first (over-bound) plan: the chain is read exactly once
+    plan = watched.new_scan().plan()
+    assert _canon_plan(plan) == oracle
+    first = [p for p in rio.reads if "/manifest/" in p]
+    assert len(first) == len(set(first)), first
+
+    # a later plan on the same tip skips the cold-state attempt and
+    # still reads the chain exactly once
+    rio.reads.clear()
+    plan = watched.new_scan().plan()
+    assert _canon_plan(plan) == oracle
+    second = [p for p in rio.reads if "/manifest/" in p]
+    assert len(second) == len(set(second)), second
+
+    # read_entries' own over-bound cold path reuses its walk too
+    reset_plan_caches()
+    rio.reads.clear()
+    entries = watched.new_scan().read_entries(watched.latest_snapshot())
+    assert len(entries) == 6                    # 3 commits x 2 buckets
+    third = [p for p in rio.reads if "/manifest/" in p]
+    assert len(third) == len(set(third)), third
+
+
+# -- vectorized manifest pruning --------------------------------------------
+
+
+def test_sidecar_prunes_partition_manifests_unfetched(tmp_path):
+    """Partition-filtered cold walks skip whole manifests via the
+    columnar sidecar: pruned manifest files are never read and their
+    entries never decoded."""
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"scan.plan.cache": "false"}, partitioned=True))
+    for pt in range(4):
+        _commit(table, [{"id": i, "v": float(pt), "pt": pt}
+                        for i in range(5)])
+    snap = table.latest_snapshot()
+    scan0 = table.new_scan()
+    all_metas = scan0.manifest_list.read_all(snap.base_manifest_list,
+                                             snap.delta_manifest_list)
+    assert len(all_metas) == 4                     # one per partition
+
+    rio = RecordingFileIO(table.file_io)
+    watched = FileStoreTable(rio, table.path,
+                             table.schema_manager.latest(),
+                             branch=table.branch)
+    pruned_before = _counter(PLAN_MANIFESTS_PRUNED)
+    read_before = _counter(PLAN_MANIFESTS_READ)
+    decoded_before = _counter(PLAN_ENTRIES_DECODED)
+    plan = watched.new_scan() \
+        .with_partition_filter({"pt": 2}).plan()
+    assert {s.partition for s in plan.splits} == {(2,)}
+    assert plan.row_count == 5
+
+    assert _counter(PLAN_MANIFESTS_PRUNED) - pruned_before == 3
+    assert _counter(PLAN_MANIFESTS_READ) - read_before == 1
+    fetched = {p.rsplit("/", 1)[-1] for p in rio.reads
+               if "/manifest/manifest-" in p
+               and "manifest-list" not in p.rsplit("/", 1)[-1]}
+    kept = [m for m in all_metas if m.file_name in fetched]
+    assert len(fetched) == 1 and len(kept) == 1
+    # the proof meter: only the surviving manifest's entries decoded
+    surviving_entries = len(scan0.manifest_file.read(
+        kept[0].file_name))
+    assert _counter(PLAN_ENTRIES_DECODED) - decoded_before == \
+        surviving_entries
+
+
+def test_sidecar_prunes_on_key_range(tmp_path):
+    """Key-range predicates prune manifests whose [min_key, max_key]
+    band misses the bounds — the LSM shape after manifest compaction
+    (clustered bands) makes this the dominant prune."""
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"scan.plan.cache": "false"}, buckets=1))
+    _commit(table, [{"id": i, "v": 1.0} for i in range(100)])
+    _commit(table, [{"id": i, "v": 2.0} for i in range(1000, 1100)])
+
+    pruned_before = _counter(PLAN_MANIFESTS_PRUNED)
+    plan = table.new_scan().with_key_filter(
+        and_(greater_than("id", 1000), less_or_equal("id", 1050))
+    ).plan()
+    assert _counter(PLAN_MANIFESTS_PRUNED) - pruned_before >= 1
+    # the surviving manifest's files only
+    files = [f for s in plan.splits for f in s.data_files]
+    assert len(files) == 1
+
+    # prune is CONSERVATIVE: the filtered read still answers right
+    rows = table.to_arrow(
+        predicate=and_(greater_than("id", 1000),
+                       less_or_equal("id", 1050)))
+    assert rows.num_rows == 50
+
+
+def test_key_filtered_cold_plan_prunes_with_cache_on(tmp_path):
+    """A key-filtered scan on a COLD default-config cache must take
+    the sidecar-pruned fallback (skipping whole manifests), not the
+    unpruned cold-state walk that fetches every one."""
+    table = FileStoreTable.create(str(tmp_path / "t"),
+                                  _schema(buckets=1))
+    _commit(table, [{"id": i, "v": 1.0} for i in range(100)])
+    _commit(table, [{"id": i, "v": 2.0} for i in range(1000, 1100)])
+    reset_plan_caches()              # the commit path warms the cache
+
+    pruned_before = _counter(PLAN_MANIFESTS_PRUNED)
+    plan = table.new_scan().with_key_filter(
+        and_(greater_than("id", 1000), less_or_equal("id", 1050))
+    ).plan()
+    assert _counter(PLAN_MANIFESTS_PRUNED) - pruned_before >= 1
+    assert len([f for s in plan.splits for f in s.data_files]) == 1
+    # an unfiltered plan afterwards still builds the cache state
+    before = _counter(PLAN_DELTA_APPLIES)
+    table.new_scan().plan()
+    _commit(table, [{"id": 5000, "v": 3.0}])
+    table.new_scan().plan()
+    assert _counter(PLAN_DELTA_APPLIES) == before + 1
+
+
+def test_sidecar_disabled_skips_key_stats(tmp_path):
+    """With manifest.stats.sidecar=false the manifest writer skips
+    the per-entry key-range decode whose only consumer is the
+    sidecar (commit hot path stays lean)."""
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"manifest.stats.sidecar": "false"}))
+    _commit(table, [{"id": 1, "v": 1.0}])
+    snap = table.latest_snapshot()
+    scan = table.new_scan()
+    metas = scan.manifest_list.read_all(snap.base_manifest_list,
+                                        snap.delta_manifest_list)
+    assert metas
+    assert all(m.min_key is None and m.max_key is None for m in metas)
+    # plans are unaffected — stats are advisory
+    assert _canon_plan(table.new_scan().plan()) == \
+        _canon_plan(_cold_plan(table))
+
+
+def test_sidecar_written_and_deleted_with_its_list(tmp_path):
+    """Every committed manifest list carries a .stats sidecar; expiry
+    reclaims the sidecar with the list."""
+    from paimon_tpu.manifest.stats_sidecar import sidecar_path
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    for i in range(4):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(4)])
+    scan = table.new_scan()
+    snap = table.latest_snapshot()
+    for name in (snap.base_manifest_list, snap.delta_manifest_list):
+        assert table.file_io.exists(sidecar_path(scan.manifest_list
+                                                 .path(name)))
+        assert scan.manifest_list.read_sidecar(name) is not None
+
+    old = table.snapshot_manager.snapshot(1)
+    old_delta = scan.manifest_list.path(old.delta_manifest_list)
+    table.expire_snapshots(retain_max=1, retain_min=1,
+                           older_than_ms=10 ** 18)
+    assert not table.file_io.exists(old_delta)
+    assert not table.file_io.exists(sidecar_path(old_delta))
+    assert table.fsck().ok
+
+
+def test_sidecar_corruption_degrades_to_python_fallback(tmp_path):
+    """A torn/garbage sidecar must never change results — pruning
+    falls back to the per-meta python check."""
+    from paimon_tpu.manifest.stats_sidecar import sidecar_path
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"scan.plan.cache": "false"}, partitioned=True))
+    for pt in range(3):
+        _commit(table, [{"id": i, "v": float(pt), "pt": pt}
+                        for i in range(4)])
+    scan = table.new_scan()
+    snap = table.latest_snapshot()
+    for name in (snap.base_manifest_list, snap.delta_manifest_list):
+        p = sidecar_path(scan.manifest_list.path(name))
+        if table.file_io.exists(p):
+            table.file_io.write_bytes(p, b"\x00garbage", overwrite=True)
+    plan = table.new_scan().with_partition_filter({"pt": 1}).plan()
+    assert {s.partition for s in plan.splits} == {(1,)}
+    assert plan.row_count == 4
+
+
+def test_sidecar_write_failure_does_not_abort_commit(tmp_path):
+    """The sidecar is ADVISORY: a store failure on its PUT must not
+    fail a commit whose required artifacts all landed — the commit
+    proceeds without a sidecar and pruning falls back to the per-meta
+    python check."""
+    from paimon_tpu.manifest.stats_sidecar import SIDECAR_PREFIX
+
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    _commit(table, [{"id": 1, "v": 1.0}])
+
+    class SidecarFailingIO:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def write_bytes(self, path, *a, **k):
+            if path.rsplit("/", 1)[-1].startswith(SIDECAR_PREFIX):
+                raise OSError("injected sidecar PUT failure")
+            return self._inner.write_bytes(path, *a, **k)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    failing = FileStoreTable(SidecarFailingIO(table.file_io),
+                             table.path, table.schema_manager.latest(),
+                             branch=table.branch)
+    sid = _commit(failing, [{"id": 2, "v": 2.0}])
+    assert sid is not None
+
+    snap = table.latest_snapshot()
+    assert snap.id == sid
+    scan = table.new_scan()
+    # the new lists carry no sidecar; readers degrade to None
+    assert scan.manifest_list.read_sidecar(
+        snap.delta_manifest_list) is None
+    assert _canon_plan(table.new_scan().plan()) == \
+        _canon_plan(_cold_plan(table))
+    assert table.to_arrow().num_rows == 2
+    assert table.fsck().ok
+
+
+# -- manifest full-compaction -----------------------------------------------
+
+
+def test_manifest_compaction_trigger_and_result(tmp_path):
+    """The count trigger fires at manifest.full-compaction.threshold;
+    the rewrite folds the chain into clustered base manifests, the
+    live set is unchanged, and warm plans ride across it."""
+    from paimon_tpu.maintenance.manifest_compact import (
+        manifest_compaction_needed,
+    )
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"manifest.full-compaction.threshold": "4",
+                 "manifest.merge-min-count": "1000"}))
+    for i in range(3):
+        _commit(table, [{"id": j, "v": float(i)} for j in range(8)])
+    assert not manifest_compaction_needed(table)
+    assert table.compact_manifests(force=False) is None
+
+    _commit(table, [{"id": 99, "v": 9.0}])
+    table.new_scan().plan()                        # warm cache
+    assert manifest_compaction_needed(table)
+    before_rows = table.to_arrow()
+    sid = table.compact_manifests(force=False)
+    assert sid is not None
+    assert not manifest_compaction_needed(table)
+
+    snap = table.latest_snapshot()
+    scan = table.new_scan()
+    assert scan.manifest_list.read(snap.delta_manifest_list) == []
+    base = scan.manifest_list.read(snap.base_manifest_list)
+    assert 1 <= len(base) < 4
+    # entries clustered by (partition, bucket, key)
+    for m in base:
+        entries = scan.manifest_file.read(m.file_name)
+        keys = [(e.partition, e.bucket) for e in entries]
+        assert keys == sorted(keys)
+
+    # the cache folds the empty delta as a no-op and stays identical
+    before = _counter(PLAN_DELTA_APPLIES)
+    warm = table.new_scan().plan()
+    assert _counter(PLAN_DELTA_APPLIES) == before + 1
+    assert _canon_plan(warm) == _canon_plan(_cold_plan(table))
+    assert table.to_arrow().equals(before_rows)
+    assert table.fsck().ok
+
+
+def test_compacted_base_alone_does_not_retrigger(tmp_path):
+    """Only SMALL (sub-half-target) manifests count toward the
+    full-compaction trigger: a table big enough that its compacted
+    base alone spans >= threshold full-size manifests must not
+    re-run the full chain rewrite on every maintenance tick.  (The
+    end-to-end small-table trigger rides
+    test_manifest_compaction_trigger_and_result — there every
+    manifest is below half the 8MB default target, so the count
+    semantics are unchanged.)"""
+    from paimon_tpu.maintenance.manifest_compact import (
+        manifest_compaction_needed,
+    )
+    from paimon_tpu.options import CoreOptions
+
+    table = FileStoreTable.create(
+        str(tmp_path / "t"),
+        _schema({"manifest.full-compaction.threshold": "3"}))
+    _commit(table, [{"id": 1, "v": 1.0}])
+    target = table.options.get(CoreOptions.MANIFEST_TARGET_FILE_SIZE)
+
+    class _Meta:
+        def __init__(self, size):
+            self.file_size = size
+
+    synthetic = {}
+
+    class _FakeList:
+        def read_all(self, base, delta):
+            return synthetic["metas"]
+
+    class _FakeScan:
+        manifest_list = _FakeList()
+
+    table.new_scan = lambda: _FakeScan()        # instance shadow
+
+    # a compacted base of 50 full-size manifests alone: never fires
+    synthetic["metas"] = [_Meta(target)] * 50
+    assert not manifest_compaction_needed(table)
+    # ...nor with fewer than threshold small deltas on top...
+    synthetic["metas"] = [_Meta(target)] * 50 + [_Meta(1024)] * 2
+    assert not manifest_compaction_needed(table)
+    # ...until >= threshold small deltas accumulate
+    synthetic["metas"] = [_Meta(target)] * 50 + [_Meta(1024)] * 3
+    assert manifest_compaction_needed(table)
+
+
+def test_manifest_compaction_crash_sweep(tmp_path):
+    """Kill every mutating op in manifest full-compaction: the table
+    stays readable, a restart converges, fsck is clean."""
+    def make(tag):
+        table = FileStoreTable.create(
+            str(tmp_path / tag),
+            _schema({"manifest.merge-min-count": "1000"}))
+        for i in range(3):
+            _commit(table, [{"id": j, "v": float(i)}
+                            for j in range(i, i + 4)])
+        return table
+
+    expected = {}
+    for i in range(3):
+        for j in range(i, i + 4):
+            expected[j] = float(i)
+
+    def verify_converged(table):
+        rows = {r["id"]: r["v"] for r in table.to_arrow().to_pylist()}
+        assert rows == expected
+
+    points = crash_point_sweep(
+        make, lambda t: t.compact_manifests(force=True),
+        name="manifest-compact",
+        verify_converged=verify_converged)
+    assert len(points) >= 3                        # manifests + lists + CAS
+
+
+# -- serving plane: double-buffered plan swap -------------------------------
+
+
+def test_lookup_never_blocks_on_plan_refresh(tmp_path):
+    """A lookup arriving while another thread's refresh is mid-plan
+    serves the CURRENT plan immediately instead of waiting for the
+    manifest walk."""
+    from paimon_tpu.lookup.local_query import LocalTableQuery
+    table = FileStoreTable.create(str(tmp_path / "t"), _schema())
+    _commit(table, [{"id": 1, "v": 1.0}, {"id": 2, "v": 2.0}])
+
+    lq = LocalTableQuery(table, cache_dir=str(tmp_path / "c"))
+    try:
+        assert lq.lookup_row({"id": 1})["v"] == 1.0   # first load
+        _commit(table, [{"id": 1, "v": 10.0}])
+
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = lq._load_plan
+
+        def slow_load():
+            entered.set()
+            assert gate.wait(10)
+            return orig()
+
+        lq._load_plan = slow_load
+        refresher_done = threading.Event()
+
+        def refresher():
+            lq.lookup_row({"id": 1})
+            refresher_done.set()
+
+        t = threading.Thread(target=refresher, daemon=True)
+        t.start()
+        assert entered.wait(10)
+        # refresh is parked mid-plan: a concurrent lookup must answer
+        # from the OLD plan without blocking
+        t0 = time.monotonic()
+        row = lq.lookup_row({"id": 2})
+        dt = time.monotonic() - t0
+        assert row["v"] == 2.0
+        assert dt < 2.0
+        assert not refresher_done.is_set()
+        gate.set()
+        t.join(10)
+        assert refresher_done.is_set()
+        # once the refresh lands, the new value serves
+        assert lq.lookup_row({"id": 1})["v"] == 10.0
+    finally:
+        lq._load_plan = orig
+        gate.set()
+        lq.close()
+
+
+# -- predicate bounds extractor ---------------------------------------------
+
+
+def test_conjunctive_bounds():
+    from paimon_tpu.predicate import (
+        conjunctive_bounds, equal, greater_or_equal, in_, or_,
+    )
+    assert conjunctive_bounds(equal("k", 5), "k") == (5, 5)
+    assert conjunctive_bounds(greater_than("k", 3), "k") == (3, None)
+    assert conjunctive_bounds(
+        and_(greater_or_equal("k", 3), less_or_equal("k", 9)),
+        "k") == (3, 9)
+    assert conjunctive_bounds(in_("k", [7, 2, 5]), "k") == (2, 7)
+    # OR contributes nothing; other fields contribute nothing
+    assert conjunctive_bounds(
+        or_(equal("k", 1), equal("k", 2)), "k") is None
+    assert conjunctive_bounds(equal("other", 1), "k") is None
+    # AND folds across children, ignoring unrelated legs
+    b = conjunctive_bounds(
+        and_(equal("other", 1), greater_than("k", 10)), "k")
+    assert b == (10, None)

@@ -159,6 +159,12 @@ class TestRemoveUnexistingManifests:
         data_manifests = [m for m in manifests
                           if "list" not in m.rsplit("/", 1)[-1]]
         os.remove(data_manifests[1])
+        # a warm delta-apply plan cache (populated by the commits
+        # above) legitimately masks the out-of-band deletion in this
+        # process; the corruption bites a COLD planner — any fresh
+        # process — which is who this repair exists for
+        from paimon_tpu.core.plan_cache import reset_plan_caches
+        reset_plan_caches()
         with pytest.raises(Exception):
             t.to_arrow()
         sid = remove_unexisting_manifests(t)
