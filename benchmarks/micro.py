@@ -335,6 +335,24 @@ def bench_multihost():
     measure(rows=min(ROWS, 200_000))
 
 
+def bench_fsck():
+    """Incremental fsck trajectory (full 10k/100k/1M matrix in
+    benchmarks/fsck_bench.py; this entry keeps a 20k-file
+    full-vs-incremental verification comparison in the micro
+    record)."""
+    from benchmarks.fsck_bench import measure_fsck
+    files = min(max(ROWS // 50, 5_000), 20_000)
+    r = measure_fsck(scales=(files,))["scales"][0]
+    for name, value, unit in (
+            ("fsck_full_ms", r["full_fsck_ms"], "ms"),
+            ("fsck_incremental_ms", r["inc_fsck_ms"], "ms"),
+            ("fsck_inc_vs_full_pct", r["inc_vs_full_pct"], "%")):
+        print(json.dumps({"benchmark": name, "value": value,
+                          "unit": unit, "files": r["files"],
+                          "platform": _PLATFORM,
+                          "device_kind": _DEVICE_KIND}), flush=True)
+
+
 BENCHES = {
     "read_parquet": lambda: bench_read("parquet"),
     "read_orc": lambda: bench_read("orc"),
@@ -349,6 +367,7 @@ BENCHES = {
     "tier": bench_tier,
     "multihost": bench_multihost,
     "plan": bench_plan,
+    "fsck": bench_fsck,
 }
 
 

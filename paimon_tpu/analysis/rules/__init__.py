@@ -12,8 +12,10 @@ engine.  Grouped by family:
   sanctioned bounded forms
 * fault    — fault-taxonomy: transient store errors handled outside
   parallel/fault.py's ladder
+* ownership — ownership-history: ownership-stamp properties parsed
+  outside parallel/distributed.py's stamp/history API
 """
 
 from paimon_tpu.analysis.rules import (  # noqa: F401
-    deadline, drift, eventloop, fault, hygiene, locks,
+    deadline, drift, eventloop, fault, hygiene, locks, ownership,
 )

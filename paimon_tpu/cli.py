@@ -301,12 +301,16 @@ def cmd_table(args):
             raise SystemExit(1)
     elif cmd == "fsck":
         table = _table(catalog, args.table)
-        report = table.fsck(snapshot_id=args.snapshot, deep=args.deep)
+        report = table.fsck(snapshot_id=args.snapshot, deep=args.deep,
+                            incremental=args.incremental,
+                            stamp_watermark=args.stamp_watermark)
         if args.fix and not report.ok:
             from paimon_tpu.maintenance import fix_violations
             actions = fix_violations(table, report)
             report = table.fsck(snapshot_id=args.snapshot,
-                                deep=args.deep)
+                                deep=args.deep,
+                                incremental=args.incremental,
+                                stamp_watermark=args.stamp_watermark)
             out = report.to_dict()
             out["fix_actions"] = actions
         else:
@@ -343,6 +347,64 @@ def cmd_branch(args):
     elif args.branch_cmd == "fast-forward":
         table.fast_forward(args.name)
         print("OK")
+
+
+def cmd_fleet(args):
+    """Fleet-plane introspection, read purely from snapshot
+    properties through the sanctioned history API
+    (parallel/distributed.py — the `ownership-history` lint rule
+    forbids raw `multihost.ownership.*` parsing here too)."""
+    import time as _time
+
+    catalog = _load_catalog(args)
+    table = _table(catalog, args.table)
+    from paimon_tpu.parallel.distributed import (
+        merge_lease_view, merge_rejoin_requests,
+        resume_generation_history,
+    )
+    from paimon_tpu.service.stream_daemon import recover_plane_stamps
+
+    hist = resume_generation_history(table)
+    if hist is None:
+        print(json.dumps({"distributed": False}, indent=2))
+        return
+    current = hist.current()
+    now = int(_time.time() * 1000)
+    leases = merge_lease_view(table, max_walk=args.lease_walk)
+    requests = merge_rejoin_requests(table)
+    hosts = {}
+    for p in range(current.num_processes):
+        ledger, floors = recover_plane_stamps(
+            table, f"{args.base_user}-p{p}")
+        # bucket shares for the default partition — partitioned
+        # tables shard per (partition, bucket), so per-partition
+        # ownership can differ; this is the representative view
+        owned = [b for b in range(current.num_buckets)
+                 if current.owner_of((), b) == p]
+        lease_ms = leases.get(p)
+        hosts[str(p)] = {
+            "dead": p in current.dead,
+            "rejoin_requested": p in requests,
+            "lease_age_ms": None if lease_ms is None
+            else max(0, now - lease_ms),
+            "adopted": sorted(ledger),
+            "floors": {str(k): v for k, v in sorted(floors.items())},
+            "owned_buckets": owned,
+        }
+    out = {
+        "distributed": True,
+        "version": current.version,
+        "processes": current.num_processes,
+        "buckets": current.num_buckets,
+        "dead": sorted(current.dead),
+        "rejoining": sorted(p for p in requests if p in current.dead),
+        "hosts": hosts,
+        "generations": [
+            {"version": m.version, "processes": m.num_processes,
+             "buckets": m.num_buckets, "dead": sorted(m.dead)}
+            for m in hist.entries],
+    }
+    print(json.dumps(out, indent=2))
 
 
 def cmd_sql(args):
@@ -547,6 +609,13 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--fix", action="store_true",
                    help="repair fixable violations "
                         "(maintenance/repair.py), then re-check")
+    c.add_argument("--incremental", action="store_true",
+                   help="verify only the delta since the last clean "
+                        "sweep's watermark (silently runs full when "
+                        "it is absent or invalidated)")
+    c.add_argument("--stamp-watermark", action="store_true",
+                   help="record a clean full-chain verification at "
+                        "the tip, arming the next incremental run")
     t.set_defaults(func=cmd_table)
 
     tg = sub.add_parser("tag", help="tag operations")
@@ -577,6 +646,21 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("table")
     c.add_argument("name")
     br.set_defaults(func=cmd_branch)
+
+    fl = sub.add_parser("fleet", help="multi-host fleet plane")
+    flsub = fl.add_subparsers(dest="fleet_cmd", required=True)
+    c = flsub.add_parser(
+        "status",
+        help="ownership-generation history, lease view, dead/"
+             "adopted/rejoining sets, per-host bucket shares")
+    c.add_argument("table")
+    c.add_argument("--base-user", default="stream-daemon",
+                   help="the daemons' commit-user base (per-host "
+                        "users are <base>-p<i>)")
+    c.add_argument("--lease-walk", type=int, default=16,
+                   help="newest-first snapshots merged into the "
+                        "lease view")
+    fl.set_defaults(func=cmd_fleet)
 
     s = sub.add_parser("sql", help="run SQL (or start a REPL)")
     s.add_argument("query", nargs="?", help="statement; omit for a REPL")

@@ -21,3 +21,7 @@ from paimon_tpu.maintenance.orphan import remove_orphan_files  # noqa: F401
 from paimon_tpu.maintenance.partition_expire import (  # noqa: F401
     expire_partitions,
 )
+from paimon_tpu.maintenance.watermark import (  # noqa: F401
+    FSCK_WATERMARK_PREFIX, ORPHAN_WATERMARK_PREFIX, SweepWatermark,
+    read_watermark, stamp_watermark, validate_watermark,
+)

@@ -29,9 +29,12 @@ class ExpireResult:
     expired_snapshots: List[int] = field(default_factory=list)
     deleted_data_files: int = 0
     deleted_manifest_files: int = 0
+    # heartbeat snapshots folded OUT OF THE MIDDLE of the retained
+    # chain (lease/rejoin traffic carrying no data and no offsets)
+    folded_snapshots: List[int] = field(default_factory=list)
 
     def is_empty(self) -> bool:
-        return not self.expired_snapshots
+        return not self.expired_snapshots and not self.folded_snapshots
 
 
 def _sidecar_name(list_name: str) -> str:
@@ -220,6 +223,81 @@ def expire_changelogs(table, retain_max: Optional[int] = None,
     return result
 
 
+def _fold_heartbeats(table, dry_run: bool = False) -> List[int]:
+    """Fold pure-heartbeat snapshots out of the MIDDLE of the retained
+    chain.  The multi-host planes commit lease renewals / rejoin
+    requests as forced empty snapshots at heartbeat cadence; under a
+    long-idle fleet they are the ONLY traffic, the count/age expiry
+    windows never trigger (they only trim the tail), and the chain
+    grows without bound.  A snapshot folds when it is provably inert:
+
+      - strictly inside the chain (never the earliest or latest),
+      - APPEND kind with deltaRecordCount == 0 and no changelog list
+        (no data, no deliveries),
+      - carries NO `stream.source.offset` — offset checkpoints are
+        recovery points and takeover/rejoin floors (the offset floor),
+      - NOT the newest such snapshot of its commit user — the lease
+        view, rejoin requests and sweep watermarks are max-merged over
+        a bounded newest-first walk, so each user's newest heartbeat
+        stays visible (the lease floor),
+      - below every consumer's progress (consumers walk ids and must
+        never meet a hole ahead of them).
+
+    Deletes the snapshot file and its uniquely-owned manifest LIST
+    files (+ stats sidecars) — never manifests or data, which are
+    shared.  Folded ids are durably recorded in `snapshot/FOLDED`
+    BEFORE deletion so fsck's chain check can tell a fold from torn
+    expiry."""
+    from paimon_tpu.service.stream_daemon import PROP_OFFSET
+    from paimon_tpu.snapshot.snapshot import CommitKind
+
+    sm = table.snapshot_manager
+    earliest = sm.earliest_snapshot_id()
+    latest = sm.latest_snapshot_id()
+    if earliest is None or latest is None or latest - earliest < 2:
+        return []
+    consumer_min = table.consumer_manager.min_next_snapshot()
+    seen_users: set = set()
+    candidates = []
+    for sid in range(latest - 1, earliest, -1):
+        try:
+            snap = sm.snapshot(sid)
+        # lint-ok: fault-taxonomy id-walk skip, not a retry: a hole
+        # (expired or already-folded id) just moves to the next id
+        except (FileNotFoundError, OSError):
+            continue
+        props = snap.properties or {}
+        if PROP_OFFSET in props:
+            continue
+        if snap.commit_kind != CommitKind.APPEND or \
+                snap.delta_record_count or \
+                snap.changelog_manifest_list:
+            continue
+        if consumer_min is not None and sid >= consumer_min:
+            continue
+        user = snap.commit_user or ""
+        if user not in seen_users:
+            seen_users.add(user)            # newest heartbeat survives
+            continue
+        candidates.append(snap)
+    if not candidates or dry_run:
+        return sorted(s.id for s in candidates)
+
+    sm.record_folded([s.id for s in candidates])
+    scan = table.new_scan()
+    for s in candidates:
+        for list_name in (s.base_manifest_list, s.delta_manifest_list):
+            if not list_name:
+                continue
+            table.file_io.delete_quietly(
+                f"{scan.path_factory.manifest_dir}/{list_name}")
+            table.file_io.delete_quietly(
+                f"{scan.path_factory.manifest_dir}/"
+                f"{_sidecar_name(list_name)}")
+        sm.delete_snapshot(s.id)
+    return sorted(s.id for s in candidates)
+
+
 def _clean_empty_dirs(table, bucket_dirs) -> None:
     """snapshot.clean-empty-directories: drop bucket dirs emptied by
     expiration, then any partition dirs emptied in turn (reference
@@ -309,6 +387,9 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
         end = min(end, min_retained_snapshot_id)
     end = min(end, latest)              # always keep the latest
     if end <= earliest:
+        # the tail window kept everything — heartbeat folding is the
+        # EAGER path and still runs (long-idle chains stay bounded)
+        result.folded_snapshots = _fold_heartbeats(table, dry_run)
         return result
 
     expiring = []
@@ -318,6 +399,7 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
         except FileNotFoundError:
             continue
     if not expiring:
+        result.folded_snapshots = _fold_heartbeats(table, dry_run)
         return result
 
     # referenced by anything that survives: retained snapshots, tags,
@@ -368,6 +450,7 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
     result.deleted_data_files = len(dead_data)
     result.deleted_manifest_files = len(dead_manifests)
     if dry_run:
+        result.folded_snapshots = _fold_heartbeats(table, dry_run=True)
         return result
 
     dead_paths = []
@@ -404,4 +487,5 @@ def expire_snapshots(table, retain_max: Optional[int] = None,
                 f"{table.path}/statistics/{s.statistics}")
         sm.delete_snapshot(s.id)
     sm.commit_earliest_hint(end)
+    result.folded_snapshots = _fold_heartbeats(table, dry_run)
     return result

@@ -96,6 +96,12 @@ class FsckReport:
     snapshots_checked: int = 0
     manifests_checked: int = 0
     data_files_checked: int = 0
+    # total manifest ENTRIES decoded — the incremental-vs-full tests
+    # assert O(delta) work on this, not on wall clock
+    manifest_entries_decoded: int = 0
+    # whether this run actually rode a valid watermark (False when
+    # incremental was requested but absent/invalidated -> full pass)
+    incremental: bool = False
 
     @property
     def ok(self) -> bool:
@@ -118,6 +124,8 @@ class FsckReport:
             "snapshots_checked": self.snapshots_checked,
             "manifests_checked": self.manifests_checked,
             "data_files_checked": self.data_files_checked,
+            "manifest_entries_decoded": self.manifest_entries_decoded,
+            "incremental": self.incremental,
             "violations": [v.to_dict() for v in self.violations],
         }
 
@@ -134,6 +142,11 @@ class _GraphWalker:
         # name -> entries, or None when the manifest is missing/corrupt
         self._manifest_cache: Dict[str, Optional[list]] = {}
         self._exists_cache: Dict[str, bool] = {}
+        # manifests proven by the LAST clean sweep (seeded from the
+        # watermark snapshot) or earlier in THIS run — the incremental
+        # walk never re-decodes them (manifest files are immutable)
+        self._verified: set = set()
+        self._verified_index: set = set()
         key_types = [
             table.schema.logical_row_type().get_field(k).type.copy(False)
             for k in table.schema.trimmed_primary_keys()]
@@ -178,6 +191,8 @@ class _GraphWalker:
                     f"manifest file exists but cannot be decoded "
                     f"(truncated or corrupt): {e}", sid)
         self.report.manifests_checked += 1
+        if entries is not None:
+            self.report.manifest_entries_decoded += len(entries)
         self._manifest_cache[name] = entries
         return entries
 
@@ -224,6 +239,76 @@ class _GraphWalker:
         self._check_level_overlap(live, sid)
         self._check_row_counts(live, snap)
         self._check_index_manifest(snap)
+        self._check_changelogs(snap)
+
+    # -- incremental walk (rides the delta manifest lists) -------------------
+
+    def seed_from(self, snap: Snapshot) -> bool:
+        """Mark every manifest reachable from the watermark snapshot as
+        verified (names only — two list reads, zero manifest decodes).
+        False when a list is unreadable: the watermark can't be
+        trusted and the caller demotes to a full pass."""
+        for list_name in (snap.base_manifest_list,
+                          snap.delta_manifest_list):
+            if not list_name:
+                continue
+            try:
+                metas = self.scan.manifest_list.read(list_name)
+            except Exception:               # noqa: BLE001
+                return False
+            self._verified.update(m.file_name for m in metas)
+        if snap.index_manifest:
+            self._verified_index.add(snap.index_manifest)
+        return True
+
+    def check_snapshot_delta(self, snap: Snapshot,
+                             prev: Optional[Snapshot]):
+        """Incremental per-snapshot check: decode only manifests NOT
+        proven by the last clean sweep (new delta manifests, base
+        manifests rewritten by manifest compaction) and verify the
+        data files they ADD.  The level-overlap and absolute
+        row-count invariants need the MERGED live set and stay with
+        the periodic full pass (the oracle); the absolute count is
+        replaced here by the arithmetic delta check — each snapshot's
+        totalRecordCount must equal the previous one's plus the net
+        row count of its delta manifests, anchored at the watermark
+        snapshot's verified total."""
+        report, sid = self.report, snap.id
+        report.snapshots_checked += 1
+        new_entries: list = []
+        delta_rows = 0
+        for plane, list_name in (("base", snap.base_manifest_list),
+                                 ("delta", snap.delta_manifest_list)):
+            if not list_name:
+                continue
+            metas = self.read_manifest_list(list_name, sid, plane)
+            for m in metas or []:
+                if m.file_name in self._verified:
+                    continue
+                self._verified.add(m.file_name)
+                got = self.read_manifest(m.file_name, sid)
+                for e in got or []:
+                    new_entries.append(e)
+                    if plane == "delta":
+                        delta_rows += e.file.row_count \
+                            if e.kind == FileKind.ADD \
+                            else -e.file.row_count
+        live = [e for e in new_entries if e.kind == FileKind.ADD]
+        self._check_data_files(live, sid)
+        if prev is not None:
+            want = prev.total_record_count + delta_rows
+            if want != snap.total_record_count:
+                report.add(
+                    ViolationKind.ROW_COUNT_MISMATCH,
+                    f"{SNAPSHOT_PREFIX}{sid}",
+                    f"snapshot records totalRecordCount="
+                    f"{snap.total_record_count}, previous snapshot "
+                    f"{prev.id} plus its delta manifests gives "
+                    f"{want}", sid)
+        if snap.index_manifest and \
+                snap.index_manifest not in self._verified_index:
+            self._verified_index.add(snap.index_manifest)
+            self._check_index_manifest(snap)
         self._check_changelogs(snap)
 
     def _check_data_files(self, live, sid: int):
@@ -390,10 +475,7 @@ def _check_ownership_chain(table, report: FsckReport, ids: List[int]):
     (A new generation MAY clear the dead set — a full-cohort rejoin
     bumps the version; what it may never do is reuse an old one.)
     """
-    from paimon_tpu.parallel.distributed import (
-        OWNERSHIP_BUCKETS_PROP, OWNERSHIP_DEAD_PROP,
-        OWNERSHIP_PROCESSES_PROP, OWNERSHIP_VERSION_PROP,
-    )
+    from paimon_tpu.parallel.distributed import stamp_from_properties
     sm = table.snapshot_manager
     prev_sid = prev_version = None
     by_version: dict = {}
@@ -402,23 +484,20 @@ def _check_ownership_chain(table, report: FsckReport, ids: List[int]):
             snap = sm.snapshot(sid)
         except (FileNotFoundError, OSError, ValueError, KeyError):
             continue   # missing/corrupt: reported by the graph walk
-        props = snap.properties or {}
-        if OWNERSHIP_VERSION_PROP not in props:
-            continue
         try:
-            version = int(props[OWNERSHIP_VERSION_PROP])
-            shape = (int(props.get(OWNERSHIP_PROCESSES_PROP) or 0),
-                     int(props.get(OWNERSHIP_BUCKETS_PROP) or 0))
-            dead = frozenset(
-                int(p) for p in
-                (props.get(OWNERSHIP_DEAD_PROP) or "").split(",")
-                if p.strip())
+            stamp = stamp_from_properties(snap.properties or {})
         except ValueError:
             report.add(ViolationKind.OWNERSHIP_INCONSISTENCY,
                        f"{SNAPSHOT_PREFIX}{sid}",
                        "unparsable multihost.ownership.* properties",
                        sid)
             continue
+        if stamp is None:
+            continue
+        stamped_map, _history = stamp
+        version = stamped_map.version
+        shape = (stamped_map.num_processes, stamped_map.num_buckets)
+        dead = stamped_map.dead
         if prev_version is not None and version < prev_version:
             report.add(
                 ViolationKind.OWNERSHIP_INCONSISTENCY,
@@ -447,7 +526,10 @@ def _check_chain(table, report: FsckReport) -> List[int]:
     sm = table.snapshot_manager
     ids = sm._all_ids()
     if ids:
-        missing = sorted(set(range(ids[0], ids[-1] + 1)) - set(ids))
+        # ids folded out of the middle by the heartbeat-folding pass
+        # (maintenance/expire.py) are legitimate holes, not torn expiry
+        missing = sorted(set(range(ids[0], ids[-1] + 1)) - set(ids)
+                         - sm.folded_ids())
         for sid in missing:
             report.add(ViolationKind.SNAPSHOT_GAP,
                        f"{SNAPSHOT_PREFIX}{sid}",
@@ -463,7 +545,9 @@ def _check_chain(table, report: FsckReport) -> List[int]:
 
 
 def fsck(table, snapshot_id: Optional[int] = None,
-         all_snapshots: bool = True, deep: bool = False) -> FsckReport:
+         all_snapshots: bool = True, deep: bool = False,
+         incremental: bool = False,
+         stamp_watermark: bool = False) -> FsckReport:
     """Verify the table's snapshot→manifest→file graph; returns an
     `FsckReport` of typed violations (empty = healthy).
 
@@ -471,16 +555,62 @@ def fsck(table, snapshot_id: Optional[int] = None,
     `all_snapshots=False` checks only the latest.  `deep=True`
     additionally reads every live data file and compares actual row
     counts against manifest stats (IO-heavy).  The snapshot chain and
-    hint files are always checked."""
-    from paimon_tpu.metrics import FSCK_VIOLATIONS, global_registry
+    hint files are always checked.
+
+    `incremental=True` rides the last clean sweep's watermark
+    (maintenance/watermark.py): only snapshots committed after it are
+    walked, and only manifests it did not already prove are decoded —
+    O(delta), not O(table).  An absent, expired, or invalidated
+    watermark (rollback_to / fast_forward recreated the stamped id)
+    silently demotes to a full pass; `report.incremental` records
+    which actually ran.  The level-overlap and absolute row-count
+    invariants need the merged live set and are only checked by the
+    full pass — run one periodically as the oracle.
+
+    `stamp_watermark=True` records a clean full-chain verification at
+    the tip via one small forced commit, arming the next incremental
+    run.  Never stamped when violations were found or when the walk
+    was partial (`snapshot_id`/`all_snapshots=False`)."""
+    from paimon_tpu.maintenance.watermark import (
+        FSCK_WATERMARK_PREFIX, read_watermark, validate_watermark,
+    )
+    from paimon_tpu.maintenance.watermark import (
+        stamp_watermark as _stamp_watermark,
+    )
+    from paimon_tpu.metrics import (
+        FLEET_FSCK_INCREMENTAL_RUNS, FLEET_FSCK_OBJECTS_CHECKED,
+        FLEET_FSCK_WATERMARK_AGE_MS, FSCK_VIOLATIONS, global_registry,
+    )
 
     report = FsckReport()
     ids = _check_chain(table, report)
     if not ids:
         return report
+    sm = table.snapshot_manager
+
+    wm = wm_snap = None
+    if incremental and snapshot_id is None:
+        wm = read_watermark(table, FSCK_WATERMARK_PREFIX)
+        if wm is not None and validate_watermark(table, wm):
+            try:
+                wm_snap = sm.snapshot(wm.snapshot_id)
+            except Exception:               # noqa: BLE001
+                wm_snap = None
+        if wm_snap is None:
+            wm = None           # absent/expired/rolled-back: full
+
+    walker = _GraphWalker(table, report, deep)
+    if wm_snap is not None and not walker.seed_from(wm_snap):
+        wm = wm_snap = None     # seed lists unreadable: full pass
+    report.incremental = wm is not None
+
     # chain-level multihost ownership consistency (cheap: properties
-    # only, no manifest IO) — always on, like the hint checks
-    _check_ownership_chain(table, report, ids)
+    # only, no manifest IO) — always on, like the hint checks; the
+    # incremental run re-anchors at the watermark snapshot so version
+    # monotonicity is checked ACROSS the sweep boundary
+    own_ids = ids if wm is None \
+        else [i for i in ids if i >= wm.snapshot_id]
+    _check_ownership_chain(table, report, own_ids)
 
     if snapshot_id is not None:
         targets = [snapshot_id] if snapshot_id in ids else []
@@ -489,26 +619,48 @@ def fsck(table, snapshot_id: Optional[int] = None,
                        f"{SNAPSHOT_PREFIX}{snapshot_id}",
                        f"requested snapshot {snapshot_id} does not "
                        f"exist", snapshot_id)
+    elif wm is not None:
+        targets = [i for i in ids if i > wm.snapshot_id]
     elif all_snapshots:
         targets = ids
     else:
         targets = [ids[-1]]
 
-    walker = _GraphWalker(table, report, deep)
-    sm = table.snapshot_manager
+    prev = wm_snap
     for sid in targets:
         try:
             snap = sm.snapshot(sid)
         except FileNotFoundError:
-            continue                        # raced an expire; chain
+            prev = None                     # raced an expire; chain
+            continue
         except Exception as e:              # noqa: BLE001
             report.add(ViolationKind.CORRUPT_SNAPSHOT,
                        f"{SNAPSHOT_PREFIX}{sid}",
                        f"snapshot file undecodable: {e}", sid)
+            prev = None     # arithmetic check re-anchors at next good
             continue
-        walker.check_snapshot(snap)
+        if wm is not None:
+            walker.check_snapshot_delta(snap, prev)
+            prev = snap
+        else:
+            walker.check_snapshot(snap)
+
+    if incremental and snapshot_id is None:
+        fleet = global_registry().fleet_metrics()
+        fleet.counter(FLEET_FSCK_INCREMENTAL_RUNS).inc()
+        fleet.counter(FLEET_FSCK_OBJECTS_CHECKED).inc(
+            report.snapshots_checked + report.manifests_checked
+            + report.data_files_checked)
+        if wm is not None:
+            import time as _time
+            fleet.gauge(FLEET_FSCK_WATERMARK_AGE_MS).set(
+                max(0, int(_time.time() * 1000) - wm.ts_ms))
 
     if report.violations:
         global_registry().maintenance_metrics().counter(
             FSCK_VIOLATIONS).inc(len(report.violations))
+    elif stamp_watermark and snapshot_id is None and \
+            (all_snapshots or incremental):
+        _stamp_watermark(table, FSCK_WATERMARK_PREFIX,
+                         commit_user="fsck")
     return report

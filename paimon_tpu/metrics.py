@@ -65,7 +65,10 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "MULTIHOST_LEASE_RENEWALS", "MULTIHOST_LEASE_EXPIRED",
            "PLAN_PLANS", "PLAN_MS", "PLAN_DELTA_APPLIES",
            "PLAN_MANIFESTS_READ", "PLAN_MANIFESTS_PRUNED",
-           "PLAN_ENTRIES_DECODED", "PLAN_MANIFEST_COMPACTIONS"]
+           "PLAN_ENTRIES_DECODED", "PLAN_MANIFEST_COMPACTIONS",
+           "FLEET_REJOINS", "FLEET_GENERATIONS",
+           "FLEET_FSCK_INCREMENTAL_RUNS", "FLEET_FSCK_OBJECTS_CHECKED",
+           "FLEET_FSCK_WATERMARK_AGE_MS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -266,6 +269,26 @@ PLAN_MANIFESTS_READ = "manifests_read"        # manifest files fetched
 PLAN_MANIFESTS_PRUNED = "manifests_pruned"    # skipped before fetch
 PLAN_ENTRIES_DECODED = "entries_decoded"      # manifest entries decoded
 PLAN_MANIFEST_COMPACTIONS = "manifest_compactions"  # full rewrites
+
+# self-healing fleet-plane counter/gauge names (fleet metric group;
+# producers in parallel/maintenance_plane.py + maintenance/fsck.py +
+# maintenance/orphan.py, consumers the kill-two-then-rejoin soak tests
+# + dashboards).  rejoins counts hosts READMITTED into the ownership
+# map by the elected granter (the acceptance signal of operator-free
+# healing: two victims rejoining render rejoins 2); generations is a
+# gauge of the current ownership-map version (every takeover, rejoin
+# and rescale advances it); fsck_incremental_runs counts fsck/orphan
+# sweeps that rode the watermark delta walk instead of the full chain;
+# fsck_objects_checked counts objects (snapshots, manifest lists,
+# manifests, data files) a sweep actually verified — the O(delta)
+# proof meter, mirroring plan entries_decoded; fsck_watermark_age_ms
+# is a gauge of how stale the last clean-sweep watermark is (an alert
+# on this catches a fleet whose verification plane silently stopped).
+FLEET_REJOINS = "rejoins"
+FLEET_GENERATIONS = "generations"
+FLEET_FSCK_INCREMENTAL_RUNS = "fsck_incremental_runs"
+FLEET_FSCK_OBJECTS_CHECKED = "fsck_objects_checked"
+FLEET_FSCK_WATERMARK_AGE_MS = "fsck_watermark_age_ms"
 
 
 class Counter:
@@ -485,6 +508,12 @@ class MetricRegistry:
         barriers + parallel/distributed.py sharded-ownership writers
         and commit arbitration)."""
         return self.group("multihost", table)
+
+    def fleet_metrics(self, table: str = "") -> MetricGroup:
+        """Self-healing fleet plane (ours; coordinated rejoin in
+        parallel/maintenance_plane.py + incremental fsck/orphan
+        sweeps in maintenance/)."""
+        return self.group("fleet", table)
 
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind

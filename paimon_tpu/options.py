@@ -682,6 +682,16 @@ class CoreOptions:
         "build the failure-detector view.  One snapshot would race "
         "concurrent committers (each stamps the view IT knew); a "
         "small window resolves the interleaving by max()")
+    MULTIHOST_REJOIN_ENABLED = ConfigOption(
+        "multihost.rejoin.enabled", _parse_bool, True,
+        "Whether a restarted host that the ownership map records DEAD "
+        "enters the coordinated rejoin protocol (publish a rejoin "
+        "request, wait for the elected survivor to readmit it into a "
+        "new ownership generation, replay its offset gap up to the "
+        "granted floor, resume).  false restores the PR 11 behavior: "
+        "plane construction refuses the resurrected host with "
+        "OwnershipError and rejoin needs an operator-driven "
+        "whole-cohort restart (docs/multihost.md)")
 
     # -- observability (ours; paimon_tpu/obs/) -------------------------------
     METRICS_ENABLED = ConfigOption(

@@ -67,10 +67,16 @@ from paimon_tpu.parallel import multihost as MH
 from paimon_tpu.snapshot.snapshot import BATCH_COMMIT_IDENTIFIER
 
 __all__ = ["OwnershipMap", "OwnershipError", "DistributedWritePlane",
-           "owner_of", "pinned_scan_plan",
+           "GenerationHistory", "owner_of", "pinned_scan_plan",
            "OWNERSHIP_VERSION_PROP", "OWNERSHIP_PROCESSES_PROP",
            "OWNERSHIP_BUCKETS_PROP", "OWNERSHIP_DEAD_PROP",
-           "LEASE_PROP_PREFIX", "lease_props", "merge_lease_view"]
+           "OWNERSHIP_HISTORY_PROP",
+           "REJOIN_REQUEST_PREFIX", "REJOIN_FLOOR_PREFIX",
+           "LEASE_PROP_PREFIX", "lease_props", "merge_lease_view",
+           "resume_generation_history", "stamp_from_properties",
+           "has_ownership_stamp", "rejoin_request_props",
+           "merge_rejoin_requests", "rejoin_floor_props",
+           "merge_rejoin_floors"]
 
 # snapshot property keys carrying the ownership-map generation: every
 # distributed commit stamps them, so the table's tip records which map
@@ -89,7 +95,24 @@ OWNERSHIP_VERSION_PROP = "multihost.ownership.version"
 OWNERSHIP_PROCESSES_PROP = "multihost.ownership.processes"
 OWNERSHIP_BUCKETS_PROP = "multihost.ownership.buckets"
 OWNERSHIP_DEAD_PROP = "multihost.ownership.dead"
+# the FULL generation chain (version -> processes/buckets/dead-set),
+# compactly encoded (see GenerationHistory): chained takeovers and
+# rejoins need the map that actually GOVERNED a dead peer's writes,
+# which the flat current-generation properties above cannot answer
+OWNERSHIP_HISTORY_PROP = "multihost.ownership.history"
+# rejoin protocol properties: a resurrected host that finds itself in
+# the recorded dead set publishes `...request.p<i> -> wall-clock ms`
+# (its lease renews on the same commit, proving it is actually up);
+# each alive survivor grants `...floor.p<i> -> "<version>:<granter>:
+# <offset>"` once it has flushed everything it ever wrote into the
+# rejoiner's groups, bounding the rejoiner's gap replay
+REJOIN_REQUEST_PREFIX = "multihost.rejoin.request.p"
+REJOIN_FLOOR_PREFIX = "multihost.rejoin.floor.p"
 LEASE_PROP_PREFIX = "multihost.lease.p"
+
+# generations are rare (one per takeover / rejoin / rescale); cap how
+# many the history property carries so the stamp stays O(1) per commit
+_HISTORY_CAP = 64
 
 _ROUTINGS = ("exchange", "spmd", "local-only")
 _ARBITRATIONS = ("cas", "coordinator")
@@ -158,6 +181,19 @@ class OwnershipMap:
         return OwnershipMap(self.version + 1, self.num_processes,
                             self.num_buckets, merged)
 
+    def without_dead(self, returning) -> "OwnershipMap":
+        """The rejoin generation: same topology, `returning` removed
+        from the dead set, version bumped.  Because ownership is the
+        pure crc32 shard, readmitting a host hands it back EXACTLY its
+        old primary groups (a group re-shards only while its primary
+        is dead) — the warm-rejoin property: SSD-tier SSTs/blocks and
+        plan-cache state built for those groups are valid again."""
+        remaining = frozenset(self.dead) - frozenset(returning)
+        if remaining == frozenset(self.dead):
+            return self
+        return OwnershipMap(self.version + 1, self.num_processes,
+                            self.num_buckets, remaining)
+
     def owned_groups(self, process_index: int, partitions=((),)
                      ) -> List[Tuple[Tuple, int]]:
         """Every (partition, bucket) this process owns, for the given
@@ -198,6 +234,119 @@ def _map_from_properties(props: Dict[str, str]) -> OwnershipMap:
         int(props.get(OWNERSHIP_BUCKETS_PROP) or 0), dead)
 
 
+@dataclass(frozen=True)
+class GenerationHistory:
+    """The full ownership-generation chain, ascending by version.
+
+    The flat `multihost.ownership.*` properties record only the
+    CURRENT generation; chained multi-death takeovers and rejoins need
+    the map that actually governed a given peer's writes — before this
+    existed, floor evaluation approximated it with `current dead -
+    {j}`, which is wrong the moment two deaths share one adoption
+    round or a host dies, rejoins and dies again.  The history makes
+    `owner_of` at any retained version EXACT.
+
+    Encoding (`to_property`): entries `version:processes:buckets:
+    dead0+dead1` joined by `|` — e.g. `1:3:4:|2:3:4:2|3:3:4:1+2`.
+    Newest `_HISTORY_CAP` generations retained."""
+
+    entries: Tuple[OwnershipMap, ...]
+
+    @staticmethod
+    def initial(m: OwnershipMap) -> "GenerationHistory":
+        return GenerationHistory((m,))
+
+    def current(self) -> OwnershipMap:
+        return self.entries[-1]
+
+    def at(self, version: int) -> Optional[OwnershipMap]:
+        """The exact map of one historical generation (None when the
+        version predates the retained window)."""
+        for m in reversed(self.entries):
+            if m.version == version:
+                return m
+        return None
+
+    def with_map(self, m: OwnershipMap) -> "GenerationHistory":
+        """Append a new generation (same map/version is a no-op; a
+        version at or below the tip replaces nothing — the caller
+        publishes monotone generations)."""
+        if self.entries and m == self.entries[-1]:
+            return self
+        kept = tuple(e for e in self.entries if e.version < m.version)
+        return GenerationHistory((kept + (m,))[-_HISTORY_CAP:])
+
+    def map_governing(self, j: int) -> Optional[OwnershipMap]:
+        """The map that governed process j's OWN writes: the newest
+        retained generation in which j was alive.  None when j is dead
+        in every retained entry (history truncation) — callers fall
+        back to the legacy `current dead - {j}` approximation."""
+        for m in reversed(self.entries):
+            if j not in m.dead and j < m.num_processes:
+                return m
+        return None
+
+    def to_property(self) -> str:
+        return "|".join(
+            f"{m.version}:{m.num_processes}:{m.num_buckets}:"
+            + "+".join(str(p) for p in sorted(m.dead))
+            for m in self.entries)
+
+    @staticmethod
+    def from_property(raw: str) -> Optional["GenerationHistory"]:
+        entries = []
+        try:
+            for part in raw.split("|"):
+                if not part:
+                    continue
+                v, n, b, dead = part.split(":")
+                entries.append(OwnershipMap(
+                    int(v), int(n), int(b),
+                    frozenset(int(p) for p in dead.split("+") if p)))
+        except ValueError:
+            return None
+        if not entries:
+            return None
+        entries.sort(key=lambda m: m.version)
+        return GenerationHistory(tuple(entries))
+
+    def to_properties(self) -> Dict[str, str]:
+        """The full ownership stamp: the current generation's flat
+        properties plus the encoded chain — what every plane-issued
+        commit carries."""
+        props = self.current().to_properties()
+        props[OWNERSHIP_HISTORY_PROP] = self.to_property()
+        return props
+
+
+def stamp_from_properties(props: Dict[str, str]
+                          ) -> Optional[Tuple[OwnershipMap,
+                                              GenerationHistory]]:
+    """THE sanctioned read path for ownership stamps: (current map,
+    generation history) from one snapshot's properties, or None when
+    the snapshot is unstamped.  A stamp without the history property
+    (legacy chain prefix) yields a single-entry history.  Every module
+    outside this plane must parse stamps through here — the
+    `ownership-history` analysis rule enforces it."""
+    if OWNERSHIP_VERSION_PROP not in (props or {}):
+        return None
+    m = _map_from_properties(props)
+    hist = None
+    raw = props.get(OWNERSHIP_HISTORY_PROP)
+    if raw:
+        hist = GenerationHistory.from_property(raw)
+    if hist is None or hist.current().version < m.version:
+        hist = GenerationHistory.initial(m) if hist is None \
+            else hist.with_map(m)
+    return m, hist
+
+
+def has_ownership_stamp(props: Optional[Dict[str, str]]) -> bool:
+    """Whether a snapshot carries an ownership-generation stamp (the
+    presence test recovery walks use)."""
+    return bool(props) and OWNERSHIP_VERSION_PROP in props
+
+
 def resume_ownership_map(table, max_walk: int = 64
                          ) -> Optional[OwnershipMap]:
     """The ownership map recorded at the table's tip: walk snapshots
@@ -226,6 +375,28 @@ def resume_ownership_map(table, max_walk: int = 64
         props = sm.snapshot(sid).properties or {}
         if OWNERSHIP_VERSION_PROP in props:
             return _map_from_properties(props)
+    return None
+
+
+def resume_generation_history(table, max_walk: int = 64
+                              ) -> Optional[GenerationHistory]:
+    """The generation history recorded at the table's tip: same walk
+    discipline as resume_ownership_map (bounded newest-first, then on
+    to the earliest rather than inventing a generation).  A stamped
+    tip without the history property (chain written before the
+    history existed) yields a single-entry history seeded from the
+    flat map."""
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id()
+    if latest is None:
+        return None
+    earliest = sm.earliest_snapshot_id() or latest
+    for sid in range(latest, earliest - 1, -1):
+        if not sm.snapshot_exists(sid):
+            continue
+        stamp = stamp_from_properties(sm.snapshot(sid).properties or {})
+        if stamp is not None:
+            return stamp[1]
     return None
 
 
@@ -269,6 +440,86 @@ def merge_lease_view(table, max_walk: int = 16) -> Dict[int, int]:
             if ms > view.get(p, -1):
                 view[p] = ms
     return view
+
+
+def rejoin_request_props(process_index: int, now_ms: int
+                         ) -> Dict[str, str]:
+    """The property a refused resurrected host stamps to ask the
+    elected survivor for readmission."""
+    return {f"{REJOIN_REQUEST_PREFIX}{process_index}": str(now_ms)}
+
+
+def merge_rejoin_requests(table, max_walk: int = 32) -> Dict[int, int]:
+    """{process -> newest rejoin-request ms} max-merged over the last
+    `max_walk` snapshots — same window discipline as the lease view.
+    The caller decides liveness: a request is actionable only while
+    the requester's LEASE is also fresh (the request commit renews it),
+    so a host that requested, was readmitted, and died again never
+    re-triggers a grant from its stale request."""
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id()
+    if latest is None:
+        return {}
+    earliest = sm.earliest_snapshot_id() or latest
+    out: Dict[int, int] = {}
+    for sid in range(latest, max(earliest, latest - max_walk) - 1, -1):
+        if not sm.snapshot_exists(sid):
+            continue
+        props = sm.snapshot(sid).properties or {}
+        for k, v in props.items():
+            if not k.startswith(REJOIN_REQUEST_PREFIX):
+                continue
+            try:
+                p, ms = int(k[len(REJOIN_REQUEST_PREFIX):]), int(v)
+            except ValueError:
+                continue
+            if ms > out.get(p, -1):
+                out[p] = ms
+    return out
+
+
+def rejoin_floor_props(granter: int, rejoiner: int, version: int,
+                       offset: int) -> Dict[str, str]:
+    """The coverage floor one survivor grants a rejoiner: 'everything
+    I ever wrote into your groups is committed and ends at `offset`',
+    scoped to the readmission generation `version` so floors from an
+    earlier rejoin of the same process can never be mistaken for this
+    one's."""
+    return {f"{REJOIN_FLOOR_PREFIX}{rejoiner}":
+            f"{version}:{granter}:{offset}"}
+
+
+def merge_rejoin_floors(table, rejoiner: int, version: int,
+                        max_walk: int = 32) -> Dict[int, int]:
+    """{granter -> offset} of every rejoin floor stamped for
+    `rejoiner` at readmission generation `version` OR LATER, folded
+    over the last `max_walk` snapshots (each snapshot is one
+    committer's stamp; the fold collects the cohort's).  Later
+    versions count because a survivor may only notice the readmission
+    after yet another generation bump — its floor is stamped at its
+    then-current offset, still a valid upper bound on what it ever
+    wrote into the rejoiner's groups.  Floors from an EARLIER rejoin
+    epoch of the same process stay excluded."""
+    sm = table.snapshot_manager
+    latest = sm.latest_snapshot_id()
+    if latest is None:
+        return {}
+    earliest = sm.earliest_snapshot_id() or latest
+    key = f"{REJOIN_FLOOR_PREFIX}{rejoiner}"
+    out: Dict[int, int] = {}
+    for sid in range(latest, max(earliest, latest - max_walk) - 1, -1):
+        if not sm.snapshot_exists(sid):
+            continue
+        raw = (sm.snapshot(sid).properties or {}).get(key)
+        if not raw:
+            continue
+        try:
+            v, granter, offset = (int(x) for x in raw.split(":"))
+        except ValueError:
+            continue
+        if v >= version and offset > out.get(granter, -(1 << 62)):
+            out[granter] = offset
+    return out
 
 
 def resume_ownership_version(table, max_walk: int = 64) -> int:
@@ -388,7 +639,9 @@ class DistributedWritePlane:
         self._dynamic_opts = {
             k: v for k, v in table.options.to_map().items()
             if base_opts.get(k) != v}
-        recorded = resume_ownership_map(table)
+        recorded_history = resume_generation_history(table)
+        recorded = recorded_history.current() \
+            if recorded_history is not None else None
         buckets = table.options.bucket
         if recorded is None:
             self.ownership = OwnershipMap(1, self.process_count,
@@ -415,6 +668,9 @@ class DistributedWritePlane:
                 if moved:
                     self._metrics.counter(
                         MULTIHOST_OWNERSHIP_HANDOFFS).inc(moved)
+        self.history = (recorded_history
+                        or GenerationHistory.initial(self.ownership)
+                        ).with_map(self.ownership)
         self._had_conflict = False
         self._closed = False
         # introspection: which new buckets THIS host rewrote in the
@@ -567,7 +823,7 @@ class DistributedWritePlane:
         if self._closed:
             raise RuntimeError("write plane is closed")
         msgs = self._write.prepare_commit()
-        props = self.ownership.to_properties()
+        props = self.history.to_properties()
         if properties:
             props.update(properties)
         self._had_conflict = False
@@ -658,6 +914,7 @@ class DistributedWritePlane:
         old_map = self.ownership
         new_map = OwnershipMap(old_map.version + 1, self.process_count,
                                new_buckets)
+        new_history = self.history.with_map(new_map)
         # an EMPTY drained table has nothing to rewrite —
         # rescale_table_buckets would no-op WITHOUT the schema change
         # and every process would then fail the post-handoff bucket
@@ -719,14 +976,14 @@ class DistributedWritePlane:
                 all_msgs = [m for pl in payloads
                             for m in pickle.loads(pl)]
                 rescale_commit(self.table, new_buckets, all_msgs,
-                               properties=new_map.to_properties())
+                               properties=new_history.to_properties())
         elif self.process_index == self.committer_index:
             from jax.sharding import Mesh
             local = Mesh(np.asarray(jax.local_devices()),
                          ("buckets",))
             sid = self.table.rescale_buckets(
                 new_buckets, mesh=local,
-                properties=new_map.to_properties())
+                properties=new_history.to_properties())
             if sid is not None:
                 self.last_rescale_written_buckets = sorted(
                     range(new_buckets))
@@ -744,6 +1001,7 @@ class DistributedWritePlane:
                 f"rescale handoff: table reports bucket="
                 f"{self.table.options.bucket}, expected {new_buckets}")
         self.ownership = new_map
+        self.history = new_history
         from paimon_tpu.metrics import MULTIHOST_OWNERSHIP_HANDOFFS
         moved = old_map.handoffs_to(self.ownership)
         if moved:
@@ -758,7 +1016,7 @@ class DistributedWritePlane:
             # overwrite branch)
             if self.process_index == self.committer_index:
                 self._commit._commit.commit(
-                    [], properties=self.ownership.to_properties(),
+                    [], properties=self.history.to_properties(),
                     force_create=True)
             MH.barrier("multihost-rescale-stamp")
         return self.table.snapshot_manager.latest_snapshot_id()

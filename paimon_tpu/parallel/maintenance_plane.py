@@ -40,9 +40,20 @@ adoption bumps the ownership version and records the dead set in
 snapshot properties; both ride the adopter's next commit, so a
 survivor restarting mid-takeover resumes the adopted generation.
 A dead host that comes back must NOT silently rejoin — its id stays
-in the dead set and plane construction refuses it (`OwnershipError`);
-rejoin is a new plane generation brought up across the whole cohort
-(see docs/multihost.md for the state machine).
+in the dead set until it is READMITTED through the coordinated rejoin
+protocol: the resurrected host constructs its plane in a `rejoining`
+state (it owns nothing), publishes a rejoin-request property whose
+liveness rides its own lease, and the elected alive host bumps the
+generation with the returner re-sharded back in.  The salted-crc32
+map hands the returner exactly its old primary groups back, so its
+SSD-tier blocks and plan-cache state are warm on re-entry (the
+host-SSD collaborative design of arxiv 2410.21760).  Every
+generation — bring-up, takeover, readmission, rescale — is persisted
+in `multihost.ownership.history`, so `owner_of` at any historical
+version is EXACT and chained multi-death adoptions use the map that
+actually governed each victim's writes (see docs/multihost.md for
+the state machine).  `multihost.rejoin.enabled=false` restores the
+refuse-with-`OwnershipError` behavior.
 
 Everything degrades to single-process: the map owns everything, the
 detector sees no peers, and heartbeats are the only observable
@@ -56,8 +67,9 @@ from typing import Callable, Dict, FrozenSet, Optional, Tuple
 
 from paimon_tpu.options import CoreOptions
 from paimon_tpu.parallel.distributed import (
-    OwnershipError, OwnershipMap, lease_props, merge_lease_view,
-    resume_ownership_map,
+    GenerationHistory, OwnershipError, OwnershipMap, lease_props,
+    merge_lease_view, merge_rejoin_requests, rejoin_request_props,
+    resume_generation_history,
 )
 
 __all__ = ["MaintenancePlane"]
@@ -109,9 +121,11 @@ class MaintenancePlane:
                 f"(bucket={table.options.bucket})")
 
         from paimon_tpu.metrics import (
-            MULTIHOST_LEASE_EXPIRED, MULTIHOST_LEASE_RENEWALS,
-            MULTIHOST_MAINTENANCE_TAKEOVERS, MULTIHOST_OWNED_BUCKETS,
-            global_registry,
+            FLEET_FSCK_INCREMENTAL_RUNS, FLEET_FSCK_OBJECTS_CHECKED,
+            FLEET_FSCK_WATERMARK_AGE_MS, FLEET_GENERATIONS,
+            FLEET_REJOINS, MULTIHOST_LEASE_EXPIRED,
+            MULTIHOST_LEASE_RENEWALS, MULTIHOST_MAINTENANCE_TAKEOVERS,
+            MULTIHOST_OWNED_BUCKETS, global_registry,
         )
         self._metrics = global_registry().multihost_metrics()
         # pre-allocate the maintenance series (PR 10 pattern): a run
@@ -121,20 +135,42 @@ class MaintenancePlane:
                   MULTIHOST_LEASE_RENEWALS, MULTIHOST_LEASE_EXPIRED):
             self._metrics.counter(c)
         self._metrics.gauge(MULTIHOST_OWNED_BUCKETS)
+        # the fleet group rides the same pre-allocation rule: a soak
+        # with zero rejoins must render rejoins 0, and the fsck
+        # series exist even before the first incremental sweep
+        self._fleet = global_registry().fleet_metrics()
+        for c in (FLEET_REJOINS, FLEET_FSCK_INCREMENTAL_RUNS,
+                  FLEET_FSCK_OBJECTS_CHECKED):
+            self._fleet.counter(c)
+        self._fleet.gauge(FLEET_GENERATIONS)
+        self._fleet.gauge(FLEET_FSCK_WATERMARK_AGE_MS)
 
-        recorded = resume_ownership_map(table)
+        # a host the recorded map calls DEAD owns nothing until the
+        # elected survivor readmits it; `rejoining` gates that state
+        self.rejoining = False
+        rejoin_enabled = o.get(CoreOptions.MULTIHOST_REJOIN_ENABLED)
+        recorded_history = resume_generation_history(table)
+        recorded = (recorded_history.current()
+                    if recorded_history is not None else None)
         buckets = table.options.bucket
         if recorded is None:
             self.ownership = OwnershipMap(1, self.process_count, buckets)
         elif (recorded.num_processes, recorded.num_buckets) == \
                 (self.process_count, buckets):
             if self.process_index in recorded.dead:
-                raise OwnershipError(
-                    f"process {self.process_index} is recorded DEAD in "
-                    f"ownership generation {recorded.version}; its "
-                    f"buckets were adopted by survivors.  Rejoin is a "
-                    f"coordinated new plane generation across the whole "
-                    f"cohort, not a silent restart (docs/multihost.md)")
+                if not rejoin_enabled:
+                    raise OwnershipError(
+                        f"process {self.process_index} is recorded "
+                        f"DEAD in ownership generation "
+                        f"{recorded.version}; its buckets were adopted "
+                        f"by survivors and multihost.rejoin.enabled is "
+                        f"false.  Rejoin is a coordinated new plane "
+                        f"generation across the whole cohort, not a "
+                        f"silent restart (docs/multihost.md)")
+                # coordinated rejoin: keep the recorded generation
+                # (self still dead, owning nothing) and wait to be
+                # readmitted — request_rejoin() publishes the ask
+                self.rejoining = True
             # survivors keep the recorded generation — INCLUDING its
             # dead set; the dead host is still dead across restarts
             self.ownership = recorded
@@ -143,6 +179,9 @@ class MaintenancePlane:
             # ownership function needs a new version
             self.ownership = OwnershipMap(recorded.version + 1,
                                           self.process_count, buckets)
+        self.history = (recorded_history
+                        or GenerationHistory.initial(self.ownership)
+                        ).with_map(self.ownership)
         self._start_ms = self._clock()
         # last-known lease view, max-merged from the store at refresh
         # points + own in-memory renewals (never regress own entry)
@@ -154,6 +193,7 @@ class MaintenancePlane:
         self._declared: set = set(self.ownership.dead)
         self._commit = None
         self._update_owned_gauge()
+        self._update_generation_gauge()
 
     # -- wiring --------------------------------------------------------------
 
@@ -177,7 +217,7 @@ class MaintenancePlane:
         fsck would (rightly) flag.  Cheap in the common case: the tip
         itself is stamped, so the walk is one snapshot deep."""
         self.refresh_ownership()
-        props = self.ownership.to_properties()
+        props = self.history.to_properties()
         props.update(lease_props(self.process_index, self._clock(),
                                  self._view))
         return props
@@ -219,6 +259,10 @@ class MaintenancePlane:
                     == self.process_index)
         self._metrics.gauge(MULTIHOST_OWNED_BUCKETS).set(owned)
 
+    def _update_generation_gauge(self):
+        from paimon_tpu.metrics import FLEET_GENERATIONS
+        self._fleet.gauge(FLEET_GENERATIONS).set(self.ownership.version)
+
     # -- leases + failure detection ------------------------------------------
 
     def refresh_view(self) -> Dict[int, int]:
@@ -232,18 +276,31 @@ class MaintenancePlane:
 
     def refresh_ownership(self) -> bool:
         """Adopt a HIGHER ownership generation recorded in the store
-        (another survivor completed a takeover first, or the write
-        plane rescaled).  Returns True when the map changed.  Versions
-        only ever move forward — the fsck ownership check relies on
-        chain monotonicity."""
-        recorded = resume_ownership_map(self.table)
+        (another survivor completed a takeover first, readmitted a
+        rejoiner, or the write plane rescaled).  Returns True when the
+        map changed.  Versions only ever move forward — the fsck
+        ownership check relies on chain monotonicity."""
+        recorded_history = resume_generation_history(self.table)
+        recorded = (recorded_history.current()
+                    if recorded_history is not None else None)
         if recorded is None or recorded.version <= self.ownership.version:
             return False
         if (recorded.num_processes, recorded.num_buckets) != \
                 (self.process_count, self.ownership.num_buckets):
             return False          # foreign topology: not ours to adopt
         self.ownership = recorded
+        self.history = recorded_history
+        # a peer the new generation readmitted is declarable AGAIN if
+        # it dies again — forget the old declaration
+        self._declared = {p for p in self._declared
+                          if p in recorded.dead}
+        if self.rejoining and self.process_index not in recorded.dead:
+            # the elected survivor readmitted us: we own our groups
+            # again (the caller still replays its offset gap before
+            # forward work — service/stream_daemon.py)
+            self.rejoining = False
         self._update_owned_gauge()
+        self._update_generation_gauge()
         return True
 
     def lease_age_ms(self, process: int,
@@ -297,9 +354,11 @@ class MaintenancePlane:
         before = self.ownership
         self.ownership = before.with_dead(dead)
         if self.ownership is not before:
+            self.history = self.history.with_map(self.ownership)
             self._metrics.counter(
                 MULTIHOST_MAINTENANCE_TAKEOVERS).inc()
             self._update_owned_gauge()
+            self._update_generation_gauge()
 
     def detect_and_take_over(self, now_ms: Optional[int] = None,
                              refresh: bool = True) -> FrozenSet[int]:
@@ -313,6 +372,69 @@ class MaintenancePlane:
         if newly and self.takeover_enabled:
             self.adopt(newly)
         return newly
+
+    # -- coordinated rejoin --------------------------------------------------
+
+    def request_rejoin(self) -> Optional[int]:
+        """Publish (or refresh) this dead-recorded host's rejoin
+        request: a forced empty snapshot stamping
+        `multihost.rejoin.request.p<i>` PLUS the usual lease renewal,
+        so the request's liveness rides the requester's own lease —
+        a rejoiner that dies again goes stale with its lease and is
+        never readmitted from a stale ask.  Returns the snapshot id,
+        or None when this plane is not in the rejoining state."""
+        if not self.rejoining:
+            return None
+        props = rejoin_request_props(self.process_index, self._clock())
+        sid = self._file_store_commit().commit(
+            [], properties=props, force_create=True)
+        self.note_renewal()
+        return sid
+
+    def pending_rejoin_requests(self) -> FrozenSet[int]:
+        """Dead-recorded peers asking to rejoin whose lease is FRESH
+        (their request commit renews it, so a live rejoiner keeps its
+        ask actionable and a re-dead one ages out).  Detector input is
+        pure store state, like death: every survivor computes the
+        same set."""
+        if self.process_count <= 1 or not self.ownership.dead:
+            return frozenset()
+        self.refresh_view()
+        reqs = merge_rejoin_requests(self.table, self.lease_walk)
+        now = self._clock()
+        return frozenset(
+            p for p in reqs
+            if p != self.process_index
+            and p in self.ownership.dead
+            and self.lease_age_ms(p, now) <= self.lease_timeout_ms)
+
+    def owns_rejoin_grant(self) -> bool:
+        """Readmission is table-global like expiry: the lowest-ranked
+        ALIVE process grants it, so the granter role itself fails
+        over deterministically."""
+        return self.owns_expiry()
+
+    def readmit(self, returning) -> FrozenSet[int]:
+        """Bump the in-memory generation with `returning` back ALIVE
+        (the granter side of rejoin).  The salted-crc32 map hands the
+        returner exactly its old primary groups back — warm SSD-tier
+        state by construction.  Returns the set actually readmitted
+        (exactly-once: a peer not currently dead is a no-op, so a
+        granter retrying after a CAS loss cannot double-count).  As
+        with `adopt`, the new generation is volatile until the caller
+        publishes it on a stamped commit — the stream daemon rides it
+        on the same forced commit as its rejoin floor."""
+        from paimon_tpu.metrics import FLEET_REJOINS
+        returning = frozenset(returning) & frozenset(self.ownership.dead)
+        if not returning:
+            return frozenset()
+        self.ownership = self.ownership.without_dead(returning)
+        self.history = self.history.with_map(self.ownership)
+        self._declared -= set(returning)
+        self._fleet.counter(FLEET_REJOINS).inc(len(returning))
+        self._update_owned_gauge()
+        self._update_generation_gauge()
+        return returning
 
     # -- heartbeats ----------------------------------------------------------
 

@@ -38,12 +38,45 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def peer_death_tolerance(max_missing_heartbeats: Optional[int] = None
+                         ) -> dict:
+    """Heartbeat-tolerance kwargs for the distributed runtime client
+    AND the coordination service, from the explicit argument or the
+    `PAIMON_MULTIHOST_PEER_MISSED_HEARTBEATS` env var.  Empty dict
+    when neither is set (jax defaults apply: ~10 missed heartbeats at
+    10s intervals, after which the coordination service declares the
+    quiet task crashed and FATALLY tears down every other task).
+
+    That default contradicts this repo's fleet design: host death is
+    an EXPECTED event the lease detector (parallel/maintenance_plane)
+    observes and survives — survivors adopt the dead host's groups
+    and keep serving.  A mesh that opts in here keeps the survivors'
+    processes alive through a peer's death long enough for leases to
+    govern, instead of having XLA abort them ~100s in."""
+    if max_missing_heartbeats is None:
+        env = os.environ.get("PAIMON_MULTIHOST_PEER_MISSED_HEARTBEATS")
+        if env:
+            max_missing_heartbeats = int(env)
+    if max_missing_heartbeats is None:
+        return {}
+    return {"service_max_missing_heartbeats": max_missing_heartbeats,
+            "client_max_missing_heartbeats": max_missing_heartbeats}
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> Tuple[int, int]:
+               process_id: Optional[int] = None,
+               max_missing_heartbeats: Optional[int] = None
+               ) -> Tuple[int, int]:
     """Bring up jax's distributed runtime (multi-host). Arguments
     default from the standard env vars; single-process is a no-op.
-    Returns (process_index, process_count)."""
+    Returns (process_index, process_count).
+
+    `max_missing_heartbeats` (or the
+    `PAIMON_MULTIHOST_PEER_MISSED_HEARTBEATS` env var) widens how many
+    10s heartbeats a peer may miss before the coordination service
+    declares it crashed and aborts the WHOLE mesh — see
+    `peer_death_tolerance` for why lease-governed fleets want this."""
     import jax
 
     coordinator_address = coordinator_address or \
@@ -85,6 +118,43 @@ def initialize(coordinator_address: Optional[str] = None,
                 RuntimeWarning, stacklevel=2)
             global_registry().multihost_metrics().counter(
                 MULTIHOST_CONFIG_WARNINGS).inc()
+        tolerance = peer_death_tolerance(max_missing_heartbeats)
+        if tolerance:
+            # the public wrapper does not forward heartbeat knobs
+            # (jax 0.4.x); mirror its one precondition and call the
+            # runtime state directly.  A jax build whose internals
+            # moved falls back to the default (intolerant) bring-up —
+            # NOT silent, same warning+metric contract as the gloo
+            # opt-in above: the mesh still comes up, but survivors
+            # will be aborted ~100s after a peer dies
+            try:
+                from jax._src import distributed as _dist
+                from jax._src import xla_bridge as _bridge
+                if _bridge.backends_are_initialized():
+                    raise RuntimeError(
+                        "multihost.initialize must run before any JAX "
+                        "computation")
+                _dist.global_state.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    **tolerance)
+                return jax.process_index(), jax.process_count()
+            except (ImportError, AttributeError, TypeError) as e:
+                import warnings
+
+                from paimon_tpu.metrics import (
+                    MULTIHOST_CONFIG_WARNINGS, global_registry,
+                )
+                warnings.warn(
+                    "multihost.initialize: this jax build does not "
+                    f"expose coordination heartbeat tolerance ({e!r});"
+                    " peers that outlive a dead host past the default "
+                    "~100s window will be aborted by the coordination "
+                    "service despite holding valid leases",
+                    RuntimeWarning, stacklevel=2)
+                global_registry().multihost_metrics().counter(
+                    MULTIHOST_CONFIG_WARNINGS).inc()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

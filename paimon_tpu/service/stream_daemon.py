@@ -186,7 +186,7 @@ def recover_plane_stamps(table: FileStoreTable, commit_user: str):
     (`stream.adopted`) exactly when THIS daemon's backfill commit for
     it landed — the global ownership dead set is deliberately not
     consulted, see PROP_ADOPTED."""
-    from paimon_tpu.parallel.distributed import OWNERSHIP_VERSION_PROP
+    from paimon_tpu.parallel.distributed import has_ownership_stamp
     sm = table.snapshot_manager
     latest = sm.latest_snapshot_id()
     earliest = sm.earliest_snapshot_id()
@@ -200,7 +200,7 @@ def recover_plane_stamps(table: FileStoreTable, commit_user: str):
         if snap.commit_user != commit_user:
             continue
         props = snap.properties or {}
-        if OWNERSHIP_VERSION_PROP not in props:
+        if not has_ownership_stamp(props):
             continue
         adopted = frozenset(
             int(p) for p in (props.get(PROP_ADOPTED) or "").split(",")
@@ -411,6 +411,9 @@ class StreamDaemon:
         self._ingest_dead: frozenset = frozenset()
         self._floors: Dict[int, int] = {}          # dead pid -> offset
         self._pending_adoptions: List[int] = []    # detector -> ingest
+        self._pending_rejoins: List[int] = []      # grant queue (elected)
+        self._pending_rejoin_acks: List[int] = []  # floor-stamp queue
+        self._rejoin_replayed = 0                  # rows gap-replayed
         self._serve_adoptions: List[int] = []      # ingest -> serve
         self._serve_dead: frozenset = frozenset()
         if plane is not None:
@@ -531,6 +534,8 @@ class StreamDaemon:
                 "dead": sorted(self.plane.ownership.dead),
                 "adopted": sorted(self._ingest_dead),
                 "floors": dict(self._floors),
+                "rejoining": self.plane.rejoining,
+                "rejoin_replayed": self._rejoin_replayed,
             }
         return out
 
@@ -732,11 +737,24 @@ class StreamDaemon:
 
     def _was_owned_by(self, j: int, part, bucket) -> bool:
         """Did (part, bucket) belong to dead peer `j` immediately
-        before its takeover?  Evaluated against the adopted map minus
-        j — deterministic from properties alone, so floors survive
-        restarts."""
+        before its takeover?  EXACT: evaluated against the newest
+        persisted generation in which j was alive
+        (`GenerationHistory.map_governing` — the map that actually
+        governed j's writes), so chained multi-death floors stay
+        correct: with two peers dead, `current dead − {j}` would
+        re-shard the OTHER victim's groups differently from any map j
+        ever wrote under and mis-scope the floor.  Deterministic from
+        persisted properties alone, so floors survive restarts.
+        Falls back to the adopted-map-minus-j approximation only when
+        the history was truncated past j (64-generation cap) or the
+        topology changed since."""
         from paimon_tpu.parallel.distributed import OwnershipMap
         m = self._forward_map()
+        governing = self.plane.history.map_governing(j)
+        if governing is not None and \
+                (governing.num_processes, governing.num_buckets) == \
+                (m.num_processes, m.num_buckets):
+            return governing.owner_of(part, bucket) == j
         prev = OwnershipMap(m.version, m.num_processes, m.num_buckets,
                             frozenset(m.dead) - {j})
         return prev.owner_of(part, bucket) == j
@@ -840,6 +858,248 @@ class StreamDaemon:
         # from the dead peer's persisted consumer position first)
         self._serve_adoptions.append(j)
 
+    # -- coordinated rejoin (plane mode) -------------------------------------
+
+    def _queue_rejoin_work(self) -> None:
+        """Detector-cadence rejoin bookkeeping (compact loop): queue
+        floor-stamp acks for peers some granter readmitted while MY
+        ledger still holds them, and — on the elected granter — queue
+        readmission grants for dead peers with a fresh rejoin
+        request, but only once EVERY alive host's durable ledger
+        covers them.  That ledger gate is the global drain of
+        in-flight adoptions: readmitting earlier would strand a
+        survivor's unpublished share of the victim's groups in a
+        generation that no longer re-shards them to it."""
+        back = frozenset(self._ingest_dead) - \
+            frozenset(self.plane.ownership.dead)
+        for j in sorted(back):
+            if j not in self._pending_rejoin_acks:
+                self._pending_rejoin_acks.append(j)
+        if not self.plane.owns_rejoin_grant():
+            return
+        asking = self.plane.pending_rejoin_requests() - \
+            frozenset(self._pending_rejoins)
+        if not asking:
+            return
+        alive = [p for p in range(self.plane.process_count)
+                 if p not in self.plane.ownership.dead]
+        ledgers = {q: recover_plane_stamps(
+            self.table, f"{self._user_base}-p{q}")[0] for q in alive}
+        for j in sorted(asking):
+            if all(j in ledgers[q] for q in alive):
+                self._pending_rejoins.append(j)
+
+    def _release_rejoined(self, returned) -> None:
+        """Forget adopted state for peers that are alive again: their
+        groups are theirs, my floors for them can only mis-suppress
+        (the governing map is their NEW generation), and the serve
+        loop must stop shipping their changelog."""
+        self._ingest_dead = frozenset(self._ingest_dead) - returned
+        for j in returned:
+            self._floors.pop(j, None)
+        self._serve_dead = frozenset(self._serve_dead) - returned
+        self._serve_adoptions[:] = [j for j in self._serve_adoptions
+                                    if j not in returned]
+
+    def _ack_rejoins(self) -> None:
+        """A granter readmitted peers MY durable ledger still holds:
+        stop writing their groups and stamp MY rejoin floor —
+        'everything I ever wrote into your groups is committed and
+        ends here'.  ONE forced commit carries the floor together
+        with my pending forward rows, so the floor is never published
+        without the rows it bounds; the rejoiner's gap replay (up to
+        the max granted floor) then supersedes my copies in offset
+        order."""
+        from paimon_tpu.parallel.distributed import rejoin_floor_props
+        with self._commit_lock:
+            back = frozenset(self._pending_rejoin_acks) - \
+                frozenset(self.plane.ownership.dead)
+            self._pending_rejoin_acks.clear()
+            back = back & frozenset(self._ingest_dead)
+            if not back:
+                return
+            props = {}
+            advanced = self._offset_pending > self._offset
+            if advanced:
+                props[PROP_OFFSET] = str(self._offset_pending)
+                props[PROP_INGEST_TS] = str(
+                    self._batch_first_pull_ms or _now_ms())
+            for j in sorted(back):
+                props.update(rejoin_floor_props(
+                    self.plane.process_index, j,
+                    self.plane.ownership.version,
+                    self._offset_pending))
+            self._release_rejoined(back)
+            ckpt = self._next_ckpt
+            self._sink.commit(ckpt, properties=props,
+                              force_create=True)
+            self._next_ckpt = ckpt + 1
+            if advanced:
+                self._offset = self._offset_pending
+                self._batch_first_pull_ms = None
+            self.plane.note_renewal()
+
+    def _grant_rejoins(self) -> None:
+        """The elected granter readmits every queued requester in ONE
+        generation bump: one forced commit publishes the new map
+        (requesters back ALIVE — the salted-crc32 shard hands each
+        exactly its old primary groups, warm), the full generation
+        history, MY rejoin floor for each, and my pending forward
+        rows.  `fleet.rejoins` counts inside `readmit`, on the
+        granter — two victims rejoining render rejoins 2."""
+        from paimon_tpu.obs.trace import span
+        from paimon_tpu.parallel.distributed import rejoin_floor_props
+        returning = list(self._pending_rejoins)
+        self._pending_rejoins.clear()
+        with span("stream.rejoin.grant", cat="stream",
+                  peers=returning):
+            with self._commit_lock:
+                granted = self.plane.readmit(returning)
+                if not granted:
+                    return
+                props = {}
+                advanced = self._offset_pending > self._offset
+                if advanced:
+                    props[PROP_OFFSET] = str(self._offset_pending)
+                    props[PROP_INGEST_TS] = str(
+                        self._batch_first_pull_ms or _now_ms())
+                for j in sorted(granted):
+                    props.update(rejoin_floor_props(
+                        self.plane.process_index, j,
+                        self.plane.ownership.version,
+                        self._offset_pending))
+                self._release_rejoined(granted)
+                ckpt = self._next_ckpt
+                self._sink.commit(ckpt, properties=props,
+                                  force_create=True)
+                self._next_ckpt = ckpt + 1
+                if advanced:
+                    self._offset = self._offset_pending
+                    self._batch_first_pull_ms = None
+                self.plane.note_renewal()
+
+    def _rejoin(self) -> bool:
+        """Blocking rejoin phase of a resurrected host (the ingest
+        loop enters here when the plane constructed in the
+        `rejoining` state):
+
+          1. publish/refresh the rejoin request at lease cadence
+             until the elected survivor readmits us;
+          2. wait for a rejoin floor from every peer that was alive
+             in the generation right before readmission — each floor
+             bounds that peer's writes into our groups.  Peers
+             readmitted WITH us never wrote past the survivors'
+             floors (their adopted shares cascaded to the survivors
+             when they died), and a peer that dies while we wait is
+             dropped from the wait — its committed writes re-ingest
+             idempotently past our replay;
+          3. replay the offset gap (own committed, max floor] for the
+             groups we own under the new map, in offset order, as ONE
+             forced commit stamping offset=floor, then resume forward
+             ingest past it.
+
+        Returns False when killed/stopped mid-phase.  Crash-safe: a
+        restart after readmission but before the replay commit finds
+        `rejoining` already False and falls back to plain forward
+        ingest from its committed offset, which re-writes the same
+        gap rows (upsert-idempotent) under normal checkpoints."""
+        from paimon_tpu.obs.trace import span
+        from paimon_tpu.parallel.distributed import merge_rejoin_floors
+
+        o = self._o
+        plane = self.plane
+        published = False
+        while plane.rejoining:
+            if self._killed or self._stop.is_set():
+                return False
+            if not published or plane.heartbeat_due():
+                with self._commit_lock:
+                    plane.request_rejoin()
+                published = True
+            plane.refresh_view()
+            plane.refresh_ownership()  # clears rejoining on readmit
+            if plane.rejoining:
+                self._stop.wait(o["ingest_poll_ms"] / 1000.0)
+        version = plane.ownership.version
+        # peers readmitted alongside us (or us alone) were DEAD in the
+        # generation the grant superseded; everyone else alive there
+        # may have written into our groups and owes us a floor
+        prev = plane.history.at(version - 1)
+        if prev is not None and \
+                prev.num_processes == plane.process_count:
+            need = set(prev.alive())
+        else:
+            need = set(p for p in range(plane.process_count)
+                       if p not in plane.ownership.dead) \
+                - {plane.process_index}
+        # our pre-death adoption ledger may hold peers that were
+        # readmitted while we were down — they replayed their own
+        # gaps; holding their floors would only mis-suppress
+        self._release_rejoined(frozenset(self._ingest_dead) -
+                               frozenset(plane.ownership.dead))
+        # and adoptions queued during recovery for peers readmitted
+        # meanwhile are stale — adopting an alive peer is nonsense
+        self._pending_adoptions[:] = [
+            j for j in self._pending_adoptions
+            if j in plane.ownership.dead]
+        table = self._sink.table
+        floors: Dict[int, int] = {}
+        while True:
+            if self._killed or self._stop.is_set():
+                return False
+            floors.update(merge_rejoin_floors(
+                table, plane.process_index, version, max_walk=128))
+            plane.refresh_view()
+            plane.refresh_ownership()
+            # a peer that dies before stamping its floor would block
+            # us forever: drop it — its committed writes into our
+            # groups re-ingest idempotently past the replay
+            need -= set(plane.ownership.dead)
+            if need <= set(floors):
+                break
+            with self._commit_lock:
+                plane.maybe_heartbeat()   # stay alive while waiting
+            self._stop.wait(o["ingest_poll_ms"] / 1000.0)
+        floor = max(floors.values(), default=self._offset)
+        replayed = 0
+        with span("stream.rejoin.replay", cat="stream",
+                  committed=self._offset, floor=floor):
+            with self._commit_lock:
+                if floor > self._offset:
+                    cursor = self._offset
+                    while cursor < floor:
+                        polled = self.source.poll(cursor, 1 << 16)
+                        if not polled:
+                            break
+                        window = [ev for off, ev in polled
+                                  if off <= floor]
+                        fm = self._forward_map()
+                        batch = []
+                        for (off, ev), g in zip(
+                                polled[:len(window)],
+                                self._event_groups(window)):
+                            if g is not None and \
+                                    self._owns_forward_group(off, g,
+                                                             fm):
+                                batch.append(ev)
+                        if batch:
+                            self._sink.write_events(batch)
+                            replayed += len(batch)
+                        cursor = polled[-1][0]
+                        if len(window) < len(polled):
+                            break     # crossed the floor inside slice
+                    props = {PROP_OFFSET: str(floor),
+                             PROP_INGEST_TS: str(_now_ms())}
+                    ckpt = self._next_ckpt
+                    self._sink.commit(ckpt, properties=props,
+                                      force_create=True)
+                    self._next_ckpt = ckpt + 1
+                    self._offset = floor
+                    self._offset_pending = floor
+                    plane.note_renewal()
+        self._rejoin_replayed += replayed
+        return True
+
     def _plane_props(self) -> Dict[str, str]:
         """Lease + ownership + floor + adoption-ledger stamps for one
         plane-issued commit (checkpoints, compactions, heartbeats,
@@ -866,6 +1126,9 @@ class StreamDaemon:
             return
         behind = frozenset(newly) | \
             (frozenset(self.plane.ownership.dead) - self._ingest_dead)
+        # never self: a rejoining host recovering against a map that
+        # still records IT dead must not queue its own adoption
+        behind -= {self.plane.process_index}
         for j in sorted(behind):
             if j not in self._pending_adoptions and \
                     j not in self._ingest_dead:
@@ -878,6 +1141,11 @@ class StreamDaemon:
         from paimon_tpu.obs.trace import span
 
         self._ingest_recover()
+        if self.plane is not None and self.plane.rejoining:
+            # resurrected host: blocking rejoin phase (request ->
+            # readmission -> gap replay) before any forward ingest
+            if not self._rejoin():
+                return
         o = self._o
         last_ckpt_at = time.monotonic()
         while True:
@@ -889,6 +1157,14 @@ class StreamDaemon:
                 # backfill would end up with a LOWER sequence number
                 # than the backfilled (older) row and lose the merge
                 self._adopt(self._pending_adoptions.pop(0))
+                continue
+            if self.plane is not None and self._pending_rejoin_acks:
+                self._ack_rejoins()
+                continue
+            if self.plane is not None and self._pending_rejoins:
+                # grants run only with the adoption queue drained:
+                # readmission must never race my own pending backfill
+                self._grant_rejoins()
                 continue
             stopping = self._stop.is_set()
             events = [] if stopping else self.source.poll(
@@ -1071,6 +1347,11 @@ class StreamDaemon:
                 # ownership bump, so the detector never adopts
                 # directly here
                 self._reconcile_adoptions(self.plane.detect_expired())
+                # rejoin bookkeeping rides the same detector cadence:
+                # queue grants (elected) and floor-stamp acks for the
+                # ingest loop — like adoption, the generation change
+                # must publish atomically with the rows it bounds
+                self._queue_rejoin_work()
                 # idle hosts still renew their lease
                 with self._commit_lock:
                     self.plane.maybe_heartbeat()

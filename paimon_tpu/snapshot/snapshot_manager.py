@@ -31,6 +31,11 @@ __all__ = ["SnapshotManager"]
 SNAPSHOT_PREFIX = "snapshot-"
 EARLIEST = "EARLIEST"
 LATEST = "LATEST"
+# JSON list of snapshot ids FOLDED out of the middle of the chain by
+# expire_snapshots' heartbeat-folding pass (maintenance/expire.py):
+# readers treat these ids as legitimately absent (fsck excuses them
+# from snapshot-gap), and the list self-prunes below EARLIEST
+FOLDED = "FOLDED"
 
 
 class SnapshotManager:
@@ -155,7 +160,8 @@ class SnapshotManager:
     def earlier_or_equal_time_mills(self,
                                     time_millis: int) -> Optional[Snapshot]:
         """Latest snapshot with timeMillis <= given (reference
-        SnapshotManager.earlierOrEqualTimeMills); binary search over ids."""
+        SnapshotManager.earlierOrEqualTimeMills); binary search over
+        ids, probing downward past folded-heartbeat holes."""
         lo = self.earliest_snapshot_id()
         hi = self.latest_snapshot_id()
         if lo is None or hi is None:
@@ -163,13 +169,50 @@ class SnapshotManager:
         best = None
         while lo <= hi:
             mid = (lo + hi) // 2
-            s = self.snapshot(mid)
+            probe = mid
+            while probe >= lo and not self.snapshot_exists(probe):
+                probe -= 1          # folded hole: nearest older id
+            if probe < lo:
+                lo = mid + 1
+                continue
+            s = self.snapshot(probe)
             if s.time_millis <= time_millis:
                 best = s
                 lo = mid + 1
             else:
-                hi = mid - 1
+                hi = probe - 1
         return best
+
+    # -- folded-heartbeat bookkeeping ----------------------------------------
+
+    def folded_ids(self) -> set:
+        """Ids deliberately removed from the middle of the chain by
+        the heartbeat-folding pass; missing/corrupt file = empty."""
+        path = f"{self.snapshot_dir}/{FOLDED}"
+        try:
+            if not self.file_io.exists(path):
+                return set()
+            import json
+            raw = json.loads(self.file_io.read_utf8(path))
+            return {int(i) for i in raw}
+        except (OSError, ValueError, TypeError):
+            return set()
+
+    def record_folded(self, ids) -> None:
+        """Durably record ids about to be folded — written BEFORE the
+        snapshot files are deleted, so a crash between the two leaves
+        ids that are folded-but-present (harmless: the excuse only
+        matters for ids that are actually missing).  Self-prunes
+        entries below the earliest retained snapshot, whose absence
+        needs no excuse."""
+        merged = self.folded_ids() | {int(i) for i in ids}
+        earliest = self.earliest_snapshot_id()
+        if earliest is not None:
+            merged = {i for i in merged if i >= earliest}
+        import json
+        self.file_io.write_utf8(f"{self.snapshot_dir}/{FOLDED}",
+                                json.dumps(sorted(merged)),
+                                overwrite=True)
 
     # -- writes --------------------------------------------------------------
 

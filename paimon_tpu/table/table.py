@@ -333,19 +333,27 @@ class FileStoreTable:
 
     def remove_orphan_files(self, older_than_ms: Optional[int] = None,
                             dry_run: bool = False,
-                            now_ms: Optional[int] = None):
-        """reference operation/OrphanFilesClean.java."""
+                            now_ms: Optional[int] = None,
+                            incremental: bool = False):
+        """reference operation/OrphanFilesClean.java; `incremental`
+        rides the last clean sweep's watermark (maintenance/orphan.py)."""
         from paimon_tpu.maintenance import remove_orphan_files
         return remove_orphan_files(self, older_than_ms=older_than_ms,
-                                   dry_run=dry_run, now_ms=now_ms)
+                                   dry_run=dry_run, now_ms=now_ms,
+                                   incremental=incremental)
 
     def fsck(self, snapshot_id: Optional[int] = None,
-             all_snapshots: bool = True, deep: bool = False):
+             all_snapshots: bool = True, deep: bool = False,
+             incremental: bool = False, stamp_watermark: bool = False):
         """Verify the snapshot→manifest→file graph; returns an
-        FsckReport of typed violations (maintenance/fsck.py)."""
+        FsckReport of typed violations (maintenance/fsck.py).
+        `incremental` verifies only the delta since the last clean
+        sweep's watermark; `stamp_watermark` records a clean run."""
         from paimon_tpu.maintenance import fsck
         return fsck(self, snapshot_id=snapshot_id,
-                    all_snapshots=all_snapshots, deep=deep)
+                    all_snapshots=all_snapshots, deep=deep,
+                    incremental=incremental,
+                    stamp_watermark=stamp_watermark)
 
     def expire_partitions(self, expiration_ms: Optional[int] = None,
                           now_ms: Optional[int] = None,

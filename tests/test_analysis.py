@@ -359,6 +359,73 @@ def test_fault_taxonomy_skip_loop_and_ladder_are_clean(tmp_path):
     assert rep.unsuppressed_by_rule("fault-taxonomy") == []
 
 
+# -- ownership-history -------------------------------------------------------
+
+def test_ownership_history_raw_prop_literal(tmp_path):
+    """Hand-parsing an ownership-stamp property outside
+    parallel/distributed.py is the fork the rule exists to catch."""
+    rep = lint(tmp_path, {"service/daemon.py": """
+        def resume(props):
+            if "multihost.ownership.version" in props:
+                return int(props["multihost.ownership.version"])
+            return None
+
+        def floors(props, pid):
+            return props.get("multihost.rejoin.floor.p" + str(pid))
+    """}, ["ownership-history"])
+    found = rep.unsuppressed_by_rule("ownership-history")
+    assert len(found) == 3, found
+    assert all("stamp_from_properties" in f.message for f in found)
+
+
+def test_ownership_history_forked_constant_import(tmp_path):
+    """Importing the raw property-name constants is the same fork one
+    step removed."""
+    rep = lint(tmp_path, {
+        "parallel/distributed.py": """
+            OWNERSHIP_VERSION_PROP = "multihost.ownership.version"
+
+            def stamp_from_properties(props):
+                return props.get(OWNERSHIP_VERSION_PROP)
+        """,
+        "maintenance/sweep.py": """
+            from fixturepkg.parallel.distributed import (
+                OWNERSHIP_VERSION_PROP,
+            )
+
+            def check(props):
+                return OWNERSHIP_VERSION_PROP in props
+        """,
+    }, ["ownership-history"])
+    found = rep.unsuppressed_by_rule("ownership-history")
+    assert len(found) == 1, found
+    assert "OWNERSHIP_VERSION_PROP" in found[0].message
+    assert found[0].file.endswith("maintenance/sweep.py")
+
+
+def test_ownership_history_docstrings_and_owner_are_clean(tmp_path):
+    """Prose may NAME the properties (docstrings exempt), the encoding
+    owner may define them, and the sanctioned API is free to use."""
+    rep = lint(tmp_path, {
+        "parallel/distributed.py": """
+            OWNERSHIP_VERSION_PROP = "multihost.ownership.version"
+            REJOIN_FLOOR_PREFIX = "multihost.rejoin.floor.p"
+        """,
+        "service/daemon.py": '''
+            """Replays the gap below the granted
+            multihost.rejoin.floor.p<i> floor before resuming."""
+
+            def resume(table, props):
+                """Anchored at multihost.ownership.history."""
+                from fixturepkg.parallel.distributed import (
+                    stamp_from_properties,
+                )
+                return stamp_from_properties(props)
+        ''',
+    }, ["ownership-history"])
+    assert rep.unsuppressed_by_rule("ownership-history") == []
+
+
 # -- migrated hygiene rules (fixture spot checks) ----------------------------
 
 def test_hygiene_rules_on_fixtures(tmp_path):
@@ -508,14 +575,15 @@ def test_cli_list_rules(capsys):
                 "collectives", "distributed-init",
                 "host-materialization", "metric-drift",
                 "options-drift", "lock-order", "loop-blocking",
-                "deadline-wait", "fault-taxonomy"):
+                "deadline-wait", "fault-taxonomy",
+                "ownership-history"):
         assert rid in out, f"rule {rid} missing from catalog"
 
 
 # -- the production tree -----------------------------------------------------
 
 def test_production_tree_zero_unsuppressed_findings(lint_report):
-    """THE acceptance gate: the full 13-rule catalog over paimon_tpu/
+    """THE acceptance gate: the full 14-rule catalog over paimon_tpu/
     reports zero unsuppressed findings — every new finding is either a
     bug to fix or a deliberate pattern that needs a reviewed,
     reasoned `# lint-ok:` marker at the site."""
@@ -530,8 +598,9 @@ def test_production_rule_catalog_is_complete(lint_report):
                    "collectives", "distributed-init",
                    "host-materialization", "metric-drift",
                    "options-drift", "lock-order", "loop-blocking",
-                   "deadline-wait", "fault-taxonomy"}
-    assert len(ids) >= 13
+                   "deadline-wait", "fault-taxonomy",
+                   "ownership-history"}
+    assert len(ids) >= 14
 
 
 def test_production_suppressions_all_carry_reasons(lint_report):

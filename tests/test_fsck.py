@@ -290,3 +290,147 @@ def test_cli_fsck_fix(capsys, tmp_path):
     report = json.loads(out)
     assert report["ok"] is True
     assert report["fix_actions"] == ["rewrite-hints"]
+
+
+# -- incremental fsck (sweep watermark) --------------------------------------
+
+def _forge_total(table, sid, delta=7):
+    path = f"{table.path}/snapshot/snapshot-{sid}"
+    d = json.loads(open(path).read())
+    d["totalRecordCount"] = d["totalRecordCount"] + delta
+    open(path, "w").write(json.dumps(d))
+
+
+def _new_path(after, before):
+    fresh = [p for p in after if p not in before]
+    assert fresh, "expected post-watermark objects"
+    return fresh[0]
+
+
+def test_incremental_is_o_delta(table):
+    """The witness for the whole mode: a stamped-clean chain costs
+    ZERO manifest decodes to re-verify, and new commits cost only
+    their own delta."""
+    full = fsck(table, stamp_watermark=True)
+    assert full.ok and not full.incremental
+    assert full.manifest_entries_decoded > 0
+
+    rep = fsck(table, incremental=True)
+    assert rep.ok and rep.incremental
+    assert rep.manifest_entries_decoded == 0
+
+    _commit(table, [{"id": 50, "v": 1.0}])
+    _commit(table, [{"id": 51, "v": 1.0}])
+    rep2 = fsck(table, incremental=True)
+    assert rep2.ok and rep2.incremental
+    assert 0 < rep2.manifest_entries_decoded < \
+        full.manifest_entries_decoded
+
+
+def test_incremental_absent_watermark_runs_full(table):
+    rep = fsck(table, incremental=True)
+    assert rep.ok and not rep.incremental
+    assert rep.manifest_entries_decoded > 0
+
+
+def test_incremental_rollback_demotes_to_full(table):
+    """rollback_to rewrites history past the stamp: the next
+    incremental run must silently fall back to a full pass (and a
+    clean stamped one re-arms it)."""
+    assert fsck(table, stamp_watermark=True).ok
+    _commit(table, [{"id": 60, "v": 6.0}])
+    table.rollback_to(2)
+    rep = fsck(table, incremental=True)
+    assert rep.ok and not rep.incremental
+    assert fsck(table, incremental=True, stamp_watermark=True).ok
+    rep2 = fsck(table, incremental=True)
+    assert rep2.ok and rep2.incremental
+
+
+def test_validate_watermark_mirrors_matches_tip(table):
+    """Identity = (id, base list, delta list): UUID list names make a
+    recreated id distinguishable, exactly like the plan cache."""
+    from paimon_tpu.maintenance import SweepWatermark, validate_watermark
+
+    snap = table.latest_snapshot()
+    good = SweepWatermark(snap.id, snap.base_manifest_list or "",
+                          snap.delta_manifest_list or "", 123)
+    assert validate_watermark(table, good)
+    assert not validate_watermark(
+        table, SweepWatermark(snap.id, "manifest-list-recreated",
+                              good.delta_list, 123))
+    assert not validate_watermark(
+        table, SweepWatermark(snap.id + 99, good.base_list,
+                              good.delta_list, 123))
+
+
+_AGREEMENT_SEEDS = [
+    (ViolationKind.DANGLING_DATA_FILE,
+     lambda t, pd, pm: os.remove(_new_path(_live_data_paths(t), pd))),
+    (ViolationKind.CORRUPT_MANIFEST,
+     lambda t, pd, pm: open(_new_path(_latest_manifest_paths(t), pm),
+                            "wb").write(b"xx")),
+    (ViolationKind.MISSING_MANIFEST,
+     lambda t, pd, pm: os.remove(
+         _new_path(_latest_manifest_paths(t), pm))),
+    (ViolationKind.MISSING_MANIFEST_LIST,
+     lambda t, pd, pm: os.remove(t.new_scan().manifest_list.path(
+         t.latest_snapshot().delta_manifest_list))),
+    (ViolationKind.SNAPSHOT_GAP,
+     lambda t, pd, pm: os.remove(
+         f"{t.path}/snapshot/snapshot-{t.latest_snapshot().id - 1}")),
+    (ViolationKind.CORRUPT_SNAPSHOT,
+     lambda t, pd, pm: open(
+         f"{t.path}/snapshot/snapshot-{t.latest_snapshot().id - 1}",
+         "w").write("{not json")),
+    (ViolationKind.ROW_COUNT_MISMATCH,
+     lambda t, pd, pm: _forge_total(t, t.latest_snapshot().id)),
+]
+
+
+@pytest.mark.parametrize(
+    "kind,seed", _AGREEMENT_SEEDS,
+    ids=[k for k, _ in _AGREEMENT_SEEDS])
+def test_incremental_full_agreement(table, kind, seed):
+    """The agreement oracle: every violation producible in the
+    post-watermark delta is found by BOTH modes — the periodic full
+    pass can only ever ADD coverage (absolute recounts,
+    level-overlap), never disagree on the delta."""
+    assert fsck(table, stamp_watermark=True).ok
+    pre_data = set(_live_data_paths(table))
+    pre_manifests = set(_latest_manifest_paths(table))
+    _commit(table, [{"id": 100, "v": 9.0}])
+    _commit(table, [{"id": 101, "v": 9.0}])
+    seed(table, pre_data, pre_manifests)
+
+    inc = fsck(table, incremental=True)
+    assert inc.incremental
+    assert kind in inc.kinds(), \
+        f"incremental missed {kind}: {inc.to_dict()}"
+    full = fsck(table)
+    assert kind in full.kinds(), f"full missed {kind}"
+
+
+def test_stamp_requires_clean_chain(table):
+    """A dirty chain must never arm the incremental mode: the stamp
+    would launder the violation out of every future delta."""
+    os.remove(_live_data_paths(table)[0])
+    rep = fsck(table, stamp_watermark=True)
+    assert not rep.ok
+    after = fsck(table, incremental=True)
+    assert not after.incremental           # nothing was stamped
+
+
+def test_cli_fsck_incremental_flags(capsys, tmp_path):
+    wh = str(tmp_path / "wh")
+    _cli_table(capsys, wh)
+    rc, out = _cli(capsys, "-w", wh, "table", "fsck", "d1.t",
+                   "--stamp-watermark")
+    assert rc == 0 and json.loads(out)["ok"] is True
+    rc, out = _cli(capsys, "-w", wh, "table", "fsck", "d1.t",
+                   "--incremental")
+    assert rc == 0
+    report = json.loads(out)
+    assert report["ok"] is True
+    assert report["incremental"] is True
+    assert report["manifest_entries_decoded"] == 0

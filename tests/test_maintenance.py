@@ -291,3 +291,112 @@ def test_watermark_advances_and_drives_tags(tmp_path):
     # watermarks never regress
     commit([{"id": 2}], wm=2 * day)
     assert t.latest_snapshot().watermark == 3 * day + 1000
+
+
+def test_orphan_incremental_watermark_rides_grace_cutoff(tmp_warehouse):
+    """Incremental sweeps stamp the completed grace CUTOFF; the next
+    sweep's candidate walk starts there, yet debris born between the
+    two cutoffs is still reclaimed."""
+    from paimon_tpu.maintenance.orphan import DEFAULT_OLDER_THAN_MS
+
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    base = int(time.time() * 1000)
+
+    # sweep 1 stamps floor = base (cutoff of this run)
+    table.remove_orphan_files(now_ms=base + DEFAULT_OLDER_THAN_MS,
+                              incremental=True)
+
+    # debris born AFTER the stamped floor, before the next cutoff
+    orphan = os.path.join(table.path, "bucket-0", "data-mid-0.parquet")
+    open(orphan, "wb").write(b"junk")
+    mt = (base + 30_000) / 1000.0
+    os.utime(orphan, (mt, mt))
+
+    deleted = table.remove_orphan_files(
+        now_ms=base + DEFAULT_OLDER_THAN_MS + 60_000, incremental=True)
+    assert [os.path.basename(p) for p in deleted] == \
+        ["data-mid-0.parquet"]
+    assert table.to_arrow().num_rows == 1      # live data untouched
+
+
+def test_orphan_rollback_between_sweeps_demotes_to_full(tmp_warehouse):
+    """Debris older than the stamped floor is invisible to an
+    incremental sweep BY DESIGN (crash-mid-expire leftovers belong to
+    the periodic full pass) — but a rollback deletes the stamping
+    snapshot, so the very next incremental call demotes to full and
+    reclaims it, mirroring the plan cache's matches_tip."""
+    from paimon_tpu.maintenance.orphan import DEFAULT_OLDER_THAN_MS
+
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    keep = table.latest_snapshot().id
+    _commit(table, [{"id": 2, "v": 2.0}])
+    base = int(time.time() * 1000)
+
+    table.remove_orphan_files(now_ms=base + DEFAULT_OLDER_THAN_MS,
+                              incremental=True)
+    # debris BELOW the floor just stamped
+    debris = os.path.join(table.path, "bucket-0", "data-old-0.parquet")
+    open(debris, "wb").write(b"junk")
+    old = (base - 100_000) / 1000.0
+    os.utime(debris, (old, old))
+
+    # incremental: skipped (mtime < floor) — deliberately
+    assert table.remove_orphan_files(
+        now_ms=base + DEFAULT_OLDER_THAN_MS + 60_000,
+        incremental=True) == []
+    assert os.path.exists(debris)
+
+    # rollback rewrites history past the stamp: demote + reclaim
+    table.rollback_to(keep)
+    deleted = table.remove_orphan_files(
+        now_ms=base + DEFAULT_OLDER_THAN_MS + 120_000,
+        incremental=True)
+    assert "data-old-0.parquet" in \
+        {os.path.basename(p) for p in deleted}
+    assert table.to_arrow().num_rows == 1
+
+
+def test_expire_folds_idle_heartbeat_chain(tmp_warehouse):
+    """The week-long-idle regression: two hosts' lease heartbeats
+    accrete empty APPENDs the retention windows never expire (the
+    chain's tail is always young).  Folding keeps the chain bounded —
+    endpoints and the newest heartbeat per committer survive (lease /
+    rejoin-request visibility for bounded newest-first walks), the
+    holes are excused to fsck, and time travel probes past them."""
+    from paimon_tpu.core.commit import FileStoreCommit
+    from paimon_tpu.maintenance import fsck
+    from paimon_tpu.parallel.distributed import lease_props
+
+    table = _make(tmp_warehouse)
+    _commit(table, [{"id": 1, "v": 1.0}])
+    for i in range(40):
+        pid = i % 2
+        fc = FileStoreCommit(table.file_io, table.path, table.schema,
+                             table.options,
+                             commit_user=f"stream-daemon-p{pid}",
+                             branch=table.branch)
+        fc.commit([], properties=lease_props(pid, 1000 + i),
+                  force_create=True)
+
+    sm = table.snapshot_manager
+    assert sm.snapshot_count() == 41
+    res = table.expire_snapshots()
+    # endpoints (1, 41) are never walked, so the tip doesn't count as
+    # p1's "seen" heartbeat: 39 (newest interior p1) and 40 (newest
+    # p0) survive, 2..38 fold
+    assert len(res.folded_snapshots) == 37
+    assert sm.snapshot_count() == 4
+    survivors = {s.commit_user for s in sm.snapshots()}
+    assert {"stream-daemon-p0", "stream-daemon-p1"} <= survivors
+
+    assert set(res.folded_snapshots) <= sm.folded_ids()
+    assert fsck(table).ok                      # holes excused
+    assert table.expire_snapshots().folded_snapshots == []  # idempotent
+
+    # time travel binary-searches past the folded holes
+    tip = sm.latest_snapshot()
+    found = sm.earlier_or_equal_time_mills(tip.time_millis)
+    assert found is not None and found.id == tip.id
+    assert table.to_arrow().num_rows == 1

@@ -204,7 +204,7 @@ class TestMaintenancePlane:
                         lambda: now["ms"])
         assert p_solo.maybe_heartbeat() is None
 
-    def test_plane_refuses_recorded_dead_self(self, tmp_path):
+    def test_plane_recorded_dead_self_enters_rejoining(self, tmp_path):
         t = _table(tmp_path, extra={"multihost.lease.timeout": "500"})
         now = {"ms": 0}
         p0 = _plane(t, 0, 2, lambda: now["ms"])
@@ -212,13 +212,65 @@ class TestMaintenancePlane:
         p0.adopt({1})
         p0.maybe_heartbeat() if p0.heartbeat_due() else \
             p0.ensure_lease()                    # publish the map
+        # default: the resurrected host constructs in the rejoining
+        # state — it owns nothing and waits to be readmitted
+        p1 = _plane(FileStoreTable.load(t.path), 1, 2,
+                    lambda: now["ms"])
+        assert p1.rejoining
+        assert not any(p1.owns((), b) for b in range(4))
+        # opting out restores the refusal
         with pytest.raises(OwnershipError, match="DEAD"):
-            _plane(FileStoreTable.load(t.path), 1, 2,
-                   lambda: now["ms"])
+            _plane(FileStoreTable.load(
+                t.path,
+                dynamic_options={"multihost.rejoin.enabled": "false"}),
+                1, 2, lambda: now["ms"])
         # survivors resume the recorded generation, dead set included
         p0b = _plane(FileStoreTable.load(t.path), 0, 2,
                      lambda: now["ms"])
         assert p0b.ownership.dead == frozenset({1})
+
+    def test_rejoin_request_readmit_round_trip(self, tmp_path):
+        t = _table(tmp_path, extra={"multihost.lease.timeout": "500"})
+        now = {"ms": 0}
+        p0 = _plane(t, 0, 2, lambda: now["ms"])
+        p0.ensure_lease()
+        p0.adopt({1})
+        p0.ensure_lease()                        # publish the map
+        p1 = _plane(FileStoreTable.load(t.path), 1, 2,
+                    lambda: now["ms"])
+        assert p1.rejoining
+        assert p1.request_rejoin() is not None
+        # every survivor computes the same pending set from the store;
+        # the elected (lowest alive) one grants
+        assert p0.pending_rejoin_requests() == frozenset({1})
+        assert p0.owns_rejoin_grant()
+        readmitted = p0.readmit(p0.pending_rejoin_requests())
+        assert readmitted == frozenset({1})
+        assert p0.ownership.dead == frozenset()
+        assert p0.ownership.version == 3         # bring-up, death, rejoin
+        # readmission is exactly-once: a retry is a no-op
+        assert p0.readmit({1}) == frozenset()
+        p0.ensure_lease()                        # publish the grant
+        # the rejoiner observes the generation where it is alive again
+        assert p1.refresh_ownership()
+        assert not p1.rejoining
+        assert p1.ownership.version == 3
+        # warm rejoin: p1 got exactly its old primary groups back
+        assert {b for b in range(4) if p1.owns((), b)} == \
+            {b for b in range(4)
+             if OwnershipMap(1, 2, 4).owner_of((), b) == 1}
+        # the full generation history is persisted and exact
+        fresh = FileStoreTable.load(t.path)
+        from paimon_tpu.parallel.distributed import (
+            resume_generation_history)
+        hist = resume_generation_history(fresh)
+        assert [m.version for m in hist.entries] == [1, 2, 3]
+        assert hist.at(2).dead == frozenset({1})
+        assert hist.at(3).dead == frozenset()
+        # a stale request from a re-dead host ages out with its lease
+        p0.adopt({1})
+        now["ms"] += 10_000
+        assert p0.pending_rejoin_requests() == frozenset()
 
     def test_expiry_election_fails_over(self, tmp_path):
         t = _table(tmp_path)

@@ -19,6 +19,26 @@ class TestBootstrap:
         monkeypatch.setenv("PROCESS_ID", "0")
         assert MH.initialize() == (0, 1)
 
+    def test_peer_death_tolerance_unset(self, monkeypatch):
+        monkeypatch.delenv("PAIMON_MULTIHOST_PEER_MISSED_HEARTBEATS",
+                           raising=False)
+        assert MH.peer_death_tolerance() == {}
+
+    def test_peer_death_tolerance_explicit_and_env(self, monkeypatch):
+        assert MH.peer_death_tolerance(360) == {
+            "service_max_missing_heartbeats": 360,
+            "client_max_missing_heartbeats": 360,
+        }
+        monkeypatch.setenv("PAIMON_MULTIHOST_PEER_MISSED_HEARTBEATS",
+                           "25")
+        assert MH.peer_death_tolerance() == {
+            "service_max_missing_heartbeats": 25,
+            "client_max_missing_heartbeats": 25,
+        }
+        # explicit argument wins over the env var
+        assert MH.peer_death_tolerance(7)[
+            "client_max_missing_heartbeats"] == 7
+
 
 class TestGlobalMesh:
     def test_one_axis_inferred(self):

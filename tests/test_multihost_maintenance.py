@@ -33,6 +33,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -101,7 +102,12 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 from paimon_tpu.parallel import multihost as MH
 
-idx, count = MH.initialize(f"127.0.0.1:{port}", n_procs, pid)
+# peer death is the EVENT UNDER TEST: widen the coordination
+# service's missed-heartbeat budget so the survivor is governed by
+# its leases (and the parent's timeout), not aborted by XLA ~100s
+# after the victim's os._exit
+idx, count = MH.initialize(f"127.0.0.1:{port}", n_procs, pid,
+                           max_missing_heartbeats=360)
 assert (idx, count) == (pid, n_procs)
 
 from paimon_tpu import Schema
@@ -171,11 +177,21 @@ def drain():
 g = global_registry()
 emitted = 0
 storms_done = 0
+compactions_at_kill = None
 marker = table_path + ".victim-dead"
 while emitted < N_TOTAL:
     source.append(*gen_events(emitted, emitted + PER_TICK))
     emitted += PER_TICK
     drain()
+    # sample the compaction counter the moment the victim's death is
+    # visible: "compaction progressed AFTER the kill" must count the
+    # work done on the post-kill two-thirds of the stream.  Sampling
+    # after the emit loop raced — a compactor that caught up exactly
+    # at stream end had nothing left to do, and the worker burned its
+    # whole 120s progress window on an already-converged table
+    if compactions_at_kill is None and os.path.exists(marker):
+        compactions_at_kill = g.stream_metrics().counter(
+            STREAM_COMPACTIONS).count
     if pid == n_procs - 1 and emitted >= KILL_AFTER:
         # HOST DEATH: no drain, no final checkpoint, no goodbye —
         # everything past the last committed checkpoint is lost and
@@ -193,7 +209,6 @@ while emitted < N_TOTAL:
     time.sleep(TICK_S)
 
 # survivor: converge on EVERYTHING (own share + adopted share)
-compactions_at_kill = None
 deadline = time.time() + 240
 while time.time() < deadline:
     drain()
@@ -336,6 +351,368 @@ def test_multihost_soak_full(tmp_path):
         args=[n_total, n_total // 2, 2],
         expected_rc={1: 42}, timeout=560)
     _audit_soak(table_path, outs, n_total)
+
+
+# -- kill-two-then-rejoin chaos soak (ISSUE 17 tentpole) ----------------------
+
+_REJOIN_SOAK_WORKER = _PROLOG + r'''
+import json, time
+from multihost_soak import SOAK_TABLE_OPTIONS, gen_events
+from paimon_tpu.cdc.source import MemoryCdcSource
+from paimon_tpu.metrics import (
+    FLEET_GENERATIONS, FLEET_REJOINS,
+    MULTIHOST_MAINTENANCE_TAKEOVERS, global_registry,
+)
+from paimon_tpu.parallel.maintenance_plane import MaintenancePlane
+from paimon_tpu.service.stream_daemon import StreamDaemon
+
+N_TOTAL = int(sys.argv[6])
+KILL = int(sys.argv[7])       # pid 2 dies past this offset (abrupt)
+KILL2 = int(sys.argv[8])      # pid 1 dies past this one, AT the CAS
+STORM = int(sys.argv[9])      # survivor 503 storms (slow soak)
+TICK_S = 0.025
+PER_TICK = 6
+
+t = shared_table(dict(SOAK_TABLE_OPTIONS))
+if pid == 1 or (pid == 0 and STORM):
+    from failing_fileio import FailingFileIO
+    fio = FailingFileIO(t.file_io, f"mh-rejoin-p{pid}")
+    t = FileStoreTable(fio, t.path, t.schema_manager.latest())
+
+plane = MaintenancePlane(t, base_user="stream-daemon")
+source = MemoryCdcSource()
+daemon = StreamDaemon(t, source, commit_user="stream-daemon",
+                      plane=plane).start()
+
+rows_f = open(table_path + f".rows-p{pid}.jsonl", "a")
+def drain():
+    while True:
+        rows = daemon.poll_changelog(timeout=0.0)
+        if not rows:
+            rows_f.flush(); return
+        for r in rows:
+            rows_f.write(json.dumps(r) + "\n")
+
+g = global_registry()
+adopted_marker = table_path + ".adopted-all"
+emitted = 0
+while emitted < N_TOTAL:
+    source.append(*gen_events(emitted, emitted + PER_TICK))
+    emitted += PER_TICK
+    drain()
+    if pid == 2 and emitted >= KILL:
+        # abrupt host death mid-traffic: no drain, no goodbye
+        rows_f.flush(); rows_f.close()
+        os._exit(42)
+    if pid == 1 and emitted >= KILL2:
+        # die AT the snapshot CAS: every store op now fails
+        # (InjectedIOError mid-upload), so the in-flight checkpoint
+        # tears partway — then the host is gone.  Cascading: pid 2 is
+        # already dead, so this victim's takeover floor must come
+        # from the generation history, not the current dead set
+        FailingFileIO.reset("mh-rejoin-p1", 0, fail_times=10000)
+        time.sleep(0.4)
+        rows_f.flush(); rows_f.close()
+        os._exit(42)
+    if pid == 0:
+        if STORM and emitted in (KILL, KILL2):
+            # 503 storm on the survivor exactly while it is trying
+            # to adopt a victim: rides the commit retry ladder
+            FailingFileIO.reset("mh-rejoin-p0", 0, fail_times=STORM)
+        if not os.path.exists(adopted_marker):
+            d = daemon.status()["distributed"]
+            if sorted(d["adopted"]) == [1, 2]:
+                open(adopted_marker, "w").close()  # parent: rejoins
+    time.sleep(TICK_S)
+
+# survivor: finish adopting both victims if the emission loop ended
+# first, then publish the marker that lets the parent resurrect them
+deadline = time.time() + 240
+while not os.path.exists(adopted_marker):
+    assert time.time() < deadline, daemon.status()
+    drain()
+    d = daemon.status()["distributed"]
+    if sorted(d["adopted"]) == [1, 2]:
+        open(adopted_marker, "w").close()
+        break
+    time.sleep(0.05)
+
+# carry the fleet through both rejoins to convergence
+deadline = time.time() + 240
+done = False
+while time.time() < deadline:
+    drain()
+    st = daemon.status()
+    if st["offset_committed"] >= N_TOTAL - 1 and \
+            not plane.ownership.dead and \
+            os.path.exists(table_path + ".rejoined-p1") and \
+            os.path.exists(table_path + ".rejoined-p2"):
+        done = True
+        break
+    time.sleep(0.05)
+assert done, daemon.status()
+
+# release the rejoiners: they hold their daemons (and leases) alive
+# until this marker so the all-alive observation above cannot race
+# their teardown — an exited rejoiner's lease expires in ~1.5s and
+# the detector would (correctly) declare it dead AGAIN
+open(table_path + ".fleet-converged", "w").close()
+
+daemon.stop(drain=True)
+drain()
+rows_f.close()
+
+fleet = g.fleet_metrics()
+summary = {
+    "takeovers": g.multihost_metrics().counter(
+        MULTIHOST_MAINTENANCE_TAKEOVERS).count,
+    "rejoins": fleet.counter(FLEET_REJOINS).count,
+    "generations": fleet.gauge(FLEET_GENERATIONS).value,
+    "offset_committed": daemon.status()["offset_committed"],
+    "ownership_version": plane.ownership.version,
+    "dead": sorted(plane.ownership.dead),
+}
+with open(table_path + ".summary.json", "w") as f:
+    json.dump(summary, f)
+print(f"proc {pid}: MH-SOAK-OK {json.dumps(summary)}", flush=True)
+sys.stdout.flush()
+os._exit(0)
+'''
+
+
+# second incarnation of a killed host: NO mesh bring-up — rejoin is a
+# store-only protocol, so the resurrected process needs nothing but
+# the table path and its old process index
+_REJOIN_WORKER = r'''
+import os, sys, json, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+pid = int(sys.argv[1]); table_path = sys.argv[3]
+REPO = sys.argv[4]; n_procs = int(sys.argv[5])
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+N_TOTAL = int(sys.argv[6])
+
+from multihost_soak import gen_events
+from paimon_tpu.cdc.source import MemoryCdcSource
+from paimon_tpu.parallel.maintenance_plane import MaintenancePlane
+from paimon_tpu.service.stream_daemon import StreamDaemon
+from paimon_tpu.table import FileStoreTable
+
+t = FileStoreTable.load(table_path)
+plane = MaintenancePlane(t, base_user="stream-daemon",
+                         process_index=pid, process_count=n_procs)
+assert plane.rejoining, \
+    "restart of a dead-recorded host must enter the rejoining state"
+source = MemoryCdcSource()
+source.append(*gen_events(0, N_TOTAL))   # full replayable history
+daemon = StreamDaemon(t, source, commit_user="stream-daemon",
+                      plane=plane).start()
+
+rows_f = open(table_path + f".rows-p{pid}.jsonl", "a")
+def drain():
+    while True:
+        rows = daemon.poll_changelog(timeout=0.0)
+        if not rows:
+            rows_f.flush(); return
+        for r in rows:
+            rows_f.write(json.dumps(r) + "\n")
+
+deadline = time.time() + 240
+ok = False
+while time.time() < deadline:
+    drain()
+    st = daemon.status()
+    if not st["distributed"]["rejoining"] and \
+            st["offset_committed"] >= N_TOTAL - 1:
+        ok = True
+        break
+    time.sleep(0.05)
+st = daemon.status()
+assert ok, st
+open(table_path + f".rejoined-p{pid}", "w").close()
+summary = {"rejoin_replayed": st["distributed"]["rejoin_replayed"],
+           "offset_committed": st["offset_committed"],
+           "ownership_version": st["distributed"]["ownership_version"]}
+with open(table_path + f".rejoin-summary-p{pid}.json", "w") as f:
+    json.dump(summary, f)
+# stay ALIVE (daemon heartbeating, lease fresh) until the survivor
+# has observed the all-alive fleet — exiting now would expire this
+# host's lease mid-observation and the detector would re-declare it
+# dead, which the survivor's convergence wait could never recover
+# from (a correct re-death, but not the lifecycle under test)
+release = time.time() + 240
+while not os.path.exists(table_path + ".fleet-converged") and \
+        time.time() < release:
+    drain()
+    time.sleep(0.05)
+daemon.stop(drain=True)
+drain()
+rows_f.close()
+print(f"proc {pid}: MH-REJOIN-OK {json.dumps(summary)}", flush=True)
+sys.stdout.flush()
+os._exit(0)
+'''
+
+
+def _run_rejoin_soak(tmp_path, n_total, kill, kill2, storm=0,
+                     timeout=420):
+    port = _free_port()
+    table_path = str(tmp_path / "t")
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(_REJOIN_SOAK_WORKER)
+    rejoin_py = tmp_path / "rejoin.py"
+    rejoin_py.write_text(_REJOIN_WORKER)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    def spawn(py, pid, extra):
+        return subprocess.Popen(
+            [sys.executable, str(py), str(pid), str(port), table_path,
+             REPO, "3"] + [str(a) for a in extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+
+    procs = {p: spawn(worker_py, p, [n_total, kill, kill2, storm])
+             for p in range(3)}
+    outs = {}
+    try:
+        for p in (2, 1):            # victims die first, in order
+            outs[p], _ = procs[p].communicate(timeout=timeout)
+            if _NO_CPU_COLLECTIVES in outs[p]:
+                pytest.skip("jaxlib CPU backend lacks Gloo "
+                            "cross-process collectives")
+            assert procs[p].returncode == 42, \
+                f"victim {p} rc={procs[p].returncode}:\n" \
+                f"{outs[p][-6000:]}"
+        # survivor adopts both; fsck mid-chaos (two hosts down)
+        deadline = time.time() + timeout
+        while not os.path.exists(table_path + ".adopted-all"):
+            assert procs[0].poll() is None, \
+                procs[0].communicate()[0][-6000:]
+            assert time.time() < deadline, \
+                "survivor never adopted both victims"
+            time.sleep(0.1)
+        mid = FileStoreTable.load(table_path).fsck()
+        assert mid.ok, [v.to_dict() for v in mid.violations]
+        # resurrect both victims — store-only rejoin, no mesh
+        rejoiners = {p: spawn(rejoin_py, p, [n_total])
+                     for p in (1, 2)}
+        for p in (1, 2):
+            out, _ = rejoiners[p].communicate(timeout=timeout)
+            outs[f"rejoin{p}"] = out
+            assert rejoiners[p].returncode == 0, \
+                f"rejoiner {p}:\n{out[-6000:]}"
+        outs[0], _ = procs[0].communicate(timeout=timeout)
+        assert procs[0].returncode == 0, outs[0][-6000:]
+    finally:
+        for pr in procs.values():
+            if pr.poll() is None:
+                pr.kill()
+    return table_path, outs
+
+
+def _audit_rejoin_soak(table_path, outs, n_total):
+    assert "MH-SOAK-OK" in outs[0], outs[0][-6000:]
+    for p in (1, 2):
+        assert "MH-REJOIN-OK" in outs[f"rejoin{p}"], \
+            outs[f"rejoin{p}"][-6000:]
+
+    expected = expected_state(n_total)
+    final = FileStoreTable.load(table_path)
+
+    # byte-identity to the single-process oracle
+    schema = (Schema.builder()
+              .column("id", BigIntType(False))
+              .column("v", BigIntType())
+              .primary_key("id")
+              .options({"bucket": "4"})
+              .build())
+    oracle = FileStoreTable.create(table_path + "-oracle", schema)
+    wb = oracle.new_batch_write_builder()
+    with wb.new_write() as w:
+        w.write_dicts([{"id": k, "v": v}
+                       for k, v in sorted(expected.items())])
+        wb.new_commit().commit(w.prepare_commit())
+    assert final.to_arrow().sort_by("id").equals(
+        oracle.to_arrow().sort_by("id")), \
+        "post-rejoin fleet state != single-process oracle"
+
+    # per-user committed offsets strictly increasing ACROSS both
+    # incarnations of each victim, and every host drained to the end
+    offsets = {p: [] for p in range(3)}
+    for snap in final.snapshot_manager.snapshots():
+        for p in range(3):
+            if snap.commit_user == f"stream-daemon-p{p}" and \
+                    snap.properties and \
+                    "stream.source.offset" in snap.properties:
+                offsets[p].append(
+                    int(snap.properties["stream.source.offset"]))
+    for p in range(3):
+        assert offsets[p], f"user p{p} never checkpointed"
+        assert offsets[p] == sorted(set(offsets[p])), \
+            f"p{p} offsets not strictly increasing: {offsets[p]}"
+        assert offsets[p][-1] == n_total - 1, \
+            f"p{p} did not converge: {offsets[p][-1]}"
+
+    # exactly-once cascading takeover + both rejoins, on /metrics
+    with open(table_path + ".summary.json") as f:
+        summary = json.load(f)
+    assert summary["takeovers"] >= 2, summary
+    assert summary["rejoins"] >= 2, summary
+    assert summary["dead"] == [], summary
+    assert summary["generations"] == summary["ownership_version"]
+    for p in (1, 2):
+        with open(f"{table_path}.rejoin-summary-p{p}.json") as f:
+            rs = json.load(f)
+        assert rs["rejoin_replayed"] > 0, \
+            f"rejoiner {p} replayed no gap rows: {rs}"
+
+    # the persisted generation history is exact: bring-up, both
+    # deaths, both readmissions — versions strictly increasing,
+    # the double-death generation present, nobody dead at the tip
+    from paimon_tpu.parallel.distributed import (
+        resume_generation_history,
+    )
+    hist = resume_generation_history(final)
+    assert hist is not None
+    versions = [m.version for m in hist.entries]
+    assert versions == sorted(set(versions)), versions
+    assert any(m.dead == frozenset({1, 2}) for m in hist.entries), \
+        [(m.version, sorted(m.dead)) for m in hist.entries]
+    assert hist.current().dead == frozenset()
+
+    report = final.fsck()
+    assert report.ok, [v.to_dict() for v in report.violations]
+
+
+def test_multihost_soak_kill_two_then_rejoin(tmp_path):
+    """ISSUE 17 acceptance (smoke scale): real 3-process gloo mesh,
+    two hosts killed mid-traffic — one abruptly, one at the snapshot
+    CAS under an injected IO storm (torn uploads) — cascading
+    exactly-once takeover computed from the persisted generation
+    history, then BOTH victims rejoin with no operator: readmitted by
+    the elected survivor, offset gaps replayed, final table
+    byte-identical to the single-process oracle, per-user offsets
+    strictly increasing, fsck clean mid-chaos and after,
+    `rejoins >= 2` and `maintenance_takeovers >= 2`."""
+    n_total = 1080
+    table_path, outs = _run_rejoin_soak(
+        tmp_path, n_total, kill=360, kill2=480)
+    _audit_rejoin_soak(table_path, outs, n_total)
+
+
+@pytest.mark.slow
+def test_multihost_soak_kill_two_then_rejoin_storm(tmp_path):
+    """Storm variant: longer stream and a 503 storm armed on the
+    SURVIVOR at both kill offsets, so each cascading adoption commit
+    has to climb the write-retry ladder while the dying host's torn
+    uploads are still on disk."""
+    n_total = 2400
+    table_path, outs = _run_rejoin_soak(
+        tmp_path, n_total, kill=798, kill2=948, storm=4, timeout=560)
+    _audit_rejoin_soak(table_path, outs, n_total)
 
 
 _RESCALE_WORKER = _PROLOG + r'''

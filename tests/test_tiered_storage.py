@@ -478,8 +478,15 @@ def test_staged_upload_failure_surfaces_at_barrier(tmp_path):
     wb = table.new_batch_write_builder()
     w = wb.new_write()
     try:
-        w.write_arrow(_data(20_000))
         with pytest.raises(TransientStoreError):
+            # fail-fast stage() may re-raise the dead upload on a
+            # LATER flush inside write_arrow (timing-dependent);
+            # otherwise it surfaces at the drain barrier — never later
+            w.write_arrow(_data(20_000))
+            w.prepare_commit()
+        # poisoning latches no later than the first drain (the early-
+        # surface ordering pays one more barrier raise to get there)
+        with pytest.raises((RuntimeError, TransientStoreError)):
             w.prepare_commit()
         # the stager is poisoned: a retried prepare on the same writer
         # must refuse instead of committing with files missing
