@@ -456,7 +456,10 @@ def serve_child_main():
     Prints one JSON line for the parent."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    from benchmarks.serve_bench import measure_replicated, measure_serving
+    from benchmarks.serve_bench import (
+        measure_replicated, measure_serving, measure_serving_external,
+        measure_warmboot,
+    )
 
     rows = int(os.environ.get("BENCH_SERVE_ROWS", "200000"))
     clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "64"))
@@ -476,6 +479,21 @@ def serve_child_main():
             emit=None)
         rep.pop("latency_series", None)
         out["replicated"] = rep
+        # the PR-18 true-ceiling rig: external loadgen processes
+        # (benchmarks/loadgen.py) against replica subprocesses —
+        # closed-loop ceiling + open-loop latency + saturation verdict
+        ext = measure_serving_external(
+            rows=rows,
+            seconds=float(os.environ.get(
+                "BENCH_SERVE_EXTERNAL_SECONDS", "8")),
+            replicas=replicas,
+            procs=int(os.environ.get(
+                "BENCH_SERVE_LOADGEN_PROCS", "8")),
+            threads=int(os.environ.get(
+                "BENCH_SERVE_LOADGEN_THREADS", "8")),
+            emit=None)
+        out["external"] = ext
+    out["warmboot"] = measure_warmboot(rows=rows, emit=None)
     from paimon_tpu.metrics import global_registry
     snap = global_registry().snapshot()
     out["metrics_snapshot"] = {
@@ -549,6 +567,38 @@ def compose_serve(result):
         },
         "metrics_snapshot": result.get("metrics_snapshot"),
     }
+    if "engine_python_point_us" in result:
+        # PR-18 native C probe: same warm readers + keys, native vs
+        # forced-python, plus the handler's measured CPU per key
+        block["native_probe"] = {
+            "metric": "serving_engine_point_us",
+            "value": result["engine_point_us"],
+            "unit": (f"us/key native C probe (python "
+                     f"{result['engine_python_point_us']}us/key = "
+                     f"{result['native_vs_python']}x; "
+                     f"{result.get('native_fallbacks', 0)} "
+                     f"fallbacks)"),
+            "native_vs_python": result["native_vs_python"],
+            "handler_cpu_per_key_ms_p50":
+                result.get("handler_cpu_per_key_ms_p50"),
+            "handler_cpu_per_key_ms_p95":
+                result.get("handler_cpu_per_key_ms_p95"),
+            "native_fallbacks": result.get("native_fallbacks"),
+        }
+    wb = result.get("warmboot")
+    if wb:
+        block["warmboot"] = {
+            "metric": "serving_warmboot_boot_ms",
+            "value": wb["warm_boot_ms"],
+            "unit": (f"ms warm boot-to-first-answer (cold "
+                     f"{wb['cold_boot_ms']}ms = "
+                     f"{wb['cold_vs_warm']}x; warm reader_builds "
+                     f"{wb['warm_reader_builds']} vs cold "
+                     f"{wb['cold_reader_builds']}; "
+                     f"{wb['warm_restore']['ssts']} SSTs adopted)"),
+            "cold_vs_warm": wb["cold_vs_warm"],
+            "warm_reader_builds": wb["warm_reader_builds"],
+        }
     rep = result.get("replicated")
     if rep:
         # ISSUE 13 acceptance vs the BENCH_r07 single-replica
@@ -585,6 +635,42 @@ def compose_serve(result):
                                "requests; obs = server-side "
                                "histograms pooled across replicas — "
                                "compare client_ok vs obs"),
+        }
+    ext = result.get("external")
+    if ext:
+        sat = ext["saturation"]
+        block["external"] = {
+            "metric": "serving_external_qps",
+            "value": ext["qps"],
+            "unit": (f"requests/s closed-loop from "
+                     f"{ext['loadgen_procs']} loadgen PROCESSES x "
+                     f"{ext['loadgen_threads']} threads "
+                     f"(benchmarks/loadgen.py, own connections) "
+                     f"against {ext['replicas']} replica processes "
+                     f"on a {ext.get('host_cpus')}-cpu host; "
+                     f"saturated={sat['saturated']} (client cpu "
+                     f"{sat['client_cpu_frac_max']}, 429s "
+                     f"{sat['busy_429']}, handler-cpu queueing "
+                     f"{sat.get('handler_cpu_queueing_x')}x); "
+                     f"{ext['oracle_rows_checked']} sampled rows "
+                     f"oracle-identical"),
+            "host_cpus": ext.get("host_cpus"),
+            "open_loop_p95_ms": {
+                "metric": "serving_external_open_loop_p95_ms",
+                "value": ext["pooled_p95_ms"],
+                "unit": (f"ms pooled across loadgen processes at "
+                         f"{ext['open'].get('target_qps')} target "
+                         f"qps open-loop (p50 "
+                         f"{ext['open']['pooled_p50_ms']}ms, p99 "
+                         f"{ext['open']['pooled_p99_ms']}ms, "
+                         f"submit-stall frac "
+                         f"{ext['open']['submit_stall_frac']}; "
+                         f"latency from SCHEDULED send time)"),
+            },
+            "handler_cpu_per_key_ms_p50":
+                ext["handler_cpu_per_key_ms_p50"],
+            "native_fallbacks": ext["native_fallbacks"],
+            "saturation": sat,
         }
     return block
 

@@ -1,0 +1,347 @@
+"""True-ceiling external load generator for the serving plane.
+
+The in-process serving rigs (benchmarks/serve_bench.py) share the
+server's GIL and CPU: at high qps the *measuring* threads steal cycles
+from the *measured* handlers and the recorded ceiling is the client's,
+not the server's.  This module is the honest alternative: a standalone
+MULTI-PROCESS load generator that
+
+* runs entirely in its own processes (spawned by serve_bench or by
+  hand), each with its own KvQueryClient keep-alive connections;
+* supports CLOSED-loop (each thread fires the next request when the
+  previous answers — classic throughput probe) and OPEN-loop arrival
+  (`--rate` total target qps, per-thread fixed interarrival schedule:
+  latency is measured FROM THE SCHEDULED SEND TIME, and a thread that
+  falls behind its schedule counts a `submit_stall` instead of
+  silently eliding the wait — the coordinated-omission guard);
+* records latencies into FIXED LOG-SPACED histograms (identical bucket
+  bounds in every process), so per-process results merge exactly and
+  pooled percentiles are computed over the fleet, not averaged;
+* reports its own burned CPU (`time.process_time` per process): if the
+  loadgen processes are pegged, the measured "ceiling" is the CLIENT's
+  — serve_bench surfaces that as a saturation verdict instead of
+  publishing a flattering server number.
+
+Usage (standalone):
+    python -m benchmarks.loadgen http://HOST:PORT \
+        --rows 200000 --seconds 4 --procs 4 --threads 8 [--rate 8000]
+Prints ONE JSON line (merged across processes).  Library use:
+`run_loadgen(address, rows, ...)` returns the same dict.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# -- mergeable fixed-bound histogram -----------------------------------------
+
+HIST_MIN_MS = 0.01
+HIST_MAX_MS = 60_000.0
+HIST_BUCKETS = 160
+_LOG_MIN = math.log(HIST_MIN_MS)
+_LOG_RANGE = math.log(HIST_MAX_MS) - _LOG_MIN
+_BOUNDS = [math.exp(_LOG_MIN + _LOG_RANGE * (i + 1) / HIST_BUCKETS)
+           for i in range(HIST_BUCKETS)]
+
+
+def hist_bucket(ms: float) -> int:
+    """Bucket index for one latency; clamped to the histogram range."""
+    if ms <= HIST_MIN_MS:
+        return 0
+    if ms >= HIST_MAX_MS:
+        return HIST_BUCKETS - 1
+    i = int((math.log(ms) - _LOG_MIN) / _LOG_RANGE * HIST_BUCKETS)
+    return min(max(i, 0), HIST_BUCKETS - 1)
+
+
+def hist_percentile(counts, p: float) -> float:
+    """Percentile over merged bucket counts: the geometric midpoint of
+    the bucket holding the p-th sample (bounded relative error set by
+    the bucket width, ~7% here — fine for ms-scale serving tails)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = max(1, math.ceil(p / 100.0 * total))
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            lo = _BOUNDS[i - 1] if i else HIST_MIN_MS
+            return math.sqrt(lo * _BOUNDS[i])
+    return _BOUNDS[-1]
+
+
+def merge_hists(hists):
+    out = [0] * HIST_BUCKETS
+    for h in hists:
+        for i, c in enumerate(h):
+            out[i] += c
+    return out
+
+
+# -- worker process ----------------------------------------------------------
+
+
+def worker_main(cfg: dict) -> int:
+    """One loadgen process: `threads` client threads of pure point
+    lookups against `address`; prints one JSON line."""
+    import numpy as np
+
+    from paimon_tpu.service import KvQueryClient, ServiceBusyError
+
+    address = cfg["address"]
+    rows = int(cfg["rows"])
+    seconds = float(cfg["seconds"])
+    threads = int(cfg["threads"])
+    seed = int(cfg["seed"])
+    batch = int(cfg.get("batch", 1))
+    # open-loop: per-thread interarrival from the TOTAL target rate
+    rate = cfg.get("rate")
+    period = (cfg["total_threads"] / float(rate)) if rate else None
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    agg = {"lookups": 0, "keys": 0, "busy": 0, "submit_stalls": 0,
+           "errors": []}
+    hist_ok = [0] * HIST_BUCKETS
+    hist_all = [0] * HIST_BUCKETS
+    stats = {"sum": 0.0, "count": 0, "max": 0.0}
+    replicas_seen = set()
+
+    def worker(widx):
+        r = np.random.default_rng(seed * 1000 + widx)
+        my_ok = [0] * HIST_BUCKETS
+        my_all = [0] * HIST_BUCKETS
+        my = {"lookups": 0, "keys": 0, "busy": 0, "stalls": 0,
+              "sum": 0.0, "count": 0, "max": 0.0}
+        try:
+            with KvQueryClient(address=address,
+                               tenant=f"lg{seed}-{widx}") as c:
+                t0 = time.perf_counter()
+                n = 0
+                while not stop.is_set():
+                    if period is not None:
+                        sched = t0 + n * period
+                        now = time.perf_counter()
+                        if now < sched:
+                            time.sleep(sched - now)
+                        elif now - sched > period:
+                            # behind schedule: the arrival process is
+                            # no longer open-loop at the target rate
+                            my["stalls"] += 1
+                        start = sched
+                    else:
+                        start = time.perf_counter()
+                    n += 1
+                    if batch > 1:
+                        ks = [{"id": int(k)}
+                              for k in r.integers(0, rows, batch)]
+                    else:
+                        ks = [{"id": int(r.integers(0, rows))}]
+                    try:
+                        c.lookup(ks)
+                        ms = (time.perf_counter() - start) * 1000.0
+                        my_ok[hist_bucket(ms)] += 1
+                        my_all[hist_bucket(ms)] += 1
+                        my["lookups"] += 1
+                        my["keys"] += len(ks)
+                        my["sum"] += ms
+                        my["count"] += 1
+                        my["max"] = max(my["max"], ms)
+                    except ServiceBusyError:
+                        ms = (time.perf_counter() - start) * 1000.0
+                        my_all[hist_bucket(ms)] += 1
+                        my["busy"] += 1
+                if c.last_replica is not None:
+                    replicas_seen.add(c.last_replica)
+        except Exception as e:      # noqa: BLE001
+            agg["errors"].append(repr(e))
+        with lock:
+            agg["lookups"] += my["lookups"]
+            agg["keys"] += my["keys"]
+            agg["busy"] += my["busy"]
+            agg["submit_stalls"] += my["stalls"]
+            stats["sum"] += my["sum"]
+            stats["count"] += my["count"]
+            stats["max"] = max(stats["max"], my["max"])
+            for i in range(HIST_BUCKETS):
+                hist_ok[i] += my_ok[i]
+                hist_all[i] += my_all[i]
+
+    ths = [threading.Thread(target=worker, args=(i,))
+           for i in range(threads)]
+    cpu0 = time.process_time()
+    t0 = time.perf_counter()
+    [t.start() for t in ths]
+    time.sleep(seconds)
+    stop.set()
+    [t.join() for t in ths]
+    wall = time.perf_counter() - t0
+    cpu = time.process_time() - cpu0
+    print(json.dumps({
+        "elapsed_s": wall, "cpu_s": cpu,
+        "lookups": agg["lookups"], "keys": agg["keys"],
+        "busy": agg["busy"], "submit_stalls": agg["submit_stalls"],
+        "errors": agg["errors"][:3],
+        "replicas_seen": sorted(replicas_seen),
+        "lat_sum_ms": stats["sum"], "lat_count": stats["count"],
+        "lat_max_ms": stats["max"],
+        "hist_ok": hist_ok, "hist_all": hist_all}), flush=True)
+    return 0
+
+
+# -- parent: spawn + merge ---------------------------------------------------
+
+
+def run_loadgen(address: str, rows: int, seconds: float = 4.0,
+                procs: int = 4, threads: int = 8,
+                rate: float = None, batch: int = 1,
+                timeout_margin: float = 300.0) -> dict:
+    """Spawn `procs` loadgen worker processes against `address`, merge
+    their fixed-bound histograms, and return the pooled result —
+    including the client-side saturation evidence (per-process CPU
+    fraction, submit stalls)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    total_threads = procs * threads
+    ps = []
+    for i in range(procs):
+        cfg = {"address": address, "rows": rows, "seconds": seconds,
+               "threads": threads, "seed": i, "batch": batch,
+               "rate": rate, "total_threads": total_threads}
+        ps.append(subprocess.Popen(
+            [sys.executable, "-m", "benchmarks.loadgen",
+             "--worker", json.dumps(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=repo))
+    results = []
+    for p in ps:
+        stdout, stderr = p.communicate(timeout=seconds + timeout_margin)
+        lines = [ln for ln in stdout.strip().splitlines() if ln]
+        if p.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"loadgen worker failed rc={p.returncode}: "
+                f"{(stderr or stdout)[-500:]}")
+        results.append(json.loads(lines[-1]))
+    errors = [e for r in results for e in r["errors"]]
+    if errors:
+        raise AssertionError(f"loadgen workers failed: {errors[:3]}")
+
+    window = max(r["elapsed_s"] for r in results)
+    lookups = sum(r["lookups"] for r in results)
+    keys = sum(r["keys"] for r in results)
+    busy = sum(r["busy"] for r in results)
+    stalls = sum(r["submit_stalls"] for r in results)
+    hist_ok = merge_hists([r["hist_ok"] for r in results])
+    hist_all = merge_hists([r["hist_all"] for r in results])
+    lat_count = sum(r["lat_count"] for r in results)
+    lat_sum = sum(r["lat_sum_ms"] for r in results)
+    # client saturation evidence: each worker process is GIL-bound, so
+    # a per-process CPU fraction near 1.0 means the CLIENT is the
+    # ceiling regardless of what the server had left
+    cpu_fracs = [r["cpu_s"] / max(r["elapsed_s"], 1e-9)
+                 for r in results]
+    out = {
+        "mode": "open" if rate else "closed",
+        "procs": procs, "threads_per_proc": threads,
+        "batch": batch, "window_s": round(window, 3),
+        "qps": round(lookups / window, 1),
+        "keys_per_s": round(keys / window, 1),
+        "busy_429": busy,
+        "submit_stalls": stalls,
+        "submit_stall_frac": round(
+            stalls / max(lookups + stalls, 1), 4),
+        "pooled_p50_ms": round(hist_percentile(hist_ok, 50), 4),
+        "pooled_p95_ms": round(hist_percentile(hist_ok, 95), 4),
+        "pooled_p99_ms": round(hist_percentile(hist_ok, 99), 4),
+        "all_p95_ms": round(hist_percentile(hist_all, 95), 4),
+        "mean_ms": round(lat_sum / max(lat_count, 1), 4),
+        "max_ms": round(max((r["lat_max_ms"] for r in results),
+                            default=0.0), 3),
+        "client_cpu_frac_per_proc": [round(f, 3) for f in cpu_fracs],
+        "client_cpu_frac_max": round(max(cpu_fracs), 3),
+        "replicas_seen": sorted(
+            {x for r in results for x in r["replicas_seen"]}),
+    }
+    if rate:
+        out["target_qps"] = rate
+        out["achieved_of_target"] = round(out["qps"] / rate, 3)
+    return out
+
+
+def saturation_verdict(lg: dict, server_stats: dict = None) -> dict:
+    """Name the bottleneck the run actually hit.  `lg` is a
+    run_loadgen() result; `server_stats` a /stats payload (optional).
+    The verdict keeps the evidence — a bench record that says
+    "client-saturated" is a statement about the rig, not the server."""
+    client_pegged = lg["client_cpu_frac_max"] >= 0.85
+    behind = lg.get("submit_stall_frac", 0.0) > 0.05
+    server_busy = lg["busy_429"] > 0
+    loop_lag = None
+    cpu_req = None
+    if server_stats:
+        # /healthz carries event_loop.recent_lag_ms: how far behind
+        # the serving event loop itself is running
+        loop_lag = (server_stats.get("event_loop")
+                    or {}).get("recent_lag_ms")
+        cpu_req = server_stats.get("handler_cpu_ms_per_request")
+    server_lagging = bool(loop_lag and loop_lag > 5.0)
+    # worker-pool queueing: observed latency many multiples of the
+    # server's own CPU per request means requests spent the difference
+    # waiting for a core — the server is the wall even when the accept
+    # loop keeps up (core-starved hosts saturate the pool, not the
+    # loop, and never send a 429)
+    queueing = None
+    if cpu_req and lg.get("pooled_p50_ms"):
+        queueing = lg["pooled_p50_ms"] / max(cpu_req, 1e-6)
+    server_queued = bool(queueing and queueing > 10.0
+                         and not client_pegged)
+    if server_busy or server_lagging or server_queued:
+        verdict = "server"
+    elif client_pegged or behind:
+        verdict = "client"
+    else:
+        verdict = "neither"
+    return {"saturated": verdict,
+            "client_cpu_frac_max": lg["client_cpu_frac_max"],
+            "submit_stall_frac": lg.get("submit_stall_frac", 0.0),
+            "busy_429": lg["busy_429"],
+            "server_loop_lag_ms": loop_lag,
+            "handler_cpu_queueing_x":
+                round(queueing, 1) if queueing else None}
+
+
+def main(argv) -> int:
+    if argv and argv[0] == "--worker":
+        return worker_main(json.loads(argv[1]))
+    if not argv:
+        print("usage: python -m benchmarks.loadgen ADDRESS "
+              "[--rows N] [--seconds S] [--procs P] [--threads T] "
+              "[--rate QPS] [--batch B]", file=sys.stderr)
+        return 2
+    address = argv[0]
+    kw = {"rows": 200_000, "seconds": 4.0, "procs": 4, "threads": 8,
+          "rate": None, "batch": 1}
+    it = iter(argv[1:])
+    for flag in it:
+        name = flag.lstrip("-")
+        if name not in kw:
+            print(f"unknown flag {flag}", file=sys.stderr)
+            return 2
+        val = next(it)
+        kw[name] = float(val) if name in ("seconds", "rate") \
+            else int(val)
+    out = run_loadgen(address, **kw)
+    out["saturation"] = saturation_verdict(out)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

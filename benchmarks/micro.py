@@ -171,6 +171,29 @@ def bench_lookup():
         _emit("lookup_probe", len(keys), probes, unit="probes/s")
 
 
+def bench_probe():
+    """SST probe kernel, native C vs python (PR-18 tentpole): the SAME
+    warm readers and key batch probed through `sst_probe_batch` and
+    again forced onto the python bloom+searchsorted path — the honest
+    per-key cost pair of the serving hot path's innermost loop."""
+    from paimon_tpu.lookup import LocalTableQuery
+    from paimon_tpu.lookup.sst import force_python_probe
+    rows = min(ROWS, 1_000_000)
+    with tempfile.TemporaryDirectory() as tmp:
+        table = _build_table(tmp, "parquet", rows)
+        q = LocalTableQuery(table, cache_dir=os.path.join(tmp, "cache"))
+        rng = np.random.default_rng(3)
+        keys = [{"id": int(k)} for k in rng.integers(0, rows, 10_000)]
+        q.lookup(keys)                           # build + warm SSTs
+        native = _best(lambda: q.lookup(keys))
+        with force_python_probe():
+            python = _best(lambda: q.lookup(keys))
+        ratio = round(python[0] / max(native[0], 1e-12), 2)
+        _emit("probe_native", len(keys), native, unit="probes/s",
+              native_vs_python=ratio)
+        _emit("probe_python", len(keys), python, unit="probes/s")
+
+
 def bench_bitmap():
     """reference bitmap index benchmarks: build + predicate filter."""
     from paimon_tpu.index.bitmap import BitmapIndex
@@ -359,6 +382,7 @@ BENCHES = {
     "read_avro": lambda: bench_read("avro"),
     "write": bench_write,
     "lookup": bench_lookup,
+    "probe": bench_probe,
     "bitmap": bench_bitmap,
     "merge": bench_merge,
     "scan": bench_scan,

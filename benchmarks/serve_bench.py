@@ -165,19 +165,31 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
 
             # engine-level warm probes (no HTTP): the sub-ms LSM
             # point-lookup path itself — batched gets against the
-            # pinned block cache + per-file SSTs
+            # pinned block cache + per-file SSTs, measured through the
+            # NATIVE C probe and again FORCED ONTO the python probe
+            # (same readers, same keys — the r12 tentpole pair)
+            from paimon_tpu.lookup.sst import force_python_probe
             q = server.query()
             probe_keys = [{"id": int(k)}
                           for k in rng.integers(0, rows, 1024)]
             q.lookup(probe_keys)            # warm every touched block
-            reps, t3 = 0, time.perf_counter()
-            while time.perf_counter() - t3 < 0.5:
-                q.lookup(probe_keys)
-                reps += 1
-            per_key_us = (time.perf_counter() - t3) \
-                / (reps * len(probe_keys)) * 1e6
+
+            def _probe_rate():
+                reps, t3 = 0, time.perf_counter()
+                while time.perf_counter() - t3 < 0.5:
+                    q.lookup(probe_keys)
+                    reps += 1
+                return (time.perf_counter() - t3) \
+                    / (reps * len(probe_keys)) * 1e6
+
+            per_key_us = _probe_rate()
+            with force_python_probe():
+                python_us = _probe_rate()
             out["engine_point_us"] = round(per_key_us, 3)
             out["engine_keys_per_s"] = round(1e6 / per_key_us, 1)
+            out["engine_python_point_us"] = round(python_us, 3)
+            out["native_vs_python"] = round(
+                python_us / max(per_key_us, 1e-9), 2)
 
             # sustained mixed load: `clients` threads, ~90% point
             # gets / 10% scans, every request timed client-side.
@@ -280,6 +292,14 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
             out["obs_lookup_p95_ms"] = round(h.percentile(95), 4)
             out["obs_lookup_p99_ms"] = round(h.percentile(99), 4)
             out["obs_lookup_count"] = h.total_count
+            # handler CPU per key (thread_time inside _lookup): the
+            # r12 bar is < 0.2 ms — wall-only numbers hide GIL convoy
+            st = server.stats()
+            cpu_h = st["lookup_cpu_per_key_ms"]
+            out["handler_cpu_per_key_ms_p50"] = cpu_h["p50"]
+            out["handler_cpu_per_key_ms_p95"] = cpu_h["p95"]
+            out["native_probes"] = st["lookup"]["native_probes"]
+            out["native_fallbacks"] = st["lookup"]["native_fallbacks"]
         finally:
             server.stop()
 
@@ -294,7 +314,16 @@ def measure_serving(rows: int = ROWS, clients: int = CLIENTS,
               "warm_vs_cold": out["warm_vs_cold"]})
         emit({"benchmark": "serving_engine_point_lookup",
               "value": out["engine_point_us"], "unit": "us/key",
-              "keys_per_s": out["engine_keys_per_s"], "rows": rows})
+              "keys_per_s": out["engine_keys_per_s"], "rows": rows,
+              "python_us": out["engine_python_point_us"],
+              "native_vs_python": out["native_vs_python"],
+              "native_fallbacks": out["native_fallbacks"]})
+        emit({"benchmark": "serving_handler_cpu_per_key",
+              "value": out["handler_cpu_per_key_ms_p50"],
+              "unit": "ms/key",
+              "p95": out["handler_cpu_per_key_ms_p95"],
+              "native_probes": out["native_probes"],
+              "native_fallbacks": out["native_fallbacks"]})
         emit({"benchmark": "serving_qps",
               "value": out["qps"], "unit": "requests/s",
               "rows": rows, "clients": clients,
@@ -620,6 +649,258 @@ def measure_replicated(rows: int = ROWS, clients: int = CLIENTS,
     return out
 
 
+# -- external loadgen rig (PR 18) --------------------------------------------
+
+
+def measure_serving_external(rows: int = ROWS, seconds: float = SECONDS,
+                             replicas: int = REPLICAS,
+                             procs: int = CLIENT_PROCS,
+                             threads: int = 8, emit=_emit) -> dict:
+    """The r12 true-ceiling rig: replica SUBPROCESSES behind a router,
+    load from benchmarks/loadgen.py worker PROCESSES (own connections,
+    mergeable histograms, client-CPU accounting).  Closed-loop first
+    for the ceiling, then open-loop at ~70% of it for honest latency,
+    and a saturation verdict naming which side the run actually hit —
+    a bench record that maxed the CLIENT says so instead of publishing
+    a flattering server number."""
+    import urllib.request
+
+    from benchmarks.loadgen import run_loadgen, saturation_verdict
+    from paimon_tpu.service import KvQueryClient
+    from paimon_tpu.service.router import ReplicaRouter
+
+    out = {"rows": rows, "replicas": replicas,
+           "loadgen_procs": procs, "loadgen_threads": threads,
+           "host_cpus": os.cpu_count()}
+    with tempfile.TemporaryDirectory() as tmp:
+        table = build_serving_table(os.path.join(tmp, "t"), rows)
+        oracle_t = table.to_arrow().sort_by("id")
+        oracle = {i: (v, n) for i, v, n in zip(
+            oracle_t.column("id").to_pylist(),
+            oracle_t.column("v").to_pylist(),
+            oracle_t.column("name").to_pylist())}
+        procs_r, addrs = _spawn_replicas(table.path, replicas)
+        router = None
+        try:
+            router = ReplicaRouter(addresses=addrs,
+                                   table_name="t").start()
+            rng = np.random.default_rng(5)
+            warm_keys = [{"id": int(k)}
+                         for k in rng.integers(0, rows, 2048)]
+            for addr in addrs.values():
+                with KvQueryClient(address=addr,
+                                   follow_topology=False) as warm:
+                    for i in range(0, len(warm_keys), 256):
+                        warm.lookup(warm_keys[i:i + 256])
+
+            # batch=8 matches the r07/r09 sustained-workload request
+            # shape, so the qps series stays comparable across rounds
+            closed = run_loadgen(router.address, rows,
+                                 seconds=seconds, procs=procs,
+                                 threads=threads, batch=8)
+            # 70% of the measured ceiling, NO absolute floor: a floor
+            # above the host's ceiling would run the open loop
+            # over-saturated and publish a latency that measures queue
+            # explosion, not the service
+            target = max(20.0, closed["qps"] * 0.7)
+            openl = run_loadgen(router.address, rows,
+                                seconds=seconds, procs=procs,
+                                threads=threads, rate=target,
+                                batch=8)
+
+            # handler CPU per key + native-probe health, pooled
+            cpu_windows = []
+            native_probes = native_fallbacks = 0
+            for addr in addrs.values():
+                st = _replica_stats(addr)
+                cpu_windows.extend(
+                    st["lookup_cpu_per_key_ms"]["window"])
+                native_probes += st["lookup"]["native_probes"]
+                native_fallbacks += st["lookup"]["native_fallbacks"]
+            cpu_windows.sort()
+
+            def pct(vals, p):
+                if not vals:
+                    return 0.0
+                return vals[min(len(vals) - 1,
+                                int(p / 100 * len(vals)))]
+
+            # saturation evidence: the worst event-loop lag across the
+            # fleet, plus the fleet's own CPU-per-request so the
+            # verdict can name worker-pool queueing (a core-starved
+            # host saturates the pool without ever lagging the loop)
+            lag = 0.0
+            for addr in addrs.values():
+                with urllib.request.urlopen(addr + "/healthz",
+                                            timeout=10) as r:
+                    h = json.loads(r.read())
+                lag = max(lag, (h.get("event_loop")
+                                or {}).get("recent_lag_ms") or 0.0)
+            verdict = saturation_verdict(closed, {
+                "event_loop": {"recent_lag_ms": lag},
+                "handler_cpu_ms_per_request": pct(cpu_windows, 50) * 8,
+            })
+
+            # sampled row identity vs the merged-scan oracle, through
+            # the router (and therefore across replicas)
+            checked = 0
+            for tenant_i in range(8):
+                with KvQueryClient(address=router.address,
+                                   tenant=f"check-{tenant_i}") as c:
+                    ids = [int(k) for k in rng.integers(0, rows, 32)]
+                    got = c.lookup([{"id": i} for i in ids])
+                    for i, row in zip(ids, got):
+                        exp = oracle.get(i)
+                        if exp is None:
+                            assert row is None, (i, row)
+                        else:
+                            assert row is not None and \
+                                (row["v"], row["name"]) == exp, \
+                                (i, row, exp)
+                            checked += 1
+            out.update({
+                "closed": closed, "open": openl,
+                "qps": closed["qps"],
+                "pooled_p95_ms": openl["pooled_p95_ms"],
+                "saturation": verdict,
+                "handler_cpu_per_key_ms_p50": round(
+                    pct(cpu_windows, 50), 4),
+                "handler_cpu_per_key_ms_p95": round(
+                    pct(cpu_windows, 95), 4),
+                "native_probes": native_probes,
+                "native_fallbacks": native_fallbacks,
+                "oracle_rows_checked": checked,
+            })
+        finally:
+            if router is not None:
+                router.stop()
+            _stop_replicas(procs_r)
+    if emit is not None:
+        emit({"benchmark": "serving_external_qps",
+              "value": out["qps"], "unit": "requests/s",
+              "rows": rows, "replicas": replicas,
+              "loadgen_procs": procs,
+              "loadgen_threads_per_proc": threads,
+              "busy_429": out["closed"]["busy_429"],
+              "saturation": out["saturation"],
+              "replicas_seen": out["closed"]["replicas_seen"]})
+        emit({"benchmark": "serving_external_open_loop_p95_ms",
+              "value": out["pooled_p95_ms"], "unit": "ms",
+              "target_qps": out["open"].get("target_qps"),
+              "achieved_of_target":
+                  out["open"].get("achieved_of_target"),
+              "submit_stall_frac": out["open"]["submit_stall_frac"],
+              "p50": out["open"]["pooled_p50_ms"],
+              "p99": out["open"]["pooled_p99_ms"],
+              "oracle_rows_checked": out["oracle_rows_checked"]})
+        emit({"benchmark": "serving_external_handler_cpu_per_key",
+              "value": out["handler_cpu_per_key_ms_p50"],
+              "unit": "ms/key",
+              "p95": out["handler_cpu_per_key_ms_p95"],
+              "native_probes": out["native_probes"],
+              "native_fallbacks": out["native_fallbacks"]})
+    return out
+
+
+# -- warm-boot rig (PR 18) ----------------------------------------------------
+
+
+def warmboot_child_main(table_path: str, opts_json: str) -> int:
+    """`--warmboot-child` mode: ONE fresh serving process.  Times
+    boot-to-first-answer (server construction through the first
+    /lookup batch answered), then prints the process-global lookup
+    counters — `reader_builds == 0` in a warm child is the proof that
+    every SST was adopted, none rebuilt."""
+    pa.set_cpu_count(2)
+    from paimon_tpu.service import KvQueryServer
+    from paimon_tpu.table import FileStoreTable
+
+    dyn = json.loads(opts_json)
+    keys = dyn.pop("__keys")
+    do_persist = dyn.pop("__persist", False)
+    table = FileStoreTable.load(table_path, dynamic_options=dyn)
+    t0 = time.perf_counter()
+    server = KvQueryServer(table)
+    q = server.query()
+    rows_out = q.lookup([{"id": int(k)} for k in keys[:8]])
+    boot_ms = (time.perf_counter() - t0) * 1000.0
+    # touch the rest of the keyspace so EVERY bucket's SST exists
+    # before a persist (the seed child) / so the counters reflect a
+    # real serving window (cold+warm children)
+    q.lookup([{"id": int(k)} for k in keys])
+    if do_persist:
+        server.persist_warm_state()
+    st = server.stats()
+    print(json.dumps({
+        "boot_to_first_answer_ms": round(boot_ms, 3),
+        "first_batch_rows": sum(r is not None for r in rows_out),
+        "reader_builds": st["lookup"]["reader_builds"],
+        "native_probes": st["lookup"]["native_probes"],
+        "native_fallbacks": st["lookup"]["native_fallbacks"],
+        "warm_restore": st["warm_restore"]}), flush=True)
+    server.shutdown()
+    return 0
+
+
+def _run_warmboot_child(table_path: str, dyn: dict) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "benchmarks.serve_bench",
+         "--warmboot-child", table_path, json.dumps(dyn)],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if p.returncode != 0:
+        raise RuntimeError(f"warmboot child failed: {p.stderr[-500:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def measure_warmboot(rows: int = ROWS, emit=_emit) -> dict:
+    """Cold vs warm boot-to-first-answer, in separate PROCESSES so the
+    process-global `reader_builds` counter is the per-boot truth:
+    a seed process builds + persists serving state onto the shared SSD
+    tier, a cold process boots with warm boot off, a warm process
+    boots from the persisted state — `reader_builds == 0` required."""
+    out = {"rows": rows}
+    with tempfile.TemporaryDirectory() as tmp:
+        table = build_serving_table(os.path.join(tmp, "t"), rows)
+        disk = os.path.join(tmp, "ssd")
+        rng = np.random.default_rng(7)
+        keys = [int(k) for k in rng.integers(0, rows, 512)]
+        base = {"service.lookup.refresh-interval": "1000",
+                "cache.disk.dir": disk, "__keys": keys}
+        # seed: build every bucket's SST, persist onto the SSD tier
+        seed = _run_warmboot_child(
+            table.path, base | {"service.warmboot.enabled": "true",
+                                "__persist": True})
+        # cold: fresh process, no warm boot
+        cold = _run_warmboot_child(table.path, dict(base))
+        # warm: fresh process, adopts the persisted SSTs + plan state
+        warm = _run_warmboot_child(
+            table.path, base | {"service.warmboot.enabled": "true"})
+        assert warm["reader_builds"] == 0, warm
+        assert warm["first_batch_rows"] == cold["first_batch_rows"]
+        out.update({
+            "seed_reader_builds": seed["reader_builds"],
+            "cold_boot_ms": cold["boot_to_first_answer_ms"],
+            "warm_boot_ms": warm["boot_to_first_answer_ms"],
+            "cold_vs_warm": round(
+                cold["boot_to_first_answer_ms"]
+                / max(warm["boot_to_first_answer_ms"], 1e-6), 2),
+            "warm_reader_builds": warm["reader_builds"],
+            "cold_reader_builds": cold["reader_builds"],
+            "warm_restore": warm["warm_restore"],
+        })
+    if emit is not None:
+        emit({"benchmark": "serving_warmboot_boot_ms",
+              "value": out["warm_boot_ms"], "unit": "ms",
+              "cold_boot_ms": out["cold_boot_ms"],
+              "cold_vs_warm": out["cold_vs_warm"],
+              "warm_reader_builds": out["warm_reader_builds"],
+              "cold_reader_builds": out["cold_reader_builds"],
+              "warm_restore": out["warm_restore"]})
+    return out
+
+
 def main(argv):
     if argv and argv[0] == "--replica-serve":
         return replica_child_main(argv[1], int(argv[2]))
@@ -627,9 +908,13 @@ def main(argv):
         return client_child_main(argv[1], float(argv[2]),
                                  int(argv[3]), int(argv[4]),
                                  int(argv[5]))
+    if argv and argv[0] == "--warmboot-child":
+        return warmboot_child_main(argv[1], argv[2])
     measure_serving()
     if REPLICAS > 1:
         measure_replicated()
+        measure_serving_external()
+    measure_warmboot()
     return 0
 
 

@@ -96,7 +96,11 @@ class KeyHasher:
 
     def hashes(self, table: pa.Table) -> np.ndarray:
         """uint64[N] murmur hashes (low 32 bits significant)."""
-        if self._fixed_width:
+        # the numpy path's fixed setup (byte matrix + casts) costs more
+        # than row-at-a-time hashing below ~10 rows — point-lookup
+        # batches take the scalar codec path, ingest batches the
+        # vectorized one; both produce identical reference hashes
+        if self._fixed_width and table.num_rows > 8:
             return self._hash_vectorized(table)
         return self._hash_rows(table)
 

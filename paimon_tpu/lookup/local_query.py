@@ -54,7 +54,7 @@ from paimon_tpu.core.bucket import FixedBucketAssigner
 from paimon_tpu.core.read import MergeFileSplitRead, assemble_runs
 from paimon_tpu.data.binary_row import BinaryRowCodec
 from paimon_tpu.lookup.sst import (
-    BlockCache, LookupStore, SstReader, pack_lanes,
+    BlockCache, LookupStore, SstReader, _key_hashes, pack_lanes,
 )
 from paimon_tpu.ops.merge import KIND_COL, SEQ_COL
 from paimon_tpu.ops.normkey import NormalizedKeyEncoder
@@ -105,7 +105,9 @@ class LocalTableQuery:
             cache_dir or tempfile.mkdtemp(prefix="paimon-lookup-"),
             max_disk_bytes=table.options.get(
                 CoreOptions.LOOKUP_CACHE_MAX_DISK_SIZE),
-            block_cache=self.block_cache)
+            block_cache=self.block_cache,
+            native_probe=bool(table.options.get(
+                CoreOptions.SERVICE_PROBE_NATIVE)))
         # snapshot-refresh TTL: within it, lookups never touch the
         # snapshot hint or manifest chain (service.lookup.refresh-
         # interval on the serving plane; 0 = check every call)
@@ -417,8 +419,10 @@ class LocalTableQuery:
                 self._building.pop(key, None)
             ev.set()
 
-    def _probe(self, key: str, load,
-               lanes: np.ndarray) -> Tuple[np.ndarray, pa.Table]:
+    def _probe(self, key: str, load, lanes: np.ndarray,
+               packed: Optional[np.ndarray] = None,
+               hashes: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, pa.Table]:
         """Build-or-reuse + probe, tolerating a concurrent refresh
         evicting the SST file between get and probe (the local file
         vanishes -> OSError): drop the dead entry and rebuild once."""
@@ -427,7 +431,7 @@ class LocalTableQuery:
             if reader is None or reader.num_rows == 0:
                 return np.zeros(0, np.int64), None
             try:
-                return reader.probe(lanes)
+                return reader.probe(lanes, packed, hashes)
             except OSError as e:
                 # route the retry decision through the fault taxonomy:
                 # a deterministic decode error must surface, only the
@@ -495,17 +499,29 @@ class LocalTableQuery:
                 self._m_delta_hits.inc(hits)
             if in_delta.all():
                 return out
-        for b in np.unique(buckets):
-            split = splits.get((pkey, int(b)))
+        # encode + pack + hash the WHOLE batch once; every probe below
+        # slices these arrays (numpy views) instead of re-running the
+        # arrow take / lane encode / splitmix fold per (bucket, run) —
+        # at serving batch sizes that ceremony dominated the handler
+        by_bucket: Dict[int, List[int]] = {}
+        delta_flags = in_delta.tolist()
+        for i, b in enumerate(buckets.tolist()):
+            if not delta_flags[i]:
+                by_bucket.setdefault(b, []).append(i)
+        enc = None
+        for b, idxs in by_bucket.items():
+            split = splits.get((pkey, b))
             if split is None:
                 continue         # empty bucket: all misses
-            sel = np.flatnonzero((buckets == b) & ~in_delta)
-            if not len(sel):
-                continue         # whole bucket answered by the delta
+            sel = np.array(idxs, dtype=np.int64)
+            if enc is None:
+                lanes_all = self._encode_lanes(query)
+                packed_all = pack_lanes(lanes_all)
+                enc = (lanes_all, packed_all, _key_hashes(packed_all))
             if self._fast_path_ok(split):
-                self._lookup_runs(pkey, split, query, sel, keys, out)
+                self._lookup_runs(pkey, split, enc, sel, keys, out)
             else:
-                self._lookup_merged(pkey, split, snap, query, sel,
+                self._lookup_merged(pkey, split, snap, enc, sel,
                                     keys, out)
         return out
 
@@ -514,16 +530,16 @@ class LocalTableQuery:
         # the full key before accepting the hit
         return all(row.get(k) == q[k] for k in self.pk)
 
-    def _lookup_merged(self, pkey: str, split, snap, query: pa.Table,
+    def _lookup_merged(self, pkey: str, split, snap, enc,
                        sel: np.ndarray, keys, out):
         """Merged-bucket fallback: the split's full merge-on-read
         result spilled as one SST (rows are final table rows — no
         kind/seq columns survive the merge)."""
         key = self._bucket_store_key(pkey, split, snap)
-        sub = query.take(pa.array(sel))
+        _, packed_all, hashes_all = enc
         hit_pos, rows = self._probe(
             key, lambda: self._read.read_split(split),
-            self._encode_lanes(sub))
+            None, packed_all[sel], hashes_all[sel])
         if rows is None:
             return
         for qi, row in zip(hit_pos, rows.to_pylist()):
@@ -531,29 +547,32 @@ class LocalTableQuery:
             if self._confirm(row, q):
                 out[int(sel[qi])] = row
 
-    def _lookup_runs(self, pkey: str, split, query: pa.Table,
+    def _lookup_runs(self, pkey: str, split, enc,
                      sel: np.ndarray, keys, out):
         """LSM point get: walk the bucket's sorted runs newest-first,
         prune files by manifest key-range stats before any IO, probe
         per-file SSTs (bloom + block binary search), stop at the first
         hit or tombstone per key."""
-        sub = query.take(pa.array(sel))
-        lanes = self._encode_lanes(sub)
+        _, packed_all, hashes_all = enc
+        packed = packed_all[sel]
+        hashes = hashes_all[sel]
         key_tuples = [tuple(d[k] for k in self.pk)
                       for d in (keys[int(i)] for i in sel)]
         pending = list(range(len(sel)))
         runs = assemble_runs(split.data_files)
+        pruned = 0
         for run in reversed(runs):          # newest run first
             if not pending:
                 break
             by_file: Dict[str, Tuple[object, List[int]]] = {}
+            ranges = [(meta, self._file_range(meta)) for meta in run]
             for pos in pending:
                 kt = key_tuples[pos]
-                for meta in run:
-                    if self._in_range(kt, self._file_range(meta)):
+                for meta, rng in ranges:
+                    if self._in_range(kt, rng):
                         by_file.setdefault(
                             meta.file_name, (meta, []))[1].append(pos)
-            self._m_pruned.inc(len(run) - len(by_file))
+            pruned += len(run) - len(by_file)
             resolved: Dict[int, Optional[dict]] = {}
             for fname in sorted(by_file):
                 meta, poss = by_file[fname]
@@ -561,10 +580,15 @@ class LocalTableQuery:
                 if not poss:
                     continue
                 key = self._file_store_key(pkey, split.bucket, meta)
+                if len(poss) == len(sel):
+                    qp, qh = packed, hashes
+                else:
+                    idx = np.array(poss)
+                    qp, qh = packed[idx], hashes[idx]
                 hit_pos, rows = self._probe(
                     key,
                     lambda m=meta: self._file_reader_load(split, m),
-                    lanes[np.array(poss)])
+                    None, qp, qh)
                 if rows is None:
                     continue
                 # highest sequence number wins within one file (a file
@@ -588,6 +612,8 @@ class LocalTableQuery:
             for pos, row in resolved.items():
                 out[int(sel[pos])] = row
             pending = [p for p in pending if p not in resolved]
+        if pruned:
+            self._m_pruned.inc(pruned)
 
     def lookup_row(self, key: dict, partition: Tuple = ()
                    ) -> Optional[dict]:

@@ -18,9 +18,19 @@ File layout:
     "PTSST1"
     block 0: zstd Arrow IPC (lane columns + row columns), key-sorted
     block 1: ...
+    keys section: zstd of the packed keys, one flat sorted
+        uint8[num_rows * key_width] buffer — the native probe's
+        contiguous search array, laid out once at build time
     footer (zstd JSON): per-block {offset, size, rows, first_key(b64)},
-        bloom filter (b64) over splitmix64 of the packed keys, num_rows
+        bloom filter (b64) over splitmix64 of the packed keys, num_rows,
+        keys {offset, size, raw}
     u32 footer_len, "PTSST1"
+
+Probes take the native path by default (native/probe.c
+`sst_probe_batch`: bloom + binary search over the flat key buffer, one
+C call per batch with the GIL released); when the shared object is
+unavailable or predates the probe symbols, the probe silently degrades
+to the vectorized numpy walk and counts a `lookup.native_fallbacks`.
 
 Both caches are bounded: the in-RAM block cache globally by bytes
 (lookup.cache-max-memory-size), the on-disk store per table by
@@ -30,9 +40,11 @@ lookup.cache-max-disk-size with LRU file eviction.
 from __future__ import annotations
 
 import base64
+import contextlib
 import io
 import json
 import os
+import shutil
 import struct
 import threading
 from collections import OrderedDict
@@ -44,7 +56,7 @@ import pyarrow as pa
 from paimon_tpu.index.bloom import BloomFilter, _splitmix64
 
 __all__ = ["SstWriter", "SstReader", "BlockCache", "LookupStore",
-           "pack_lanes"]
+           "pack_lanes", "force_python_probe"]
 
 _MAGIC = b"PTSST1"
 DEFAULT_BLOCK_ROWS = 4096
@@ -120,10 +132,20 @@ class SstWriter:
                 break
         bloom = BloomFilter.build(_key_hashes(packed), self.bloom_fpp) \
             if n else None
+        # flat sorted key buffer: the native probe's contiguous search
+        # array, written once here so probes never re-pack block lanes
+        raw_keys = packed.tobytes()
+        keys_off = out.tell()
+        comp_keys = pa.Codec("zstd").compress(raw_keys)
+        if isinstance(comp_keys, pa.Buffer):
+            comp_keys = comp_keys.to_pybytes()
+        out.write(comp_keys)
         footer = {
             "num_rows": n, "num_lanes": num_lanes,
             "key_width": 4 * num_lanes,
             "blocks": blocks,
+            "keys": {"offset": keys_off, "size": len(comp_keys),
+                     "raw": len(raw_keys)},
             "bloom": base64.b64encode(bloom.serialize()).decode()
             if bloom else None,
         }
@@ -154,8 +176,31 @@ def _block_counters():
         _COUNTERS = {
             "hits": group.counter(m.LOOKUP_BLOCK_CACHE_HITS),
             "misses": group.counter(m.LOOKUP_BLOCK_CACHE_MISSES),
+            "native": group.counter(m.LOOKUP_NATIVE_PROBES),
+            "fallbacks": group.counter(m.LOOKUP_NATIVE_FALLBACKS),
         }
     return _COUNTERS
+
+
+# bench/test override: force the numpy probe even when the native
+# library is loaded (the native-vs-python comparisons need both paths
+# over the SAME readers)
+_FORCE_PYTHON_PROBE = False
+
+# paimon_tpu.native, resolved once on first probe (a sys.modules
+# lookup per probe is measurable at serving batch sizes)
+_native_mod = None
+
+
+@contextlib.contextmanager
+def force_python_probe():
+    global _FORCE_PYTHON_PROBE
+    prev = _FORCE_PYTHON_PROBE
+    _FORCE_PYTHON_PROBE = True
+    try:
+        yield
+    finally:
+        _FORCE_PYTHON_PROBE = prev
 
 
 class BlockCache:
@@ -203,9 +248,11 @@ _GLOBAL_BLOCK_CACHE = BlockCache()
 
 class SstReader:
     def __init__(self, path: str,
-                 block_cache: Optional[BlockCache] = None):
+                 block_cache: Optional[BlockCache] = None,
+                 native_probe: bool = True):
         self.path = path
         self.cache = block_cache or _GLOBAL_BLOCK_CACHE
+        self.native_probe = native_probe
         with open(path, "rb") as f:
             f.seek(0, os.SEEK_END)
             size = f.tell()
@@ -231,10 +278,62 @@ class SstReader:
         self._bloom = BloomFilter.deserialize(
             base64.b64decode(self.footer["bloom"])) \
             if self.footer.get("bloom") else None
+        # global row index -> block: starts[i] is block i's first row
+        rows = [b["rows"] for b in self.footer["blocks"]]
+        self._row_starts = np.concatenate(
+            [np.zeros(1, np.int64),
+             np.cumsum(rows, dtype=np.int64)]) \
+            if rows else np.zeros(1, np.int64)
+        self._lane_cols = [f"__lane{i}" for i in
+                           range(self.footer["num_lanes"])]
+        # raw-pointer native probe context (native.sst_probe_prepare),
+        # resolved lazily once; False = native probe unavailable
+        self._native_prep = None
+        # flat sorted key buffer (PINNED once loaded, like the bloom
+        # and first-keys index): lazy — the python path never needs it
+        self._flat: Optional[np.ndarray] = None
+        self._flat_lock = threading.Lock()
 
     @property
     def file_size(self) -> int:
         return self._file_size
+
+    def _flat_keys(self) -> np.ndarray:
+        """The contiguous uint8[num_rows * key_width] sorted key buffer
+        the native probe searches; read from the keys section, or (for
+        files written before the section existed, e.g. a warm-boot
+        restore from an older build) materialized once from the
+        blocks."""
+        f = self._flat
+        if f is not None:
+            return f
+        with self._flat_lock:
+            if self._flat is None:
+                ks = self.footer.get("keys")
+                if ks is not None:
+                    if ks["raw"] == 0:
+                        buf = b""
+                    else:
+                        with open(self.path, "rb") as fh:
+                            fh.seek(ks["offset"])
+                            blob = fh.read(ks["size"])
+                        buf = pa.Codec("zstd").decompress(
+                            blob, decompressed_size=ks["raw"])
+                        if isinstance(buf, pa.Buffer):
+                            buf = buf.to_pybytes()
+                else:
+                    nl = self.footer["num_lanes"]
+                    parts = []
+                    for i in range(len(self.footer["blocks"])):
+                        t = self._block(i)
+                        lanes = np.stack(
+                            [np.asarray(t.column(f"__lane{j}"))
+                             for j in range(nl)],
+                            axis=1).astype(np.uint32)
+                        parts.append(pack_lanes(lanes).tobytes())
+                    buf = b"".join(parts)
+                self._flat = np.frombuffer(buf, dtype=np.uint8)
+        return self._flat
 
     def _block(self, i: int) -> pa.Table:
         key = (self.path, i)
@@ -249,17 +348,148 @@ class SstReader:
             self.cache.put(key, t)
         return t
 
-    def probe(self, lanes: np.ndarray) -> Tuple[np.ndarray, pa.Table]:
+    def probe(self, lanes: Optional[np.ndarray],
+              packed: Optional[np.ndarray] = None,
+              hashes: Optional[np.ndarray] = None
+              ) -> Tuple[np.ndarray, pa.Table]:
         """Batch probe: query lanes uint32[M, L] ->
         (hit_query_positions int64[H], matched rows pa.Table[H] minus
-        lane columns, aligned with the positions)."""
-        m = lanes.shape[0]
+        lane columns, aligned with the positions).
+
+        `packed`/`hashes` let the caller pack and hash the query ONCE
+        per lookup batch and slice per (bucket, run) — at batch sizes
+        of a few keys the per-probe pack/hash ceremony used to rival
+        the probe itself.
+
+        Native by default: one `sst_probe_batch` C call resolves the
+        whole batch (bloom + flat-key binary search, GIL released);
+        only the few hit rows are then gathered from cached blocks.
+        Unavailable native (no compiler, PAIMON_DISABLE_NATIVE, or a
+        stale `.so` without the probe symbols) silently degrades to
+        the numpy path and counts a `lookup.native_fallbacks`.
+
+        When `packed` is supplied, `lanes` may be None — both probe
+        flavors work off the packed big-endian keys alone."""
+        if packed is None:
+            packed = pack_lanes(lanes)
+        m = packed.shape[0]
         if m == 0 or self.num_rows == 0:
             return np.zeros(0, np.int64), None
-        packed = pack_lanes(lanes)
+        if self.native_probe and not _FORCE_PYTHON_PROBE:
+            res = self._probe_native(packed, hashes)
+            if res is not None:
+                _block_counters()["native"].inc()
+                return res
+            _block_counters()["fallbacks"].inc()
+        return self._probe_python(packed, hashes)
+
+    def _probe_native(self, packed: np.ndarray,
+                      hashes: Optional[np.ndarray] = None
+                      ) -> Optional[Tuple[np.ndarray, pa.Table]]:
+        global _native_mod
+        native = _native_mod
+        if native is None:
+            from paimon_tpu import native as _nm
+            native = _native_mod = _nm
+        kw = packed.dtype.itemsize
+        if hashes is None:
+            hashes = _key_hashes(packed)
+        if packed.flags.c_contiguous:
+            qkeys = packed.view(np.uint8)    # zero-copy byte view
+        else:
+            qkeys = np.frombuffer(packed.tobytes(), dtype=np.uint8)
+        prep = self._native_prep
+        if prep is None:
+            prep = native.sst_probe_prepare(
+                self._flat_keys(), self.num_rows, kw,
+                self._bloom.bits if self._bloom is not None else None,
+                self._bloom.k if self._bloom is not None else 0)
+            self._native_prep = prep if prep is not None else False
+        if prep:
+            res = native.sst_probe_prepared(prep, qkeys, hashes)
+        else:
+            res = native.sst_probe(
+                self._flat_keys(), self.num_rows, kw,
+                self._bloom.bits if self._bloom is not None else None,
+                self._bloom.k if self._bloom is not None else 0,
+                qkeys, hashes)
+        if res is None:
+            return None
+        lo, hi = res
+        hit_q = (hi > lo).nonzero()[0]
+        if len(hit_q) == 0:
+            return np.zeros(0, np.int64), None
+        starts = self._row_starts
+        if len(hit_q) <= 2:
+            # scalar gather for the 1-2 hit case — the serving norm
+            # is ONE key per (bucket, run) probe, where the vectorized
+            # argsort/unique ceremony below costs more than the C
+            # probe itself
+            parts = []
+            for qi in hit_q:
+                s, e = int(lo[qi]), int(hi[qi])
+                b = int(np.searchsorted(starts, s, side="right")) - 1
+                if e - s != 1 or e > int(starts[b + 1]):
+                    parts = None
+                    break          # equal-key run / block spanner
+                parts.append(
+                    self._block(b).slice(s - int(starts[b]), 1))
+            if parts is not None:
+                out = parts[0] if len(parts) == 1 else \
+                    pa.concat_tables(parts, promote_options="none")
+                return (hit_q.astype(np.int64),
+                        out.drop_columns(self._lane_cols))
+        lo_h = lo[hit_q]
+        hi_h = hi[hit_q]
+        # block of each hit's first and last row, vectorized: the
+        # common case (single-row hit inside one block) gathers with
+        # ONE `take` per touched block — per-hit python slicing here
+        # used to cost more than the whole C probe
+        b_lo = np.searchsorted(starts, lo_h, side="right") - 1
+        b_last = np.searchsorted(starts, hi_h - 1, side="right") - 1
+        fast = (hi_h - lo_h == 1) & (b_lo == b_last)
+        hits_parts: List[np.ndarray] = []
+        rows: List[pa.Table] = []
+        if fast.any():
+            qf, rf, bf = hit_q[fast], lo_h[fast], b_lo[fast]
+            order = np.argsort(bf, kind="stable")
+            qf, rf, bf = qf[order], rf[order], bf[order]
+            blocks, cuts = np.unique(bf, return_index=True)
+            for g, b in enumerate(blocks):
+                s = cuts[g]
+                e = cuts[g + 1] if g + 1 < len(blocks) else len(bf)
+                t = self._block(int(b))
+                if e - s <= 4:
+                    # zero-copy slices beat a gather kernel for a
+                    # handful of rows (the serving batch case)
+                    for r in rf[s:e] - int(starts[b]):
+                        rows.append(t.slice(int(r), 1))
+                else:
+                    rows.append(t.take(rf[s:e] - int(starts[b])))
+                hits_parts.append(qf[s:e])
+        for qi in hit_q[~fast]:    # equal-key runs / block-spanners
+            s, e = int(lo[qi]), int(hi[qi])
+            b = int(np.searchsorted(starts, s, side="right")) - 1
+            while s < e:
+                take = min(e, int(starts[b + 1])) - s
+                t = self._block(b)
+                rows.append(t.slice(s - int(starts[b]), take))
+                hits_parts.append(np.full(take, qi, np.int64))
+                s += take
+                b += 1
+        out = pa.concat_tables(rows, promote_options="none")
+        drop = self._lane_cols
+        return (np.concatenate(hits_parts).astype(np.int64),
+                out.drop_columns(drop))
+
+    def _probe_python(self, packed: np.ndarray,
+                      hashes: Optional[np.ndarray] = None
+                      ) -> Tuple[np.ndarray, pa.Table]:
+        m = len(packed)
         cand = np.arange(m)
         if self._bloom is not None:
-            keep = self._bloom.might_contain_many(_key_hashes(packed))
+            keep = self._bloom.might_contain_many(
+                _key_hashes(packed) if hashes is None else hashes)
             cand = cand[keep]
             if len(cand) == 0:
                 return np.zeros(0, np.int64), None
@@ -305,7 +535,7 @@ class SstReader:
         if not hits:
             return np.zeros(0, np.int64), None
         out = pa.concat_tables(rows, promote_options="none")
-        drop = [c for c in out.column_names if c.startswith("__lane")]
+        drop = self._lane_cols
         return (np.array(hits, dtype=np.int64), out.drop_columns(drop))
 
 
@@ -323,10 +553,12 @@ class LookupStore:
 
     def __init__(self, directory: str,
                  max_disk_bytes: int = 10 << 30,
-                 block_cache: Optional[BlockCache] = None):
+                 block_cache: Optional[BlockCache] = None,
+                 native_probe: bool = True):
         self.dir = directory
         self.max_disk = max_disk_bytes
         self.block_cache = block_cache or _GLOBAL_BLOCK_CACHE
+        self.native_probe = native_probe
         os.makedirs(directory, exist_ok=True)
         # the store is a CACHE: files from a previous process can never
         # be trusted (snapshot may have moved) and would escape the
@@ -375,7 +607,33 @@ class LookupStore:
         path = os.path.join(self.dir,
                             f"{digest}-{uuid.uuid4().hex[:8]}.sst")
         (writer or SstWriter()).write(path, lanes, table)
-        reader = SstReader(path, self.block_cache)
+        reader = SstReader(path, self.block_cache,
+                           native_probe=self.native_probe)
+        return self._publish(key, reader)
+
+    def adopt(self, key: str, src_path: str) -> SstReader:
+        """Register an already-built SST file under `key` (the warm-
+        boot restore path: the file was persisted through the shared
+        SSD tier by another process).  The file is hard-linked — or
+        copied across filesystems — into the store dir under the usual
+        naming, so eviction and the disk budget treat it exactly like
+        a locally built SST.  No reader build is counted: that is the
+        point of warm boot."""
+        import hashlib
+        import uuid
+        digest = hashlib.sha1(key.encode("utf-8")).hexdigest()[:24]
+        path = os.path.join(self.dir,
+                            f"{digest}-{uuid.uuid4().hex[:8]}.sst")
+        try:
+            os.link(src_path, path)
+        except OSError:
+            shutil.copyfile(src_path, path)
+        reader = SstReader(path, self.block_cache,
+                           native_probe=self.native_probe)
+        return self._publish(key, reader)
+
+    def _publish(self, key: str, reader: SstReader) -> SstReader:
+        path = reader.path
         with self._lock:
             if self._closed:
                 # a build racing close(): publishing would leak a

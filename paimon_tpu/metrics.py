@@ -40,15 +40,18 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "SERVICE_TENANT_BYTES", "SERVICE_ADMISSION_WAIT_MS",
            "SERVICE_LOOKUP_MS", "SERVICE_SCAN_MS",
            "SERVICE_CHANGELOG_MS", "SERVICE_LOOKUP_KEYS",
+           "SERVICE_LOOKUP_CPU_MS",
            "SERVICE_LOOP_LAG_MS", "SERVICE_CONNECTIONS",
            "SERVICE_DELTA_ROWS", "SERVICE_DELTA_BYTES",
            "SERVICE_DELTA_OVERFLOWS", "SERVICE_ROUTER_FORWARDED",
            "SERVICE_ROUTER_UPSTREAM_ERRORS",
+           "SERVICE_ROUTER_RING_CHANGES",
            "SERVICE_SCAN_CACHE_HITS", "SERVICE_SCAN_CACHE_MISSES",
            "LOOKUP_BLOCK_CACHE_HITS", "LOOKUP_BLOCK_CACHE_MISSES",
            "LOOKUP_READER_BUILDS", "LOOKUP_READER_REUSES",
            "LOOKUP_FILES_PRUNED", "LOOKUP_SNAPSHOT_REFRESHES",
-           "LOOKUP_DELTA_HITS",
+           "LOOKUP_DELTA_HITS", "LOOKUP_NATIVE_PROBES",
+           "LOOKUP_NATIVE_FALLBACKS",
            "CACHE_DISK_HITS", "CACHE_DISK_MISSES",
            "CACHE_DISK_PROMOTIONS", "CACHE_DISK_DEMOTIONS",
            "CACHE_DISK_EVICTIONS", "CACHE_DISK_BYTES",
@@ -153,6 +156,11 @@ SERVICE_LOOKUP_MS = "lookup_ms"               # whole /lookup request
 SERVICE_SCAN_MS = "scan_ms"                   # whole /scan request
 SERVICE_CHANGELOG_MS = "changelog_ms"         # whole /changelog poll
 SERVICE_LOOKUP_KEYS = "lookup_keys"           # point-get keys served
+# per-key handler CPU (thread_time around the /lookup body, divided
+# by the batch's key count): the bench-honesty meter behind the
+# "handler CPU per lookup" headline — wall latency can hide in IO,
+# this cannot
+SERVICE_LOOKUP_CPU_MS = "lookup_cpu_per_key_ms"
 
 # event-loop serving engine + hot delta tier + replica router names
 # (same service metric group; producers are service/async_server.py,
@@ -170,6 +178,8 @@ SERVICE_DELTA_BYTES = "delta_bytes"           # gauge: delta-tier bytes
 SERVICE_DELTA_OVERFLOWS = "delta_overflow"    # writes past max-bytes
 SERVICE_ROUTER_FORWARDED = "router_forwarded"     # proxied requests
 SERVICE_ROUTER_UPSTREAM_ERRORS = "router_upstream_errors"
+SERVICE_ROUTER_RING_CHANGES = "router_ring_changes"   # join/leave/
+# suspend/re-admit events — a churning ring is a churning SST cache
 SERVICE_SCAN_CACHE_HITS = "scan_cache_hits"       # snapshot-keyed
 SERVICE_SCAN_CACHE_MISSES = "scan_cache_misses"   # result cache
 
@@ -184,6 +194,13 @@ LOOKUP_READER_REUSES = "reader_reuses"        # SSTs served warm
 LOOKUP_FILES_PRUNED = "files_pruned"          # skipped by stats, no IO
 LOOKUP_SNAPSHOT_REFRESHES = "snapshot_refreshes"  # plan reloads
 LOOKUP_DELTA_HITS = "delta_hits"              # keys answered by delta
+# native_probes counts SST probe batches resolved by the C path
+# (native/probe.c sst_probe_batch); native_fallbacks counts batches
+# that WANTED the native path but degraded to numpy (no compiler,
+# PAIMON_DISABLE_NATIVE, or a stale .so predating the probe symbols —
+# a nonzero steady-state value is the "serving the slow path" alarm)
+LOOKUP_NATIVE_PROBES = "native_probes"
+LOOKUP_NATIVE_FALLBACKS = "native_fallbacks"
 
 # tiered host-SSD storage counter/gauge/histogram names (cache_disk
 # metric group; producers in fs/caching.py DiskCacheTier + the

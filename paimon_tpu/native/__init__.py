@@ -22,8 +22,23 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "radix_sort.c")
+# every .c in this package compiles into ONE shared object; keep the
+# list explicit so the build (and the tier-1 build-smoke test) cannot
+# silently miss a new source file
+SOURCES = ("radix_sort.c", "probe.c")
+_SRCS = tuple(os.path.join(_DIR, s) for s in SOURCES)
+_SRC = _SRCS[0]                      # kept for older call sites
 _LIB_NAME = "_paimon_native.so"
+
+# symbols the ctypes wrappers bind, grouped by generation: REQUIRED
+# ones fail the whole load when absent, OPTIONAL ones (added after the
+# first shipped .so) degrade per-call to the Python path with a
+# lookup.native_fallbacks counter
+REQUIRED_SYMBOLS = ("radix_argsort_u64", "merge_winners_u64",
+                    "ovc_codes_u64", "ovc_codes_lanes",
+                    "ovc_merge_u64", "ovc_merge_lanes")
+OPTIONAL_SYMBOLS = ("sst_probe_batch",)
+EXPORTED_SYMBOLS = REQUIRED_SYMBOLS + OPTIONAL_SYMBOLS
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -46,10 +61,11 @@ def _build(cc: str, use_cache: bool = True) -> Optional[str]:
         out_dir = make_dir()
         out = os.path.join(out_dir, _LIB_NAME)
         if use_cache and os.path.exists(out) and \
-                os.path.getmtime(out) >= os.path.getmtime(_SRC):
+                os.path.getmtime(out) >= max(os.path.getmtime(s)
+                                             for s in _SRCS):
             return out
         tmp = out + f".build-{os.getpid()}"
-        cmd = [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-o", tmp, *_SRCS]
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=120)
@@ -120,6 +136,16 @@ def load() -> Optional[ctypes.CDLL]:
     lib.ovc_merge_lanes.argtypes = [p_u32, p_i64, p_u64, p_i64, i64,
                                     i64, i64, p_i32, p_u64]
     lib.ovc_merge_lanes.restype = ctypes.c_int
+    # OPTIONAL generation: a .so that predates probe.c still loads —
+    # the probe path degrades per-call to Python (the caller counts a
+    # lookup.native_fallbacks for it)
+    try:
+        lib.sst_probe_batch.argtypes = [p_u8, i64, i64, p_u64, i64,
+                                        i64, p_u8, p_u64, i64, p_i64,
+                                        p_i64]
+        lib.sst_probe_batch.restype = ctypes.c_int
+    except AttributeError:
+        pass
     _lib = lib
     return _lib
 
@@ -243,6 +269,124 @@ def ovc_merge_lanes(lanes: np.ndarray, seq: np.ndarray,
                            n, num_lanes, perm, code) != 0:
         return None
     return perm, code
+
+
+def sst_probe(flat_keys: np.ndarray, n_rows: int, key_width: int,
+              bloom_bits: Optional[np.ndarray], bloom_k: int,
+              qkeys: np.ndarray, qhashes: np.ndarray
+              ) -> Optional[tuple]:
+    """Batched SST probe: bloom + binary search over the flat sorted
+    key buffer, one C call for the whole query batch.  Returns the per
+    query row ranges (lo int64[m], hi int64[m]; lo==hi is a miss,
+    -1/-1 a bloom rejection), or None when the native library is
+    unavailable or the loaded `.so` predates the probe symbols (the
+    caller falls back to the Python path and counts it)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "sst_probe_batch"):
+        return None
+    if bloom_bits is None:
+        bloom_bits = np.zeros(0, dtype=np.uint64)
+        bloom_k = 0
+    m = len(qhashes)
+    lo = np.empty(m, dtype=np.int64)
+    hi = np.empty(m, dtype=np.int64)
+    if lib.sst_probe_batch(
+            np.ascontiguousarray(flat_keys, dtype=np.uint8),
+            int(n_rows), int(key_width),
+            np.ascontiguousarray(bloom_bits, dtype=np.uint64),
+            len(bloom_bits), int(bloom_k),
+            np.ascontiguousarray(qkeys, dtype=np.uint8),
+            np.ascontiguousarray(qhashes, dtype=np.uint64),
+            m, lo, hi) != 0:
+        return None
+    return lo, hi
+
+
+_RAW_PROBE = None
+
+
+def _raw_probe():
+    """`sst_probe_batch` re-bound through a raw CFUNCTYPE taking
+    c_void_p arguments: skips the per-call ndpointer from_param
+    validation, which at serving batch sizes (a handful of keys per
+    probe) rivals the binary search itself.  CFUNCTYPE foreign calls
+    release the GIL like CDLL ones."""
+    global _RAW_PROBE
+    if _RAW_PROBE is None:
+        lib = load()
+        if lib is None or not hasattr(lib, "sst_probe_batch"):
+            _RAW_PROBE = False
+        else:
+            addr = ctypes.cast(lib.sst_probe_batch,
+                               ctypes.c_void_p).value
+            i64 = ctypes.c_int64
+            vp = ctypes.c_void_p
+            proto = ctypes.CFUNCTYPE(ctypes.c_int, vp, i64, i64, vp,
+                                     i64, i64, vp, vp, i64, vp, vp)
+            _RAW_PROBE = proto(addr)
+    return _RAW_PROBE or None
+
+
+def sst_probe_prepare(flat_keys: np.ndarray, n_rows: int,
+                      key_width: int,
+                      bloom_bits: Optional[np.ndarray],
+                      bloom_k: int) -> Optional[tuple]:
+    """Pin an SST's static probe arguments (flat key buffer + bloom
+    words) as raw pointers, resolved ONCE per reader; pass the result
+    to `sst_probe_prepared` per batch.  Returns None when the native
+    probe is unavailable (caller keeps using `sst_probe`, which then
+    reports the fallback)."""
+    fn = _raw_probe()
+    if fn is None:
+        return None
+    fk = np.ascontiguousarray(flat_keys, dtype=np.uint8)
+    bb = np.ascontiguousarray(bloom_bits, dtype=np.uint64) \
+        if bloom_bits is not None else np.zeros(0, dtype=np.uint64)
+    # the trailing array refs keep the pinned buffers alive as long as
+    # the prep tuple (the raw pointers dangle otherwise)
+    return (fn, fk.ctypes.data, int(n_rows), int(key_width),
+            bb.ctypes.data, len(bb), int(bloom_k), (fk, bb))
+
+
+def sst_probe_prepared(prep: tuple, qkeys: np.ndarray,
+                       qhashes: np.ndarray) -> Optional[tuple]:
+    """`sst_probe` over a `sst_probe_prepare` context: only the query
+    arrays cross the boundary per call.
+
+    lo/hi share ONE scratch allocation and every pointer comes from
+    `__array_interface__` — `.ctypes.data` builds a ctypes view object
+    per access, which at one-or-two-key probes costs as much as the
+    search itself."""
+    fn, fk_ptr, n_rows, kw, bb_ptr, bb_len, bk, _pin = prep
+    qk = np.ascontiguousarray(qkeys, dtype=np.uint8)
+    qh = np.ascontiguousarray(qhashes, dtype=np.uint64)
+    m = len(qh)
+    res = np.empty(2 * m, dtype=np.int64)
+    base = res.__array_interface__["data"][0]
+    if fn(fk_ptr, n_rows, kw, bb_ptr, bb_len, bk,
+          qk.__array_interface__["data"][0],
+          qh.__array_interface__["data"][0], m,
+          base, base + 8 * m) != 0:
+        return None
+    return res[:m], res[m:]
+
+
+def build_fresh(out_dir: str) -> Optional[str]:
+    """Compile every native source from scratch into `out_dir` (no
+    cache, package dir untouched) — the tier-1 build-smoke test uses
+    this to prove the sources still compile and export every bound
+    symbol.  Returns the .so path or None (no compiler/failed)."""
+    cc = _compiler()
+    if cc is None:
+        return None
+    out = os.path.join(out_dir, _LIB_NAME)
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-o", out, *_SRCS]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out if proc.returncode == 0 else None
 
 
 def merge_winners(keys: np.ndarray, seq: np.ndarray, keep_last: bool

@@ -872,6 +872,40 @@ class CoreOptions:
         "Virtual nodes per replica on the router's consistent-hash "
         "ring: more vnodes = smoother tenant spread and smaller "
         "reassignment when the replica count changes")
+    SERVICE_REPLICA_HEALTH_INTERVAL = ConfigOption(
+        "service.replicas.health-interval", _parse_duration_ms, 1_000,
+        "How often the router health-checks REMOTE replicas "
+        "(processes on other machines registered via POST /register): "
+        "an unreachable replica is taken out of the hash ring after "
+        "two consecutive failures and re-admitted on the first "
+        "successful check; in-process replicas are never checked — "
+        "their liveness is the process's")
+    SERVICE_PROBE_NATIVE = ConfigOption(
+        "service.probe.native", _parse_bool, True,
+        "Resolve SST point-probe batches with the native C path "
+        "(native/probe.c): bloom filter + binary search over the "
+        "flat sorted key buffer laid out at SST build time, one call "
+        "per (bucket, sorted-run) file with the GIL released.  "
+        "Degrades silently to the vectorized numpy walk — counting "
+        "lookup.native_fallbacks — when no compiler is available, "
+        "PAIMON_DISABLE_NATIVE=1, or the cached .so predates the "
+        "probe symbols; false forces the numpy walk")
+    SERVICE_WARMBOOT_ENABLED = ConfigOption(
+        "service.warmboot.enabled", _parse_bool, False,
+        "Boot serving replicas WARM from state persisted through the "
+        "shared SSD tier: on stop (or an explicit POST /warmboot) a "
+        "replica serializes its plan-cache state and hard-links its "
+        "built SST files under service.warmboot.dir; the next replica "
+        "over the same table restores them at query-engine "
+        "construction and serves its first lookup with zero reader "
+        "builds and no manifest walk.  Requires service.warmboot.dir "
+        "or cache.disk.dir")
+    SERVICE_WARMBOOT_DIR = ConfigOption(
+        "service.warmboot.dir", str, None,
+        "Directory the warm-boot state persists into — a shared SSD "
+        "mount reachable by every machine's replicas (the same "
+        "sharing contract as cache.disk.dir, which is also the "
+        "default location: <cache.disk.dir>/warmboot)")
     SERVICE_DELTA_ENABLED = ConfigOption(
         "service.delta.enabled", _parse_bool, True,
         "Serve point lookups from the hot in-memory delta tier "

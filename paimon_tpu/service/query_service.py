@@ -192,7 +192,8 @@ class KvQueryServer:
         self.brownout = BrownoutController(self.admission, opts)
         from paimon_tpu.metrics import (
             SERVICE_CHANGELOG_MS, SERVICE_CONNECTIONS,
-            SERVICE_LOOKUP_KEYS, SERVICE_LOOKUP_MS, SERVICE_LOOP_LAG_MS,
+            SERVICE_LOOKUP_CPU_MS, SERVICE_LOOKUP_KEYS,
+            SERVICE_LOOKUP_MS, SERVICE_LOOP_LAG_MS,
             SERVICE_SCAN_CACHE_HITS, SERVICE_SCAN_CACHE_MISSES,
             SERVICE_SCAN_MS, global_registry,
         )
@@ -201,6 +202,20 @@ class KvQueryServer:
         self._m_scan_ms = g.histogram(SERVICE_SCAN_MS)
         self._m_changelog_ms = g.histogram(SERVICE_CHANGELOG_MS)
         self._m_lookup_keys = g.counter(SERVICE_LOOKUP_KEYS)
+        # per-key handler CPU (thread_time): the honest denominator
+        # behind qps headlines — wall latency can hide in IO waits,
+        # CPU per key cannot
+        self._m_lookup_cpu = g.histogram(SERVICE_LOOKUP_CPU_MS)
+        # warm boot (service/warmboot.py): restore at query-engine
+        # construction, persist on shutdown or explicit POST /warmboot
+        from paimon_tpu.service import warmboot as _warmboot
+        self._warmboot_dir = None
+        if opts.get(CoreOptions.SERVICE_WARMBOOT_ENABLED):
+            base = _warmboot.warmboot_dir(opts)
+            if base:
+                self._warmboot_dir = _warmboot.table_state_dir(
+                    base, table)
+        self.last_warm_restore: Optional[dict] = None
         # the event-loop engine (service/async_server.py): handlers
         # run on the bounded service.workers pool; the loop thread
         # owns every socket and pipelined keep-alive parse
@@ -285,12 +300,32 @@ class KvQueryServer:
         """The shared serving-side point-lookup engine (pk tables)."""
         with self._query_lock:
             if self._query is None:
-                self._query = LocalTableQuery(
+                q = LocalTableQuery(
                     self.table,
                     refresh_interval_ms=self.options.get(
                         CoreOptions.SERVICE_LOOKUP_REFRESH_INTERVAL),
                     delta=self._delta)
+                if self._warmboot_dir is not None:
+                    # adopt persisted SSTs + plan state BEFORE the
+                    # first lookup: a warm replica's first batch runs
+                    # with reader_builds == 0 and no cold manifest walk
+                    from paimon_tpu.service import warmboot
+                    self.last_warm_restore = \
+                        warmboot.restore_serving_state(
+                            q, self._warmboot_dir)
+                self._query = q
             return self._query
+
+    def persist_warm_state(self) -> dict:
+        """Persist the current serving state (built SSTs + plan-cache
+        state) for warm boot; {"ssts": 0, ...} when warm boot is off
+        or nothing is built yet."""
+        with self._query_lock:
+            q = self._query
+        if q is None or self._warmboot_dir is None:
+            return {"ssts": 0, "snapshot_id": None, "plan": False}
+        from paimon_tpu.service import warmboot
+        return warmboot.persist_serving_state(q, self._warmboot_dir)
 
     def new_serving_writer(self, commit_user: Optional[str] = None):
         """A writer whose rows are readable via /lookup IMMEDIATELY —
@@ -313,6 +348,29 @@ class KvQueryServer:
         self.services.register(PRIMARY_KEY_LOOKUP, self.address)
         return self
 
+    def register_with_router(self, router_address: str) -> dict:
+        """Join a (possibly cross-machine) router's hash ring: POST
+        this replica's (id, address) to the router's /register.  The
+        router health-checks us from then on; pair with a warm-boot
+        restore for a joiner that serves its first lookup hot."""
+        import http.client
+        host, port = KvQueryClient._hostport(router_address)
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.request(
+                "POST", "/register",
+                json.dumps({"id": self.replica_id,
+                            "address": self.address}).encode(),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read() or b"{}")
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"router refused registration: {body}")
+            return body
+        finally:
+            conn.close()
+
     def stop(self):
         self.services.unregister(PRIMARY_KEY_LOOKUP)
         self.shutdown()
@@ -324,6 +382,13 @@ class KvQueryServer:
         self.server.stop()
         # the process-wide degraded switch must not outlive the server
         self.brownout.reset()
+        # persist BEFORE close drops the SST store: a restarting
+        # replica finds this one's warm state on the shared SSD tier
+        if self._warmboot_dir is not None:
+            try:
+                self.persist_warm_state()
+            except Exception:  # lint-ok: swallow warm-state persist is advisory — a failed snapshot must not block shutdown; next boot is simply cold
+                pass
         with self._query_lock:
             if self._query is not None:
                 self._query.close()
@@ -418,15 +483,45 @@ class KvQueryServer:
         with self._query_lock:
             snap = self._query.snapshot_id \
                 if self._query is not None else None
+        from paimon_tpu.metrics import (
+            LOOKUP_NATIVE_FALLBACKS, LOOKUP_NATIVE_PROBES,
+            LOOKUP_READER_BUILDS, LOOKUP_READER_REUSES,
+            global_registry,
+        )
+        lg = global_registry().lookup_metrics()
         return {"replica_id": self.replica_id,
                 "snapshot_id": snap,
                 "lookup_ms": h(self._m_lookup_ms),
                 "scan_ms": h(self._m_scan_ms),
                 "lookup_keys": self._m_lookup_keys.count,
+                "lookup_cpu_per_key_ms": h(self._m_lookup_cpu),
+                # process-global lookup-plane counters: the warm-boot
+                # proof (reader_builds == 0) and the native-probe
+                # health (fallbacks must not move in steady state)
+                "lookup": {
+                    "reader_builds":
+                        lg.counter(LOOKUP_READER_BUILDS).count,
+                    "reader_reuses":
+                        lg.counter(LOOKUP_READER_REUSES).count,
+                    "native_probes":
+                        lg.counter(LOOKUP_NATIVE_PROBES).count,
+                    "native_fallbacks":
+                        lg.counter(LOOKUP_NATIVE_FALLBACKS).count,
+                },
+                "warm_restore": self.last_warm_restore,
                 "delta": None if self._delta is None
                 else self._delta.stats()}
 
     def _handle_post(self, req: HttpRequest) -> HttpResponse:
+        if req.path == "/warmboot":
+            # explicit persist (admin/bench): hard-link the built SSTs
+            # + plan state onto the shared SSD tier NOW, so replicas
+            # registered after this call boot warm
+            try:
+                return self._json_response(200,
+                                           self.persist_warm_state())
+            except Exception as e:      # noqa: BLE001
+                return self._json_response(500, {"error": str(e)})
         if req.path == "/lookup":
             handle, timer = self._lookup, self._m_lookup_ms
         elif req.path == "/scan":
@@ -503,8 +598,12 @@ class KvQueryServer:
             return DEFAULT_PRIORITY
 
     def _lookup(self, req):
+        import time as _time
         keys = req["keys"]
         est = max(1, len(keys)) * self._lookup_key_bytes
+        # thread CPU, not wall: admission-queue and IO waits burn no
+        # CPU on this thread, so the quotient is honest handler cost
+        cpu0 = _time.thread_time()
         with self.admission.acquire(self._tenant(req), est,
                                     self._priority(req)):
             rows = self.query().lookup(
@@ -512,6 +611,8 @@ class KvQueryServer:
                  for d in keys],
                 partition=tuple(_decode_value(v)
                                 for v in req.get("partition") or ()))
+        self._m_lookup_cpu.update(
+            (_time.thread_time() - cpu0) * 1000.0 / max(1, len(keys)))
         self._m_lookup_keys.inc(len(keys))
         return {"rows": [None if r is None else
                          {k: _encode_value(x) for k, x in r.items()}

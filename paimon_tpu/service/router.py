@@ -23,6 +23,9 @@ discovery) scaled onto this repo's serving plane:
   it answers:
 
       POST /lookup /scan /changelog   forwarded to the owning replica
+      POST /register                  {"id", "address"}: a replica on
+                                      ANOTHER MACHINE joins the ring
+      POST /deregister                {"id"}: planned leave
       GET  /topology                  the ring: replica ids+addresses
       GET  /healthz                   per-replica healthz + a rollup
       GET  /metrics                   Prometheus; remote replicas are
@@ -31,7 +34,11 @@ discovery) scaled onto this repo's serving plane:
   In-process replicas are dispatched DIRECTLY (function call, no
   second TCP hop — Netty's local channel, in spirit); remote replicas
   (other processes sharing the SSD tier) forward over pooled
-  keep-alive connections.
+  keep-alive connections.  Registered remotes are health-checked every
+  `service.replicas.health-interval`: two consecutive failed GET
+  /healthz probes suspend a replica OUT of the ring (its tenants
+  rehash to survivors), the first success re-admits it — in-process
+  replicas are never probed, their liveness is the process's.
 * smart clients skip the hop entirely: `KvQueryClient` fetches
   /topology once, builds the SAME ring, and talks to the owning
   replica directly — the router is the dumb-client path and the
@@ -170,15 +177,28 @@ class ReplicaRouter:
         if workers is None:
             workers = opts_holder.get(CoreOptions.SERVICE_WORKERS) \
                 if opts_holder is not None else 16
+        self._vnodes = vnodes
+        self._health_interval_ms = opts_holder.get(
+            CoreOptions.SERVICE_REPLICA_HEALTH_INTERVAL) \
+            if opts_holder is not None else 1_000
+        # membership state: `_lock` guards replicas/_remote/_suspended
+        # mutation; `self.ring` swaps ATOMICALLY (readers pick off
+        # whatever ring reference they loaded — no read-side lock)
+        self._membership_lock = threading.Lock()
+        self._suspended: set = set()
+        self._fail_counts: Dict[int, int] = {}
+        self._health_stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
         self.ring = HashRing(entries, vnodes)
         from paimon_tpu.metrics import (
-            SERVICE_ROUTER_FORWARDED, SERVICE_ROUTER_UPSTREAM_ERRORS,
-            global_registry,
+            SERVICE_ROUTER_FORWARDED, SERVICE_ROUTER_RING_CHANGES,
+            SERVICE_ROUTER_UPSTREAM_ERRORS, global_registry,
         )
         g = global_registry().service_metrics(table_name)
         self._m_forwarded = g.counter(SERVICE_ROUTER_FORWARDED)
         self._m_upstream_errors = g.counter(
             SERVICE_ROUTER_UPSTREAM_ERRORS)
+        self._m_ring_changes = g.counter(SERVICE_ROUTER_RING_CHANGES)
         self.server = AsyncHttpServer(
             host, port, self._handle, workers=workers,
             name="paimon-router")
@@ -187,20 +207,110 @@ class ReplicaRouter:
 
     def start(self) -> "ReplicaRouter":
         self.server.start()
+        from paimon_tpu.parallel.executors import spawn_thread
+        self._health_thread = spawn_thread(
+            self._health_loop, name="paimon-router-health")
         return self
 
     def stop(self):
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
         self.server.stop()
         for pool in self._remote.values():
             pool.close()
+
+    # -- membership ----------------------------------------------------------
+
+    def _rebuild_ring_locked(self):
+        """Swap in a fresh ring over the non-suspended membership.
+        Caller holds `_membership_lock`; readers keep using whichever
+        ring reference they already loaded."""
+        live = [e for e in self.replicas
+                if e["id"] not in self._suspended]
+        self.ring = HashRing(live, self._vnodes)
+        self._m_ring_changes.inc()
+
+    def register_replica(self, rid: int, address: str) -> None:
+        """Admit (or re-admit with a new address) a REMOTE replica.
+        Registering an id that is currently suspended clears the
+        suspension — the replica is announcing it is back."""
+        rid = int(rid)
+        with self._membership_lock:
+            if rid in self._local:
+                raise ValueError(
+                    f"replica {rid} is in-process; cannot re-register")
+            old_pool = self._remote.get(rid)
+            self._remote[rid] = _UpstreamPool(address)
+            self.replicas = (
+                [e for e in self.replicas if e["id"] != rid]
+                + [{"id": rid, "address": address}])
+            self.replicas.sort(key=lambda e: e["id"])
+            self._suspended.discard(rid)
+            self._fail_counts.pop(rid, None)
+            self._rebuild_ring_locked()
+        if old_pool is not None:
+            old_pool.close()
+
+    def deregister_replica(self, rid: int) -> bool:
+        """Planned leave: drop a remote replica from ring + membership.
+        Returns False for unknown or in-process ids."""
+        rid = int(rid)
+        with self._membership_lock:
+            if rid in self._local or rid not in self._remote:
+                return False
+            pool = self._remote.pop(rid)
+            self.replicas = [e for e in self.replicas
+                             if e["id"] != rid]
+            self._suspended.discard(rid)
+            self._fail_counts.pop(rid, None)
+            self._rebuild_ring_locked()
+        pool.close()
+        return True
+
+    def _health_loop(self):
+        """Probe REMOTE replicas every `service.replicas.health-
+        interval`: 2 consecutive failures suspend one out of the ring,
+        the first success re-admits it.  In-process replicas are never
+        probed."""
+        interval = max(0.05, self._health_interval_ms / 1000.0)
+        while not self._health_stop.wait(interval):
+            with self._membership_lock:
+                targets = list(self._remote.items())
+            for rid, pool in targets:
+                ok = False
+                try:
+                    status, _, _ = pool.request("GET", "/healthz",
+                                                b"", {})
+                    ok = status == 200
+                except Exception:      # noqa: BLE001
+                    self._m_upstream_errors.inc()
+                with self._membership_lock:
+                    if rid not in self._remote:
+                        continue       # deregistered mid-probe
+                    if ok:
+                        self._fail_counts.pop(rid, None)
+                        if rid in self._suspended:
+                            self._suspended.discard(rid)
+                            self._rebuild_ring_locked()
+                    else:
+                        n = self._fail_counts.get(rid, 0) + 1
+                        self._fail_counts[rid] = n
+                        if n >= 2 and rid not in self._suspended:
+                            self._suspended.add(rid)
+                            self._rebuild_ring_locked()
 
     # -- dispatch ------------------------------------------------------------
 
     def _handle(self, req: HttpRequest) -> HttpResponse:
         if req.method == "GET":
             if req.path == "/topology":
+                with self._membership_lock:
+                    replicas = list(self.replicas)
+                    suspended = sorted(self._suspended)
                 return HttpResponse(200, json.dumps(
-                    {"replicas": self.replicas,
+                    {"replicas": replicas,
+                     "suspended": suspended,
                      "virtual_nodes": self.ring.vnodes,
                      "router": True}).encode())
             if req.path == "/healthz":
@@ -208,6 +318,9 @@ class ReplicaRouter:
             if req.path == "/metrics":
                 return self._metrics()
             return HttpResponse(404, b'{"error": "not found"}')
+        if req.method == "POST" and req.path in ("/register",
+                                                 "/deregister"):
+            return self._handle_membership(req)
         if req.method != "POST" or req.path not in (
                 "/lookup", "/scan", "/changelog"):
             return HttpResponse(404, b'{"error": "not found"}')
@@ -220,13 +333,48 @@ class ReplicaRouter:
         self._m_forwarded.inc()
         return self._forward(node, req)
 
+    def _handle_membership(self, req: HttpRequest) -> HttpResponse:
+        try:
+            body = json.loads(req.body or b"{}")
+            rid = int(body["id"])
+        except (ValueError, KeyError, TypeError):
+            return HttpResponse(
+                400, b'{"error": "expected {\\"id\\": int}"}')
+        if req.path == "/register":
+            address = str(body.get("address") or "")
+            if not address.startswith("http"):
+                return HttpResponse(
+                    400, b'{"error": "expected an http address"}')
+            try:
+                self.register_replica(rid, address)
+            except ValueError as e:
+                return HttpResponse(
+                    409, json.dumps({"error": str(e)}).encode())
+            return HttpResponse(200, json.dumps(
+                {"registered": rid,
+                 "replica_count": len(self.replicas)}).encode())
+        if not self.deregister_replica(rid):
+            return HttpResponse(
+                404, json.dumps(
+                    {"error": f"unknown remote replica {rid}"}
+                ).encode())
+        return HttpResponse(200, json.dumps(
+            {"deregistered": rid,
+             "replica_count": len(self.replicas)}).encode())
+
     def _forward(self, node: dict, req: HttpRequest) -> HttpResponse:
         rid = node["id"]
         local = self._local.get(rid)
         if local is not None:
             # in-process replica: direct dispatch, no second TCP hop
             return local._handle(req)
-        pool = self._remote[rid]
+        pool = self._remote.get(rid)
+        if pool is None:       # deregistered between pick and forward
+            self._m_upstream_errors.inc()
+            return HttpResponse(
+                502, json.dumps({"error": f"replica {rid} left the "
+                                          f"ring"}).encode(),
+                headers={"X-Replica-Id": str(rid)})
         fwd_headers = {"Content-Type": "application/json"}
         if "x-request-timeout-ms" in req.headers:
             fwd_headers["X-Request-Timeout-Ms"] = \
@@ -265,8 +413,15 @@ class ReplicaRouter:
         per: Dict[str, object] = {}
         worst = 0
         ok = True
-        for e in self.replicas:
+        with self._membership_lock:
+            replicas = list(self.replicas)
+            suspended = set(self._suspended)
+        for e in replicas:
             rid = e["id"]
+            if rid in suspended:
+                per[str(rid)] = {"suspended": True}
+                ok = False
+                continue
             try:
                 status, body = self._replica_get(rid, "/healthz")
                 h = json.loads(body)
@@ -282,7 +437,8 @@ class ReplicaRouter:
             "router": True,
             "status": "ok" if ok and worst == 0 else "degraded",
             "brownout_level_max": worst,
-            "replica_count": len(self.replicas),
+            "replica_count": len(replicas),
+            "suspended": sorted(suspended),
             "replicas": per}).encode())
 
     def _metrics(self) -> HttpResponse:
@@ -294,7 +450,9 @@ class ReplicaRouter:
         if self._local:
             from paimon_tpu.obs.export import render_prometheus
             parts.append(render_prometheus())
-        for rid, pool in self._remote.items():
+        with self._membership_lock:
+            remotes = list(self._remote.items())
+        for rid, pool in remotes:
             try:
                 status, data, _ = pool.request("GET", "/metrics", b"",
                                                {})
