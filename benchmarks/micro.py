@@ -246,13 +246,17 @@ def bench_scan():
 
 def bench_obs():
     """Observability overhead on the scan hot path: the same table
-    scanned three ways —
+    scanned four ways —
 
       * no-instrumentation baseline: trace AND metrics off, so every
         span() call is one flag check returning the shared no-op;
       * disabled (the DEFAULT): trace off, metrics on (stage latency
         histograms record);
-      * enabled: full span collection into the ring.
+      * enabled: full span collection into the ring;
+      * fleet: enabled PLUS the cross-process plane — flight recorder
+        on and a trace.export.dir spool flushed after every scan (the
+        worst-case per-operation flush cadence; real daemons flush on
+        export/drain).
 
     Reports best-of times plus overhead percentages; the tier-1 test
     asserts obs_overhead_disabled_pct < 2.  Overheads are measured over
@@ -260,22 +264,30 @@ def bench_obs():
     disabled overhead is ~0.1%, so any excess is timer noise and the
     min is the honest estimate."""
     from paimon_tpu import obs
+    from paimon_tpu.obs import flight
+    from paimon_tpu.obs.trace import set_export_dir, spool_flush
 
     rows = min(ROWS, 200_000)
     trials = int(os.environ.get("OBS_TRIALS", "3"))
     with tempfile.TemporaryDirectory() as tmp:
         table = _build_table(tmp, "parquet", rows)
         table.to_arrow()                    # warm footer/page caches
+        spool_dir = os.path.join(tmp, "spool")
 
         def scan():
             table.to_arrow()
+
+        def scan_fleet():
+            table.to_arrow()
+            flight.record("bench.scan", rows=rows)
+            spool_flush()
 
         was_tracing = obs.tracing_enabled()
         was_metrics = obs.metrics_enabled()
         try:
             best = {"base": float("inf"), "disabled": float("inf"),
-                    "enabled": float("inf")}
-            over_disabled = over_enabled = float("inf")
+                    "enabled": float("inf"), "fleet": float("inf")}
+            over_disabled = over_enabled = over_fleet = float("inf")
             for _ in range(max(1, trials)):
                 obs.disable_tracing()
                 obs.set_metrics_enabled(False)
@@ -284,23 +296,32 @@ def bench_obs():
                 disabled, _ = _best(scan)
                 obs.enable_tracing()
                 enabled, _ = _best(scan)
+                set_export_dir(spool_dir)
+                fleet, _ = _best(scan_fleet)
+                set_export_dir(None)
                 obs.disable_tracing()
                 best["base"] = min(best["base"], base)
                 best["disabled"] = min(best["disabled"], disabled)
                 best["enabled"] = min(best["enabled"], enabled)
+                best["fleet"] = min(best["fleet"], fleet)
                 over_disabled = min(over_disabled,
                                     max(0.0, disabled / base - 1))
                 over_enabled = min(over_enabled,
                                    max(0.0, enabled / base - 1))
+                over_fleet = min(over_fleet,
+                                 max(0.0, fleet / base - 1))
         finally:
+            set_export_dir(None)
             obs.set_metrics_enabled(was_metrics)
             (obs.enable_tracing if was_tracing
              else obs.disable_tracing)()
         _emit("obs_scan_noinstr", rows, best["base"])
         _emit("obs_scan_trace_disabled", rows, best["disabled"])
         _emit("obs_scan_trace_enabled", rows, best["enabled"])
+        _emit("obs_scan_fleet", rows, best["fleet"])
         for name, pct in (("obs_overhead_disabled_pct", over_disabled),
-                          ("obs_overhead_enabled_pct", over_enabled)):
+                          ("obs_overhead_enabled_pct", over_enabled),
+                          ("obs_overhead_fleet_pct", over_fleet)):
             print(json.dumps({"benchmark": name,
                               "value": round(pct * 100, 3),
                               "unit": "pct", "rows": rows,

@@ -48,6 +48,48 @@ def check_metric_drift(model: ProgramModel) -> List[Finding]:
     return out
 
 
+_OBS_CONST_MODULES = ("obs/trace.py", "obs/flight.py")
+_OBS_CONST_RE = re.compile(
+    r"^((?:STAGE|EV)_[A-Z0-9_]+)\s*=\s*['\"]", re.MULTILINE)
+
+
+@rule("obs-drift",
+      "exported stage/flight-event constant with no producer")
+def check_obs_drift(model: ProgramModel) -> List[Finding]:
+    """Every ``STAGE_*`` span-stage constant (obs/trace.py) and
+    ``EV_*`` flight-event constant (obs/flight.py) must be USED
+    somewhere in the package beyond its defining assignment — an
+    orphaned name means the merged fleet trace / flight ring
+    documents an event nothing records.  Same-module uses count:
+    the producer for ``STAGE_SERVE_REQUEST`` is trace.py's own
+    header-adoption path.  (SLO metric-name constants live in
+    metrics.py's ``__all__`` and are covered by `metric-drift`.)
+
+    Unlike the other drift rules this one is pure source analysis —
+    no imports, no repo anchors — so it runs on fixture packages
+    too."""
+    out: List[Finding] = []
+    all_mods = list(model.modules.values())
+    for rel in _OBS_CONST_MODULES:
+        mod = model.modules.get(rel)
+        if mod is None:
+            continue
+        # the defining module minus the definition lines themselves
+        residue = _OBS_CONST_RE.sub("", mod.source)
+        others = "\n".join(m.source for m in all_mods if m is not mod)
+        for m in _OBS_CONST_RE.finditer(mod.source):
+            name = m.group(1)
+            if re.search(rf"\b{name}\b", others) or \
+                    re.search(rf"\b{name}\b", residue):
+                continue
+            line = mod.source[:m.start()].count("\n") + 1
+            out.append(Finding(
+                "obs-drift", mod.rel, line,
+                f"observability constant {name} has no producer in "
+                f"{model.package_name}/ — record it or delete it"))
+    return out
+
+
 @rule("options-drift",
       "docs/options.md or CoreOptions out of sync")
 def check_options_drift(model: ProgramModel) -> List[Finding]:

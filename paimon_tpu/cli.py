@@ -20,7 +20,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import pyarrow as pa
 
@@ -299,6 +299,23 @@ def cmd_table(args):
         print(json.dumps(status, indent=2, default=str))
         if any(lp["failed"] for lp in status["loops"].values()):
             raise SystemExit(1)
+    elif cmd == "debug-bundle":
+        table = _table(catalog, args.table)
+        out_path = args.out or "debug-bundle.json"
+        bundle = build_debug_bundle(table, serving=args.serving,
+                                    base_user=args.base_user,
+                                    lease_walk=args.lease_walk)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=2, default=str)
+        os.replace(tmp, out_path)
+        print(json.dumps({
+            "out": out_path,
+            "flight_events": len(bundle["flight"]["events"]),
+            "metric_rows": len(bundle["metrics"]),
+            "distributed": bundle["fleet"].get("distributed", False),
+            "serving": bundle["healthz"] is not None,
+        }, indent=2))
     elif cmd == "fsck":
         table = _table(catalog, args.table)
         report = table.fsck(snapshot_id=args.snapshot, deep=args.deep,
@@ -349,15 +366,13 @@ def cmd_branch(args):
         print("OK")
 
 
-def cmd_fleet(args):
+def _fleet_view(table, base_user: str, lease_walk: int) -> Dict:
     """Fleet-plane introspection, read purely from snapshot
     properties through the sanctioned history API
     (parallel/distributed.py — the `ownership-history` lint rule
     forbids raw `multihost.ownership.*` parsing here too)."""
     import time as _time
 
-    catalog = _load_catalog(args)
-    table = _table(catalog, args.table)
     from paimon_tpu.parallel.distributed import (
         merge_lease_view, merge_rejoin_requests,
         resume_generation_history,
@@ -366,16 +381,15 @@ def cmd_fleet(args):
 
     hist = resume_generation_history(table)
     if hist is None:
-        print(json.dumps({"distributed": False}, indent=2))
-        return
+        return {"distributed": False}
     current = hist.current()
     now = int(_time.time() * 1000)
-    leases = merge_lease_view(table, max_walk=args.lease_walk)
+    leases = merge_lease_view(table, max_walk=lease_walk)
     requests = merge_rejoin_requests(table)
     hosts = {}
     for p in range(current.num_processes):
         ledger, floors = recover_plane_stamps(
-            table, f"{args.base_user}-p{p}")
+            table, f"{base_user}-p{p}")
         # bucket shares for the default partition — partitioned
         # tables shard per (partition, bucket), so per-partition
         # ownership can differ; this is the representative view
@@ -404,6 +418,89 @@ def cmd_fleet(args):
              "buckets": m.num_buckets, "dead": sorted(m.dead)}
             for m in hist.entries],
     }
+    return out
+
+
+def _options_diff(table) -> Dict:
+    """Explicitly-set table options vs their registered defaults —
+    the 'what is different about THIS table' half of a debug bundle."""
+    from paimon_tpu.options import ConfigOption, CoreOptions
+
+    defaults = {v.key: v.default for v in vars(CoreOptions).values()
+                if isinstance(v, ConfigOption)}
+    diff = {}
+    for k, v in sorted(table.options.to_map().items()):
+        d = defaults.get(k)
+        if d is not None and str(d) == v:
+            continue                       # explicitly set to default
+        diff[k] = {"value": v,
+                   "default": None if d is None else str(d),
+                   "known": k in defaults}
+    return diff
+
+
+def build_debug_bundle(table, serving: Optional[str] = None,
+                       base_user: str = "stream-daemon",
+                       lease_walk: int = 16) -> Dict:
+    """One support artifact with everything a post-mortem starts
+    from: the black-box flight ring, a full metrics snapshot, the
+    serving plane's /healthz + /slo (best-effort — the server may be
+    the thing that died), the store-derived fleet view, and the
+    table's options diff vs defaults."""
+    import time as _time
+
+    from paimon_tpu.metrics import global_registry
+    from paimon_tpu.obs import flight
+    from paimon_tpu.obs.trace import process_tag
+
+    bundle: Dict = {
+        "created_ms": int(_time.time() * 1000),
+        "table": table.name,
+        "process": process_tag(),
+        "flight": {"events": flight.recorder().snapshot()},
+        "metrics": global_registry().snapshot_rows(),
+        "healthz": None,
+        "slo": None,
+        "fleet": {},
+        "options": _options_diff(table),
+    }
+    try:
+        from paimon_tpu.service.query_service import KvQueryClient
+        client = KvQueryClient(
+            table=None if serving else table, address=serving,
+            follow_topology=False)
+        bundle["healthz"] = client.healthz()
+        bundle["slo"] = client.slo()
+    except Exception as e:                 # noqa: BLE001 — diagnostic
+        bundle["serving_error"] = f"{type(e).__name__}: {e}"
+    try:
+        bundle["fleet"] = _fleet_view(table, base_user, lease_walk)
+    except Exception as e:                 # noqa: BLE001 — diagnostic
+        bundle["fleet"] = {"error": f"{type(e).__name__}: {e}"}
+    return bundle
+
+
+def cmd_fleet(args):
+    if args.fleet_cmd == "trace":
+        from paimon_tpu.obs.merge import export_merged
+        stats = export_merged(args.merge, args.out)
+        print(json.dumps(stats, indent=2))
+        if stats["processes"] == 0:
+            raise SystemExit(1)
+        return
+    catalog = _load_catalog(args)
+    table = _table(catalog, args.table)
+    out = _fleet_view(table, args.base_user, args.lease_walk)
+    if getattr(args, "serving", None):
+        # the store plane above is read from snapshots; the serving
+        # plane (SLO burn rates) lives behind HTTP — best-effort so a
+        # downed router never hides the store-side view
+        try:
+            from paimon_tpu.service.query_service import KvQueryClient
+            out["slo"] = KvQueryClient(address=args.serving,
+                                       follow_topology=False).slo()
+        except Exception as e:             # noqa: BLE001 — diagnostic
+            out["slo"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(out, indent=2))
 
 
@@ -600,6 +697,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="trace the daemon; write Chrome trace-event "
                         "JSON (opens in Perfetto)")
     c = tsub.add_parser(
+        "debug-bundle",
+        help="write one support artifact: flight-recorder ring, "
+             "metrics snapshot, /healthz + /slo (best-effort), fleet "
+             "status, and the table's options diff vs defaults")
+    c.add_argument("table")
+    c.add_argument("--out", metavar="OUT.json",
+                   help="bundle path (default: debug-bundle.json)")
+    c.add_argument("--serving", metavar="HOST:PORT",
+                   help="query service / router to probe for "
+                        "/healthz + /slo (default: the table's "
+                        "registered service address)")
+    c.add_argument("--base-user", default="stream-daemon")
+    c.add_argument("--lease-walk", type=int, default=16)
+    c = tsub.add_parser(
         "fsck", help="verify the snapshot/manifest/file graph")
     c.add_argument("table")
     c.add_argument("--snapshot", type=int,
@@ -660,6 +771,22 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--lease-walk", type=int, default=16,
                    help="newest-first snapshots merged into the "
                         "lease view")
+    c.add_argument("--serving", metavar="HOST:PORT",
+                   help="router (or single replica) to fold the "
+                        "serving plane's /slo burn rates into the "
+                        "status (best-effort)")
+    c = flsub.add_parser(
+        "trace",
+        help="stitch per-process trace spools (trace.export.dir) "
+             "into ONE Perfetto-loadable file: a track per process, "
+             "flow arrows across every serving hop and store-carried "
+             "link")
+    c.add_argument("--merge", required=True, metavar="SPOOL_DIR",
+                   help="the fleet's shared trace.export.dir")
+    c.add_argument("--out", default="fleet-trace.json",
+                   metavar="OUT.json",
+                   help="merged Chrome trace-event JSON "
+                        "(default: fleet-trace.json)")
     fl.set_defaults(func=cmd_fleet)
 
     s = sub.add_parser("sql", help="run SQL (or start a REPL)")

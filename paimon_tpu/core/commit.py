@@ -425,6 +425,17 @@ class FileStoreCommit:
                     merged_props = dict(self.properties_provider() or {})
                     merged_props.update(properties or {})
                     eff_properties = merged_props or None
+                from paimon_tpu.obs.trace import current_context_token
+                _ctx = current_context_token()
+                if _ctx is not None:
+                    # store-carried trace context: readers of this
+                    # snapshot (scan plans, lease folds) link their
+                    # spans back to the committing process's span in
+                    # the merged fleet trace.  setdefault — an
+                    # explicit/provider-stamped context (takeover
+                    # attribution) wins over the ambient span.
+                    eff_properties = dict(eff_properties or {})
+                    eff_properties.setdefault("trace.context", _ctx)
                 snapshot = Snapshot(
                     id=new_id,
                     schema_id=self.schema.id,
@@ -473,6 +484,11 @@ class FileStoreCommit:
                 # reusable across attempts unless the entry set is dynamic)
                 if self.conflict_listener is not None:
                     self.conflict_listener(_attempts)
+                from paimon_tpu.obs.flight import (
+                    EV_COMMIT_CONFLICT, record,
+                )
+                record(EV_COMMIT_CONFLICT, attempt=_attempts,
+                       snapshot=new_id, user=self.commit_user)
                 _delete_attempt_lists()
                 if (entries_fn is not None or ids_assigned) and \
                         new_manifest is not None:

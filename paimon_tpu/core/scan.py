@@ -170,6 +170,18 @@ class FileStoreScan:
             return ScanPlan(None, [], streaming=streaming)
         splits = self._plan_splits(snapshot, streaming)
         plan = ScanPlan(snapshot.id, splits, streaming=streaming)
+        from paimon_tpu.obs.trace import (
+            STAGE_PLAN_LINK, span, tracing_enabled,
+        )
+        if tracing_enabled():
+            ctx = (snapshot.properties or {}).get("trace.context")
+            if ctx:
+                # store-carried boundary: this plan consumed a
+                # snapshot committed (possibly) elsewhere — the merge
+                # tool draws the committer-span -> plan flow arrow
+                with span(STAGE_PLAN_LINK, cat="scan", link=ctx,
+                          snapshot=snapshot.id):
+                    pass
         dt_ms = (_time.perf_counter() - t0) * 1000
         self._m_plans.inc()
         self._m_plan_ms.update(dt_ms)

@@ -225,6 +225,13 @@ class CircuitBreaker:
             return self._state
 
     def _set_state_locked(self, state: str):
+        if state != self._state:
+            # flight-recorder: breaker flips are the canonical
+            # "something was wrong with the store" black-box event
+            # (flight's lock is a leaf — safe under self._lock)
+            from paimon_tpu.obs.flight import EV_BREAKER, record
+            record(EV_BREAKER, backend=self.name, frm=self._state,
+                   to=state)
         self._state = state
         self._g_state.set(self._GAUGE_VALUE[state])
 

@@ -8,6 +8,7 @@ registry is the programmatic one.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -71,7 +72,10 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricGroup",
            "PLAN_ENTRIES_DECODED", "PLAN_MANIFEST_COMPACTIONS",
            "FLEET_REJOINS", "FLEET_GENERATIONS",
            "FLEET_FSCK_INCREMENTAL_RUNS", "FLEET_FSCK_OBJECTS_CHECKED",
-           "FLEET_FSCK_WATERMARK_AGE_MS"]
+           "FLEET_FSCK_WATERMARK_AGE_MS",
+           "SLO_AVAILABILITY_BURN_FAST", "SLO_AVAILABILITY_BURN_SLOW",
+           "SLO_LATENCY_BURN_FAST", "SLO_LATENCY_BURN_SLOW",
+           "SLO_ALERT", "SLO_GOOD_EVENTS", "SLO_BAD_EVENTS"]
 
 # fault-tolerance counter names (one definition; producers in
 # parallel/fault.py + mesh_engine.py, consumers in tests/dashboards):
@@ -307,6 +311,32 @@ FLEET_FSCK_INCREMENTAL_RUNS = "fsck_incremental_runs"
 FLEET_FSCK_OBJECTS_CHECKED = "fsck_objects_checked"
 FLEET_FSCK_WATERMARK_AGE_MS = "fsck_watermark_age_ms"
 
+# SLO burn-rate plane gauge/counter names (slo metric group; producer
+# obs/slo.py's SloEvaluator — evaluated per replica over the serving
+# histogram windows, consumers GET /slo, the router fleet aggregate,
+# `paimon fleet status` and the Prometheus `paimon_slo_*` series).
+# burn = (observed bad-event rate) / (error budget); >1 means the
+# budget is being spent faster than the objective allows, and the
+# alert gauge goes 1 only when BOTH the fast and slow windows burn hot
+# (Google SRE multi-window multi-burn-rate alerting: the slow window
+# kills flapping, the fast window kills slow detection).
+SLO_AVAILABILITY_BURN_FAST = "availability_burn_fast"
+SLO_AVAILABILITY_BURN_SLOW = "availability_burn_slow"
+SLO_LATENCY_BURN_FAST = "latency_burn_fast"
+SLO_LATENCY_BURN_SLOW = "latency_burn_slow"
+SLO_ALERT = "alert"
+SLO_GOOD_EVENTS = "good_events"
+SLO_BAD_EVENTS = "bad_events"
+
+# Fixed cumulative-bucket bounds (milliseconds) for the Prometheus
+# `le`-bucket exposition of every latency histogram.  FIXED ON PURPOSE:
+# external Prometheus can only aggregate `_bucket` series across
+# replicas (histogram_quantile over a sum()) when every replica exports
+# the identical bound set.
+HISTOGRAM_BUCKET_BOUNDS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0)
+
 
 class Counter:
     def __init__(self):
@@ -357,13 +387,34 @@ class Histogram:
         self._values: deque = deque(maxlen=max(1, int(window)))
         self._total_count = 0
         self._total_sum = 0.0
+        # cumulative per-bound counts over the FIXED shared bound set
+        # (HISTOGRAM_BUCKET_BOUNDS_MS) — the +Inf bucket is
+        # total_count.  Stored non-cumulative per slot; bucket_counts()
+        # emits the running `le` form Prometheus wants.
+        self._bucket_slots = [0] * len(HISTOGRAM_BUCKET_BOUNDS_MS)
         self._lock = threading.Lock()
 
     def update(self, v: float):
+        i = bisect.bisect_left(HISTOGRAM_BUCKET_BOUNDS_MS, v)
         with self._lock:
             self._values.append(v)
             self._total_count += 1
             self._total_sum += v
+            if i < len(self._bucket_slots):
+                self._bucket_slots[i] += 1
+
+    def bucket_counts(self) -> List[tuple]:
+        """Cumulative ``(le_bound_ms, count)`` pairs, monotonic in both
+        coordinates, ending with ``(inf, total_count)``."""
+        with self._lock:
+            slots = list(self._bucket_slots)
+            total = self._total_count
+        out, run = [], 0
+        for bound, n in zip(HISTOGRAM_BUCKET_BOUNDS_MS, slots):
+            run += n
+            out.append((bound, run))
+        out.append((float("inf"), total))
+        return out
 
     @property
     def total_count(self) -> int:
@@ -532,6 +583,12 @@ class MetricRegistry:
         sweeps in maintenance/)."""
         return self.group("fleet", table)
 
+    def slo_metrics(self, table: str = "") -> MetricGroup:
+        """SLO burn-rate plane (ours; obs/slo.py SloEvaluator —
+        pre-allocated so the `paimon_slo_*` series exist from the
+        first scrape, before any request has been judged)."""
+        return self.group("slo", table)
+
     def snapshot_rows(self) -> List[Dict[str, object]]:
         """Flat typed rows — THE single serialization point behind
         every observability surface (`$metrics` system table,
@@ -566,7 +623,8 @@ class MetricRegistry:
                                  "mean": mean,
                                  "p95": m.percentile(95), "max": m.max,
                                  "total_count": m.total_count,
-                                 "total_sum": m.total_sum})
+                                 "total_sum": m.total_sum,
+                                 "buckets": m.bucket_counts()})
         return rows
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:

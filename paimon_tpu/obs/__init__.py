@@ -6,24 +6,40 @@ commit — and one serialization point (`MetricRegistry.snapshot_rows`)
 feeds every surface:
 
 * Chrome trace-event JSON export (`obs/export.py`, opens in Perfetto);
+* fleet-wide merged traces (`obs/merge.py`): per-process spools under
+  `trace.export.dir` stitched into one Perfetto file with flow arrows
+  across every serving hop and store-carried context boundary;
+* the black-box flight recorder (`obs/flight.py`): an always-on ring
+  of operational events dumped on crash/SIGTERM and on demand;
+* the SLO burn-rate plane (`obs/slo.py`): declarative availability +
+  latency objectives served at /slo and aggregated on the router;
 * `$metrics` / `$traces` system tables (`table/system.py`);
 * Prometheus text exposition (`GET /metrics` on the query service);
-* CLI: `paimon table metrics <db.table>` and `--trace out.json`.
+* CLI: `paimon table metrics`, `paimon table debug-bundle`,
+  `paimon fleet trace --merge`, and `--trace out.json`.
 """
 
 from paimon_tpu.obs.trace import (  # noqa: F401
-    Span, TraceCollector, collector, disable_tracing, enable_tracing,
-    metrics_enabled, set_metrics_enabled, span, sync_from_options,
-    take_spans, tracing_enabled,
+    Span, TraceCollector, collector, current_context_token,
+    current_trace_id, disable_tracing, enable_tracing, inject_headers,
+    metrics_enabled, new_trace_id, process_tag, server_span,
+    set_export_dir, set_metrics_enabled, set_replica_id, span,
+    spool_flush, sync_from_options, take_spans, tracing_enabled,
 )
 from paimon_tpu.obs.export import (  # noqa: F401
     export_chrome_trace, render_prometheus, to_chrome_trace,
 )
+from paimon_tpu.obs.merge import (  # noqa: F401
+    export_merged, merge_spools, read_spools,
+)
 
 __all__ = [
-    "Span", "TraceCollector", "collector", "disable_tracing",
-    "enable_tracing", "export_chrome_trace", "metrics_enabled",
-    "render_prometheus", "set_metrics_enabled", "span",
-    "sync_from_options", "take_spans", "to_chrome_trace",
-    "tracing_enabled",
+    "Span", "TraceCollector", "collector", "current_context_token",
+    "current_trace_id", "disable_tracing", "enable_tracing",
+    "export_chrome_trace", "export_merged", "inject_headers",
+    "merge_spools", "metrics_enabled", "new_trace_id", "process_tag",
+    "read_spools", "render_prometheus", "server_span",
+    "set_export_dir", "set_metrics_enabled", "set_replica_id", "span",
+    "spool_flush", "sync_from_options", "take_spans",
+    "to_chrome_trace", "tracing_enabled",
 ]

@@ -100,7 +100,14 @@ def render_prometheus(rows: Optional[List[Dict]] = None) -> str:
     quantile comes from the sliding window, while `_sum`/`_count` are
     the histogram's CUMULATIVE totals (monotonic, as rate()/increase()
     require; window-derived values would cap at the window size) —
-    plus a `_max` gauge over the window.  `rows` defaults to
+    plus a `_max` gauge over the window.  Rows that carry cumulative
+    `buckets` additionally render a REAL `le`-bucket histogram family
+    under `<base>_hist` (0.0.4 forbids mixing summary and histogram
+    samples in one family, and the summary name is the compatibility
+    surface), so an external Prometheus can pool
+    `histogram_quantile(0.99, sum by (le) (rate(..._hist_bucket[5m])))`
+    across replicas — per-replica quantiles can't be aggregated, shared
+    fixed buckets can.  `rows` defaults to
     `global_registry().snapshot_rows()`, THE shared serialization
     point.
     """
@@ -126,6 +133,20 @@ def render_prometheus(rows: Optional[List[Dict]] = None) -> str:
             mx = base + "_max"
             kinds[mx] = "gauge"
             families.setdefault(mx, []).append((mx + labels, r["max"]))
+            if r.get("buckets"):
+                hist = base + "_hist"
+                kinds[hist] = "histogram"
+                hf = families.setdefault(hist, [])
+                for bound, n in r["buckets"]:
+                    le = "+Inf" if bound == float("inf") \
+                        else _fmt(bound)
+                    lb = '{le="%s"}' % le if not labels else \
+                        labels[:-1] + ',le="%s"}' % le
+                    hf.append((hist + "_bucket" + lb, n))
+                hf.append((hist + "_sum" + labels,
+                           r.get("total_sum", 0.0)))
+                hf.append((hist + "_count" + labels,
+                           r.get("total_count", 0)))
         else:
             name = _prom_name(r["group"], r["metric"])
             kinds[name] = "counter" if r["kind"] == "counter" else "gauge"

@@ -36,6 +36,9 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import json
+import os
+import platform
 import threading
 import time
 from collections import deque
@@ -44,9 +47,27 @@ from typing import Dict, List, Optional
 __all__ = ["Span", "TraceCollector", "span", "enable_tracing",
            "disable_tracing", "tracing_enabled", "set_metrics_enabled",
            "metrics_enabled", "collector", "take_spans",
-           "sync_from_options", "export_path"]
+           "sync_from_options", "export_path", "export_dir",
+           "set_export_dir", "process_tag", "set_replica_id",
+           "new_trace_id", "current_trace_id", "current_context_token",
+           "inject_headers", "server_span", "spool_flush",
+           "reset_spool"]
 
 DEFAULT_BUFFER_SPANS = 8192
+
+# Span stage names introduced by the fleet plane.  Producers use
+# these BY NAME (the analysis-plane obs-drift rule checks that every
+# STAGE_* constant has a producer in the package), so a renamed
+# stage that loses its producer fails analysis instead of silently
+# vanishing from the merged timeline.
+STAGE_SERVE_REQUEST = "serve.request"
+STAGE_CLIENT_REQUEST = "client.request"
+STAGE_PLAN_LINK = "plan.link"
+STAGE_LEASE_FOLD = "lease.fold"
+
+# Header names of the W3C-style context carried on every serving hop.
+HDR_TRACE_ID = "X-Trace-Id"
+HDR_PARENT_SPAN = "X-Parent-Span"
 
 
 class Span:
@@ -121,9 +142,26 @@ _enabled = False
 _metrics_on = True
 _collector = TraceCollector()
 _export_path: Optional[str] = None
+_export_dir: Optional[str] = None
 _ids = itertools.count(1)
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "paimon_current_span", default=None)
+_trace_id: contextvars.ContextVar = contextvars.ContextVar(
+    "paimon_trace_id", default=None)
+
+# Process identity for cross-process span references.  The OS reuses
+# pids, so a random salt keeps tokens unique across a fleet's whole
+# lifetime (a crashed worker's pid can be handed to its replacement).
+_PROC = "%s-%d-%s" % (platform.node(), os.getpid(),
+                      os.urandom(3).hex())
+_replica_id: Optional[str] = None
+
+# Spool bookkeeping: the per-process .jsonl under `trace.export.dir`
+# is append-only; `_spooled_through` is the highest span id already on
+# disk so repeated flushes never duplicate lines.
+_spool_lock = threading.Lock()
+_spooled_through = 0
+_spool_header_done = False
 
 
 class _NoopSpan:
@@ -229,6 +267,182 @@ def span(name: str, *, cat: str = "", group: Optional[str] = None,
     return _LiveSpan(name, cat, group, metric or name, attrs)
 
 
+# -- cross-process trace context --------------------------------------------
+
+def process_tag() -> str:
+    """Stable identity of this process inside a fleet trace:
+    ``<host>-<pid>-<salt>``.  Span references across process
+    boundaries are ``<process_tag>:<span_id>`` tokens."""
+    return _PROC
+
+
+def set_replica_id(replica_id: Optional[str]) -> None:
+    """Tag this process's spool with a serving replica id so merged
+    traces name tracks by replica, not just host-pid."""
+    global _replica_id
+    _replica_id = replica_id
+
+
+def new_trace_id() -> str:
+    """Fresh 128-bit trace id (32 hex chars, W3C trace-id shaped)."""
+    return os.urandom(16).hex()
+
+
+def current_trace_id() -> Optional[str]:
+    return _trace_id.get()
+
+
+def current_context_token() -> Optional[str]:
+    """``<process_tag>:<span_id>`` of the current span, or None when
+    no span is open (or tracing is off).  This is what gets stamped
+    into snapshot commit properties and the X-Parent-Span header."""
+    if not _enabled:
+        return None
+    sid = _current.get()
+    if sid is None:
+        return None
+    return f"{_PROC}:{sid}"
+
+
+def inject_headers(headers: Dict[str, str]) -> Dict[str, str]:
+    """Add the W3C-style context headers to an outbound request.  A
+    no-op unless tracing is on and a span is current; allocates a
+    trace id lazily so the first hop of a request mints it."""
+    if not _enabled:
+        return headers
+    sid = _current.get()
+    if sid is None:
+        return headers
+    tid = _trace_id.get()
+    if tid is None:
+        tid = new_trace_id()
+        _trace_id.set(tid)
+    headers[HDR_TRACE_ID] = tid
+    headers[HDR_PARENT_SPAN] = f"{_PROC}:{sid}"
+    return headers
+
+
+class _AdoptedSpan:
+    """Server-side request span that adopts the remote caller's
+    context: the trace id rides the contextvar for the handler's
+    duration, and the remote parent token lands in the span attrs
+    (``remote_parent``), where the fleet merge tool turns it into a
+    flow arrow between the two processes' tracks."""
+
+    __slots__ = ("_headers", "_attrs", "_inner", "_tid_token")
+
+    def __init__(self, headers: Dict[str, str], attrs: Dict):
+        self._headers = headers
+        self._attrs = attrs
+
+    def __enter__(self):
+        tid = self._headers.get("x-trace-id")
+        parent = self._headers.get("x-parent-span")
+        self._tid_token = _trace_id.set(tid) if tid else None
+        if tid:
+            self._attrs["trace_id"] = tid
+        if parent:
+            self._attrs["remote_parent"] = parent
+        self._inner = _LiveSpan(STAGE_SERVE_REQUEST, "serve", None,
+                                None, self._attrs)
+        self._inner.__enter__()
+        return self._inner
+
+    def __exit__(self, exc_type, exc, tb):
+        r = self._inner.__exit__(exc_type, exc, tb)
+        if self._tid_token is not None:
+            _trace_id.reset(self._tid_token)
+        return r
+
+
+def server_span(headers: Optional[Dict[str, str]], **attrs):
+    """Context manager wrapping one inbound request's handler; the
+    shared no-op when tracing is off (one flag check on the serving
+    hot path).  `headers` are the request's lower-cased headers."""
+    if not _enabled:
+        return _NOOP
+    return _AdoptedSpan(headers or {}, attrs)
+
+
+# -- per-process spool under trace.export.dir -------------------------------
+
+def _jsonable(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
+
+
+def spool_flush() -> Optional[str]:
+    """Append spans newer than the last flush to this process's
+    ``<trace.export.dir>/<process_tag>.jsonl``; returns the spool path
+    or None when no dir is configured.  The first line is a process
+    header carrying identity plus a (wall clock, perf_counter) anchor
+    pair — span timestamps are on the process-local perf_counter
+    timeline, and the merge tool uses the anchor to re-base every
+    process onto one shared wall-clock timeline.
+
+    Like `maybe_export`, a spool failure warns instead of raising: the
+    recorder must never fail the data path it observes."""
+    global _spooled_through, _spool_header_done
+    if _export_dir is None:
+        return None
+    spans = _collector.snapshot()
+    path = os.path.join(_export_dir, _PROC + ".jsonl")
+    with _spool_lock:
+        fresh = [s for s in spans if s.span_id > _spooled_through]
+        try:
+            os.makedirs(_export_dir, exist_ok=True)
+            with open(path, "a") as f:
+                if not _spool_header_done:
+                    f.write(json.dumps({
+                        "proc": _PROC, "pid": os.getpid(),
+                        "host": platform.node(),
+                        "replica": _replica_id,
+                        "wall_s": time.time(),
+                        "perf_s": time.perf_counter(),
+                    }) + "\n")
+                    _spool_header_done = True
+                for s in fresh:
+                    f.write(json.dumps({
+                        "sid": s.span_id, "parent": s.parent_id,
+                        "name": s.name, "cat": s.cat,
+                        "ts": round(s.start_us, 3),
+                        "dur": round(s.dur_us, 3),
+                        "tid": s.tid, "thread": s.thread,
+                        "attrs": {k: _jsonable(v)
+                                  for k, v in s.attrs.items()},
+                    }) + "\n")
+        except OSError as e:
+            import warnings
+            warnings.warn(f"trace spool to {path!r} failed: {e}",
+                          RuntimeWarning)
+            return None
+        if fresh:
+            _spooled_through = max(_spooled_through,
+                                   max(s.span_id for s in fresh))
+    return path
+
+
+def reset_spool() -> None:
+    """Forget spool state (tests): the next flush rewrites the header
+    and re-spools the whole ring to a fresh file."""
+    global _spooled_through, _spool_header_done
+    with _spool_lock:
+        _spooled_through = 0
+        _spool_header_done = False
+
+
+def set_export_dir(d: Optional[str]) -> None:
+    global _export_dir
+    if d != _export_dir:
+        _export_dir = d
+        reset_spool()
+
+
+def export_dir() -> Optional[str]:
+    return _export_dir
+
+
 # -- switches ----------------------------------------------------------------
 
 def enable_tracing(max_spans: Optional[int] = None):
@@ -298,6 +512,8 @@ def sync_from_options(options) -> None:
         set_metrics_enabled(bool(raw.get(CoreOptions.METRICS_ENABLED)))
     if raw.contains(CoreOptions.TRACE_EXPORT_PATH):
         _export_path = raw.get(CoreOptions.TRACE_EXPORT_PATH)
+    if raw.contains(CoreOptions.TRACE_EXPORT_DIR):
+        set_export_dir(raw.get(CoreOptions.TRACE_EXPORT_DIR))
 
 
 def maybe_export() -> Optional[str]:
@@ -307,7 +523,11 @@ def maybe_export() -> Optional[str]:
     An export failure (unwritable path) must never fail — or, from a
     `finally`, MASK the error of — the data path it observes: it
     warns and returns None instead."""
-    if _export_path is None or not _enabled:
+    if not _enabled:
+        return None
+    if _export_dir is not None:
+        spool_flush()
+    if _export_path is None:
         return None
     from paimon_tpu.obs.export import export_chrome_trace
     try:

@@ -619,6 +619,40 @@ class CoreOptions:
         "Hysteresis: once entered, a brownout rung holds at least "
         "this long before the ladder may step back down (prevents "
         "flapping between shed and un-shed at the pressure boundary)")
+    SERVICE_SLO_ENABLED = ConfigOption(
+        "service.slo.enabled", _parse_bool, True,
+        "Evaluate declarative SLOs on the serving plane (obs/slo.py): "
+        "every response feeds an availability and a latency-p99 "
+        "objective as multi-window burn rates, served at GET /slo per "
+        "replica, aggregated fleet-wide on the router, rendered by "
+        "`paimon fleet status`, and exported as the `slo` Prometheus "
+        "group")
+    SERVICE_SLO_AVAILABILITY_TARGET = ConfigOption(
+        "service.slo.availability-target", float, 0.999,
+        "Availability objective: the fraction of requests that must "
+        "succeed (429 load-sheds and 5xx count against the budget; "
+        "other 4xx are the caller's fault).  0.999 leaves a 0.1% "
+        "error budget")
+    SERVICE_SLO_LATENCY_P99_MS = ConfigOption(
+        "service.slo.latency-p99-ms", float, 250.0,
+        "Latency objective: 99% of requests must finish within this "
+        "many milliseconds; the over-threshold fraction burns the 1% "
+        "latency budget")
+    SERVICE_SLO_FAST_WINDOW_S = ConfigOption(
+        "service.slo.fast-window-s", float, 300.0,
+        "Fast burn-rate window (seconds): detects a budget-burning "
+        "incident quickly but flaps easily — the alert fires only "
+        "when the slow window agrees")
+    SERVICE_SLO_SLOW_WINDOW_S = ConfigOption(
+        "service.slo.slow-window-s", float, 3600.0,
+        "Slow burn-rate window (seconds): stable confirmation leg of "
+        "the multi-window alert; clamped to at least the fast window")
+    SERVICE_SLO_BURN_THRESHOLD = ConfigOption(
+        "service.slo.burn-threshold", float, 2.0,
+        "Burn-rate level both windows must reach to flip the alert: "
+        "1.0 spends the budget exactly at objective pace, 2.0 spends "
+        "a month's budget in ~15 days — the conventional page "
+        "threshold for a combined fast+slow pair")
 
     # -- multi-host write plane (ours; parallel/multihost.py +
     #    parallel/distributed.py) --------------------------------------------
@@ -723,6 +757,35 @@ class CoreOptions:
         "this file as Chrome trace-event JSON at pipeline completion "
         "points (scan drained, write pool shut down, mesh compaction "
         "finished); the CLI --trace flag is the one-shot equivalent")
+    TRACE_EXPORT_DIR = ConfigOption(
+        "trace.export.dir", str, None,
+        "Shared spool directory for FLEET traces: every process with "
+        "this set appends its spans (tagged host/pid/replica, with a "
+        "wall-clock anchor) to its own <dir>/<process-tag>.jsonl at "
+        "the same completion points plus daemon shutdown/SIGTERM; "
+        "`paimon fleet trace --merge <dir>` stitches the spools into "
+        "one Perfetto file with per-process tracks and flow arrows at "
+        "every serving hop and store-carried context boundary")
+    OBS_FLIGHT_ENABLED = ConfigOption(
+        "obs.flight.enabled", _parse_bool, True,
+        "Black-box flight recorder (obs/flight.py): keep an always-on "
+        "bounded ring of operational events — retry arms, breaker "
+        "flips, brownout transitions, 429/504 sheds, commit conflicts, "
+        "lease expiries, takeovers, rejoin grants, loop crashes — "
+        "dumped atomically on crash/SIGTERM and by `paimon table "
+        "debug-bundle`.  Recording is one dict append under a leaf "
+        "lock; disable only if that is too much")
+    OBS_FLIGHT_EVENTS = ConfigOption(
+        "obs.flight.events", int, 512,
+        "Capacity of the flight-recorder event ring; oldest events "
+        "evict first")
+    OBS_FLIGHT_DUMP_DIR = ConfigOption(
+        "obs.flight.dump.dir", str, None,
+        "When set, installs crash hooks (sys.excepthook + atexit + the "
+        "stream daemon's signal handler) that dump the flight ring to "
+        "flight-<host>-<pid>-<ms>.json under this directory, so a "
+        "crashed or SIGTERM'd process leaves its last events behind "
+        "for `paimon fleet trace` forensics")
 
     # -- streaming daemon (ours; service/stream_daemon.py) -------------------
     STREAM_CHECKPOINT_INTERVAL = ConfigOption(

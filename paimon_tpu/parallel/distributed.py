@@ -420,16 +420,25 @@ def merge_lease_view(table, max_walk: int = 16) -> Dict[int, int]:
     window (not just the tip) keeps concurrent committers from
     regressing each other — each stamps the view IT knew, and the
     interleaving is resolved by max()."""
+    from paimon_tpu.obs.trace import (
+        STAGE_LEASE_FOLD, span, tracing_enabled,
+    )
     sm = table.snapshot_manager
     latest = sm.latest_snapshot_id()
     if latest is None:
         return {}
     earliest = sm.earliest_snapshot_id() or latest
     view: Dict[int, int] = {}
+    link_ctx = link_sid = None
     for sid in range(latest, max(earliest, latest - max_walk) - 1, -1):
         if not sm.snapshot_exists(sid):
             continue
         props = sm.snapshot(sid).properties or {}
+        if link_ctx is None and props.get("trace.context"):
+            # newest store-carried context in the fold window: the
+            # detector's fold links back to the peer whose commit it
+            # consumed — THE worker<->worker boundary in merged traces
+            link_ctx, link_sid = props["trace.context"], sid
         for k, v in props.items():
             if not k.startswith(LEASE_PROP_PREFIX):
                 continue
@@ -439,6 +448,10 @@ def merge_lease_view(table, max_walk: int = 16) -> Dict[int, int]:
                 continue
             if ms > view.get(p, -1):
                 view[p] = ms
+    if link_ctx is not None and tracing_enabled():
+        with span(STAGE_LEASE_FOLD, cat="maintenance", link=link_ctx,
+                  snapshot=link_sid):
+            pass
     return view
 
 

@@ -107,6 +107,9 @@ class BucketRetryPolicy:
                     raise
                 if on_retry is not None:
                     on_retry(attempt, e)
+                from paimon_tpu.obs.flight import EV_RETRY, record
+                record(EV_RETRY, attempt=attempt,
+                       error=type(e).__name__)
                 from paimon_tpu.obs.trace import span
                 with span("retry.backoff", cat="compaction",
                           attempt=attempt, error=type(e).__name__):

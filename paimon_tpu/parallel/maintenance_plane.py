@@ -192,6 +192,11 @@ class MaintenancePlane:
         # a declaration the caller is still acting on)
         self._declared: set = set(self.ownership.dead)
         self._commit = None
+        # trace context of the detector round that adopted a dead
+        # peer; rides the NEXT stamped commit as `trace.context` so
+        # the published takeover links back to the detection span in
+        # the merged fleet trace (volatile like the adoption itself)
+        self._takeover_ctx: Optional[str] = None
         self._update_owned_gauge()
         self._update_generation_gauge()
 
@@ -220,6 +225,9 @@ class MaintenancePlane:
         props = self.history.to_properties()
         props.update(lease_props(self.process_index, self._clock(),
                                  self._view))
+        if self._takeover_ctx is not None:
+            props.setdefault("trace.context", self._takeover_ctx)
+            self._takeover_ctx = None
         return props
 
     def attach(self, file_store_commit) -> None:
@@ -342,6 +350,9 @@ class MaintenancePlane:
             self._declared |= newly
             self._metrics.counter(MULTIHOST_LEASE_EXPIRED).inc(
                 len(newly))
+            from paimon_tpu.obs.flight import EV_LEASE_EXPIRED, record
+            record(EV_LEASE_EXPIRED, detector=self.process_index,
+                   peers=sorted(newly))
         return newly
 
     def adopt(self, dead) -> None:
@@ -359,6 +370,10 @@ class MaintenancePlane:
                 MULTIHOST_MAINTENANCE_TAKEOVERS).inc()
             self._update_owned_gauge()
             self._update_generation_gauge()
+            from paimon_tpu.obs.flight import EV_TAKEOVER, record
+            record(EV_TAKEOVER, survivor=self.process_index,
+                   dead=sorted(self.ownership.dead),
+                   generation=self.ownership.version)
 
     def detect_and_take_over(self, now_ms: Optional[int] = None,
                              refresh: bool = True) -> FrozenSet[int]:
@@ -370,7 +385,13 @@ class MaintenancePlane:
         from store state alone."""
         newly = self.detect_expired(now_ms, refresh)
         if newly and self.takeover_enabled:
-            self.adopt(newly)
+            from paimon_tpu.obs.trace import (
+                current_context_token, span,
+            )
+            with span("maintenance.takeover", cat="maintenance",
+                      detector=self.process_index, dead=sorted(newly)):
+                self.adopt(newly)
+                self._takeover_ctx = current_context_token()
         return newly
 
     # -- coordinated rejoin --------------------------------------------------
@@ -434,6 +455,10 @@ class MaintenancePlane:
         self._fleet.counter(FLEET_REJOINS).inc(len(returning))
         self._update_owned_gauge()
         self._update_generation_gauge()
+        from paimon_tpu.obs.flight import EV_REJOIN_GRANT, record
+        record(EV_REJOIN_GRANT, granter=self.process_index,
+               returning=sorted(returning),
+               generation=self.ownership.version)
         return returning
 
     # -- heartbeats ----------------------------------------------------------

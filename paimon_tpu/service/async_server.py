@@ -51,6 +51,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
+from paimon_tpu.obs.trace import server_span
+
 __all__ = ["AsyncHttpServer", "HttpRequest", "HttpResponse"]
 
 # request-line + headers must fit here; a client that cannot finish its
@@ -274,7 +276,13 @@ class AsyncHttpServer:
 
     def _run_handler(self, conn: _Conn, slot: _Slot, req: HttpRequest):
         try:
-            resp = self._handler(req)
+            # one flag check when tracing is off; when on, adopts the
+            # caller's X-Trace-Id/X-Parent-Span as this request's
+            # context — THE server-side hop boundary for every
+            # AsyncHttpServer-based service (query server, router)
+            with server_span(req.headers, method=req.method,
+                             path=req.path):
+                resp = self._handler(req)
         except Exception as e:      # noqa: BLE001 — must answer
             # json.dumps, never string splicing: exception text may
             # hold quotes/backslashes/control chars and the body must

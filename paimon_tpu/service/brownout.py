@@ -110,8 +110,12 @@ class BrownoutController:
         rate signal (called by the server for every response)."""
         if status == 429:
             self.rejected.record()
+            from paimon_tpu.obs.flight import EV_HTTP_429, record
+            record(EV_HTTP_429)
         elif status == 504:
             self.timeouts.record()
+            from paimon_tpu.obs.flight import EV_HTTP_504, record
+            record(EV_HTTP_504)
 
     def signals(self) -> Dict[str, object]:
         """The three pressure signals, as /healthz reports them."""
@@ -149,6 +153,11 @@ class BrownoutController:
 
     def _apply_locked(self, level: int, now: float):
         from paimon_tpu.fs.resilience import set_degraded_for
+        from paimon_tpu.obs.flight import EV_BROWNOUT, record
+        if level != self._level:
+            # flight-recorder: rung transitions are exactly the
+            # "what changed right before it broke" an operator wants
+            record(EV_BROWNOUT, frm=self._level, to=level)
         self._level = level
         self._held_until = now + self.hold_ms / 1000.0
         self._g_level.set(level)

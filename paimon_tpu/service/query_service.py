@@ -190,6 +190,18 @@ class KvQueryServer:
             CoreOptions.SERVICE_REQUEST_TIMEOUT)
         from paimon_tpu.service.brownout import BrownoutController
         self.brownout = BrownoutController(self.admission, opts)
+        # fleet observability: sync the process-global trace/flight
+        # switches from this table's options (explicit keys win), tag
+        # the trace spool with the replica id, and stand up the SLO
+        # burn-rate evaluator every response feeds
+        from paimon_tpu.obs import flight as _flight
+        from paimon_tpu.obs import trace as _trace
+        _trace.sync_from_options(opts)
+        _flight.sync_from_options(opts)
+        _trace.set_replica_id(f"r{self.replica_id}")
+        from paimon_tpu.obs.slo import SloConfig, SloEvaluator
+        self.slo = SloEvaluator(SloConfig.from_options(opts),
+                                table=table.name)
         from paimon_tpu.metrics import (
             SERVICE_CHANGELOG_MS, SERVICE_CONNECTIONS,
             SERVICE_LOOKUP_CPU_MS, SERVICE_LOOKUP_KEYS,
@@ -382,6 +394,11 @@ class KvQueryServer:
         self.server.stop()
         # the process-wide degraded switch must not outlive the server
         self.brownout.reset()
+        # flush the trace spool/export (fleet merge must include a
+        # replica's last serving spans even when it exits cleanly
+        # between pipeline completion points)
+        from paimon_tpu.obs.trace import maybe_export
+        maybe_export()
         # persist BEFORE close drops the SST store: a restarting
         # replica finds this one's warm state on the shared SSD tier
         if self._warmboot_dir is not None:
@@ -432,6 +449,13 @@ class KvQueryServer:
         if req.path == "/stats":
             try:
                 return self._json_response(200, self.stats())
+            except Exception as e:      # noqa: BLE001
+                return self._json_response(500, {"error": str(e)})
+        if req.path == "/slo":
+            # burn rates + alert state NOW (also refreshes the `slo`
+            # Prometheus gauges, so a scrape can't disagree)
+            try:
+                return self._json_response(200, self.slo.evaluate())
             except Exception as e:      # noqa: BLE001
                 return self._json_response(500, {"error": str(e)})
         if req.path != "/metrics":
@@ -576,6 +600,10 @@ class KvQueryServer:
         except Exception as e:      # noqa: BLE001
             status, payload = 500, {"error": str(e)}
         self.brownout.record_outcome(status)
+        # every data-path response is an SLO event — INCLUDING sheds
+        # and deadline misses; that is exactly what the availability
+        # objective counts
+        self.slo.observe(status, (_time.perf_counter() - t0) * 1000.0)
         if status not in (429, 504):
             # 429s spent their time in the admission queue and 504s
             # are deadline-bounded by construction —
@@ -851,6 +879,21 @@ class KvQueryClient:
             body.setdefault("timeout_ms", self.timeout_ms)
         payload = json.dumps(body).encode()
         headers = {"Content-Type": "application/json"}
+        from paimon_tpu.obs.trace import (
+            STAGE_CLIENT_REQUEST, inject_headers, span,
+        )
+        # the client-side hop span: inject_headers mints the 128-bit
+        # trace id (first hop) and stamps X-Trace-Id/X-Parent-Span so
+        # the server's serve.request span records this one as its
+        # remote parent — the merged fleet trace draws the arrow
+        with span(STAGE_CLIENT_REQUEST, cat="serve",
+                  endpoint=endpoint):
+            inject_headers(headers)
+            return self._post_conn(endpoint, payload, headers, timeout,
+                                   idempotent)
+
+    def _post_conn(self, endpoint: str, payload: bytes, headers: dict,
+                   timeout: int, idempotent: bool) -> dict:
         with self._lock:
             self._ensure_topology_locked(timeout)
             address = self._target_address()
@@ -929,6 +972,25 @@ class KvQueryClient:
             if resp.status != 200:
                 raise RuntimeError(
                     f"healthz failed: {resp.status} "
+                    f"{data.decode(errors='replace')}")
+            return json.loads(data)
+        finally:
+            conn.close()
+
+    def slo(self) -> dict:
+        """GET /slo: multi-window burn rates + alert state for the
+        replica's declared objectives (one-shot connection, like
+        healthz).  Against a router this is the fleet-wide aggregate
+        (worst replica burn; alert if any replica alerts)."""
+        host, port = self._hostport(self.address)
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request("GET", "/slo")
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"slo failed: {resp.status} "
                     f"{data.decode(errors='replace')}")
             return json.loads(data)
         finally:

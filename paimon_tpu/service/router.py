@@ -317,6 +317,8 @@ class ReplicaRouter:
                 return self._healthz()
             if req.path == "/metrics":
                 return self._metrics()
+            if req.path == "/slo":
+                return self._slo()
             return HttpResponse(404, b'{"error": "not found"}')
         if req.method == "POST" and req.path in ("/register",
                                                  "/deregister"):
@@ -379,6 +381,13 @@ class ReplicaRouter:
         if "x-request-timeout-ms" in req.headers:
             fwd_headers["X-Request-Timeout-Ms"] = \
                 req.headers["x-request-timeout-ms"]
+        # propagate the trace context: the router's own serve.request
+        # span (adopted from the client by the engine) is current on
+        # this thread, so the replica links to the router hop and the
+        # router hop links to the client — the full chain survives
+        # the extra network boundary
+        from paimon_tpu.obs.trace import inject_headers
+        inject_headers(fwd_headers)
         try:
             status, data, up_headers = pool.request(
                 "POST", req.path, req.body, fwd_headers)
@@ -464,6 +473,36 @@ class ReplicaRouter:
         return HttpResponse(
             200, "\n".join(parts).encode(),
             content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    def _slo(self) -> HttpResponse:
+        """Fleet-wide SLO rollup: per-replica /slo documents folded by
+        obs/slo.aggregate_slo — the fleet burns at the WORST replica's
+        rate (an SLO is violated wherever any user lands) and alerts
+        on the OR.  Unreachable/suspended replicas degrade the answer
+        to partial instead of failing it, same contract as /metrics
+        federation."""
+        from paimon_tpu.obs.slo import aggregate_slo
+        per: Dict[str, Dict] = {}
+        with self._membership_lock:
+            replicas = list(self.replicas)
+            suspended = set(self._suspended)
+        for e in replicas:
+            rid = e["id"]
+            if rid in suspended:
+                per[str(rid)] = {"suspended": True}
+                continue
+            try:
+                status, body = self._replica_get(rid, "/slo")
+                doc = json.loads(body)
+                per[str(rid)] = doc if status == 200 else \
+                    {"error": doc}
+            except Exception as exc:      # noqa: BLE001
+                self._m_upstream_errors.inc()
+                per[str(rid)] = {"error": str(exc)}
+        agg = aggregate_slo(per)
+        agg["router"] = True
+        agg["suspended"] = sorted(suspended)
+        return HttpResponse(200, json.dumps(agg).encode())
 
 
 _SERIES_RE = re.compile(

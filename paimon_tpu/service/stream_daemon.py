@@ -295,6 +295,7 @@ class _Supervisor:
                     # it — status()/CLI exit code must not report a
                     # clean drain that wasn't
                     self.failed = True
+                    self._record_terminal("drain")
                     return
                 self.restarts += 1
                 d._metrics.counter(STREAM_LOOP_RESTARTS).inc()
@@ -304,6 +305,7 @@ class _Supervisor:
                 if d._o["max_restarts"] is not None and \
                         self.consecutive > d._o["max_restarts"]:
                     self.failed = True
+                    self._record_terminal("max_restarts")
                     return                    # terminal; status carries it
                 if healthy or backoff is None:
                     backoff = Backoff(d._o["restart_backoff_ms"],
@@ -313,6 +315,16 @@ class _Supervisor:
                           loop=self.name, attempt=self.restarts,
                           error=type(e).__name__):
                     d._stop.wait(wait_ms / 1000.0)
+
+    def _record_terminal(self, why: str):
+        """A loop died for good: black-box the crash so a post-mortem
+        can see the triggering event plus the preceding ring."""
+        from paimon_tpu.obs import flight
+        from paimon_tpu.obs.trace import spool_flush
+        flight.record(flight.EV_LOOP_CRASH, loop=self.name, why=why,
+                      error=self.last_error, restarts=self.restarts)
+        flight.dump()
+        spool_flush()
 
 
 class StreamDaemon:
@@ -357,6 +369,8 @@ class StreamDaemon:
             self.commit_user = commit_user
         o = self.table.options
         sync_from_options(o)
+        from paimon_tpu.obs import flight
+        flight.sync_from_options(o)
         self._o = {
             "ckpt_interval_ms": o.get(
                 CoreOptions.STREAM_CHECKPOINT_INTERVAL),
@@ -489,13 +503,25 @@ class StreamDaemon:
         for sup in self._loops:
             sup.join(10.0)
         self._close_sink()
+        from paimon_tpu.obs.trace import spool_flush
+        spool_flush()
         return self.status()
 
     def install_signal_handlers(self):
-        """SIGTERM/SIGINT -> graceful drain (run_forever returns)."""
+        """SIGTERM/SIGINT -> graceful drain (run_forever returns).
+
+        The handler flushes the trace spool and flight ring *before*
+        initiating the drain: if the drain itself wedges and the
+        process is then killed hard, the black box still made it to
+        disk."""
         import signal
 
         def handler(signum, frame):
+            from paimon_tpu.obs import flight
+            from paimon_tpu.obs.trace import spool_flush
+            flight.record(flight.EV_SIGTERM, signum=signum)
+            flight.dump()
+            spool_flush()
             self._signal.set()
 
         try:

@@ -574,16 +574,87 @@ def test_cli_list_rules(capsys):
     for rid in ("swallow", "threads", "sleeps", "sockets",
                 "collectives", "distributed-init",
                 "host-materialization", "metric-drift",
-                "options-drift", "lock-order", "loop-blocking",
-                "deadline-wait", "fault-taxonomy",
+                "obs-drift", "options-drift", "lock-order",
+                "loop-blocking", "deadline-wait", "fault-taxonomy",
                 "ownership-history"):
         assert rid in out, f"rule {rid} missing from catalog"
+
+
+# -- obs-drift ---------------------------------------------------------------
+
+def test_obs_drift_orphaned_stage_constant(tmp_path):
+    """A STAGE_* name nothing ever opens a span under is drift: the
+    merged fleet trace documents a stage that never appears."""
+    rep = lint(tmp_path, {
+        "obs/trace.py": """
+            STAGE_SERVE_REQUEST = "serve.request"
+            STAGE_GHOST = "ghost.stage"
+
+            def adopt():
+                return STAGE_SERVE_REQUEST
+        """,
+    }, ["obs-drift"])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert f.rule == "obs-drift"
+    assert "STAGE_GHOST" in f.message
+    assert f.file.endswith("obs/trace.py")
+
+
+def test_obs_drift_orphaned_flight_event(tmp_path):
+    rep = lint(tmp_path, {
+        "obs/flight.py": """
+            EV_RETRY = "retry"
+            EV_NEVER_RECORDED = "never"
+        """,
+        "parallel/fault.py": """
+            from fixturepkg.obs.flight import EV_RETRY
+
+            def on_retry():
+                return EV_RETRY
+        """,
+    }, ["obs-drift"])
+    assert [1 for f in rep.findings] == [1]
+    assert "EV_NEVER_RECORDED" in rep.findings[0].message
+
+
+def test_obs_drift_clean_when_all_consumed(tmp_path):
+    """Known-good: same-module use (trace.py's own adoption path)
+    and cross-module use both count as producers."""
+    rep = lint(tmp_path, {
+        "obs/trace.py": """
+            STAGE_SERVE_REQUEST = "serve.request"
+            STAGE_CLIENT_REQUEST = "client.request"
+
+            def adopt():
+                return STAGE_SERVE_REQUEST
+        """,
+        "obs/flight.py": """
+            EV_CRASH = "crash"
+
+            def _hook():
+                return EV_CRASH
+        """,
+        "service/query_service.py": """
+            from fixturepkg.obs.trace import STAGE_CLIENT_REQUEST
+
+            def post():
+                return STAGE_CLIENT_REQUEST
+        """,
+    }, ["obs-drift"])
+    assert rep.findings == []
+
+
+def test_obs_drift_ignores_packages_without_obs(tmp_path):
+    rep = lint(tmp_path, {"util.py": "def f():\n    return 1\n"},
+               ["obs-drift"])
+    assert rep.findings == []
 
 
 # -- the production tree -----------------------------------------------------
 
 def test_production_tree_zero_unsuppressed_findings(lint_report):
-    """THE acceptance gate: the full 14-rule catalog over paimon_tpu/
+    """THE acceptance gate: the full 15-rule catalog over paimon_tpu/
     reports zero unsuppressed findings — every new finding is either a
     bug to fix or a deliberate pattern that needs a reviewed,
     reasoned `# lint-ok:` marker at the site."""
@@ -597,10 +668,10 @@ def test_production_rule_catalog_is_complete(lint_report):
     assert ids >= {"swallow", "threads", "sleeps", "sockets",
                    "collectives", "distributed-init",
                    "host-materialization", "metric-drift",
-                   "options-drift", "lock-order", "loop-blocking",
-                   "deadline-wait", "fault-taxonomy",
+                   "obs-drift", "options-drift", "lock-order",
+                   "loop-blocking", "deadline-wait", "fault-taxonomy",
                    "ownership-history"}
-    assert len(ids) >= 14
+    assert len(ids) >= 15
 
 
 def test_production_suppressions_all_carry_reasons(lint_report):

@@ -258,7 +258,8 @@ class TestPrometheusEndpoint:
             if ln.startswith("# TYPE "):
                 _, _, rest = ln.partition("# TYPE ")
                 fam, kind = rest.rsplit(" ", 1)
-                assert kind in ("counter", "gauge", "summary"), ln
+                assert kind in ("counter", "gauge", "summary",
+                                "histogram"), ln
                 declared.add(fam)
             else:
                 assert PROM_SAMPLE.match(ln), f"invalid sample: {ln!r}"
@@ -274,7 +275,7 @@ class TestPrometheusEndpoint:
         for ln in lines:
             if not ln.startswith("#"):
                 name = re.split(r"[{ ]", ln, 1)[0]
-                base = re.sub(r"_(sum|count)$", "", name)
+                base = re.sub(r"_(sum|count|bucket)$", "", name)
                 assert name in declared or base in declared, ln
 
     def test_render_prometheus_escapes_labels(self):
@@ -298,6 +299,53 @@ class TestPrometheusEndpoint:
         text = render_prometheus(reg.snapshot_rows())
         assert "paimon_scan_lat_ms_count 250" in text
         assert "paimon_scan_lat_ms_sum 500" in text
+
+    def test_histogram_le_buckets_real_exposition(self):
+        """Satellite: every histogram additionally exports a REAL
+        cumulative `le`-bucket family (`<base>_hist`) so PromQL
+        histogram_quantile works fleet-wide — validated line by line:
+        fixed shared bounds, monotone cumulative counts, +Inf equals
+        _hist_count, and _hist_sum equals the cumulative total."""
+        from paimon_tpu.metrics import (
+            HISTOGRAM_BUCKET_BOUNDS_MS, MetricRegistry,
+        )
+        from paimon_tpu.obs.export import render_prometheus
+
+        reg = MetricRegistry()
+        h = reg.scan_metrics("t1").histogram("lat_ms")
+        values = [0.5, 1.0, 3.0, 30.0, 450.0, 99_999.0]
+        for v in values:
+            h.update(v)
+        text = render_prometheus(reg.snapshot_rows())
+        lines = [ln for ln in text.splitlines() if ln]
+        assert "# TYPE paimon_scan_lat_ms_hist histogram" in lines
+
+        sample = re.compile(
+            r'^paimon_scan_lat_ms_hist_bucket\{table="t1",'
+            r'le="([^"]+)"\} (\d+)$')
+        buckets = []
+        for ln in lines:
+            m = sample.match(ln)
+            if m:
+                buckets.append((m.group(1), int(m.group(2))))
+        # one line per shared fixed bound, +Inf last — the IDENTICAL
+        # bound set on every replica is what makes sum() aggregation
+        # across the fleet legal
+        assert [b for b, _ in buckets] == \
+            [("%g" % b) for b in HISTOGRAM_BUCKET_BOUNDS_MS] + ["+Inf"]
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), "le counts must be cumulative"
+        assert counts[-1] == len(values)
+        # le="1" counts 0.5 AND the exactly-1.0 update (le is <=)
+        assert counts[0] == 2
+        sum_ln = [ln for ln in lines
+                  if ln.startswith("paimon_scan_lat_ms_hist_sum")]
+        cnt_ln = [ln for ln in lines
+                  if ln.startswith("paimon_scan_lat_ms_hist_count")]
+        assert float(sum_ln[0].rsplit(" ", 1)[1]) == sum(values)
+        assert int(cnt_ln[0].rsplit(" ", 1)[1]) == len(values)
+        # the pre-existing summary family is untouched alongside
+        assert "# TYPE paimon_scan_lat_ms summary" in lines
 
 
 class TestSwitches:
@@ -470,11 +518,20 @@ def test_disabled_tracing_overhead_bounded(entry):
     lines = [json.loads(ln) for ln in proc.stdout.splitlines()]
     by_name = {d["benchmark"]: d for d in lines}
     assert {"obs_scan_noinstr", "obs_scan_trace_disabled",
-            "obs_scan_trace_enabled",
-            "obs_overhead_disabled_pct"} <= set(by_name)
+            "obs_scan_trace_enabled", "obs_scan_fleet",
+            "obs_overhead_disabled_pct",
+            "obs_overhead_fleet_pct"} <= set(by_name)
     overhead = by_name["obs_overhead_disabled_pct"]["value"]
     assert overhead < 5.0, (
         f"disabled-tracing overhead {overhead}% >= 5% "
         f"(noinstr={by_name['obs_scan_noinstr']['best_seconds']}s, "
         f"disabled="
         f"{by_name['obs_scan_trace_disabled']['best_seconds']}s)")
+    # the FULL fleet plane (tracing + flight ring + per-scan spool
+    # flush) is the worst case and still must stay in budget: the
+    # per-operation cost is one ring append + one buffered file append
+    fleet = by_name["obs_overhead_fleet_pct"]["value"]
+    assert fleet < 25.0, (
+        f"fleet-observability overhead {fleet}% >= 25% "
+        f"(noinstr={by_name['obs_scan_noinstr']['best_seconds']}s, "
+        f"fleet={by_name['obs_scan_fleet']['best_seconds']}s)")

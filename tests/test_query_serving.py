@@ -642,7 +642,8 @@ class TestServing:
         for ln in lines:
             if ln.startswith("# TYPE "):
                 fam, kind = ln[len("# TYPE "):].rsplit(" ", 1)
-                assert kind in ("counter", "gauge", "summary"), ln
+                assert kind in (
+                    "counter", "gauge", "summary", "histogram"), ln
                 declared[fam] = kind
             else:
                 assert prom_sample.match(ln), f"invalid sample: {ln!r}"
@@ -662,6 +663,12 @@ class TestServing:
             "paimon_lookup_block_cache_misses") == "counter"
         assert declared.get("paimon_lookup_reader_builds") == "counter"
         assert declared.get("paimon_lookup_files_pruned") == "counter"
+        # latency summaries also render a cumulative le-bucket family
+        assert declared.get("paimon_service_lookup_ms_hist") == "histogram"
+        assert any(
+            ln.startswith("paimon_service_lookup_ms_hist_bucket{")
+            and 'le="+Inf"' in ln for ln in lines), \
+            "cumulative +Inf bucket missing"
         # the per-tenant gauge carries the tenant as its label
         assert any(ln.startswith(
             'paimon_service_tenant_inflight_bytes{table="alice"}')

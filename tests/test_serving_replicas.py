@@ -616,6 +616,51 @@ class TestReplicas:
                 rs.address + "/metrics", timeout=10).read().decode()
             assert "paimon_service_requests" in text
 
+    def test_router_federation_survives_dead_remote(self, tmp_path):
+        """A remote replica that died does not poison the router's
+        aggregation surfaces: /metrics federates the live replica's
+        series (replica label intact) and skips the dead one, /slo
+        rolls up the live replica and lists the dead one as
+        unreachable — partial answers, never a 5xx."""
+        t = _pk_table(str(tmp_path / "t"))
+        _commit(t, _rows(10))
+        live = KvQueryServer(FileStoreTable.load(t.path),
+                             replica_id=1).start()
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_addr = "http://127.0.0.1:%d" % s.getsockname()[1]
+        s.close()                         # nobody listens here anymore
+        router = ReplicaRouter(
+            addresses={1: live.address, 2: dead_addr}, table_name="t")
+        router.server.start()
+        try:
+            # prime the live replica's serving series
+            with KvQueryClient(address=live.address,
+                               follow_topology=False) as c:
+                c.lookup_row({"id": 1})
+            import urllib.request
+            text = urllib.request.urlopen(
+                router.address + "/metrics",
+                timeout=10).read().decode()
+            with KvQueryClient(address=router.address,
+                               follow_topology=False) as c:
+                slo = c.slo()
+            live_lines = [ln for ln in text.splitlines()
+                          if ln.startswith("paimon_service_requests{")]
+            assert any('replica="1"' in ln for ln in live_lines), \
+                text[:2000]
+            assert not any('replica="2"' in ln
+                           for ln in text.splitlines())
+            assert slo["replicas"] == 1
+            assert "1" in slo["per_replica"]
+            assert "2" in slo["unreachable"]
+            assert slo["alert"] is False
+        finally:
+            router.server.stop()
+            for pool in router._remote.values():
+                pool.close()
+            live.stop()
+
     def test_hash_ring_stability_on_resize(self):
         nodes3 = [{"id": i, "address": f"http://h:{8000 + i}"}
                   for i in range(3)]
